@@ -1,0 +1,65 @@
+"""Core-runtime demo: event scheduling, random streams, CommandLine.
+
+Reference parity: src/core/examples/sample-simulator.cc — a model object
+schedules its own next event off an exponential random variable until the
+simulator is stopped.
+
+Run:  python examples/sample-simulator.py [--events=N] [--RngRun=R]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpudes.core import (
+    CommandLine,
+    ExponentialRandomVariable,
+    MilliSeconds,
+    Seconds,
+    Simulator,
+)
+
+
+class MyModel:
+    def __init__(self, limit):
+        self.count = 0
+        self.limit = limit
+        self.delay = ExponentialRandomVariable(Mean=0.5)
+
+    def start(self):
+        Simulator.Schedule(MilliSeconds(10), self.deal_with_event, 42.0)
+
+    def deal_with_event(self, value):
+        self.count += 1
+        print(f"at {Simulator.Now().GetSeconds():.6f}s: event #{self.count} value={value}")
+        if self.count < self.limit:
+            Simulator.Schedule(Seconds(self.delay.GetValue()), self.deal_with_event, value)
+
+
+def random_function(model):
+    print(f"at {Simulator.Now().GetSeconds():.6f}s: random function fired")
+    model.start()
+
+
+def cancelled_event():
+    print("this event should never run")
+
+
+def main(argv=None):
+    cmd = CommandLine("sample-simulator [--events=N]")
+    cmd.AddValue("events", "number of model events to run", 6)
+    cmd.Parse(argv)
+
+    model = MyModel(cmd.GetValue("events"))
+    Simulator.Schedule(Seconds(10), random_function, model)
+    doomed = Simulator.Schedule(Seconds(30), cancelled_event)
+    doomed.Cancel()
+    Simulator.Stop(Seconds(100))
+    Simulator.Run()
+    print(f"done at {Simulator.Now().GetSeconds():.6f}s after {Simulator.GetEventCount()} events")
+    Simulator.Destroy()
+
+
+if __name__ == "__main__":
+    main()

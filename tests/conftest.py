@@ -1,0 +1,37 @@
+"""Test configuration.
+
+JAX runs on a virtual 8-device CPU mesh (SURVEY.md 4: the analog of ns-3's
+mpirun-on-localhost distributed test harness) — set before any jax import.
+Every test gets a fresh simulator world.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_world():
+    """Reset all process-global simulator state between tests."""
+    from tpudes.core.simulator import Simulator
+    from tpudes.core.global_value import GlobalValue
+    from tpudes.core.rng import RngSeedManager
+    from tpudes.core.config import Names
+
+    yield
+    Simulator.Destroy()
+    GlobalValue.ResetAll()
+    RngSeedManager.Reset()
+    Names.Clear()
+    # network-layer globals (NodeList) reset lazily if the module is loaded
+    mod = sys.modules.get("tpudes.network.node")
+    if mod is not None:
+        mod.NodeList.Reset()

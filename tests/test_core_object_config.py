@@ -1,0 +1,171 @@
+"""Object model: TypeId, attributes, aggregation, trace sources,
+GlobalValue, Config paths, CommandLine (reference parity:
+src/core/test/ attribute/config test suites; SURVEY.md 4)."""
+
+import pytest
+
+from tpudes.core.command_line import CommandLine
+from tpudes.core.config import Config, Names
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.object import Object, ObjectFactory, TypeId
+from tpudes.core.trace import TracedCallback, TracedValue
+
+
+class Gadget(Object):
+    tid = (
+        TypeId("test::Gadget")
+        .AddConstructor(lambda **kw: Gadget(**kw))
+        .AddAttribute("Power", "tx power", 10.0)
+        .AddAttribute("Name", "a name", "gadget")
+        .AddTraceSource("Fired", "fired when poked")
+    )
+
+    def poke(self, x):
+        self.fired(x)
+
+
+class SuperGadget(Gadget):
+    tid = (
+        TypeId("test::SuperGadget")
+        .SetParent(Gadget.tid)
+        .AddConstructor(lambda **kw: SuperGadget(**kw))
+        .AddAttribute("Boost", "extra gain", 3.0)
+    )
+
+
+class Holder(Object):
+    tid = TypeId("test::Holder").AddAttribute("Gadgets", "child list", None)
+
+    def __init__(self, gadgets):
+        super().__init__()
+        self.gadgets = gadgets
+
+
+def test_attribute_defaults_and_set():
+    g = Gadget()
+    assert g.power == 10.0
+    assert g.GetAttribute("Power") == 10.0
+    g.SetAttribute("Power", 20.0)
+    assert g.power == 20.0
+
+
+def test_construct_overrides():
+    g = Gadget(Power=33.0, Name="bob")
+    assert g.power == 33.0 and g.name == "bob"
+
+
+def test_inherited_attributes():
+    s = SuperGadget()
+    assert s.power == 10.0 and s.boost == 3.0
+    s.SetAttribute("Power", 1.0)  # parent attribute reachable from child
+    assert s.power == 1.0
+
+
+def test_unknown_attribute_raises():
+    with pytest.raises(KeyError):
+        Gadget().SetAttribute("Nope", 1)
+    assert not Gadget().SetAttributeFailSafe("Nope", 1)
+
+
+def test_trace_source_connect():
+    g = Gadget()
+    got = []
+    assert g.TraceConnectWithoutContext("Fired", got.append)
+    g.poke(42)
+    assert got == [42]
+
+
+def test_trace_with_context():
+    g = Gadget()
+    got = []
+    g.TraceConnect("Fired", "/my/path", lambda ctx, v: got.append((ctx, v)))
+    g.poke(7)
+    assert got == [("/my/path", 7)]
+
+
+def test_aggregation():
+    a, b = Gadget(), SuperGadget()
+    a.AggregateObject(b)
+    assert a.GetObject(SuperGadget) is b
+    assert b.GetObject(Gadget) in (a, b)  # first match in ring
+    assert a.GetObject(TypeId.LookupByName("test::SuperGadget")) is b
+
+
+def test_object_factory():
+    f = ObjectFactory("test::Gadget", Power=5.0)
+    f.Set("Name", "fab")
+    g = f.Create()
+    assert g.power == 5.0 and g.name == "fab"
+
+
+def test_set_default():
+    Config.SetDefault("test::Gadget::Power", 99.0)
+    try:
+        assert Gadget().power == 99.0
+        # subclasses inherit the overridden default
+        assert SuperGadget().power == 99.0
+    finally:
+        from tpudes.core.object import _DEFAULT_OVERRIDES
+
+        _DEFAULT_OVERRIDES.clear()
+
+
+def test_config_paths_and_wildcards():
+    holders = [Holder([Gadget(), Gadget()]), Holder([Gadget()])]
+    Config.RegisterRootNamespaceObject("HolderList", lambda: holders)
+    Config.Set("/HolderList/0/Gadgets/1/Power", 55.0)
+    assert holders[0].gadgets[1].power == 55.0
+    assert holders[0].gadgets[0].power == 10.0
+    Config.Set("/HolderList/*/Gadgets/*/Power", 77.0)
+    assert all(g.power == 77.0 for h in holders for g in h.gadgets)
+    got = []
+    Config.Connect("/HolderList/*/Gadgets/*/Fired", lambda ctx, v: got.append(v))
+    holders[1].gadgets[0].poke(1)
+    assert got == [1]
+
+
+def test_names_registry():
+    g = Gadget()
+    Names.Add("ap", g)
+    assert Names.Find("ap") is g
+    assert Names.FindName(g) == "ap"
+
+
+def test_traced_value():
+    tv = TracedValue(5)
+    got = []
+    tv.ConnectWithoutContext(lambda old, new: got.append((old, new)))
+    tv.Set(6)
+    tv.Set(6)  # no change, no fire
+    tv.Set(7)
+    assert got == [(5, 6), (6, 7)]
+
+
+def test_command_line_custom_and_global():
+    cmd = CommandLine()
+    cmd.AddValue("nCsma", "number of CSMA nodes", 3)
+    cmd.Parse(["--nCsma=10", "--RngRun=5"])
+    assert cmd.GetValue("nCsma") == 10
+    assert GlobalValue.GetValue("RngRun") == 5
+
+
+def test_command_line_attribute_default():
+    cmd = CommandLine()
+    cmd.Parse(["--test::Gadget::Power=42"])
+    try:
+        assert Gadget().power == 42.0 or Gadget().power == "42"
+    finally:
+        from tpudes.core.object import _DEFAULT_OVERRIDES
+
+        _DEFAULT_OVERRIDES.clear()
+
+
+def test_command_line_unknown_raises():
+    with pytest.raises(ValueError):
+        CommandLine().Parse(["--nonsense=1"])
+
+
+def test_global_value_env(monkeypatch):
+    monkeypatch.setenv("NS_GLOBAL_VALUE", "RngRun=9;ChecksumEnabled=true")
+    GlobalValue.ApplyEnvironment()
+    assert GlobalValue.GetValue("RngRun") in (9, "9")

@@ -1,0 +1,183 @@
+"""RNG: MRG32k3a stream independence, run/substream selection,
+distribution sanity (statistical, tolerance-based — mirroring upstream
+random-variable-stream test suite; SURVEY.md 4)."""
+
+import math
+
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.rng import (
+    BernoulliRandomVariable,
+    ConstantRandomVariable,
+    DeterministicRandomVariable,
+    EmpiricalRandomVariable,
+    ErlangRandomVariable,
+    ExponentialRandomVariable,
+    GammaRandomVariable,
+    LogNormalRandomVariable,
+    NormalRandomVariable,
+    ParetoRandomVariable,
+    RngSeedManager,
+    RngStream,
+    SequentialRandomVariable,
+    TriangularRandomVariable,
+    UniformRandomVariable,
+    WeibullRandomVariable,
+    ZipfRandomVariable,
+)
+
+N = 20000
+
+
+def mean_of(rv, n=N):
+    return sum(rv.GetValue() for _ in range(n)) / n
+
+
+def test_rand_u01_range_and_determinism():
+    a = RngStream(1, 0, 0)
+    b = RngStream(1, 0, 0)
+    va = [a.RandU01() for _ in range(1000)]
+    vb = [b.RandU01() for _ in range(1000)]
+    assert va == vb  # same position = bitwise identical
+    assert all(0.0 <= v < 1.0 for v in va)
+
+
+def test_streams_differ():
+    a = RngStream(1, 0, 0)
+    b = RngStream(1, 1, 0)
+    c = RngStream(1, 0, 1)
+    va = [a.RandU01() for _ in range(100)]
+    vb = [b.RandU01() for _ in range(100)]
+    vc = [c.RandU01() for _ in range(100)]
+    assert va != vb and va != vc and vb != vc
+
+
+def test_stream_jump_equals_iteration():
+    # substream jump is 2^76 steps: statistically uncorrelated, and two
+    # jumps from the same base must equal one double jump
+    a = RngStream(1, 3, 4)
+    b = RngStream(1, 3, 4)
+    assert [a.RandU01() for _ in range(10)] == [b.RandU01() for _ in range(10)]
+
+
+def test_run_number_selects_substream():
+    RngSeedManager.SetRun(1)
+    rv1 = UniformRandomVariable(Stream=5)
+    v1 = [rv1.GetValue() for _ in range(50)]
+    RngSeedManager.SetRun(2)
+    rv2 = UniformRandomVariable(Stream=5)
+    v2 = [rv2.GetValue() for _ in range(50)]
+    assert v1 != v2
+    # back to run 1 reproduces exactly (the replica reproducibility contract)
+    RngSeedManager.SetRun(1)
+    rv3 = UniformRandomVariable(Stream=5)
+    assert [rv3.GetValue() for _ in range(50)] == v1
+
+
+def test_auto_stream_allocation_unique():
+    RngSeedManager.Reset()
+    rvs = [UniformRandomVariable() for _ in range(5)]
+    seqs = [[rv.GetValue() for _ in range(20)] for rv in rvs]
+    for i in range(5):
+        for j in range(i + 1, 5):
+            assert seqs[i] != seqs[j]
+
+
+def test_uniform_moments():
+    rv = UniformRandomVariable(Min=2.0, Max=6.0, Stream=11)
+    m = mean_of(rv)
+    assert abs(m - 4.0) < 0.05
+    assert all(2.0 <= rv.GetValue() < 6.0 for _ in range(1000))
+
+
+def test_exponential_moments():
+    rv = ExponentialRandomVariable(Mean=3.0, Stream=12)
+    assert abs(mean_of(rv) - 3.0) < 0.1
+
+
+def test_exponential_bound():
+    rv = ExponentialRandomVariable(Mean=3.0, Bound=4.0, Stream=13)
+    assert all(rv.GetValue() <= 4.0 for _ in range(2000))
+
+
+def test_normal_moments():
+    rv = NormalRandomVariable(Mean=5.0, Variance=4.0, Stream=14)
+    vals = [rv.GetValue() for _ in range(N)]
+    m = sum(vals) / N
+    var = sum((v - m) ** 2 for v in vals) / N
+    assert abs(m - 5.0) < 0.06
+    assert abs(var - 4.0) < 0.15
+
+
+def test_lognormal_moments():
+    mu, sigma = 0.5, 0.4
+    rv = LogNormalRandomVariable(Mu=mu, Sigma=sigma, Stream=15)
+    expected = math.exp(mu + sigma**2 / 2)
+    assert abs(mean_of(rv) - expected) < 0.05
+
+
+def test_pareto_mean():
+    rv = ParetoRandomVariable(Scale=1.0, Shape=3.0, Stream=16)
+    assert abs(mean_of(rv) - 1.5) < 0.05  # alpha*xm/(alpha-1)
+
+
+def test_weibull_mean():
+    rv = WeibullRandomVariable(Scale=2.0, Shape=2.0, Stream=17)
+    expected = 2.0 * math.gamma(1.5)
+    assert abs(mean_of(rv) - expected) < 0.05
+
+
+def test_gamma_mean():
+    rv = GammaRandomVariable(Alpha=2.5, Beta=2.0, Stream=18)
+    assert abs(mean_of(rv) - 5.0) < 0.12
+
+
+def test_gamma_alpha_below_one():
+    rv = GammaRandomVariable(Alpha=0.5, Beta=1.0, Stream=19)
+    assert abs(mean_of(rv) - 0.5) < 0.05
+
+
+def test_erlang_mean():
+    rv = ErlangRandomVariable(K=3, Lambda=2.0, Stream=20)
+    assert abs(mean_of(rv) - 1.5) < 0.05
+
+
+def test_triangular_mean():
+    rv = TriangularRandomVariable(Min=0.0, Max=1.0, Mean=0.5, Stream=21)
+    assert abs(mean_of(rv) - 0.5) < 0.02
+
+
+def test_constant_and_deterministic():
+    assert ConstantRandomVariable(Constant=7.5).GetValue() == 7.5
+    rv = DeterministicRandomVariable(values=[1, 2, 3])
+    assert [rv.GetValue() for _ in range(5)] == [1, 2, 3, 1, 2]
+
+
+def test_sequential():
+    rv = SequentialRandomVariable(Min=0.0, Max=3.0, Increment=1.0, Consecutive=2)
+    assert [rv.GetValue() for _ in range(8)] == [0, 0, 1, 1, 2, 2, 0, 0]
+
+
+def test_bernoulli_mean():
+    rv = BernoulliRandomVariable(Probability=0.3, Stream=22)
+    assert abs(mean_of(rv) - 0.3) < 0.02
+
+
+def test_zipf_support():
+    rv = ZipfRandomVariable(N=5, Alpha=1.0, Stream=23)
+    vals = {rv.GetValue() for _ in range(2000)}
+    assert vals <= {1.0, 2.0, 3.0, 4.0, 5.0}
+    assert 1.0 in vals
+
+
+def test_empirical_interpolation():
+    rv = EmpiricalRandomVariable(Interpolate=True, Stream=24)
+    rv.CDF(0.0, 0.0)
+    rv.CDF(10.0, 1.0)
+    vals = [rv.GetValue() for _ in range(N)]
+    assert all(0.0 <= v <= 10.0 for v in vals)
+    assert abs(sum(vals) / N - 5.0) < 0.15
+
+
+def test_global_rngrun_binding():
+    GlobalValue.Bind("RngRun", 17)
+    assert RngSeedManager.GetRun() == 17

@@ -1,0 +1,109 @@
+"""Scheduler implementations: ordering, FIFO-at-same-ts, lazy removal.
+
+Mirrors upstream scheduler test strategy (src/core/test/...; SURVEY.md 4):
+all five queue types must produce identical (ts, uid) pop order.
+"""
+
+import random
+
+import pytest
+
+from tpudes.core.event import Event
+from tpudes.core.scheduler import (
+    CalendarScheduler,
+    HeapScheduler,
+    ListScheduler,
+    MapScheduler,
+    PriorityQueueScheduler,
+    create_scheduler,
+)
+
+ALL = [HeapScheduler, ListScheduler, MapScheduler, CalendarScheduler, PriorityQueueScheduler]
+
+
+def make_events(n, seed=42):
+    rng = random.Random(seed)
+    return [Event(rng.randrange(0, 10_000_000), uid, 0, lambda: None, ()) for uid in range(n)]
+
+
+@pytest.mark.parametrize("cls", ALL)
+def test_pop_order(cls):
+    events = make_events(500)
+    s = cls()
+    for e in events:
+        s.Insert(e)
+    expected = sorted(events, key=lambda e: (e.ts, e.uid))
+    popped = []
+    while not s.IsEmpty():
+        popped.append(s.RemoveNext())
+    assert popped == expected
+
+
+@pytest.mark.parametrize("cls", ALL)
+def test_same_ts_fifo(cls):
+    s = cls()
+    events = [Event(100, uid, 0, lambda: None, ()) for uid in range(50)]
+    shuffled = events[:]
+    random.Random(7).shuffle(shuffled)
+    for e in shuffled:
+        s.Insert(e)
+    assert [s.RemoveNext().uid for _ in range(50)] == list(range(50))
+
+
+@pytest.mark.parametrize("cls", ALL)
+def test_cancel_skipped(cls):
+    s = cls()
+    events = make_events(100)
+    for e in events:
+        s.Insert(e)
+    for e in events[::3]:
+        s.Remove(e)
+    live = sorted((e for e in events if not e.cancelled), key=lambda e: (e.ts, e.uid))
+    popped = []
+    while not s.IsEmpty():
+        popped.append(s.RemoveNext())
+    assert popped == live
+
+
+@pytest.mark.parametrize("cls", ALL)
+def test_all_cancelled_is_empty(cls):
+    s = cls()
+    events = make_events(20)
+    for e in events:
+        s.Insert(e)
+    for e in events:
+        s.Remove(e)
+    assert s.IsEmpty()
+
+
+@pytest.mark.parametrize("cls", ALL)
+def test_interleaved_insert_pop(cls):
+    rng = random.Random(3)
+    s = cls()
+    uid = 0
+    last = (-1, -1)
+    now = 0
+    for _ in range(2000):
+        if s.IsEmpty() or rng.random() < 0.55:
+            e = Event(now + rng.randrange(0, 1000), uid, 0, lambda: None, ())
+            uid += 1
+            s.Insert(e)
+        else:
+            e = s.RemoveNext()
+            key = (e.ts, e.uid)
+            assert key > last or key[0] >= last[0]
+            now = e.ts
+            last = key
+
+
+def test_factory_names():
+    for name in (
+        "tpudes::HeapScheduler",
+        "tpudes::MapScheduler",
+        "tpudes::ListScheduler",
+        "tpudes::CalendarScheduler",
+        "ns3::MapScheduler",
+    ):
+        assert create_scheduler(name) is not None
+    with pytest.raises(ValueError):
+        create_scheduler("nope")

@@ -1,0 +1,141 @@
+"""Simulator engine semantics: ordering, contexts, cancel/remove, stop,
+destroy, ScheduleWithContext — mirroring upstream simulator test suite
+behaviors (src/core/test/; SURVEY.md 4)."""
+
+import pytest
+
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.nstime import MilliSeconds, Seconds, Time
+from tpudes.core.simulator import RealtimeSimulatorImpl, Simulator
+
+
+def test_event_ordering_and_now():
+    log = []
+    Simulator.Schedule(Seconds(2), lambda: log.append(("b", Simulator.Now().GetSeconds())))
+    Simulator.Schedule(Seconds(1), lambda: log.append(("a", Simulator.Now().GetSeconds())))
+    Simulator.Schedule(Seconds(3), lambda: log.append(("c", Simulator.Now().GetSeconds())))
+    Simulator.Run()
+    assert log == [("a", 1.0), ("b", 2.0), ("c", 3.0)]
+
+
+def test_same_time_fifo_order():
+    log = []
+    for i in range(10):
+        Simulator.Schedule(Seconds(1), log.append, i)
+    Simulator.Run()
+    assert log == list(range(10))
+
+
+def test_schedule_now_and_nested():
+    log = []
+
+    def outer():
+        log.append("outer")
+        Simulator.ScheduleNow(lambda: log.append("nested-now"))
+        Simulator.Schedule(Seconds(1), lambda: log.append("nested-later"))
+
+    Simulator.Schedule(Seconds(5), outer)
+    Simulator.Run()
+    assert log == ["outer", "nested-now", "nested-later"]
+    assert Simulator.Now() == Seconds(6)
+
+
+def test_cancel_and_remove():
+    log = []
+    keep = Simulator.Schedule(Seconds(1), lambda: log.append("keep"))
+    cancel = Simulator.Schedule(Seconds(2), lambda: log.append("cancel"))
+    remove = Simulator.Schedule(Seconds(3), lambda: log.append("remove"))
+    cancel.Cancel()
+    Simulator.Remove(remove)
+    assert keep.IsPending()
+    assert cancel.IsCancelled()
+    Simulator.Run()
+    assert log == ["keep"]
+    assert keep.IsExpired()
+
+
+def test_stop_at_time():
+    log = []
+    for s in range(1, 10):
+        Simulator.Schedule(Seconds(s), log.append, s)
+    Simulator.Stop(Seconds(4.5))
+    Simulator.Run()
+    assert log == [1, 2, 3, 4]
+    assert abs(Simulator.Now().GetSeconds() - 4.5) < 1e-9
+
+
+def test_stop_now_inside_event():
+    log = []
+
+    def stopper():
+        log.append("stop")
+        Simulator.Stop()
+
+    Simulator.Schedule(Seconds(1), stopper)
+    Simulator.Schedule(Seconds(2), lambda: log.append("never"))
+    Simulator.Run()
+    assert log == ["stop"]
+
+
+def test_context_propagation():
+    seen = []
+    Simulator.ScheduleWithContext(7, Seconds(1), lambda: seen.append(Simulator.GetContext()))
+    Simulator.ScheduleWithContext(9, Seconds(2), lambda: seen.append(Simulator.GetContext()))
+    Simulator.Run()
+    assert seen == [7, 9]
+
+
+def test_schedule_destroy():
+    log = []
+    Simulator.Schedule(Seconds(1), lambda: log.append("run"))
+    Simulator.ScheduleDestroy(lambda: log.append("destroy"))
+    Simulator.Run()
+    assert log == ["run"]
+    Simulator.Destroy()
+    assert log == ["run", "destroy"]
+
+
+def test_event_count():
+    for s in range(5):
+        Simulator.Schedule(Seconds(s + 1), lambda: None)
+    Simulator.Run()
+    assert Simulator.GetEventCount() == 5
+
+
+def test_engine_seam_selection():
+    GlobalValue.Bind("SimulatorImplementationType", "tpudes::RealtimeSimulatorImpl")
+    impl = Simulator.GetImpl()
+    assert isinstance(impl, RealtimeSimulatorImpl)
+
+
+def test_realtime_tracks_wallclock():
+    import time as wall
+
+    GlobalValue.Bind("SimulatorImplementationType", "tpudes::RealtimeSimulatorImpl")
+    log = []
+    Simulator.Schedule(MilliSeconds(50), lambda: log.append(wall.monotonic()))
+    t0 = wall.monotonic()
+    Simulator.Run()
+    assert len(log) == 1
+    elapsed = log[0] - t0
+    assert 0.045 <= elapsed <= 0.5  # scheduled at +50ms wall time
+
+
+def test_scheduler_type_global():
+    GlobalValue.Bind("SchedulerType", "tpudes::CalendarScheduler")
+    log = []
+    Simulator.Schedule(Seconds(2), log.append, 2)
+    Simulator.Schedule(Seconds(1), log.append, 1)
+    Simulator.Run()
+    assert log == [1, 2]
+
+
+def test_run_twice_after_destroy():
+    log = []
+    Simulator.Schedule(Seconds(1), log.append, "first")
+    Simulator.Run()
+    Simulator.Destroy()
+    Simulator.Schedule(Seconds(1), log.append, "second")
+    Simulator.Run()
+    assert log == ["first", "second"]
+    assert Simulator.Now() == Seconds(1)  # fresh engine restarted at 0

@@ -1,0 +1,53 @@
+"""Time arithmetic and parsing (reference parity: src/core/model/nstime.h
+semantics; mirrors upstream time test style — exact tick arithmetic)."""
+
+from tpudes.core.nstime import (
+    Time,
+    Seconds,
+    MilliSeconds,
+    MicroSeconds,
+    NanoSeconds,
+    Minutes,
+    Hours,
+)
+
+
+def test_constructors_and_ticks():
+    assert Seconds(1).GetNanoSeconds() == 1_000_000_000
+    assert MilliSeconds(5).GetNanoSeconds() == 5_000_000
+    assert MicroSeconds(7).GetNanoSeconds() == 7_000
+    assert NanoSeconds(13).ticks == 13
+    assert Minutes(2).GetSeconds() == 120.0
+    assert Hours(1).GetSeconds() == 3600.0
+
+
+def test_arithmetic_exact():
+    t = Seconds(1) + MilliSeconds(500)
+    assert t.GetNanoSeconds() == 1_500_000_000
+    assert (t - Seconds(1)).GetNanoSeconds() == 500_000_000
+    assert (t * 2).GetNanoSeconds() == 3_000_000_000
+    assert t / Seconds(1) == 1.5
+    assert Seconds(10) // Seconds(3) == 3
+    assert (Seconds(10) % Seconds(3)).GetSeconds() == 1.0
+
+
+def test_comparisons():
+    assert Seconds(1) < Seconds(2)
+    assert Seconds(2) >= MilliSeconds(2000)
+    assert Seconds(2) == MilliSeconds(2000)
+    assert NanoSeconds(1).IsStrictlyPositive()
+    assert Time(0).IsZero()
+    assert (-Seconds(1)).IsStrictlyNegative()
+
+
+def test_string_parsing():
+    assert Time("1s") == Seconds(1)
+    assert Time("5ms") == MilliSeconds(5)
+    assert Time("2.5us") == MicroSeconds(2.5)
+    assert Time("100ns").ticks == 100
+    assert Time("1min") == Seconds(60)
+    assert Time("3") == Seconds(3)  # bare number = seconds, as in ns-3
+
+
+def test_float_seconds_roundtrip():
+    assert abs(Seconds(0.123456789).GetSeconds() - 0.123456789) < 1e-12

@@ -1,0 +1,29 @@
+"""tpudes — a TPU-native discrete-event network simulation framework.
+
+A from-scratch framework with the capabilities of ``ybaddi/ns-3-dev-dnemu``
+(an ns-3 fork; see SURVEY.md): a discrete-event core with pluggable engines
+behind the ``SimulatorImplementationType`` seam, an ns-3-class model library
+(propagation, WiFi, LTE, internet stack, applications, mobility), and a
+JAX/XLA execution backend (``JaxSimulatorImpl``) that evaluates the
+high-fanout PHY math — propagation loss/delay, interference SNR, NIST
+error-rate, LTE RB-grid SINR/BLER — as jit-compiled, vmapped kernels over
+(node x link x replica) arrays in conservative time windows, with
+Monte-Carlo replicas sharded across a TPU mesh.
+
+Layout:
+  core/      Simulator, Scheduler, Time, events, Object/TypeId/attributes,
+             GlobalValue, Config, CommandLine, RNG streams, logging, tracing
+             (reference parity: src/core/model/)
+  network/   Packet, Node, NetDevice, Channel, Socket, Queue, ErrorModel,
+             addresses (reference parity: src/network/model/)
+  models/    propagation, mobility, spectrum, wifi, lte, internet, apps
+             (reference parity: src/{propagation,mobility,spectrum,wifi,
+             lte,internet,applications}/)
+  ops/       pure jittable JAX kernels (the TPU compute path)
+  parallel/  mesh/replica sharding, conservative-window PDES engine,
+             LBTS collectives (reference parity: src/mpi/)
+  helper/    topology-wiring helpers (reference parity: src/*/helper/)
+  utils/     observability: flow monitor, pcap, stats, progress
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,36 @@
+"""Core runtime: time, events, scheduler, simulator engines, object model,
+configuration, RNG, logging, tracing.
+
+Reference parity: src/core/model/ (see SURVEY.md section 2.1).
+"""
+
+from tpudes.core.nstime import Time, Seconds, MilliSeconds, MicroSeconds, NanoSeconds, PicoSeconds, FemtoSeconds, Minutes, Hours, Days
+from tpudes.core.event import EventId
+from tpudes.core.simulator import Simulator
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.object import Object, ObjectBase, ObjectFactory, TypeId
+from tpudes.core.command_line import CommandLine
+from tpudes.core.config import Config, Names
+from tpudes.core.trace import TracedCallback, TracedValue, MakeCallback
+from tpudes.core.log import LogComponent, LogComponentEnable, LogComponentDisable
+from tpudes.core.rng import (
+    RngSeedManager,
+    RngStream,
+    UniformRandomVariable,
+    ConstantRandomVariable,
+    ExponentialRandomVariable,
+    NormalRandomVariable,
+    LogNormalRandomVariable,
+    ParetoRandomVariable,
+    WeibullRandomVariable,
+    GammaRandomVariable,
+    ErlangRandomVariable,
+    TriangularRandomVariable,
+    SequentialRandomVariable,
+    DeterministicRandomVariable,
+    EmpiricalRandomVariable,
+    ZipfRandomVariable,
+    ZetaRandomVariable,
+    BernoulliRandomVariable,
+    BinomialRandomVariable,
+)

@@ -1,0 +1,89 @@
+"""Command-line parser binding script args, attributes, and GlobalValues.
+
+Reference parity: src/core/model/command-line.{h,cc} (SURVEY.md 2.1).
+Supported forms, as in ns-3:
+  --name=value          a script-local value added with AddValue
+  --GlobalName=value    any registered GlobalValue (RngRun, engine type...)
+  --ns3::Class::Attr=v  a class attribute default (Config.SetDefault);
+                        tpudes::Class::Attr equally accepted
+  --PrintHelp / --help, --PrintGlobals, --PrintAttributes=<class>
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpudes.core.config import Config
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.object import TypeId
+
+
+class CommandLine:
+    def __init__(self, usage: str = ""):
+        self._usage = usage
+        self._values: dict[str, dict] = {}
+
+    def AddValue(self, name: str, help: str, default=None, callback=None):
+        self._values[name] = {"help": help, "value": default, "callback": callback}
+
+    def GetValue(self, name: str):
+        return self._values[name]["value"]
+
+    def Parse(self, argv=None) -> None:
+        argv = list(sys.argv[1:] if argv is None else argv)
+        for arg in argv:
+            if arg in ("--PrintHelp", "--help"):
+                self.PrintHelp()
+                raise SystemExit(0)
+            if arg == "--PrintGlobals":
+                for gv in GlobalValue.Iterate():
+                    print(f"    --{gv.name}=[{gv.value}]  {gv.help}")
+                raise SystemExit(0)
+            if arg.startswith("--PrintAttributes="):
+                tid = TypeId.LookupByName(arg.split("=", 1)[1])
+                for name, spec in tid.AllAttributes().items():
+                    print(f"    --{tid.name}::{name}=[{spec.initial}]  {spec.help}")
+                raise SystemExit(0)
+            if not arg.startswith("--") or "=" not in arg:
+                raise ValueError(f"unrecognized argument {arg!r}")
+            name, _, value = arg[2:].partition("=")
+            self._apply(name, value)
+
+    def _apply(self, name: str, value: str) -> None:
+        if name in self._values:
+            slot = self._values[name]
+            if slot["callback"] is not None:
+                slot["callback"](value)
+            else:
+                slot["value"] = _coerce(value, slot["value"])
+            return
+        if "::" in name:
+            Config.SetDefault(name, value)
+            return
+        if GlobalValue.BindFailSafe(name, _coerce_global(name, value)):
+            return
+        raise ValueError(f"unknown command-line argument --{name}")
+
+    def PrintHelp(self) -> None:
+        print(self._usage)
+        if self._values:
+            print("Program Options:")
+            for name, slot in self._values.items():
+                print(f"    --{name}=[{slot['value']}]  {slot['help']}")
+        print("General options: --PrintHelp --PrintGlobals --PrintAttributes=<type>")
+
+
+def _coerce(value: str, template):
+    """Parse a CLI string toward the type of the current/default value."""
+    if isinstance(template, bool):
+        return value.lower() in ("1", "true", "t", "yes", "y")
+    if isinstance(template, int):
+        return int(value)
+    if isinstance(template, float):
+        return float(value)
+    return value
+
+
+def _coerce_global(name: str, value: str):
+    current = GlobalValue.GetValueFailSafe(name)
+    return _coerce(value, current)

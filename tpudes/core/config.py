@@ -1,0 +1,186 @@
+"""Config namespace: path-based attribute get/set/connect with wildcards,
+plus the Names object-naming registry.
+
+Reference parity: src/core/model/config.{h,cc}, names.{h,cc}
+(SURVEY.md 2.1). Paths look like
+``/NodeList/3/DeviceList/0/Phy/TxPowerStart`` or with wildcards
+``/NodeList/*/DeviceList/*/Phy/PhyRxDrop``; ``$TypeName`` segments cast
+through object aggregation, as in ns-3.
+
+Path resolution walks: config roots ("NodeList", Names) -> list indices /
+wildcards -> attributes whose values are Objects or lists of Objects ->
+leaf attribute (Set/Get) or trace source (Connect).
+"""
+
+from __future__ import annotations
+
+from tpudes.core.object import Object, TypeId, set_default
+
+
+class Names:
+    """Hierarchical object naming (src/core/model/names.{h,cc})."""
+
+    _by_name: dict[str, object] = {}
+    _by_obj: dict[int, str] = {}
+
+    @classmethod
+    def Add(cls, name: str, obj) -> None:
+        name = name.lstrip("/")
+        if name.startswith("Names/"):
+            name = name[len("Names/"):]
+        cls._by_name[name] = obj
+        cls._by_obj[id(obj)] = name
+
+    @classmethod
+    def Find(cls, name: str):
+        name = name.lstrip("/")
+        if name.startswith("Names/"):
+            name = name[len("Names/"):]
+        return cls._by_name.get(name)
+
+    @classmethod
+    def FindName(cls, obj) -> str | None:
+        return cls._by_obj.get(id(obj))
+
+    @classmethod
+    def Clear(cls) -> None:
+        cls._by_name.clear()
+        cls._by_obj.clear()
+
+
+class Config:
+    # root name -> zero-arg callable returning a list of objects
+    _roots: dict[str, callable] = {}
+
+    @classmethod
+    def RegisterRootNamespaceObject(cls, name: str, provider) -> None:
+        cls._roots[name] = provider
+
+    # --- resolution ---
+    @classmethod
+    def _resolve(cls, path: str):
+        """Resolve all but the last path segment; return (objects, leaf)."""
+        tokens = [t for t in path.split("/") if t]
+        if not tokens:
+            raise ValueError(f"bad config path {path!r}")
+        leaf = tokens[-1]
+        steps = tokens[:-1]
+        current: list = []
+        if not steps:
+            raise ValueError(f"config path too short: {path!r}")
+        # first token: a root namespace or Names
+        first = steps[0]
+        if first == "Names":
+            obj = Names.Find("/".join(steps[1:] or [leaf]))
+            if obj is None:
+                return [], leaf
+            if steps[1:]:
+                current = [obj]
+                steps = []
+            else:
+                return [obj], leaf
+        elif first in cls._roots:
+            current = [cls._roots[first]()]
+            steps = steps[1:]
+        else:
+            raise ValueError(f"unknown config root {first!r} in {path!r}")
+        for tok in steps:
+            nxt: list = []
+            for obj in current:
+                nxt.extend(cls._step(obj, tok))
+            current = nxt
+        return current, leaf
+
+    @staticmethod
+    def _step(obj, tok: str) -> list:
+        # list indexing / wildcard
+        if isinstance(obj, (list, tuple)):
+            if tok == "*":
+                return list(obj)
+            if tok.isdigit():
+                i = int(tok)
+                return [obj[i]] if i < len(obj) else []
+            # apply the token to each element instead
+            out = []
+            for el in obj:
+                out.extend(Config._step(el, tok))
+            return out
+        # aggregation cast
+        if tok.startswith("$"):
+            tid = TypeId.LookupByNameFailSafe(tok[1:])
+            if tid is None or not isinstance(obj, Object):
+                return []
+            found = obj.GetObject(tid)
+            return [found] if found is not None else []
+        # attribute whose value is an object / list of objects
+        tid = type(obj).GetTypeId() if hasattr(type(obj), "GetTypeId") else None
+        if tid is not None:
+            spec = tid.LookupAttribute(tok)
+            if spec is not None:
+                val = getattr(obj, spec.field)
+                if isinstance(val, (list, tuple)):
+                    return [list(val)]
+                return [val] if val is not None else []
+        # plain python attribute fallback (e.g. helper-exposed children)
+        val = getattr(obj, tok, None)
+        if val is None:
+            return []
+        if isinstance(val, (list, tuple)):
+            return [list(val)]
+        return [val]
+
+    # --- public API ---
+    @classmethod
+    def Set(cls, path: str, value) -> None:
+        objs, leaf = cls._resolve(path)
+        if not objs:
+            raise ValueError(f"config path matched nothing: {path!r}")
+        for obj in objs:
+            obj.SetAttribute(leaf, value)
+
+    @classmethod
+    def SetFailSafe(cls, path: str, value) -> bool:
+        try:
+            objs, leaf = cls._resolve(path)
+        except ValueError:
+            return False
+        ok = False
+        for obj in objs:
+            ok = obj.SetAttributeFailSafe(leaf, value) or ok
+        return ok
+
+    @classmethod
+    def Get(cls, path: str) -> list:
+        objs, leaf = cls._resolve(path)
+        return [obj.GetAttribute(leaf) for obj in objs]
+
+    @classmethod
+    def Connect(cls, path: str, cb) -> None:
+        """Connect with the matched path string prepended as context."""
+        objs, leaf = cls._resolve(path)
+        if not objs:
+            raise ValueError(f"config path matched nothing: {path!r}")
+        for obj in objs:
+            if not obj.TraceConnect(leaf, path, cb):
+                raise ValueError(f"no trace source {leaf!r} at {path!r}")
+
+    @classmethod
+    def ConnectWithoutContext(cls, path: str, cb) -> None:
+        objs, leaf = cls._resolve(path)
+        if not objs:
+            raise ValueError(f"config path matched nothing: {path!r}")
+        for obj in objs:
+            if not obj.TraceConnectWithoutContext(leaf, cb):
+                raise ValueError(f"no trace source {leaf!r} at {path!r}")
+
+    @classmethod
+    def SetDefault(cls, full_name: str, value) -> None:
+        """``Config.SetDefault("tpudes::PointToPointNetDevice::DataRate", v)``
+        or the ns-3 two-colon form ``ns3::Class::Attr``."""
+        tid_name, _, attr = full_name.rpartition("::")
+        set_default(tid_name, attr, value)
+
+    @classmethod
+    def LookupMatches(cls, path: str) -> list:
+        objs, _ = cls._resolve(path.rstrip("/") + "/_")  # dummy leaf segment
+        return objs

@@ -1,0 +1,101 @@
+"""Events: type-erased bound closures with cancel/expire semantics.
+
+Reference parity: src/core/model/event-impl.{h,cc}, event-id.{h,cc},
+make-event.h (SURVEY.md 2.1). In ns-3 an event is a heap-allocated
+``EventImpl`` (a bound closure) keyed by (timestamp, uid); ``EventId`` is a
+value handle supporting ``Cancel``/``IsExpired``/``IsPending``. Here the
+closure is a plain Python callable + args; ``Event`` is the queue record.
+"""
+
+from __future__ import annotations
+
+
+class Event:
+    """Internal queue record: (ts, uid) orders the queue; context is the
+    owning node id (0xffffffff = no context, as in ns-3)."""
+
+    __slots__ = ("ts", "uid", "context", "fn", "args", "cancelled")
+
+    NO_CONTEXT = 0xFFFFFFFF
+
+    def __init__(self, ts: int, uid: int, context: int, fn, args):
+        self.ts = ts
+        self.uid = uid
+        self.context = context
+        self.fn = fn
+        self.args = args
+        self.cancelled = False
+
+    def invoke(self):
+        self.fn(*self.args)
+
+    def cancel(self):
+        self.cancelled = True
+
+    # ordering used by schedulers: strict (ts, uid) as in ns-3 Scheduler::EventKey
+    def __lt__(self, other: "Event"):
+        if self.ts != other.ts:
+            return self.ts < other.ts
+        return self.uid < other.uid
+
+    def __repr__(self):
+        return f"Event(ts={self.ts}, uid={self.uid}, ctx={self.context}, fn={getattr(self.fn, '__qualname__', self.fn)})"
+
+
+class EventId:
+    """Value handle to a scheduled event (src/core/model/event-id.h).
+
+    ``Cancel`` marks the closure cancelled without dequeuing (lazy
+    deletion); ``Remove`` is done through ``Simulator.Remove``.
+    """
+
+    __slots__ = ("_event",)
+
+    def __init__(self, event: Event | None = None):
+        self._event = event
+
+    def Cancel(self):
+        if self._event is not None:
+            self._event.cancel()
+
+    def IsCancelled(self) -> bool:
+        return self._event is not None and self._event.cancelled
+
+    def IsExpired(self) -> bool:
+        # expired = already run, cancelled, or null
+        from tpudes.core.simulator import Simulator
+
+        ev = self._event
+        if ev is None or ev.cancelled:
+            return True
+        now = Simulator.NowTicks()
+        if ev.ts < now:
+            return True
+        if ev.ts == now and Simulator._impl is not None and ev.uid <= Simulator._impl.current_uid:
+            return True
+        return False
+
+    def IsPending(self) -> bool:
+        return not self.IsExpired()
+
+    # ns-3 deprecated alias
+    def IsRunning(self) -> bool:
+        return self.IsPending()
+
+    def GetTs(self) -> int:
+        return self._event.ts if self._event is not None else 0
+
+    def GetUid(self) -> int:
+        return self._event.uid if self._event is not None else 0
+
+    def GetContext(self) -> int:
+        return self._event.context if self._event is not None else Event.NO_CONTEXT
+
+    def __eq__(self, other):
+        return isinstance(other, EventId) and self._event is other._event
+
+    def __hash__(self):
+        return id(self._event)
+
+    def __repr__(self):
+        return f"EventId({self._event!r})"

@@ -1,0 +1,113 @@
+"""GlobalValue: process-wide named configuration values.
+
+Reference parity: src/core/model/global-value.{h,cc} (SURVEY.md 2.1).
+These are the process-level knobs — engine type, scheduler type, RngRun,
+ChecksumEnabled — settable programmatically (``Bind``), from the command
+line (``--Name=value`` via CommandLine), or from the environment variable
+``NS_GLOBAL_VALUE`` (``name=value;name=value``).
+
+This seam is the one-flag opt-in contract from BASELINE.json: scripts
+switch to the TPU engine with
+``GlobalValue.Bind("SimulatorImplementationType", "tpudes::JaxSimulatorImpl")``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class GlobalValue:
+    _registry: dict[str, "GlobalValue"] = {}
+
+    def __init__(self, name: str, help: str, initial):
+        self.name = name
+        self.help = help
+        self.initial = initial
+        self.value = initial
+        GlobalValue._registry[name] = self
+
+    @classmethod
+    def Bind(cls, name: str, value) -> None:
+        gv = cls._registry.get(name)
+        if gv is None:
+            raise KeyError(f"no GlobalValue named {name!r}")
+        gv.value = value
+
+    @classmethod
+    def BindFailSafe(cls, name: str, value) -> bool:
+        if name in cls._registry:
+            cls._registry[name].value = value
+            return True
+        return False
+
+    @classmethod
+    def GetValue(cls, name: str):
+        gv = cls._registry.get(name)
+        if gv is None:
+            raise KeyError(f"no GlobalValue named {name!r}")
+        return gv.value
+
+    @classmethod
+    def GetValueFailSafe(cls, name: str, default=None):
+        gv = cls._registry.get(name)
+        return gv.value if gv is not None else default
+
+    @classmethod
+    def Iterate(cls):
+        return iter(cls._registry.values())
+
+    @classmethod
+    def ResetAll(cls) -> None:
+        for gv in cls._registry.values():
+            gv.value = gv.initial
+
+    @classmethod
+    def ApplyEnvironment(cls) -> None:
+        """Apply NS_GLOBAL_VALUE=name=value;name=value overrides, coercing
+        the string toward the type of the registered initial value."""
+        env = os.environ.get("NS_GLOBAL_VALUE", "")
+        for pair in env.split(";"):
+            if "=" in pair:
+                name, _, value = pair.partition("=")
+                name, value = name.strip(), value.strip()
+                gv = cls._registry.get(name)
+                if gv is None:
+                    continue
+                if isinstance(gv.initial, bool):
+                    gv.value = value.lower() in ("1", "true", "t", "yes", "y")
+                elif isinstance(gv.initial, int):
+                    gv.value = int(value)
+                elif isinstance(gv.initial, float):
+                    gv.value = float(value)
+                else:
+                    gv.value = value
+
+
+# --- the core globals, mirroring ns-3's (src/core/model/simulator.cc,
+# rng-seed-manager.cc, chunk registration sites) ---
+
+SimulatorImplementationType = GlobalValue(
+    "SimulatorImplementationType",
+    "The type of simulator engine to use (the JaxSimulatorImpl seam).",
+    "tpudes::DefaultSimulatorImpl",
+)
+
+SchedulerType = GlobalValue(
+    "SchedulerType",
+    "The event-scheduler (priority queue) implementation to use.",
+    "tpudes::HeapScheduler",
+)
+
+RngSeed = GlobalValue("RngSeed", "The global RNG seed.", 1)
+
+RngRun = GlobalValue(
+    "RngRun",
+    "The run number (substream selector) — the Monte-Carlo replica axis.",
+    1,
+)
+
+ChecksumEnabled = GlobalValue(
+    "ChecksumEnabled", "Whether protocol checksums are computed.", False
+)
+
+GlobalValue.ApplyEnvironment()

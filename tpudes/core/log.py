@@ -1,0 +1,132 @@
+"""NS_LOG-style component logging.
+
+Reference parity: src/core/model/log.{h,cc}, log-macros-enabled.h
+(SURVEY.md 2.1): named components with per-component levels, enabled at
+runtime via ``LogComponentEnable`` or the ``NS_LOG`` environment variable
+(``NS_LOG="UdpEchoClient=info|prefix_time:UdpEchoServer=level_all"``).
+
+Disabled components cost one dict lookup + int compare per call — the
+Python analogue of ns-3's compiled-out macros.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+LOG_NONE = 0
+LOG_ERROR = 1
+LOG_WARN = 2
+LOG_DEBUG = 3
+LOG_INFO = 4
+LOG_FUNCTION = 5
+LOG_LOGIC = 6
+LOG_ALL = 7
+
+_LEVEL_NAMES = {
+    "error": LOG_ERROR,
+    "warn": LOG_WARN,
+    "debug": LOG_DEBUG,
+    "info": LOG_INFO,
+    "function": LOG_FUNCTION,
+    "logic": LOG_LOGIC,
+    "all": LOG_ALL,
+    "level_error": LOG_ERROR,
+    "level_warn": LOG_WARN,
+    "level_debug": LOG_DEBUG,
+    "level_info": LOG_INFO,
+    "level_function": LOG_FUNCTION,
+    "level_logic": LOG_LOGIC,
+    "level_all": LOG_ALL,
+    "*": LOG_ALL,
+}
+
+_components: dict[str, int] = {}
+_prefix_time = True
+_prefix_node = True
+
+
+class LogComponent:
+    """One named log component (the NS_LOG_COMPONENT_DEFINE analogue)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+        _components.setdefault(name, _env_level(name))
+
+    @property
+    def level(self) -> int:
+        return _components[self.name]
+
+    def IsEnabled(self, level: int) -> bool:
+        return _components[self.name] >= level
+
+    def _emit(self, tag: str, args) -> None:
+        from tpudes.core.simulator import Simulator
+
+        parts = []
+        if _prefix_time:
+            parts.append(f"+{Simulator.NowTicks()}ns")
+        ctx = Simulator._impl.current_context if Simulator._impl else None
+        if _prefix_node and ctx is not None and ctx != 0xFFFFFFFF:
+            parts.append(str(ctx))
+        parts.append(f"{self.name}:{tag}:")
+        parts.extend(str(a) for a in args)
+        print(" ".join(parts), file=sys.stderr)
+
+    def error(self, *args):
+        if _components[self.name] >= LOG_ERROR:
+            self._emit("ERROR", args)
+
+    def warn(self, *args):
+        if _components[self.name] >= LOG_WARN:
+            self._emit("WARN", args)
+
+    def debug(self, *args):
+        if _components[self.name] >= LOG_DEBUG:
+            self._emit("DEBUG", args)
+
+    def info(self, *args):
+        if _components[self.name] >= LOG_INFO:
+            self._emit("INFO", args)
+
+    def function(self, *args):
+        if _components[self.name] >= LOG_FUNCTION:
+            self._emit("FUNC", args)
+
+    def logic(self, *args):
+        if _components[self.name] >= LOG_LOGIC:
+            self._emit("LOGIC", args)
+
+
+def _env_level(name: str) -> int:
+    env = os.environ.get("NS_LOG", "")
+    level = LOG_NONE
+    for clause in env.split(":"):
+        if not clause:
+            continue
+        comp, _, spec = clause.partition("=")
+        if comp not in (name, "*", "***"):
+            continue
+        if not spec:
+            level = max(level, LOG_DEBUG)
+            continue
+        for tok in spec.split("|"):
+            tok = tok.strip().lower()
+            if tok in _LEVEL_NAMES:
+                level = max(level, _LEVEL_NAMES[tok])
+    return level
+
+
+def LogComponentEnable(name: str, level: int = LOG_ALL) -> None:
+    _components[name] = level
+
+
+def LogComponentDisable(name: str) -> None:
+    _components[name] = LOG_NONE
+
+
+def LogComponentEnableAll(level: int = LOG_ALL) -> None:
+    for name in _components:
+        _components[name] = level

@@ -1,0 +1,286 @@
+"""Simulation time: 64-bit integer ticks with settable resolution.
+
+Reference parity: src/core/model/nstime.h, time.cc (SURVEY.md 2.1).
+ns-3 stores time as int64 ticks at a process-global resolution (default
+nanoseconds) and uses int64x64 fixed-point only for multiplication by
+non-integers; here Python's arbitrary-precision ints make the fixed-point
+type unnecessary — tick arithmetic is exact by construction.
+
+The hot path (the event loop) deals in *raw integer ticks*; ``Time`` is the
+user-facing wrapper. Helper constructors (``Seconds`` etc.) mirror the
+ns-3 free functions.
+"""
+
+from __future__ import annotations
+
+import re
+
+# Unit exponents relative to seconds (powers of ten), ns-3 Time::Unit order.
+_UNITS = {
+    "Y": None,  # year — handled specially (not power of ten)
+    "d": None,
+    "h": None,
+    "min": None,
+    "s": 0,
+    "ms": -3,
+    "us": -6,
+    "ns": -9,
+    "ps": -12,
+    "fs": -15,
+}
+
+# seconds per non-decimal unit
+_ODD_UNITS = {"Y": 365 * 86400, "d": 86400, "h": 3600, "min": 60}
+
+
+class Time:
+    """An amount of simulated time, stored as integer ticks.
+
+    Resolution is process-global (default: nanoseconds), mirroring
+    ns-3 ``Time::SetResolution``. Changing resolution is only allowed
+    while no simulator is running.
+    """
+
+    __slots__ = ("ticks",)
+
+    # --- process-global resolution state ---
+    _res_exp = -9  # 10^-9 s per tick (nanoseconds), ns-3 default
+    _res_name = "ns"
+
+    S = 0
+    MS = 1
+    US = 2
+    NS = 3
+    PS = 4
+    FS = 5
+
+    _UNIT_TO_NAME = {S: "s", MS: "ms", US: "us", NS: "ns", PS: "ps", FS: "fs"}
+
+    def __init__(self, value: "int | float | str | Time" = 0):
+        if isinstance(value, Time):
+            self.ticks = value.ticks
+        elif isinstance(value, int):
+            self.ticks = value
+        elif isinstance(value, float):
+            # ns-3: a bare number is *seconds* when given as a string, but a
+            # raw numeric ctor arg is ticks. Floats as ticks get rounded.
+            self.ticks = int(round(value))
+        elif isinstance(value, str):
+            self.ticks = _parse_time_string(value)
+        else:
+            raise TypeError(f"cannot construct Time from {type(value)!r}")
+
+    # --- resolution ---
+    @classmethod
+    def SetResolution(cls, unit: int) -> None:
+        # ns-3 forbids changing resolution once Time objects exist; the
+        # enforceable analogue here is "before the engine is created" —
+        # tick values created under the old resolution would silently
+        # rescale otherwise.
+        from tpudes.core.simulator import Simulator
+
+        if Simulator._impl is not None:
+            raise RuntimeError("Time.SetResolution after simulator creation")
+        name = cls._UNIT_TO_NAME[unit]
+        cls._res_exp = _UNITS[name]
+        cls._res_name = name
+
+    @classmethod
+    def GetResolution(cls) -> int:
+        return {v: k for k, v in cls._UNIT_TO_NAME.items()}[cls._res_name]
+
+    # --- constructors from units ---
+    @classmethod
+    def from_seconds(cls, s: float) -> "Time":
+        return cls(int(round(s * 10 ** (-cls._res_exp))))
+
+    @classmethod
+    def from_unit(cls, value: float, exp: int) -> "Time":
+        # value * 10^exp seconds, converted to ticks of 10^_res_exp seconds
+        shift = exp - cls._res_exp
+        if shift >= 0:
+            return cls(int(round(value * 10**shift)))
+        return cls(int(round(value / 10**(-shift))))
+
+    # --- accessors ---
+    def _in_unit(self, exp: int) -> int:
+        shift = self._res_exp - exp
+        if shift >= 0:
+            return self.ticks * 10**shift
+        return self.ticks // 10**(-shift)
+
+    def GetSeconds(self) -> float:
+        return self.ticks / 10 ** (-self._res_exp) if self._res_exp < 0 else float(self.ticks * 10**self._res_exp)
+
+    def GetMilliSeconds(self) -> int:
+        return self._in_unit(-3)
+
+    def GetMicroSeconds(self) -> int:
+        return self._in_unit(-6)
+
+    def GetNanoSeconds(self) -> int:
+        return self._in_unit(-9)
+
+    def GetPicoSeconds(self) -> int:
+        return self._in_unit(-12)
+
+    def GetFemtoSeconds(self) -> int:
+        return self._in_unit(-15)
+
+    def GetTimeStep(self) -> int:
+        return self.ticks
+
+    def GetInteger(self) -> int:
+        return self.ticks
+
+    def GetDouble(self) -> float:
+        return float(self.ticks)
+
+    def IsZero(self) -> bool:
+        return self.ticks == 0
+
+    def IsNegative(self) -> bool:
+        return self.ticks <= 0
+
+    def IsPositive(self) -> bool:
+        return self.ticks >= 0
+
+    def IsStrictlyNegative(self) -> bool:
+        return self.ticks < 0
+
+    def IsStrictlyPositive(self) -> bool:
+        return self.ticks > 0
+
+    # --- arithmetic ---
+    def __add__(self, other):
+        return Time(self.ticks + Time(other).ticks)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return Time(self.ticks - Time(other).ticks)
+
+    def __rsub__(self, other):
+        return Time(Time(other).ticks - self.ticks)
+
+    def __mul__(self, k):
+        if isinstance(k, (int, float)):
+            return Time(int(round(self.ticks * k)))
+        return NotImplemented
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, Time):
+            return self.ticks / other.ticks
+        if isinstance(other, (int, float)):
+            return Time(int(round(self.ticks / other)))
+        return NotImplemented
+
+    def __floordiv__(self, other):
+        if isinstance(other, Time):
+            return self.ticks // other.ticks
+        return NotImplemented
+
+    def __mod__(self, other):
+        if isinstance(other, Time):
+            return Time(self.ticks % other.ticks)
+        return NotImplemented
+
+    def __neg__(self):
+        return Time(-self.ticks)
+
+    def __abs__(self):
+        return Time(abs(self.ticks))
+
+    # --- comparison / hashing ---
+    def __eq__(self, other):
+        return isinstance(other, Time) and self.ticks == other.ticks
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __lt__(self, other):
+        return self.ticks < Time(other).ticks
+
+    def __le__(self, other):
+        return self.ticks <= Time(other).ticks
+
+    def __gt__(self, other):
+        return self.ticks > Time(other).ticks
+
+    def __ge__(self, other):
+        return self.ticks >= Time(other).ticks
+
+    def __hash__(self):
+        return hash(self.ticks)
+
+    def __bool__(self):
+        return self.ticks != 0
+
+    def __repr__(self):
+        return f"Time({self.ticks}{self._res_name})"
+
+    def __str__(self):
+        return f"+{self.ticks}{self._res_name}"
+
+    def As(self, unit: int) -> str:
+        name = self._UNIT_TO_NAME[unit]
+        exp = _UNITS[name]
+        val = self.ticks * 10.0 ** (self._res_exp - exp)
+        return f"{val:+g}{name}"
+
+
+_TIME_RE = re.compile(r"^\s*([+-]?[0-9.eE+-]+?)\s*(Y|d|h|min|s|ms|us|ns|ps|fs)?\s*$")
+
+
+def _parse_time_string(s: str) -> int:
+    m = _TIME_RE.match(s)
+    if not m:
+        raise ValueError(f"cannot parse time string {s!r}")
+    num, unit = m.group(1), m.group(2) or "s"
+    value = float(num)
+    if unit in _ODD_UNITS:
+        return Time.from_seconds(value * _ODD_UNITS[unit]).ticks
+    return Time.from_unit(value, _UNITS[unit]).ticks
+
+
+# ns-3 free-function constructors (src/core/model/nstime.h)
+def Seconds(v: float) -> Time:
+    return Time.from_seconds(v)
+
+
+def MilliSeconds(v: float) -> Time:
+    return Time.from_unit(v, -3)
+
+
+def MicroSeconds(v: float) -> Time:
+    return Time.from_unit(v, -6)
+
+
+def NanoSeconds(v: float) -> Time:
+    return Time.from_unit(v, -9)
+
+
+def PicoSeconds(v: float) -> Time:
+    return Time.from_unit(v, -12)
+
+
+def FemtoSeconds(v: float) -> Time:
+    return Time.from_unit(v, -15)
+
+
+def Minutes(v: float) -> Time:
+    return Time.from_seconds(v * 60)
+
+
+def Hours(v: float) -> Time:
+    return Time.from_seconds(v * 3600)
+
+
+def Days(v: float) -> Time:
+    return Time.from_seconds(v * 86400)
+
+
+def TimeStep(ticks: int) -> Time:
+    return Time(ticks)

@@ -1,0 +1,333 @@
+"""Object model: TypeId registry, typed attributes, aggregation, tracing
+metadata.
+
+Reference parity: src/core/model/object.{h,cc}, type-id.{h,cc},
+object-base.{h,cc}, attribute.{h,cc} and the *-value files, plus
+object-factory.{h,cc} (SURVEY.md 2.1).
+
+Differences from ns-3, by design (idiomatic Python, same capability):
+- ``Ptr<>`` ref-counting is Python's GC; ``Ptr`` is not reproduced.
+- Attribute *values* are plain Python objects; the typed
+  ``IntegerValue``/``StringValue`` wrappers collapse into optional
+  ``checker`` callables that parse/validate (strings from the command line
+  are parsed by the checker, preserving the string-settable contract).
+- An attribute binds to a python field on the instance (``field``), so
+  model code reads ``self.data_rate`` directly at C speed while
+  ``SetAttribute("DataRate", "5Mbps")`` remains the configuration surface.
+"""
+
+from __future__ import annotations
+
+import copy
+
+
+class AttributeSpec:
+    __slots__ = ("name", "help", "initial", "field", "checker", "flags")
+
+    def __init__(self, name, help, initial, field, checker=None, flags="rw"):
+        self.name = name
+        self.help = help
+        self.initial = initial
+        self.field = field
+        self.checker = checker
+        self.flags = flags
+
+
+class TraceSourceSpec:
+    __slots__ = ("name", "help", "field")
+
+    def __init__(self, name, help, field):
+        self.name = name
+        self.help = help
+        self.field = field
+
+
+class TypeId:
+    """Run-time type metadata: name, parent, constructor, attributes,
+    trace sources (src/core/model/type-id.{h,cc}). Fluent API mirrors
+    ns-3's ``GetTypeId`` idiom."""
+
+    _registry: dict[str, "TypeId"] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self.parent: TypeId | None = None
+        self.group = ""
+        self.ctor = None
+        self.attributes: dict[str, AttributeSpec] = {}
+        self.trace_sources: dict[str, TraceSourceSpec] = {}
+        TypeId._registry[name] = self
+        # accept the ns3:: spelling of our own TypeIds for script parity
+        if name.startswith("tpudes::"):
+            TypeId._registry["ns3::" + name[len("tpudes::"):]] = self
+
+    # --- fluent declaration API ---
+    def SetParent(self, parent: "TypeId | None") -> "TypeId":
+        self.parent = parent
+        return self
+
+    def SetGroupName(self, group: str) -> "TypeId":
+        self.group = group
+        return self
+
+    def AddConstructor(self, ctor) -> "TypeId":
+        self.ctor = ctor
+        return self
+
+    def AddAttribute(self, name, help, initial, field=None, checker=None) -> "TypeId":
+        field = field or _default_field(name)
+        self.attributes[name] = AttributeSpec(name, help, initial, field, checker)
+        return self
+
+    def AddTraceSource(self, name, help, field=None) -> "TypeId":
+        field = field or _default_field(name)
+        self.trace_sources[name] = TraceSourceSpec(name, help, field)
+        return self
+
+    # --- lookup ---
+    @staticmethod
+    def LookupByName(name: str) -> "TypeId":
+        tid = TypeId._registry.get(name)
+        if tid is None:
+            raise KeyError(f"unknown TypeId {name!r}")
+        return tid
+
+    @staticmethod
+    def LookupByNameFailSafe(name: str) -> "TypeId | None":
+        return TypeId._registry.get(name)
+
+    def LookupAttribute(self, name: str) -> AttributeSpec | None:
+        tid = self
+        while tid is not None:
+            spec = tid.attributes.get(name)
+            if spec is not None:
+                return spec
+            tid = tid.parent
+        return None
+
+    def LookupTraceSource(self, name: str) -> TraceSourceSpec | None:
+        tid = self
+        while tid is not None:
+            spec = tid.trace_sources.get(name)
+            if spec is not None:
+                return spec
+            tid = tid.parent
+        return None
+
+    def AllAttributes(self) -> dict[str, AttributeSpec]:
+        out = {}
+        chain = []
+        tid = self
+        while tid is not None:
+            chain.append(tid)
+            tid = tid.parent
+        for tid in reversed(chain):
+            out.update(tid.attributes)
+        return out
+
+    def IsChildOf(self, other: "TypeId") -> bool:
+        tid = self
+        while tid is not None:
+            if tid is other:
+                return True
+            tid = tid.parent
+        return False
+
+    def GetName(self) -> str:
+        return self.name
+
+    def __repr__(self):
+        return f"TypeId({self.name})"
+
+
+def _default_field(name: str) -> str:
+    # "DataRate" -> "data_rate"
+    out = []
+    for i, c in enumerate(name):
+        if c.isupper() and i > 0 and (not name[i - 1].isupper()):
+            out.append("_")
+        out.append(c.lower())
+    return "".join(out)
+
+
+# module-level defaults overridden by Config.SetDefault (config.py)
+_DEFAULT_OVERRIDES: dict[tuple[str, str], object] = {}
+
+
+def set_default(tid_name: str, attr: str, value) -> None:
+    tid = TypeId.LookupByName(tid_name)
+    spec = tid.LookupAttribute(attr)
+    if spec is None:
+        raise KeyError(f"{tid_name} has no attribute {attr!r}")
+    if isinstance(value, str) and spec.checker is None:
+        # coerce CLI strings toward the type of the declared default
+        if isinstance(spec.initial, bool):
+            value = value.lower() in ("1", "true", "t", "yes", "y")
+        elif isinstance(spec.initial, int):
+            value = int(float(value))
+        elif isinstance(spec.initial, float):
+            value = float(value)
+    _DEFAULT_OVERRIDES[(tid.name, attr)] = value
+
+
+class ObjectBase:
+    """Attribute plumbing shared by Object and helper-constructed types
+    (src/core/model/object-base.{h,cc})."""
+
+    tid: TypeId | None = None  # set by each class
+
+    @classmethod
+    def GetTypeId(cls) -> TypeId:
+        return cls.tid
+
+    def construct_attributes(self, overrides: dict | None = None):
+        """Apply attribute defaults (plus Config.SetDefault overrides and
+        per-construct overrides) to this instance's fields, and
+        instantiate declared trace sources."""
+        from tpudes.core.trace import TracedCallback
+
+        tid = type(self).GetTypeId()
+        if tid is None:
+            return
+        for name, spec in tid.AllAttributes().items():
+            value = spec.initial
+            # walk override chain: class default overrides first
+            t = tid
+            while t is not None:
+                if (t.name, name) in _DEFAULT_OVERRIDES:
+                    value = _DEFAULT_OVERRIDES[(t.name, name)]
+                    break
+                t = t.parent
+            if overrides and name in overrides:
+                value = overrides[name]
+            if spec.checker is not None:
+                value = spec.checker(value)
+            elif isinstance(value, (list, dict)):
+                value = copy.copy(value)
+            setattr(self, spec.field, value)
+        # trace sources: instantiate a TracedCallback per declared source
+        t = tid
+        while t is not None:
+            for name, ts in t.trace_sources.items():
+                if not hasattr(self, ts.field):
+                    setattr(self, ts.field, TracedCallback())
+            t = t.parent
+
+    def SetAttribute(self, name: str, value) -> None:
+        spec = self._lookup_or_raise(name)
+        if spec.checker is not None:
+            value = spec.checker(value)
+        setattr(self, spec.field, value)
+
+    def SetAttributeFailSafe(self, name: str, value) -> bool:
+        tid = type(self).GetTypeId()
+        spec = tid.LookupAttribute(name) if tid else None
+        if spec is None:
+            return False
+        if spec.checker is not None:
+            try:
+                value = spec.checker(value)
+            except (ValueError, TypeError):
+                return False
+        setattr(self, spec.field, value)
+        return True
+
+    def GetAttribute(self, name: str):
+        spec = self._lookup_or_raise(name)
+        return getattr(self, spec.field)
+
+    def _lookup_or_raise(self, name: str) -> AttributeSpec:
+        tid = type(self).GetTypeId()
+        spec = tid.LookupAttribute(name) if tid is not None else None
+        if spec is None:
+            raise KeyError(f"{type(self).__name__} has no attribute {name!r}")
+        return spec
+
+    def TraceConnectWithoutContext(self, name: str, cb) -> bool:
+        tid = type(self).GetTypeId()
+        spec = tid.LookupTraceSource(name) if tid is not None else None
+        if spec is None:
+            return False
+        getattr(self, spec.field).ConnectWithoutContext(cb)
+        return True
+
+    def TraceConnect(self, name: str, context: str, cb) -> bool:
+        tid = type(self).GetTypeId()
+        spec = tid.LookupTraceSource(name) if tid is not None else None
+        if spec is None:
+            return False
+        getattr(self, spec.field).Connect(cb, context)
+        return True
+
+
+class Object(ObjectBase):
+    """Base for simulation objects: attribute construction + aggregation
+    (src/core/model/object.{h,cc}). ``AggregateObject`` links objects into
+    one queryable group — e.g. a Node aggregates Ipv4, mobility models."""
+
+    def __init__(self, **attributes):
+        self._aggregates: list[Object] = [self]
+        self._disposed = False
+        self.construct_attributes(attributes or None)
+
+    def AggregateObject(self, other: "Object") -> None:
+        # merge the two aggregate rings
+        group = self._aggregates
+        for o in other._aggregates:
+            if o not in group:
+                group.append(o)
+        for o in group:
+            o._aggregates = group
+
+    def GetObject(self, cls_or_tid):
+        """Find an aggregated object by class or TypeId."""
+        if isinstance(cls_or_tid, TypeId):
+            for o in self._aggregates:
+                otid = type(o).GetTypeId()
+                if otid is not None and otid.IsChildOf(cls_or_tid):
+                    return o
+            return None
+        for o in self._aggregates:
+            if isinstance(o, cls_or_tid):
+                return o
+        return None
+
+    def Dispose(self) -> None:
+        if not self._disposed:
+            self._disposed = True
+            self.DoDispose()
+
+    def DoDispose(self) -> None:
+        pass
+
+    def Initialize(self) -> None:
+        self.DoInitialize()
+
+    def DoInitialize(self) -> None:
+        pass
+
+
+class ObjectFactory:
+    """Creates objects from a TypeId name + attribute overrides
+    (src/core/model/object-factory.{h,cc})."""
+
+    def __init__(self, type_name: str | None = None, **attributes):
+        self._tid: TypeId | None = None
+        self._attributes = dict(attributes)
+        if type_name:
+            self.SetTypeId(type_name)
+
+    def SetTypeId(self, name: str | TypeId) -> None:
+        self._tid = name if isinstance(name, TypeId) else TypeId.LookupByName(name)
+
+    def Set(self, name: str, value) -> "ObjectFactory":
+        self._attributes[name] = value
+        return self
+
+    def Create(self):
+        if self._tid is None or self._tid.ctor is None:
+            raise RuntimeError(f"ObjectFactory: no constructor for {self._tid}")
+        return self._tid.ctor(**self._attributes)
+
+    def GetTypeId(self) -> TypeId | None:
+        return self._tid

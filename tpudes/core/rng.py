@@ -1,0 +1,559 @@
+"""Random number generation: MRG32k3a streams + the random-variable
+distribution library.
+
+Reference parity: src/core/model/rng-stream.{h,cc},
+rng-seed-manager.{h,cc}, random-variable-stream.{h,cc} (SURVEY.md 2.1).
+
+The generator is L'Ecuyer's MRG32k3a with the standard stream structure:
+each new ``RandomVariableStream`` takes the next *stream* (a 2^127 jump)
+and the global run number (``RngRun``) selects the *substream* (a 2^76
+jump) — the Monte-Carlo replica axis. Jumps are exact 3x3 matrix powers
+mod m, so streams are provably non-overlapping, matching ns-3's
+reproducibility contract on the host path.
+
+The TPU path uses counter-based threefry keys derived from
+(seed, run, stream-id) instead (tpudes/ops/random.py) — per-backend
+deterministic, cross-backend statistically equivalent (documented
+deviation; SURVEY.md 7 hard part 4).
+"""
+
+from __future__ import annotations
+
+import math
+
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.object import Object, TypeId
+
+# --- MRG32k3a constants (L'Ecuyer 1999) ---
+_M1 = 4294967087
+_M2 = 4294944443
+_A12 = 1403580
+_A13N = 810728
+_A21 = 527612
+_A23N = 1370589
+_NORM = 1.0 / (_M1 + 1)
+
+# one-step transition matrices
+_A1 = ((0, 1, 0), (0, 0, 1), ((_M1 - _A13N) % _M1, _A12, 0))
+_A2 = ((0, 1, 0), (0, 0, 1), ((_M2 - _A23N) % _M2, 0, _A21))
+
+
+def _mat_mul(a, b, m):
+    return tuple(
+        tuple(sum(a[i][k] * b[k][j] for k in range(3)) % m for j in range(3))
+        for i in range(3)
+    )
+
+
+def _mat_pow(a, e, m):
+    r = ((1, 0, 0), (0, 1, 0), (0, 0, 1))
+    while e > 0:
+        if e & 1:
+            r = _mat_mul(r, a, m)
+        a = _mat_mul(a, a, m)
+        e >>= 1
+    return r
+
+
+def _mat_vec(a, v, m):
+    return [sum(a[i][k] * v[k] for k in range(3)) % m for i in range(3)]
+
+
+# jump matrices: stream = 2^127 steps, substream = 2^76 steps (L'Ecuyer)
+_A1_P127 = _mat_pow(_A1, 1 << 127, _M1)
+_A2_P127 = _mat_pow(_A2, 1 << 127, _M2)
+_A1_P76 = _mat_pow(_A1, 1 << 76, _M1)
+_A2_P76 = _mat_pow(_A2, 1 << 76, _M2)
+
+
+class RngStream:
+    """One MRG32k3a stream positioned at (seed, stream, substream)."""
+
+    __slots__ = ("_s1", "_s2")
+
+    def __init__(self, seed: int, stream: int, substream: int):
+        # ns-3 expands the scalar seed into the six-value package seed.
+        s = seed % _M1
+        if s == 0:
+            s = 12345
+        base1 = [s, s, s]
+        base2 = [s % _M2 or 12345] * 3
+        if stream > 0:
+            j1 = _mat_pow(_A1_P127, stream, _M1)
+            j2 = _mat_pow(_A2_P127, stream, _M2)
+            base1 = _mat_vec(j1, base1, _M1)
+            base2 = _mat_vec(j2, base2, _M2)
+        if substream > 0:
+            j1 = _mat_pow(_A1_P76, substream, _M1)
+            j2 = _mat_pow(_A2_P76, substream, _M2)
+            base1 = _mat_vec(j1, base1, _M1)
+            base2 = _mat_vec(j2, base2, _M2)
+        self._s1 = base1
+        self._s2 = base2
+
+    def RandU01(self) -> float:
+        s1 = self._s1
+        s2 = self._s2
+        p1 = (_A12 * s1[1] - _A13N * s1[0]) % _M1
+        s1[0], s1[1], s1[2] = s1[1], s1[2], p1
+        p2 = (_A21 * s2[2] - _A23N * s2[0]) % _M2
+        s2[0], s2[1], s2[2] = s2[1], s2[2], p2
+        # L'Ecuyer: p1 <= p2 maps to p1 - p2 + m1, so p1 == p2 yields
+        # m1*norm (just below 1), never exactly 0.0 — keeps log(u)/u**-x
+        # in downstream distributions safe.
+        d = p1 - p2
+        if d <= 0:
+            d += _M1
+        return d * _NORM
+
+    def RandInt(self, low: int, high: int) -> int:
+        return low + int(self.RandU01() * (high - low + 1))
+
+
+class RngSeedManager:
+    """Global (seed, run) state + stream allocation
+    (src/core/model/rng-seed-manager.{h,cc})."""
+
+    _next_stream = 0
+
+    @classmethod
+    def SetSeed(cls, seed: int) -> None:
+        GlobalValue.Bind("RngSeed", int(seed))
+
+    @classmethod
+    def GetSeed(cls) -> int:
+        return GlobalValue.GetValue("RngSeed")
+
+    @classmethod
+    def SetRun(cls, run: int) -> None:
+        GlobalValue.Bind("RngRun", int(run))
+
+    @classmethod
+    def GetRun(cls) -> int:
+        return GlobalValue.GetValue("RngRun")
+
+    @classmethod
+    def GetNextStreamIndex(cls) -> int:
+        idx = cls._next_stream
+        cls._next_stream += 1
+        return idx
+
+    @classmethod
+    def Reset(cls) -> None:
+        cls._next_stream = 0
+
+
+class RandomVariableStream(Object):
+    """Base of all distributions
+    (src/core/model/random-variable-stream.{h,cc}). Each instance owns an
+    RngStream; ``SetStream`` pins the stream index for reproducibility
+    (the per-model ``AssignStreams`` contract)."""
+
+    tid = (
+        TypeId("tpudes::RandomVariableStream")
+        .AddAttribute("Stream", "Stream index (-1 = auto-allocate)", -1)
+        .AddAttribute("Antithetic", "Use antithetic (1-u) variates", False)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._rng: RngStream | None = None
+
+    def _stream_rng(self) -> RngStream:
+        if self._rng is None:
+            if self.stream < 0:
+                self.stream = RngSeedManager.GetNextStreamIndex()
+            self._rng = RngStream(
+                RngSeedManager.GetSeed(), self.stream, RngSeedManager.GetRun()
+            )
+        return self._rng
+
+    def SetStream(self, stream: int) -> None:
+        self.stream = stream
+        self._rng = None
+
+    def GetStream(self) -> int:
+        return self.stream
+
+    def _u01(self) -> float:
+        u = self._stream_rng().RandU01()
+        return 1.0 - u if self.antithetic else u
+
+    def GetValue(self) -> float:
+        raise NotImplementedError
+
+    def GetInteger(self) -> int:
+        return int(self.GetValue())
+
+
+class UniformRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::UniformRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Min", "Lower bound", 0.0)
+        .AddAttribute("Max", "Upper bound (exclusive)", 1.0)
+    )
+
+    def GetValue(self, min=None, max=None) -> float:
+        lo = self.min if min is None else min
+        hi = self.max if max is None else max
+        return lo + self._u01() * (hi - lo)
+
+    def GetInteger(self, min=None, max=None) -> int:
+        lo = int(self.min if min is None else min)
+        hi = int(self.max if max is None else max)
+        return lo + int(self._u01() * (hi - lo + 1))
+
+
+class ConstantRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::ConstantRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Constant", "The constant value", 0.0)
+    )
+
+    def GetValue(self) -> float:
+        return self.constant
+
+
+class SequentialRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::SequentialRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Min", "First value", 0.0)
+        .AddAttribute("Max", "Bound (restart below it)", 10.0)
+        .AddAttribute("Increment", "Step", 1.0)
+        .AddAttribute("Consecutive", "Repeats per value", 1)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._current = None
+        self._count = 0
+
+    def GetValue(self) -> float:
+        if self._current is None:
+            self._current = self.min
+        value = self._current
+        self._count += 1
+        if self._count >= self.consecutive:
+            self._count = 0
+            inc = self.increment.GetValue() if hasattr(self.increment, "GetValue") else self.increment
+            self._current += inc
+            if self._current >= self.max:
+                self._current = self.min
+        return value
+
+
+class ExponentialRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::ExponentialRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Mean", "Mean 1/lambda", 1.0)
+        .AddAttribute("Bound", "Upper truncation (0 = none)", 0.0)
+    )
+
+    def GetValue(self, mean=None, bound=None) -> float:
+        mean = self.mean if mean is None else mean
+        bound = self.bound if bound is None else bound
+        while True:
+            v = -mean * math.log(1.0 - self._u01())
+            if bound == 0.0 or v <= bound:
+                return v
+
+
+class ParetoRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::ParetoRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Scale", "Scale xm", 1.0)
+        .AddAttribute("Shape", "Shape alpha", 2.0)
+        .AddAttribute("Bound", "Upper truncation (0 = none)", 0.0)
+    )
+
+    def GetValue(self) -> float:
+        while True:
+            v = self.scale / (1.0 - self._u01()) ** (1.0 / self.shape)
+            if self.bound == 0.0 or v <= self.bound:
+                return v
+
+
+class WeibullRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::WeibullRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Scale", "Scale lambda", 1.0)
+        .AddAttribute("Shape", "Shape k", 1.0)
+        .AddAttribute("Bound", "Upper truncation (0 = none)", 0.0)
+    )
+
+    def GetValue(self) -> float:
+        while True:
+            v = self.scale * (-math.log(1.0 - self._u01())) ** (1.0 / self.shape)
+            if self.bound == 0.0 or v <= self.bound:
+                return v
+
+
+class NormalRandomVariable(RandomVariableStream):
+    INFINITE_VALUE = 1e307
+
+    tid = (
+        TypeId("tpudes::NormalRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Mean", "Mean", 0.0)
+        .AddAttribute("Variance", "Variance", 1.0)
+        .AddAttribute("Bound", "Symmetric bound around mean", 1e307)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._next: float | None = None
+
+    def GetValue(self, mean=None, variance=None, bound=None) -> float:
+        mean = self.mean if mean is None else mean
+        variance = self.variance if variance is None else variance
+        bound = self.bound if bound is None else bound
+        std = math.sqrt(variance)
+        while True:
+            if self._next is not None:
+                z, self._next = self._next, None
+            else:
+                # Box-Muller (polar), as ns-3 does
+                while True:
+                    u1 = 2.0 * self._u01() - 1.0
+                    u2 = 2.0 * self._u01() - 1.0
+                    w = u1 * u1 + u2 * u2
+                    if 0.0 < w < 1.0:
+                        break
+                y = math.sqrt(-2.0 * math.log(w) / w)
+                z = u1 * y
+                self._next = u2 * y
+            v = mean + z * std
+            if abs(v - mean) <= bound:
+                return v
+
+
+class LogNormalRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::LogNormalRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Mu", "Location mu (of ln X)", 0.0)
+        .AddAttribute("Sigma", "Scale sigma (of ln X)", 1.0)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._normal = None
+
+    def GetValue(self, mu=None, sigma=None) -> float:
+        mu = self.mu if mu is None else mu
+        sigma = self.sigma if sigma is None else sigma
+        if self._normal is None:
+            self._normal = NormalRandomVariable(Stream=0)
+            self._normal._rng = self._stream_rng()  # share the stream
+        z = self._normal.GetValue(0.0, 1.0, NormalRandomVariable.INFINITE_VALUE)
+        return math.exp(mu + sigma * z)
+
+
+class GammaRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::GammaRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Alpha", "Shape alpha", 1.0)
+        .AddAttribute("Beta", "Scale beta", 1.0)
+    )
+
+    def GetValue(self, alpha=None, beta=None) -> float:
+        alpha = self.alpha if alpha is None else alpha
+        beta = self.beta if beta is None else beta
+        # Marsaglia-Tsang; boost for alpha < 1 via U^(1/alpha) trick
+        if alpha < 1.0:
+            u = self._u01()
+            return self.GetValue(alpha + 1.0, beta) * u ** (1.0 / alpha)
+        d = alpha - 1.0 / 3.0
+        c = 1.0 / math.sqrt(9.0 * d)
+        while True:
+            while True:
+                # standard normal via Box-Muller polar
+                u1 = 2.0 * self._u01() - 1.0
+                u2 = 2.0 * self._u01() - 1.0
+                w = u1 * u1 + u2 * u2
+                if 0.0 < w < 1.0:
+                    break
+            x = u1 * math.sqrt(-2.0 * math.log(w) / w)
+            v = (1.0 + c * x) ** 3
+            if v <= 0:
+                continue
+            u = self._u01()
+            if u < 1.0 - 0.0331 * x**4:
+                return beta * d * v
+            if math.log(u) < 0.5 * x * x + d * (1.0 - v + math.log(v)):
+                return beta * d * v
+
+
+class ErlangRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::ErlangRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("K", "Shape k (integer)", 1)
+        .AddAttribute("Lambda", "Rate lambda", 1.0, field="lam")
+    )
+
+    def GetValue(self, k=None, lam=None) -> float:
+        k = self.k if k is None else k
+        lam = self.lam if lam is None else lam
+        total = 0.0
+        for _ in range(int(k)):
+            total += -math.log(1.0 - self._u01())
+        return total / lam
+
+
+class TriangularRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::TriangularRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Min", "Lower bound", 0.0)
+        .AddAttribute("Max", "Upper bound", 1.0)
+        .AddAttribute("Mean", "Mode-determining mean", 0.5)
+    )
+
+    def GetValue(self) -> float:
+        a, b, mean = self.min, self.max, self.mean
+        mode = 3.0 * mean - a - b
+        u = self._u01()
+        if u <= (mode - a) / (b - a):
+            return a + math.sqrt(u * (b - a) * (mode - a))
+        return b - math.sqrt((1.0 - u) * (b - a) * (b - mode))
+
+
+class ZipfRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::ZipfRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("N", "Number of outcomes", 1)
+        .AddAttribute("Alpha", "Exponent alpha", 0.0)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._c_for = None  # (n, alpha) the cached constant was computed for
+        self._c = None
+
+    def GetValue(self) -> float:
+        if self._c_for != (self.n, self.alpha):
+            self._c = 1.0 / sum(1.0 / i**self.alpha for i in range(1, self.n + 1))
+            self._c_for = (self.n, self.alpha)
+        u = self._u01()
+        acc = 0.0
+        for i in range(1, self.n + 1):
+            acc += self._c / i**self.alpha
+            if u <= acc:
+                return float(i)
+        return float(self.n)
+
+
+class ZetaRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::ZetaRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Alpha", "Exponent alpha (> 1)", 3.14)
+    )
+
+    def GetValue(self) -> float:
+        # Devroye's rejection method, as ns-3 uses
+        a = self.alpha
+        b = 2.0 ** (a - 1.0)
+        while True:
+            u = self._u01()
+            v = self._u01()
+            x = math.floor(u ** (-1.0 / (a - 1.0)))
+            t = (1.0 + 1.0 / x) ** (a - 1.0)
+            if v * x * (t - 1.0) / (b - 1.0) <= t / b:
+                return x
+
+
+class DeterministicRandomVariable(RandomVariableStream):
+    tid = TypeId("tpudes::DeterministicRandomVariable").SetParent(RandomVariableStream.tid)
+
+    def __init__(self, values=(), **attributes):
+        super().__init__(**attributes)
+        self._values = list(values)
+        self._i = 0
+
+    def SetValueArray(self, values) -> None:
+        self._values = list(values)
+        self._i = 0
+
+    def GetValue(self) -> float:
+        v = self._values[self._i % len(self._values)]
+        self._i += 1
+        return v
+
+
+class EmpiricalRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::EmpiricalRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Interpolate", "Linear-interpolate between CDF points", False)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._cdf: list[tuple[float, float]] = []  # (value, cumulative prob)
+
+    def CDF(self, value: float, prob: float) -> None:
+        self._cdf.append((value, prob))
+        self._cdf.sort(key=lambda p: p[1])
+
+    def GetValue(self) -> float:
+        u = self._u01()
+        prev_v, prev_p = None, 0.0
+        for v, p in self._cdf:
+            if u <= p:
+                if self.interpolate and prev_v is not None and p > prev_p:
+                    return prev_v + (v - prev_v) * (u - prev_p) / (p - prev_p)
+                return v
+            prev_v, prev_p = v, p
+        return self._cdf[-1][0] if self._cdf else 0.0
+
+
+class BernoulliRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::BernoulliRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Probability", "Probability of 1", 0.5)
+    )
+
+    def GetValue(self) -> float:
+        return 1.0 if self._u01() < self.probability else 0.0
+
+
+class BinomialRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::BinomialRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Trials", "Number of trials n", 10)
+        .AddAttribute("Probability", "Success probability p", 0.5)
+    )
+
+    def GetValue(self) -> float:
+        return float(sum(1 for _ in range(self.trials) if self._u01() < self.probability))
+
+
+class LaplacianRandomVariable(RandomVariableStream):
+    tid = (
+        TypeId("tpudes::LaplacianRandomVariable")
+        .SetParent(RandomVariableStream.tid)
+        .AddAttribute("Location", "Location mu", 0.0)
+        .AddAttribute("Scale", "Scale b", 1.0)
+        .AddAttribute("Bound", "Symmetric truncation (0 = none)", 0.0)
+    )
+
+    def GetValue(self) -> float:
+        while True:
+            u = self._u01() - 0.5
+            v = self.location - self.scale * math.copysign(1.0, u) * math.log(
+                1.0 - 2.0 * abs(u)
+            )
+            if self.bound == 0.0 or abs(v - self.location) <= self.bound:
+                return v

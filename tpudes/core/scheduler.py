@@ -1,0 +1,246 @@
+"""Event schedulers: priority queues of (ts, uid) -> event.
+
+Reference parity: src/core/model/scheduler.{h,cc} plus the five concrete
+implementations map-scheduler, list-scheduler, heap-scheduler,
+calendar-scheduler, priority-queue-scheduler (SURVEY.md 2.1). The engine
+selects one via the ``SchedulerType`` GlobalValue, exactly like ns-3's
+TypeId object-factory seam.
+
+Cancellation is lazy everywhere: ``EventId.Cancel`` only flags the event;
+schedulers purge flagged events when they reach the head (purge-on-read),
+so ``IsEmpty``/``PeekNext``/``RemoveNext`` always reflect live events.
+This matches ns-3 semantics (a cancelled event stays queued and is skipped
+at invoke time) while keeping the queue state self-consistent.
+
+The default is the binary heap (fastest in CPython); a native C++ core
+(tpudes.core.native) replaces it in the engines when the shared library is
+built.
+"""
+
+from __future__ import annotations
+
+import heapq
+from bisect import insort
+
+from tpudes.core.event import Event
+
+
+class Scheduler:
+    """Abstract priority queue of events, ordered by (ts, uid)."""
+
+    def Insert(self, ev: Event) -> None:
+        raise NotImplementedError
+
+    def IsEmpty(self) -> bool:
+        raise NotImplementedError
+
+    def PeekNext(self) -> Event:
+        """Next live event; caller must ensure not IsEmpty()."""
+        raise NotImplementedError
+
+    def RemoveNext(self) -> Event:
+        """Pop next live event; caller must ensure not IsEmpty()."""
+        raise NotImplementedError
+
+    def Remove(self, ev: Event) -> None:
+        """Remove a pending event (ns-3 Scheduler::Remove). Lazy: flag it;
+        it is purged when it reaches the head."""
+        ev.cancel()
+
+    def __len__(self):
+        """Count of live (non-cancelled) events. O(n); test/debug use."""
+        raise NotImplementedError
+
+
+class HeapScheduler(Scheduler):
+    """Binary heap (src/core/model/heap-scheduler.{h,cc}) with lazy
+    deletion of cancelled events."""
+
+    __slots__ = ("_heap",)
+
+    def __init__(self):
+        self._heap: list[Event] = []
+
+    def Insert(self, ev: Event) -> None:
+        heapq.heappush(self._heap, ev)
+
+    def _purge(self):
+        h = self._heap
+        while h and h[0].cancelled:
+            heapq.heappop(h)
+
+    def IsEmpty(self) -> bool:
+        self._purge()
+        return not self._heap
+
+    def PeekNext(self) -> Event:
+        self._purge()
+        return self._heap[0]
+
+    def RemoveNext(self) -> Event:
+        self._purge()
+        return heapq.heappop(self._heap)
+
+    def __len__(self):
+        return sum(1 for e in self._heap if not e.cancelled)
+
+
+class ListScheduler(Scheduler):
+    """Sorted insertion list (src/core/model/list-scheduler.{h,cc}).
+
+    O(n) insert, O(1) pop. Kept for parity and for tiny event counts.
+    """
+
+    __slots__ = ("_list",)
+
+    def __init__(self):
+        self._list: list[Event] = []
+
+    def Insert(self, ev: Event) -> None:
+        insort(self._list, ev)
+
+    def _purge(self):
+        while self._list and self._list[0].cancelled:
+            self._list.pop(0)
+
+    def IsEmpty(self) -> bool:
+        self._purge()
+        return not self._list
+
+    def PeekNext(self) -> Event:
+        self._purge()
+        return self._list[0]
+
+    def RemoveNext(self) -> Event:
+        self._purge()
+        return self._list.pop(0)
+
+    def __len__(self):
+        return sum(1 for e in self._list if not e.cancelled)
+
+
+class MapScheduler(Scheduler):
+    """Ordered-map scheduler (src/core/model/map-scheduler.{h,cc} —
+    std::map in ns-3, the upstream default).
+
+    CPython has no balanced tree in the stdlib; the binary heap provides
+    identical (ts, uid) ordering semantics, so this is an alias TypeId kept
+    for scheduler-selection parity with ns-3 scripts.
+    """
+
+    def __init__(self):
+        self._inner = HeapScheduler()
+
+    def Insert(self, ev):
+        self._inner.Insert(ev)
+
+    def IsEmpty(self):
+        return self._inner.IsEmpty()
+
+    def PeekNext(self):
+        return self._inner.PeekNext()
+
+    def RemoveNext(self):
+        return self._inner.RemoveNext()
+
+    def __len__(self):
+        return len(self._inner)
+
+
+class PriorityQueueScheduler(MapScheduler):
+    """std::priority_queue analogue (src/core/model/
+    priority-queue-scheduler.{h,cc}); same heap structure in Python, kept
+    as a distinct TypeId for parity."""
+
+
+class CalendarScheduler(Scheduler):
+    """Calendar queue (src/core/model/calendar-scheduler.{h,cc}): hashed
+    time buckets of width ``width`` ticks; O(1) amortized insert/pop under
+    uniform event-time spread (Brown 1988, the design ns-3 follows).
+
+    This implementation keeps the bucket array but finds the minimum by
+    scanning bucket heads (O(nbuckets) per pop) rather than the textbook
+    year-scan — simpler, same interface, adequate since the heap is the
+    performance path.
+    """
+
+    def __init__(self, nbuckets: int = 64, width: int = 1_000_000):
+        self._n = nbuckets
+        self._w = width
+        self._buckets: list[list[Event]] = [[] for _ in range(nbuckets)]
+        self._count = 0  # live events (cancelled purged on sight)
+
+    def _bucket(self, ts: int) -> list[Event]:
+        return self._buckets[(ts // self._w) % self._n]
+
+    def Insert(self, ev: Event) -> None:
+        insort(self._bucket(ev.ts), ev)
+        self._count += 1
+        if self._count > 4 * self._n:
+            self._resize(2 * self._n)
+
+    def _purge_heads(self):
+        for b in self._buckets:
+            while b and b[0].cancelled:
+                b.pop(0)
+                self._count -= 1
+
+    def IsEmpty(self) -> bool:
+        self._purge_heads()
+        return self._count == 0
+
+    def _min_bucket(self) -> list[Event]:
+        self._purge_heads()
+        best = None
+        for b in self._buckets:
+            if b and (best is None or b[0] < best[0]):
+                best = b
+        if best is None:
+            raise IndexError("empty calendar queue")
+        return best
+
+    def PeekNext(self) -> Event:
+        return self._min_bucket()[0]
+
+    def RemoveNext(self) -> Event:
+        b = self._min_bucket()
+        self._count -= 1
+        return b.pop(0)
+
+    def Remove(self, ev: Event) -> None:
+        if not ev.cancelled:
+            ev.cancel()
+        # purged (and counted down) when it reaches a bucket head
+
+    def _resize(self, n: int):
+        events = [e for b in self._buckets for e in b if not e.cancelled]
+        self._n = n
+        self._buckets = [[] for _ in range(n)]
+        self._count = len(events)
+        for e in events:
+            insort(self._bucket(e.ts), e)
+
+    def __len__(self):
+        return sum(sum(1 for e in b if not e.cancelled) for b in self._buckets)
+
+
+SCHEDULER_TYPES = {
+    "tpudes::HeapScheduler": HeapScheduler,
+    "tpudes::MapScheduler": MapScheduler,
+    "tpudes::ListScheduler": ListScheduler,
+    "tpudes::CalendarScheduler": CalendarScheduler,
+    "tpudes::PriorityQueueScheduler": PriorityQueueScheduler,
+    # ns-3 spellings accepted for drop-in script compatibility
+    "ns3::HeapScheduler": HeapScheduler,
+    "ns3::MapScheduler": MapScheduler,
+    "ns3::ListScheduler": ListScheduler,
+    "ns3::CalendarScheduler": CalendarScheduler,
+    "ns3::PriorityQueueScheduler": PriorityQueueScheduler,
+}
+
+
+def create_scheduler(type_name: str) -> Scheduler:
+    try:
+        return SCHEDULER_TYPES[type_name]()
+    except KeyError:
+        raise ValueError(f"unknown SchedulerType {type_name!r}") from None

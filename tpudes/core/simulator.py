@@ -1,0 +1,322 @@
+"""Simulator facade + pluggable engines.
+
+Reference parity: src/core/model/simulator.{h,cc} (static facade),
+simulator-impl.{h,cc} (abstract engine), default-simulator-impl.{h,cc}
+(sequential engine), realtime-simulator-impl.{h,cc} +
+wall-clock-synchronizer.{h,cc} (wall-clock engine). See SURVEY.md 2.1 and
+the call stack in SURVEY.md 3.1.
+
+The engine is chosen lazily at first use from the GlobalValue
+``SimulatorImplementationType`` — the exact seam BASELINE.json's north star
+plugs ``JaxSimulatorImpl`` into (registered via
+:func:`register_simulator_impl` on import of ``tpudes.parallel``).
+
+The hot loop works in raw integer ticks; ``Time`` objects only appear at
+the API boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _wallclock
+from collections import deque
+
+from tpudes.core.event import Event, EventId
+from tpudes.core.nstime import Time
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.scheduler import create_scheduler
+
+
+class SimulatorImpl:
+    """Abstract engine: owns current time/context and runs the loop
+    (src/core/model/simulator-impl.h)."""
+
+    def __init__(self):
+        self.current_ts = 0
+        self.current_context = Event.NO_CONTEXT
+        self.current_uid = 0
+        self._uid = 1  # uid 0 reserved (ns-3 reserves low uids for destroy)
+        self._stop = False
+        self._destroy_events: list[Event] = []
+        scheduler_type = GlobalValue.GetValue("SchedulerType")
+        self._events = create_scheduler(scheduler_type)
+        self._event_count = 0  # total executed, for ShowProgress/bench
+
+    # --- scheduling ---
+    def Schedule(self, delay_ticks: int, fn, args) -> Event:
+        if delay_ticks < 0:
+            raise ValueError(f"negative schedule delay: {delay_ticks} ticks")
+        ts = self.current_ts + delay_ticks
+        ev = Event(ts, self._uid, self.current_context, fn, args)
+        self._uid += 1
+        self._events.Insert(ev)
+        return ev
+
+    def ScheduleWithContext(self, context: int, delay_ticks: int, fn, args) -> Event:
+        if delay_ticks < 0:
+            raise ValueError(f"negative schedule delay: {delay_ticks} ticks")
+        ts = self.current_ts + delay_ticks
+        ev = Event(ts, self._uid, context, fn, args)
+        self._uid += 1
+        self._events.Insert(ev)
+        return ev
+
+    def ScheduleAt(self, context: int, ts: int, fn, args) -> Event:
+        """Schedule at an absolute timestamp (window engines, thread
+        injection, cross-partition receives)."""
+        ev = Event(ts, self._uid, context, fn, args)
+        self._uid += 1
+        self._events.Insert(ev)
+        return ev
+
+    def ScheduleDestroy(self, fn, args) -> Event:
+        ev = Event(0, self._uid, self.current_context, fn, args)
+        self._uid += 1
+        self._destroy_events.append(ev)
+        return ev
+
+    def Remove(self, ev: Event) -> None:
+        self._events.Remove(ev)
+
+    # --- time ---
+    def Now(self) -> int:
+        return self.current_ts
+
+    def NextTs(self) -> int:
+        """Timestamp of next pending event (for window engines)."""
+        if self._events.IsEmpty():
+            return -1
+        return self._events.PeekNext().ts
+
+    def IsFinished(self) -> bool:
+        return self._stop or self._events.IsEmpty()
+
+    # --- control ---
+    def Run(self) -> None:
+        raise NotImplementedError
+
+    def Stop(self, delay_ticks: int | None = None) -> Event | None:
+        if delay_ticks is None:
+            self._stop = True
+            return None
+        return self.Schedule(delay_ticks, self._do_stop, ())
+
+    def _do_stop(self):
+        self._stop = True
+
+    def Destroy(self) -> None:
+        for ev in self._destroy_events:
+            if not ev.cancelled:
+                ev.invoke()
+        self._destroy_events.clear()
+
+    # --- shared inner step ---
+    def _invoke(self, ev: Event) -> None:
+        self.current_ts = ev.ts
+        self.current_context = ev.context
+        self.current_uid = ev.uid
+        self._event_count += 1
+        ev.invoke()
+
+
+class DefaultSimulatorImpl(SimulatorImpl):
+    """Sequential engine: pop events in (ts, uid) order and invoke until
+    the queue drains or Stop() (src/core/model/default-simulator-impl.cc).
+
+    ``ScheduleWithContextThreadSafe`` + ``_process_events_with_context``
+    mirror ns-3's mutex-guarded cross-thread injection channel (used by
+    emulation read threads; SURVEY.md 5.2).
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._injected: deque = deque()
+        self._injected_lock = threading.Lock()
+        self._main_thread = threading.get_ident()
+
+    def ScheduleWithContextThreadSafe(self, context: int, delay_ticks: int, fn, args):
+        # capture the timestamp at *injection* time (ns-3 grabs m_currentTs
+        # under the mutex here) — sim time may advance before the drain
+        with self._injected_lock:
+            self._injected.append((context, self.current_ts + delay_ticks, fn, args))
+
+    def _process_events_with_context(self):
+        if not self._injected:
+            return
+        with self._injected_lock:
+            pending, self._injected = self._injected, deque()
+        for context, ts, fn, args in pending:
+            # an injected ts may be in the engine's past by the time it
+            # drains; clamp to now (the realtime engine's contract)
+            self.ScheduleAt(context, max(ts, self.current_ts), fn, args)
+
+    def Run(self) -> None:
+        self._stop = False
+        events = self._events
+        while not self._stop:
+            self._process_events_with_context()
+            if events.IsEmpty():
+                break
+            self._invoke(events.RemoveNext())
+
+
+class RealtimeSimulatorImpl(DefaultSimulatorImpl):
+    """Pins simulated time to the wall clock
+    (src/core/model/realtime-simulator-impl.cc): before invoking an event
+    at sim time t, sleep until wall-clock has reached t since Run() began.
+    ``BestEffort`` mode tolerates falling behind; ``HardLimit`` raises if
+    the jitter exceeds ``hard_limit`` (default 0.1 s), as in ns-3.
+    """
+
+    BEST_EFFORT = 0
+    HARD_LIMIT = 1
+
+    def __init__(self, mode: int = 0, hard_limit_s: float = 0.1):
+        super().__init__()
+        self.mode = mode
+        self.hard_limit_s = hard_limit_s
+
+    def Run(self) -> None:
+        self._stop = False
+        start_wall = _wallclock.monotonic()
+        start_sim_s = Time(self.current_ts).GetSeconds()
+        events = self._events
+        while not self._stop:
+            self._process_events_with_context()
+            if events.IsEmpty():
+                break
+            ev = events.PeekNext()
+            target_wall = start_wall + (Time(ev.ts).GetSeconds() - start_sim_s)
+            now_wall = _wallclock.monotonic()
+            if target_wall > now_wall:
+                # sleep in slices so injected (emulation) events can preempt
+                while True:
+                    remaining = target_wall - _wallclock.monotonic()
+                    if remaining <= 0:
+                        break
+                    _wallclock.sleep(min(remaining, 0.001))
+                    if self._injected:
+                        break
+                if self._injected:
+                    continue  # re-evaluate next event after injection
+            elif self.mode == self.HARD_LIMIT and now_wall - target_wall > self.hard_limit_s:
+                raise RuntimeError(
+                    f"RealtimeSimulatorImpl(HardLimit): fell "
+                    f"{now_wall - target_wall:.3f}s behind wall clock"
+                )
+            self._invoke(events.RemoveNext())
+
+
+# --- engine registry (the ObjectFactory seam) ---
+
+SIMULATOR_IMPL_TYPES: dict[str, type] = {}
+
+
+def register_simulator_impl(name: str, cls: type) -> None:
+    SIMULATOR_IMPL_TYPES[name] = cls
+
+
+register_simulator_impl("tpudes::DefaultSimulatorImpl", DefaultSimulatorImpl)
+register_simulator_impl("ns3::DefaultSimulatorImpl", DefaultSimulatorImpl)
+register_simulator_impl("tpudes::RealtimeSimulatorImpl", RealtimeSimulatorImpl)
+register_simulator_impl("ns3::RealtimeSimulatorImpl", RealtimeSimulatorImpl)
+
+
+class Simulator:
+    """Static facade (src/core/model/simulator.h): Schedule / Run / Stop /
+    Now / Destroy. All times are ``Time`` at this boundary."""
+
+    _impl: SimulatorImpl | None = None
+
+    # --- engine seam ---
+    @classmethod
+    def GetImpl(cls) -> SimulatorImpl:
+        if cls._impl is None:
+            name = GlobalValue.GetValue("SimulatorImplementationType")
+            impl_cls = SIMULATOR_IMPL_TYPES.get(name)
+            if impl_cls is None:
+                # late registration: the JAX engine lives in tpudes.parallel
+                if "Jax" in name:
+                    import tpudes.parallel  # noqa: F401  (registers itself)
+
+                    impl_cls = SIMULATOR_IMPL_TYPES.get(name)
+            if impl_cls is None:
+                raise ValueError(f"unknown SimulatorImplementationType {name!r}")
+            cls._impl = impl_cls()
+        return cls._impl
+
+    @classmethod
+    def SetImplementation(cls, impl: SimulatorImpl) -> None:
+        if cls._impl is not None:
+            raise RuntimeError("simulator implementation already created")
+        cls._impl = impl
+
+    # --- scheduling API ---
+    @classmethod
+    def Schedule(cls, delay: Time, fn, *args) -> EventId:
+        return EventId(cls.GetImpl().Schedule(Time(delay).ticks, fn, args))
+
+    @classmethod
+    def ScheduleNow(cls, fn, *args) -> EventId:
+        return EventId(cls.GetImpl().Schedule(0, fn, args))
+
+    @classmethod
+    def ScheduleWithContext(cls, context: int, delay: Time, fn, *args) -> EventId:
+        return EventId(cls.GetImpl().ScheduleWithContext(context, Time(delay).ticks, fn, args))
+
+    @classmethod
+    def ScheduleDestroy(cls, fn, *args) -> EventId:
+        return EventId(cls.GetImpl().ScheduleDestroy(fn, args))
+
+    @classmethod
+    def Cancel(cls, event_id: EventId) -> None:
+        event_id.Cancel()
+
+    @classmethod
+    def Remove(cls, event_id: EventId) -> None:
+        if event_id._event is not None:
+            cls.GetImpl().Remove(event_id._event)
+
+    # --- control ---
+    @classmethod
+    def Run(cls) -> None:
+        cls.GetImpl().Run()
+
+    @classmethod
+    def Stop(cls, delay: Time | None = None) -> EventId | None:
+        if delay is None:
+            cls.GetImpl().Stop(None)
+            return None
+        return EventId(cls.GetImpl().Stop(Time(delay).ticks))
+
+    @classmethod
+    def Destroy(cls) -> None:
+        """Invoke destroy events and reset the engine, so a process can run
+        several simulations back-to-back (each pytest test does)."""
+        if cls._impl is not None:
+            cls._impl.Destroy()
+        cls._impl = None
+
+    # --- time / context ---
+    @classmethod
+    def Now(cls) -> Time:
+        return Time(cls.GetImpl().current_ts)
+
+    @classmethod
+    def NowTicks(cls) -> int:
+        return cls._impl.current_ts if cls._impl is not None else 0
+
+    @classmethod
+    def GetContext(cls) -> int:
+        return cls.GetImpl().current_context
+
+    @classmethod
+    def GetEventCount(cls) -> int:
+        return cls.GetImpl()._event_count
+
+    @classmethod
+    def IsFinished(cls) -> bool:
+        return cls.GetImpl().IsFinished()
+
+    # convenience used by models: delay for next occurrence
+    NO_CONTEXT = Event.NO_CONTEXT

@@ -1,0 +1,87 @@
+"""Callback and tracing primitives — the hook mechanism for ALL
+observability.
+
+Reference parity: src/core/model/callback.h, traced-callback.h,
+traced-value.h (SURVEY.md 2.1, 5.1). In Python any callable is a
+``Callback``; ``MakeCallback`` exists for source compatibility.
+"""
+
+from __future__ import annotations
+
+
+def MakeCallback(fn, obj=None):
+    if obj is None:
+        return fn
+    return lambda *args: fn(obj, *args)
+
+
+def MakeNullCallback(*_):
+    """A safely-invokable no-op sentinel, as in ns-3."""
+
+    def _null(*_args, **_kw):
+        return None
+
+    _null.is_null = True
+    return _null
+
+
+class TracedCallback:
+    """A list of connected sinks invoked on fire
+    (src/core/model/traced-callback.h). ``Connect`` attaches a context
+    string prepended to the sink's arguments, as Config.Connect does."""
+
+    __slots__ = ("_sinks",)
+
+    def __init__(self):
+        self._sinks: list = []
+
+    def ConnectWithoutContext(self, cb) -> None:
+        self._sinks.append((None, cb))
+
+    def Connect(self, cb, context: str) -> None:
+        self._sinks.append((context, cb))
+
+    def DisconnectWithoutContext(self, cb) -> None:
+        self._sinks = [(c, s) for (c, s) in self._sinks if s is not cb]
+
+    def Disconnect(self, cb, context: str) -> None:
+        self._sinks = [(c, s) for (c, s) in self._sinks if not (s is cb and c == context)]
+
+    def IsEmpty(self) -> bool:
+        return not self._sinks
+
+    def __call__(self, *args) -> None:
+        for context, sink in self._sinks:
+            if context is None:
+                sink(*args)
+            else:
+                sink(context, *args)
+
+
+class TracedValue:
+    """A value that fires (old, new) callbacks on change
+    (src/core/model/traced-value.h)."""
+
+    __slots__ = ("_value", "_trace")
+
+    def __init__(self, initial=None):
+        self._value = initial
+        self._trace = TracedCallback()
+
+    def Get(self):
+        return self._value
+
+    def Set(self, value) -> None:
+        if value != self._value:
+            old = self._value
+            self._value = value
+            self._trace(old, value)
+
+    def ConnectWithoutContext(self, cb) -> None:
+        self._trace.ConnectWithoutContext(cb)
+
+    def Connect(self, cb, context: str) -> None:
+        self._trace.Connect(cb, context)
+
+    def __repr__(self):
+        return f"TracedValue({self._value!r})"
