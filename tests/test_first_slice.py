@@ -1,0 +1,180 @@
+"""Minimum end-to-end slice (SURVEY.md 7 step 3): first.cc equivalent,
+with the event-trace golden oracle later engines must reproduce.
+
+The expected timings are *upstream ns-3's own printed values* for
+first.cc (2.00369s / 2.00737s): 1054 bytes (1024 payload + 8 UDP + 20
+IPv4 + 2 PPP) at 5 Mbps = 1.6864 ms serialization + 2 ms propagation.
+"""
+
+import pytest
+
+from tpudes.core.nstime import MilliSeconds, Seconds
+from tpudes.core.simulator import Simulator
+from tpudes.helper import (
+    InternetStackHelper,
+    Ipv4AddressHelper,
+    NodeContainer,
+    PointToPointHelper,
+    UdpEchoClientHelper,
+    UdpEchoServerHelper,
+)
+from tpudes.network.address import InetSocketAddress, Ipv4Address
+
+
+def build_first(packets=1, data_rate="5Mbps", delay="2ms"):
+    nodes = NodeContainer()
+    nodes.Create(2)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", data_rate)
+    p2p.SetChannelAttribute("Delay", delay)
+    devices = p2p.Install(nodes)
+    stack = InternetStackHelper()
+    stack.Install(nodes)
+    address = Ipv4AddressHelper()
+    address.SetBase("10.1.1.0", "255.255.255.0")
+    interfaces = address.Assign(devices)
+
+    server_apps = UdpEchoServerHelper(9).Install(nodes.Get(1))
+    server_apps.Start(Seconds(1.0))
+    server_apps.Stop(Seconds(10.0))
+    client_helper = UdpEchoClientHelper(interfaces.GetAddress(1), 9)
+    client_helper.SetAttribute("MaxPackets", packets)
+    client_helper.SetAttribute("Interval", Seconds(1.0))
+    client_helper.SetAttribute("PacketSize", 1024)
+    client_apps = client_helper.Install(nodes.Get(0))
+    client_apps.Start(Seconds(2.0))
+    client_apps.Stop(Seconds(10.0))
+    return nodes, devices, interfaces, server_apps.Get(0), client_apps.Get(0)
+
+
+def test_first_golden_trace():
+    nodes, devices, interfaces, server, client = build_first()
+    trace = []
+    client.TraceConnectWithoutContext("Tx", lambda p: trace.append(("ctx", Simulator.Now().ticks, p.GetSize())))
+    server.TraceConnectWithoutContext("Rx", lambda p: trace.append(("srx", Simulator.Now().ticks, p.GetSize())))
+    client.TraceConnectWithoutContext("Rx", lambda p: trace.append(("crx", Simulator.Now().ticks, p.GetSize())))
+    Simulator.Run()
+    # golden: tx at 2s; server rx at 2s + 1.6864ms + 2ms; client rx after
+    # the symmetric return trip — ns-3 first.cc's exact printed times
+    assert trace == [
+        ("ctx", 2_000_000_000, 1024),
+        ("srx", 2_003_686_400, 1024),
+        ("crx", 2_007_372_800, 1024),
+    ]
+
+
+def test_first_addresses():
+    nodes, devices, interfaces, server, client = build_first()
+    assert str(interfaces.GetAddress(0)) == "10.1.1.1"
+    assert str(interfaces.GetAddress(1)) == "10.1.1.2"
+
+
+def test_echo_multiple_packets():
+    nodes, devices, interfaces, server, client = build_first(packets=5)
+    Simulator.Run()
+    assert client.sent == 5
+    assert server.received == 5
+    assert client.received == 5
+
+
+def test_queueing_delay_back_to_back():
+    """Two packets sent at once: the second's rx is one serialization
+    time after the first's (tx queue drains serially)."""
+    nodes, devices, interfaces, server, client = build_first()
+    from tpudes.network.packet import Packet
+    from tpudes.network.socket import SocketFactory
+
+    rx_times = []
+    server.TraceConnectWithoutContext("Rx", lambda p: rx_times.append(Simulator.Now().ticks))
+
+    def burst():
+        sock = SocketFactory.CreateSocket(nodes.Get(0), "tpudes::UdpSocketFactory")
+        sock.Bind()
+        dst = InetSocketAddress(interfaces.GetAddress(1), 9)
+        sock.SendTo(Packet(1024), 0, dst)
+        sock.SendTo(Packet(1024), 0, dst)
+
+    Simulator.Schedule(Seconds(5), burst)
+    Simulator.Run()
+    assert len(rx_times) >= 2
+    ser_time = rx_times[-1] - rx_times[-2]
+    assert ser_time == 1_686_400  # exactly one 1054-byte serialization @5Mbps
+
+
+def test_three_node_forwarding():
+    """n0 -- n1 -- n2 with static routes through n1: exercises TTL
+    decrement and UnicastForward."""
+    nodes = NodeContainer()
+    nodes.Create(3)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "5Mbps")
+    p2p.SetChannelAttribute("Delay", "1ms")
+    d01 = p2p.Install(nodes.Get(0), nodes.Get(1))
+    d12 = p2p.Install(nodes.Get(1), nodes.Get(2))
+    InternetStackHelper().Install(nodes)
+    addr = Ipv4AddressHelper()
+    addr.SetBase("10.1.1.0", "255.255.255.0")
+    i01 = addr.Assign(d01)
+    addr.SetBase("10.1.2.0", "255.255.255.0")
+    i12 = addr.Assign(d12)
+
+    from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+
+    # default routes via n1 on the edge nodes
+    ip0 = nodes.Get(0).GetObject(Ipv4L3Protocol)
+    ip2 = nodes.Get(2).GetObject(Ipv4L3Protocol)
+    ip0.GetRoutingProtocol().SetDefaultRoute(i01.GetAddress(1), 1)
+    ip2.GetRoutingProtocol().SetDefaultRoute(i12.GetAddress(0), 1)
+
+    server_apps = UdpEchoServerHelper(9).Install(nodes.Get(2))
+    server_apps.Start(Seconds(0.5))
+    client_helper = UdpEchoClientHelper(i12.GetAddress(1), 9)
+    client_helper.SetAttribute("MaxPackets", 2)
+    client_apps = client_helper.Install(nodes.Get(0))
+    client_apps.Start(Seconds(1.0))
+
+    forwards = []
+    ip1 = nodes.Get(1).GetObject(Ipv4L3Protocol)
+    ip1.TraceConnectWithoutContext("UnicastForward", lambda h, p, i: forwards.append(h.ttl))
+
+    Simulator.Stop(Seconds(20))
+    Simulator.Run()
+    server = server_apps.Get(0)
+    client = client_apps.Get(0)
+    assert server.received == 2
+    assert client.received == 2
+    assert len(forwards) == 4  # 2 requests + 2 replies through n1
+    assert all(ttl == 63 for ttl in forwards)
+
+
+def test_interface_down_drops():
+    nodes, devices, interfaces, server, client = build_first()
+    from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+
+    drops = []
+    ip0 = nodes.Get(0).GetObject(Ipv4L3Protocol)
+    ip0.TraceConnectWithoutContext("Drop", lambda h, p, r: drops.append(r))
+    Simulator.Schedule(MilliSeconds(1500), ip0.SetDown, 1)
+    Simulator.Run()
+    assert server.received == 0
+    assert drops and drops[0] == Ipv4L3Protocol.DROP_INTERFACE_DOWN
+
+
+def test_loopback_delivery():
+    nodes, devices, interfaces, server, client = build_first()
+    from tpudes.network.packet import Packet
+    from tpudes.network.socket import SocketFactory
+
+    got = []
+
+    def setup():
+        recv = SocketFactory.CreateSocket(nodes.Get(0), "tpudes::UdpSocketFactory")
+        recv.Bind(InetSocketAddress(Ipv4Address.GetAny(), 777))
+        recv.SetRecvCallback(lambda s: got.append(s.Recv().GetSize()))
+        send = SocketFactory.CreateSocket(nodes.Get(0), "tpudes::UdpSocketFactory")
+        send.Bind()
+        send.SendTo(Packet(64), 0, InetSocketAddress("127.0.0.1", 777))
+
+    Simulator.Schedule(Seconds(3), setup)
+    Simulator.Run()
+    assert got == [64]

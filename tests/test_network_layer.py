@@ -1,0 +1,223 @@
+"""Packet/node layer: Packet value semantics, addresses, queues, error
+models, SimpleNetDevice delivery (parity with upstream
+src/network/test/; SURVEY.md 2.2, 4)."""
+
+import pytest
+
+from tpudes.core.nstime import MilliSeconds, Seconds
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import (
+    InetSocketAddress,
+    Ipv4Address,
+    Ipv4Mask,
+    Mac48Address,
+)
+from tpudes.network.data_rate import DataRate
+from tpudes.network.error_model import ListErrorModel, RateErrorModel
+from tpudes.network.net_device import SimpleChannel, SimpleNetDevice
+from tpudes.network.node import Node, NodeList
+from tpudes.network.packet import Header, LlcSnapHeader, Packet, Tag
+from tpudes.network.queue import DropTailQueue, QueueSize
+
+
+class FakeHeader(Header):
+    def __init__(self, x=0):
+        self.x = x
+
+    def GetSerializedSize(self):
+        return 4
+
+    def Serialize(self):
+        return self.x.to_bytes(4, "big")
+
+
+class FlowTag(Tag):
+    def __init__(self, flow_id):
+        self.flow_id = flow_id
+
+
+def test_packet_headers_lifo_and_size():
+    p = Packet(100)
+    p.AddHeader(FakeHeader(1))
+    p.AddHeader(FakeHeader(2))
+    assert p.GetSize() == 108
+    h = p.RemoveHeader(FakeHeader)
+    assert h.x == 2  # last added = front of packet
+    assert p.RemoveHeader().x == 1
+    assert p.GetSize() == 100
+
+
+def test_packet_copy_value_semantics():
+    p = Packet(50)
+    p.AddHeader(FakeHeader(9))
+    c = p.Copy()
+    c.RemoveHeader()
+    assert p.GetSize() == 54  # original unaffected (COW)
+    assert c.GetSize() == 50
+    assert c.GetUid() == p.GetUid()  # copies share uid, as in ns-3
+
+
+def test_packet_tags():
+    p = Packet(10)
+    p.AddPacketTag(FlowTag(7))
+    c = p.Copy()
+    assert c.PeekPacketTag(FlowTag).flow_id == 7
+    removed = c.RemovePacketTag(FlowTag)
+    assert removed.flow_id == 7
+    assert c.PeekPacketTag(FlowTag) is None
+    assert p.PeekPacketTag(FlowTag).flow_id == 7  # original keeps its tag
+
+
+def test_packet_wire_serialization():
+    p = Packet(b"abc")
+    p.AddHeader(LlcSnapHeader(0x0806))
+    raw = p.ToBytes()
+    assert len(raw) == 11
+    h, consumed = LlcSnapHeader.Deserialize(raw)
+    assert consumed == 8 and h.ether_type == 0x0806
+    assert raw[8:] == b"abc"
+
+
+def test_mac48_allocate_unique():
+    a, b = Mac48Address.Allocate(), Mac48Address.Allocate()
+    assert a != b
+    assert str(Mac48Address("00:00:00:00:00:01")) == "00:00:00:00:00:01"
+    assert Mac48Address.GetBroadcast().IsBroadcast()
+
+
+def test_ipv4_address_and_mask():
+    a = Ipv4Address("10.1.1.5")
+    m = Ipv4Mask("255.255.255.0")
+    assert str(a.CombineMask(m)) == "10.1.1.0"
+    assert m.IsMatch(a, Ipv4Address("10.1.1.200"))
+    assert not m.IsMatch(a, Ipv4Address("10.1.2.5"))
+    assert m.GetPrefixLength() == 24
+    assert Ipv4Mask("/16").GetPrefixLength() == 16
+    assert str(a.GetSubnetDirectedBroadcast(m)) == "10.1.1.255"
+    sa = InetSocketAddress("10.1.1.5", 80)
+    assert sa.GetPort() == 80 and sa.GetIpv4() == a
+
+
+def test_data_rate_parsing_and_tx_time():
+    r = DataRate("5Mbps")
+    assert r.GetBitRate() == 5_000_000
+    t = r.CalculateBytesTxTime(625)  # 5000 bits @ 5Mbps = 1ms
+    assert t == MilliSeconds(1)
+    assert DataRate("1kbps").GetBitRate() == 1000
+    with pytest.raises(ValueError):
+        DataRate("5flops")
+
+
+def test_drop_tail_queue_packet_mode():
+    q = DropTailQueue(MaxSize="2p")
+    drops = []
+    q.TraceConnectWithoutContext("Drop", drops.append)
+    assert q.Enqueue(Packet(100)) and q.Enqueue(Packet(100))
+    assert not q.Enqueue(Packet(100))  # full -> tail drop
+    assert len(drops) == 1
+    assert q.GetNPackets() == 2
+    assert q.Dequeue().GetSize() == 100
+    assert q.GetNPackets() == 1
+
+
+def test_drop_tail_queue_byte_mode():
+    q = DropTailQueue(MaxSize="250B")
+    assert q.Enqueue(Packet(100)) and q.Enqueue(Packet(100))
+    assert not q.Enqueue(Packet(100))  # 300B > 250B
+    assert q.GetNBytes() == 200
+
+
+def test_queue_size_parsing():
+    assert QueueSize("10p").mode == QueueSize.PACKETS
+    assert QueueSize("64kB").value == 64000
+
+
+def test_rate_error_model_statistics():
+    em = RateErrorModel(ErrorRate=0.1, ErrorUnit=RateErrorModel.ERROR_UNIT_PACKET)
+    em.AssignStreams(50)
+    n = 10000
+    corrupted = sum(1 for _ in range(n) if em.IsCorrupt(Packet(10)))
+    assert abs(corrupted / n - 0.1) < 0.02
+
+
+def test_list_error_model_deterministic():
+    em = ListErrorModel()
+    p1, p2, p3 = Packet(1), Packet(1), Packet(1)
+    em.SetList([p2.GetUid()])
+    assert not em.IsCorrupt(p1)
+    assert em.IsCorrupt(p2)
+    assert not em.IsCorrupt(p3)
+    em.Disable()
+    assert not em.IsCorrupt(p2)
+
+
+def test_node_registry_and_device():
+    n1, n2 = Node(), Node()
+    assert NodeList.GetNNodes() == 2
+    assert NodeList.GetNode(n1.GetId()) is n1
+    d = SimpleNetDevice()
+    assert n1.AddDevice(d) == 0
+    assert d.GetNode() is n1 and n1.GetDevice(0) is d
+
+
+def test_simple_channel_end_to_end_delivery():
+    n1, n2 = Node(), Node()
+    d1, d2 = SimpleNetDevice(), SimpleNetDevice()
+    n1.AddDevice(d1)
+    n2.AddDevice(d2)
+    ch = SimpleChannel(Delay=MilliSeconds(5))
+    d1.SetChannel(ch)
+    d2.SetChannel(ch)
+
+    got = []
+    d2.SetReceiveCallback(
+        lambda dev, pkt, proto, sender: got.append(
+            (pkt.GetSize(), proto, str(sender), Simulator.Now())
+        )
+    )
+    Simulator.Schedule(Seconds(1), d1.Send, Packet(123), d2.GetAddress(), 0x0800)
+    Simulator.Run()
+    assert len(got) == 1
+    size, proto, sender, t = got[0]
+    assert size == 123 and proto == 0x0800
+    assert sender == str(d1.GetAddress())
+    assert t == Seconds(1) + MilliSeconds(5)
+
+
+def test_simple_device_error_model_drop_trace():
+    n1, n2 = Node(), Node()
+    d1, d2 = SimpleNetDevice(), SimpleNetDevice()
+    n1.AddDevice(d1)
+    n2.AddDevice(d2)
+    ch = SimpleChannel()
+    d1.SetChannel(ch)
+    d2.SetChannel(ch)
+    em = ListErrorModel()
+    d2.SetReceiveErrorModel(em)
+
+    got, dropped = [], []
+    d2.SetReceiveCallback(lambda dev, pkt, proto, sender: got.append(pkt))
+    d2.TraceConnectWithoutContext("PhyRxDrop", dropped.append)
+
+    p_lost = Packet(10)
+    em.SetList([p_lost.GetUid()])
+    Simulator.Schedule(Seconds(1), d1.Send, p_lost, d2.GetAddress(), 0)
+    Simulator.Schedule(Seconds(2), d1.Send, Packet(20), d2.GetAddress(), 0)
+    Simulator.Run()
+    assert len(got) == 1 and got[0].GetSize() == 20
+    assert len(dropped) == 1
+
+
+def test_broadcast_reaches_all_but_sender():
+    nodes = [Node() for _ in range(4)]
+    devs = [SimpleNetDevice() for _ in range(4)]
+    ch = SimpleChannel()
+    for n, d in zip(nodes, devs):
+        n.AddDevice(d)
+        d.SetChannel(ch)
+    got = []
+    for i, d in enumerate(devs):
+        d.SetReceiveCallback(lambda dev, pkt, proto, sender, i=i: got.append(i))
+    Simulator.Schedule(Seconds(1), devs[0].Send, Packet(5), Mac48Address.GetBroadcast(), 0)
+    Simulator.Run()
+    assert sorted(got) == [1, 2, 3]

@@ -1,0 +1,24 @@
+"""Topology-wiring helpers.
+
+Reference parity: src/*/helper/ (SURVEY.md 1 — the "helper" API layer):
+containers, PointToPointHelper, InternetStackHelper, Ipv4AddressHelper,
+application helpers.
+"""
+
+from tpudes.helper.containers import (
+    NodeContainer,
+    NetDeviceContainer,
+    Ipv4InterfaceContainer,
+    ApplicationContainer,
+)
+from tpudes.helper.point_to_point import PointToPointHelper
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.helper.applications import (
+    UdpEchoServerHelper,
+    UdpEchoClientHelper,
+    UdpServerHelper,
+    UdpClientHelper,
+    PacketSinkHelper,
+    OnOffHelper,
+    BulkSendHelper,
+)

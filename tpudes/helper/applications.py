@@ -1,0 +1,88 @@
+"""Application helpers.
+
+Reference parity: src/applications/helper/udp-echo-helper.{h,cc},
+udp-client-server-helper.{h,cc}, on-off-helper.{h,cc},
+packet-sink-helper.{h,cc}, bulk-send-helper.{h,cc}.
+"""
+
+from __future__ import annotations
+
+from tpudes.helper.containers import ApplicationContainer, NodeContainer
+from tpudes.models.applications import (
+    BulkSendApplication,
+    OnOffApplication,
+    PacketSink,
+    UdpClient,
+    UdpEchoClient,
+    UdpEchoServer,
+    UdpServer,
+)
+
+
+class _AppHelper:
+    app_cls = None
+
+    def __init__(self, **attrs):
+        self._attrs = dict(attrs)
+
+    def SetAttribute(self, name: str, value) -> None:
+        self._attrs[name] = value
+
+    def Install(self, nodes) -> ApplicationContainer:
+        if not isinstance(nodes, (NodeContainer, list, tuple)):
+            nodes = [nodes]
+        apps = ApplicationContainer()
+        for node in nodes:
+            app = self.app_cls(**self._attrs)
+            node.AddApplication(app)
+            apps.Add(app)
+        return apps
+
+
+class UdpEchoServerHelper(_AppHelper):
+    app_cls = UdpEchoServer
+
+    def __init__(self, port: int = 9, **attrs):
+        super().__init__(Port=port, **attrs)
+
+
+class UdpEchoClientHelper(_AppHelper):
+    app_cls = UdpEchoClient
+
+    def __init__(self, address=None, port: int = 0, **attrs):
+        super().__init__(RemoteAddress=address, RemotePort=port, **attrs)
+
+
+class UdpServerHelper(_AppHelper):
+    app_cls = UdpServer
+
+    def __init__(self, port: int = 100, **attrs):
+        super().__init__(Port=port, **attrs)
+
+
+class UdpClientHelper(_AppHelper):
+    app_cls = UdpClient
+
+    def __init__(self, address=None, port: int = 100, **attrs):
+        super().__init__(RemoteAddress=address, RemotePort=port, **attrs)
+
+
+class PacketSinkHelper(_AppHelper):
+    app_cls = PacketSink
+
+    def __init__(self, protocol: str = "tpudes::UdpSocketFactory", local=None, **attrs):
+        super().__init__(Protocol=protocol, Local=local, **attrs)
+
+
+class OnOffHelper(_AppHelper):
+    app_cls = OnOffApplication
+
+    def __init__(self, protocol: str = "tpudes::UdpSocketFactory", remote=None, **attrs):
+        super().__init__(Protocol=protocol, Remote=remote, **attrs)
+
+
+class BulkSendHelper(_AppHelper):
+    app_cls = BulkSendApplication
+
+    def __init__(self, protocol: str = "tpudes::TcpSocketFactory", remote=None, **attrs):
+        super().__init__(Protocol=protocol, Remote=remote, **attrs)

@@ -1,0 +1,89 @@
+"""Containers: ordered collections the helper API operates on.
+
+Reference parity: src/network/helper/node-container.{h,cc},
+net-device-container.{h,cc}, src/internet/helper/
+ipv4-interface-container.{h,cc}, application-container.{h,cc}.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.nstime import Time
+from tpudes.network.node import Node
+
+
+class _Container:
+    def __init__(self, *items):
+        self._items: list = []
+        for it in items:
+            self.Add(it)
+
+    def Add(self, other) -> None:
+        if isinstance(other, _Container):
+            self._items.extend(other._items)
+        elif isinstance(other, (list, tuple)):
+            self._items.extend(other)
+        else:
+            self._items.append(other)
+
+    def Get(self, i: int):
+        return self._items[i]
+
+    def GetN(self) -> int:
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+
+class NodeContainer(_Container):
+    def Create(self, n: int, system_id: int = 0) -> "NodeContainer":
+        for _ in range(n):
+            self._items.append(Node(system_id=system_id))
+        return self
+
+    @staticmethod
+    def GetGlobal() -> "NodeContainer":
+        from tpudes.network.node import NodeList
+
+        c = NodeContainer()
+        c.Add(NodeList.All())
+        return c
+
+
+class NetDeviceContainer(_Container):
+    pass
+
+
+class ApplicationContainer(_Container):
+    def Start(self, time: Time) -> None:
+        for app in self._items:
+            app.SetStartTime(time)
+
+    def Stop(self, time: Time) -> None:
+        for app in self._items:
+            app.SetStopTime(time)
+
+
+class Ipv4InterfaceContainer(_Container):
+    """Items are (Ipv4L3Protocol, interface_index) pairs."""
+
+    def Add(self, other) -> None:
+        # a 2-tuple (ipv4, if_index) is one item, not a sequence to splice
+        if isinstance(other, tuple) and len(other) == 2 and isinstance(other[1], int):
+            self._items.append(other)
+        else:
+            super().Add(other)
+
+    def GetAddress(self, i: int, j: int = 0):
+        ipv4, index = self._items[i]
+        return ipv4.GetAddress(index, j).GetLocal()
+
+    def SetMetric(self, i: int, metric: int) -> None:
+        ipv4, index = self._items[i]
+        ipv4.GetInterface(index).metric = metric

@@ -1,0 +1,105 @@
+"""InternetStackHelper + Ipv4AddressHelper.
+
+Reference parity: src/internet/helper/internet-stack-helper.{h,cc},
+ipv4-address-helper.{h,cc}. Address assignment auto-installs the
+connected-subnet route on the interface, as upstream's
+Ipv4StaticRouting does on NotifyAddAddress.
+"""
+
+from __future__ import annotations
+
+from tpudes.helper.containers import Ipv4InterfaceContainer, NetDeviceContainer, NodeContainer
+from tpudes.models.internet.ipv4 import (
+    Ipv4InterfaceAddress,
+    Ipv4L3Protocol,
+    Ipv4StaticRouting,
+)
+from tpudes.models.internet.udp import UdpL4Protocol
+from tpudes.network.address import Ipv4Address, Ipv4Mask
+
+
+class InternetStackHelper:
+    def __init__(self):
+        self._routing_factory = None
+
+    def SetRoutingHelper(self, routing_helper) -> None:
+        self._routing_factory = routing_helper
+
+    def Install(self, nodes) -> None:
+        if not isinstance(nodes, (NodeContainer, list, tuple)):
+            nodes = [nodes]
+        for node in nodes:
+            if node.GetObject(Ipv4L3Protocol) is not None:
+                continue  # already installed
+            ipv4 = Ipv4L3Protocol()
+            ipv4.SetNode(node)
+            node.AggregateObject(ipv4)
+            if self._routing_factory is not None:
+                routing = self._routing_factory.Create(node)
+            else:
+                routing = Ipv4StaticRouting()
+            ipv4.SetRoutingProtocol(routing)
+            udp = UdpL4Protocol()
+            udp.SetNode(node)
+            ipv4.Insert(udp)
+            node.AggregateObject(udp)
+            # TCP (src/internet/model/tcp-l4-protocol) is installed when
+            # available so sockets of both families work out of the box
+            try:
+                from tpudes.models.internet.tcp import TcpL4Protocol
+
+                tcp = TcpL4Protocol()
+                tcp.SetNode(node)
+                ipv4.Insert(tcp)
+                node.AggregateObject(tcp)
+            except ImportError:
+                pass
+
+    InstallAll = Install
+
+
+class Ipv4AddressHelper:
+    def __init__(self, network: str = "10.0.0.0", mask: str = "255.255.255.0", base: str = "0.0.0.1"):
+        self.SetBase(network, mask, base)
+
+    def SetBase(self, network: str, mask: str, base: str = "0.0.0.1") -> None:
+        self._network = Ipv4Address(network).addr
+        self._mask = Ipv4Mask(mask)
+        self._base = Ipv4Address(base).addr
+        self._next = self._base
+
+    def NewNetwork(self) -> None:
+        # advance network by one subnet
+        step = (~self._mask.mask & 0xFFFFFFFF) + 1
+        self._network += step
+        self._next = self._base
+
+    def NewAddress(self) -> Ipv4Address:
+        addr = Ipv4Address(self._network | self._next)
+        self._next += 1
+        return addr
+
+    def Assign(self, devices: NetDeviceContainer) -> Ipv4InterfaceContainer:
+        container = Ipv4InterfaceContainer()
+        for device in devices:
+            node = device.GetNode()
+            ipv4 = node.GetObject(Ipv4L3Protocol)
+            if ipv4 is None:
+                raise RuntimeError(
+                    f"node {node.GetId()} has no internet stack (InternetStackHelper.Install first)"
+                )
+            if_index = ipv4.GetInterfaceForDevice(device)
+            if if_index < 0:
+                if_index = ipv4.AddInterface(device)
+            addr = self.NewAddress()
+            ipv4.AddAddress(if_index, Ipv4InterfaceAddress(addr, self._mask))
+            # connected-subnet route
+            routing = ipv4.GetRoutingProtocol()
+            if isinstance(routing, Ipv4StaticRouting):
+                routing.AddNetworkRouteTo(addr.CombineMask(self._mask), self._mask, if_index)
+            else:
+                notify = getattr(routing, "NotifyAddAddress", None)
+                if notify is not None:
+                    notify(if_index, Ipv4InterfaceAddress(addr, self._mask))
+            container.Add((ipv4, if_index))
+        return container

@@ -1,0 +1,45 @@
+"""PointToPointHelper: install p2p links between node pairs.
+
+Reference parity: src/point-to-point/helper/point-to-point-helper.{h,cc}.
+"""
+
+from __future__ import annotations
+
+from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+from tpudes.models.p2p import PointToPointChannel, PointToPointNetDevice
+from tpudes.network.queue import DropTailQueue
+
+
+class PointToPointHelper:
+    def __init__(self):
+        self._device_attrs: dict = {}
+        self._channel_attrs: dict = {}
+        self._queue_attrs: dict = {}
+
+    def SetDeviceAttribute(self, name: str, value) -> None:
+        self._device_attrs[name] = value
+
+    def SetChannelAttribute(self, name: str, value) -> None:
+        self._channel_attrs[name] = value
+
+    def SetQueue(self, _type: str = "tpudes::DropTailQueue", **attrs) -> None:
+        self._queue_attrs = attrs
+
+    def Install(self, a, b=None) -> NetDeviceContainer:
+        if b is None:  # a is a container of exactly 2 nodes
+            assert isinstance(a, NodeContainer) and a.GetN() == 2
+            a, b = a.Get(0), a.Get(1)
+        if isinstance(a, NodeContainer):
+            a = a.Get(0)
+        if isinstance(b, NodeContainer):
+            b = b.Get(0)
+        dev_a = PointToPointNetDevice(**self._device_attrs)
+        dev_b = PointToPointNetDevice(**self._device_attrs)
+        dev_a.SetQueue(DropTailQueue(**self._queue_attrs))
+        dev_b.SetQueue(DropTailQueue(**self._queue_attrs))
+        a.AddDevice(dev_a)
+        b.AddDevice(dev_b)
+        channel = PointToPointChannel(**self._channel_attrs)
+        dev_a.Attach(channel)
+        dev_b.Attach(channel)
+        return NetDeviceContainer(dev_a, dev_b)

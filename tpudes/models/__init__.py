@@ -1,0 +1,3 @@
+"""Model library: link technologies, channel physics, internet stack,
+applications — the L3–L6 layers of SURVEY.md 1.
+"""

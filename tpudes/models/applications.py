@@ -1,0 +1,390 @@
+"""Traffic applications.
+
+Reference parity: src/applications/model/ — udp-echo-{client,server}.{h,cc}
+(the first.cc workload), udp-client-server, packet-sink,
+onoff-application, bulk-send (SURVEY.md 2.7 applications row).
+"""
+
+from __future__ import annotations
+
+from tpudes.core.nstime import Seconds, Time
+from tpudes.core.object import TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import InetSocketAddress, Ipv4Address
+from tpudes.network.application import Application
+from tpudes.network.data_rate import DataRate
+from tpudes.network.packet import Packet
+from tpudes.network.socket import SocketFactory
+from tpudes.core.rng import ConstantRandomVariable, ExponentialRandomVariable
+
+
+class UdpEchoServer(Application):
+    tid = (
+        TypeId("tpudes::UdpEchoServer")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: UdpEchoServer(**kw))
+        .AddAttribute("Port", "listen port", 9)
+        .AddTraceSource("Rx", "a packet was received")
+        .AddTraceSource("RxWithAddresses", "(packet, from, local)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self.received = 0
+
+    def StartApplication(self):
+        if self._socket is None:
+            self._socket = SocketFactory.CreateSocket(self._node, "tpudes::UdpSocketFactory")
+            self._socket.Bind(InetSocketAddress(Ipv4Address.GetAny(), self.port))
+        self._socket.SetRecvCallback(self._handle_read)
+
+    def StopApplication(self):
+        if self._socket is not None:
+            self._socket.Close()
+            self._socket = None
+
+    def _handle_read(self, socket):
+        while True:
+            packet, src = socket.RecvFrom()
+            if packet is None:
+                break
+            self.received += 1
+            self.rx(packet)
+            self.rx_with_addresses(packet, src, socket.GetSockName())
+            # echo payload back to sender (ns-3 echoes the same packet)
+            socket.SendTo(packet.Copy(), 0, src)
+
+
+class UdpEchoClient(Application):
+    tid = (
+        TypeId("tpudes::UdpEchoClient")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: UdpEchoClient(**kw))
+        .AddAttribute("MaxPackets", "max packets to send", 100)
+        .AddAttribute("Interval", "time between packets", Seconds(1.0), checker=Time)
+        .AddAttribute("RemoteAddress", "destination address", None)
+        .AddAttribute("RemotePort", "destination port", 0)
+        .AddAttribute("PacketSize", "payload bytes", 100)
+        .AddTraceSource("Tx", "a packet is sent")
+        .AddTraceSource("Rx", "an echo reply is received")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self._send_event = None
+        self.sent = 0
+        self.received = 0
+
+    def SetRemote(self, address: Ipv4Address, port: int) -> None:
+        self.remote_address = address
+        self.remote_port = port
+
+    def StartApplication(self):
+        if self._socket is None:
+            self._socket = SocketFactory.CreateSocket(self._node, "tpudes::UdpSocketFactory")
+            self._socket.Bind()
+            self._socket.Connect(InetSocketAddress(Ipv4Address(self.remote_address), self.remote_port))
+        self._socket.SetRecvCallback(self._handle_read)
+        self._schedule_transmit(Time(0))
+
+    def StopApplication(self):
+        if self._send_event is not None:
+            self._send_event.Cancel()
+        if self._socket is not None:
+            self._socket.Close()
+            self._socket = None
+
+    def _schedule_transmit(self, dt: Time):
+        self._send_event = Simulator.Schedule(dt, self._send)
+
+    def _send(self):
+        packet = Packet(self.packet_size)
+        self.tx(packet)
+        self._socket.Send(packet)
+        self.sent += 1
+        # ns-3 parity: MaxPackets == 0 means unlimited (until StopTime)
+        if self.max_packets == 0 or self.sent < self.max_packets:
+            self._schedule_transmit(self.interval)
+
+    def _handle_read(self, socket):
+        while True:
+            packet, src = socket.RecvFrom()
+            if packet is None:
+                break
+            self.received += 1
+            self.rx(packet)
+
+
+class UdpServer(Application):
+    """Counting sink with loss/jitter bookkeeping
+    (src/applications/model/udp-server.{h,cc})."""
+
+    tid = (
+        TypeId("tpudes::UdpServer")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: UdpServer(**kw))
+        .AddAttribute("Port", "listen port", 100)
+        .AddTraceSource("Rx", "a packet was received")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self.received = 0
+        self.received_bytes = 0
+
+    def StartApplication(self):
+        if self._socket is None:
+            self._socket = SocketFactory.CreateSocket(self._node, "tpudes::UdpSocketFactory")
+            self._socket.Bind(InetSocketAddress(Ipv4Address.GetAny(), self.port))
+        self._socket.SetRecvCallback(self._handle_read)
+
+    def StopApplication(self):
+        if self._socket is not None:
+            self._socket.Close()
+            self._socket = None
+
+    def _handle_read(self, socket):
+        while True:
+            packet, _ = socket.RecvFrom()
+            if packet is None:
+                break
+            self.received += 1
+            self.received_bytes += packet.GetSize()
+            self.rx(packet)
+
+
+class UdpClient(Application):
+    """Fixed-interval UDP source (src/applications/model/udp-client.{h,cc})."""
+
+    tid = (
+        TypeId("tpudes::UdpClient")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: UdpClient(**kw))
+        .AddAttribute("MaxPackets", "max packets (0=unlimited)", 100)
+        .AddAttribute("Interval", "inter-packet interval", Seconds(1.0), checker=Time)
+        .AddAttribute("RemoteAddress", "destination address", None)
+        .AddAttribute("RemotePort", "destination port", 100)
+        .AddAttribute("PacketSize", "payload bytes", 1024)
+        .AddTraceSource("Tx", "a packet is sent")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self._send_event = None
+        self.sent = 0
+
+    def StartApplication(self):
+        if self._socket is None:
+            self._socket = SocketFactory.CreateSocket(self._node, "tpudes::UdpSocketFactory")
+            self._socket.Bind()
+            self._socket.Connect(InetSocketAddress(Ipv4Address(self.remote_address), self.remote_port))
+        self._send()
+
+    def StopApplication(self):
+        if self._send_event is not None:
+            self._send_event.Cancel()
+
+    def _send(self):
+        packet = Packet(self.packet_size)
+        self.tx(packet)
+        self._socket.Send(packet)
+        self.sent += 1
+        if self.max_packets == 0 or self.sent < self.max_packets:
+            self._send_event = Simulator.Schedule(self.interval, self._send)
+
+
+class PacketSink(Application):
+    """Receive-anything sink (src/applications/model/packet-sink.{h,cc});
+    works over UDP now and TCP when the TCP stack lands."""
+
+    tid = (
+        TypeId("tpudes::PacketSink")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: PacketSink(**kw))
+        .AddAttribute("Local", "local address to bind", None)
+        .AddAttribute("Protocol", "socket factory type", "tpudes::UdpSocketFactory")
+        .AddTraceSource("Rx", "(packet, from)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self._accepted: list = []
+        self.total_rx = 0
+
+    def GetTotalRx(self) -> int:
+        return self.total_rx
+
+    def StartApplication(self):
+        if self._socket is None:
+            self._socket = SocketFactory.CreateSocket(self._node, self.protocol)
+            self._socket.Bind(self.local)
+            self._socket.Listen()
+            self._socket.SetAcceptCallback(lambda s, a: True, self._handle_accept)
+        self._socket.SetRecvCallback(self._handle_read)
+
+    def StopApplication(self):
+        if self._socket is not None:
+            self._socket.Close()
+            self._socket = None
+        for s in self._accepted:
+            s.Close()
+        self._accepted = []
+
+    def _handle_accept(self, socket, from_addr):
+        self._accepted.append(socket)
+        socket.SetRecvCallback(self._handle_read)
+
+    def _handle_read(self, socket):
+        while True:
+            packet, src = socket.RecvFrom()
+            if packet is None:
+                break
+            self.total_rx += packet.GetSize()
+            self.rx(packet, src)
+
+
+class OnOffApplication(Application):
+    """CBR-during-on-periods traffic generator
+    (src/applications/model/onoff-application.{h,cc})."""
+
+    tid = (
+        TypeId("tpudes::OnOffApplication")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: OnOffApplication(**kw))
+        .AddAttribute("DataRate", "rate while on", "500kbps", checker=DataRate)
+        .AddAttribute("PacketSize", "payload bytes", 512)
+        .AddAttribute("Remote", "destination (InetSocketAddress)", None)
+        .AddAttribute("OnTime", "on-duration RNG", None)
+        .AddAttribute("OffTime", "off-duration RNG", None)
+        .AddAttribute("MaxBytes", "stop after bytes (0=never)", 0)
+        .AddAttribute("Protocol", "socket factory type", "tpudes::UdpSocketFactory")
+        .AddTraceSource("Tx", "a packet is sent")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self._on = False
+        self._running = False
+        self._sent_bytes = 0
+        self._next_event = None
+        self._cycle_event = None
+        if self.on_time is None:
+            self.on_time = ConstantRandomVariable(Constant=1.0)
+        if self.off_time is None:
+            self.off_time = ConstantRandomVariable(Constant=1.0)
+
+    def StartApplication(self):
+        self._running = True
+        if self._socket is None:
+            self._socket = SocketFactory.CreateSocket(self._node, self.protocol)
+            self._socket.Bind()
+            self._socket.Connect(self.remote)
+        self._start_on()
+
+    def StopApplication(self):
+        self._running = False
+        for ev in (self._next_event, self._cycle_event):
+            if ev is not None:
+                ev.Cancel()
+        if self._socket is not None:
+            self._socket.Close()
+            self._socket = None
+
+    def _start_on(self):
+        if not self._running:
+            return
+        self._on = True
+        duration = Seconds(self.on_time.GetValue())
+        self._cycle_event = Simulator.Schedule(duration, self._start_off)
+        self._send()
+
+    def _start_off(self):
+        self._on = False
+        if self._next_event is not None:
+            self._next_event.Cancel()
+        if not self._running:
+            return
+        duration = Seconds(self.off_time.GetValue())
+        self._cycle_event = Simulator.Schedule(duration, self._start_on)
+
+    def _send(self):
+        if not self._on or not self._running or self._socket is None:
+            return
+        if self.max_bytes and self._sent_bytes >= self.max_bytes:
+            return
+        packet = Packet(self.packet_size)
+        self.tx(packet)
+        self._socket.Send(packet)
+        self._sent_bytes += self.packet_size
+        interval = self.data_rate.CalculateBytesTxTime(self.packet_size)
+        self._next_event = Simulator.Schedule(interval, self._send)
+
+
+class BulkSendApplication(Application):
+    """Send-as-fast-as-the-socket-allows source
+    (src/applications/model/bulk-send-application.{h,cc}); primarily for
+    TCP throughput workloads."""
+
+    tid = (
+        TypeId("tpudes::BulkSendApplication")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: BulkSendApplication(**kw))
+        .AddAttribute("SendSize", "bytes per Send call", 512)
+        .AddAttribute("Remote", "destination (InetSocketAddress)", None)
+        .AddAttribute("MaxBytes", "stop after bytes (0=never)", 0)
+        .AddAttribute("Protocol", "socket factory type", "tpudes::TcpSocketFactory")
+        .AddTraceSource("Tx", "a packet is sent")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self.total_bytes = 0
+        self._connected = False
+
+    def StartApplication(self):
+        if "Udp" in self.protocol:
+            # ns-3 parity: BulkSend requires a connection-oriented
+            # (stream) socket — over UDP the send loop would never block
+            raise ValueError("BulkSendApplication requires a TCP socket factory")
+        if self._socket is None:
+            self._socket = SocketFactory.CreateSocket(self._node, self.protocol)
+            # callbacks BEFORE Connect: a synchronous connect success
+            # (e.g. loopback) must not be missed
+            self._socket.SetConnectCallback(self._on_connect, lambda s: None)
+            self._socket.SetSendCallback(self._on_send_space)
+            self._socket.Bind()
+            self._socket.Connect(self.remote)
+
+    def StopApplication(self):
+        if self._socket is not None:
+            self._socket.Close()
+
+    def _on_connect(self, socket):
+        self._connected = True
+        self._send_data()
+
+    def _on_send_space(self, socket, available):
+        if self._connected:
+            self._send_data()
+
+    def _send_data(self):
+        while self.max_bytes == 0 or self.total_bytes < self.max_bytes:
+            to_send = self.send_size
+            if self.max_bytes:
+                to_send = min(to_send, self.max_bytes - self.total_bytes)
+            avail = self._socket.GetTxAvailable()
+            if avail == 0:
+                break
+            packet = Packet(min(to_send, avail))
+            sent = self._socket.Send(packet)
+            if sent <= 0:
+                break
+            self.total_bytes += sent
+            self.tx(packet)

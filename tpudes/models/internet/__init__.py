@@ -1,0 +1,4 @@
+"""Internet stack: IPv4, UDP, TCP, routing.
+
+Reference parity: src/internet/model/ (SURVEY.md 2.7).
+"""

@@ -1,0 +1,442 @@
+"""IPv4 L3: header, interfaces, routing, forwarding.
+
+Reference parity: src/internet/model/ipv4-l3-protocol.{h,cc},
+ipv4-interface.{h,cc}, ipv4-interface-address.{h,cc}, ipv4-route.{h,cc},
+ipv4-static-routing.{h,cc}, ipv4-routing-protocol.{h,cc}
+(SURVEY.md 2.7). ARP is elided on point-to-point links exactly as
+upstream does (p2p devices don't NeedsArp); CSMA/WiFi ARP arrives with
+those modules.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import Ipv4Address, Ipv4Mask
+from tpudes.network.packet import Header
+
+
+class Ipv4Header(Header):
+    """20-byte IPv4 header (no options), src/internet/model/ipv4-header.cc."""
+
+    def __init__(
+        self,
+        source: Ipv4Address = None,
+        destination: Ipv4Address = None,
+        protocol: int = 0,
+        ttl: int = 64,
+        identification: int = 0,
+        payload_size: int = 0,
+        tos: int = 0,
+    ):
+        self.source = source or Ipv4Address()
+        self.destination = destination or Ipv4Address()
+        self.protocol = protocol
+        self.ttl = ttl
+        self.identification = identification
+        self.payload_size = payload_size
+        self.tos = tos
+        self.dont_fragment = False
+
+    def GetSerializedSize(self) -> int:
+        return 20
+
+    def Serialize(self) -> bytes:
+        return struct.pack(
+            "!BBHHHBBH4s4s",
+            0x45,
+            self.tos,
+            20 + self.payload_size,
+            self.identification,
+            0x4000 if self.dont_fragment else 0,
+            self.ttl,
+            self.protocol,
+            0,
+            self.source.to_bytes(),
+            self.destination.to_bytes(),
+        )
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        (vihl, tos, total, ident, flags, ttl, proto, _, src, dst) = struct.unpack(
+            "!BBHHHBBH4s4s", data[:20]
+        )
+        h = cls(
+            Ipv4Address.from_bytes(src),
+            Ipv4Address.from_bytes(dst),
+            proto,
+            ttl,
+            ident,
+            total - 20,
+            tos,
+        )
+        h.dont_fragment = bool(flags & 0x4000)
+        return h, 20
+
+    # ns-3 accessor parity
+    def GetSource(self):
+        return self.source
+
+    def GetDestination(self):
+        return self.destination
+
+    def GetProtocol(self):
+        return self.protocol
+
+    def GetTtl(self):
+        return self.ttl
+
+    def SetTtl(self, ttl):
+        self.ttl = ttl
+
+
+class Ipv4InterfaceAddress:
+    __slots__ = ("local", "mask")
+
+    def __init__(self, local: Ipv4Address, mask: Ipv4Mask):
+        self.local = Ipv4Address(local)
+        self.mask = Ipv4Mask(mask)
+
+    def GetLocal(self) -> Ipv4Address:
+        return self.local
+
+    def GetMask(self) -> Ipv4Mask:
+        return self.mask
+
+    def GetBroadcast(self) -> Ipv4Address:
+        return self.local.GetSubnetDirectedBroadcast(self.mask)
+
+    def __repr__(self):
+        return f"{self.local}/{self.mask.GetPrefixLength()}"
+
+
+class Ipv4Interface(Object):
+    tid = (
+        TypeId("tpudes::Ipv4Interface")
+        .AddAttribute("Metric", "interface metric", 1)
+    )
+
+    def __init__(self, device=None, **attributes):
+        super().__init__(**attributes)
+        self.device = device
+        self.addresses: list[Ipv4InterfaceAddress] = []
+        self.up = True
+        self.forwarding = True
+
+    def AddAddress(self, addr: Ipv4InterfaceAddress) -> None:
+        self.addresses.append(addr)
+
+    def GetAddress(self, i: int = 0) -> Ipv4InterfaceAddress:
+        return self.addresses[i]
+
+    def GetNAddresses(self) -> int:
+        return len(self.addresses)
+
+    def IsUp(self) -> bool:
+        return self.up
+
+    def SetUp(self) -> None:
+        self.up = True
+
+    def SetDown(self) -> None:
+        self.up = False
+
+    def Send(self, packet, header, dest_mac=None) -> None:
+        device = self.device
+        if device is None:  # loopback
+            node = self._node
+            Simulator.ScheduleWithContext(
+                node.GetId(), 0, node.GetObject(Ipv4L3Protocol)._receive_loopback, packet
+            )
+            return
+        device.Send(packet, dest_mac if dest_mac is not None else device.GetBroadcast(), Ipv4L3Protocol.PROT_NUMBER)
+
+
+class Ipv4Route:
+    """The routing decision (src/internet/model/ipv4-route.h)."""
+
+    __slots__ = ("destination", "source", "gateway", "output_device", "if_index")
+
+    def __init__(self, destination=None, source=None, gateway=None, output_device=None):
+        self.destination = destination
+        self.source = source
+        self.gateway = gateway
+        self.output_device = output_device
+        self.if_index = None
+
+    def __repr__(self):
+        return f"Route(dst={self.destination}, src={self.source}, gw={self.gateway})"
+
+
+class Ipv4RoutingProtocol(Object):
+    tid = TypeId("tpudes::Ipv4RoutingProtocol")
+
+    def SetIpv4(self, ipv4) -> None:
+        self.ipv4 = ipv4
+
+    def RouteOutput(self, packet, header, oif=None):
+        """-> (route | None, errno)"""
+        raise NotImplementedError
+
+    def NotifyInterfaceUp(self, i):
+        pass
+
+    def NotifyInterfaceDown(self, i):
+        pass
+
+
+class Ipv4StaticRouting(Ipv4RoutingProtocol):
+    """Longest-prefix-match static routing
+    (src/internet/model/ipv4-static-routing.{h,cc})."""
+
+    tid = (
+        TypeId("tpudes::Ipv4StaticRouting")
+        .SetParent(Ipv4RoutingProtocol.tid)
+        .AddConstructor(lambda **kw: Ipv4StaticRouting(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        # (network, mask, gateway|None, ifindex, metric)
+        self.routes: list[tuple] = []
+
+    def AddNetworkRouteTo(self, network, mask, if_index, gateway=None, metric=0):
+        self.routes.append(
+            (Ipv4Address(network), Ipv4Mask(mask), Ipv4Address(gateway) if gateway else None, if_index, metric)
+        )
+
+    def AddHostRouteTo(self, dest, if_index, gateway=None, metric=0):
+        self.AddNetworkRouteTo(dest, Ipv4Mask.GetOnes(), if_index, gateway, metric)
+
+    def SetDefaultRoute(self, gateway, if_index, metric=0):
+        self.AddNetworkRouteTo(Ipv4Address.GetAny(), Ipv4Mask.GetZero(), if_index, gateway, metric)
+
+    def GetNRoutes(self) -> int:
+        return len(self.routes)
+
+    def LookupRoute(self, dest: Ipv4Address):
+        best = None
+        best_key = (-1, 1 << 30)  # (prefix_len, metric)
+        for network, mask, gateway, if_index, metric in self.routes:
+            if mask.IsMatch(dest, network):
+                key = (mask.GetPrefixLength(), -metric)
+                if key > (best_key[0], -best_key[1]):
+                    best = (network, mask, gateway, if_index, metric)
+                    best_key = (mask.GetPrefixLength(), metric)
+        return best
+
+    def RouteOutput(self, packet, header, oif=None):
+        found = self.LookupRoute(header.destination)
+        if found is None:
+            return None, 10  # ERROR_NOROUTETOHOST
+        _, _, gateway, if_index, _ = found
+        iface = self.ipv4.GetInterface(if_index)
+        route = Ipv4Route(
+            destination=header.destination,
+            source=self.ipv4.SelectSourceAddress(if_index),
+            gateway=gateway,
+            output_device=iface.device,
+        )
+        route.if_index = if_index
+        return route, 0
+
+
+class Ipv4L3Protocol(Object):
+    """The IPv4 layer aggregated on each node
+    (src/internet/model/ipv4-l3-protocol.{h,cc}); also serves as the
+    ns-3 ``Ipv4`` API object (GetAddress/GetInterfaceForAddress/...)."""
+
+    PROT_NUMBER = 0x0800
+
+    tid = (
+        TypeId("tpudes::Ipv4L3Protocol")
+        .AddConstructor(lambda **kw: Ipv4L3Protocol(**kw))
+        .AddAttribute("DefaultTtl", "Default TTL", 64)
+        .AddAttribute("IpForward", "Enable forwarding", True)
+        .AddTraceSource("Tx", "ip tx (packet, interface)")
+        .AddTraceSource("Rx", "ip rx (packet, interface)")
+        .AddTraceSource("Drop", "ip drop (header, packet, reason)")
+        .AddTraceSource("SendOutgoing", "(header, packet, interface)")
+        .AddTraceSource("UnicastForward", "(header, packet, interface)")
+        .AddTraceSource("LocalDeliver", "(header, packet, interface)")
+    )
+
+    # drop reasons (ns-3 Ipv4L3Protocol::DropReason)
+    DROP_TTL_EXPIRED = 1
+    DROP_NO_ROUTE = 2
+    DROP_INTERFACE_DOWN = 5
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        self.interfaces: list[Ipv4Interface] = []
+        self._protocols: dict[int, object] = {}  # l4 protocol number -> protocol
+        self._routing: Ipv4RoutingProtocol | None = None
+        self._ident = 0
+
+    # --- node wiring ---
+    def SetNode(self, node) -> None:
+        self._node = node
+        # interface 0: loopback, as upstream
+        lo = Ipv4Interface(device=None)
+        lo._node = node
+        lo.AddAddress(Ipv4InterfaceAddress(Ipv4Address.GetLoopback(), Ipv4Mask("255.0.0.0")))
+        self.interfaces.append(lo)
+
+    def GetNode(self):
+        return self._node
+
+    def SetRoutingProtocol(self, routing: Ipv4RoutingProtocol) -> None:
+        self._routing = routing
+        routing.SetIpv4(self)
+
+    def GetRoutingProtocol(self) -> Ipv4RoutingProtocol:
+        return self._routing
+
+    def Insert(self, l4_protocol) -> None:
+        self._protocols[l4_protocol.PROT_NUMBER] = l4_protocol
+
+    def GetProtocol(self, number: int):
+        return self._protocols.get(number)
+
+    # --- interfaces ---
+    def AddInterface(self, device) -> int:
+        index = len(self.interfaces)
+        iface = Ipv4Interface(device=device)
+        iface._node = self._node
+        self.interfaces.append(iface)
+        self._node.RegisterProtocolHandler(self._receive, self.PROT_NUMBER, device)
+        return index
+
+    def GetInterface(self, i: int) -> Ipv4Interface:
+        return self.interfaces[i]
+
+    def GetNInterfaces(self) -> int:
+        return len(self.interfaces)
+
+    def AddAddress(self, i: int, addr: Ipv4InterfaceAddress) -> None:
+        self.interfaces[i].AddAddress(addr)
+
+    def GetAddress(self, i: int, ad: int = 0) -> Ipv4InterfaceAddress:
+        return self.interfaces[i].GetAddress(ad)
+
+    def GetInterfaceForAddress(self, addr: Ipv4Address) -> int:
+        for i, iface in enumerate(self.interfaces):
+            for a in iface.addresses:
+                if a.local == addr:
+                    return i
+        return -1
+
+    def GetInterfaceForDevice(self, device) -> int:
+        for i, iface in enumerate(self.interfaces):
+            if iface.device is device:
+                return i
+        return -1
+
+    def SelectSourceAddress(self, if_index: int) -> Ipv4Address:
+        iface = self.interfaces[if_index]
+        return iface.addresses[0].local if iface.addresses else Ipv4Address.GetAny()
+
+    def IsDestinationAddress(self, addr: Ipv4Address, iif: int) -> bool:
+        if addr.IsBroadcast() or addr.IsLocalhost() or addr.IsMulticast():
+            return True
+        for iface in self.interfaces:
+            for a in iface.addresses:
+                if a.local == addr or a.GetBroadcast() == addr:
+                    return True
+        return False
+
+    def SetUp(self, i: int) -> None:
+        self.interfaces[i].SetUp()
+
+    def SetDown(self, i: int) -> None:
+        self.interfaces[i].SetDown()
+
+    def IsUp(self, i: int) -> bool:
+        return self.interfaces[i].IsUp()
+
+    # --- send path (SURVEY.md 3.1) ---
+    def Send(self, packet, source: Ipv4Address, destination: Ipv4Address, protocol: int, route: Ipv4Route = None):
+        self._ident += 1
+        header = Ipv4Header(
+            source=source,
+            destination=destination,
+            protocol=protocol,
+            ttl=self.default_ttl,
+            identification=self._ident,
+            payload_size=packet.GetSize(),
+        )
+        if destination.IsLocalhost():
+            packet.AddHeader(header)
+            Simulator.ScheduleWithContext(self._node.GetId(), 0, self._receive_loopback, packet)
+            return
+        if route is None:
+            route, errno = self._routing.RouteOutput(packet, header)
+            if route is None:
+                self.drop(header, packet, self.DROP_NO_ROUTE)
+                return
+        if_index = getattr(route, "if_index", None)
+        if if_index is None:
+            if_index = self.GetInterfaceForDevice(route.output_device)
+        iface = self.interfaces[if_index]
+        if not iface.IsUp():
+            self.drop(header, packet, self.DROP_INTERFACE_DOWN)
+            return
+        self.send_outgoing(header, packet, if_index)
+        packet.AddHeader(header)
+        self.tx(packet, if_index)
+        iface.Send(packet, header)
+
+    # --- receive path ---
+    def _receive(self, device, packet, protocol, sender):
+        if_index = self.GetInterfaceForDevice(device)
+        if not self.interfaces[if_index].IsUp():
+            return
+        packet = packet.Copy()
+        self.rx(packet, if_index)
+        header = packet.RemoveHeader(Ipv4Header)
+        if self.IsDestinationAddress(header.destination, if_index):
+            self.local_deliver(header, packet, if_index)
+            self._deliver_l4(packet, header, if_index)
+        elif self.ip_forward:
+            self._forward(packet, header, if_index)
+        else:
+            self.drop(header, packet, self.DROP_NO_ROUTE)
+
+    def _receive_loopback(self, packet):
+        header = packet.RemoveHeader(Ipv4Header)
+        self.local_deliver(header, packet, 0)
+        self._deliver_l4(packet, header, 0)
+
+    def _deliver_l4(self, packet, header, if_index):
+        l4 = self._protocols.get(header.protocol)
+        if l4 is not None:
+            l4.Receive(packet, header, self.interfaces[if_index])
+
+    def _forward(self, packet, header, in_if):
+        # headers are shared across packet copies (COW); never mutate in
+        # place — other receivers/trace sinks hold the same instance
+        import copy as _copy
+
+        header = _copy.copy(header)
+        header.ttl -= 1
+        if header.ttl <= 0:
+            self.drop(header, packet, self.DROP_TTL_EXPIRED)
+            return
+        route, errno = self._routing.RouteOutput(packet, header)
+        if route is None:
+            self.drop(header, packet, self.DROP_NO_ROUTE)
+            return
+        if_index = getattr(route, "if_index", None)
+        if if_index is None:
+            if_index = self.GetInterfaceForDevice(route.output_device)
+        self.unicast_forward(header, packet, if_index)
+        packet.AddHeader(header)
+        self.tx(packet, if_index)
+        self.interfaces[if_index].Send(packet, header)
+
+
+# the ns-3 "Ipv4" API name aliases to the L3 protocol object here
+Ipv4 = Ipv4L3Protocol

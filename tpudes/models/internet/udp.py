@@ -1,0 +1,312 @@
+"""UDP: header, L4 protocol with endpoint demux, socket implementation.
+
+Reference parity: src/internet/model/udp-header.{h,cc},
+udp-l4-protocol.{h,cc}, udp-socket-impl.{h,cc},
+ipv4-end-point{,-demux}.{h,cc} (SURVEY.md 2.7).
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import deque
+
+from tpudes.core.object import TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import InetSocketAddress, Ipv4Address
+from tpudes.network.packet import Header
+from tpudes.network.socket import (
+    ERROR_ADDRINUSE,
+    ERROR_INVAL,
+    ERROR_NOROUTETOHOST,
+    ERROR_NOTCONN,
+    ERROR_SHUTDOWN,
+    Socket,
+)
+from tpudes.core.object import Object
+
+
+class UdpHeader(Header):
+    def __init__(self, source_port: int = 0, destination_port: int = 0, payload_size: int = 0):
+        self.source_port = source_port
+        self.destination_port = destination_port
+        self.payload_size = payload_size
+
+    def GetSerializedSize(self) -> int:
+        return 8
+
+    def Serialize(self) -> bytes:
+        return struct.pack("!HHHH", self.source_port, self.destination_port, 8 + self.payload_size, 0)
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        (sp, dp, length, _) = struct.unpack("!HHHH", data[:8])
+        return cls(sp, dp, length - 8), 8
+
+    def GetSourcePort(self):
+        return self.source_port
+
+    def GetDestinationPort(self):
+        return self.destination_port
+
+
+class Ipv4EndPoint:
+    """One (local addr, local port, peer addr, peer port) binding."""
+
+    __slots__ = ("local_addr", "local_port", "peer_addr", "peer_port", "rx_callback", "bound_device")
+
+    def __init__(self, local_addr: Ipv4Address, local_port: int):
+        self.local_addr = local_addr
+        self.local_port = local_port
+        self.peer_addr = Ipv4Address.GetAny()
+        self.peer_port = 0
+        self.rx_callback = None
+        self.bound_device = None
+
+    def SetPeer(self, addr: Ipv4Address, port: int) -> None:
+        self.peer_addr = addr
+        self.peer_port = port
+
+    def match_quality(
+        self, dst: Ipv4Address, dport: int, src: Ipv4Address, sport: int, dst_is_broadcast: bool = False
+    ) -> int:
+        """-1 = no match; otherwise higher = more specific (the demux
+        scoring upstream's Ipv4EndPointDemux::Lookup performs).
+        ``dst_is_broadcast`` covers subnet-directed broadcasts, which a
+        specifically-bound socket must still accept."""
+        if self.local_port != dport:
+            return -1
+        score = 0
+        if not self.local_addr.IsAny():
+            if self.local_addr != dst and not dst.IsBroadcast() and not dst_is_broadcast:
+                return -1
+            score += 2
+        if not self.peer_addr.IsAny():
+            if self.peer_addr != src:
+                return -1
+            score += 2
+        if self.peer_port != 0:
+            if self.peer_port != sport:
+                return -1
+            score += 1
+        return score
+
+
+class Ipv4EndPointDemux:
+    EPHEMERAL_START = 49152
+
+    def __init__(self):
+        self._endpoints: list[Ipv4EndPoint] = []
+        self._ephemeral = self.EPHEMERAL_START
+
+    def Allocate(self, addr: Ipv4Address = None, port: int = 0) -> Ipv4EndPoint | None:
+        addr = addr if addr is not None else Ipv4Address.GetAny()
+        if port == 0:
+            port = self._alloc_ephemeral()
+            if port == 0:
+                return None
+        elif any(
+            e.local_port == port and (e.local_addr == addr or e.local_addr.IsAny() or addr.IsAny())
+            for e in self._endpoints
+        ):
+            return None  # in use
+        ep = Ipv4EndPoint(addr, port)
+        self._endpoints.append(ep)
+        return ep
+
+    def _alloc_ephemeral(self) -> int:
+        used = {e.local_port for e in self._endpoints}
+        for _ in range(65535 - self.EPHEMERAL_START):
+            port = self._ephemeral
+            self._ephemeral += 1
+            if self._ephemeral >= 65535:
+                self._ephemeral = self.EPHEMERAL_START
+            if port not in used:
+                return port
+        return 0
+
+    def DeAllocate(self, ep: Ipv4EndPoint) -> None:
+        if ep in self._endpoints:
+            self._endpoints.remove(ep)
+
+    def Lookup(
+        self, dst: Ipv4Address, dport: int, src: Ipv4Address, sport: int, dst_is_broadcast: bool = False
+    ) -> Ipv4EndPoint | None:
+        best, best_score = None, -1
+        for ep in self._endpoints:
+            score = ep.match_quality(dst, dport, src, sport, dst_is_broadcast)
+            if score > best_score:
+                best, best_score = ep, score
+        return best
+
+
+class UdpL4Protocol(Object):
+    PROT_NUMBER = 17
+
+    tid = (
+        TypeId("tpudes::UdpL4Protocol")
+        .AddConstructor(lambda **kw: UdpL4Protocol(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        self._demux = Ipv4EndPointDemux()
+
+    def SetNode(self, node) -> None:
+        self._node = node
+
+    def CreateSocket(self) -> "UdpSocketImpl":
+        sock = UdpSocketImpl()
+        sock.SetNode(self._node)
+        sock._udp = self
+        return sock
+
+    # --- tx ---
+    def Send(self, packet, saddr: Ipv4Address, daddr: Ipv4Address, sport: int, dport: int, route=None):
+        packet.AddHeader(UdpHeader(sport, dport, packet.GetSize()))
+        from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+
+        ipv4 = self._node.GetObject(Ipv4L3Protocol)
+        ipv4.Send(packet, saddr, daddr, self.PROT_NUMBER, route)
+
+    # --- rx (from Ipv4L3Protocol._deliver_l4) ---
+    def Receive(self, packet, ip_header, incoming_interface):
+        udp_header = packet.RemoveHeader(UdpHeader)
+        dst = ip_header.destination
+        dst_is_broadcast = dst.IsBroadcast() or any(
+            a.GetBroadcast() == dst for a in incoming_interface.addresses
+        )
+        ep = self._demux.Lookup(
+            dst,
+            udp_header.destination_port,
+            ip_header.source,
+            udp_header.source_port,
+            dst_is_broadcast,
+        )
+        if ep is None:
+            return  # port unreachable; ICMP out of scope this round
+        if ep.rx_callback is not None:
+            ep.rx_callback(packet, ip_header, udp_header)
+
+
+class UdpSocketImpl(Socket):
+    tid = (
+        TypeId("tpudes::UdpSocketImpl")
+        .SetParent(Socket.tid)
+        .AddConstructor(lambda **kw: UdpSocketImpl(**kw))
+        .AddAttribute("RcvBufSize", "receive buffer bytes", 131072)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._udp: UdpL4Protocol | None = None
+        self._endpoint: Ipv4EndPoint | None = None
+        self._default_dest: InetSocketAddress | None = None
+        self._rx_queue: deque = deque()
+        self._rx_bytes = 0
+        self._shutdown_send = False
+        self._shutdown_recv = False
+
+    # --- bind/connect ---
+    def Bind(self, address: InetSocketAddress = None) -> int:
+        if self._endpoint is not None:
+            return 0
+        if address is None:
+            self._endpoint = self._udp._demux.Allocate()
+        else:
+            self._endpoint = self._udp._demux.Allocate(address.GetIpv4(), address.GetPort())
+        if self._endpoint is None:
+            self._errno = ERROR_ADDRINUSE
+            return -1
+        self._endpoint.rx_callback = self._forward_up
+        return 0
+
+    def Connect(self, address: InetSocketAddress) -> int:
+        if self._endpoint is None and self.Bind() != 0:
+            return -1
+        self._default_dest = address
+        self._endpoint.SetPeer(address.GetIpv4(), address.GetPort())
+        self.NotifyConnectionSucceeded()
+        return 0
+
+    def Listen(self) -> int:
+        self._errno = ERROR_INVAL
+        return -1
+
+    # --- send/recv ---
+    def Send(self, packet, flags: int = 0) -> int:
+        if self._default_dest is None:
+            self._errno = ERROR_NOTCONN
+            return -1
+        return self.SendTo(packet, flags, self._default_dest)
+
+    def SendTo(self, packet, flags: int, to_address: InetSocketAddress) -> int:
+        if self._shutdown_send:
+            self._errno = ERROR_SHUTDOWN
+            return -1
+        if self._endpoint is None and self.Bind() != 0:
+            return -1
+        from tpudes.models.internet.ipv4 import Ipv4L3Protocol, Ipv4Header
+
+        ipv4 = self._node.GetObject(Ipv4L3Protocol)
+        daddr = to_address.GetIpv4()
+        saddr = self._endpoint.local_addr
+        if saddr.IsAny():
+            if daddr.IsLocalhost():
+                saddr = Ipv4Address.GetLoopback()
+            else:
+                probe = Ipv4Header(destination=daddr)
+                route, errno = ipv4.GetRoutingProtocol().RouteOutput(packet, probe)
+                if route is None:
+                    self._errno = ERROR_NOROUTETOHOST
+                    return -1
+                saddr = route.source
+        size = packet.GetSize()
+        self._udp.Send(packet, saddr, daddr, self._endpoint.local_port, to_address.GetPort())
+        self.NotifyDataSent(size)
+        self.NotifySend(self.GetTxAvailable())
+        return size
+
+    def _forward_up(self, packet, ip_header, udp_header):
+        if self._shutdown_recv:
+            return
+        if self._rx_bytes + packet.GetSize() > self.rcv_buf_size:
+            return  # drop on full buffer
+        src = InetSocketAddress(ip_header.source, udp_header.source_port)
+        self._rx_queue.append((packet, src))
+        self._rx_bytes += packet.GetSize()
+        self.NotifyDataRecv()
+
+    def Recv(self, max_size: int = 0xFFFFFFFF, flags: int = 0):
+        packet, _ = self.RecvFrom(max_size, flags)
+        return packet
+
+    def RecvFrom(self, max_size: int = 0xFFFFFFFF, flags: int = 0):
+        if not self._rx_queue:
+            return None, None
+        packet, src = self._rx_queue.popleft()
+        self._rx_bytes -= packet.GetSize()
+        return packet, src
+
+    def GetRxAvailable(self) -> int:
+        return self._rx_bytes
+
+    def GetSockName(self) -> InetSocketAddress:
+        if self._endpoint is None:
+            return InetSocketAddress(Ipv4Address.GetAny(), 0)
+        return InetSocketAddress(self._endpoint.local_addr, self._endpoint.local_port)
+
+    def Close(self) -> int:
+        if self._endpoint is not None:
+            self._udp._demux.DeAllocate(self._endpoint)
+            self._endpoint = None
+        self.NotifyNormalClose()
+        return 0
+
+    def ShutdownSend(self) -> int:
+        self._shutdown_send = True
+        return 0
+
+    def ShutdownRecv(self) -> int:
+        self._shutdown_recv = True
+        return 0

@@ -1,0 +1,174 @@
+"""Point-to-point link: the first.cc workload's L4 technology.
+
+Reference parity: src/point-to-point/model/point-to-point-net-device.{h,cc},
+point-to-point-channel.{h,cc}, ppp-header.{h,cc} (SURVEY.md 2.9, 3.1).
+Serialization delay = size/DataRate on the device; propagation delay on
+the channel; PPP framing; drop-tail tx queue; full phy/mac trace-source
+set so pcap/ascii helpers and FlowMonitor can hook in.
+
+The remote-channel variant for partitioned topologies lives in
+tpudes/parallel/remote_channel.py (parity:
+point-to-point-remote-channel.{h,cc}).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import Time
+from tpudes.core.object import TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.data_rate import DataRate
+from tpudes.network.net_device import Channel, NetDevice
+from tpudes.network.packet import Header
+from tpudes.network.queue import DropTailQueue
+
+
+class PppHeader(Header):
+    """2-byte PPP protocol field (src/point-to-point/model/ppp-header.cc)."""
+
+    PROTO_MAP = {0x0800: 0x0021, 0x86DD: 0x0057, 0x8847: 0x0281}
+    PROTO_UNMAP = {v: k for k, v in PROTO_MAP.items()}
+
+    def __init__(self, protocol: int = 0x0021):
+        self.protocol = protocol
+
+    def GetSerializedSize(self) -> int:
+        return 2
+
+    def Serialize(self) -> bytes:
+        return struct.pack("!H", self.protocol)
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        (proto,) = struct.unpack("!H", data[:2])
+        return cls(proto), 2
+
+
+class PointToPointChannel(Channel):
+    tid = (
+        TypeId("tpudes::PointToPointChannel")
+        .SetParent(Channel.tid)
+        .AddConstructor(lambda **kw: PointToPointChannel(**kw))
+        .AddAttribute("Delay", "Propagation delay", Time(0), checker=Time)
+    )
+
+    def Attach(self, device: "PointToPointNetDevice") -> None:
+        if len(self._devices) >= 2:
+            raise RuntimeError("PointToPointChannel supports exactly 2 devices")
+        self._devices.append(device)
+
+    def GetDelay(self) -> Time:
+        return self.delay
+
+    def GetPeer(self, device) -> "PointToPointNetDevice":
+        return self._devices[1] if self._devices[0] is device else self._devices[0]
+
+    def TransmitStart(self, packet, src_device, tx_time: Time) -> bool:
+        """Called by the sending device when the first bit hits the wire;
+        the receive event lands at tx_time + propagation delay on the
+        peer's node context (the ScheduleWithContext seam that makes this
+        link partitionable — SURVEY.md 3.2/3.3)."""
+        peer = self.GetPeer(src_device)
+        Simulator.ScheduleWithContext(
+            peer.GetNode().GetId(), tx_time + self.delay, peer.Receive, packet
+        )
+        return True
+
+
+class PointToPointNetDevice(NetDevice):
+    tid = (
+        TypeId("tpudes::PointToPointNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddConstructor(lambda **kw: PointToPointNetDevice(**kw))
+        .AddAttribute("DataRate", "Link data rate", "32768bps", checker=DataRate)
+        .AddAttribute("InterframeGap", "Gap between frames", Time(0), checker=Time)
+        .AddTraceSource("MacTx", "packet arrived for transmission")
+        .AddTraceSource("MacTxDrop", "packet dropped before transmission")
+        .AddTraceSource("MacRx", "packet delivered up")
+        .AddTraceSource("PhyTxBegin", "packet begun transmitting")
+        .AddTraceSource("PhyTxEnd", "packet finished transmitting")
+        .AddTraceSource("PhyRxEnd", "packet finished receiving")
+        .AddTraceSource("PhyRxDrop", "packet dropped in reception")
+        .AddTraceSource("PromiscSniffer", "promiscuous packet tap")
+        .AddTraceSource("Sniffer", "non-promiscuous packet tap")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._channel: PointToPointChannel | None = None
+        self._queue = DropTailQueue()
+        self._tx_busy = False
+        self._error_model = None
+
+    # --- wiring ---
+    def Attach(self, channel: PointToPointChannel) -> None:
+        self._channel = channel
+        channel.Attach(self)
+
+    def GetChannel(self):
+        return self._channel
+
+    def SetQueue(self, queue) -> None:
+        self._queue = queue
+
+    def GetQueue(self):
+        return self._queue
+
+    def SetReceiveErrorModel(self, em) -> None:
+        self._error_model = em
+
+    def IsPointToPoint(self) -> bool:
+        return True
+
+    def IsBroadcast(self) -> bool:
+        return False
+
+    # --- transmit path (SURVEY.md 3.1: the first.cc hot path) ---
+    def Send(self, packet, dest=None, protocol: int = 0x0800) -> bool:
+        if not self._link_up:
+            self.mac_tx_drop(packet)
+            return False
+        self.mac_tx(packet)
+        packet.AddHeader(PppHeader(PppHeader.PROTO_MAP.get(protocol, 0x0021)))
+        if not self._queue.Enqueue(packet):
+            self.mac_tx_drop(packet)
+            return False
+        if not self._tx_busy:
+            self._transmit_next()
+        return True
+
+    def _transmit_next(self) -> None:
+        packet = self._queue.Dequeue()
+        if packet is None:
+            return
+        self._tx_busy = True
+        self.phy_tx_begin(packet)
+        tx_time = self.data_rate.CalculateBytesTxTime(packet.GetSize())
+        self._channel.TransmitStart(packet.Copy(), self, tx_time)
+        Simulator.Schedule(tx_time + self.interframe_gap, self._transmit_complete, packet)
+
+    def _transmit_complete(self, packet) -> None:
+        self.phy_tx_end(packet)
+        self.sniffer(packet)
+        self.promisc_sniffer(packet)
+        self._tx_busy = False
+        self._transmit_next()
+
+    # --- receive path ---
+    def Receive(self, packet) -> None:
+        if self._error_model is not None and self._error_model.IsCorrupt(packet):
+            self.phy_rx_drop(packet)
+            return
+        self.phy_rx_end(packet)
+        self.sniffer(packet)
+        self.promisc_sniffer(packet)
+        ppp = packet.RemoveHeader(PppHeader)
+        protocol = PppHeader.PROTO_UNMAP.get(ppp.protocol, 0x0800)
+        self.mac_rx(packet)
+        self._deliver_up(packet, protocol, self._remote_address(), self._address, 0)
+
+    def _remote_address(self):
+        if self._channel is None:
+            return self._address
+        return self._channel.GetPeer(self).GetAddress()

@@ -1,0 +1,28 @@
+"""Packet & node abstractions.
+
+Reference parity: src/network/model/ (SURVEY.md 2.2): Packet (COW buffer +
+headers/tags), Node, NetDevice, Channel, Address types, Socket, Queue,
+ErrorModel, DataRate.
+"""
+
+from tpudes.network.packet import Packet, Header, Trailer, Tag
+from tpudes.network.address import (
+    Address,
+    Mac48Address,
+    Ipv4Address,
+    Ipv4Mask,
+    Ipv6Address,
+    InetSocketAddress,
+)
+from tpudes.network.node import Node, NodeList
+from tpudes.network.net_device import NetDevice, Channel, SimpleNetDevice, SimpleChannel
+from tpudes.network.queue import Queue, DropTailQueue, QueueSize
+from tpudes.network.error_model import (
+    ErrorModel,
+    RateErrorModel,
+    ListErrorModel,
+    BurstErrorModel,
+)
+from tpudes.network.data_rate import DataRate
+from tpudes.network.socket import Socket
+from tpudes.network.application import Application

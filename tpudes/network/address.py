@@ -1,0 +1,255 @@
+"""Address types: MAC-48, IPv4 (+mask), IPv6-lite, socket addresses.
+
+Reference parity: src/network/utils/mac48-address.{h,cc},
+ipv4-address.{h,cc}, ipv6-address.{h,cc}, inet-socket-address.{h,cc}
+(SURVEY.md 2.2). All value types, hashable, with the string forms ns-3
+scripts use ("10.1.1.0", "255.255.255.0", "00:00:00:00:00:01").
+"""
+
+from __future__ import annotations
+
+
+class Address:
+    """Generic opaque address wrapper (src/network/model/address.h)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value=None):
+        self.value = value
+
+    def __eq__(self, other):
+        return isinstance(other, Address) and self.value == other.value
+
+    def __hash__(self):
+        return hash(self.value)
+
+    def __repr__(self):
+        return f"Address({self.value!r})"
+
+
+class Mac48Address:
+    __slots__ = ("addr",)
+    _next = 0
+
+    def __init__(self, addr: "str | int | Mac48Address" = 0):
+        if isinstance(addr, Mac48Address):
+            self.addr = addr.addr
+        elif isinstance(addr, int):
+            self.addr = addr & 0xFFFFFFFFFFFF
+        else:
+            self.addr = int(addr.replace(":", ""), 16)
+
+    @classmethod
+    def Allocate(cls) -> "Mac48Address":
+        cls._next += 1
+        return cls(cls._next)
+
+    @classmethod
+    def GetBroadcast(cls) -> "Mac48Address":
+        return cls(0xFFFFFFFFFFFF)
+
+    def IsBroadcast(self) -> bool:
+        return self.addr == 0xFFFFFFFFFFFF
+
+    def IsGroup(self) -> bool:
+        return bool((self.addr >> 40) & 0x01)
+
+    def to_bytes(self) -> bytes:
+        return self.addr.to_bytes(6, "big")
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Mac48Address":
+        return cls(int.from_bytes(b[:6], "big"))
+
+    def __eq__(self, other):
+        return isinstance(other, Mac48Address) and self.addr == other.addr
+
+    def __hash__(self):
+        return hash(("mac48", self.addr))
+
+    def __str__(self):
+        b = self.to_bytes()
+        return ":".join(f"{x:02x}" for x in b)
+
+    __repr__ = __str__
+
+
+class Ipv4Address:
+    __slots__ = ("addr",)
+
+    def __init__(self, addr: "str | int | Ipv4Address" = 0):
+        if isinstance(addr, Ipv4Address):
+            self.addr = addr.addr
+        elif isinstance(addr, int):
+            self.addr = addr & 0xFFFFFFFF
+        else:
+            parts = addr.split(".")
+            self.addr = (
+                (int(parts[0]) << 24)
+                | (int(parts[1]) << 16)
+                | (int(parts[2]) << 8)
+                | int(parts[3])
+            )
+
+    @classmethod
+    def GetAny(cls) -> "Ipv4Address":
+        return cls(0)
+
+    @classmethod
+    def GetBroadcast(cls) -> "Ipv4Address":
+        return cls(0xFFFFFFFF)
+
+    @classmethod
+    def GetLoopback(cls) -> "Ipv4Address":
+        return cls("127.0.0.1")
+
+    def IsBroadcast(self) -> bool:
+        return self.addr == 0xFFFFFFFF
+
+    def IsAny(self) -> bool:
+        return self.addr == 0
+
+    def IsLocalhost(self) -> bool:
+        return (self.addr >> 24) == 127
+
+    def IsMulticast(self) -> bool:
+        return 0xE0000000 <= self.addr <= 0xEFFFFFFF
+
+    def CombineMask(self, mask: "Ipv4Mask") -> "Ipv4Address":
+        return Ipv4Address(self.addr & mask.mask)
+
+    def GetSubnetDirectedBroadcast(self, mask: "Ipv4Mask") -> "Ipv4Address":
+        return Ipv4Address(self.addr | (~mask.mask & 0xFFFFFFFF))
+
+    def to_bytes(self) -> bytes:
+        return self.addr.to_bytes(4, "big")
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Ipv4Address":
+        return cls(int.from_bytes(b[:4], "big"))
+
+    def __eq__(self, other):
+        return isinstance(other, Ipv4Address) and self.addr == other.addr
+
+    def __lt__(self, other):
+        return self.addr < other.addr
+
+    def __hash__(self):
+        return hash(("ipv4", self.addr))
+
+    def __str__(self):
+        a = self.addr
+        return f"{a >> 24 & 0xFF}.{a >> 16 & 0xFF}.{a >> 8 & 0xFF}.{a & 0xFF}"
+
+    __repr__ = __str__
+
+
+class Ipv4Mask:
+    __slots__ = ("mask",)
+
+    def __init__(self, mask: "str | int | Ipv4Mask" = 0):
+        if isinstance(mask, Ipv4Mask):
+            self.mask = mask.mask
+        elif isinstance(mask, int):
+            self.mask = mask & 0xFFFFFFFF
+        elif mask.startswith("/"):
+            n = int(mask[1:])
+            self.mask = (0xFFFFFFFF << (32 - n)) & 0xFFFFFFFF if n else 0
+        else:
+            self.mask = Ipv4Address(mask).addr
+
+    def IsMatch(self, a: Ipv4Address, b: Ipv4Address) -> bool:
+        return (a.addr & self.mask) == (b.addr & self.mask)
+
+    def GetPrefixLength(self) -> int:
+        return bin(self.mask).count("1")
+
+    @classmethod
+    def GetOnes(cls) -> "Ipv4Mask":
+        return cls(0xFFFFFFFF)
+
+    @classmethod
+    def GetZero(cls) -> "Ipv4Mask":
+        return cls(0)
+
+    def __eq__(self, other):
+        return isinstance(other, Ipv4Mask) and self.mask == other.mask
+
+    def __hash__(self):
+        return hash(("mask", self.mask))
+
+    def __str__(self):
+        return str(Ipv4Address(self.mask))
+
+    __repr__ = __str__
+
+
+class Ipv6Address:
+    """Minimal IPv6 value type (full v6 stack is out-of-scope this round;
+    the type exists so APIs carrying it have the right shape)."""
+
+    __slots__ = ("addr",)
+
+    def __init__(self, addr: "str | int" = 0):
+        if isinstance(addr, Ipv6Address):
+            self.addr = addr.addr
+        elif isinstance(addr, int):
+            self.addr = addr
+        else:
+            # minimal :: expansion parser
+            s = addr
+            if "::" in s:
+                head, _, tail = s.partition("::")
+                h = [p for p in head.split(":") if p]
+                t = [p for p in tail.split(":") if p]
+                parts = h + ["0"] * (8 - len(h) - len(t)) + t
+            else:
+                parts = s.split(":")
+            self.addr = 0
+            for p in parts:
+                self.addr = (self.addr << 16) | int(p or "0", 16)
+
+    @classmethod
+    def GetAny(cls) -> "Ipv6Address":
+        return cls(0)
+
+    def __eq__(self, other):
+        return isinstance(other, Ipv6Address) and self.addr == other.addr
+
+    def __hash__(self):
+        return hash(("ipv6", self.addr))
+
+    def __str__(self):
+        groups = [(self.addr >> (16 * (7 - i))) & 0xFFFF for i in range(8)]
+        return ":".join(f"{g:x}" for g in groups)
+
+    __repr__ = __str__
+
+
+class InetSocketAddress:
+    """(Ipv4Address, port) pair (src/network/utils/inet-socket-address.h)."""
+
+    __slots__ = ("ipv4", "port")
+
+    def __init__(self, ipv4: "Ipv4Address | str | int", port: int = 0):
+        self.ipv4 = Ipv4Address(ipv4) if not isinstance(ipv4, Ipv4Address) else ipv4
+        self.port = port
+
+    def GetIpv4(self) -> Ipv4Address:
+        return self.ipv4
+
+    def GetPort(self) -> int:
+        return self.port
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, InetSocketAddress)
+            and self.ipv4 == other.ipv4
+            and self.port == other.port
+        )
+
+    def __hash__(self):
+        return hash((self.ipv4, self.port))
+
+    def __repr__(self):
+        return f"{self.ipv4}:{self.port}"

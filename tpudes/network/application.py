@@ -1,0 +1,58 @@
+"""Application base class with scheduled start/stop.
+
+Reference parity: src/network/model/application.{h,cc} (SURVEY.md 2.2).
+"""
+
+from __future__ import annotations
+
+from tpudes.core.nstime import Time
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+
+
+class Application(Object):
+    tid = (
+        TypeId("tpudes::Application")
+        .AddAttribute("StartTime", "app start time", Time(0), checker=Time)
+        .AddAttribute("StopTime", "app stop time (0 = never)", Time(0), checker=Time)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        self._started = False
+
+    def SetNode(self, node) -> None:
+        self._node = node
+
+    def GetNode(self):
+        return self._node
+
+    def SetStartTime(self, start: Time) -> None:
+        self.start_time = Time(start)
+
+    def SetStopTime(self, stop: Time) -> None:
+        self.stop_time = Time(stop)
+
+    def DoInitialize(self) -> None:
+        # Applications self-schedule their Start/Stop at Initialize (t=0)
+        delay = self.start_time - Simulator.Now()
+        Simulator.Schedule(Time(max(0, delay.ticks)), self._start)
+        if self.stop_time.ticks > 0:
+            delay = self.stop_time - Simulator.Now()
+            Simulator.Schedule(Time(max(0, delay.ticks)), self._stop)
+
+    def _start(self):
+        self._started = True
+        self.StartApplication()
+
+    def _stop(self):
+        if self._started:
+            self._started = False
+        self.StopApplication()
+
+    def StartApplication(self) -> None:
+        pass
+
+    def StopApplication(self) -> None:
+        pass

@@ -1,0 +1,69 @@
+"""DataRate value type with "5Mbps"-style parsing.
+
+Reference parity: src/network/utils/data-rate.{h,cc} (SURVEY.md 2.2).
+"""
+
+from __future__ import annotations
+
+import re
+
+from tpudes.core.nstime import Time
+
+_SUFFIXES = {
+    "bps": 1,
+    "b/s": 1,
+    "kbps": 10**3,
+    "kb/s": 10**3,
+    "kibps": 2**10,
+    "mbps": 10**6,
+    "mb/s": 10**6,
+    "mibps": 2**20,
+    "gbps": 10**9,
+    "gb/s": 10**9,
+    "gibps": 2**30,
+    "bs": 1,  # tolerant
+}
+
+_RATE_RE = re.compile(r"^\s*([0-9.eE+-]+)\s*([a-zA-Z/]*)\s*$")
+
+
+class DataRate:
+    __slots__ = ("bps",)
+
+    def __init__(self, rate: "str | int | float | DataRate" = 0):
+        if isinstance(rate, DataRate):
+            self.bps = rate.bps
+        elif isinstance(rate, (int, float)):
+            self.bps = int(rate)
+        else:
+            m = _RATE_RE.match(rate)
+            if not m:
+                raise ValueError(f"cannot parse data rate {rate!r}")
+            value = float(m.group(1))
+            suffix = m.group(2).lower() or "bps"
+            if suffix not in _SUFFIXES:
+                raise ValueError(f"unknown data-rate unit {m.group(2)!r}")
+            self.bps = int(value * _SUFFIXES[suffix])
+
+    def GetBitRate(self) -> int:
+        return self.bps
+
+    def CalculateBytesTxTime(self, nbytes: int) -> Time:
+        return self.CalculateBitsTxTime(nbytes * 8)
+
+    def CalculateBitsTxTime(self, nbits: int) -> Time:
+        # exact integer tick math: ticks = bits * ticks_per_sec / bps
+        ticks_per_sec = 10 ** (-Time._res_exp)
+        return Time((nbits * ticks_per_sec) // self.bps)
+
+    def __eq__(self, other):
+        return isinstance(other, DataRate) and self.bps == other.bps
+
+    def __lt__(self, other):
+        return self.bps < DataRate(other).bps
+
+    def __hash__(self):
+        return hash(("rate", self.bps))
+
+    def __repr__(self):
+        return f"DataRate({self.bps}bps)"

@@ -1,0 +1,165 @@
+"""Error models: stochastic and scripted packet corruption (the link-level
+fault-injection surface).
+
+Reference parity: src/network/utils/error-model.{h,cc} (SURVEY.md 2.2,
+5.3): RateErrorModel (per-bit/byte/packet Bernoulli), ListErrorModel
+(scripted losses by packet uid — the deterministic test fixture),
+BurstErrorModel (correlated loss runs).
+"""
+
+from __future__ import annotations
+
+from tpudes.core.object import Object, TypeId
+from tpudes.core.rng import UniformRandomVariable
+
+
+class ErrorModel(Object):
+    tid = (
+        TypeId("tpudes::ErrorModel")
+        .AddAttribute("IsEnabled", "enable/disable the model", True, field="enabled")
+    )
+
+    def IsCorrupt(self, packet) -> bool:
+        if not self.enabled:
+            return False
+        return self.DoCorrupt(packet)
+
+    def DoCorrupt(self, packet) -> bool:
+        raise NotImplementedError
+
+    def Enable(self) -> None:
+        self.enabled = True
+
+    def Disable(self) -> None:
+        self.enabled = False
+
+    def Reset(self) -> None:
+        self.DoReset()
+
+    def DoReset(self) -> None:
+        pass
+
+
+class RateErrorModel(ErrorModel):
+    ERROR_UNIT_BIT = "ERROR_UNIT_BIT"
+    ERROR_UNIT_BYTE = "ERROR_UNIT_BYTE"
+    ERROR_UNIT_PACKET = "ERROR_UNIT_PACKET"
+
+    tid = (
+        TypeId("tpudes::RateErrorModel")
+        .SetParent(ErrorModel.tid)
+        .AddConstructor(lambda **kw: RateErrorModel(**kw))
+        .AddAttribute("ErrorRate", "error rate per unit", 0.0)
+        .AddAttribute("ErrorUnit", "BIT, BYTE or PACKET", "ERROR_UNIT_BYTE")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._ranvar = UniformRandomVariable()
+
+    def SetRandomVariable(self, rv) -> None:
+        self._ranvar = rv
+
+    def AssignStreams(self, stream: int) -> int:
+        self._ranvar.SetStream(stream)
+        return 1
+
+    def DoCorrupt(self, packet) -> bool:
+        if self.error_unit == self.ERROR_UNIT_PACKET:
+            p_ok = 1.0 - self.error_rate
+        elif self.error_unit == self.ERROR_UNIT_BYTE:
+            p_ok = (1.0 - self.error_rate) ** packet.GetSize()
+        else:
+            p_ok = (1.0 - self.error_rate) ** (8 * packet.GetSize())
+        return self._ranvar.GetValue() >= p_ok
+
+
+class ListErrorModel(ErrorModel):
+    """Corrupt exactly the listed packet uids — deterministic scripted
+    losses for tests."""
+
+    tid = (
+        TypeId("tpudes::ListErrorModel")
+        .SetParent(ErrorModel.tid)
+        .AddConstructor(lambda **kw: ListErrorModel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._list: set[int] = set()
+
+    def SetList(self, uids) -> None:
+        self._list = set(uids)
+
+    def GetList(self):
+        return sorted(self._list)
+
+    def DoCorrupt(self, packet) -> bool:
+        return packet.GetUid() in self._list
+
+
+class ReceiveListErrorModel(ErrorModel):
+    """Corrupt the Nth received packets (by arrival index)."""
+
+    tid = (
+        TypeId("tpudes::ReceiveListErrorModel")
+        .SetParent(ErrorModel.tid)
+        .AddConstructor(lambda **kw: ReceiveListErrorModel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._list: set[int] = set()
+        self._count = 0
+
+    def SetList(self, indices) -> None:
+        self._list = set(indices)
+
+    def DoCorrupt(self, packet) -> bool:
+        i = self._count
+        self._count += 1
+        return i in self._list
+
+    def DoReset(self) -> None:
+        self._count = 0
+
+
+class BurstErrorModel(ErrorModel):
+    """Correlated loss: when triggered, corrupts a random-length run of
+    consecutive packets."""
+
+    tid = (
+        TypeId("tpudes::BurstErrorModel")
+        .SetParent(ErrorModel.tid)
+        .AddConstructor(lambda **kw: BurstErrorModel(**kw))
+        .AddAttribute("ErrorRate", "burst start probability", 0.0, field="burst_rate")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._ranvar = UniformRandomVariable()
+        self._burst_size = UniformRandomVariable(Min=1.0, Max=4.0)
+        self._remaining = 0
+
+    def SetRandomVariable(self, rv) -> None:
+        self._ranvar = rv
+
+    def SetRandomBurstSize(self, rv) -> None:
+        self._burst_size = rv
+
+    def AssignStreams(self, stream: int) -> int:
+        self._ranvar.SetStream(stream)
+        self._burst_size.SetStream(stream + 1)
+        return 2
+
+    def DoCorrupt(self, packet) -> bool:
+        if self._remaining > 0:
+            self._remaining -= 1
+            return True
+        if self._ranvar.GetValue() < self.burst_rate:
+            self._remaining = max(0, int(self._burst_size.GetValue()) - 1)
+            return True
+        return False
+
+    def DoReset(self) -> None:
+        self._remaining = 0

@@ -1,0 +1,217 @@
+"""NetDevice & Channel abstractions + the Simple* test fixtures.
+
+Reference parity: src/network/model/net-device.{h,cc}, channel.{h,cc},
+src/network/utils/simple-net-device.{h,cc}, simple-channel.{h,cc}
+(SURVEY.md 2.2, 4 — SimpleNetDevice is upstream's protocol-test fixture
+and serves the same role here).
+"""
+
+from __future__ import annotations
+
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.core.nstime import Time
+from tpudes.network.address import Mac48Address
+
+
+class Channel(Object):
+    tid = TypeId("tpudes::Channel").AddAttribute("Id", "channel id", 0, field="cid")
+
+    _next_id = 0
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        Channel._next_id += 1
+        self.cid = Channel._next_id
+        self._devices: list = []
+
+    def GetId(self) -> int:
+        return self.cid
+
+    def GetNDevices(self) -> int:
+        return len(self._devices)
+
+    def GetDevice(self, i: int):
+        return self._devices[i]
+
+
+class NetDevice(Object):
+    tid = (
+        TypeId("tpudes::NetDevice")
+        .AddAttribute("Mtu", "Maximum transmission unit", 1500)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        self._if_index = 0
+        self._address = Mac48Address.Allocate()
+        self._rx_callback = None
+        self._promisc_callback = None
+        self._link_up = True
+        self._link_change_callbacks = []
+
+    # --- identity / wiring ---
+    def SetNode(self, node) -> None:
+        self._node = node
+
+    def GetNode(self):
+        return self._node
+
+    def SetIfIndex(self, index: int) -> None:
+        self._if_index = index
+
+    def GetIfIndex(self) -> int:
+        return self._if_index
+
+    def SetAddress(self, address) -> None:
+        self._address = address
+
+    def GetAddress(self):
+        return self._address
+
+    def GetChannel(self):
+        return None
+
+    def GetMtu(self) -> int:
+        return self.mtu
+
+    def SetMtu(self, mtu: int) -> None:
+        self.mtu = mtu
+
+    # --- link state ---
+    def IsLinkUp(self) -> bool:
+        return self._link_up
+
+    def SetLinkUp(self) -> None:
+        if not self._link_up:
+            self._link_up = True
+            for cb in self._link_change_callbacks:
+                cb()
+
+    def SetLinkDown(self) -> None:
+        if self._link_up:
+            self._link_up = False
+            for cb in self._link_change_callbacks:
+                cb()
+
+    def AddLinkChangeCallback(self, cb) -> None:
+        self._link_change_callbacks.append(cb)
+
+    # --- capabilities (defaults; subclasses override) ---
+    def IsBroadcast(self) -> bool:
+        return True
+
+    def GetBroadcast(self):
+        return Mac48Address.GetBroadcast()
+
+    def IsMulticast(self) -> bool:
+        return False
+
+    def IsPointToPoint(self) -> bool:
+        return False
+
+    def IsBridge(self) -> bool:
+        return False
+
+    def NeedsArp(self) -> bool:
+        return False
+
+    # --- tx/rx ---
+    def Send(self, packet, dest, protocol: int) -> bool:
+        raise NotImplementedError
+
+    def SendFrom(self, packet, source, dest, protocol: int) -> bool:
+        return self.Send(packet, dest, protocol)
+
+    def SetReceiveCallback(self, cb) -> None:
+        """cb(device, packet, protocol, sender) -> bool"""
+        self._rx_callback = cb
+
+    def SetPromiscReceiveCallback(self, cb) -> None:
+        self._promisc_callback = cb
+
+    def _deliver_up(self, packet, protocol, sender, receiver, packet_type):
+        if self._promisc_callback is not None:
+            self._promisc_callback(self, packet.Copy(), protocol, sender, receiver, packet_type)
+        if packet_type != 3 and self._rx_callback is not None:  # 3 = OTHERHOST
+            return self._rx_callback(self, packet, protocol, sender)
+        if self._node is not None:
+            return self._node.ReceiveFromDevice(
+                self, packet, protocol, sender, receiver, packet_type
+            )
+        return False
+
+
+class SimpleChannel(Channel):
+    """Broadcast test channel with a fixed delay
+    (src/network/utils/simple-channel.{h,cc})."""
+
+    tid = (
+        TypeId("tpudes::SimpleChannel")
+        .SetParent(Channel.tid)
+        .AddConstructor(lambda **kw: SimpleChannel(**kw))
+        .AddAttribute("Delay", "Propagation delay", Time(0), field="delay", checker=Time)
+    )
+
+    def Add(self, device: "SimpleNetDevice") -> None:
+        self._devices.append(device)
+
+    def Send(self, packet, protocol, dest, sender_device) -> None:
+        for dev in self._devices:
+            if dev is sender_device:
+                continue
+            Simulator.ScheduleWithContext(
+                dev.GetNode().GetId(),
+                self.delay,
+                dev.Receive,
+                packet.Copy(),
+                protocol,
+                dest,
+                sender_device.GetAddress(),
+            )
+
+
+class SimpleNetDevice(NetDevice):
+    """Trivial device for protocol tests
+    (src/network/utils/simple-net-device.{h,cc})."""
+
+    tid = (
+        TypeId("tpudes::SimpleNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddConstructor(lambda **kw: SimpleNetDevice(**kw))
+        .AddTraceSource("PhyRxDrop", "Packet dropped by the error model")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._channel: SimpleChannel | None = None
+        self._error_model = None
+
+    def SetChannel(self, channel: SimpleChannel) -> None:
+        self._channel = channel
+        channel.Add(self)
+
+    def GetChannel(self):
+        return self._channel
+
+    def SetReceiveErrorModel(self, em) -> None:
+        self._error_model = em
+
+    def Send(self, packet, dest, protocol: int) -> bool:
+        if not self._link_up or self._channel is None:
+            return False
+        self._channel.Send(packet, protocol, dest, self)
+        return True
+
+    def Receive(self, packet, protocol, to, from_addr) -> None:
+        if self._error_model is not None and self._error_model.IsCorrupt(packet):
+            self.phy_rx_drop(packet)
+            return
+        if to == self._address:
+            packet_type = 0  # HOST
+        elif getattr(to, "IsBroadcast", lambda: False)():
+            packet_type = 1  # BROADCAST
+        else:
+            packet_type = 3  # OTHERHOST
+        self._deliver_up(packet, protocol, from_addr, to, packet_type)

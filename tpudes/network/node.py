@@ -1,0 +1,145 @@
+"""Node: the container of devices, protocol handlers, and applications.
+
+Reference parity: src/network/model/node.{h,cc}, node-list.{h,cc}
+(SURVEY.md 2.2). ``systemId`` is the partition key for space-parallel
+runs (src/mpi partitioning; SURVEY.md 2.3) — nodes owned by another
+partition only participate through remote channels.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+
+
+class NodeList:
+    """Global node registry; config root ``/NodeList`` (src/network/model/
+    node-list.{h,cc})."""
+
+    _nodes: list = []
+
+    @classmethod
+    def Add(cls, node) -> int:
+        cls._nodes.append(node)
+        return len(cls._nodes) - 1
+
+    @classmethod
+    def GetNode(cls, nid: int):
+        return cls._nodes[nid]
+
+    @classmethod
+    def GetNNodes(cls) -> int:
+        return len(cls._nodes)
+
+    @classmethod
+    def All(cls) -> list:
+        return list(cls._nodes)
+
+    @classmethod
+    def Reset(cls) -> None:
+        cls._nodes = []
+
+
+# register as a Config root
+from tpudes.core.config import Config  # noqa: E402
+
+Config.RegisterRootNamespaceObject("NodeList", lambda: NodeList._nodes)
+
+
+class ProtocolHandlerEntry:
+    __slots__ = ("handler", "protocol", "device", "promiscuous")
+
+    def __init__(self, handler, protocol, device, promiscuous):
+        self.handler = handler
+        self.protocol = protocol
+        self.device = device
+        self.promiscuous = promiscuous
+
+
+class Node(Object):
+    tid = (
+        TypeId("tpudes::Node")
+        .AddConstructor(lambda **kw: Node(**kw))
+        .AddAttribute("DeviceList", "The list of devices on this node", None, field="devices")
+        .AddAttribute("ApplicationList", "The list of applications", None, field="applications")
+        .AddAttribute("Id", "The node id", 0, field="nid")
+    )
+
+    # packet types for promiscuous callbacks (ns-3 NetDevice::PacketType)
+    PACKET_HOST = 0
+    PACKET_BROADCAST = 1
+    PACKET_MULTICAST = 2
+    PACKET_OTHERHOST = 3
+
+    def __init__(self, system_id: int = 0, **attributes):
+        super().__init__(**attributes)
+        self.devices = []
+        self.applications = []
+        self._handlers: list[ProtocolHandlerEntry] = []
+        self.system_id = system_id  # MPI-rank analog: mesh-partition key
+        self.nid = NodeList.Add(self)
+
+    def GetId(self) -> int:
+        return self.nid
+
+    def GetSystemId(self) -> int:
+        return self.system_id
+
+    # --- devices ---
+    def AddDevice(self, device) -> int:
+        index = len(self.devices)
+        self.devices.append(device)
+        device.SetNode(self)
+        device.SetIfIndex(index)
+        return index
+
+    def GetDevice(self, index: int):
+        return self.devices[index]
+
+    def GetNDevices(self) -> int:
+        return len(self.devices)
+
+    # --- applications ---
+    def AddApplication(self, app) -> int:
+        index = len(self.applications)
+        self.applications.append(app)
+        app.SetNode(self)
+        # ns-3 schedules app initialization at time 0
+        Simulator.ScheduleWithContext(self.nid, 0, app.Initialize)
+        return index
+
+    def GetApplication(self, index: int):
+        return self.applications[index]
+
+    def GetNApplications(self) -> int:
+        return len(self.applications)
+
+    # --- protocol dispatch ---
+    def RegisterProtocolHandler(self, handler, protocol=0, device=None, promiscuous=False):
+        """handler(device, packet, protocol, sender) called for matching
+        received packets; protocol 0 = all."""
+        self._handlers.append(ProtocolHandlerEntry(handler, protocol, device, promiscuous))
+
+    def UnregisterProtocolHandler(self, handler):
+        self._handlers = [e for e in self._handlers if e.handler is not handler]
+
+    def ReceiveFromDevice(self, device, packet, protocol, sender, receiver=None, packet_type=PACKET_HOST):
+        """Called by NetDevices on packet arrival; dispatches to handlers
+        (ns-3 Node::ReceiveFromDevice / NonPromiscReceiveFromDevice)."""
+        found = False
+        for entry in self._handlers:
+            if entry.device is not None and entry.device is not device:
+                continue
+            if entry.protocol != 0 and entry.protocol != protocol:
+                continue
+            if packet_type == self.PACKET_OTHERHOST and not entry.promiscuous:
+                continue
+            if entry.promiscuous:
+                entry.handler(device, packet, protocol, sender, receiver, packet_type)
+            else:
+                entry.handler(device, packet, protocol, sender)
+            found = True
+        return found
+
+    def __repr__(self):
+        return f"Node({self.nid})"

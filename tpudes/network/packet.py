@@ -1,0 +1,234 @@
+"""Packet: virtual byte buffer with headers/trailers/tags, value semantics.
+
+Reference parity: src/network/model/packet.{h,cc}, buffer.{h,cc},
+header.h, trailer.h, tag.h, packet-metadata.{h,cc} (SURVEY.md 2.2).
+
+Design (idiomatic Python, same capabilities):
+- The payload is *virtual* (a size of zero-filled bytes, or real bytes if
+  provided) exactly as ns-3's default.
+- Headers/trailers are kept *structured* (immutable tuples of Header
+  objects) rather than eagerly serialized — the common simulation path
+  never needs the wire bytes, and immutability gives ns-3's
+  copy-on-write value semantics for free: ``Copy()`` is O(1).
+- ``ToBytes``/``FromBytes`` provide the real on-the-wire serialization
+  (pcap writing, cross-partition packet transport — the MPI-serialization
+  analog in SURVEY.md 2.3).
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+class Header:
+    """Base protocol header (src/network/model/header.h). Subclasses
+    define fields, GetSerializedSize, Serialize -> bytes and
+    classmethod Deserialize(bytes) -> (header, consumed)."""
+
+    def GetSerializedSize(self) -> int:
+        return len(self.Serialize())
+
+    def Serialize(self) -> bytes:
+        return b""
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        return cls(), 0
+
+    def __repr__(self):
+        fields = ", ".join(
+            f"{k}={v!r}" for k, v in vars(self).items() if not k.startswith("_")
+        )
+        return f"{type(self).__name__}({fields})"
+
+
+class Trailer(Header):
+    """Base protocol trailer (src/network/model/trailer.h)."""
+
+
+class Tag:
+    """Base packet/byte tag (src/network/model/tag.h) — small value
+    annotations carried alongside the bytes."""
+
+
+class ByteTag:
+    __slots__ = ("tag", "start", "end")
+
+    def __init__(self, tag: Tag, start: int, end: int):
+        self.tag = tag
+        self.start = start
+        self.end = end
+
+
+_next_uid = [0]
+
+
+class Packet:
+    """A network packet with ns-3 value semantics."""
+
+    __slots__ = ("_headers", "_trailers", "_payload", "_payload_size", "_packet_tags", "_byte_tags", "_uid")
+
+    def __init__(self, payload: "int | bytes" = 0):
+        self._headers: tuple = ()
+        self._trailers: tuple = ()
+        if isinstance(payload, (bytes, bytearray)):
+            self._payload = bytes(payload)
+            self._payload_size = len(self._payload)
+        else:
+            self._payload = None  # virtual zero-filled
+            self._payload_size = int(payload)
+        self._packet_tags: tuple = ()
+        self._byte_tags: tuple = ()
+        _next_uid[0] += 1
+        self._uid = _next_uid[0]
+
+    # --- size ---
+    def GetSize(self) -> int:
+        return (
+            self._payload_size
+            + sum(h.GetSerializedSize() for h in self._headers)
+            + sum(t.GetSerializedSize() for t in self._trailers)
+        )
+
+    def GetUid(self) -> int:
+        return self._uid
+
+    # --- headers (front) ---
+    def AddHeader(self, header: Header) -> None:
+        self._headers = (header,) + self._headers
+
+    def RemoveHeader(self, header_cls=None):
+        """Pop the front header. With a class argument, asserts the type
+        (ns-3 deserializes into the caller's header object; here the
+        header instance is returned)."""
+        if not self._headers:
+            raise IndexError("packet has no headers")
+        h = self._headers[0]
+        if header_cls is not None and not isinstance(h, header_cls):
+            raise TypeError(f"front header is {type(h).__name__}, expected {header_cls.__name__}")
+        self._headers = self._headers[1:]
+        return h
+
+    def PeekHeader(self, header_cls=None):
+        if not self._headers:
+            return None
+        h = self._headers[0]
+        if header_cls is not None and not isinstance(h, header_cls):
+            return None
+        return h
+
+    def FindHeader(self, header_cls):
+        """Scan all headers for one of the given type (metadata walk)."""
+        for h in self._headers:
+            if isinstance(h, header_cls):
+                return h
+        return None
+
+    # --- trailers (back) ---
+    def AddTrailer(self, trailer: Trailer) -> None:
+        self._trailers = self._trailers + (trailer,)
+
+    def RemoveTrailer(self, trailer_cls=None):
+        if not self._trailers:
+            raise IndexError("packet has no trailers")
+        t = self._trailers[-1]
+        if trailer_cls is not None and not isinstance(t, trailer_cls):
+            raise TypeError(f"back trailer is {type(t).__name__}")
+        self._trailers = self._trailers[:-1]
+        return t
+
+    def PeekTrailer(self, trailer_cls=None):
+        if not self._trailers:
+            return None
+        t = self._trailers[-1]
+        if trailer_cls is not None and not isinstance(t, trailer_cls):
+            return None
+        return t
+
+    # --- packet tags (whole-packet annotations) ---
+    def AddPacketTag(self, tag: Tag) -> None:
+        self._packet_tags = self._packet_tags + (tag,)
+
+    def PeekPacketTag(self, tag_cls):
+        for t in self._packet_tags:
+            if isinstance(t, tag_cls):
+                return t
+        return None
+
+    def RemovePacketTag(self, tag_cls):
+        for t in self._packet_tags:
+            if isinstance(t, tag_cls):
+                self._packet_tags = tuple(x for x in self._packet_tags if x is not t)
+                return t
+        return None
+
+    def RemoveAllPacketTags(self) -> None:
+        self._packet_tags = ()
+
+    # --- byte tags (range annotations; ranges kept whole-packet here) ---
+    def AddByteTag(self, tag: Tag) -> None:
+        self._byte_tags = self._byte_tags + (ByteTag(tag, 0, self.GetSize()),)
+
+    def GetByteTags(self) -> tuple:
+        return self._byte_tags
+
+    def FindFirstMatchingByteTag(self, tag_cls):
+        for bt in self._byte_tags:
+            if isinstance(bt.tag, tag_cls):
+                return bt.tag
+        return None
+
+    # --- value semantics ---
+    def Copy(self) -> "Packet":
+        """O(1): all internal state is immutable tuples (the COW analog)."""
+        p = Packet.__new__(Packet)
+        p._headers = self._headers
+        p._trailers = self._trailers
+        p._payload = self._payload
+        p._payload_size = self._payload_size
+        p._packet_tags = self._packet_tags
+        p._byte_tags = self._byte_tags
+        p._uid = self._uid
+        return p
+
+    def CreateFragment(self, start: int, length: int) -> "Packet":
+        """Byte-range fragment of the serialized form (used by
+        fragmentation); returns a raw-payload packet."""
+        data = self.ToBytes()[start : start + length]
+        return Packet(data)
+
+    # --- wire serialization ---
+    def ToBytes(self) -> bytes:
+        parts = [h.Serialize() for h in self._headers]
+        if self._payload is not None:
+            parts.append(self._payload)
+        else:
+            parts.append(b"\x00" * self._payload_size)
+        parts.extend(t.Serialize() for t in self._trailers)
+        return b"".join(parts)
+
+    def GetPayload(self) -> bytes:
+        return self._payload if self._payload is not None else b"\x00" * self._payload_size
+
+    def __repr__(self):
+        names = [type(h).__name__ for h in self._headers]
+        return f"Packet(uid={self._uid}, size={self.GetSize()}, headers={names})"
+
+
+class LlcSnapHeader(Header):
+    """8-byte LLC/SNAP header (src/network/utils/llc-snap-header.{h,cc}),
+    used by CSMA/WiFi to carry the EtherType."""
+
+    def __init__(self, ether_type: int = 0x0800):
+        self.ether_type = ether_type
+
+    def GetSerializedSize(self) -> int:
+        return 8
+
+    def Serialize(self) -> bytes:
+        return struct.pack("!BBB3sH", 0xAA, 0xAA, 0x03, b"\x00\x00\x00", self.ether_type)
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        (_, _, _, _, et) = struct.unpack("!BBB3sH", data[:8])
+        return cls(et), 8

@@ -1,0 +1,111 @@
+"""Packet queues.
+
+Reference parity: src/network/model/queue.{h,cc},
+src/network/utils/drop-tail-queue.{h,cc}, queue-size.{h,cc}
+(SURVEY.md 2.2).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import deque
+
+from tpudes.core.object import Object, TypeId
+
+_QS_RE = re.compile(r"^\s*([0-9]+)\s*(p|B|kB|MB|Kib|Mib)?\s*$")
+
+
+class QueueSize:
+    """"100p" (packets) or "64kB" (bytes) — src/network/utils/queue-size.h."""
+
+    PACKETS = "p"
+    BYTES = "B"
+
+    __slots__ = ("mode", "value")
+
+    def __init__(self, spec: "str | QueueSize" = "100p"):
+        if isinstance(spec, QueueSize):
+            self.mode, self.value = spec.mode, spec.value
+            return
+        m = _QS_RE.match(spec)
+        if not m:
+            raise ValueError(f"cannot parse queue size {spec!r}")
+        value, unit = int(m.group(1)), m.group(2) or "p"
+        if unit == "p":
+            self.mode, self.value = self.PACKETS, value
+        else:
+            mult = {"B": 1, "kB": 1000, "MB": 10**6, "Kib": 1024, "Mib": 2**20}[unit]
+            self.mode, self.value = self.BYTES, value * mult
+
+    def GetValue(self) -> int:
+        return self.value
+
+    def __repr__(self):
+        return f"QueueSize({self.value}{'p' if self.mode == self.PACKETS else 'B'})"
+
+
+class Queue(Object):
+    tid = (
+        TypeId("tpudes::Queue")
+        .AddAttribute("MaxSize", "Max queue size", "100p", field="max_size", checker=QueueSize)
+        .AddTraceSource("Enqueue", "packet enqueued")
+        .AddTraceSource("Dequeue", "packet dequeued")
+        .AddTraceSource("Drop", "packet dropped")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._q: deque = deque()
+        self._nbytes = 0
+        self.total_received_packets = 0
+        self.total_dropped_packets = 0
+
+    def GetNPackets(self) -> int:
+        return len(self._q)
+
+    def GetNBytes(self) -> int:
+        return self._nbytes
+
+    def IsEmpty(self) -> bool:
+        return not self._q
+
+    def _would_overflow(self, packet) -> bool:
+        if self.max_size.mode == QueueSize.PACKETS:
+            return len(self._q) + 1 > self.max_size.value
+        return self._nbytes + packet.GetSize() > self.max_size.value
+
+    def Enqueue(self, packet) -> bool:
+        self.total_received_packets += 1
+        if self._would_overflow(packet):
+            self.total_dropped_packets += 1
+            self.drop(packet)
+            return False
+        self._q.append(packet)
+        self._nbytes += packet.GetSize()
+        self.enqueue(packet)
+        return True
+
+    def Dequeue(self):
+        if not self._q:
+            return None
+        packet = self._q.popleft()
+        self._nbytes -= packet.GetSize()
+        self.dequeue(packet)
+        return packet
+
+    def Peek(self):
+        return self._q[0] if self._q else None
+
+    def Flush(self) -> None:
+        while self._q:
+            self.Dequeue()
+
+
+class DropTailQueue(Queue):
+    """FIFO with tail drop (src/network/utils/drop-tail-queue.{h,cc})."""
+
+    tid = (
+        TypeId("tpudes::DropTailQueue")
+        .SetParent(Queue.tid)
+        .AddConstructor(lambda **kw: DropTailQueue(**kw))
+    )

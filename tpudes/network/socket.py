@@ -1,0 +1,181 @@
+"""Abstract BSD-like asynchronous Socket API.
+
+Reference parity: src/network/model/socket.{h,cc} (SURVEY.md 2.2):
+callback-driven (no blocking), Bind/Connect/Send/Recv, with the same
+callback set models rely on (receive, connection succeeded/failed, data
+sent, send buffer space).
+"""
+
+from __future__ import annotations
+
+from tpudes.core.object import Object, TypeId
+
+# ns-3 Socket::SocketErrno
+ERROR_NOTERROR = 0
+ERROR_ISCONN = 1
+ERROR_NOTCONN = 2
+ERROR_MSGSIZE = 3
+ERROR_AGAIN = 4
+ERROR_SHUTDOWN = 5
+ERROR_OPNOTSUPP = 6
+ERROR_AFNOSUPPORT = 7
+ERROR_INVAL = 8
+ERROR_BADF = 9
+ERROR_NOROUTETOHOST = 10
+ERROR_NODEV = 11
+ERROR_ADDRNOTAVAIL = 12
+ERROR_ADDRINUSE = 13
+
+
+class Socket(Object):
+    tid = TypeId("tpudes::Socket")
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        self._errno = ERROR_NOTERROR
+        self._recv_callback = None
+        self._connect_success_cb = None
+        self._connect_fail_cb = None
+        self._close_cb = None
+        self._close_error_cb = None
+        self._accept_request_cb = None
+        self._new_connection_cb = None
+        self._data_sent_cb = None
+        self._send_cb = None
+
+    # --- node wiring ---
+    def SetNode(self, node) -> None:
+        self._node = node
+
+    def GetNode(self):
+        return self._node
+
+    def GetErrno(self) -> int:
+        return self._errno
+
+    # --- callbacks ---
+    def SetRecvCallback(self, cb) -> None:
+        """cb(socket) — data available; call Recv/RecvFrom to drain."""
+        self._recv_callback = cb
+
+    def SetConnectCallback(self, success_cb, fail_cb) -> None:
+        self._connect_success_cb = success_cb
+        self._connect_fail_cb = fail_cb
+
+    def SetCloseCallbacks(self, normal_cb, error_cb) -> None:
+        self._close_cb = normal_cb
+        self._close_error_cb = error_cb
+
+    def SetAcceptCallback(self, request_cb, new_connection_cb) -> None:
+        self._accept_request_cb = request_cb
+        self._new_connection_cb = new_connection_cb
+
+    def SetDataSentCallback(self, cb) -> None:
+        self._data_sent_cb = cb
+
+    def SetSendCallback(self, cb) -> None:
+        """cb(socket, available_bytes) — send buffer space available."""
+        self._send_cb = cb
+
+    # --- API (subclasses implement) ---
+    def Bind(self, address=None) -> int:
+        raise NotImplementedError
+
+    def Connect(self, address) -> int:
+        raise NotImplementedError
+
+    def Listen(self) -> int:
+        raise NotImplementedError
+
+    def Send(self, packet, flags: int = 0) -> int:
+        raise NotImplementedError
+
+    def SendTo(self, packet, flags: int, to_address) -> int:
+        raise NotImplementedError
+
+    def Recv(self, max_size: int = 0xFFFFFFFF, flags: int = 0):
+        raise NotImplementedError
+
+    def RecvFrom(self, max_size: int = 0xFFFFFFFF, flags: int = 0):
+        """returns (packet, from_address) or (None, None)"""
+        raise NotImplementedError
+
+    def Close(self) -> int:
+        raise NotImplementedError
+
+    def ShutdownSend(self) -> int:
+        return 0
+
+    def ShutdownRecv(self) -> int:
+        return 0
+
+    def GetTxAvailable(self) -> int:
+        return 0xFFFFFFFF
+
+    def GetRxAvailable(self) -> int:
+        return 0
+
+    def BindToNetDevice(self, device) -> None:
+        self._bound_device = device
+
+    # --- helpers for subclasses ---
+    def NotifyDataRecv(self) -> None:
+        if self._recv_callback is not None:
+            self._recv_callback(self)
+
+    def NotifyConnectionSucceeded(self) -> None:
+        if self._connect_success_cb is not None:
+            self._connect_success_cb(self)
+
+    def NotifyConnectionFailed(self) -> None:
+        if self._connect_fail_cb is not None:
+            self._connect_fail_cb(self)
+
+    def NotifyNormalClose(self) -> None:
+        if self._close_cb is not None:
+            self._close_cb(self)
+
+    def NotifyErrorClose(self) -> None:
+        if self._close_error_cb is not None:
+            self._close_error_cb(self)
+
+    def NotifyConnectionRequest(self, from_address) -> bool:
+        if self._accept_request_cb is not None:
+            return self._accept_request_cb(self, from_address)
+        return True
+
+    def NotifyNewConnectionCreated(self, socket, from_address) -> None:
+        if self._new_connection_cb is not None:
+            self._new_connection_cb(socket, from_address)
+
+    def NotifyDataSent(self, size: int) -> None:
+        if self._data_sent_cb is not None:
+            self._data_sent_cb(self, size)
+
+    def NotifySend(self, available: int) -> None:
+        if self._send_cb is not None:
+            self._send_cb(self, available)
+
+
+class SocketFactory:
+    """Per-node socket creation seam (src/network/model/socket-factory.h):
+    ``Socket.CreateSocket(node, "tpudes::UdpSocketFactory")``."""
+
+    @staticmethod
+    def CreateSocket(node, factory_name: str) -> Socket:
+        if "Udp" in factory_name:
+            from tpudes.models.internet.udp import UdpL4Protocol
+
+            udp = node.GetObject(UdpL4Protocol)
+            if udp is None:
+                raise RuntimeError(f"node {node.GetId()} has no UDP stack installed")
+            return udp.CreateSocket()
+        if "Tcp" in factory_name:
+            from tpudes.models.internet.tcp import TcpL4Protocol
+
+            tcp = node.GetObject(TcpL4Protocol)
+            if tcp is None:
+                raise RuntimeError(f"node {node.GetId()} has no TCP stack installed")
+            return tcp.CreateSocket()
+        raise ValueError(f"unknown socket factory {factory_name!r}")
