@@ -1,0 +1,131 @@
+"""Propagation kernel validation vs float64 closed forms.
+
+Mirrors upstream's propagation-loss-model-test-suite.cc approach:
+analytic expected values, tolerance compares (SURVEY.md §4 — f32 vs f64
+tolerance discipline)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudes.ops import propagation as P
+
+C = 299792458.0
+
+
+def test_friis_matches_closed_form():
+    # expected values computed from the textbook formula at 5.15 GHz
+    f = 5.15e9
+    lam = C / f
+    for d in [10.0, 100.0, 1000.0]:
+        loss = -10 * math.log10(lam**2 / (16 * math.pi**2 * d**2))
+        got = float(P.friis(jnp.float32(20.0), jnp.float32(d), f))
+        assert got == pytest.approx(20.0 - loss, abs=1e-3)
+
+
+def test_friis_zero_distance_clamps_to_min_loss():
+    got = float(P.friis(jnp.float32(17.0), jnp.float32(0.0), min_loss_db=3.0))
+    assert got == pytest.approx(14.0, abs=1e-5)
+
+
+def test_log_distance_reference_point():
+    # at d = d0 the loss is exactly the reference loss
+    got = float(P.log_distance(jnp.float32(0.0), jnp.float32(1.0)))
+    assert got == pytest.approx(-P.DEFAULT_REFERENCE_LOSS_DB, abs=1e-4)
+    # one decade at exponent 3 adds 30 dB
+    got10 = float(P.log_distance(jnp.float32(0.0), jnp.float32(10.0)))
+    assert got10 == pytest.approx(-P.DEFAULT_REFERENCE_LOSS_DB - 30.0, abs=1e-3)
+
+
+def test_three_log_distance_slopes():
+    ref = P.DEFAULT_REFERENCE_LOSS_DB
+    # inside first segment: only exponent0 active
+    got = float(P.three_log_distance(jnp.float32(0.0), jnp.float32(100.0)))
+    assert got == pytest.approx(-(ref + 19.0 * math.log10(100.0)), abs=1e-3)
+    # beyond d2: all three slopes accumulate
+    d = 1000.0
+    expect = ref + 19.0 * math.log10(200.0) + 38.0 * math.log10(500.0 / 200.0) + 38.0 * math.log10(d / 500.0)
+    got = float(P.three_log_distance(jnp.float32(0.0), jnp.float32(d)))
+    assert got == pytest.approx(-expect, abs=1e-3)
+
+
+def test_two_ray_ground_crossover_continuity_regions():
+    f = 5.15e9
+    lam = C / f
+    ht = hr = 10.0
+    crossover = 4 * math.pi * ht * hr / lam
+    # far field: d^-4 law
+    d = 4 * crossover
+    expect = 10 * math.log10(ht**2 * hr**2 / d**4)
+    got = float(P.two_ray_ground(jnp.float32(0.0), jnp.float32(d), ht, hr, f))
+    assert got == pytest.approx(expect, abs=1e-3)
+    # near field equals Friis
+    d_near = crossover / 4
+    got_near = float(P.two_ray_ground(jnp.float32(0.0), jnp.float32(d_near), ht, hr, f))
+    friis = float(P.friis(jnp.float32(0.0), jnp.float32(d_near), f))
+    assert got_near == pytest.approx(friis, abs=1e-4)
+
+
+def test_range_loss_cuts_off():
+    d = jnp.array([100.0, 250.0, 251.0])
+    got = np.asarray(P.range_loss(jnp.float32(10.0), d, max_range=250.0))
+    assert got[0] == pytest.approx(10.0)
+    assert got[1] == pytest.approx(10.0)
+    assert got[2] < -900.0
+
+
+def test_nakagami_mean_preserves_power():
+    # Gamma(m, P/m) has mean P: the fading is unit-mean by construction
+    key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, 4000)
+    tx = jnp.float32(10.0)  # dBm → 10 mW
+    draws = jax.vmap(lambda k: P.nakagami(k, tx, jnp.float32(50.0)))(keys)
+    mean_w = float(jnp.mean(P.dbm_to_w(draws)))
+    assert mean_w == pytest.approx(0.01, rel=0.05)
+
+
+def test_pairwise_distance_and_delay():
+    pos = jnp.array([[0.0, 0.0, 0.0], [3.0, 4.0, 0.0], [0.0, 0.0, 12.0]])
+    d = np.asarray(P.pairwise_distance(pos))
+    assert d[0, 1] == pytest.approx(5.0)
+    assert d[0, 2] == pytest.approx(12.0)
+    assert d[1, 1] == pytest.approx(0.0)
+    delay = float(P.constant_speed_delay_s(jnp.float32(C)))
+    assert delay == pytest.approx(1.0)
+
+
+def test_models_are_jit_and_vmap_compatible():
+    d = jnp.linspace(1.0, 500.0, 64)
+    fn = jax.jit(lambda dd: P.log_distance(16.0, dd))
+    out = fn(d)
+    assert out.shape == (64,)
+    # batched over a replica axis of keys
+    keys = jax.random.split(jax.random.PRNGKey(1), 8)
+    out = jax.vmap(lambda k: P.nakagami(k, 16.0, d))(keys)
+    assert out.shape == (8, 64)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_chain_composition():
+    composed = P.chain(
+        lambda tx, d: P.log_distance(tx, d),
+        lambda tx, d: tx - 2.0,  # constant extra loss stage
+    )
+    base = float(P.log_distance(jnp.float32(5.0), jnp.float32(42.0)))
+    got = float(composed(jnp.float32(5.0), jnp.float32(42.0)))
+    assert got == pytest.approx(base - 2.0, abs=1e-5)
+
+
+def test_okumura_hata_monotone_in_distance():
+    d = jnp.array([200.0, 500.0, 1000.0, 5000.0])
+    rx = np.asarray(P.okumura_hata(jnp.float32(43.0), d))
+    assert np.all(np.diff(rx) < 0)
+
+
+def test_cost231_hata_small_vs_large_city():
+    rx_small = float(P.cost231_hata(jnp.float32(43.0), jnp.float32(1000.0)))
+    rx_large = float(P.cost231_hata(jnp.float32(43.0), jnp.float32(1000.0), large_city=True))
+    assert rx_large < rx_small  # large-city correction adds loss at 2 GHz

@@ -44,16 +44,17 @@ class InternetStackHelper:
             ipv4.Insert(udp)
             node.AggregateObject(udp)
             # TCP (src/internet/model/tcp-l4-protocol) is installed when
-            # available so sockets of both families work out of the box
-            try:
+            # available so sockets of both families work out of the box;
+            # probe for the module so a broken tcp.py still raises loudly
+            import importlib.util
+
+            if importlib.util.find_spec("tpudes.models.internet.tcp") is not None:
                 from tpudes.models.internet.tcp import TcpL4Protocol
 
                 tcp = TcpL4Protocol()
                 tcp.SetNode(node)
                 ipv4.Insert(tcp)
                 node.AggregateObject(tcp)
-            except ImportError:
-                pass
 
     InstallAll = Install
 
@@ -75,6 +76,14 @@ class Ipv4AddressHelper:
         self._next = self._base
 
     def NewAddress(self) -> Ipv4Address:
+        # exhaustion guard: never hand out the subnet broadcast address or
+        # bleed into the next subnet (upstream NS_ABORTs here too)
+        host_max = ~self._mask.mask & 0xFFFFFFFF
+        if self._next >= host_max:
+            raise RuntimeError(
+                f"Ipv4AddressHelper: address pool exhausted in "
+                f"{Ipv4Address(self._network)}/{self._mask.GetPrefixLength()}"
+            )
         addr = Ipv4Address(self._network | self._next)
         self._next += 1
         return addr

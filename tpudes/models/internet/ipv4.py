@@ -218,13 +218,13 @@ class Ipv4StaticRouting(Ipv4RoutingProtocol):
 
     def LookupRoute(self, dest: Ipv4Address):
         best = None
-        best_key = (-1, 1 << 30)  # (prefix_len, metric)
+        best_key = (-1, -(1 << 30))  # (prefix_len, -metric): longest prefix, then lowest metric
         for network, mask, gateway, if_index, metric in self.routes:
             if mask.IsMatch(dest, network):
                 key = (mask.GetPrefixLength(), -metric)
-                if key > (best_key[0], -best_key[1]):
+                if key > best_key:
                     best = (network, mask, gateway, if_index, metric)
-                    best_key = (mask.GetPrefixLength(), metric)
+                    best_key = key
         return best
 
     def RouteOutput(self, packet, header, oif=None):
@@ -359,7 +359,7 @@ class Ipv4L3Protocol(Object):
 
     # --- send path (SURVEY.md 3.1) ---
     def Send(self, packet, source: Ipv4Address, destination: Ipv4Address, protocol: int, route: Ipv4Route = None):
-        self._ident += 1
+        self._ident = (self._ident + 1) & 0xFFFF  # uint16_t wrap, as upstream
         header = Ipv4Header(
             source=source,
             destination=destination,
@@ -432,6 +432,9 @@ class Ipv4L3Protocol(Object):
         if_index = getattr(route, "if_index", None)
         if if_index is None:
             if_index = self.GetInterfaceForDevice(route.output_device)
+        if not self.interfaces[if_index].IsUp():
+            self.drop(header, packet, self.DROP_INTERFACE_DOWN)
+            return
         self.unicast_forward(header, packet, if_index)
         packet.AddHeader(header)
         self.tx(packet, if_index)

@@ -1,0 +1,32 @@
+"""Pure JAX kernels — the lifted math segments of the reference's hot
+loops (SURVEY.md §3.2, §3.4): propagation, WiFi error rates,
+interference chunking, spectrum/LTE RB math.
+
+Everything here is side-effect free, static-shaped, and composable under
+jit / vmap / shard_map; hosts pack state into tensors, call these, and
+turn the results back into events (SURVEY.md §7 design stance).
+"""
+
+from tpudes.ops import propagation
+from tpudes.ops import wifi_error
+from tpudes.ops import interference
+from tpudes.ops.propagation import (
+    distance,
+    pairwise_distance,
+    dbm_to_w,
+    w_to_dbm,
+    friis,
+    log_distance,
+    three_log_distance,
+    two_ray_ground,
+    nakagami,
+    constant_speed_delay_s,
+)
+from tpudes.ops.wifi_error import (
+    WifiMode,
+    ALL_MODES,
+    MODES_BY_NAME,
+    chunk_success_rate,
+    mode_chunk_success_rate,
+)
+from tpudes.ops.interference import frame_success_rate, batch_frame_success_rate, thermal_noise_w

@@ -1,0 +1,84 @@
+"""Interference chunk-SNR / PER kernel.
+
+Reference parity: src/wifi/model/interference-helper.{h,cc} (upstream
+path; mount empty at survey — SURVEY.md §0).  Upstream tracks overlapping
+signals as noise-interference events and splits a received PPDU into SNR
+"chunks" at each event boundary, multiplying per-chunk success
+probabilities into a packet success rate (SURVEY.md §3.2).
+
+TPU-first design: per received frame we carry a FIXED number K of
+candidate interferers (padded + masked).  2K+2 boundary times → 2K+1
+chunks, all static shapes: sort, midpoint-test activity, elementwise
+success, product.  One frame is one row; vmap gives the
+(frame × replica) batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpudes.ops.wifi_error import mode_chunk_success_rate
+
+BOLTZMANN = 1.380649e-23
+
+
+def thermal_noise_w(bandwidth_hz, noise_figure_db=7.0, temperature_k=290.0):
+    """Noise floor in watts: F · k·T·B (WifiPhy::SetNoiseFigure math)."""
+    nt = BOLTZMANN * temperature_k * bandwidth_hz
+    return 10.0 ** (noise_figure_db / 10.0) * nt
+
+
+def frame_success_rate(
+    signal_w: jax.Array,        # () received frame power in W
+    frame_start: jax.Array,     # () frame start time (s, or any unit)
+    frame_end: jax.Array,       # () frame end
+    mode_index: jax.Array,      # () int32 WifiMode id
+    data_rate_bps: jax.Array,   # () PHY data rate (bits/s of payload)
+    noise_w: jax.Array,         # () noise floor in W
+    int_power_w: jax.Array,     # (K,) interferer powers
+    int_start: jax.Array,       # (K,) interferer start times
+    int_end: jax.Array,         # (K,) interferer end times
+    int_mask: jax.Array,        # (K,) 1.0 = real interferer, 0.0 = padding
+) -> jax.Array:
+    """Packet success probability of one frame under K padded interferers.
+
+    Mirrors InterferenceHelper::CalculatePayloadPer: chunked SNR between
+    interference-event boundaries, per-chunk NIST success, product.
+    """
+    # clip interferer intervals to the frame, padding collapses to empty
+    s = jnp.clip(int_start, frame_start, frame_end)
+    e = jnp.clip(int_end, frame_start, frame_end)
+    s = jnp.where(int_mask > 0, s, frame_start)
+    e = jnp.where(int_mask > 0, e, frame_start)
+
+    bounds = jnp.concatenate(
+        [jnp.stack([frame_start, frame_end]), s, e]
+    )  # (2K+2,)
+    bounds = jnp.sort(bounds)
+    c_start = bounds[:-1]                       # (2K+1,)
+    c_end = bounds[1:]
+    dur = jnp.maximum(c_end - c_start, 0.0)
+    mid = 0.5 * (c_start + c_end)               # (2K+1,)
+
+    # interference active at each chunk midpoint: (2K+1, K) → (2K+1,)
+    active = (
+        (int_start[None, :] <= mid[:, None])
+        & (mid[:, None] < int_end[None, :])
+        & (int_mask[None, :] > 0)
+    )
+    i_w = jnp.sum(jnp.where(active, int_power_w[None, :], 0.0), axis=-1)
+
+    snr = signal_w / (noise_w + i_w)
+    nbits = data_rate_bps * dur
+    succ = mode_chunk_success_rate(snr, nbits, mode_index)
+    # zero-length chunks contribute success=1 (nbits=0 ⇒ (1-pe)^0)
+    return jnp.prod(jnp.where(dur > 0, succ, 1.0))
+
+
+#: batched over frames: all args gain a leading frame axis
+batch_frame_success_rate = jax.vmap(frame_success_rate)
+
+
+def snr_db(signal_w: jax.Array, noise_w: jax.Array, interference_w: jax.Array = 0.0):
+    return 10.0 * jnp.log10(signal_w / (noise_w + interference_w))

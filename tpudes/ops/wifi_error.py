@@ -1,0 +1,226 @@
+"""NIST error-rate model as a batched TPU kernel.
+
+Reference parity: src/wifi/model/nist-error-rate-model.{h,cc} and the
+WifiMode/ WifiTxVector mode metadata in src/wifi/model/wifi-mode.{h,cc}
+(upstream paths; mount empty at survey — SURVEY.md §0).  The underlying
+math is public: per-modulation AWGN BER (erfc closed forms) and the
+union bound over the first ten terms of the K=7 convolutional code
+distance spectrum (Frenger/Haccoun–Bégin weight tables, as used by the
+NIST 802.11 model doc).
+
+TPU-first design: a *mode* is an integer index into constant arrays
+(constellation size, coding-rate class, data rate).  ``chunk_success_rate``
+is pure elementwise math over (snr, nbits, mode) arrays — vmapping it over
+a (tx × rx × chunk × replica) batch is the whole point (SURVEY.md §3.2:
+the NistErrorRateModel::GetChunkSuccessRate leaf of the WiFi hot path).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import erfc
+
+# --- coding-rate classes (bValue in upstream terms) ------------------------
+# index: 0 → rate 1/2 (b=1), 1 → rate 2/3 (b=2), 2 → rate 3/4 (b=3),
+#        3 → rate 5/6 (b=5)
+_B_FACTOR = jnp.array([1.0 / 2.0, 1.0 / 4.0, 1.0 / 6.0, 1.0 / 10.0])
+
+# union-bound distance-spectrum weights a_d and distances d for the K=7
+# convolutional code at each puncturing (first ten terms; rate 1/2 has
+# nine published terms, padded with zero)
+_PE_COEFFS = jnp.array(
+    [
+        # rate 1/2 (free distance 10)
+        [36.0, 211.0, 1404.0, 11633.0, 77433.0, 502690.0, 3322763.0,
+         21292910.0, 134365911.0, 0.0],
+        # rate 2/3 (free distance 6)
+        [3.0, 70.0, 285.0, 1276.0, 6160.0, 27128.0, 117019.0,
+         498860.0, 2103891.0, 8784123.0],
+        # rate 3/4 (free distance 5)
+        [42.0, 201.0, 1492.0, 10469.0, 62935.0, 379644.0, 2253373.0,
+         13073811.0, 75152755.0, 428005675.0],
+        # rate 5/6 (free distance 4)
+        [92.0, 528.0, 8694.0, 79453.0, 792114.0, 7375573.0, 67884974.0,
+         610875423.0, 5427275376.0, 47664215639.0],
+    ]
+)
+_PE_EXPONENTS = jnp.array(
+    [
+        [10.0, 12.0, 14.0, 16.0, 18.0, 20.0, 22.0, 24.0, 26.0, 28.0],
+        [6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0, 14.0, 15.0],
+        [5.0, 6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0, 14.0],
+        [4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0],
+    ]
+)
+
+RATE_1_2, RATE_2_3, RATE_3_4, RATE_5_6 = 0, 1, 2, 3
+
+
+def _qam_ber(snr: jax.Array, m: jax.Array) -> jax.Array:
+    """Gray-coded square M-QAM AWGN BER:
+    2(1-1/√M)/log2(M) · ½ erfc(√(3·snr / (2(M-1)))).
+    Reproduces upstream's Get16/64/256/1024QamBer closed forms."""
+    log2m = jnp.log2(m)
+    z = jnp.sqrt(3.0 * snr / (2.0 * (m - 1.0)))
+    return (2.0 * (1.0 - 1.0 / jnp.sqrt(m)) / log2m) * 0.5 * erfc(z)
+
+
+def uncoded_ber(snr: jax.Array, constellation: jax.Array) -> jax.Array:
+    """Per-bit AWGN error probability by constellation size.
+
+    BPSK (2): ½erfc(√snr); QPSK (4): ½erfc(√(snr/2)); M-QAM: closed form.
+    ``snr`` is linear per-symbol SNR, as in the upstream call convention.
+    """
+    constellation = jnp.asarray(constellation, dtype=snr.dtype)
+    bpsk = 0.5 * erfc(jnp.sqrt(snr))
+    qpsk = 0.5 * erfc(jnp.sqrt(snr / 2.0))
+    qam = _qam_ber(snr, jnp.maximum(constellation, 16.0))
+    return jnp.where(
+        constellation <= 2.0, bpsk, jnp.where(constellation <= 4.0, qpsk, qam)
+    )
+
+
+def coded_pe(ber: jax.Array, rate_class: jax.Array) -> jax.Array:
+    """First-event error probability union bound (CalculatePe): with
+    D = √(4p(1-p)), pe = factor(b) · Σ a_k D^e_k, clamped to [0, 1]."""
+    p = jnp.clip(ber, 0.0, 0.5)
+    d = jnp.sqrt(4.0 * p * (1.0 - p))
+    coeffs = _PE_COEFFS[rate_class]           # (..., 10)
+    exps = _PE_EXPONENTS[rate_class]          # (..., 10)
+    factor = _B_FACTOR[rate_class]
+    # stable evaluation: a_k D^e_k = exp(log a_k + e_k log D); D=0 → 0
+    log_d = jnp.log(jnp.maximum(d, 1e-35))
+    terms = jnp.where(
+        coeffs[..., :] > 0.0,
+        jnp.exp(jnp.log(jnp.maximum(coeffs, 1e-35)) + exps * log_d[..., None]),
+        0.0,
+    )
+    pe = factor * jnp.sum(terms, axis=-1)
+    return jnp.clip(pe, 0.0, 1.0)
+
+
+def chunk_success_rate(
+    snr: jax.Array, nbits: jax.Array, constellation: jax.Array, rate_class: jax.Array
+) -> jax.Array:
+    """NistErrorRateModel::GetChunkSuccessRate: (1 - pe)^nbits via the
+    numerically stable exp(nbits·log1p(-pe)) form."""
+    ber = uncoded_ber(snr, constellation)
+    pe = coded_pe(ber, rate_class)
+    pe = jnp.minimum(pe, 1.0 - 1e-12)
+    return jnp.exp(nbits * jnp.log1p(-pe))
+
+
+# --- mode registry ---------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class WifiMode:
+    """One entry of the WifiMode registry (wifi-mode.{h,cc} analog):
+    enough metadata for rate selection, duration math, and the error
+    kernel's (constellation, rate_class) lookup."""
+
+    name: str
+    index: int
+    constellation: int      # 2 BPSK, 4 QPSK, 16/64/256/1024 QAM
+    rate_class: int         # RATE_* above
+    data_rate_bps: int      # PHY data rate at 20 MHz, 800 ns GI, 1 SS
+    bits_per_symbol: float  # data bits per OFDM symbol (duration math)
+    standard: str = "ofdm"
+
+    def GetDataRate(self) -> int:
+        return self.data_rate_bps
+
+    def GetUniqueName(self) -> str:
+        return self.name
+
+
+def _ofdm_modes():
+    # 802.11a/g 20 MHz OFDM: 48 data subcarriers, 4 µs symbol
+    table = [
+        ("OfdmRate6Mbps", 2, RATE_1_2, 6e6),
+        ("OfdmRate9Mbps", 2, RATE_3_4, 9e6),
+        ("OfdmRate12Mbps", 4, RATE_1_2, 12e6),
+        ("OfdmRate18Mbps", 4, RATE_3_4, 18e6),
+        ("OfdmRate24Mbps", 16, RATE_1_2, 24e6),
+        ("OfdmRate36Mbps", 16, RATE_3_4, 36e6),
+        ("OfdmRate48Mbps", 64, RATE_2_3, 48e6),
+        ("OfdmRate54Mbps", 64, RATE_3_4, 54e6),
+    ]
+    return [
+        WifiMode(name, i, m, b, int(rate), rate * 4e-6)
+        for i, (name, m, b, rate) in enumerate(table)
+    ]
+
+
+def _ht_he_modes(start_index: int):
+    # HT/VHT/HE MCS ladder (1 SS, 20 MHz, long GI); HE rates use 13.6 µs
+    # symbols but the error-model metadata (constellation, rate) is what
+    # matters here — duration math uses bits_per_symbol.
+    ladder = [
+        ("HtMcs0", 2, RATE_1_2, 6.5e6),
+        ("HtMcs1", 4, RATE_1_2, 13e6),
+        ("HtMcs2", 4, RATE_3_4, 19.5e6),
+        ("HtMcs3", 16, RATE_1_2, 26e6),
+        ("HtMcs4", 16, RATE_3_4, 39e6),
+        ("HtMcs5", 64, RATE_2_3, 52e6),
+        ("HtMcs6", 64, RATE_3_4, 58.5e6),
+        ("HtMcs7", 64, RATE_5_6, 65e6),
+        ("VhtMcs8", 256, RATE_3_4, 78e6),
+        ("VhtMcs9", 256, RATE_5_6, 86.7e6),
+        ("HeMcs10", 1024, RATE_3_4, 97.5e6),
+        ("HeMcs11", 1024, RATE_5_6, 108.3e6),
+    ]
+    return [
+        WifiMode(name, start_index + i, m, b, int(rate), rate * 4e-6, standard="ht")
+        for i, (name, m, b, rate) in enumerate(ladder)
+    ]
+
+
+OFDM_MODES = _ofdm_modes()
+HT_MODES = _ht_he_modes(len(OFDM_MODES))
+ALL_MODES = OFDM_MODES + HT_MODES
+MODES_BY_NAME = {m.name: m for m in ALL_MODES}
+
+#: constant per-mode lookup arrays for the kernel side — index with the
+#: integer mode id carried in packed tx tensors
+MODE_CONSTELLATION = jnp.array([m.constellation for m in ALL_MODES], dtype=jnp.float32)
+MODE_RATE_CLASS = jnp.array([m.rate_class for m in ALL_MODES], dtype=jnp.int32)
+MODE_DATA_RATE = jnp.array([m.data_rate_bps for m in ALL_MODES], dtype=jnp.float32)
+
+
+def mode_chunk_success_rate(
+    snr: jax.Array, nbits: jax.Array, mode_index: jax.Array
+) -> jax.Array:
+    """Success rate with the mode resolved from the registry by index —
+    the form the window kernel uses on packed tensors."""
+    constellation = MODE_CONSTELLATION[mode_index]
+    rate_class = MODE_RATE_CLASS[mode_index]
+    return chunk_success_rate(snr, nbits, constellation, rate_class)
+
+
+# --- scalar host-side reference (float64, for tests & referee runs) --------
+
+
+def chunk_success_rate_py(snr: float, nbits: float, constellation: int, rate_class: int) -> float:
+    """Pure-Python float64 oracle mirroring the kernel; used by unit tests
+    as the tolerance reference (SURVEY.md §4: f32 vs f64 checks)."""
+    if constellation <= 2:
+        ber = 0.5 * math.erfc(math.sqrt(snr))
+    elif constellation <= 4:
+        ber = 0.5 * math.erfc(math.sqrt(snr / 2.0))
+    else:
+        m = float(constellation)
+        z = math.sqrt(3.0 * snr / (2.0 * (m - 1.0)))
+        ber = (2.0 * (1.0 - 1.0 / math.sqrt(m)) / math.log2(m)) * 0.5 * math.erfc(z)
+    p = min(max(ber, 0.0), 0.5)
+    d = math.sqrt(4.0 * p * (1.0 - p))
+    coeffs = [float(c) for c in _PE_COEFFS[rate_class]]
+    exps = [float(e) for e in _PE_EXPONENTS[rate_class]]
+    factor = float(_B_FACTOR[rate_class])
+    pe = factor * sum(c * d**e for c, e in zip(coeffs, exps) if c > 0)
+    pe = min(pe, 1.0 - 1e-12)
+    return math.exp(nbits * math.log1p(-pe))
