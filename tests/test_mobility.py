@@ -1,0 +1,157 @@
+"""Mobility model tests — mirrors upstream's mobility test suite style:
+closed-form kinematics checks, bounds containment, trace firing."""
+
+import math
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.models.mobility import (
+    CalculateDistance,
+    ConstantAccelerationMobilityModel,
+    ConstantPositionMobilityModel,
+    ConstantVelocityMobilityModel,
+    GaussMarkovMobilityModel,
+    GridPositionAllocator,
+    ListPositionAllocator,
+    MobilityHelper,
+    MobilityModel,
+    RandomDiscPositionAllocator,
+    RandomRectanglePositionAllocator,
+    RandomWalk2dMobilityModel,
+    RandomWaypointMobilityModel,
+    Vector,
+    WaypointMobilityModel,
+    positions_array,
+)
+from tpudes.network.node import Node
+
+
+def test_vector_math():
+    v = Vector(3, 4, 0)
+    assert v.GetLength() == pytest.approx(5.0)
+    assert CalculateDistance(Vector(1, 1, 1), Vector(1, 1, 1)) == 0.0
+    assert (Vector(1, 2, 3) + Vector(1, 1, 1)).tuple() == (2, 3, 4)
+
+
+def test_constant_velocity_closed_form():
+    m = ConstantVelocityMobilityModel()
+    m.SetPosition(Vector(0, 0, 0))
+    m.SetVelocity(Vector(1, 2, 0))
+    got = []
+    Simulator.Schedule(Seconds(2.5), lambda: got.append(m.GetPosition()))
+    Simulator.Run()
+    assert got[0].x == pytest.approx(2.5)
+    assert got[0].y == pytest.approx(5.0)
+
+
+def test_constant_acceleration():
+    m = ConstantAccelerationMobilityModel()
+    m.SetPosition(Vector(0, 0, 0))
+    m.SetVelocityAndAcceleration(Vector(1, 0, 0), Vector(2, 0, 0))
+    got = []
+    Simulator.Schedule(Seconds(3.0), lambda: got.append((m.GetPosition(), m.GetVelocity())))
+    Simulator.Run()
+    pos, vel = got[0]
+    assert pos.x == pytest.approx(1 * 3 + 0.5 * 2 * 9)  # 12
+    assert vel.x == pytest.approx(1 + 2 * 3)  # 7
+
+
+def test_course_change_trace_fires():
+    m = ConstantPositionMobilityModel()
+    hits = []
+    m.TraceConnectWithoutContext("CourseChange", lambda model: hits.append(model.GetPosition().x))
+    m.SetPosition(Vector(7, 0, 0))
+    assert hits == [7]
+
+
+def test_random_walk_stays_in_bounds():
+    m = RandomWalk2dMobilityModel(Bounds=(0.0, 20.0, 0.0, 20.0), Time=0.5, MinSpeed=5.0, MaxSpeed=10.0)
+    m.SetPosition(Vector(10, 10, 0))
+    samples = []
+
+    def sample():
+        p = m.GetPosition()
+        samples.append(p)
+
+    for i in range(1, 60):
+        Simulator.Schedule(Seconds(i * 0.25), sample)
+    Simulator.Stop(Seconds(16))
+    Simulator.Run()
+    assert len(samples) == 59
+    for p in samples:
+        assert -1e-6 <= p.x <= 20 + 1e-6 and -1e-6 <= p.y <= 20 + 1e-6
+    # it actually moved
+    assert max(CalculateDistance(samples[0], s) for s in samples) > 1.0
+
+
+def test_random_waypoint_reaches_waypoints():
+    alloc = ListPositionAllocator()
+    alloc.Add(Vector(10, 0, 0))
+    alloc.Add(Vector(0, 0, 0))
+    m = RandomWaypointMobilityModel(MinSpeed=1.0, MaxSpeed=1.0, Pause=0.5)
+    m.SetPositionAllocator(alloc)
+    m.SetPosition(Vector(0, 0, 0))
+    seen = []
+    # at t=10s it must have arrived at (10,0,0) and be pausing
+    Simulator.Schedule(Seconds(10.2), lambda: seen.append(m.GetPosition()))
+    Simulator.Stop(Seconds(11))
+    Simulator.Run()
+    assert seen[0].x == pytest.approx(10.0, abs=0.3)
+
+
+def test_gauss_markov_moves_and_stays_bounded():
+    m = GaussMarkovMobilityModel(Bounds=(0.0, 50.0, 0.0, 50.0, 0.0, 0.0), TimeStep=0.5, MeanVelocity=2.0)
+    m.SetPosition(Vector(25, 25, 0))
+    track = []
+    for i in range(1, 40):
+        Simulator.Schedule(Seconds(i * 0.5), lambda: track.append(m.GetPosition()))
+    Simulator.Stop(Seconds(21))
+    Simulator.Run()
+    assert max(CalculateDistance(track[0], p) for p in track) > 1.0
+
+
+def test_waypoint_interpolation():
+    m = WaypointMobilityModel()
+    m.AddWaypoint(Seconds(0), Vector(0, 0, 0))
+    m.AddWaypoint(Seconds(10), Vector(100, 0, 0))
+    got = []
+    Simulator.Schedule(Seconds(2.5), lambda: got.append((m.GetPosition().x, m.GetVelocity().x)))
+    Simulator.Run()
+    assert got[0][0] == pytest.approx(25.0)
+    assert got[0][1] == pytest.approx(10.0)
+
+
+def test_grid_allocator_row_first():
+    g = GridPositionAllocator(MinX=0.0, MinY=0.0, DeltaX=5.0, DeltaY=10.0, GridWidth=3)
+    pts = [g.GetNext() for _ in range(5)]
+    assert pts[0].tuple() == (0, 0, 0)
+    assert pts[2].tuple() == (10, 0, 0)
+    assert pts[3].tuple() == (0, 10, 0)  # wrapped to second row
+
+
+def test_random_allocators_in_region():
+    r = RandomRectanglePositionAllocator(MinX=1.0, MaxX=2.0, MinY=3.0, MaxY=4.0)
+    for _ in range(20):
+        p = r.GetNext()
+        assert 1 <= p.x <= 2 and 3 <= p.y <= 4
+    d = RandomDiscPositionAllocator(X=10.0, Y=10.0, Rho=5.0)
+    for _ in range(20):
+        p = d.GetNext()
+        assert CalculateDistance(p, Vector(10, 10, 0)) <= 5.0 + 1e-9
+
+
+def test_mobility_helper_install_and_positions_array():
+    nodes = [Node(), Node(), Node()]
+    helper = MobilityHelper()
+    helper.SetPositionAllocator(
+        "tpudes::GridPositionAllocator", MinX=0.0, MinY=0.0, DeltaX=2.0, DeltaY=2.0, GridWidth=2
+    )
+    helper.SetMobilityModel("ns3::ConstantPositionMobilityModel")  # ns3:: alias accepted
+    helper.Install(nodes)
+    for node in nodes:
+        assert node.GetObject(MobilityModel) is not None
+    arr = positions_array(nodes)
+    assert arr.shape == (3, 3)
+    assert arr[1][0] == pytest.approx(2.0)
+    assert arr[2][1] == pytest.approx(2.0)

@@ -26,8 +26,11 @@ class InternetStackHelper:
         self._routing_factory = routing_helper
 
     def Install(self, nodes) -> None:
+        import importlib.util
+
         if not isinstance(nodes, (NodeContainer, list, tuple)):
             nodes = [nodes]
+        have_tcp = importlib.util.find_spec("tpudes.models.internet.tcp") is not None
         for node in nodes:
             if node.GetObject(Ipv4L3Protocol) is not None:
                 continue  # already installed
@@ -45,10 +48,8 @@ class InternetStackHelper:
             node.AggregateObject(udp)
             # TCP (src/internet/model/tcp-l4-protocol) is installed when
             # available so sockets of both families work out of the box;
-            # probe for the module so a broken tcp.py still raises loudly
-            import importlib.util
-
-            if importlib.util.find_spec("tpudes.models.internet.tcp") is not None:
+            # the spec probe (above) lets a broken tcp.py raise loudly
+            if have_tcp:
                 from tpudes.models.internet.tcp import TcpL4Protocol
 
                 tcp = TcpL4Protocol()

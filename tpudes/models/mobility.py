@@ -1,0 +1,696 @@
+"""Mobility models, position allocators, and the mobility helper.
+
+Reference parity: src/mobility/model/mobility-model.{h,cc},
+constant-position-, constant-velocity-, constant-acceleration-,
+random-walk-2d-, random-waypoint-, gauss-markov-, waypoint-mobility-model,
+position-allocator.{h,cc}, helper/mobility-helper.{h,cc} (upstream paths;
+mount empty at survey — SURVEY.md §0).
+
+TPU-first twist: every model answers ``GetPosition()`` lazily from closed
+form state (no per-tick update events for the kinematic models, same as
+upstream), and :func:`positions_array` gathers a node batch into one
+``(N, 3)`` float32 array — the geometry input of the propagation kernels
+(SURVEY.md §7 step 5).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from tpudes.core.nstime import Seconds, Time
+from tpudes.core.object import Object, TypeId
+from tpudes.core.rng import UniformRandomVariable
+from tpudes.core.simulator import Simulator
+
+
+@dataclass
+class Vector:
+    """ns-3 Vector3D."""
+
+    x: float = 0.0
+    y: float = 0.0
+    z: float = 0.0
+
+    def __add__(self, o):
+        return Vector(self.x + o.x, self.y + o.y, self.z + o.z)
+
+    def __sub__(self, o):
+        return Vector(self.x - o.x, self.y - o.y, self.z - o.z)
+
+    def __mul__(self, s: float):
+        return Vector(self.x * s, self.y * s, self.z * s)
+
+    __rmul__ = __mul__
+
+    def GetLength(self) -> float:
+        return math.sqrt(self.x * self.x + self.y * self.y + self.z * self.z)
+
+    def tuple(self):
+        return (self.x, self.y, self.z)
+
+
+def CalculateDistance(a: Vector, b: Vector) -> float:
+    return (a - b).GetLength()
+
+
+class MobilityModel(Object):
+    """Abstract mobility model; ``CourseChange`` is the canonical trace
+    source (mobility-model.cc)."""
+
+    tid = (
+        TypeId("tpudes::MobilityModel")
+        .AddTraceSource("CourseChange", "position/velocity changed (model)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+
+    # public API (upstream names)
+    def GetPosition(self) -> Vector:
+        return self.DoGetPosition()
+
+    def SetPosition(self, position: Vector) -> None:
+        self.DoSetPosition(position)
+
+    def GetVelocity(self) -> Vector:
+        return self.DoGetVelocity()
+
+    def GetDistanceFrom(self, other: "MobilityModel") -> float:
+        return CalculateDistance(self.GetPosition(), other.GetPosition())
+
+    def GetRelativeSpeed(self, other: "MobilityModel") -> float:
+        return (self.GetVelocity() - other.GetVelocity()).GetLength()
+
+    def NotifyCourseChange(self) -> None:
+        self.course_change(self)
+
+    # subclass hooks
+    def DoGetPosition(self) -> Vector:
+        raise NotImplementedError
+
+    def DoSetPosition(self, position: Vector) -> None:
+        raise NotImplementedError
+
+    def DoGetVelocity(self) -> Vector:
+        return Vector()
+
+
+class ConstantPositionMobilityModel(MobilityModel):
+    tid = (
+        TypeId("tpudes::ConstantPositionMobilityModel")
+        .SetParent(MobilityModel.tid)
+        .AddConstructor(lambda **kw: ConstantPositionMobilityModel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._position = Vector()
+
+    def DoGetPosition(self) -> Vector:
+        return self._position
+
+    def DoSetPosition(self, position: Vector) -> None:
+        self._position = position
+        self.NotifyCourseChange()
+
+
+class ConstantVelocityMobilityModel(MobilityModel):
+    """Closed-form kinematics: p(t) = p0 + v·(t - t0)
+    (constant-velocity-helper.cc semantics)."""
+
+    tid = (
+        TypeId("tpudes::ConstantVelocityMobilityModel")
+        .SetParent(MobilityModel.tid)
+        .AddConstructor(lambda **kw: ConstantVelocityMobilityModel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._base_position = Vector()
+        self._velocity = Vector()
+        self._base_time = 0
+
+    def _elapsed_s(self) -> float:
+        return Time(Simulator.NowTicks() - self._base_time).GetSeconds()
+
+    def DoGetPosition(self) -> Vector:
+        return self._base_position + self._velocity * self._elapsed_s()
+
+    def DoSetPosition(self, position: Vector) -> None:
+        self._base_position = position
+        self._base_time = Simulator.NowTicks()
+        self.NotifyCourseChange()
+
+    def SetVelocity(self, velocity: Vector) -> None:
+        self._base_position = self.DoGetPosition()
+        self._base_time = Simulator.NowTicks()
+        self._velocity = velocity
+        self.NotifyCourseChange()
+
+    def DoGetVelocity(self) -> Vector:
+        return self._velocity
+
+
+class ConstantAccelerationMobilityModel(MobilityModel):
+    """p(t) = p0 + v0·dt + ½a·dt² (constant-acceleration-mobility-model.cc)."""
+
+    tid = (
+        TypeId("tpudes::ConstantAccelerationMobilityModel")
+        .SetParent(MobilityModel.tid)
+        .AddConstructor(lambda **kw: ConstantAccelerationMobilityModel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._base_position = Vector()
+        self._velocity = Vector()
+        self._acceleration = Vector()
+        self._base_time = 0
+
+    def SetVelocityAndAcceleration(self, velocity: Vector, acceleration: Vector) -> None:
+        self._base_position = self.DoGetPosition()
+        self._base_time = Simulator.NowTicks()
+        self._velocity = velocity
+        self._acceleration = acceleration
+        self.NotifyCourseChange()
+
+    def DoGetPosition(self) -> Vector:
+        dt = Time(Simulator.NowTicks() - self._base_time).GetSeconds()
+        return (
+            self._base_position
+            + self._velocity * dt
+            + self._acceleration * (0.5 * dt * dt)
+        )
+
+    def DoSetPosition(self, position: Vector) -> None:
+        self._base_position = position
+        self._base_time = Simulator.NowTicks()
+        self.NotifyCourseChange()
+
+    def DoGetVelocity(self) -> Vector:
+        dt = Time(Simulator.NowTicks() - self._base_time).GetSeconds()
+        return self._velocity + self._acceleration * dt
+
+
+class RandomWalk2dMobilityModel(MobilityModel):
+    """2D random walk in a rectangle: pick direction+speed, walk for
+    Mode=Time (default 1 s) or Mode=Distance, reflect off bounds
+    (random-walk-2d-mobility-model.cc)."""
+
+    MODE_TIME = 0
+    MODE_DISTANCE = 1
+
+    tid = (
+        TypeId("tpudes::RandomWalk2dMobilityModel")
+        .SetParent(MobilityModel.tid)
+        .AddConstructor(lambda **kw: RandomWalk2dMobilityModel(**kw))
+        .AddAttribute("Bounds", "rectangle (xmin,xmax,ymin,ymax)", (0.0, 100.0, 0.0, 100.0), field="bounds")
+        .AddAttribute("Time", "walk segment duration (s)", 1.0, field="segment_s")
+        .AddAttribute("Distance", "walk segment length (m)", 0.0, field="segment_m")
+        .AddAttribute("Mode", "Time|Distance", 0, field="mode")
+        .AddAttribute("MinSpeed", "uniform speed low (m/s)", 2.0, field="min_speed")
+        .AddAttribute("MaxSpeed", "uniform speed high (m/s)", 4.0, field="max_speed")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._position = Vector()
+        self._velocity = Vector()
+        self._base_time = 0
+        self._event = None
+        self._segment_left_s = 0.0
+        self._speed_rv = UniformRandomVariable(Min=self.min_speed, Max=self.max_speed)
+        self._dir_rv = UniformRandomVariable(Min=0.0, Max=2 * math.pi)
+        self._started = False
+
+    def _now_position(self) -> Vector:
+        dt = Time(Simulator.NowTicks() - self._base_time).GetSeconds()
+        return self._position + self._velocity * dt
+
+    def _start(self):
+        """Begin a fresh segment: new random speed + direction."""
+        self._started = True
+        self._position = self._now_position()
+        self._base_time = Simulator.NowTicks()
+        speed = self._speed_rv.GetValue()
+        direction = self._dir_rv.GetValue()
+        self._velocity = Vector(speed * math.cos(direction), speed * math.sin(direction), 0.0)
+        if self.mode == self.MODE_DISTANCE and self.segment_m > 0:
+            self._segment_left_s = self.segment_m / max(speed, 1e-9)
+        else:
+            self._segment_left_s = self.segment_s
+        self._walk()
+
+    def _walk(self):
+        """Walk until the segment ends or a wall is hit, whichever is
+        first (upstream DoWalk schedules the boundary-intersection
+        event and rebounds for the remainder of the segment)."""
+        delay_s = min(self._segment_left_s, self._time_to_boundary())
+        self._segment_left_s -= delay_s
+        self.NotifyCourseChange()
+        self._event = Simulator.Schedule(Seconds(delay_s), self._step)
+
+    def _time_to_boundary(self) -> float:
+        xmin, xmax, ymin, ymax = self.bounds
+        t = float("inf")
+        if self._velocity.x > 1e-12:
+            t = min(t, (xmax - self._position.x) / self._velocity.x)
+        elif self._velocity.x < -1e-12:
+            t = min(t, (xmin - self._position.x) / self._velocity.x)
+        if self._velocity.y > 1e-12:
+            t = min(t, (ymax - self._position.y) / self._velocity.y)
+        elif self._velocity.y < -1e-12:
+            t = min(t, (ymin - self._position.y) / self._velocity.y)
+        return max(t, 0.0)
+
+    def _step(self):
+        pos = self._now_position()
+        self._position = pos
+        self._base_time = Simulator.NowTicks()
+        if self._segment_left_s <= 0:
+            self._start()  # segment exhausted: draw a new direction
+            return
+        # wall hit mid-segment: snap to the wall, rebound, finish the
+        # segment.  eps absorbs float error + integer-tick rounding of the
+        # boundary-crossing delay (a micron at walking speeds).
+        xmin, xmax, ymin, ymax = self.bounds
+        vx, vy = self._velocity.x, self._velocity.y
+        eps = 1e-6
+        if pos.x <= xmin + eps and vx < 0:
+            pos.x, vx = xmin, -vx
+        elif pos.x >= xmax - eps and vx > 0:
+            pos.x, vx = xmax, -vx
+        if pos.y <= ymin + eps and vy < 0:
+            pos.y, vy = ymin, -vy
+        elif pos.y >= ymax - eps and vy > 0:
+            pos.y, vy = ymax, -vy
+        self._position = pos
+        self._velocity = Vector(vx, vy, 0.0)
+        self._walk()
+
+    def DoGetPosition(self) -> Vector:
+        return self._now_position() if self._started else self._position
+
+    def DoSetPosition(self, position: Vector) -> None:
+        self._position = position
+        self._base_time = Simulator.NowTicks()
+        if not self._started:
+            # first placement starts the walk (upstream DoInitialize)
+            Simulator.ScheduleNow(self._start)
+        self.NotifyCourseChange()
+
+    def DoGetVelocity(self) -> Vector:
+        return self._velocity
+
+
+class RandomWaypointMobilityModel(MobilityModel):
+    """Pick a random waypoint, travel at a random speed, pause, repeat
+    (random-waypoint-mobility-model.cc)."""
+
+    tid = (
+        TypeId("tpudes::RandomWaypointMobilityModel")
+        .SetParent(MobilityModel.tid)
+        .AddConstructor(lambda **kw: RandomWaypointMobilityModel(**kw))
+        .AddAttribute("MinSpeed", "uniform speed low (m/s)", 0.3, field="min_speed")
+        .AddAttribute("MaxSpeed", "uniform speed high (m/s)", 0.7, field="max_speed")
+        .AddAttribute("Pause", "pause at each waypoint (s)", 2.0, field="pause_s")
+    )
+
+    def __init__(self, position_allocator=None, **attributes):
+        super().__init__(**attributes)
+        self._position = Vector()
+        self._velocity = Vector()
+        self._base_time = 0
+        self._allocator = position_allocator
+        self._speed_rv = UniformRandomVariable(Min=self.min_speed, Max=self.max_speed)
+        self._started = False
+
+    def SetPositionAllocator(self, allocator) -> None:
+        self._allocator = allocator
+
+    def _now_position(self) -> Vector:
+        dt = Time(Simulator.NowTicks() - self._base_time).GetSeconds()
+        return self._position + self._velocity * dt
+
+    def _begin_pause(self):
+        self._position = self._now_position()
+        self._base_time = Simulator.NowTicks()
+        self._velocity = Vector()
+        self.NotifyCourseChange()
+        Simulator.Schedule(Seconds(self.pause_s), self._begin_walk)
+
+    def _begin_walk(self):
+        self._started = True
+        destination = self._allocator.GetNext()
+        self._position = self._now_position()
+        self._base_time = Simulator.NowTicks()
+        delta = destination - self._position
+        dist = delta.GetLength()
+        speed = self._speed_rv.GetValue()
+        if dist < 1e-9 or speed < 1e-9:
+            Simulator.Schedule(Seconds(self.pause_s), self._begin_walk)
+            return
+        self._velocity = delta * (speed / dist)
+        self.NotifyCourseChange()
+        Simulator.Schedule(Seconds(dist / speed), self._begin_pause)
+
+    def DoGetPosition(self) -> Vector:
+        return self._now_position() if self._started else self._position
+
+    def DoSetPosition(self, position: Vector) -> None:
+        self._position = position
+        self._base_time = Simulator.NowTicks()
+        if not self._started and self._allocator is not None:
+            Simulator.ScheduleNow(self._begin_walk)
+        self.NotifyCourseChange()
+
+    def DoGetVelocity(self) -> Vector:
+        return self._velocity
+
+
+class GaussMarkovMobilityModel(MobilityModel):
+    """Gauss-Markov: speed/direction follow an AR(1) with memory alpha
+    (gauss-markov-mobility-model.cc). 3D bounds, fixed timestep."""
+
+    tid = (
+        TypeId("tpudes::GaussMarkovMobilityModel")
+        .SetParent(MobilityModel.tid)
+        .AddConstructor(lambda **kw: GaussMarkovMobilityModel(**kw))
+        .AddAttribute("Bounds", "(xmin,xmax,ymin,ymax,zmin,zmax)", (0.0, 150.0, 0.0, 150.0, 0.0, 0.0), field="bounds")
+        .AddAttribute("TimeStep", "update period (s)", 1.0, field="timestep_s")
+        .AddAttribute("Alpha", "memory 0..1", 0.85, field="alpha")
+        .AddAttribute("MeanVelocity", "asymptotic mean speed (m/s)", 1.0, field="mean_velocity")
+        .AddAttribute("MeanDirection", "asymptotic mean direction (rad)", 0.0, field="mean_direction")
+        .AddAttribute("NormalVelocity", "gaussian sigma of speed", 0.5, field="sigma_velocity")
+        .AddAttribute("NormalDirection", "gaussian sigma of direction", 0.5, field="sigma_direction")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        from tpudes.core.rng import NormalRandomVariable
+
+        self._position = Vector()
+        self._velocity = Vector()
+        self._speed = self.mean_velocity
+        self._direction = self.mean_direction
+        self._base_time = 0
+        self._gauss = NormalRandomVariable(Mean=0.0, Variance=1.0)
+        self._started = False
+
+    def _now_position(self) -> Vector:
+        dt = Time(Simulator.NowTicks() - self._base_time).GetSeconds()
+        return self._position + self._velocity * dt
+
+    def _step(self):
+        self._started = True
+        a = self.alpha
+        one = math.sqrt(1.0 - a * a)
+        self._speed = (
+            a * self._speed
+            + (1 - a) * self.mean_velocity
+            + one * self.sigma_velocity * self._gauss.GetValue()
+        )
+        self._direction = (
+            a * self._direction
+            + (1 - a) * self.mean_direction
+            + one * self.sigma_direction * self._gauss.GetValue()
+        )
+        self._position = self._now_position()
+        self._base_time = Simulator.NowTicks()
+        self._velocity = Vector(
+            self._speed * math.cos(self._direction),
+            self._speed * math.sin(self._direction),
+            0.0,
+        )
+        # reflect at bounds
+        xmin, xmax, ymin, ymax, _, _ = self.bounds
+        p = self._position
+        if p.x < xmin or p.x > xmax:
+            self._velocity.x = -self._velocity.x
+            self._direction = math.pi - self._direction
+        if p.y < ymin or p.y > ymax:
+            self._velocity.y = -self._velocity.y
+            self._direction = -self._direction
+        self.NotifyCourseChange()
+        Simulator.Schedule(Seconds(self.timestep_s), self._step)
+
+    def DoGetPosition(self) -> Vector:
+        return self._now_position() if self._started else self._position
+
+    def DoSetPosition(self, position: Vector) -> None:
+        self._position = position
+        self._base_time = Simulator.NowTicks()
+        if not self._started:
+            Simulator.ScheduleNow(self._step)
+        self.NotifyCourseChange()
+
+    def DoGetVelocity(self) -> Vector:
+        return self._velocity
+
+
+class WaypointMobilityModel(MobilityModel):
+    """Scripted (time, position) waypoints with linear interpolation
+    (waypoint-mobility-model.cc)."""
+
+    tid = (
+        TypeId("tpudes::WaypointMobilityModel")
+        .SetParent(MobilityModel.tid)
+        .AddConstructor(lambda **kw: WaypointMobilityModel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._waypoints: list[tuple[int, Vector]] = []  # (ticks, pos) sorted
+
+    def AddWaypoint(self, when: Time, position: Vector) -> None:
+        ticks = Time(when).ticks
+        if self._waypoints and ticks < self._waypoints[-1][0]:
+            raise ValueError("waypoints must be added in time order")
+        self._waypoints.append((ticks, position))
+
+    def DoGetPosition(self) -> Vector:
+        now = Simulator.NowTicks()
+        wp = self._waypoints
+        if not wp:
+            return Vector()
+        if now <= wp[0][0]:
+            return wp[0][1]
+        if now >= wp[-1][0]:
+            return wp[-1][1]
+        for (t0, p0), (t1, p1) in zip(wp, wp[1:]):
+            if t0 <= now <= t1:
+                frac = (now - t0) / max(t1 - t0, 1)
+                return p0 + (p1 - p0) * frac
+        return wp[-1][1]
+
+    def DoSetPosition(self, position: Vector) -> None:
+        self._waypoints = [(Simulator.NowTicks(), position)]
+        self.NotifyCourseChange()
+
+    def DoGetVelocity(self) -> Vector:
+        now = Simulator.NowTicks()
+        for (t0, p0), (t1, p1) in zip(self._waypoints, self._waypoints[1:]):
+            if t0 <= now < t1:
+                dt = Time(t1 - t0).GetSeconds()
+                return (p1 - p0) * (1.0 / dt) if dt > 0 else Vector()
+        return Vector()
+
+
+# --- position allocators ---------------------------------------------------
+
+
+class PositionAllocator(Object):
+    tid = TypeId("tpudes::PositionAllocator")
+
+    def GetNext(self) -> Vector:
+        raise NotImplementedError
+
+
+class ListPositionAllocator(PositionAllocator):
+    tid = (
+        TypeId("tpudes::ListPositionAllocator")
+        .SetParent(PositionAllocator.tid)
+        .AddConstructor(lambda **kw: ListPositionAllocator(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._positions: list[Vector] = []
+        self._next = 0
+
+    def Add(self, position: Vector) -> None:
+        self._positions.append(position)
+
+    def GetNext(self) -> Vector:
+        pos = self._positions[self._next % len(self._positions)]
+        self._next += 1
+        return pos
+
+
+class GridPositionAllocator(PositionAllocator):
+    ROW_FIRST = 0
+    COLUMN_FIRST = 1
+
+    tid = (
+        TypeId("tpudes::GridPositionAllocator")
+        .SetParent(PositionAllocator.tid)
+        .AddConstructor(lambda **kw: GridPositionAllocator(**kw))
+        .AddAttribute("MinX", "x of first node", 0.0, field="min_x")
+        .AddAttribute("MinY", "y of first node", 0.0, field="min_y")
+        .AddAttribute("Z", "z of all nodes", 0.0, field="z")
+        .AddAttribute("DeltaX", "x spacing", 1.0, field="delta_x")
+        .AddAttribute("DeltaY", "y spacing", 1.0, field="delta_y")
+        .AddAttribute("GridWidth", "nodes per row/column", 10, field="grid_width")
+        .AddAttribute("LayoutType", "RowFirst|ColumnFirst", 0, field="layout")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._current = 0
+
+    def GetNext(self) -> Vector:
+        i = self._current
+        self._current += 1
+        if self.layout == self.ROW_FIRST:
+            col, row = i % self.grid_width, i // self.grid_width
+        else:
+            row, col = i % self.grid_width, i // self.grid_width
+        return Vector(self.min_x + col * self.delta_x, self.min_y + row * self.delta_y, self.z)
+
+
+class RandomRectanglePositionAllocator(PositionAllocator):
+    tid = (
+        TypeId("tpudes::RandomRectanglePositionAllocator")
+        .SetParent(PositionAllocator.tid)
+        .AddConstructor(lambda **kw: RandomRectanglePositionAllocator(**kw))
+        .AddAttribute("MinX", "", 0.0, field="min_x")
+        .AddAttribute("MaxX", "", 1.0, field="max_x")
+        .AddAttribute("MinY", "", 0.0, field="min_y")
+        .AddAttribute("MaxY", "", 1.0, field="max_y")
+        .AddAttribute("Z", "", 0.0, field="z")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._x = UniformRandomVariable(Min=self.min_x, Max=self.max_x)
+        self._y = UniformRandomVariable(Min=self.min_y, Max=self.max_y)
+
+    def GetNext(self) -> Vector:
+        return Vector(self._x.GetValue(), self._y.GetValue(), self.z)
+
+
+class RandomDiscPositionAllocator(PositionAllocator):
+    tid = (
+        TypeId("tpudes::RandomDiscPositionAllocator")
+        .SetParent(PositionAllocator.tid)
+        .AddConstructor(lambda **kw: RandomDiscPositionAllocator(**kw))
+        .AddAttribute("X", "disc center x", 0.0, field="cx")
+        .AddAttribute("Y", "disc center y", 0.0, field="cy")
+        .AddAttribute("Z", "", 0.0, field="z")
+        .AddAttribute("Rho", "disc radius", 200.0, field="rho")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._theta = UniformRandomVariable(Min=0.0, Max=2 * math.pi)
+        self._r = UniformRandomVariable(Min=0.0, Max=self.rho)
+
+    def GetNext(self) -> Vector:
+        theta, r = self._theta.GetValue(), self._r.GetValue()
+        return Vector(self.cx + r * math.cos(theta), self.cy + r * math.sin(theta), self.z)
+
+
+class RandomBoxPositionAllocator(PositionAllocator):
+    tid = (
+        TypeId("tpudes::RandomBoxPositionAllocator")
+        .SetParent(PositionAllocator.tid)
+        .AddConstructor(lambda **kw: RandomBoxPositionAllocator(**kw))
+        .AddAttribute("MinX", "", 0.0, field="min_x")
+        .AddAttribute("MaxX", "", 1.0, field="max_x")
+        .AddAttribute("MinY", "", 0.0, field="min_y")
+        .AddAttribute("MaxY", "", 1.0, field="max_y")
+        .AddAttribute("MinZ", "", 0.0, field="min_z")
+        .AddAttribute("MaxZ", "", 1.0, field="max_z")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._x = UniformRandomVariable(Min=self.min_x, Max=self.max_x)
+        self._y = UniformRandomVariable(Min=self.min_y, Max=self.max_y)
+        self._z = UniformRandomVariable(Min=self.min_z, Max=self.max_z)
+
+    def GetNext(self) -> Vector:
+        return Vector(self._x.GetValue(), self._y.GetValue(), self._z.GetValue())
+
+
+# --- helper ---------------------------------------------------------------
+
+
+class MobilityHelper:
+    """helper/mobility-helper.{h,cc}: configure allocator + model type,
+    Install over a container."""
+
+    _MODELS = {
+        "tpudes::ConstantPositionMobilityModel": ConstantPositionMobilityModel,
+        "tpudes::ConstantVelocityMobilityModel": ConstantVelocityMobilityModel,
+        "tpudes::ConstantAccelerationMobilityModel": ConstantAccelerationMobilityModel,
+        "tpudes::RandomWalk2dMobilityModel": RandomWalk2dMobilityModel,
+        "tpudes::RandomWaypointMobilityModel": RandomWaypointMobilityModel,
+        "tpudes::GaussMarkovMobilityModel": GaussMarkovMobilityModel,
+        "tpudes::WaypointMobilityModel": WaypointMobilityModel,
+    }
+
+    def __init__(self):
+        self._allocator = None
+        self._model_name = "tpudes::ConstantPositionMobilityModel"
+        self._model_kwargs: dict = {}
+
+    def SetPositionAllocator(self, allocator_or_name, **attributes):
+        if isinstance(allocator_or_name, str):
+            registry = {
+                "tpudes::ListPositionAllocator": ListPositionAllocator,
+                "tpudes::GridPositionAllocator": GridPositionAllocator,
+                "tpudes::RandomRectanglePositionAllocator": RandomRectanglePositionAllocator,
+                "tpudes::RandomDiscPositionAllocator": RandomDiscPositionAllocator,
+                "tpudes::RandomBoxPositionAllocator": RandomBoxPositionAllocator,
+            }
+            name = allocator_or_name.replace("ns3::", "tpudes::")
+            self._allocator = registry[name](**attributes)
+        else:
+            self._allocator = allocator_or_name
+        return self._allocator
+
+    def SetMobilityModel(self, name: str, **attributes):
+        self._model_name = name.replace("ns3::", "tpudes::")
+        if self._model_name not in self._MODELS:
+            raise ValueError(f"unknown mobility model {name!r}")
+        self._model_kwargs = attributes
+
+    def Install(self, nodes) -> None:
+        try:
+            iterator = iter(nodes)
+        except TypeError:
+            iterator = iter([nodes])
+        for node in iterator:
+            model = self._MODELS[self._model_name](**self._model_kwargs)
+            if isinstance(model, RandomWaypointMobilityModel) and self._allocator is not None:
+                model.SetPositionAllocator(self._allocator)
+            node.AggregateObject(model)
+            if self._allocator is not None:
+                model.SetPosition(self._allocator.GetNext())
+
+    InstallAll = Install
+
+
+def positions_array(nodes):
+    """Gather the mobility positions of a node batch into an (N, 3)
+    float32 array — the geometry input of the propagation kernels."""
+    import numpy as np
+
+    out = np.zeros((len(nodes), 3), dtype=np.float32)
+    for i, node in enumerate(nodes):
+        m = node.GetObject(MobilityModel)
+        if m is not None:
+            out[i] = m.GetPosition().tuple()
+    return out

@@ -154,7 +154,7 @@ def range_loss(
 ) -> jax.Array:
     """RangePropagationLossModel: full power within MaxRange, -1000 dBm
     beyond (upstream uses -1000 as 'nothing')."""
-    return jnp.where(d <= max_range, tx_power_dbm, tx_power_dbm - 1000.0)
+    return jnp.where(d <= max_range, tx_power_dbm, jnp.full_like(jnp.asarray(d), -1000.0))
 
 
 def matrix_loss(

@@ -27,35 +27,37 @@ from jax.scipy.special import erfc
 # --- coding-rate classes (bValue in upstream terms) ------------------------
 # index: 0 → rate 1/2 (b=1), 1 → rate 2/3 (b=2), 2 → rate 3/4 (b=3),
 #        3 → rate 5/6 (b=5)
-_B_FACTOR = jnp.array([1.0 / 2.0, 1.0 / 4.0, 1.0 / 6.0, 1.0 / 10.0])
+# Python lists are the float64 source of truth (used by the test oracle);
+# the jnp arrays the kernel reads are built from them below.
+B_FACTOR_TABLE = [1.0 / 2.0, 1.0 / 4.0, 1.0 / 6.0, 1.0 / 10.0]
 
 # union-bound distance-spectrum weights a_d and distances d for the K=7
 # convolutional code at each puncturing (first ten terms; rate 1/2 has
 # nine published terms, padded with zero)
-_PE_COEFFS = jnp.array(
-    [
-        # rate 1/2 (free distance 10)
-        [36.0, 211.0, 1404.0, 11633.0, 77433.0, 502690.0, 3322763.0,
-         21292910.0, 134365911.0, 0.0],
-        # rate 2/3 (free distance 6)
-        [3.0, 70.0, 285.0, 1276.0, 6160.0, 27128.0, 117019.0,
-         498860.0, 2103891.0, 8784123.0],
-        # rate 3/4 (free distance 5)
-        [42.0, 201.0, 1492.0, 10469.0, 62935.0, 379644.0, 2253373.0,
-         13073811.0, 75152755.0, 428005675.0],
-        # rate 5/6 (free distance 4)
-        [92.0, 528.0, 8694.0, 79453.0, 792114.0, 7375573.0, 67884974.0,
-         610875423.0, 5427275376.0, 47664215639.0],
-    ]
-)
-_PE_EXPONENTS = jnp.array(
-    [
-        [10.0, 12.0, 14.0, 16.0, 18.0, 20.0, 22.0, 24.0, 26.0, 28.0],
-        [6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0, 14.0, 15.0],
-        [5.0, 6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0, 14.0],
-        [4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0],
-    ]
-)
+PE_COEFFS_TABLE = [
+    # rate 1/2 (free distance 10)
+    [36.0, 211.0, 1404.0, 11633.0, 77433.0, 502690.0, 3322763.0,
+     21292910.0, 134365911.0, 0.0],
+    # rate 2/3 (free distance 6)
+    [3.0, 70.0, 285.0, 1276.0, 6160.0, 27128.0, 117019.0,
+     498860.0, 2103891.0, 8784123.0],
+    # rate 3/4 (free distance 5)
+    [42.0, 201.0, 1492.0, 10469.0, 62935.0, 379644.0, 2253373.0,
+     13073811.0, 75152755.0, 428005675.0],
+    # rate 5/6 (free distance 4)
+    [92.0, 528.0, 8694.0, 79453.0, 792114.0, 7375573.0, 67884974.0,
+     610875423.0, 5427275376.0, 47664215639.0],
+]
+PE_EXPONENTS_TABLE = [
+    [10.0, 12.0, 14.0, 16.0, 18.0, 20.0, 22.0, 24.0, 26.0, 28.0],
+    [6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0, 14.0, 15.0],
+    [5.0, 6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0, 14.0],
+    [4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0],
+]
+
+_B_FACTOR = jnp.array(B_FACTOR_TABLE)
+_PE_COEFFS = jnp.array(PE_COEFFS_TABLE)
+_PE_EXPONENTS = jnp.array(PE_EXPONENTS_TABLE)
 
 RATE_1_2, RATE_2_3, RATE_3_4, RATE_5_6 = 0, 1, 2, 3
 
@@ -218,9 +220,9 @@ def chunk_success_rate_py(snr: float, nbits: float, constellation: int, rate_cla
         ber = (2.0 * (1.0 - 1.0 / math.sqrt(m)) / math.log2(m)) * 0.5 * math.erfc(z)
     p = min(max(ber, 0.0), 0.5)
     d = math.sqrt(4.0 * p * (1.0 - p))
-    coeffs = [float(c) for c in _PE_COEFFS[rate_class]]
-    exps = [float(e) for e in _PE_EXPONENTS[rate_class]]
-    factor = float(_B_FACTOR[rate_class])
+    coeffs = PE_COEFFS_TABLE[rate_class]
+    exps = PE_EXPONENTS_TABLE[rate_class]
+    factor = B_FACTOR_TABLE[rate_class]
     pe = factor * sum(c * d**e for c, e in zip(coeffs, exps) if c > 0)
     pe = min(pe, 1.0 - 1e-12)
     return math.exp(nbits * math.log1p(-pe))
