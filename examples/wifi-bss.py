@@ -1,0 +1,104 @@
+"""Infrastructure BSS: AP + N STAs, UDP echo upstream traffic.
+
+The WiFi workload shape from BASELINE.json config #3 (64-STA YansWifiPhy
+BSS); upstream analog: examples/wireless/wifi-simple-infra.cc + the
+third.cc tutorial topology.
+
+Run: python examples/wifi-bss.py --nStas=8 --simTime=2
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpudes.core import CommandLine, Seconds, Simulator
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.models.mobility import MobilityHelper
+from tpudes.models.wifi import (
+    WifiHelper,
+    WifiMacHelper,
+    YansWifiChannelHelper,
+    YansWifiPhyHelper,
+)
+
+
+def main(argv=None):
+    cmd = CommandLine()
+    cmd.AddValue("nStas", "number of stations", 8)
+    cmd.AddValue("simTime", "simulated seconds", 2.0)
+    cmd.AddValue("packetSize", "UDP payload bytes", 512)
+    cmd.AddValue("interval", "client send interval (s)", 0.1)
+    cmd.Parse(argv)
+    n_stas = int(cmd.nStas)
+    sim_time = float(cmd.simTime)
+
+    nodes = NodeContainer()
+    nodes.Create(n_stas + 1)  # node 0 = AP
+
+    mobility = MobilityHelper()
+    mobility.SetPositionAllocator(
+        "tpudes::RandomDiscPositionAllocator", X=0.0, Y=0.0, Rho=25.0
+    )
+    mobility.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mobility.Install(nodes)
+
+    channel = YansWifiChannelHelper.Default().Create()
+    phy = YansWifiPhyHelper()
+    phy.SetChannel(channel)
+    wifi = WifiHelper()
+    wifi.SetRemoteStationManager("tpudes::ConstantRateWifiManager", DataMode="OfdmRate54Mbps")
+
+    ap_mac = WifiMacHelper()
+    ap_mac.SetType("tpudes::ApWifiMac")
+    ap_devices = wifi.Install(phy, ap_mac, [nodes.Get(0)])
+
+    sta_mac = WifiMacHelper()
+    sta_mac.SetType("tpudes::StaWifiMac")
+    sta_devices = wifi.Install(phy, sta_mac, [nodes.Get(i) for i in range(1, n_stas + 1)])
+
+    stack = InternetStackHelper()
+    stack.Install(nodes)
+    address = Ipv4AddressHelper()
+    address.SetBase("10.1.3.0", "255.255.255.0")
+    devices = NetDeviceContainer()
+    devices.Add(ap_devices.Get(0))
+    for i in range(n_stas):
+        devices.Add(sta_devices.Get(i))
+    interfaces = address.Assign(devices)
+
+    server = UdpEchoServerHelper(9)
+    server_apps = server.Install(nodes.Get(0))
+    server_apps.Start(Seconds(0.5))
+    server_apps.Stop(Seconds(sim_time))
+    rx_count = [0]
+    server_apps.Get(0).TraceConnectWithoutContext("Rx", lambda pkt, *a: rx_count.__setitem__(0, rx_count[0] + 1))
+
+    for i in range(n_stas):
+        client = UdpEchoClientHelper(interfaces.GetAddress(0), 9)
+        client.SetAttribute("MaxPackets", 1_000_000)
+        client.SetAttribute("Interval", Seconds(float(cmd.interval)))
+        client.SetAttribute("PacketSize", int(cmd.packetSize))
+        apps = client.Install(nodes.Get(1 + i))
+        apps.Start(Seconds(1.0 + 0.001 * i))  # staggered join
+        apps.Stop(Seconds(sim_time))
+
+    wall0 = time.monotonic()
+    Simulator.Stop(Seconds(sim_time))
+    Simulator.Run()
+    wall = time.monotonic() - wall0
+    events = Simulator.GetEventCount()
+    n_assoc = sum(
+        1 for i in range(n_stas) if sta_devices.Get(i).GetMac().IsAssociated()
+    )
+    print(f"stas={n_stas} associated={n_assoc} server_rx={rx_count[0]} "
+          f"events={events} wall={wall:.2f}s events/s={events / max(wall, 1e-9):,.0f}")
+    Simulator.Destroy()
+    return 0 if n_assoc == n_stas and rx_count[0] > 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

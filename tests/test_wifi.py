@@ -1,0 +1,291 @@
+"""WiFi slice tests — mirrors upstream's wifi test suite strategy
+(SURVEY.md §4): PHY duration math vs closed form, end-to-end BSS
+topologies asserting on delivery counters, deterministic loss via
+geometry, DCF contention resolution."""
+
+import math
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.helper.containers import NodeContainer
+from tpudes.models.mobility import (
+    ConstantPositionMobilityModel,
+    ListPositionAllocator,
+    MobilityHelper,
+    Vector,
+)
+from tpudes.models.wifi import (
+    AdhocWifiMac,
+    ApWifiMac,
+    StaWifiMac,
+    WifiHelper,
+    WifiMacHelper,
+    YansWifiChannelHelper,
+    YansWifiPhyHelper,
+    ppdu_duration_s,
+)
+from tpudes.network.node import Node
+from tpudes.network.packet import Packet
+from tpudes.ops.wifi_error import MODES_BY_NAME
+
+
+def _wifi_nodes(n, positions, mac_setup, rate_manager=("tpudes::ConstantRateWifiManager", {})):
+    """Build n wifi nodes at given positions; mac_setup(i, mac_helper)."""
+    nodes = NodeContainer()
+    nodes.Create(n)
+    mobility = MobilityHelper()
+    alloc = ListPositionAllocator()
+    for p in positions:
+        alloc.Add(Vector(*p))
+    mobility.SetPositionAllocator(alloc)
+    mobility.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mobility.Install(nodes)
+
+    channel = YansWifiChannelHelper.Default().Create()
+    phy = YansWifiPhyHelper()
+    phy.SetChannel(channel)
+    wifi = WifiHelper()
+    wifi.SetRemoteStationManager(rate_manager[0], **rate_manager[1])
+
+    devices = []
+    for i, node in enumerate(nodes):
+        mac = WifiMacHelper()
+        mac_setup(i, mac)
+        dev_container = wifi.Install(phy, mac, [node])
+        devices.append(dev_container.Get(0))
+    return nodes, devices
+
+
+def test_ppdu_duration_closed_form():
+    # 1000-byte frame at 6 Mbps: 20µs + ceil((16+8000+6)/24)*4µs
+    mode = MODES_BY_NAME["OfdmRate6Mbps"]
+    d = ppdu_duration_s(1000, mode)
+    assert d == pytest.approx(20e-6 + math.ceil(8022 / 24) * 4e-6)
+    # 54 Mbps: NDBPS=216
+    d54 = ppdu_duration_s(1000, MODES_BY_NAME["OfdmRate54Mbps"])
+    assert d54 == pytest.approx(20e-6 + math.ceil(8022 / 216) * 4e-6)
+    assert d54 < d
+
+
+def test_adhoc_unicast_delivery_with_ack():
+    nodes, devices = _wifi_nodes(
+        2, [(0, 0, 0), (10, 0, 0)], lambda i, m: m.SetType("tpudes::AdhocWifiMac")
+    )
+    got = []
+    devices[1].SetReceiveCallback(lambda dev, pkt, proto, sender: got.append(pkt.GetSize()) or True)
+    Simulator.Schedule(
+        Seconds(1.0), devices[0].Send, Packet(500), devices[1].GetAddress(), 0x0800
+    )
+    Simulator.Stop(Seconds(2))
+    Simulator.Run()
+    assert got == [500]  # LLC stripped before delivery
+
+
+def test_out_of_range_not_delivered():
+    # LogDistance exponent 3: at 10 km rx ≈ -150 dBm, far below sensitivity
+    nodes, devices = _wifi_nodes(
+        2, [(0, 0, 0), (10000, 0, 0)], lambda i, m: m.SetType("tpudes::AdhocWifiMac")
+    )
+    got = []
+    drops = []
+    devices[1].SetReceiveCallback(lambda dev, pkt, proto, sender: got.append(1) or True)
+    devices[1].GetPhy().TraceConnectWithoutContext(
+        "PhyRxDrop", lambda pkt, reason: drops.append(reason)
+    )
+    Simulator.Schedule(
+        Seconds(1.0), devices[0].Send, Packet(500), devices[1].GetAddress(), 0x0800
+    )
+    Simulator.Stop(Seconds(2))
+    Simulator.Run()
+    assert got == []
+    assert "below-sensitivity" in drops
+
+
+def test_infra_association_and_data():
+    def setup(i, mac):
+        if i == 0:
+            mac.SetType("tpudes::ApWifiMac")
+        else:
+            mac.SetType("tpudes::StaWifiMac")
+
+    nodes, devices = _wifi_nodes(3, [(0, 0, 0), (5, 0, 0), (0, 5, 0)], setup)
+    ap_mac = devices[0].GetMac()
+    sta1 = devices[1].GetMac()
+    sta2 = devices[2].GetMac()
+    got = []
+    devices[0].SetReceiveCallback(lambda dev, pkt, proto, sender: got.append(pkt.GetSize()) or True)
+
+    # STA enqueues before association: must be held then sent
+    Simulator.Schedule(
+        Seconds(0.01), devices[1].Send, Packet(200), devices[0].GetAddress(), 0x0800
+    )
+    Simulator.Stop(Seconds(1))
+    Simulator.Run()
+    assert sta1.IsAssociated() and sta2.IsAssociated()
+    assert ap_mac.IsAssociated(sta1.GetAddress())
+    assert got == [200]
+
+
+def test_intra_bss_relay():
+    """STA1 → AP → STA2 relaying through the DS."""
+
+    def setup(i, mac):
+        mac.SetType("tpudes::ApWifiMac" if i == 0 else "tpudes::StaWifiMac")
+
+    nodes, devices = _wifi_nodes(3, [(0, 0, 0), (5, 0, 0), (0, 5, 0)], setup)
+    got = []
+    devices[2].SetReceiveCallback(lambda dev, pkt, proto, sender: got.append(pkt.GetSize()) or True)
+    # give association time, then send STA1 → STA2 (addr3 routing via AP)
+    Simulator.Schedule(
+        Seconds(0.5), devices[1].Send, Packet(300), devices[2].GetAddress(), 0x0800
+    )
+    Simulator.Stop(Seconds(1.5))
+    Simulator.Run()
+    assert got == [300]
+
+
+def test_dcf_contention_both_deliver():
+    """Two simultaneous transmitters to a third node: DCF backoff must
+    eventually deliver both (retries resolve the collision)."""
+    nodes, devices = _wifi_nodes(
+        3, [(0, 0, 0), (4, 0, 0), (2, 2, 0)], lambda i, m: m.SetType("tpudes::AdhocWifiMac")
+    )
+    got = []
+    devices[2].SetReceiveCallback(lambda dev, pkt, proto, sender: got.append(str(sender)) or True)
+    # exactly simultaneous sends — same tick
+    for i in (0, 1):
+        Simulator.Schedule(
+            Seconds(1.0), devices[i].Send, Packet(400), devices[2].GetAddress(), 0x0800
+        )
+    Simulator.Stop(Seconds(2))
+    Simulator.Run()
+    assert sorted(got) == sorted([str(devices[0].GetAddress()), str(devices[1].GetAddress())])
+
+
+def test_broadcast_no_ack_single_copy():
+    nodes, devices = _wifi_nodes(
+        3, [(0, 0, 0), (5, 0, 0), (0, 5, 0)], lambda i, m: m.SetType("tpudes::AdhocWifiMac")
+    )
+    got = [[], []]
+    from tpudes.network.address import Mac48Address
+
+    devices[1].SetReceiveCallback(lambda dev, pkt, proto, sender: got[0].append(1) or True)
+    devices[2].SetReceiveCallback(lambda dev, pkt, proto, sender: got[1].append(1) or True)
+    Simulator.Schedule(
+        Seconds(1.0), devices[0].Send, Packet(100), Mac48Address.GetBroadcast(), 0x0800
+    )
+    Simulator.Stop(Seconds(2))
+    Simulator.Run()
+    assert got == [[1], [1]]
+
+
+def test_retry_and_dedup_under_forced_loss():
+    """Force every first data rx to fail via interference from a third
+    node? Simpler: check the dup cache — deliver once even when the ack
+    is lost and the sender retries."""
+    nodes, devices = _wifi_nodes(
+        2, [(0, 0, 0), (10, 0, 0)], lambda i, m: m.SetType("tpudes::AdhocWifiMac")
+    )
+    rx_mac = devices[1].GetMac()
+    got = []
+    devices[1].SetReceiveCallback(lambda dev, pkt, proto, sender: got.append(1) or True)
+    # sabotage the first ack: drop it at the sender PHY by forcing the
+    # receiver's first ack tx to be preempted — instead simply simulate a
+    # retry by sending the same (seq, retry) frame twice via MAC internals
+    from tpudes.models.wifi.mac import WifiMacHeader, WifiMacType
+
+    header = WifiMacHeader(
+        WifiMacType.DATA,
+        addr1=devices[1].GetAddress(),
+        addr2=devices[0].GetAddress(),
+        addr3=devices[1].GetAddress(),
+        seq=7,
+    )
+    from tpudes.network.packet import LlcSnapHeader
+
+    def send_copy(retry):
+        p = Packet(50)
+        p.AddHeader(LlcSnapHeader(0x0800))
+        h = WifiMacHeader(
+            WifiMacType.DATA,
+            addr1=header.addr1,
+            addr2=header.addr2,
+            addr3=header.addr3,
+            seq=7,
+            retry=retry,
+        )
+        frame = p.Copy()
+        frame.AddHeader(h)
+        devices[0].GetPhy().Send(frame, MODES_BY_NAME["OfdmRate6Mbps"])
+
+    # original then a spaced retry: the second must hit the dup cache
+    Simulator.Schedule(Seconds(1.0), send_copy, False)
+    Simulator.Schedule(Seconds(1.1), send_copy, True)
+    Simulator.Stop(Seconds(2))
+    Simulator.Run()
+    assert got == [1]
+
+
+def test_arf_rate_climbs():
+    nodes, devices = _wifi_nodes(
+        2,
+        [(0, 0, 0), (5, 0, 0)],
+        lambda i, m: m.SetType("tpudes::AdhocWifiMac"),
+        rate_manager=("tpudes::ArfWifiManager", {}),
+    )
+    got = []
+    devices[1].SetReceiveCallback(lambda dev, pkt, proto, sender: got.append(1) or True)
+    for k in range(25):
+        Simulator.Schedule(
+            Seconds(0.1 + 0.01 * k), devices[0].Send, Packet(100), devices[1].GetAddress(), 0x0800
+        )
+    Simulator.Stop(Seconds(2))
+    Simulator.Run()
+    assert len(got) == 25
+    manager = devices[0].GetMac()._station_manager
+    st = manager._st(devices[1].GetAddress())
+    assert st["rate"] >= 2  # climbed at least two steps after 25 acks
+
+
+def test_wifi_udp_echo_end_to_end():
+    """The first.cc flow over WiFi adhoc + ARP: UDP echo client/server."""
+    from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+    from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+    from tpudes.helper.containers import NetDeviceContainer
+
+    def setup(i, mac):
+        mac.SetType("tpudes::AdhocWifiMac")
+
+    nodes, devices = _wifi_nodes(2, [(0, 0, 0), (20, 0, 0)], setup)
+    stack = InternetStackHelper()
+    stack.Install(nodes)
+    address = Ipv4AddressHelper()
+    address.SetBase("10.1.3.0", "255.255.255.0")
+    container = NetDeviceContainer()
+    for d in devices:
+        container.Add(d)
+    interfaces = address.Assign(container)
+
+    server = UdpEchoServerHelper(9)
+    server_apps = server.Install(nodes.Get(1))
+    server_apps.Start(Seconds(0.5))
+    server_apps.Stop(Seconds(5.0))
+
+    client = UdpEchoClientHelper(interfaces.GetAddress(1), 9)
+    client.SetAttribute("MaxPackets", 2)
+    client.SetAttribute("Interval", Seconds(0.5))
+    client.SetAttribute("PacketSize", 256)
+    client_apps = client.Install(nodes.Get(0))
+    client_apps.Start(Seconds(1.0))
+    client_apps.Stop(Seconds(5.0))
+
+    server_rx = []
+    client_rx = []
+    server_apps.Get(0).TraceConnectWithoutContext("Rx", lambda pkt, *a: server_rx.append(pkt.GetSize()))
+    client_apps.Get(0).TraceConnectWithoutContext("Rx", lambda pkt, *a: client_rx.append(pkt.GetSize()))
+
+    Simulator.Stop(Seconds(6))
+    Simulator.Run()
+    assert server_rx == [256, 256]
+    assert client_rx == [256, 256]

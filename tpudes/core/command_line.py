@@ -29,6 +29,14 @@ class CommandLine:
     def GetValue(self, name: str):
         return self._values[name]["value"]
 
+    def __getattr__(self, name: str):
+        # attribute access mirrors C++'s bind-by-reference ergonomics:
+        # cmd.AddValue("nStas", ...) → cmd.nStas after Parse()
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]["value"]
+        raise AttributeError(name)
+
     def Parse(self, argv=None) -> None:
         argv = list(sys.argv[1:] if argv is None else argv)
         for arg in argv:

@@ -9,6 +9,7 @@ Ipv4StaticRouting does on NotifyAddAddress.
 from __future__ import annotations
 
 from tpudes.helper.containers import Ipv4InterfaceContainer, NetDeviceContainer, NodeContainer
+from tpudes.models.internet.arp import ArpL3Protocol
 from tpudes.models.internet.ipv4 import (
     Ipv4InterfaceAddress,
     Ipv4L3Protocol,
@@ -37,6 +38,9 @@ class InternetStackHelper:
             ipv4 = Ipv4L3Protocol()
             ipv4.SetNode(node)
             node.AggregateObject(ipv4)
+            arp = ArpL3Protocol()
+            arp.SetNode(node)
+            node.AggregateObject(arp)
             if self._routing_factory is not None:
                 routing = self._routing_factory.Create(node)
             else:

@@ -1,0 +1,185 @@
+"""ARP: address resolution for NeedsArp devices (WiFi, CSMA).
+
+Reference parity: src/internet/model/arp-l3-protocol.{h,cc},
+arp-cache.{h,cc}, arp-header.{h,cc} (upstream paths; mount empty at
+survey — SURVEY.md §0).  Request/reply over device broadcast, per-device
+cache with pending-packet queue, alive-timeout refresh.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import Seconds
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import Ipv4Address, Mac48Address
+from tpudes.network.packet import Header, Packet
+
+ARP_PROT_NUMBER = 0x0806
+
+
+class ArpHeader(Header):
+    REQUEST = 1
+    REPLY = 2
+
+    def __init__(self, op=1, source_mac=None, source_ip=None, dest_mac=None, dest_ip=None):
+        self.op = op
+        self.source_mac = source_mac or Mac48Address()
+        self.source_ip = Ipv4Address(source_ip or 0)
+        self.dest_mac = dest_mac or Mac48Address()
+        self.dest_ip = Ipv4Address(dest_ip or 0)
+
+    def GetSerializedSize(self) -> int:
+        return 28
+
+    def Serialize(self) -> bytes:
+        return (
+            struct.pack(">HHBBH", 1, 0x0800, 6, 4, self.op)
+            + self.source_mac.to_bytes()
+            + struct.pack(">I", self.source_ip.addr)
+            + self.dest_mac.to_bytes()
+            + struct.pack(">I", self.dest_ip.addr)
+        )
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        op = struct.unpack(">H", data[6:8])[0]
+        h = cls(op=op)
+        h.source_mac = Mac48Address.from_bytes(data[8:14])
+        h.source_ip = Ipv4Address(struct.unpack(">I", data[14:18])[0])
+        h.dest_mac = Mac48Address.from_bytes(data[18:24])
+        h.dest_ip = Ipv4Address(struct.unpack(">I", data[24:28])[0])
+        return h
+
+
+class ArpCacheEntry:
+    WAIT_REPLY = 0
+    ALIVE = 1
+
+    __slots__ = ("state", "mac", "pending", "retries", "timeout_event")
+
+    def __init__(self):
+        self.state = self.WAIT_REPLY
+        self.mac = None
+        self.pending: list = []  # (packet, protocol)
+        self.retries = 0
+        self.timeout_event = None
+
+
+class ArpL3Protocol(Object):
+    """Per-node ARP with per-device caches."""
+
+    PROT_NUMBER = ARP_PROT_NUMBER
+
+    tid = (
+        TypeId("tpudes::ArpL3Protocol")
+        .AddConstructor(lambda **kw: ArpL3Protocol(**kw))
+        .AddAttribute("RequestJitter", "max request jitter (s)", 0.0)
+        .AddAttribute("MaxRetries", "request retransmissions", 3, field="max_retries")
+        .AddAttribute("WaitReplyTimeout", "per-request timeout (s)", 1.0, field="wait_timeout_s")
+        .AddTraceSource("Drop", "packet dropped (no ARP resolution)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        self._caches: dict[int, dict[int, ArpCacheEntry]] = {}  # id(device) -> ip -> entry
+
+    def SetNode(self, node) -> None:
+        self._node = node
+        node.RegisterProtocolHandler(self._receive, self.PROT_NUMBER)
+
+    def _cache(self, device) -> dict:
+        return self._caches.setdefault(id(device), {})
+
+    def Lookup(self, packet: Packet, protocol: int, dest_ip: Ipv4Address, device, sender_ip: Ipv4Address) -> None:
+        """Resolve dest_ip; send ``packet`` when the MAC is known
+        (ArpL3Protocol::Lookup semantics: queue + request on miss)."""
+        cache = self._cache(device)
+        entry = cache.get(dest_ip.addr)
+        if entry is not None and entry.state == ArpCacheEntry.ALIVE:
+            device.Send(packet, entry.mac, protocol)
+            return
+        if entry is None:
+            entry = ArpCacheEntry()
+            cache[dest_ip.addr] = entry
+            self._send_request(device, dest_ip, sender_ip)
+            entry.timeout_event = Simulator.Schedule(
+                Seconds(self.wait_timeout_s), self._on_timeout, device, dest_ip, sender_ip
+            )
+        entry.pending.append((packet, protocol))
+
+    def _on_timeout(self, device, dest_ip, sender_ip):
+        """Retry the request up to MaxRetries, then drop the pending
+        queue (ArpCache WaitReply retransmission contract)."""
+        cache = self._cache(device)
+        entry = cache.get(dest_ip.addr)
+        if entry is None or entry.state == ArpCacheEntry.ALIVE:
+            return
+        entry.retries += 1
+        if entry.retries > self.max_retries:
+            pending, entry.pending = entry.pending, []
+            del cache[dest_ip.addr]  # allow a fresh resolution attempt later
+            for packet, _proto in pending:
+                self.drop(packet)
+            return
+        self._send_request(device, dest_ip, sender_ip)
+        entry.timeout_event = Simulator.Schedule(
+            Seconds(self.wait_timeout_s), self._on_timeout, device, dest_ip, sender_ip
+        )
+
+    def _send_request(self, device, dest_ip, sender_ip):
+        req = Packet(0)
+        req.AddHeader(
+            ArpHeader(
+                op=ArpHeader.REQUEST,
+                source_mac=device.GetAddress(),
+                source_ip=sender_ip,
+                dest_mac=Mac48Address(),
+                dest_ip=dest_ip,
+            )
+        )
+        device.Send(req, Mac48Address.GetBroadcast(), self.PROT_NUMBER)
+
+    def _receive(self, device, packet, protocol, sender):
+        from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+
+        header = packet.RemoveHeader(ArpHeader)
+        ipv4 = self._node.GetObject(Ipv4L3Protocol)
+        if ipv4 is None:
+            return
+        if_index = ipv4.GetInterfaceForDevice(device)
+        if if_index < 0:
+            return
+        my_addrs = [a.GetLocal().addr for a in ipv4.GetInterface(if_index).addresses]
+
+        # learn the sender mapping opportunistically (upstream does)
+        cache = self._cache(device)
+        entry = cache.get(header.source_ip.addr)
+        if entry is None:
+            entry = ArpCacheEntry()
+            cache[header.source_ip.addr] = entry
+        entry.mac = header.source_mac
+        was_waiting = entry.state == ArpCacheEntry.WAIT_REPLY
+        entry.state = ArpCacheEntry.ALIVE
+        if entry.timeout_event is not None:
+            entry.timeout_event.Cancel()
+            entry.timeout_event = None
+        if was_waiting and entry.pending:
+            pending, entry.pending = entry.pending, []
+            for queued, proto in pending:
+                device.Send(queued, entry.mac, proto)
+
+        if header.op == ArpHeader.REQUEST and header.dest_ip.addr in my_addrs:
+            reply = Packet(0)
+            reply.AddHeader(
+                ArpHeader(
+                    op=ArpHeader.REPLY,
+                    source_mac=device.GetAddress(),
+                    source_ip=header.dest_ip,
+                    dest_mac=header.source_mac,
+                    dest_ip=header.source_ip,
+                )
+            )
+            device.Send(reply, header.source_mac, self.PROT_NUMBER)

@@ -387,7 +387,7 @@ class Ipv4L3Protocol(Object):
         self.send_outgoing(header, packet, if_index)
         packet.AddHeader(header)
         self.tx(packet, if_index)
-        iface.Send(packet, header)
+        self._send_via(iface, packet, header, route)
 
     # --- receive path ---
     def _receive(self, device, packet, protocol, sender):
@@ -438,7 +438,31 @@ class Ipv4L3Protocol(Object):
         self.unicast_forward(header, packet, if_index)
         packet.AddHeader(header)
         self.tx(packet, if_index)
-        self.interfaces[if_index].Send(packet, header)
+        self._send_via(self.interfaces[if_index], packet, header, route)
+
+    def _send_via(self, iface, packet, header, route):
+        """Hand the packet to the interface, resolving the next-hop MAC
+        through ARP on devices that need it (Ipv4L3Protocol::SendRealOut)."""
+        device = iface.device
+        has_gateway = route is not None and route.gateway is not None and not route.gateway.IsAny()
+        next_hop = route.gateway if has_gateway else header.destination
+        if (
+            device is not None
+            and device.NeedsArp()
+            and not next_hop.IsBroadcast()
+            and not next_hop.IsMulticast()
+            and not any(
+                next_hop == a.GetBroadcast() for a in iface.addresses
+            )
+        ):
+            from tpudes.models.internet.arp import ArpL3Protocol
+
+            arp = self._node.GetObject(ArpL3Protocol)
+            if arp is not None:
+                sender_ip = iface.GetAddress().GetLocal() if iface.GetNAddresses() else Ipv4Address(0)
+                arp.Lookup(packet, self.PROT_NUMBER, next_hop, device, sender_ip)
+                return
+        iface.Send(packet, header)
 
 
 # the ns-3 "Ipv4" API name aliases to the L3 protocol object here

@@ -219,6 +219,7 @@ class RandomWalk2dMobilityModel(MobilityModel):
         self._velocity = Vector()
         self._base_time = 0
         self._event = None
+        self._start_scheduled = False
         self._segment_left_s = 0.0
         self._speed_rv = UniformRandomVariable(Min=self.min_speed, Max=self.max_speed)
         self._dir_rv = UniformRandomVariable(Min=0.0, Max=2 * math.pi)
@@ -295,8 +296,17 @@ class RandomWalk2dMobilityModel(MobilityModel):
     def DoSetPosition(self, position: Vector) -> None:
         self._position = position
         self._base_time = Simulator.NowTicks()
-        if not self._started:
+        if self._started:
+            # teleport mid-walk: restart the segment so the pending step
+            # and its boundary timing match the new position (upstream
+            # cancels m_event and re-initializes)
+            if self._event is not None:
+                self._event.Cancel()
+                self._event = None
+            self._start()
+        elif not self._start_scheduled:
             # first placement starts the walk (upstream DoInitialize)
+            self._start_scheduled = True
             Simulator.ScheduleNow(self._start)
         self.NotifyCourseChange()
 
@@ -325,9 +335,15 @@ class RandomWaypointMobilityModel(MobilityModel):
         self._allocator = position_allocator
         self._speed_rv = UniformRandomVariable(Min=self.min_speed, Max=self.max_speed)
         self._started = False
+        self._start_scheduled = False
+        self._placed = False
 
     def SetPositionAllocator(self, allocator) -> None:
         self._allocator = allocator
+        # position may already have been set: kick the walk off now
+        if self._placed and not self._started and not self._start_scheduled:
+            self._start_scheduled = True
+            Simulator.ScheduleNow(self._begin_walk)
 
     def _now_position(self) -> Vector:
         dt = Time(Simulator.NowTicks() - self._base_time).GetSeconds()
@@ -361,7 +377,9 @@ class RandomWaypointMobilityModel(MobilityModel):
     def DoSetPosition(self, position: Vector) -> None:
         self._position = position
         self._base_time = Simulator.NowTicks()
-        if not self._started and self._allocator is not None:
+        self._placed = True
+        if not self._started and not self._start_scheduled and self._allocator is not None:
+            self._start_scheduled = True
             Simulator.ScheduleNow(self._begin_walk)
         self.NotifyCourseChange()
 
@@ -397,6 +415,7 @@ class GaussMarkovMobilityModel(MobilityModel):
         self._base_time = 0
         self._gauss = NormalRandomVariable(Mean=0.0, Variance=1.0)
         self._started = False
+        self._start_scheduled = False
 
     def _now_position(self) -> Vector:
         dt = Time(Simulator.NowTicks() - self._base_time).GetSeconds()
@@ -423,13 +442,16 @@ class GaussMarkovMobilityModel(MobilityModel):
             self._speed * math.sin(self._direction),
             0.0,
         )
-        # reflect at bounds
+        # clamp back inside and reflect only outward-pointing velocity,
+        # so an inward draw is never flipped back out
         xmin, xmax, ymin, ymax, _, _ = self.bounds
         p = self._position
-        if p.x < xmin or p.x > xmax:
+        p.x = min(max(p.x, xmin), xmax)
+        p.y = min(max(p.y, ymin), ymax)
+        if (p.x <= xmin and self._velocity.x < 0) or (p.x >= xmax and self._velocity.x > 0):
             self._velocity.x = -self._velocity.x
             self._direction = math.pi - self._direction
-        if p.y < ymin or p.y > ymax:
+        if (p.y <= ymin and self._velocity.y < 0) or (p.y >= ymax and self._velocity.y > 0):
             self._velocity.y = -self._velocity.y
             self._direction = -self._direction
         self.NotifyCourseChange()
@@ -441,7 +463,8 @@ class GaussMarkovMobilityModel(MobilityModel):
     def DoSetPosition(self, position: Vector) -> None:
         self._position = position
         self._base_time = Simulator.NowTicks()
-        if not self._started:
+        if not self._started and not self._start_scheduled:
+            self._start_scheduled = True
             Simulator.ScheduleNow(self._step)
         self.NotifyCourseChange()
 

@@ -1,0 +1,282 @@
+"""Propagation loss/delay model objects (the attribute-configured wrappers
+around the pure kernels in :mod:`tpudes.ops.propagation`).
+
+Reference parity: src/propagation/model/propagation-loss-model.{h,cc},
+propagation-delay-model.{h,cc} (upstream paths; mount empty at survey —
+SURVEY.md §0).
+
+Each loss model exposes BOTH evaluation paths (SURVEY.md §7 design
+stance):
+
+- ``CalcRxPower(tx_dbm, mob_a, mob_b)`` — scalar float64 host path, the
+  ordering-authoritative oracle used by the sequential engine;
+- ``batch_rx_power(tx_dbm, d)`` — the jittable array form over a
+  distance batch, composed by the window engine into fused kernels.
+
+Models chain with ``SetNext`` exactly like upstream.
+"""
+
+from __future__ import annotations
+
+import math
+
+from tpudes.core.object import Object, TypeId
+from tpudes.core.rng import NormalRandomVariable, UniformRandomVariable
+from tpudes.ops import propagation as K
+
+SPEED_OF_LIGHT = K.SPEED_OF_LIGHT
+
+
+class PropagationLossModel(Object):
+    tid = TypeId("tpudes::PropagationLossModel")
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._next: PropagationLossModel | None = None
+
+    def SetNext(self, next_model: "PropagationLossModel") -> None:
+        self._next = next_model
+
+    def GetNext(self):
+        return self._next
+
+    def CalcRxPower(self, tx_power_dbm: float, mob_a, mob_b) -> float:
+        """Full-chain scalar rx power (upstream CalcRxPower walks the
+        chain the same way)."""
+        rx = self.DoCalcRxPower(tx_power_dbm, mob_a, mob_b)
+        if self._next is not None:
+            rx = self._next.CalcRxPower(rx, mob_a, mob_b)
+        return rx
+
+    def DoCalcRxPower(self, tx_power_dbm: float, mob_a, mob_b) -> float:
+        raise NotImplementedError
+
+    # --- batch path -------------------------------------------------------
+    def batch_rx_power(self, tx_power_dbm, d):
+        """Array rx power over distances; chains like the scalar path."""
+        rx = self.do_batch_rx_power(tx_power_dbm, d)
+        if self._next is not None:
+            rx = self._next.batch_rx_power(rx, d)
+        return rx
+
+    def do_batch_rx_power(self, tx_power_dbm, d):
+        raise NotImplementedError
+
+    @staticmethod
+    def _dist(mob_a, mob_b) -> float:
+        return mob_a.GetDistanceFrom(mob_b)
+
+
+class FriisPropagationLossModel(PropagationLossModel):
+    tid = (
+        TypeId("tpudes::FriisPropagationLossModel")
+        .SetParent(PropagationLossModel.tid)
+        .AddConstructor(lambda **kw: FriisPropagationLossModel(**kw))
+        .AddAttribute("Frequency", "carrier frequency (Hz)", 5.15e9, field="frequency")
+        .AddAttribute("SystemLoss", "system loss L >= 1", 1.0, field="system_loss")
+        .AddAttribute("MinLoss", "minimum loss (dB)", 0.0, field="min_loss")
+    )
+
+    def DoCalcRxPower(self, tx_power_dbm, mob_a, mob_b):
+        d = self._dist(mob_a, mob_b)
+        if d <= 0:
+            return tx_power_dbm - self.min_loss
+        lam = SPEED_OF_LIGHT / self.frequency
+        loss = -10.0 * math.log10(lam * lam / (16.0 * math.pi**2 * d * d * self.system_loss))
+        return tx_power_dbm - max(loss, self.min_loss)
+
+    def do_batch_rx_power(self, tx_power_dbm, d):
+        return K.friis(tx_power_dbm, d, self.frequency, self.system_loss, self.min_loss)
+
+
+class LogDistancePropagationLossModel(PropagationLossModel):
+    tid = (
+        TypeId("tpudes::LogDistancePropagationLossModel")
+        .SetParent(PropagationLossModel.tid)
+        .AddConstructor(lambda **kw: LogDistancePropagationLossModel(**kw))
+        .AddAttribute("Exponent", "path-loss exponent", 3.0, field="exponent")
+        .AddAttribute("ReferenceDistance", "d0 (m)", 1.0, field="reference_distance")
+        .AddAttribute("ReferenceLoss", "loss at d0 (dB)", K.DEFAULT_REFERENCE_LOSS_DB, field="reference_loss")
+    )
+
+    def DoCalcRxPower(self, tx_power_dbm, mob_a, mob_b):
+        d = self._dist(mob_a, mob_b)
+        if d <= self.reference_distance:
+            return tx_power_dbm - self.reference_loss
+        loss = self.reference_loss + 10.0 * self.exponent * math.log10(d / self.reference_distance)
+        return tx_power_dbm - loss
+
+    def do_batch_rx_power(self, tx_power_dbm, d):
+        return K.log_distance(tx_power_dbm, d, self.exponent, self.reference_distance, self.reference_loss)
+
+
+class ThreeLogDistancePropagationLossModel(PropagationLossModel):
+    tid = (
+        TypeId("tpudes::ThreeLogDistancePropagationLossModel")
+        .SetParent(PropagationLossModel.tid)
+        .AddConstructor(lambda **kw: ThreeLogDistancePropagationLossModel(**kw))
+        .AddAttribute("Distance0", "d0", 1.0, field="d0")
+        .AddAttribute("Distance1", "d1", 200.0, field="d1")
+        .AddAttribute("Distance2", "d2", 500.0, field="d2")
+        .AddAttribute("Exponent0", "", 1.9, field="exponent0")
+        .AddAttribute("Exponent1", "", 3.8, field="exponent1")
+        .AddAttribute("Exponent2", "", 3.8, field="exponent2")
+        .AddAttribute("ReferenceLoss", "loss at d0", K.DEFAULT_REFERENCE_LOSS_DB, field="reference_loss")
+    )
+
+    def DoCalcRxPower(self, tx_power_dbm, mob_a, mob_b):
+        d = max(self._dist(mob_a, mob_b), self.d0)
+        loss = self.reference_loss
+        loss += 10.0 * self.exponent0 * math.log10(min(max(d, self.d0), self.d1) / self.d0)
+        loss += 10.0 * self.exponent1 * math.log10(min(max(d, self.d1), self.d2) / self.d1)
+        loss += 10.0 * self.exponent2 * math.log10(max(d, self.d2) / self.d2)
+        return tx_power_dbm - loss
+
+    def do_batch_rx_power(self, tx_power_dbm, d):
+        return K.three_log_distance(
+            tx_power_dbm, d, self.d0, self.d1, self.d2,
+            self.exponent0, self.exponent1, self.exponent2, self.reference_loss,
+        )
+
+
+class FixedRssLossModel(PropagationLossModel):
+    tid = (
+        TypeId("tpudes::FixedRssLossModel")
+        .SetParent(PropagationLossModel.tid)
+        .AddConstructor(lambda **kw: FixedRssLossModel(**kw))
+        .AddAttribute("Rss", "fixed receive power (dBm)", -150.0, field="rss")
+    )
+
+    def DoCalcRxPower(self, tx_power_dbm, mob_a, mob_b):
+        return self.rss
+
+    def do_batch_rx_power(self, tx_power_dbm, d):
+        return K.fixed_rss(tx_power_dbm, d, self.rss)
+
+
+class RangePropagationLossModel(PropagationLossModel):
+    tid = (
+        TypeId("tpudes::RangePropagationLossModel")
+        .SetParent(PropagationLossModel.tid)
+        .AddConstructor(lambda **kw: RangePropagationLossModel(**kw))
+        .AddAttribute("MaxRange", "cutoff (m)", 250.0, field="max_range")
+    )
+
+    def DoCalcRxPower(self, tx_power_dbm, mob_a, mob_b):
+        return tx_power_dbm if self._dist(mob_a, mob_b) <= self.max_range else -1000.0
+
+    def do_batch_rx_power(self, tx_power_dbm, d):
+        return K.range_loss(tx_power_dbm, d, self.max_range)
+
+
+class MatrixPropagationLossModel(PropagationLossModel):
+    """Explicit per-(mobility-pair) loss (matrix-propagation-loss-model.cc);
+    pairs default to DefaultLoss."""
+
+    tid = (
+        TypeId("tpudes::MatrixPropagationLossModel")
+        .SetParent(PropagationLossModel.tid)
+        .AddConstructor(lambda **kw: MatrixPropagationLossModel(**kw))
+        .AddAttribute("DefaultLoss", "loss for unset pairs (dB)", 1e9, field="default_loss")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._loss: dict[tuple[int, int], float] = {}
+
+    def SetLoss(self, mob_a, mob_b, loss_db: float, symmetric: bool = True) -> None:
+        self._loss[(id(mob_a), id(mob_b))] = loss_db
+        if symmetric:
+            self._loss[(id(mob_b), id(mob_a))] = loss_db
+
+    def DoCalcRxPower(self, tx_power_dbm, mob_a, mob_b):
+        return tx_power_dbm - self._loss.get((id(mob_a), id(mob_b)), self.default_loss)
+
+    def do_batch_rx_power(self, tx_power_dbm, d):
+        raise NotImplementedError("matrix loss batches via explicit loss tables")
+
+
+class NakagamiPropagationLossModel(PropagationLossModel):
+    tid = (
+        TypeId("tpudes::NakagamiPropagationLossModel")
+        .SetParent(PropagationLossModel.tid)
+        .AddConstructor(lambda **kw: NakagamiPropagationLossModel(**kw))
+        .AddAttribute("Distance1", "", 80.0, field="d1")
+        .AddAttribute("Distance2", "", 200.0, field="d2")
+        .AddAttribute("m0", "", 1.5, field="m0")
+        .AddAttribute("m1", "", 0.75, field="m1")
+        .AddAttribute("m2", "", 0.75, field="m2")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        # Erlang/Gamma draw via sum-of-exponentials / normal approx on the
+        # host path; batch path uses jax.random.gamma
+        self._u = UniformRandomVariable()
+        self._n = NormalRandomVariable(Mean=0.0, Variance=1.0)
+
+    def _gamma_draw(self, shape: float) -> float:
+        # Marsaglia-Tsang via host RNG streams (reproducible per-run)
+        d = (shape if shape >= 1 else shape + 1) - 1.0 / 3.0
+        c = 1.0 / math.sqrt(9.0 * d)
+        while True:
+            x = self._n.GetValue()
+            v = (1.0 + c * x) ** 3
+            if v <= 0:
+                continue
+            u = self._u.GetValue()
+            if math.log(max(u, 1e-300)) < 0.5 * x * x + d - d * v + d * math.log(v):
+                g = d * v
+                break
+        if shape < 1:
+            g *= self._u.GetValue() ** (1.0 / shape)
+        return g
+
+    def DoCalcRxPower(self, tx_power_dbm, mob_a, mob_b):
+        d = self._dist(mob_a, mob_b)
+        m = self.m0 if d < self.d1 else (self.m1 if d < self.d2 else self.m2)
+        power_w = 10.0 ** ((tx_power_dbm - 30.0) / 10.0)
+        draw = self._gamma_draw(m) * (power_w / m)
+        return 10.0 * math.log10(max(draw, 1e-30)) + 30.0
+
+    def do_batch_rx_power(self, tx_power_dbm, d):
+        raise NotImplementedError(
+            "stochastic batch path needs a key: use ops.propagation.nakagami"
+        )
+
+
+class PropagationDelayModel(Object):
+    tid = TypeId("tpudes::PropagationDelayModel")
+
+    def GetDelay(self, mob_a, mob_b) -> float:
+        """Delay in SECONDS (converted to Time by callers)."""
+        raise NotImplementedError
+
+
+class ConstantSpeedPropagationDelayModel(PropagationDelayModel):
+    tid = (
+        TypeId("tpudes::ConstantSpeedPropagationDelayModel")
+        .SetParent(PropagationDelayModel.tid)
+        .AddConstructor(lambda **kw: ConstantSpeedPropagationDelayModel(**kw))
+        .AddAttribute("Speed", "m/s", SPEED_OF_LIGHT, field="speed")
+    )
+
+    def GetDelay(self, mob_a, mob_b) -> float:
+        return mob_a.GetDistanceFrom(mob_b) / self.speed
+
+
+class RandomPropagationDelayModel(PropagationDelayModel):
+    tid = (
+        TypeId("tpudes::RandomPropagationDelayModel")
+        .SetParent(PropagationDelayModel.tid)
+        .AddConstructor(lambda **kw: RandomPropagationDelayModel(**kw))
+        .AddAttribute("Min", "s", 0.0, field="min_s")
+        .AddAttribute("Max", "s", 1.0, field="max_s")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._rv = UniformRandomVariable(Min=self.min_s, Max=self.max_s)
+
+    def GetDelay(self, mob_a, mob_b) -> float:
+        return self._rv.GetValue()

@@ -1,0 +1,32 @@
+"""WiFi module: Yans PHY/channel, DCF MAC, rate control, helpers.
+
+Reference parity: src/wifi/ (SURVEY.md §2.5). Round-1 scope: DCF +
+data/ack exchange, beacon/assoc state machines, NIST error model via
+:mod:`tpudes.ops.wifi_error`; EDCA/QoS, RTS/CTS+NAV, aggregation,
+BlockAck and the HT/VHT/HE FEM chain are later rounds.
+"""
+
+from tpudes.models.wifi.phy import YansWifiPhy, WifiPhyState, InterferenceHelper, ppdu_duration_s
+from tpudes.models.wifi.channel import YansWifiChannel
+from tpudes.models.wifi.mac import (
+    AdhocWifiMac,
+    ApWifiMac,
+    StaWifiMac,
+    WifiMac,
+    WifiMacHeader,
+    WifiMacType,
+)
+from tpudes.models.wifi.device import WifiNetDevice
+from tpudes.models.wifi.rate_control import (
+    AarfWifiManager,
+    ArfWifiManager,
+    ConstantRateWifiManager,
+    IdealWifiManager,
+    MinstrelWifiManager,
+)
+from tpudes.models.wifi.helper import (
+    WifiHelper,
+    WifiMacHelper,
+    YansWifiChannelHelper,
+    YansWifiPhyHelper,
+)

@@ -1,0 +1,84 @@
+"""YansWifiChannel — the O(N_tx × N_rx) hot loop.
+
+Reference parity: src/wifi/model/yans-wifi-channel.{h,cc} (upstream path;
+mount empty at survey — SURVEY.md §0).  SURVEY.md §3.2: for each other
+PHY on the channel, apply delay + loss chain and schedule
+StartReceivePreamble with node context.
+
+The scalar per-receiver loop is the ordering-authoritative host path.
+``rx_power_row`` exposes the same computation as one batched kernel call
+over every receiver at once (positions gathered into arrays) — the form
+JaxSimulatorImpl uses per window.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.nstime import Seconds
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+
+
+class YansWifiChannel(Object):
+    tid = (
+        TypeId("tpudes::YansWifiChannel")
+        .AddConstructor(lambda **kw: YansWifiChannel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._phys: list = []
+        self._loss = None
+        self._delay = None
+
+    # --- wiring ---
+    def Add(self, phy) -> None:
+        self._phys.append(phy)
+
+    def GetNDevices(self) -> int:
+        return len(self._phys)
+
+    def GetDevice(self, i: int):
+        return self._phys[i].GetDevice()
+
+    def SetPropagationLossModel(self, loss) -> None:
+        self._loss = loss
+
+    def SetPropagationDelayModel(self, delay) -> None:
+        self._delay = delay
+
+    # --- the hot loop ---
+    def Send(self, sender_phy, packet, mode, tx_power_dbm: float, duration_s: float) -> None:
+        sender_mob = sender_phy.GetMobility()
+        for phy in self._phys:
+            if phy is sender_phy:
+                continue
+            rx_mob = phy.GetMobility()
+            delay_s = self._delay.GetDelay(sender_mob, rx_mob) if self._delay else 0.0
+            rx_dbm = (
+                self._loss.CalcRxPower(tx_power_dbm, sender_mob, rx_mob)
+                if self._loss
+                else tx_power_dbm
+            )
+            node = phy.GetDevice().GetNode() if phy.GetDevice() else None
+            context = node.GetId() if node else 0
+            Simulator.ScheduleWithContext(
+                context,
+                Seconds(delay_s),
+                phy.StartReceivePreamble,
+                packet.Copy(),
+                mode,
+                rx_dbm,
+                duration_s,
+            )
+
+    # --- batched form (window engine) ---
+    def rx_power_row(self, tx_power_dbm, tx_index: int, positions):
+        """(N,) rx powers from transmitter ``tx_index`` to every PHY given
+        an (N, 3) position array; one fused kernel call instead of the
+        per-receiver Python loop."""
+        import jax.numpy as jnp
+
+        from tpudes.ops.propagation import distance
+
+        d = distance(positions[tx_index][None, :], positions)
+        return self._loss.batch_rx_power(jnp.asarray(tx_power_dbm), d)

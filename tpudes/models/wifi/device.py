@@ -1,0 +1,69 @@
+"""WifiNetDevice — MAC/PHY glue to the Node/NetDevice contract.
+
+Reference parity: src/wifi/model/wifi-net-device.{h,cc} (upstream path;
+mount empty at survey — SURVEY.md §0).  LLC/SNAP encapsulation on top of
+the MAC, as upstream.
+"""
+
+from __future__ import annotations
+
+from tpudes.network.net_device import NetDevice
+from tpudes.network.packet import LlcSnapHeader
+from tpudes.core.object import TypeId
+
+
+class WifiNetDevice(NetDevice):
+    tid = (
+        TypeId("tpudes::WifiNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddConstructor(lambda **kw: WifiNetDevice(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._mac = None
+        self._phy = None
+
+    # --- wiring ---
+    def SetMac(self, mac) -> None:
+        self._mac = mac
+        mac.SetDevice(self)
+        mac.SetAddress(self._address)
+        mac.SetForwardUpCallback(self._forward_up)
+
+    def GetMac(self):
+        return self._mac
+
+    def SetPhy(self, phy) -> None:
+        self._phy = phy
+        phy.SetDevice(self)
+
+    def GetPhy(self):
+        return self._phy
+
+    def GetChannel(self):
+        return self._phy.GetChannel() if self._phy else None
+
+    def SetAddress(self, address) -> None:
+        super().SetAddress(address)
+        if self._mac is not None:
+            self._mac.SetAddress(address)
+
+    # --- NetDevice contract ---
+    def NeedsArp(self) -> bool:
+        return True
+
+    def IsBroadcast(self) -> bool:
+        return True
+
+    def Send(self, packet, dest, protocol: int) -> bool:
+        if not self._link_up:
+            return False
+        packet.AddHeader(LlcSnapHeader(protocol))
+        self._mac.Enqueue(packet, dest)
+        return True
+
+    def _forward_up(self, packet, from_addr, to_addr):
+        llc = packet.RemoveHeader(LlcSnapHeader)
+        packet_type = 1 if to_addr.IsBroadcast() else 0  # BROADCAST/HOST
+        self._deliver_up(packet, llc.ether_type, from_addr, to_addr, packet_type)

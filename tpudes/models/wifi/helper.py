@@ -1,0 +1,157 @@
+"""WiFi helpers: channel/phy/mac/device wiring.
+
+Reference parity: src/wifi/helper/wifi-helper.{h,cc},
+yans-wifi-helper.{h,cc}, wifi-mac-helper.{h,cc} (upstream paths; mount
+empty at survey — SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+from tpudes.helper.containers import NetDeviceContainer
+from tpudes.models.propagation import (
+    ConstantSpeedPropagationDelayModel,
+    LogDistancePropagationLossModel,
+)
+from tpudes.models.wifi.channel import YansWifiChannel
+from tpudes.models.wifi.device import WifiNetDevice
+from tpudes.models.wifi.mac import AdhocWifiMac, ApWifiMac, StaWifiMac
+from tpudes.models.wifi.phy import YansWifiPhy
+from tpudes.models.wifi.rate_control import RATE_MANAGERS
+from tpudes.network.address import Mac48Address
+
+_LOSS_MODELS = {}
+_DELAY_MODELS = {}
+
+
+def _registries():
+    if not _LOSS_MODELS:
+        from tpudes.models import propagation as P
+
+        for name in (
+            "FriisPropagationLossModel",
+            "LogDistancePropagationLossModel",
+            "ThreeLogDistancePropagationLossModel",
+            "FixedRssLossModel",
+            "RangePropagationLossModel",
+            "MatrixPropagationLossModel",
+            "NakagamiPropagationLossModel",
+        ):
+            _LOSS_MODELS[f"tpudes::{name}"] = getattr(P, name)
+        for name in ("ConstantSpeedPropagationDelayModel", "RandomPropagationDelayModel"):
+            _DELAY_MODELS[f"tpudes::{name}"] = getattr(P, name)
+    return _LOSS_MODELS, _DELAY_MODELS
+
+
+class YansWifiChannelHelper:
+    def __init__(self):
+        self._loss_chain: list = []
+        self._delay = None
+
+    @staticmethod
+    def Default() -> "YansWifiChannelHelper":
+        h = YansWifiChannelHelper()
+        h.AddPropagationLoss("tpudes::LogDistancePropagationLossModel")
+        h.SetPropagationDelay("tpudes::ConstantSpeedPropagationDelayModel")
+        return h
+
+    def AddPropagationLoss(self, name_or_model, **attributes):
+        loss_registry, _ = _registries()
+        if isinstance(name_or_model, str):
+            model = loss_registry[name_or_model.replace("ns3::", "tpudes::")](**attributes)
+        else:
+            model = name_or_model
+        self._loss_chain.append(model)
+        return model
+
+    def SetPropagationDelay(self, name_or_model, **attributes):
+        _, delay_registry = _registries()
+        if isinstance(name_or_model, str):
+            self._delay = delay_registry[name_or_model.replace("ns3::", "tpudes::")](**attributes)
+        else:
+            self._delay = name_or_model
+        return self._delay
+
+    def Create(self) -> YansWifiChannel:
+        channel = YansWifiChannel()
+        if self._loss_chain:
+            head = self._loss_chain[0]
+            for model in self._loss_chain[1:]:
+                head.SetNext(model)  # chain as upstream does
+            channel.SetPropagationLossModel(head)
+        if self._delay is None:
+            self._delay = ConstantSpeedPropagationDelayModel()
+        channel.SetPropagationDelayModel(self._delay)
+        return channel
+
+
+class YansWifiPhyHelper:
+    def __init__(self):
+        self._channel = None
+        self._attributes: dict = {}
+
+    def SetChannel(self, channel) -> None:
+        self._channel = channel
+
+    def Set(self, name: str, value) -> None:
+        """Attribute name as in the PHY TypeId (e.g. 'TxPowerStart')."""
+        self._attributes[name] = value
+
+    def Create(self, node, device) -> YansWifiPhy:
+        phy = YansWifiPhy(**self._attributes)
+        phy.SetDevice(device)
+        phy.SetChannel(self._channel)
+        return phy
+
+
+class WifiMacHelper:
+    _MACS = {
+        "tpudes::AdhocWifiMac": AdhocWifiMac,
+        "tpudes::ApWifiMac": ApWifiMac,
+        "tpudes::StaWifiMac": StaWifiMac,
+    }
+
+    def __init__(self):
+        self._type = "tpudes::AdhocWifiMac"
+        self._kwargs: dict = {}
+
+    def SetType(self, name: str, **attributes) -> None:
+        self._type = name.replace("ns3::", "tpudes::")
+        if self._type not in self._MACS:
+            raise ValueError(f"unknown MAC type {name!r}")
+        self._kwargs = attributes
+
+    def Create(self):
+        return self._MACS[self._type](**self._kwargs)
+
+
+class WifiHelper:
+    def __init__(self):
+        self._manager_type = "tpudes::ConstantRateWifiManager"
+        self._manager_kwargs: dict = {}
+
+    def SetRemoteStationManager(self, name: str, **attributes) -> None:
+        name = name.replace("ns3::", "tpudes::")
+        if name not in RATE_MANAGERS:
+            raise ValueError(f"unknown rate manager {name!r}")
+        self._manager_type = name
+        self._manager_kwargs = attributes
+
+    def Install(self, phy_helper: YansWifiPhyHelper, mac_helper: WifiMacHelper, nodes) -> NetDeviceContainer:
+        container = NetDeviceContainer()
+        try:
+            iterator = list(iter(nodes))
+        except TypeError:
+            iterator = [nodes]
+        for node in iterator:
+            device = WifiNetDevice()
+            device.SetAddress(Mac48Address.Allocate())
+            node.AddDevice(device)
+            phy = phy_helper.Create(node, device)
+            device.SetPhy(phy)
+            mac = mac_helper.Create()
+            manager = RATE_MANAGERS[self._manager_type](**self._manager_kwargs)
+            mac.SetWifiRemoteStationManager(manager)
+            device.SetMac(mac)
+            mac.SetPhy(phy)  # after device/address so beacons carry it
+            container.Add(device)
+        return container

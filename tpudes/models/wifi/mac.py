@@ -1,0 +1,565 @@
+"""WiFi MAC: frames, DCF channel access, frame exchange, high MACs.
+
+Reference parity (upstream paths; mount empty at survey — SURVEY.md §0):
+- src/wifi/model/wifi-mac-header.{h,cc} — frame format
+- src/wifi/model/channel-access-manager.{h,cc}, txop.{h,cc} — DCF:
+  DIFS + slotted backoff, freeze on busy, CW doubling
+- src/wifi/model/frame-exchange-manager.{h,cc} — data/ack exchange,
+  retransmission, duplicate detection
+- src/wifi/model/{adhoc,ap,sta}-wifi-mac.{h,cc} — high MACs (beacons,
+  association state machine)
+
+Round-1 scope notes (SURVEY.md §7 step 6): DCF only (EDCA/QoS, RTS/CTS
++ NAV, aggregation and BlockAck are later-round work — the seam is
+``FrameExchange``); association is the real two-frame exchange but
+without auth.  The 9 µs slot feedback loop stays host-side by design
+(SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import MicroSeconds, Seconds, Time
+from tpudes.core.object import Object, TypeId
+from tpudes.core.rng import UniformRandomVariable
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import Mac48Address
+from tpudes.network.packet import Header, Packet
+from tpudes.ops.wifi_error import MODES_BY_NAME, WifiMode
+from tpudes.models.wifi.phy import ppdu_duration_s
+
+# 802.11a OFDM 20 MHz MAC timing (wifi-phy.cc / wifi-mac.cc)
+SLOT_US = 9
+SIFS_US = 16
+DIFS_US = SIFS_US + 2 * SLOT_US  # 34 µs
+CW_MIN = 15
+CW_MAX = 1023
+RETRY_LIMIT = 7
+ACK_SIZE = 14          # bytes incl. FCS
+MAC_HEADER_SIZE = 24   # data/mgmt header
+FCS_SIZE = 4
+BEACON_INTERVAL_US = 102400
+
+#: control responses use the highest mandatory rate ≤ data rate
+MANDATORY_RATES = ("OfdmRate6Mbps", "OfdmRate12Mbps", "OfdmRate24Mbps")
+
+
+def control_answer_mode(data_mode: WifiMode) -> WifiMode:
+    best = MODES_BY_NAME["OfdmRate6Mbps"]
+    for name in MANDATORY_RATES:
+        m = MODES_BY_NAME[name]
+        if m.data_rate_bps <= data_mode.data_rate_bps:
+            best = m
+    return best
+
+
+class WifiMacType:
+    DATA = 0
+    ACK = 1
+    BEACON = 2
+    ASSOC_REQ = 3
+    ASSOC_RESP = 4
+    RTS = 5
+    CTS = 6
+
+
+class WifiMacHeader(Header):
+    """Compact 802.11 header (wifi-mac-header.cc): type, flags, duration,
+    RA/TA/BSSID, sequence."""
+
+    def __init__(self, frame_type=WifiMacType.DATA, addr1=None, addr2=None, addr3=None, seq=0, retry=False, duration_us=0, to_ds=False, from_ds=False):
+        self.frame_type = frame_type
+        self.addr1 = addr1 or Mac48Address.GetBroadcast()  # RA
+        self.addr2 = addr2 or Mac48Address("00:00:00:00:00:00")  # TA
+        self.addr3 = addr3 or Mac48Address("00:00:00:00:00:00")  # BSSID/DA
+        self.seq = seq
+        self.retry = retry
+        self.duration_us = duration_us
+        self.to_ds = to_ds
+        self.from_ds = from_ds
+
+    def GetSerializedSize(self) -> int:
+        if self.frame_type in (WifiMacType.ACK, WifiMacType.CTS):
+            return 10  # fc+dur+ra (FCS added as size constant by callers)
+        return MAC_HEADER_SIZE
+
+    def Serialize(self) -> bytes:
+        flags = (self.retry << 0) | (self.to_ds << 1) | (self.from_ds << 2)
+        fixed = struct.pack(
+            ">BBHH", self.frame_type, flags, self.duration_us & 0xFFFF, self.seq & 0xFFF
+        )
+        return fixed + self.addr1.to_bytes() + self.addr2.to_bytes() + self.addr3.to_bytes()[:2]
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        frame_type, flags, duration, seq = struct.unpack(">BBHH", data[:6])
+        h = cls(frame_type=frame_type, seq=seq, duration_us=duration,
+                retry=bool(flags & 1), to_ds=bool(flags & 2), from_ds=bool(flags & 4))
+        h.addr1 = Mac48Address.from_bytes(data[6:12])
+        h.addr2 = Mac48Address.from_bytes(data[12:18])
+        return h
+
+    def IsData(self):
+        return self.frame_type == WifiMacType.DATA
+
+    def IsAck(self):
+        return self.frame_type == WifiMacType.ACK
+
+    def __repr__(self):
+        names = {0: "DATA", 1: "ACK", 2: "BEACON", 3: "ASSOC_REQ", 4: "ASSOC_RESP", 5: "RTS", 6: "CTS"}
+        return f"WifiMacHeader({names.get(self.frame_type)}, to={self.addr1}, from={self.addr2}, seq={self.seq})"
+
+
+class ChannelAccessManager:
+    """DCF access (channel-access-manager.cc + txop.cc, folded): wait
+    for DIFS of idle, count down backoff slots, freeze while busy."""
+
+    def __init__(self, phy, grant_callback):
+        self._phy = phy
+        self._grant = grant_callback
+        self._rng = UniformRandomVariable()
+        self._cw = CW_MIN
+        self._slots_left = 0
+        self._pending = False
+        self._slot_event = None
+        phy.RegisterListener(self)
+
+    # --- Txop API ---
+    def request_access(self, new_backoff: bool = True) -> None:
+        """Ask for a TX opportunity; grant fires via callback."""
+        if self._pending:
+            return
+        self._pending = True
+        if new_backoff:
+            # ns-3 draws in [0, cw] inclusive
+            self._slots_left = int(self._rng.GetValue(0, self._cw + 1 - 1e-9))
+        self._try_schedule()
+
+    def notify_success(self) -> None:
+        self._cw = CW_MIN
+
+    def notify_failure(self) -> int:
+        """Double CW; returns the new CW."""
+        self._cw = min(2 * (self._cw + 1) - 1, CW_MAX)
+        return self._cw
+
+    def reset_cw(self) -> None:
+        self._cw = CW_MIN
+
+    def AssignStreams(self, stream: int) -> int:
+        self._rng.SetStream(stream)
+        return 1
+
+    # --- countdown machinery ---
+    def _cancel_slot(self):
+        if self._slot_event is not None:
+            self._slot_event.cancel()
+            self._slot_event = None
+
+    def _try_schedule(self):
+        """(Re)start the DIFS + slot countdown from now/busy-end."""
+        self._cancel_slot()
+        if not self._pending:
+            return
+        now = Simulator.NowTicks()
+        idle_start = max(self._phy.busy_until(), now)
+        wait = (idle_start - now) + MicroSeconds(DIFS_US).ticks
+        self._slot_event = Simulator.GetImpl().Schedule(wait, self._tick, ())
+
+    def _tick(self):
+        self._slot_event = None
+        if not self._pending:
+            return
+        if not self._phy.IsStateIdle():
+            self._try_schedule()  # went busy again: refreeze
+            return
+        if self._slots_left > 0:
+            self._slots_left -= 1
+            self._slot_event = Simulator.GetImpl().Schedule(
+                MicroSeconds(SLOT_US).ticks, self._tick, ()
+            )
+            return
+        self._pending = False
+        self._grant()
+
+    # --- PHY listener contract ---
+    def NotifyRxStart(self, end_ts):
+        self._cancel_slot()
+
+    def NotifyRxEnd(self):
+        self._try_schedule()
+
+    def NotifyTxStart(self, end_ts):
+        self._cancel_slot()
+
+    def NotifyTxEnd(self):
+        self._try_schedule()
+
+    def NotifyCcaBusyStart(self, end_ts):
+        self._try_schedule()  # reschedules from new busy end
+
+
+class WifiMac(Object):
+    """Base MAC with DCF + data/ack frame exchange (frame-exchange-
+    manager.cc semantics: single outstanding frame, ack timeout, retry
+    with CW doubling, dup detection)."""
+
+    tid = (
+        TypeId("tpudes::WifiMac")
+        .AddTraceSource("MacTx", "frame handed to DCF (packet)")
+        .AddTraceSource("MacRx", "frame delivered up (packet)")
+        .AddTraceSource("MacTxDrop", "tx dropped after retries (packet)")
+        .AddTraceSource("MacRxDrop", "rx dropped (packet)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._phy = None
+        self._device = None
+        self._address = None
+        self._station_manager = None
+        self._queue: list[tuple[Packet, WifiMacHeader]] = []
+        self._current: tuple[Packet, WifiMacHeader] | None = None
+        self._access: ChannelAccessManager | None = None
+        self._ack_timeout_event = None
+        self._seq = 0
+        self._retries = 0
+        self._dup_cache: dict = {}  # ta -> last seq
+        self._forward_up = None
+
+    # --- wiring ---
+    def SetPhy(self, phy) -> None:
+        self._phy = phy
+        phy.SetReceiveOkCallback(self._rx_ok)
+        phy.SetReceiveErrorCallback(self._rx_error)
+        self._access = ChannelAccessManager(phy, self._on_access_granted)
+
+    def GetPhy(self):
+        return self._phy
+
+    def SetDevice(self, device) -> None:
+        self._device = device
+
+    def SetAddress(self, address) -> None:
+        self._address = address
+
+    def GetAddress(self):
+        return self._address
+
+    def SetWifiRemoteStationManager(self, manager) -> None:
+        self._station_manager = manager
+
+    def SetForwardUpCallback(self, cb) -> None:
+        """cb(packet, from_addr, to_addr)"""
+        self._forward_up = cb
+
+    # --- tx path ---
+    def Enqueue(self, packet: Packet, to) -> None:
+        raise NotImplementedError
+
+    def _enqueue_frame(self, packet: Packet, header: WifiMacHeader) -> None:
+        self.mac_tx(packet)
+        self._queue.append((packet, header))
+        if self._current is None:
+            self._dequeue()
+
+    def _dequeue(self):
+        if self._current is not None or not self._queue:
+            return
+        self._current = self._queue.pop(0)
+        self._retries = 0
+        self._access.request_access()
+
+    def _on_access_granted(self):
+        if self._current is None:
+            return
+        packet, header = self._current
+        self._send_current(packet, header)
+
+    def _send_current(self, packet, header):
+        if (
+            header.addr1.IsBroadcast()
+            or header.addr1.IsGroup()
+            or not header.IsData()
+        ):
+            # non-unicast AND management frames go at the lowest basic
+            # rate (WifiRemoteStationManager::GetNonUnicastMode; mgmt
+            # frames use basic rates in 802.11)
+            mode = MODES_BY_NAME["OfdmRate6Mbps"]
+        elif self._station_manager is not None:
+            mode = self._station_manager.get_data_mode(header.addr1)
+        else:
+            mode = MODES_BY_NAME["OfdmRate6Mbps"]
+        frame = packet.Copy()
+        header.retry = self._retries > 0
+        frame.AddHeader(header)
+        size = frame.GetSize() + FCS_SIZE
+        tx_dur_s = ppdu_duration_s(size, mode)
+        if header.addr1.IsBroadcast() or header.IsAck():
+            # no ack expected: done at end of tx
+            Simulator.GetImpl().Schedule(
+                Seconds(tx_dur_s).ticks, self._tx_complete_no_ack, ()
+            )
+        else:
+            ack_mode = control_answer_mode(mode)
+            ack_dur_s = ppdu_duration_s(ACK_SIZE, ack_mode)
+            timeout_s = tx_dur_s + SIFS_US * 1e-6 + ack_dur_s + SLOT_US * 1e-6 + 4e-6
+            self._ack_timeout_event = Simulator.GetImpl().Schedule(
+                Seconds(timeout_s).ticks, self._on_ack_timeout, ()
+            )
+        self._phy.Send(frame, mode)
+
+    def _tx_complete_no_ack(self):
+        self._current = None
+        self._access.notify_success()
+        self._dequeue()
+
+    def _on_ack_timeout(self):
+        self._ack_timeout_event = None
+        packet, header = self._current
+        self._retries += 1
+        if self._station_manager:
+            self._station_manager.report_data_failed(header.addr1)
+        if self._retries > RETRY_LIMIT:
+            self.mac_tx_drop(packet)
+            if self._station_manager:
+                self._station_manager.report_final_failed(header.addr1)
+            self._current = None
+            self._access.reset_cw()
+            self._dequeue()
+            return
+        self._access.notify_failure()
+        self._access.request_access()
+
+    def _on_ack(self, from_addr):
+        if self._current is None or self._ack_timeout_event is None:
+            return
+        self._ack_timeout_event.cancel()
+        self._ack_timeout_event = None
+        packet, header = self._current
+        if self._station_manager:
+            self._station_manager.report_data_ok(header.addr1)
+        self._current = None
+        self._access.notify_success()
+        self._dequeue()
+
+    def _next_seq(self) -> int:
+        self._seq = (self._seq + 1) & 0xFFF
+        return self._seq
+
+    # --- rx path ---
+    def _rx_ok(self, packet: Packet, snr: float, mode: WifiMode):
+        header = packet.RemoveHeader(WifiMacHeader)
+        if self._station_manager:
+            self._station_manager.report_rx_snr(header.addr2, snr)
+        if header.IsAck():
+            if header.addr1 == self._address:
+                self._on_ack(header.addr1)
+            return
+        if header.addr1 != self._address and not header.addr1.IsBroadcast():
+            return  # not for us
+        if not header.addr1.IsBroadcast():
+            # unicast data AND management frames are acked (SIFS, bypasses
+            # DCF) and deduplicated, as in frame-exchange-manager
+            self._send_ack(header.addr2, mode)
+            last = self._dup_cache.get(str(header.addr2))
+            if last == (header.seq, header.frame_type):
+                self.mac_rx_drop(packet)
+                return
+            self._dup_cache[str(header.addr2)] = (header.seq, header.frame_type)
+        self.Receive(packet, header)
+
+    def _rx_error(self, packet, snr):
+        pass  # PHY already traced the drop
+
+    def _send_ack(self, to, data_mode):
+        ack_mode = control_answer_mode(data_mode)
+        ack = Packet(ACK_SIZE - 10 - FCS_SIZE)
+        header = WifiMacHeader(WifiMacType.ACK, addr1=to, addr2=self._address)
+        ack.AddHeader(header)
+        Simulator.GetImpl().Schedule(
+            MicroSeconds(SIFS_US).ticks, self._phy.Send, (ack, ack_mode)
+        )
+
+    def Receive(self, packet: Packet, header: WifiMacHeader):
+        """Subclass hook for non-ack frames addressed to us."""
+        raise NotImplementedError
+
+    def _deliver_up(self, packet, header):
+        self.mac_rx(packet)
+        if self._forward_up is not None:
+            src = header.addr3 if header.from_ds else header.addr2
+            self._forward_up(packet, src, header.addr1)
+
+
+class AdhocWifiMac(WifiMac):
+    """IBSS: direct peer-to-peer data (adhoc-wifi-mac.cc)."""
+
+    tid = (
+        TypeId("tpudes::AdhocWifiMac")
+        .SetParent(WifiMac.tid)
+        .AddConstructor(lambda **kw: AdhocWifiMac(**kw))
+    )
+
+    def Enqueue(self, packet, to):
+        header = WifiMacHeader(
+            WifiMacType.DATA, addr1=to, addr2=self._address, addr3=to, seq=self._next_seq()
+        )
+        self._enqueue_frame(packet, header)
+
+    def Receive(self, packet, header):
+        if header.IsData():
+            self._deliver_up(packet, header)
+
+
+class ApWifiMac(WifiMac):
+    """Infrastructure AP: periodic beacons, association responses, DS
+    relaying (ap-wifi-mac.cc)."""
+
+    tid = (
+        TypeId("tpudes::ApWifiMac")
+        .SetParent(WifiMac.tid)
+        .AddConstructor(lambda **kw: ApWifiMac(**kw))
+        .AddAttribute("BeaconInterval", "µs", BEACON_INTERVAL_US, field="beacon_interval_us")
+        .AddAttribute("EnableBeaconing", "", True, field="enable_beaconing")
+        .AddTraceSource("AssociatedSta", "(addr)")
+    )
+
+    def __init__(self, ssid: str = "default", **attributes):
+        super().__init__(**attributes)
+        self.ssid = ssid
+        self._stas: set[str] = set()
+        self._beacons_started = False
+
+    def SetPhy(self, phy):
+        super().SetPhy(phy)
+        if self.enable_beaconing and not self._beacons_started:
+            self._beacons_started = True
+            Simulator.ScheduleNow(self._send_beacon)
+
+    def _send_beacon(self):
+        beacon = Packet(50)  # SSID + rates + caps payload
+        header = WifiMacHeader(
+            WifiMacType.BEACON,
+            addr1=Mac48Address.GetBroadcast(),
+            addr2=self._address,
+            addr3=self._address,
+            seq=self._next_seq(),
+        )
+        self._enqueue_frame(beacon, header)
+        Simulator.Schedule(MicroSeconds(self.beacon_interval_us), self._send_beacon)
+
+    def Enqueue(self, packet, to):
+        header = WifiMacHeader(
+            WifiMacType.DATA,
+            addr1=to,
+            addr2=self._address,
+            addr3=self._address,
+            seq=self._next_seq(),
+            from_ds=True,
+        )
+        self._enqueue_frame(packet, header)
+
+    def Receive(self, packet, header):
+        if header.frame_type == WifiMacType.ASSOC_REQ:
+            self._stas.add(str(header.addr2))
+            self.associated_sta(header.addr2)
+            resp = Packet(24)
+            rheader = WifiMacHeader(
+                WifiMacType.ASSOC_RESP,
+                addr1=header.addr2,
+                addr2=self._address,
+                addr3=self._address,
+                seq=self._next_seq(),
+            )
+            self._enqueue_frame(resp, rheader)
+        elif header.IsData():
+            # ToDS frame: addr3 is the final destination
+            if header.addr3 == self._address or header.addr3.IsBroadcast():
+                self._deliver_up(packet, header)
+            elif str(header.addr3) in self._stas:
+                self.Enqueue(packet, header.addr3)  # intra-BSS relay
+            else:
+                self._deliver_up(packet, header)  # toward the DS/bridge
+
+    def IsAssociated(self, addr) -> bool:
+        return str(addr) in self._stas
+
+
+class StaWifiMac(WifiMac):
+    """Infrastructure STA: passive scan → associate → data through the AP
+    (sta-wifi-mac.cc state machine, without auth)."""
+
+    tid = (
+        TypeId("tpudes::StaWifiMac")
+        .SetParent(WifiMac.tid)
+        .AddConstructor(lambda **kw: StaWifiMac(**kw))
+        .AddTraceSource("Assoc", "(ap addr)")
+        .AddTraceSource("DeAssoc", "(ap addr)")
+    )
+
+    #: re-issue an assoc request if unanswered for this long (upstream
+    #: StaWifiMac AssocRequestTimeout is 500 ms)
+    ASSOC_REQUEST_TIMEOUT_S = 0.5
+
+    def __init__(self, ssid: str = "default", **attributes):
+        super().__init__(**attributes)
+        self.ssid = ssid
+        self._ap = None
+        self._associated = False
+        self._assoc_req_ts = None  # ticks of last assoc request
+        self._pending_data: list[tuple[Packet, object]] = []
+
+    def IsAssociated(self) -> bool:
+        return self._associated
+
+    def GetBssid(self):
+        return self._ap
+
+    def Enqueue(self, packet, to):
+        if not self._associated:
+            self._pending_data.append((packet, to))
+            return
+        header = WifiMacHeader(
+            WifiMacType.DATA,
+            addr1=self._ap,
+            addr2=self._address,
+            addr3=to,
+            seq=self._next_seq(),
+            to_ds=True,
+        )
+        self._enqueue_frame(packet, header)
+
+    def _send_assoc_req(self):
+        self._assoc_req_ts = Simulator.NowTicks()
+        req = Packet(28)
+        rheader = WifiMacHeader(
+            WifiMacType.ASSOC_REQ,
+            addr1=self._ap,
+            addr2=self._address,
+            addr3=self._ap,
+            seq=self._next_seq(),
+        )
+        self._enqueue_frame(req, rheader)
+
+    def Receive(self, packet, header):
+        if header.frame_type == WifiMacType.BEACON:
+            if self._ap is None:
+                self._ap = header.addr2
+                self._send_assoc_req()
+            elif not self._associated:
+                # unanswered request (lost in contention): retry on a
+                # later beacon once the timeout has elapsed
+                elapsed = Time(Simulator.NowTicks() - (self._assoc_req_ts or 0)).GetSeconds()
+                if elapsed > self.ASSOC_REQUEST_TIMEOUT_S:
+                    self._send_assoc_req()
+        elif header.frame_type == WifiMacType.ASSOC_RESP:
+            if not self._associated:
+                self._associated = True
+                self.assoc(header.addr2)
+                pending, self._pending_data = self._pending_data, []
+                for packet, to in pending:
+                    self.Enqueue(packet, to)
+        elif header.IsData():
+            self._deliver_up(packet, header)

@@ -1,0 +1,348 @@
+"""WiFi PHY: state machine, interference tracking, Yans PHY.
+
+Reference parity: src/wifi/model/wifi-phy.{h,cc}, yans-wifi-phy.{h,cc},
+interference-helper.{h,cc}, wifi-phy-state-helper.{h,cc} (upstream paths;
+mount empty at survey — SURVEY.md §0).  Call stack lifted here:
+SURVEY.md §3.2 — StartReceivePreamble → InterferenceHelper chunk SNRs →
+NistErrorRateModel → PER coin-flip.
+
+TPU-first split: the PHY keeps exact event ordering on the host; the PER
+math leaf is *pure* and exists twice — ``chunk_success_rate_py`` (float64
+host oracle, used by the sequential engine) and the jittable kernels in
+:mod:`tpudes.ops` (used by the window engine on packed batches).  The
+``pending_evaluations`` hook exposes each frame's (snr-chunks, mode,
+nbits) tuple so JaxSimulatorImpl can defer/batch the coin-flips.
+"""
+
+from __future__ import annotations
+
+import math
+
+from tpudes.core.nstime import Seconds, Time
+from tpudes.core.object import Object, TypeId
+from tpudes.core.rng import UniformRandomVariable
+from tpudes.ops.wifi_error import MODES_BY_NAME, WifiMode, chunk_success_rate_py
+
+BOLTZMANN = 1.380649e-23
+
+# 802.11 OFDM 20 MHz timing (wifi-phy.cc mode tables)
+PREAMBLE_DURATION_S = 16e-6  # PLCP preamble
+SIGNAL_DURATION_S = 4e-6     # L-SIG
+SYMBOL_DURATION_S = 4e-6
+SERVICE_BITS = 16
+TAIL_BITS = 6
+
+
+def ppdu_duration_s(size_bytes: int, mode: WifiMode) -> float:
+    """PPDU airtime: preamble + L-SIG + ceil((service+8·len+tail)/NDBPS)
+    OFDM symbols (WifiPhy::CalculateTxDuration for non-HT OFDM)."""
+    ndbps = mode.data_rate_bps * SYMBOL_DURATION_S  # data bits per symbol
+    nsym = math.ceil((SERVICE_BITS + 8 * size_bytes + TAIL_BITS) / ndbps)
+    return PREAMBLE_DURATION_S + SIGNAL_DURATION_S + nsym * SYMBOL_DURATION_S
+
+
+class WifiPhyState:
+    IDLE = 0
+    CCA_BUSY = 1
+    TX = 2
+    RX = 3
+    SWITCHING = 4
+    SLEEP = 5
+    OFF = 6
+
+
+class _Event:
+    """One tracked signal (interference-helper.h Event): rx power and
+    airtime of a PPDU as seen by one PHY."""
+
+    __slots__ = ("packet", "mode", "start_ts", "end_ts", "rx_power_w")
+
+    def __init__(self, packet, mode, start_ts, end_ts, rx_power_w):
+        self.packet = packet
+        self.mode = mode
+        self.start_ts = start_ts
+        self.end_ts = end_ts
+        self.rx_power_w = rx_power_w
+
+
+class InterferenceHelper:
+    """Tracks all signal events at one PHY and computes per-frame PER by
+    chunked SNR (interference-helper.cc).  Host float64 path; the window
+    engine reads the same event lists to build padded batches."""
+
+    def __init__(self, noise_figure_db: float = 7.0, bandwidth_hz: float = 20e6):
+        self.set_noise(noise_figure_db, bandwidth_hz)
+        self._events: list[_Event] = []
+
+    def set_noise(self, noise_figure_db: float, bandwidth_hz: float) -> None:
+        self.noise_w = (
+            10.0 ** (noise_figure_db / 10.0) * BOLTZMANN * 290.0 * bandwidth_hz
+        )
+
+    def add(self, packet, mode, start_ts, end_ts, rx_power_w) -> _Event:
+        ev = _Event(packet, mode, start_ts, end_ts, rx_power_w)
+        self._events.append(ev)
+        return ev
+
+    def gc(self, now_ts: int) -> None:
+        """Drop events that can no longer overlap anything in flight."""
+        self._events = [e for e in self._events if e.end_ts >= now_ts]
+
+    def energy_w(self, ts: int, exclude: _Event | None = None) -> float:
+        """Total signal power present at time ts (for CCA)."""
+        return sum(
+            e.rx_power_w
+            for e in self._events
+            if e is not exclude and e.start_ts <= ts < e.end_ts
+        )
+
+    def snr_chunks(self, event: _Event):
+        """[(snr_linear, duration_s)] chunks of ``event`` between
+        interference boundaries — the exact quantity the batched kernel
+        computes on padded tensors."""
+        bounds = {event.start_ts, event.end_ts}
+        others = [
+            e
+            for e in self._events
+            if e is not event and e.end_ts > event.start_ts and e.start_ts < event.end_ts
+        ]
+        for e in others:
+            if event.start_ts < e.start_ts < event.end_ts:
+                bounds.add(e.start_ts)
+            if event.start_ts < e.end_ts < event.end_ts:
+                bounds.add(e.end_ts)
+        edges = sorted(bounds)
+        chunks = []
+        for t0, t1 in zip(edges, edges[1:]):
+            if t1 <= t0:
+                continue
+            mid = (t0 + t1) // 2
+            ni = sum(e.rx_power_w for e in others if e.start_ts <= mid < e.end_ts)
+            snr = event.rx_power_w / (self.noise_w + ni)
+            chunks.append((snr, Time(t1 - t0).GetSeconds()))
+        return chunks
+
+    def calculate_per(self, event: _Event) -> float:
+        """1 - Π chunk success (InterferenceHelper::CalculatePayloadPer)."""
+        mode = event.mode
+        psr = 1.0
+        for snr, dur_s in self.snr_chunks(event):
+            nbits = mode.data_rate_bps * dur_s
+            psr *= chunk_success_rate_py(snr, nbits, mode.constellation, mode.rate_class)
+        return 1.0 - psr
+
+    def first_snr(self, event: _Event) -> float:
+        chunks = self.snr_chunks(event)
+        return chunks[0][0] if chunks else 0.0
+
+
+class YansWifiPhy(Object):
+    """Scalar-power PHY over YansWifiChannel (yans-wifi-phy.cc).
+
+    State transitions IDLE/CCA_BUSY/RX/TX; reception starts only from
+    IDLE/CCA_BUSY when rx power clears RxSensitivity; concurrent arrivals
+    feed the interference helper.
+    """
+
+    tid = (
+        TypeId("tpudes::YansWifiPhy")
+        .AddConstructor(lambda **kw: YansWifiPhy(**kw))
+        .AddAttribute("TxPowerStart", "min tx power (dBm)", 16.0206, field="tx_power_start")
+        .AddAttribute("TxPowerEnd", "max tx power (dBm)", 16.0206, field="tx_power_end")
+        .AddAttribute("TxGain", "dB", 0.0, field="tx_gain")
+        .AddAttribute("RxGain", "dB", 0.0, field="rx_gain")
+        .AddAttribute("RxSensitivity", "min frame power (dBm)", -101.0, field="rx_sensitivity")
+        .AddAttribute("CcaEdThreshold", "energy-detect threshold (dBm)", -62.0, field="cca_ed_threshold")
+        .AddAttribute("RxNoiseFigure", "dB", 7.0, field="noise_figure")
+        .AddAttribute("ChannelWidth", "MHz", 20, field="channel_width")
+        .AddAttribute("Frequency", "carrier (Hz)", 5.18e9, field="frequency")
+        .AddTraceSource("PhyTxBegin", "(packet, tx_power_w)")
+        .AddTraceSource("PhyTxEnd", "(packet)")
+        .AddTraceSource("PhyRxBegin", "(packet, rx_power_w)")
+        .AddTraceSource("PhyRxEnd", "(packet)")
+        .AddTraceSource("PhyRxDrop", "(packet, reason)")
+        .AddTraceSource("State", "(start, duration, state)")
+        .AddTraceSource("MonitorSnifferRx", "(packet, snr, mode)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._channel = None
+        self._device = None
+        self._mobility = None
+        self._state = WifiPhyState.IDLE
+        self._state_until = 0  # ticks when TX/RX/CCA_BUSY ends
+        self._interference = InterferenceHelper(self.noise_figure, self.channel_width * 1e6)
+        self._current_rx: _Event | None = None
+        self._rx_ok_callback = None
+        self._rx_error_callback = None
+        self._listeners = []  # MAC channel-access listeners
+        self._rng = UniformRandomVariable()
+        from tpudes.core.simulator import Simulator
+
+        self._sim = Simulator
+
+    # --- wiring ---
+    def SetChannel(self, channel) -> None:
+        self._channel = channel
+        channel.Add(self)
+
+    def GetChannel(self):
+        return self._channel
+
+    def SetDevice(self, device) -> None:
+        self._device = device
+
+    def GetDevice(self):
+        return self._device
+
+    def SetMobility(self, mobility) -> None:
+        self._mobility = mobility
+
+    def GetMobility(self):
+        if self._mobility is not None:
+            return self._mobility
+        if self._device is not None and self._device.GetNode() is not None:
+            from tpudes.models.mobility import MobilityModel
+
+            return self._device.GetNode().GetObject(MobilityModel)
+        return None
+
+    def SetReceiveOkCallback(self, cb) -> None:
+        """cb(packet, snr, mode)"""
+        self._rx_ok_callback = cb
+
+    def SetReceiveErrorCallback(self, cb) -> None:
+        self._rx_error_callback = cb
+
+    def RegisterListener(self, listener) -> None:
+        """listener gets NotifyRxStart/NotifyRxEnd/NotifyTxStart/
+        NotifyCcaBusyStart (channel-access-manager contract)."""
+        self._listeners.append(listener)
+
+    def AssignStreams(self, stream: int) -> int:
+        self._rng.SetStream(stream)
+        return 1
+
+    # --- state ---
+    def GetState(self) -> int:
+        now = self._sim.NowTicks()
+        if self._state != WifiPhyState.IDLE and now >= self._state_until:
+            return WifiPhyState.IDLE
+        return self._state
+
+    def IsStateIdle(self) -> bool:
+        return self.GetState() == WifiPhyState.IDLE
+
+    def _set_state(self, state: int, until_ts: int) -> None:
+        self._state = state
+        self._state_until = until_ts
+        self.state(self._sim.NowTicks(), until_ts - self._sim.NowTicks(), state)
+
+    def busy_until(self) -> int:
+        """Ticks when the medium (as seen by this PHY) goes idle again."""
+        return self._state_until if self._state != WifiPhyState.IDLE else self._sim.NowTicks()
+
+    # --- tx ---
+    def GetTxPowerDbm(self, power_level: int = 0) -> float:
+        return self.tx_power_start + self.tx_gain
+
+    def Send(self, packet, mode: WifiMode, tx_power_level: int = 0) -> None:
+        """WifiPhy::Send: enter TX, hand the PPDU to the channel."""
+        duration_s = ppdu_duration_s(packet.GetSize(), mode)
+        now = self._sim.NowTicks()
+        end = now + Seconds(duration_s).ticks
+        # a PHY transmitting aborts any reception in progress
+        if self._current_rx is not None:
+            self.phy_rx_drop(self._current_rx.packet, "tx-preempts-rx")
+            self._current_rx = None
+        self._set_state(WifiPhyState.TX, end)
+        self.phy_tx_begin(packet, 10 ** ((self.GetTxPowerDbm(tx_power_level) - 30) / 10))
+        for listener in self._listeners:
+            listener.NotifyTxStart(end)
+        self._channel.Send(self, packet, mode, self.GetTxPowerDbm(tx_power_level), duration_s)
+        self._sim.GetImpl().Schedule(end - now, self._end_tx, (packet,))
+
+    def _end_tx(self, packet):
+        self.phy_tx_end(packet)
+        for listener in self._listeners:
+            listener.NotifyTxEnd()
+        self._maybe_idle()
+
+    # --- rx (called by the channel after delay) ---
+    def StartReceivePreamble(self, packet, mode: WifiMode, rx_power_dbm: float, duration_s: float) -> None:
+        rx_power_dbm += self.rx_gain
+        rx_power_w = 10.0 ** ((rx_power_dbm - 30.0) / 10.0)
+        now = self._sim.NowTicks()
+        end = now + Seconds(duration_s).ticks
+        self._interference.gc(now)
+        event = self._interference.add(packet, mode, now, end, rx_power_w)
+
+        state = self.GetState()
+        if state in (WifiPhyState.TX, WifiPhyState.SLEEP, WifiPhyState.OFF):
+            self.phy_rx_drop(packet, "tx-busy" if state == WifiPhyState.TX else "off")
+            return
+        if state == WifiPhyState.RX:
+            # already locked onto another frame: this one is interference
+            self.phy_rx_drop(packet, "rx-busy")
+            self._maybe_cca_busy()
+            return
+        if rx_power_dbm < self.rx_sensitivity:
+            self.phy_rx_drop(packet, "below-sensitivity")
+            self._maybe_cca_busy()
+            return
+        # lock on
+        self._current_rx = event
+        self._set_state(WifiPhyState.RX, end)
+        self.phy_rx_begin(packet, rx_power_w)
+        for listener in self._listeners:
+            listener.NotifyRxStart(end)
+        self._sim.GetImpl().Schedule(end - now, self._end_rx, (event,))
+
+    def _end_rx(self, event):
+        if self._current_rx is not event:
+            return  # aborted by our own TX
+        self._current_rx = None
+        per = self._interference.calculate_per(event)
+        snr = self._interference.first_snr(event)
+        self.phy_rx_end(event.packet)
+        for listener in self._listeners:
+            listener.NotifyRxEnd()
+        if self._rng.GetValue() > per:
+            self.monitor_sniffer_rx(event.packet, snr, event.mode)
+            if self._rx_ok_callback is not None:
+                self._rx_ok_callback(event.packet, snr, event.mode)
+        else:
+            self.phy_rx_drop(event.packet, "error")
+            if self._rx_error_callback is not None:
+                self._rx_error_callback(event.packet, snr)
+        self._maybe_idle()
+
+    # --- cca ---
+    def _maybe_cca_busy(self):
+        """Energy above CcaEdThreshold keeps the medium busy for MAC."""
+        now = self._sim.NowTicks()
+        energy = self._interference.energy_w(now)
+        if energy > 10.0 ** ((self.cca_ed_threshold - 30.0) / 10.0):
+            # busy until the last contributing event ends
+            end = max(
+                (e.end_ts for e in self._interference._events if e.start_ts <= now < e.end_ts),
+                default=now,
+            )
+            if self.GetState() == WifiPhyState.IDLE or (
+                self._state == WifiPhyState.CCA_BUSY and end > self._state_until
+            ):
+                self._set_state(WifiPhyState.CCA_BUSY, end)
+                for listener in self._listeners:
+                    listener.NotifyCcaBusyStart(end)
+
+    def _maybe_idle(self):
+        now = self._sim.NowTicks()
+        if self._state_until <= now:
+            self._state = WifiPhyState.IDLE
+        self._maybe_cca_busy()
+
+    # --- introspection for the window engine ---
+    @property
+    def interference(self) -> InterferenceHelper:
+        return self._interference

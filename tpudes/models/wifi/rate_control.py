@@ -1,0 +1,257 @@
+"""Rate-control algorithms (WifiRemoteStationManager family).
+
+Reference parity: src/wifi/model/wifi-remote-station-manager.{h,cc} and
+the algorithms under src/wifi/model/rate-control/ (upstream paths; mount
+empty at survey — SURVEY.md §0): ConstantRate, Arf, Aarf, Ideal, and a
+Minstrel-style EWMA sampler.
+
+Per-station state keys off the remote MAC address; the MAC reports tx
+outcomes and rx SNRs through the ``report_*`` hooks.
+"""
+
+from __future__ import annotations
+
+import math
+
+from tpudes.core.object import Object, TypeId
+from tpudes.core.rng import UniformRandomVariable
+from tpudes.ops.wifi_error import MODES_BY_NAME, OFDM_MODES, WifiMode, chunk_success_rate_py
+
+
+class WifiRemoteStationManager(Object):
+    tid = TypeId("tpudes::WifiRemoteStationManager")
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._stations: dict[str, dict] = {}
+        self._modes = list(OFDM_MODES)
+
+    def _st(self, addr) -> dict:
+        key = str(addr)
+        if key not in self._stations:
+            self._stations[key] = self._new_station()
+        return self._stations[key]
+
+    def _new_station(self) -> dict:
+        return {}
+
+    # --- MAC-facing API ---
+    def get_data_mode(self, addr) -> WifiMode:
+        raise NotImplementedError
+
+    def report_data_ok(self, addr) -> None:
+        pass
+
+    def report_data_failed(self, addr) -> None:
+        pass
+
+    def report_final_failed(self, addr) -> None:
+        pass
+
+    def report_rx_snr(self, addr, snr: float) -> None:
+        pass
+
+
+class ConstantRateWifiManager(WifiRemoteStationManager):
+    tid = (
+        TypeId("tpudes::ConstantRateWifiManager")
+        .SetParent(WifiRemoteStationManager.tid)
+        .AddConstructor(lambda **kw: ConstantRateWifiManager(**kw))
+        .AddAttribute("DataMode", "WifiMode name", "OfdmRate6Mbps", field="data_mode_name")
+    )
+
+    def get_data_mode(self, addr) -> WifiMode:
+        return MODES_BY_NAME[self.data_mode_name]
+
+
+class ArfWifiManager(WifiRemoteStationManager):
+    """ARF (arf-wifi-manager.cc): 10 successes → rate up; 2 consecutive
+    failures (or first tx at a new rate failing) → rate down."""
+
+    SUCCESS_THRESHOLD = 10
+    FAILURE_THRESHOLD = 2
+
+    tid = (
+        TypeId("tpudes::ArfWifiManager")
+        .SetParent(WifiRemoteStationManager.tid)
+        .AddConstructor(lambda **kw: ArfWifiManager(**kw))
+    )
+
+    def _new_station(self):
+        return {"rate": 0, "success": 0, "failed": 0, "recovery": False}
+
+    def get_data_mode(self, addr):
+        return self._modes[self._st(addr)["rate"]]
+
+    def report_data_ok(self, addr):
+        st = self._st(addr)
+        st["failed"] = 0
+        st["success"] += 1
+        if st["success"] >= self.SUCCESS_THRESHOLD and st["rate"] < len(self._modes) - 1:
+            st["rate"] += 1
+            st["success"] = 0
+            st["recovery"] = True
+        else:
+            st["recovery"] = False
+
+    def report_data_failed(self, addr):
+        st = self._st(addr)
+        st["failed"] += 1
+        st["success"] = 0
+        if st["recovery"]:
+            # first frame after a rate increase failed: fall straight back
+            if st["rate"] > 0:
+                st["rate"] -= 1
+            st["recovery"] = False
+            st["failed"] = 0
+        elif st["failed"] >= self.FAILURE_THRESHOLD:
+            if st["rate"] > 0:
+                st["rate"] -= 1
+            st["failed"] = 0
+
+
+class AarfWifiManager(ArfWifiManager):
+    """AARF (aarf-wifi-manager.cc): like ARF but the success threshold
+    doubles (×2, capped) every time a probe at the higher rate fails."""
+
+    tid = (
+        TypeId("tpudes::AarfWifiManager")
+        .SetParent(WifiRemoteStationManager.tid)
+        .AddConstructor(lambda **kw: AarfWifiManager(**kw))
+    )
+
+    MAX_SUCCESS_THRESHOLD = 60
+
+    def _new_station(self):
+        st = super()._new_station()
+        st["threshold"] = self.SUCCESS_THRESHOLD
+        return st
+
+    def report_data_ok(self, addr):
+        st = self._st(addr)
+        st["failed"] = 0
+        st["success"] += 1
+        if st["success"] >= st["threshold"] and st["rate"] < len(self._modes) - 1:
+            st["rate"] += 1
+            st["success"] = 0
+            st["recovery"] = True
+        else:
+            st["recovery"] = False
+
+    def report_data_failed(self, addr):
+        st = self._st(addr)
+        st["failed"] += 1
+        st["success"] = 0
+        if st["recovery"]:
+            st["threshold"] = min(2 * st["threshold"], self.MAX_SUCCESS_THRESHOLD)
+            if st["rate"] > 0:
+                st["rate"] -= 1
+            st["recovery"] = False
+            st["failed"] = 0
+        elif st["failed"] >= self.FAILURE_THRESHOLD:
+            st["threshold"] = self.SUCCESS_THRESHOLD
+            if st["rate"] > 0:
+                st["rate"] -= 1
+            st["failed"] = 0
+
+
+class IdealWifiManager(WifiRemoteStationManager):
+    """Ideal (ideal-wifi-manager.cc): the receiver's SNR is known (fed
+    back via report_rx_snr); choose the fastest mode whose predicted
+    success rate at that SNR clears a BER target."""
+
+    tid = (
+        TypeId("tpudes::IdealWifiManager")
+        .SetParent(WifiRemoteStationManager.tid)
+        .AddConstructor(lambda **kw: IdealWifiManager(**kw))
+        .AddAttribute("BerThreshold", "target chunk error", 1e-6, field="ber_threshold")
+    )
+
+    _CHUNK_BITS = 1500 * 8
+
+    def _new_station(self):
+        return {"snr": None}
+
+    def report_rx_snr(self, addr, snr):
+        self._st(addr)["snr"] = snr
+
+    def get_data_mode(self, addr):
+        snr = self._st(addr)["snr"]
+        if snr is None:
+            return self._modes[0]
+        best = self._modes[0]
+        for mode in self._modes:
+            ok = chunk_success_rate_py(snr, self._CHUNK_BITS, mode.constellation, mode.rate_class)
+            if 1.0 - ok < self.ber_threshold * self._CHUNK_BITS:
+                best = mode
+        return best
+
+
+class MinstrelWifiManager(WifiRemoteStationManager):
+    """Minstrel-style sampler (minstrel-wifi-manager.cc, simplified):
+    EWMA per-rate success probability, throughput-ordered selection,
+    ~10% lookaround sampling."""
+
+    tid = (
+        TypeId("tpudes::MinstrelWifiManager")
+        .SetParent(WifiRemoteStationManager.tid)
+        .AddConstructor(lambda **kw: MinstrelWifiManager(**kw))
+        .AddAttribute("LookAroundRate", "sampling fraction", 0.1, field="lookaround")
+        .AddAttribute("Ewma", "EWMA weight on history", 0.75, field="ewma")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._rng = UniformRandomVariable()
+
+    def _new_station(self):
+        n = len(self._modes)
+        return {
+            "prob": [1.0] * n,
+            "attempts": [0] * n,
+            "last_mode": 0,
+            "sampling": False,
+        }
+
+    def _best_rate(self, st) -> int:
+        tput = [
+            p * m.data_rate_bps for p, m in zip(st["prob"], self._modes)
+        ]
+        return max(range(len(tput)), key=tput.__getitem__)
+
+    def get_data_mode(self, addr):
+        st = self._st(addr)
+        if self._rng.GetValue() < self.lookaround:
+            idx = int(self._rng.GetValue(0, len(self._modes) - 1e-9))
+            st["sampling"] = True
+        else:
+            idx = self._best_rate(st)
+            st["sampling"] = False
+        st["last_mode"] = idx
+        st["attempts"][idx] += 1
+        return self._modes[idx]
+
+    def _update(self, st, idx, ok: float):
+        w = self.ewma
+        st["prob"][idx] = w * st["prob"][idx] + (1 - w) * ok
+
+    def report_data_ok(self, addr):
+        st = self._st(addr)
+        self._update(st, st["last_mode"], 1.0)
+
+    def report_data_failed(self, addr):
+        st = self._st(addr)
+        self._update(st, st["last_mode"], 0.0)
+
+    def AssignStreams(self, stream: int) -> int:
+        self._rng.SetStream(stream)
+        return 1
+
+
+RATE_MANAGERS = {
+    "tpudes::ConstantRateWifiManager": ConstantRateWifiManager,
+    "tpudes::ArfWifiManager": ArfWifiManager,
+    "tpudes::AarfWifiManager": AarfWifiManager,
+    "tpudes::IdealWifiManager": IdealWifiManager,
+    "tpudes::MinstrelWifiManager": MinstrelWifiManager,
+}
