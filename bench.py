@@ -1,0 +1,141 @@
+"""Benchmark: BASELINE.md config #3 — YansWifiPhy BSS PHY evaluations,
+64 STAs × 512 Monte-Carlo replicas.
+
+Numerator: the fused window kernel (tpudes.parallel.kernels) running
+multi-window lax.scan on the accelerator — the TPU execution path of
+SURVEY.md §3.2's hot loop.
+
+Denominator (vs_baseline): the identical logical work — per-(tx, rx)
+log-distance rx power + NIST chunk PER + coin flip — through the host
+scalar path used by DefaultSimulatorImpl (float64 oracle math).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_NODES = 65          # AP + 64 STAs
+N_REPLICAS = 512
+N_WINDOWS = 256
+TX_PER_WINDOW = 8     # expected concurrent transmitters per window
+
+
+def tpu_rate() -> tuple[float, dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from tpudes.parallel.kernels import wifi_phy_window
+
+    key = jax.random.PRNGKey(42)
+    k_pos, k_run = jax.random.split(key)
+    positions = jax.random.uniform(k_pos, (N_NODES, 3), minval=0.0, maxval=60.0)
+    positions = positions.at[:, 2].set(0.0)
+    mode_idx = jnp.full((N_NODES,), 7, dtype=jnp.int32)     # 54 Mbps
+    frame_bytes = jnp.full((N_NODES,), 1000.0, dtype=jnp.float32)
+    tx_prob = TX_PER_WINDOW / N_NODES
+
+    def window(carry, k):
+        delivered = carry
+        k_tx, k_phy = jax.random.split(k)
+        # per-replica tx draws: (R, N)
+        tx = jax.random.uniform(k_tx, (N_REPLICAS, N_NODES)) < tx_prob
+        keys = jax.random.split(k_phy, N_REPLICAS)
+        ok, _, _ = jax.vmap(
+            lambda t, kk: wifi_phy_window(positions, t, mode_idx, frame_bytes, kk)
+        )(tx, keys)
+        return delivered + jnp.sum(ok, dtype=jnp.int32), jnp.sum(tx, dtype=jnp.int32)
+
+    @jax.jit
+    def run(k):
+        keys = jax.random.split(k, N_WINDOWS)
+        delivered, tx_counts = jax.lax.scan(window, jnp.int32(0), keys)
+        return delivered, jnp.sum(tx_counts)
+
+    # compile
+    d, ntx = run(k_run)
+    d.block_until_ready()
+    # timed
+    t0 = time.monotonic()
+    d, ntx = run(jax.random.PRNGKey(43))
+    d.block_until_ready()
+    wall = time.monotonic() - t0
+
+    evals = int(ntx) * (N_NODES - 1)  # logical (tx → rx) frame evaluations
+    # aggregate simulated time: windows are 1 ms, all replicas advance together
+    sim_s_aggregate = N_WINDOWS * 1e-3 * N_REPLICAS
+    extras = {
+        "delivered": int(d),
+        "wall_s": wall,
+        "sim_s_per_wall_s_per_chip": sim_s_aggregate / wall / max(len(jax.devices()), 1),
+        "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+    }
+    return evals / wall, extras
+
+
+def cpu_rate() -> float:
+    """Identical logical work through the sequential engine's float64
+    scalar path (the DefaultSimulatorImpl denominator)."""
+    import random
+
+    from tpudes.ops.wifi_error import ALL_MODES, chunk_success_rate_py
+
+    mode = ALL_MODES[7]
+    rng = random.Random(1)
+    noise_w = 10 ** (7 / 10) * 1.380649e-23 * 290 * 20e6
+    # pre-draw geometry like the scalar channel would see it
+    pos = [(rng.uniform(0, 60), rng.uniform(0, 60)) for _ in range(N_NODES)]
+    n_pairs = 0
+    t0 = time.monotonic()
+    target_pairs = 60_000
+    delivered = 0
+    while n_pairs < target_pairs:
+        tx_set = [i for i in range(N_NODES) if rng.random() < TX_PER_WINDOW / N_NODES]
+        for t in tx_set:
+            for r in range(N_NODES):
+                if r == t:
+                    continue
+                # log-distance rx power (float64 scalar, as CalcRxPower)
+                dx, dy = pos[t][0] - pos[r][0], pos[t][1] - pos[r][1]
+                d = max(math.sqrt(dx * dx + dy * dy), 1.0)
+                rx_dbm = 16.0206 - (46.6777 + 30.0 * math.log10(d))
+                rx_w = 10 ** ((rx_dbm - 30) / 10)
+                # interference from other concurrent tx
+                i_w = 0.0
+                for o in tx_set:
+                    if o in (t, r):
+                        continue
+                    ox, oy = pos[o][0] - pos[r][0], pos[o][1] - pos[r][1]
+                    od = max(math.sqrt(ox * ox + oy * oy), 1.0)
+                    i_w += 10 ** ((16.0206 - (46.6777 + 30.0 * math.log10(od)) - 30) / 10)
+                sinr = rx_w / (noise_w + i_w)
+                psr = chunk_success_rate_py(sinr, 8000.0, mode.constellation, mode.rate_class)
+                if rng.random() < psr:
+                    delivered += 1
+                n_pairs += 1
+    wall = time.monotonic() - t0
+    return n_pairs / wall
+
+
+def main():
+    cpu = cpu_rate()
+    tpu, extras = tpu_rate()
+    out = {
+        "metric": "wifi-bss phy frame evaluations (64 STA x 512 replicas)",
+        "value": round(tpu, 1),
+        "unit": "evals/s",
+        "vs_baseline": round(tpu / cpu, 2),
+        "baseline_evals_s": round(cpu, 1),
+        **{k: (round(v, 3) if isinstance(v, float) else v) for k, v in extras.items()},
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

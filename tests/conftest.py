@@ -15,6 +15,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# a sitecustomize may force an accelerator platform regardless of
+# JAX_PLATFORMS (e.g. the axon TPU plugin); pin the test backend to the
+# virtual CPU mesh explicitly
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
@@ -35,3 +42,6 @@ def fresh_world():
     mod = sys.modules.get("tpudes.network.node")
     if mod is not None:
         mod.NodeList.Reset()
+    eng = sys.modules.get("tpudes.parallel.engine")
+    if eng is not None:
+        eng.BatchableRegistry.reset()

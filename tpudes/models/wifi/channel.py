@@ -29,6 +29,17 @@ class YansWifiChannel(Object):
         self._phys: list = []
         self._loss = None
         self._delay = None
+        # per-window batched caches (filled by JaxSimulatorImpl)
+        self._rx_dbm_cache = None   # (N, N) host ndarray: [tx, rx]
+        self._delay_cache = None    # (N, N) seconds
+        self._phy_index: dict[int, int] = {}
+        self._geometry_dirty = True
+        self._watched_mobilities: set[int] = set()
+        self._tx_power_cache = None  # (N,) snapshot at refresh
+        self._no_batch_path = False  # loss chain lacks a batch form
+        from tpudes.parallel.engine import BatchableRegistry
+
+        BatchableRegistry.register(self)
 
     # --- wiring ---
     def Add(self, phy) -> None:
@@ -48,17 +59,35 @@ class YansWifiChannel(Object):
 
     # --- the hot loop ---
     def Send(self, sender_phy, packet, mode, tx_power_dbm: float, duration_s: float) -> None:
+        cache = self._rx_dbm_cache
+        tx_idx = None
+        if cache is not None:
+            tx_idx = self._phy_index.get(id(sender_phy))
+            if (
+                tx_idx is None
+                or cache.shape[0] != len(self._phys)
+                or abs(tx_power_dbm - self._tx_power_cache[tx_idx]) > 1e-9
+            ):
+                # phy added after refresh, or per-call power differs from
+                # the snapshot: this send takes the scalar path
+                cache = None
         sender_mob = sender_phy.GetMobility()
-        for phy in self._phys:
+        for i, phy in enumerate(self._phys):
             if phy is sender_phy:
                 continue
-            rx_mob = phy.GetMobility()
-            delay_s = self._delay.GetDelay(sender_mob, rx_mob) if self._delay else 0.0
-            rx_dbm = (
-                self._loss.CalcRxPower(tx_power_dbm, sender_mob, rx_mob)
-                if self._loss
-                else tx_power_dbm
-            )
+            if cache is not None:
+                # window-cached row: the pair math already ran as one
+                # batched kernel at the window boundary
+                rx_dbm = float(cache[tx_idx, i])
+                delay_s = float(self._delay_cache[tx_idx, i])
+            else:
+                rx_mob = phy.GetMobility()
+                delay_s = self._delay.GetDelay(sender_mob, rx_mob) if self._delay else 0.0
+                rx_dbm = (
+                    self._loss.CalcRxPower(tx_power_dbm, sender_mob, rx_mob)
+                    if self._loss
+                    else tx_power_dbm
+                )
             node = phy.GetDevice().GetNode() if phy.GetDevice() else None
             context = node.GetId() if node else 0
             Simulator.ScheduleWithContext(
@@ -70,6 +99,72 @@ class YansWifiChannel(Object):
                 rx_dbm,
                 duration_s,
             )
+
+    # --- per-window batched refresh (JaxSimulatorImpl contract) ---
+    def refresh_window_cache(self) -> None:
+        """Snapshot geometry and compute the full (tx × rx) rx-power and
+        delay tables in one batched kernel call.  Stochastic loss chains
+        (Nakagami) keep the scalar path — their draws must stay on the
+        host RNG streams for reproducibility."""
+        from tpudes.core.global_value import GlobalValue
+
+        min_phys = GlobalValue.GetValueFailSafe("JaxBatchMinPhys", 32)
+        if (
+            self._no_batch_path
+            or len(self._phys) < max(int(min_phys), 2)
+            or self._loss is None
+        ):
+            # small topologies: kernel dispatch + compile costs more than
+            # the scalar loop saves — stay on the host path
+            return
+        if self._delay is not None and not hasattr(self._delay, "speed"):
+            return  # stochastic delay model: host RNG must draw per send
+        # dirty-flag on CourseChange: static topologies pay ONE kernel
+        # dispatch total instead of one per window (host↔device round
+        # trips are the budget — SURVEY.md §7 hard part 3)
+        for phy in self._phys:
+            mob = phy.GetMobility()
+            if mob is not None and id(mob) not in self._watched_mobilities:
+                self._watched_mobilities.add(id(mob))
+                self._geometry_dirty = True
+                mob.TraceConnectWithoutContext(
+                    "CourseChange", lambda *_a: setattr(self, "_geometry_dirty", True)
+                )
+        if not self._geometry_dirty and self._rx_dbm_cache is not None and len(
+            self._phys
+        ) == self._rx_dbm_cache.shape[0]:
+            return
+        self._geometry_dirty = False
+        try:
+            import numpy as np
+            import jax.numpy as jnp
+
+            from tpudes.ops.propagation import pairwise_distance
+
+            positions = np.zeros((len(self._phys), 3), dtype=np.float32)
+            tx_power = np.zeros((len(self._phys),), dtype=np.float32)
+            self._phy_index = {id(p): i for i, p in enumerate(self._phys)}
+            for i, phy in enumerate(self._phys):
+                mob = phy.GetMobility()
+                if mob is None:
+                    return  # geometry unknown: stay on the scalar path
+                pos = mob.GetPosition()
+                positions[i] = (pos.x, pos.y, pos.z)
+                tx_power[i] = phy.GetTxPowerDbm()
+            d = pairwise_distance(jnp.asarray(positions))
+            rx = self._loss.batch_rx_power(jnp.asarray(tx_power)[:, None], d)
+            self._rx_dbm_cache = np.asarray(rx)
+            if self._delay is not None:
+                self._delay_cache = np.asarray(d) / self._delay.speed
+            else:
+                self._delay_cache = np.zeros_like(np.asarray(d))  # scalar path uses 0.0
+            self._tx_power_cache = tx_power
+        except NotImplementedError:
+            # chain contains a model without a batch path: remember, so we
+            # don't redo the failed build every window
+            self._no_batch_path = True
+            self._rx_dbm_cache = None
+            self._delay_cache = None
 
     # --- batched form (window engine) ---
     def rx_power_row(self, tx_power_dbm, tx_index: int, positions):

@@ -1,0 +1,27 @@
+"""Parallel/TPU execution layer: the windowed engine, fused window
+kernels, replica axis, and device-mesh collectives.
+
+SURVEY.md §2.3, §5.8, §7 steps 4/7 — the reference's MPI machinery maps
+here to XLA collectives over the device mesh; the Monte-Carlo RngRun
+axis becomes vmap/shard_map over replicas.
+
+Importing this module registers ``tpudes::JaxSimulatorImpl`` at the
+SimulatorImplementationType seam (one-GlobalValue opt-in, as in
+BASELINE.json's north star).
+"""
+
+from tpudes.parallel.engine import BatchableRegistry, JaxSimulatorImpl
+from tpudes.parallel.kernels import (
+    WindowParams,
+    lte_tti_sinr,
+    multi_window_scan,
+    replicated,
+    wifi_phy_window,
+)
+from tpudes.parallel.mesh import (
+    lbts_grant,
+    make_replica_batch,
+    replica_mesh,
+    shard_leading_axis,
+    sharded_window_step,
+)

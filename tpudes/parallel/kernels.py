@@ -1,0 +1,149 @@
+"""Fused window kernels — the TPU fast path of the simulator.
+
+This is the north star's compute core (BASELINE.json): per conservative
+time window, the (node × link × replica) PHY math of SURVEY.md §3.2 is
+evaluated as ONE jitted kernel instead of O(N²) Python callbacks:
+
+    positions ─► pairwise distance ─► loss chain ─► rx power matrix
+    tx mask   ─► SINR (all concurrent tx as interference) ─► NIST PER
+    rng key   ─► per-frame success coin flips ─► rx-event mask
+
+``wifi_phy_window`` is the single-replica kernel; ``replicated`` vmaps
+it over a replica axis of RNG keys (Monte-Carlo over RngRun — the DP
+analog, SURVEY.md §2.3); the mesh-sharded form lives in
+:mod:`tpudes.parallel.mesh`.
+
+Abstraction level: within one window all active transmissions are
+treated as overlapping (synchronized-slot interference), the same
+granularity upstream's LTE model uses per TTI and the granted-time-
+window PDES uses per grant.  The scalar host DES path remains the exact
+per-event oracle; tests compare the two at matched scenarios.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from tpudes.ops.interference import thermal_noise_w
+from tpudes.ops.propagation import dbm_to_w, log_distance, pairwise_distance
+from tpudes.ops.wifi_error import mode_chunk_success_rate
+
+
+@dataclass(frozen=True)
+class WindowParams:
+    """Static (trace-time) parameters of the window kernel."""
+
+    tx_power_dbm: float = 16.0206
+    noise_figure_db: float = 7.0
+    bandwidth_hz: float = 20e6
+    path_loss_exponent: float = 3.0
+    reference_loss_db: float = 46.6777
+    rx_sensitivity_dbm: float = -101.0
+
+    @property
+    def noise_w(self) -> float:
+        return float(thermal_noise_w(self.bandwidth_hz, self.noise_figure_db))
+
+
+def wifi_phy_window(
+    positions: jax.Array,   # (N, 3) float32
+    tx_active: jax.Array,   # (N,)  bool/0-1: transmitting this window
+    mode_idx: jax.Array,    # (N,)  int32 WifiMode per transmitter
+    frame_bytes: jax.Array, # (N,)  float32 PSDU size per transmitter
+    key: jax.Array,         # PRNG key (per replica)
+    params: WindowParams = WindowParams(),
+):
+    """One conservative window of the Yans PHY for one replica.
+
+    Returns ``(ok, sinr, rx_dbm)``:
+      ok    (N, N) bool — ok[t, r]: r decodes t's frame this window
+      sinr  (N, N) float32 — post-interference SINR per (tx, rx) pair
+      rx_dbm(N, N) float32 — rx power matrix (loss chain applied)
+    """
+    n = positions.shape[0]
+    tx_active = tx_active.astype(jnp.float32)
+
+    d = pairwise_distance(positions)                       # (N, N)
+    rx_dbm = log_distance(
+        params.tx_power_dbm, d,
+        exponent=params.path_loss_exponent,
+        reference_loss_db=params.reference_loss_db,
+    )
+    eye = jnp.eye(n, dtype=bool)
+    rx_w = jnp.where(eye, 0.0, dbm_to_w(rx_dbm)) * tx_active[:, None]  # (tx, rx)
+
+    # total signal power arriving at each receiver from all active tx
+    total_w = jnp.sum(rx_w, axis=0)                        # (N,)
+    interference = total_w[None, :] - rx_w                 # exclude own signal
+    sinr = rx_w / (params.noise_w + interference)
+
+    nbits = 8.0 * frame_bytes[:, None]
+    psr = mode_chunk_success_rate(sinr, nbits, mode_idx[:, None])
+    coin = jax.random.uniform(key, (n, n))
+    detectable = rx_dbm >= params.rx_sensitivity_dbm
+    receiving = (1.0 - tx_active)[None, :] > 0             # half-duplex rx
+    ok = (
+        (coin < psr)
+        & detectable
+        & receiving
+        & (tx_active[:, None] > 0)
+        & ~eye
+    )
+    return ok, sinr, rx_dbm
+
+
+def replicated(kernel=wifi_phy_window):
+    """vmap a window kernel over the replica axis: all array args gain a
+    leading R dimension; ``params`` stays static."""
+
+    def run(positions, tx_active, mode_idx, frame_bytes, keys, params=WindowParams()):
+        return jax.vmap(
+            lambda p, t, m, f, k: kernel(p, t, m, f, k, params)
+        )(positions, tx_active, mode_idx, frame_bytes, keys)
+
+    return run
+
+
+@functools.partial(jax.jit, static_argnames=("n_windows",))
+def multi_window_scan(positions, tx_prob, mode_idx, frame_bytes, key, n_windows: int = 16):
+    """Run ``n_windows`` consecutive windows under jit with lax.scan —
+    per-window tx sets drawn Bernoulli(tx_prob); accumulates delivered
+    frame counts.  This is the shape of the bench inner loop: zero host
+    round-trips inside the scan (SURVEY.md §7 hard part 3)."""
+
+    def step(carry, k):
+        delivered = carry
+        k_tx, k_phy = jax.random.split(k)
+        tx = jax.random.uniform(k_tx, (positions.shape[0],)) < tx_prob
+        ok, _, _ = wifi_phy_window(positions, tx, mode_idx, frame_bytes, k_phy)
+        return delivered + jnp.sum(ok, dtype=jnp.int32), None
+
+    keys = jax.random.split(key, n_windows)
+    total, _ = jax.lax.scan(step, jnp.int32(0), keys)
+    return total
+
+
+# --- LTE TTI kernel (SURVEY.md §3.4 shape; full LTE slice lands with the
+# LTE module, this is the spectral core) ------------------------------------
+
+
+def lte_tti_sinr(
+    tx_psd_w: jax.Array,     # (E, RB) per-eNB tx PSD over resource blocks
+    gain: jax.Array,         # (E, U) linear path gain eNB→UE
+    serving: jax.Array,      # (U,) int32 serving eNB per UE
+    noise_psd_w: float,
+):
+    """Per-RB SINR for each UE in one TTI: serving signal over sum of
+    other-cell interference + noise (LteInterference chunk processing,
+    dense over the RB grid)."""
+    # power seen by UE u from eNB e on each RB: (E, U, RB)
+    seen = tx_psd_w[:, None, :] * gain[:, :, None]
+    total = jnp.sum(seen, axis=0)                          # (U, RB)
+    sig = jnp.take_along_axis(
+        seen, serving[None, :, None], axis=0
+    )[0]                                                   # (U, RB)
+    return sig / (total - sig + noise_psd_w)
