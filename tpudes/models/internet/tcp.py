@@ -1,0 +1,618 @@
+"""TCP: header, L4 protocol, and the socket state machine.
+
+Reference parity: src/internet/model/tcp-l4-protocol.{h,cc},
+tcp-header.{h,cc}, tcp-socket-base.{h,cc}, tcp-tx-buffer / tcp-rx-buffer
+(upstream paths; mount empty at survey — SURVEY.md §0).
+
+Round-1 scope (SURVEY.md §2.7): full 3-way handshake, byte-accurate
+sliding window with cumulative acks, RFC 6298 RTO with Karn's rule and
+exponential backoff, fast retransmit + NewReno fast recovery, pluggable
+TcpCongestionOps (see tcp_congestion.py), FIN teardown with TIME_WAIT.
+SACK, ECN/DCTCP, window scaling and timestamps are later rounds — the
+seams are the header option field and the buffer classes.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import Seconds
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+from tpudes.models.internet.tcp_congestion import (
+    TCP_VARIANTS,
+    TcpCongestionOps,
+    TcpNewReno,
+    TcpSocketState,
+)
+from tpudes.models.internet.udp import Ipv4EndPointDemux
+from tpudes.network.address import InetSocketAddress, Ipv4Address
+from tpudes.network.packet import Header, Packet
+from tpudes.network.socket import Socket
+
+
+class TcpHeader(Header):
+    FIN = 0x01
+    SYN = 0x02
+    RST = 0x04
+    PSH = 0x08
+    ACK = 0x10
+
+    def __init__(self, source_port=0, destination_port=0, seq=0, ack=0, flags=0, window=65535):
+        self.source_port = source_port
+        self.destination_port = destination_port
+        self.seq = seq
+        self.ack = ack
+        self.flags = flags
+        self.window = window
+
+    def GetSerializedSize(self) -> int:
+        return 20
+
+    def Serialize(self) -> bytes:
+        return struct.pack(
+            ">HHIIBBHHH",
+            self.source_port, self.destination_port,
+            self.seq & 0xFFFFFFFF, self.ack & 0xFFFFFFFF,
+            5 << 4, self.flags, self.window & 0xFFFF, 0, 0,
+        )
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        sp, dp, seq, ack, _off, flags, window, _ck, _up = struct.unpack(">HHIIBBHHH", data[:20])
+        return cls(sp, dp, seq, ack, flags, window)
+
+    def __repr__(self):
+        names = [n for n, bit in (("FIN", 1), ("SYN", 2), ("RST", 4), ("PSH", 8), ("ACK", 16)) if self.flags & bit]
+        return f"TcpHeader({'|'.join(names) or 'none'}, seq={self.seq}, ack={self.ack})"
+
+
+class TcpL4Protocol(Object):
+    PROT_NUMBER = 6
+
+    tid = (
+        TypeId("tpudes::TcpL4Protocol")
+        .AddConstructor(lambda **kw: TcpL4Protocol(**kw))
+        .AddAttribute(
+            "SocketType",
+            "default TcpCongestionOps for new sockets (the tcp-variants knob)",
+            "TcpNewReno",
+            field="socket_type",
+        )
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        self._demux = Ipv4EndPointDemux()
+        self._sockets: list = []
+
+    def SetNode(self, node) -> None:
+        self._node = node
+
+    def CreateSocket(self, variant: type | str | None = None) -> "TcpSocketBase":
+        sock = TcpSocketBase()
+        sock.SetNode(self._node)
+        sock._tcp = self
+        if variant is None:
+            variant = self.socket_type
+        if isinstance(variant, str):
+            variant = TCP_VARIANTS[variant.replace("tpudes::", "").replace("ns3::", "")]
+        sock.SetCongestionControl(variant())
+        self._sockets.append(sock)
+        return sock
+
+    def Send(self, packet, saddr, daddr, sport, dport, route=None):
+        header = TcpHeader()  # placeholder: sockets add their own header
+        raise NotImplementedError("sockets serialize their own segments")
+
+    def SendPacket(self, packet, tcp_header, saddr, daddr, route=None):
+        packet.AddHeader(tcp_header)
+        ipv4 = self._node.GetObject(Ipv4L3Protocol)
+        ipv4.Send(packet, saddr, daddr, self.PROT_NUMBER, route)
+
+    def Receive(self, packet, ip_header, incoming_interface):
+        header = packet.RemoveHeader(TcpHeader)
+        ep = self._demux.Lookup(
+            ip_header.destination, header.destination_port,
+            ip_header.source, header.source_port,
+        )
+        if ep is None:
+            return  # no listener: upstream sends RST; round-1: drop
+        ep.rx_callback(packet, header, ip_header)
+
+
+MSL_S = 120.0  # max segment lifetime (TIME_WAIT = 2 MSL)
+
+
+class TcpSocketBase(Socket):
+    """The TCP state machine (tcp-socket-base.cc), byte-accurate window
+    bookkeeping with dummy payload bytes."""
+
+    CLOSED = 0
+    LISTEN = 1
+    SYN_SENT = 2
+    SYN_RCVD = 3
+    ESTABLISHED = 4
+    FIN_WAIT_1 = 5
+    FIN_WAIT_2 = 6
+    CLOSE_WAIT = 7
+    CLOSING = 8
+    LAST_ACK = 9
+    TIME_WAIT = 10
+
+    tid = (
+        TypeId("tpudes::TcpSocketBase")
+        .SetParent(Socket.tid)
+        .AddConstructor(lambda **kw: TcpSocketBase(**kw))
+        .AddAttribute("SegmentSize", "MSS (bytes)", 536, field="segment_size")
+        .AddAttribute("InitialCwnd", "initial cwnd (segments)", 10, field="initial_cwnd")
+        .AddAttribute("SndBufSize", "tx buffer (bytes)", 131072, field="snd_buf_size")
+        .AddAttribute("RcvBufSize", "rx buffer (bytes)", 131072, field="rcv_buf_size")
+        .AddAttribute("MinRto", "minimum RTO (s)", 1.0, field="min_rto_s")
+        .AddAttribute("InitialRto", "initial RTO (s)", 1.0, field="initial_rto_s")
+        .AddTraceSource("CongestionWindow", "(old, new)")
+        .AddTraceSource("SlowStartThreshold", "(old, new)")
+        .AddTraceSource("State", "(old, new)")
+        .AddTraceSource("Tx", "(packet, header)")
+        .AddTraceSource("RxAck", "(ack)")
+        .AddTraceSource("Retransmit", "(seq)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._tcp: TcpL4Protocol | None = None
+        self._state = self.CLOSED
+        self._endpoint = None
+        self._cong: TcpCongestionOps = TcpNewReno()
+        self._tcb = TcpSocketState(self.segment_size, self.initial_cwnd)
+        # sender state
+        self._snd_una = 0        # first unacked byte
+        self._snd_nxt = 0        # next byte to send
+        self._tx_unsent = 0      # bytes queued, not yet segmented
+        self._segments: dict[int, dict] = {}  # seq -> {size, tx_ts, retx}
+        self._dupack_count = 0
+        self._recover = 0
+        self._rto_event = None
+        self._rto_s = self.initial_rto_s
+        self._srtt = None
+        self._rttvar = None
+        self._backoff = 0
+        # receiver state
+        self._rcv_nxt = 0
+        self._ooo: dict[int, int] = {}  # seq -> size (out of order)
+        self._rx_available = 0
+        self._peer_rwnd = 65535
+        self._fin_rcvd_seq = None
+        self._sent_fin = False
+        self._connected = False
+
+    # --- setup ---
+    def SetCongestionControl(self, ops: TcpCongestionOps) -> None:
+        self._cong = ops
+        if hasattr(ops, "set_clock"):
+            ops.set_clock(lambda: Simulator.Now().GetSeconds())
+
+    def GetCongestionControl(self):
+        return self._cong
+
+    def _set_state(self, new_state):
+        old, self._state = self._state, new_state
+        self.state(old, new_state)
+
+    def _ipv4(self):
+        return self._node.GetObject(Ipv4L3Protocol)
+
+    # --- Socket API ---
+    def Bind(self, address: InetSocketAddress = None) -> int:
+        if address is None:
+            self._endpoint = self._tcp._demux.Allocate()
+        else:
+            self._endpoint = self._tcp._demux.Allocate(address.ipv4, address.port)
+        if self._endpoint is None:
+            self._errno = 2
+            return -1
+        self._endpoint.rx_callback = self._receive
+        return 0
+
+    def Listen(self) -> int:
+        if self._endpoint is None:
+            self._errno = 7
+            return -1
+        self._set_state(self.LISTEN)
+        return 0
+
+    def Connect(self, address: InetSocketAddress) -> int:
+        if self._endpoint is None and self.Bind() != 0:
+            return -1
+        self._endpoint.SetPeer(address.ipv4, address.port)
+        if self._endpoint.local_addr.IsAny():
+            # resolve the source address from the route to the peer
+            # (upstream SetupEndpoint)
+            from tpudes.models.internet.ipv4 import Ipv4Header
+
+            probe = Ipv4Header(destination=address.ipv4)
+            route, _errno = self._ipv4().GetRoutingProtocol().RouteOutput(None, probe)
+            if route is None:
+                self._errno = 10  # ERROR_NOROUTETOHOST
+                return -1
+            self._endpoint.local_addr = route.source
+        self._remote = address
+        self._set_state(self.SYN_SENT)
+        self._send_flags(TcpHeader.SYN, seq=self._snd_nxt)
+        self._schedule_rto()
+        return 0
+
+    def Send(self, packet, flags: int = 0) -> int:
+        size = packet.GetSize() if hasattr(packet, "GetSize") else int(packet)
+        if self._state not in (self.ESTABLISHED, self.SYN_SENT, self.SYN_RCVD, self.CLOSE_WAIT):
+            self._errno = 6
+            return -1
+        if self.GetTxAvailable() < size:
+            self._errno = 11  # ERROR_MSGSIZE/again
+            return -1
+        self._tx_unsent += size
+        if self._state in (self.ESTABLISHED, self.CLOSE_WAIT):
+            self._send_pending()
+        return size
+
+    def GetTxAvailable(self) -> int:
+        in_buffer = self._tx_unsent + (self._snd_nxt - self._snd_una)
+        return max(self.snd_buf_size - in_buffer, 0)
+
+    def GetRxAvailable(self) -> int:
+        return self._rx_available
+
+    def Recv(self, max_size: int = 0xFFFFFFFF, flags: int = 0):
+        size = min(self._rx_available, max_size)
+        if size <= 0:
+            return None
+        self._rx_available -= size
+        return Packet(size)
+
+    def RecvFrom(self, max_size: int = 0xFFFFFFFF, flags: int = 0):
+        packet = self.Recv(max_size, flags)
+        if packet is None:
+            return None, None
+        return packet, InetSocketAddress(self._endpoint.peer_addr, self._endpoint.peer_port)
+
+    def Close(self) -> int:
+        if self._state in (self.ESTABLISHED, self.SYN_RCVD):
+            if self._tx_unsent > 0 or self._snd_nxt > self._snd_una:
+                self._closing_after_tx = True  # FIN after the buffer drains
+                return 0
+            self._send_fin()
+            self._set_state(self.FIN_WAIT_1)
+        elif self._state == self.CLOSE_WAIT:
+            if self._tx_unsent > 0 or self._snd_nxt > self._snd_una:
+                self._closing_after_tx = True
+                return 0
+            self._send_fin()
+            self._set_state(self.LAST_ACK)
+        elif self._state == self.LISTEN or self._state == self.SYN_SENT:
+            self._set_state(self.CLOSED)
+            self._cancel_rto()
+        return 0
+
+    # --- segment tx ---
+    def _header(self, flags, seq=None, ack=None):
+        return TcpHeader(
+            source_port=self._endpoint.local_port,
+            destination_port=self._endpoint.peer_port,
+            seq=seq if seq is not None else self._snd_nxt,
+            ack=ack if ack is not None else self._rcv_nxt,
+            flags=flags,
+            window=min(self.rcv_buf_size - self._rx_available, 65535),
+        )
+
+    def _send_flags(self, flags, seq=None, size=0):
+        header = self._header(flags, seq=seq)
+        packet = Packet(size)
+        self.tx(packet, header)
+        self._tcp.SendPacket(
+            packet, header, self._endpoint.local_addr, self._endpoint.peer_addr
+        )
+        if flags & TcpHeader.SYN or flags & TcpHeader.FIN:
+            seq_used = header.seq
+            self._segments[seq_used] = {
+                "size": 1, "tx_ts": Simulator.Now().GetSeconds(), "retx": 0,
+                "flags": flags,
+            }
+            self._snd_nxt = max(self._snd_nxt, seq_used + 1)
+
+    def _send_fin(self):
+        self._sent_fin = True
+        self._send_flags(TcpHeader.FIN | TcpHeader.ACK)
+        self._schedule_rto()
+
+    def _available_window(self) -> int:
+        in_flight = self._snd_nxt - self._snd_una
+        self._tcb.bytes_in_flight = in_flight
+        return max(min(self._tcb.cwnd, self._peer_rwnd) - in_flight, 0)
+
+    def _send_pending(self):
+        while self._tx_unsent > 0 and self._available_window() >= min(
+            self.segment_size, self._tx_unsent
+        ):
+            size = min(self.segment_size, self._tx_unsent)
+            self._tx_unsent -= size
+            seq = self._snd_nxt
+            self._segments[seq] = {
+                "size": size, "tx_ts": Simulator.Now().GetSeconds(), "retx": 0,
+                "flags": TcpHeader.ACK,
+            }
+            self._snd_nxt += size
+            header = self._header(TcpHeader.ACK, seq=seq)
+            packet = Packet(size)
+            self.tx(packet, header)
+            self._tcp.SendPacket(
+                packet, header, self._endpoint.local_addr, self._endpoint.peer_addr
+            )
+            self._schedule_rto(only_if_unset=True)
+        if (
+            getattr(self, "_closing_after_tx", False)
+            and self._tx_unsent == 0
+            and not self._sent_fin
+        ):
+            self._send_fin()
+            self._set_state(
+                self.FIN_WAIT_1 if self._state == self.ESTABLISHED else self.LAST_ACK
+            )
+
+    def _retransmit_seq(self, seq):
+        seg = self._segments.get(seq)
+        if seg is None:
+            return
+        seg["retx"] += 1
+        seg["tx_ts"] = None  # Karn: no RTT sample from retransmits
+        self.retransmit(seq)
+        flags = seg.get("flags", TcpHeader.ACK)
+        header = self._header(flags, seq=seq)
+        size = 0 if flags & (TcpHeader.SYN | TcpHeader.FIN) else seg["size"]
+        packet = Packet(size)
+        self._tcp.SendPacket(
+            packet, header, self._endpoint.local_addr, self._endpoint.peer_addr
+        )
+
+    # --- RTO ---
+    def _schedule_rto(self, only_if_unset=False):
+        if only_if_unset and self._rto_event is not None:
+            return
+        self._cancel_rto()
+        self._rto_event = Simulator.Schedule(
+            Seconds(self._rto_s * (2 ** self._backoff)), self._on_rto
+        )
+
+    def _cancel_rto(self):
+        if self._rto_event is not None:
+            self._rto_event.Cancel()
+            self._rto_event = None
+
+    def _on_rto(self):
+        self._rto_event = None
+        if self._snd_una >= self._snd_nxt and self._state not in (
+            self.SYN_SENT, self.SYN_RCVD, self.FIN_WAIT_1, self.LAST_ACK, self.CLOSING
+        ):
+            return
+        self._backoff = min(self._backoff + 1, 8)
+        if self._state in (self.ESTABLISHED, self.CLOSE_WAIT, self.FIN_WAIT_1):
+            old = self._tcb.ssthresh
+            self._tcb.ssthresh = self._cong.GetSsThresh(self._tcb, self._snd_nxt - self._snd_una)
+            self.slow_start_threshold(old, self._tcb.ssthresh)
+            old_cwnd = self._tcb.cwnd
+            self._tcb.cwnd = self._tcb.segment_size
+            self.congestion_window(old_cwnd, self._tcb.cwnd)
+            self._tcb.cong_state = TcpSocketState.CA_LOSS
+            self._cong.CongestionStateSet(self._tcb, TcpSocketState.CA_LOSS)
+            self._dupack_count = 0
+        self._retransmit_seq(self._snd_una)
+        self._schedule_rto()
+
+    def _rtt_sample(self, rtt_s: float):
+        if self._srtt is None:
+            self._srtt = rtt_s
+            self._rttvar = rtt_s / 2
+        else:
+            self._rttvar = 0.75 * self._rttvar + 0.25 * abs(self._srtt - rtt_s)
+            self._srtt = 0.875 * self._srtt + 0.125 * rtt_s
+        self._rto_s = max(self._srtt + 4 * self._rttvar, self.min_rto_s)
+        self._tcb.last_rtt_s = rtt_s
+        self._tcb.min_rtt_s = min(self._tcb.min_rtt_s, rtt_s)
+
+    # --- rx ---
+    def _receive(self, packet, header: TcpHeader, ip_header):
+        self._peer_rwnd = header.window
+        if self._state == self.LISTEN:
+            if header.flags & TcpHeader.SYN:
+                self._handle_listen_syn(packet, header, ip_header)
+            return
+        if self._state == self.SYN_SENT:
+            if header.flags & TcpHeader.SYN and header.flags & TcpHeader.ACK:
+                self._rcv_nxt = header.seq + 1
+                self._process_ack(header, payload_size=packet.GetSize())
+                self._set_state(self.ESTABLISHED)
+                self._connected = True
+                self._backoff = 0
+                self._send_flags(TcpHeader.ACK)
+                self.NotifyConnectionSucceeded()
+                self._send_pending()
+            return
+        if self._state == self.SYN_RCVD:
+            if header.flags & TcpHeader.ACK and header.ack >= self._snd_una + 1:
+                self._process_ack(header, payload_size=packet.GetSize())
+                self._set_state(self.ESTABLISHED)
+                self._connected = True
+                self._backoff = 0
+                self.NotifyNewConnectionCreated(
+                    self,
+                    InetSocketAddress(self._endpoint.peer_addr, self._endpoint.peer_port),
+                )
+                self._send_pending()
+            # fall through: SYN+ACK retransmission handled by RTO
+        if header.flags & TcpHeader.ACK:
+            self._process_ack(header, payload_size=packet.GetSize())
+        if packet.GetSize() > 0 or header.flags & TcpHeader.FIN:
+            self._process_data(packet, header)
+
+    def _handle_listen_syn(self, packet, header, ip_header):
+        if not self.NotifyConnectionRequest(
+            InetSocketAddress(ip_header.source, header.source_port)
+        ):
+            return
+        # fork a new socket for this connection (upstream CompleteFork)
+        fork = self._tcp.CreateSocket()
+        fork._cong = type(self._cong)()
+        fork.SetCongestionControl(fork._cong)
+        fork.segment_size = self.segment_size
+        fork._tcb = TcpSocketState(self.segment_size, self.initial_cwnd)
+        fork._endpoint = self._tcp._demux.Allocate4(
+            ip_header.destination, self._endpoint.local_port,
+            ip_header.source, header.source_port,
+        )
+        fork._endpoint.rx_callback = fork._receive
+        fork._rcv_nxt = header.seq + 1
+        fork._set_state(self.SYN_RCVD)
+        # inherit the listener's callbacks (upstream CompleteFork)
+        fork._accept_request_cb = self._accept_request_cb
+        fork._new_connection_cb = self._new_connection_cb
+        fork._recv_callback = self._recv_callback
+        fork._send_cb = self._send_cb
+        fork._close_cb = self._close_cb
+        fork._send_flags(TcpHeader.SYN | TcpHeader.ACK)
+        fork._schedule_rto()
+
+    def _process_ack(self, header, payload_size: int = 0):
+        ack = header.ack
+        if ack > self._snd_una:
+            self.rx_ack(ack)
+            acked_bytes = 0
+            segments_acked = 0
+            now_s = Simulator.Now().GetSeconds()
+            for seq in sorted(self._segments):
+                seg = self._segments[seq]
+                if seq + seg["size"] <= ack:
+                    acked_bytes += seg["size"]
+                    segments_acked += 1
+                    if seg["tx_ts"] is not None:
+                        self._rtt_sample(now_s - seg["tx_ts"])
+                    del self._segments[seq]
+            self._snd_una = ack
+            self._backoff = 0
+            self._dupack_count = 0
+            self._cong.PktsAcked(self._tcb, segments_acked, self._tcb.last_rtt_s)
+            if self._tcb.cong_state == TcpSocketState.CA_RECOVERY:
+                if ack >= self._recover:  # full ack: leave recovery
+                    old = self._tcb.cwnd
+                    self._tcb.cwnd = min(self._tcb.ssthresh, self._snd_nxt - self._snd_una + self._tcb.segment_size)
+                    self.congestion_window(old, self._tcb.cwnd)
+                    self._tcb.cong_state = TcpSocketState.CA_OPEN
+                    self._cong.CongestionStateSet(self._tcb, TcpSocketState.CA_OPEN)
+                else:  # partial ack: retransmit next hole (NewReno)
+                    self._retransmit_seq(self._snd_una)
+            elif self._tcb.cong_state == TcpSocketState.CA_LOSS:
+                self._tcb.cong_state = TcpSocketState.CA_OPEN
+                self._cong.CongestionStateSet(self._tcb, TcpSocketState.CA_OPEN)
+                old = self._tcb.cwnd
+                self._cong.IncreaseWindow(self._tcb, segments_acked)
+                self.congestion_window(old, self._tcb.cwnd)
+            else:
+                old = self._tcb.cwnd
+                self._cong.IncreaseWindow(self._tcb, segments_acked)
+                if old != self._tcb.cwnd:
+                    self.congestion_window(old, self._tcb.cwnd)
+            if self._snd_una >= self._snd_nxt:
+                self._cancel_rto()
+                self._handle_all_acked()
+            else:
+                self._schedule_rto()
+            self._send_pending()
+            self.NotifySend(self.GetTxAvailable())
+        elif (
+            ack == self._snd_una
+            and self._snd_nxt > self._snd_una
+            and payload_size == 0
+            and header.flags == TcpHeader.ACK
+        ):
+            self._dupack_count += 1
+            if self._tcb.cong_state == TcpSocketState.CA_RECOVERY:
+                self._tcb.cwnd += self._tcb.segment_size  # inflate
+                self._send_pending()
+            elif self._dupack_count == 3:
+                # fast retransmit + enter recovery
+                old = self._tcb.ssthresh
+                self._tcb.ssthresh = self._cong.GetSsThresh(self._tcb, self._snd_nxt - self._snd_una)
+                self.slow_start_threshold(old, self._tcb.ssthresh)
+                old_cwnd = self._tcb.cwnd
+                self._tcb.cwnd = self._tcb.ssthresh + 3 * self._tcb.segment_size
+                self.congestion_window(old_cwnd, self._tcb.cwnd)
+                self._tcb.cong_state = TcpSocketState.CA_RECOVERY
+                self._cong.CongestionStateSet(self._tcb, TcpSocketState.CA_RECOVERY)
+                self._recover = self._snd_nxt
+                self._retransmit_seq(self._snd_una)
+
+    def _handle_all_acked(self):
+        if self._state == self.FIN_WAIT_1 and self._sent_fin:
+            self._set_state(self.FIN_WAIT_2)
+        elif self._state == self.CLOSING:
+            self._enter_time_wait()
+        elif self._state == self.LAST_ACK:
+            self._set_state(self.CLOSED)
+            self._cleanup()
+            self.NotifyNormalClose()
+
+    def _process_data(self, packet, header):
+        size = packet.GetSize()
+        seq = header.seq
+        fin = bool(header.flags & TcpHeader.FIN)
+        if size > 0:
+            if seq == self._rcv_nxt:
+                self._rcv_nxt += size
+                self._rx_available += size
+                # drain contiguous out-of-order segments
+                while self._rcv_nxt in self._ooo:
+                    s = self._ooo.pop(self._rcv_nxt)
+                    self._rcv_nxt += s
+                    self._rx_available += s
+                self.NotifyDataRecv()
+            elif seq > self._rcv_nxt:
+                self._ooo[seq] = size
+            # else: duplicate, re-ack
+        if fin:
+            fin_seq = seq + size
+            if fin_seq == self._rcv_nxt:
+                self._rcv_nxt += 1
+                self._handle_fin()
+        # ack everything we have (immediate ack; DelAck is a later knob)
+        if self._state in (
+            self.ESTABLISHED, self.FIN_WAIT_1, self.FIN_WAIT_2,
+            self.CLOSE_WAIT, self.CLOSING, self.TIME_WAIT, self.LAST_ACK,
+        ):
+            self._send_flags(TcpHeader.ACK)
+
+    def _handle_fin(self):
+        if self._state == self.ESTABLISHED:
+            self._set_state(self.CLOSE_WAIT)
+            self.NotifyNormalClose()
+        elif self._state == self.FIN_WAIT_1:
+            self._set_state(self.CLOSING)
+        elif self._state == self.FIN_WAIT_2:
+            self._enter_time_wait()
+
+    def _enter_time_wait(self):
+        self._set_state(self.TIME_WAIT)
+        self._cancel_rto()
+        Simulator.Schedule(Seconds(2 * MSL_S), self._time_wait_done)
+
+    def _time_wait_done(self):
+        self._set_state(self.CLOSED)
+        self._cleanup()
+        self.NotifyNormalClose()
+
+    def _cleanup(self):
+        self._cancel_rto()
+        if self._endpoint is not None:
+            self._tcp._demux.DeAllocate(self._endpoint)
+            self._endpoint = None
+
+

@@ -1,0 +1,334 @@
+"""TCP congestion-control algorithms (the tcp-variants axis).
+
+Reference parity: src/internet/model/tcp-congestion-ops.{h,cc} and the
+per-variant files tcp-{cubic,scalable,highspeed,vegas,veno}.cc (upstream
+paths; mount empty at survey — SURVEY.md §0).  The pluggable seam is the
+``TcpCongestionOps`` interface consumed by TcpSocketBase: cwnd growth,
+ssthresh on loss, and (for delay-based variants) per-ack RTT hooks.
+
+All state lives in the shared ``TcpSocketState`` (tcb), as upstream.
+"""
+
+from __future__ import annotations
+
+import math
+
+from tpudes.core.object import Object, TypeId
+
+
+class TcpSocketState:
+    """The tcb shared between socket and congestion ops
+    (tcp-socket-state.h)."""
+
+    # congestion states (tcp-socket-state.h TcpCongState_t)
+    CA_OPEN = 0
+    CA_DISORDER = 1
+    CA_CWR = 2
+    CA_RECOVERY = 3
+    CA_LOSS = 4
+
+    def __init__(self, segment_size=536, initial_cwnd_segments=10, initial_ssthresh=0xFFFFFFFF):
+        self.segment_size = segment_size
+        self.cwnd = initial_cwnd_segments * segment_size
+        self.ssthresh = initial_ssthresh
+        self.cong_state = self.CA_OPEN
+        self.last_rtt_s: float | None = None
+        self.min_rtt_s: float = math.inf
+        self.bytes_in_flight = 0
+
+    def GetCwndInSegments(self) -> float:
+        return self.cwnd / self.segment_size
+
+
+class TcpCongestionOps(Object):
+    tid = TypeId("tpudes::TcpCongestionOps")
+
+    def GetName(self) -> str:
+        return type(self).__name__
+
+    def IncreaseWindow(self, tcb: TcpSocketState, segments_acked: int) -> None:
+        raise NotImplementedError
+
+    def GetSsThresh(self, tcb: TcpSocketState, bytes_in_flight: int) -> int:
+        raise NotImplementedError
+
+    def PktsAcked(self, tcb: TcpSocketState, segments_acked: int, rtt_s: float) -> None:
+        pass
+
+    def CongestionStateSet(self, tcb: TcpSocketState, new_state: int) -> None:
+        pass
+
+
+class TcpNewReno(TcpCongestionOps):
+    """Slow start + AIMD congestion avoidance (tcp-congestion-ops.cc
+    TcpNewReno — the upstream base behavior)."""
+
+    tid = (
+        TypeId("tpudes::TcpNewReno")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpNewReno(**kw))
+    )
+
+    def SlowStart(self, tcb, segments_acked) -> int:
+        if segments_acked >= 1:
+            tcb.cwnd += tcb.segment_size
+            return segments_acked - 1
+        return segments_acked
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        if segments_acked > 0:
+            adder = max(1.0, (segments_acked * tcb.segment_size * tcb.segment_size) / tcb.cwnd)
+            tcb.cwnd += int(adder)
+
+    def IncreaseWindow(self, tcb, segments_acked) -> None:
+        if tcb.cwnd < tcb.ssthresh:
+            segments_acked = self.SlowStart(tcb, segments_acked)
+        if tcb.cwnd >= tcb.ssthresh:
+            self.CongestionAvoidance(tcb, segments_acked)
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        return max(2 * tcb.segment_size, bytes_in_flight // 2)
+
+
+class TcpCubic(TcpCongestionOps):
+    """CUBIC (RFC 8312; tcp-cubic.cc): w(t) = C(t-K)³ + w_max, with TCP-
+    friendly region and fast convergence."""
+
+    tid = (
+        TypeId("tpudes::TcpCubic")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpCubic(**kw))
+        .AddAttribute("C", "cubic scaling", 0.4, field="c")
+        .AddAttribute("Beta", "multiplicative decrease", 0.7, field="beta")
+        .AddAttribute("FastConvergence", "", True, field="fast_convergence")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._w_max = 0.0
+        self._epoch_start_s: float | None = None
+        self._k = 0.0
+        self._origin_cwnd = 0.0
+        self._tcp_cwnd = 0.0  # TCP-friendly estimate (segments)
+        self._now = None  # injected by the socket (simulated seconds)
+
+    def set_clock(self, now_fn) -> None:
+        self._now = now_fn
+
+    def _seconds(self) -> float:
+        return self._now() if self._now else 0.0
+
+    def IncreaseWindow(self, tcb, segments_acked) -> None:
+        if segments_acked <= 0:
+            return
+        if tcb.cwnd < tcb.ssthresh:
+            tcb.cwnd += segments_acked * tcb.segment_size
+            return
+        seg = tcb.segment_size
+        cwnd_seg = tcb.cwnd / seg
+        if self._epoch_start_s is None:
+            self._epoch_start_s = self._seconds()
+            if cwnd_seg < self._w_max:
+                self._k = ((self._w_max - cwnd_seg) / self.c) ** (1.0 / 3.0)
+                self._origin_cwnd = self._w_max
+            else:
+                self._k = 0.0
+                self._origin_cwnd = cwnd_seg
+            self._tcp_cwnd = cwnd_seg
+        t = self._seconds() - self._epoch_start_s + (tcb.min_rtt_s if tcb.min_rtt_s < math.inf else 0.0)
+        target = self._origin_cwnd + self.c * (t - self._k) ** 3
+        # TCP-friendly region (estimate standard AIMD growth)
+        rtt = tcb.last_rtt_s or 0.1
+        self._tcp_cwnd += 3.0 * (1 - self.beta) / (1 + self.beta) * segments_acked / cwnd_seg
+        target = max(target, self._tcp_cwnd)
+        if target > cwnd_seg:
+            # spread the increase over the next RTT worth of acks
+            cnt = cwnd_seg / (target - cwnd_seg)
+            tcb.cwnd += int(max(segments_acked * seg / max(cnt, 1e-9), 1))
+        else:
+            tcb.cwnd += max(int(seg / (100.0 * cwnd_seg)), 0)
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        seg = tcb.segment_size
+        cwnd_seg = tcb.cwnd / seg
+        if self.fast_convergence and cwnd_seg < self._w_max:
+            self._w_max = cwnd_seg * (1.0 + self.beta) / 2.0
+        else:
+            self._w_max = cwnd_seg
+        self._epoch_start_s = None  # new epoch on loss
+        return max(int(tcb.cwnd * self.beta), 2 * seg)
+
+    def CongestionStateSet(self, tcb, new_state) -> None:
+        if new_state == TcpSocketState.CA_LOSS:
+            self._epoch_start_s = None
+            self._w_max = tcb.cwnd / tcb.segment_size
+
+
+class TcpScalable(TcpNewReno):
+    """Scalable TCP (tcp-scalable.cc): cwnd += 0.01 per ack in CA;
+    ssthresh = 0.875 · cwnd."""
+
+    tid = (
+        TypeId("tpudes::TcpScalable")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpScalable(**kw))
+        .AddAttribute("AIFactor", "additive increase divisor", 50, field="ai_factor")
+        .AddAttribute("MDFactor", "multiplicative decrease", 0.125, field="md_factor")
+    )
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        # cwnd += acked · mss / min(w, 1/a): ~1% of cwnd per RTT once
+        # w > ai_factor — the "scalable" exponential regime
+        if segments_acked > 0:
+            w = tcb.cwnd / tcb.segment_size
+            increment = segments_acked * tcb.segment_size / min(w, float(self.ai_factor))
+            tcb.cwnd += max(int(increment), 1)
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        return max(int(tcb.cwnd * (1.0 - self.md_factor)), 2 * tcb.segment_size)
+
+
+class TcpHighSpeed(TcpNewReno):
+    """HighSpeed TCP (RFC 3649; tcp-highspeed.cc): a(w)/b(w) grow with
+    cwnd, closed-form approximation of the RFC table."""
+
+    tid = (
+        TypeId("tpudes::TcpHighSpeed")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpHighSpeed(**kw))
+    )
+
+    LOW_WINDOW = 38.0
+
+    def _a(self, w_seg: float) -> float:
+        if w_seg <= self.LOW_WINDOW:
+            return 1.0
+        # RFC 3649: a(w) grows ~ w^0.8; normalized to a(38)=1, a(83000)=72
+        return max(1.0, 0.156 * w_seg ** 0.8 / 2.0)
+
+    def _b(self, w_seg: float) -> float:
+        if w_seg <= self.LOW_WINDOW:
+            return 0.5
+        b = 0.5 - 0.4 * (math.log(w_seg) - math.log(self.LOW_WINDOW)) / (
+            math.log(83000.0) - math.log(self.LOW_WINDOW)
+        )
+        return max(b, 0.1)
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        if segments_acked > 0:
+            w = tcb.cwnd / tcb.segment_size
+            tcb.cwnd += int(self._a(w) * segments_acked * tcb.segment_size * tcb.segment_size / tcb.cwnd) or 1
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        w = tcb.cwnd / tcb.segment_size
+        return max(int(tcb.cwnd * (1.0 - self._b(w))), 2 * tcb.segment_size)
+
+
+class TcpVegas(TcpNewReno):
+    """Vegas (tcp-vegas.cc): delay-based — compare expected vs actual
+    throughput, adjust cwnd to keep alpha..beta extra segments queued."""
+
+    tid = (
+        TypeId("tpudes::TcpVegas")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpVegas(**kw))
+        .AddAttribute("Alpha", "lower bound of queued packets", 2, field="alpha")
+        .AddAttribute("Beta", "upper bound of queued packets", 4, field="beta")
+        .AddAttribute("Gamma", "slow-start bound", 1, field="gamma")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._base_rtt_s = math.inf
+        self._cnt_rtt = 0
+        self._min_rtt_s = math.inf
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if rtt_s and rtt_s > 0:
+            self._base_rtt_s = min(self._base_rtt_s, rtt_s)
+            self._min_rtt_s = min(self._min_rtt_s, rtt_s)
+            self._cnt_rtt += 1
+
+    def IncreaseWindow(self, tcb, segments_acked) -> None:
+        if self._cnt_rtt <= 2 or self._base_rtt_s == math.inf:
+            super().IncreaseWindow(tcb, segments_acked)
+            return
+        seg = tcb.segment_size
+        cwnd_seg = tcb.cwnd / seg
+        rtt = self._min_rtt_s if self._min_rtt_s < math.inf else self._base_rtt_s
+        expected = cwnd_seg / self._base_rtt_s
+        actual = cwnd_seg / rtt
+        diff = (expected - actual) * self._base_rtt_s
+        if tcb.cwnd < tcb.ssthresh:  # Vegas slow start, gated by gamma
+            if diff <= self.gamma:
+                super().IncreaseWindow(tcb, segments_acked)
+            else:
+                tcb.ssthresh = max(tcb.cwnd - seg, 2 * seg)
+        else:
+            if diff < self.alpha:
+                tcb.cwnd += seg
+            elif diff > self.beta:
+                tcb.cwnd = max(tcb.cwnd - seg, 2 * seg)
+        self._min_rtt_s = math.inf  # per-RTT sample window
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        return max(min(tcb.ssthresh, tcb.cwnd - tcb.segment_size), 2 * tcb.segment_size)
+
+
+class TcpVeno(TcpNewReno):
+    """Veno (tcp-veno.cc): Vegas-style backlog estimate modulates both
+    the increase (slower when backlog > beta) and the decrease (milder
+    on random loss)."""
+
+    tid = (
+        TypeId("tpudes::TcpVeno")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpVeno(**kw))
+        .AddAttribute("Beta", "backlog threshold (segments)", 3, field="beta")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._base_rtt_s = math.inf
+        self._min_rtt_s = math.inf
+        self._diff = 0.0
+        self._inc = True
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if rtt_s and rtt_s > 0:
+            self._base_rtt_s = min(self._base_rtt_s, rtt_s)
+            self._min_rtt_s = min(self._min_rtt_s, rtt_s)
+
+    def IncreaseWindow(self, tcb, segments_acked) -> None:
+        if self._base_rtt_s == math.inf:
+            super().IncreaseWindow(tcb, segments_acked)
+            return
+        seg = tcb.segment_size
+        cwnd_seg = tcb.cwnd / seg
+        rtt = self._min_rtt_s if self._min_rtt_s < math.inf else self._base_rtt_s
+        self._diff = cwnd_seg * (1 - self._base_rtt_s / rtt)
+        if tcb.cwnd < tcb.ssthresh:
+            segments_acked = self.SlowStart(tcb, segments_acked)
+        elif self._diff < self.beta:
+            self.CongestionAvoidance(tcb, segments_acked)  # as Reno
+        else:
+            # congestive regime: increase every other RTT
+            if self._inc:
+                self.CongestionAvoidance(tcb, segments_acked)
+            self._inc = not self._inc
+        self._min_rtt_s = math.inf
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        if self._diff < self.beta:
+            return max(int(tcb.cwnd * 4 // 5), 2 * tcb.segment_size)  # random loss
+        return max(tcb.cwnd // 2, 2 * tcb.segment_size)
+
+
+TCP_VARIANTS = {
+    "TcpNewReno": TcpNewReno,
+    "TcpCubic": TcpCubic,
+    "TcpScalable": TcpScalable,
+    "TcpHighSpeed": TcpHighSpeed,
+    "TcpVegas": TcpVegas,
+    "TcpVeno": TcpVeno,
+}

@@ -124,6 +124,17 @@ class Ipv4EndPointDemux:
                 return port
         return 0
 
+    def Allocate4(
+        self, addr: Ipv4Address, port: int, peer_addr: Ipv4Address, peer_port: int
+    ) -> Ipv4EndPoint:
+        """Fully-qualified endpoint for an accepted TCP connection: may
+        share (addr, port) with the listener — the 4-tuple disambiguates
+        (upstream Ipv4EndPointDemux::Allocate with peer args)."""
+        ep = Ipv4EndPoint(addr, port)
+        ep.SetPeer(peer_addr, peer_port)
+        self._endpoints.append(ep)
+        return ep
+
     def DeAllocate(self, ep: Ipv4EndPoint) -> None:
         if ep in self._endpoints:
             self._endpoints.remove(ep)
