@@ -144,3 +144,44 @@ def test_thermal_noise():
     n = I.thermal_noise_w(20e6, noise_figure_db=7.0)
     dbm = 10 * math.log10(n) + 30
     assert dbm == pytest.approx(-93.97, abs=0.1)
+
+
+def test_nist_qam_ber_reference_values():
+    """Upstream NIST closed forms (ADVICE r1 high): 16-QAM BER is
+    0.375*erfc(sqrt(snr/10)) — no extra 1/2 factor; same family for
+    64/256-QAM.  Checks both the jnp kernel and the f64 oracle."""
+    snr = 10.0  # 10 dB linear
+    want16 = 0.375 * math.erfc(math.sqrt(snr / 10.0))
+    got16 = float(WE.uncoded_ber(jnp.asarray(snr), jnp.asarray(16.0)))
+    assert got16 == pytest.approx(want16, rel=1e-5)
+
+    # 64-QAM: 2(1-1/8)/6 * erfc(sqrt(3snr/126)) = (7/24) erfc(sqrt(snr/42))
+    want64 = (7.0 / 24.0) * math.erfc(math.sqrt(snr / 42.0))
+    got64 = float(WE.uncoded_ber(jnp.asarray(snr), jnp.asarray(64.0)))
+    assert got64 == pytest.approx(want64, rel=1e-5)
+
+    # the f64 oracle must produce the success rate implied by the fixed
+    # closed form end-to-end (catches a re-introduced 0.5 factor)
+    nbits = 1000.0
+    p = min(max(want16, 0.0), 0.5)
+    dd = math.sqrt(4.0 * p * (1.0 - p))
+    pe = WE.B_FACTOR_TABLE[WE.RATE_1_2] * sum(
+        c * dd**e
+        for c, e in zip(WE.PE_COEFFS_TABLE[WE.RATE_1_2], WE.PE_EXPONENTS_TABLE[WE.RATE_1_2])
+        if c > 0
+    )
+    want_sr = math.exp(nbits * math.log1p(-min(pe, 1.0 - 1e-12)))
+    got_sr = WE.chunk_success_rate_py(snr, nbits, 16, WE.RATE_1_2)
+    assert got_sr == pytest.approx(want_sr, rel=1e-9)
+    # and the jnp kernel must agree with the oracle
+    got_kernel = float(WE.chunk_success_rate(
+        jnp.asarray(snr), jnp.asarray(nbits), jnp.asarray(16.0), jnp.asarray(WE.RATE_1_2)))
+    assert got_kernel == pytest.approx(got_sr, rel=1e-4)
+
+
+def test_bpsk_qpsk_ber_reference_values():
+    snr = 4.0
+    assert float(WE.uncoded_ber(jnp.asarray(snr), jnp.asarray(2.0))) == pytest.approx(
+        0.5 * math.erfc(math.sqrt(snr)), rel=1e-5)
+    assert float(WE.uncoded_ber(jnp.asarray(snr), jnp.asarray(4.0))) == pytest.approx(
+        0.5 * math.erfc(math.sqrt(snr / 2.0)), rel=1e-5)

@@ -136,7 +136,10 @@ def test_cwnd_grows_then_halves_on_fast_retransmit():
         sock.TraceConnectWithoutContext("CongestionWindow", lambda old, new: cwnd_trace.append((old, new)))
         sock.TraceConnectWithoutContext("Retransmit", lambda seq: retx.append(seq))
 
-    Simulator.Schedule(Seconds(0.2), attach)
+    # attach as soon as the socket exists (app starts at 0.1s); the loss
+    # of packet #40 triggers fast retransmit ~0.14s, so attaching later
+    # would miss the Retransmit/CongestionWindow events entirely
+    Simulator.Schedule(Seconds(0.101), attach)
     Simulator.Stop(Seconds(30))
     Simulator.Run()
     assert sink_apps.Get(0).GetTotalRx() == 400_000

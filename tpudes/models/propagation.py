@@ -125,7 +125,9 @@ class ThreeLogDistancePropagationLossModel(PropagationLossModel):
     )
 
     def DoCalcRxPower(self, tx_power_dbm, mob_a, mob_b):
-        d = max(self._dist(mob_a, mob_b), self.d0)
+        d = self._dist(mob_a, mob_b)
+        if d < self.d0:
+            return tx_power_dbm  # 0 dB path loss below d0 (upstream semantics)
         loss = self.reference_loss
         loss += 10.0 * self.exponent0 * math.log10(min(max(d, self.d0), self.d1) / self.d0)
         loss += 10.0 * self.exponent1 * math.log10(min(max(d, self.d1), self.d2) / self.d1)

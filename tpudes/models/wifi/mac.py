@@ -122,18 +122,37 @@ class ChannelAccessManager:
         self._cw = CW_MIN
         self._slots_left = 0
         self._pending = False
+        self._immediate = False  # zero-backoff grant in flight
         self._slot_event = None
         phy.RegisterListener(self)
 
     # --- Txop API ---
-    def request_access(self, new_backoff: bool = True) -> None:
-        """Ask for a TX opportunity; grant fires via callback."""
+    def request_access(self, new_backoff: bool = True,
+                       allow_immediate: bool = True) -> None:
+        """Ask for a TX opportunity; grant fires via callback.
+
+        ``allow_immediate=False`` forces the backoff countdown even on an
+        idle medium — used after a failed exchange, where 802.11 always
+        draws a backoff (otherwise colliding stations retry in lockstep)."""
         if self._pending:
             return
         self._pending = True
         if new_backoff:
+            now = Simulator.NowTicks()
+            difs = MicroSeconds(DIFS_US).ticks
+            if (allow_immediate and self._phy.IsStateIdle()
+                    and now - self._phy.idle_since() >= difs):
+                # medium already idle ≥ DIFS: grant immediately with no
+                # backoff (upstream DCF); backoff is drawn only after a
+                # busy medium or a failed exchange
+                self._slots_left = 0
+                self._immediate = True
+                self._cancel_slot()
+                self._slot_event = Simulator.GetImpl().Schedule(0, self._tick, ())
+                return
             # ns-3 draws in [0, cw] inclusive
             self._slots_left = int(self._rng.GetValue(0, self._cw + 1 - 1e-9))
+        self._immediate = False
         self._try_schedule()
 
     def notify_success(self) -> None:
@@ -181,22 +200,34 @@ class ChannelAccessManager:
             )
             return
         self._pending = False
+        self._immediate = False
         self._grant()
+
+    def _on_medium_busy(self):
+        """A zero-backoff grant interrupted by the medium going busy must
+        fall back to a drawn backoff (upstream DCF: the immediate grant
+        only applies while the medium stays idle)."""
+        if self._pending and self._immediate:
+            self._immediate = False
+            self._slots_left = int(self._rng.GetValue(0, self._cw + 1 - 1e-9))
 
     # --- PHY listener contract ---
     def NotifyRxStart(self, end_ts):
+        self._on_medium_busy()
         self._cancel_slot()
 
     def NotifyRxEnd(self):
         self._try_schedule()
 
     def NotifyTxStart(self, end_ts):
+        self._on_medium_busy()
         self._cancel_slot()
 
     def NotifyTxEnd(self):
         self._try_schedule()
 
     def NotifyCcaBusyStart(self, end_ts):
+        self._on_medium_busy()
         self._try_schedule()  # reschedules from new busy end
 
 
@@ -308,7 +339,7 @@ class WifiMac(Object):
             self._ack_timeout_event = Simulator.GetImpl().Schedule(
                 Seconds(timeout_s).ticks, self._on_ack_timeout, ()
             )
-        self._phy.Send(frame, mode)
+        self._phy.Send(frame, mode, size_bytes=size)
 
     def _tx_complete_no_ack(self):
         self._current = None
@@ -330,7 +361,7 @@ class WifiMac(Object):
             self._dequeue()
             return
         self._access.notify_failure()
-        self._access.request_access()
+        self._access.request_access(allow_immediate=False)
 
     def _on_ack(self, from_addr):
         if self._current is None or self._ack_timeout_event is None:
@@ -375,11 +406,13 @@ class WifiMac(Object):
 
     def _send_ack(self, to, data_mode):
         ack_mode = control_answer_mode(data_mode)
-        ack = Packet(ACK_SIZE - 10 - FCS_SIZE)
+        ack = Packet(0)
         header = WifiMacHeader(WifiMacType.ACK, addr1=to, addr2=self._address)
         ack.AddHeader(header)
+        # on-air size is the 802.11 ACK (14 B incl. FCS) so the airtime
+        # matches the ack-timeout budget in _send_current exactly
         Simulator.GetImpl().Schedule(
-            MicroSeconds(SIFS_US).ticks, self._phy.Send, (ack, ack_mode)
+            MicroSeconds(SIFS_US).ticks, self._phy.Send, (ack, ack_mode, 0, ACK_SIZE)
         )
 
     def Receive(self, packet: Packet, header: WifiMacHeader):

@@ -243,13 +243,26 @@ class YansWifiPhy(Object):
         """Ticks when the medium (as seen by this PHY) goes idle again."""
         return self._state_until if self._state != WifiPhyState.IDLE else self._sim.NowTicks()
 
+    def idle_since(self) -> int:
+        """Start tick of the current idle period (0 if never busy).
+        Only meaningful while IsStateIdle(); lets the access manager
+        grant without backoff when the medium has been idle ≥ DIFS."""
+        return self._state_until
+
     # --- tx ---
     def GetTxPowerDbm(self, power_level: int = 0) -> float:
         return self.tx_power_start + self.tx_gain
 
-    def Send(self, packet, mode: WifiMode, tx_power_level: int = 0) -> None:
-        """WifiPhy::Send: enter TX, hand the PPDU to the channel."""
-        duration_s = ppdu_duration_s(packet.GetSize(), mode)
+    def Send(self, packet, mode: WifiMode, tx_power_level: int = 0,
+             size_bytes: int | None = None) -> None:
+        """WifiPhy::Send: enter TX, hand the PPDU to the channel.
+
+        ``size_bytes`` is the on-air PSDU size (incl. FCS) when it
+        differs from ``packet.GetSize()`` — the MAC passes it so airtime
+        matches its ack-timeout budget exactly."""
+        duration_s = ppdu_duration_s(
+            packet.GetSize() if size_bytes is None else size_bytes, mode
+        )
         now = self._sim.NowTicks()
         end = now + Seconds(duration_s).ticks
         # a PHY transmitting aborts any reception in progress

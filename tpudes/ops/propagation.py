@@ -110,13 +110,16 @@ def three_log_distance(
     reference_loss_db: float = DEFAULT_REFERENCE_LOSS_DB,
 ) -> jax.Array:
     """Three-slope log-distance (ThreeLogDistancePropagationLossModel):
-    cumulative piecewise slopes over [d0,d1), [d1,d2), [d2,∞)."""
+    cumulative piecewise slopes over [d0,d1), [d1,d2), [d2,∞);
+    0 dB path loss below d0 (upstream semantics)."""
+    below_d0 = d < d0
     d = jnp.maximum(d, d0)
     # cumulative loss at the active breakpoints
     seg0 = 10.0 * exponent0 * jnp.log10(jnp.clip(d, d0, d1) / d0)
     seg1 = 10.0 * exponent1 * jnp.log10(jnp.clip(d, d1, d2) / d1)
     seg2 = 10.0 * exponent2 * jnp.log10(jnp.maximum(d, d2) / d2)
-    return tx_power_dbm - (reference_loss_db + seg0 + seg1 + seg2)
+    loss = reference_loss_db + seg0 + seg1 + seg2
+    return tx_power_dbm - jnp.where(below_d0, 0.0, loss)
 
 
 def two_ray_ground(

@@ -55,20 +55,27 @@ PE_EXPONENTS_TABLE = [
     [4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 10.0, 11.0, 12.0, 13.0],
 ]
 
-_B_FACTOR = jnp.array(B_FACTOR_TABLE)
-_PE_COEFFS = jnp.array(PE_COEFFS_TABLE)
-_PE_EXPONENTS = jnp.array(PE_EXPONENTS_TABLE)
+# NOTE: kept as numpy at module scope so importing this module never
+# touches a JAX backend (the driver's dryrun forces the CPU platform
+# *after* imports; a module-level jnp.array would pin the default
+# backend first).  jnp.asarray at the use sites is free inside jit.
+import numpy as _np
+
+_B_FACTOR = _np.array(B_FACTOR_TABLE)
+_PE_COEFFS = _np.array(PE_COEFFS_TABLE)
+_PE_EXPONENTS = _np.array(PE_EXPONENTS_TABLE)
 
 RATE_1_2, RATE_2_3, RATE_3_4, RATE_5_6 = 0, 1, 2, 3
 
 
 def _qam_ber(snr: jax.Array, m: jax.Array) -> jax.Array:
     """Gray-coded square M-QAM AWGN BER:
-    2(1-1/√M)/log2(M) · ½ erfc(√(3·snr / (2(M-1)))).
-    Reproduces upstream's Get16/64/256/1024QamBer closed forms."""
+    2(1-1/√M)/log2(M) · erfc(√(3·snr / (2(M-1)))).
+    Reproduces upstream's Get16/64/256/1024QamBer closed forms
+    (16-QAM: 0.75·erfc(√(snr/10)) — no extra ½ factor)."""
     log2m = jnp.log2(m)
     z = jnp.sqrt(3.0 * snr / (2.0 * (m - 1.0)))
-    return (2.0 * (1.0 - 1.0 / jnp.sqrt(m)) / log2m) * 0.5 * erfc(z)
+    return (2.0 * (1.0 - 1.0 / jnp.sqrt(m)) / log2m) * erfc(z)
 
 
 def uncoded_ber(snr: jax.Array, constellation: jax.Array) -> jax.Array:
@@ -91,9 +98,9 @@ def coded_pe(ber: jax.Array, rate_class: jax.Array) -> jax.Array:
     D = √(4p(1-p)), pe = factor(b) · Σ a_k D^e_k, clamped to [0, 1]."""
     p = jnp.clip(ber, 0.0, 0.5)
     d = jnp.sqrt(4.0 * p * (1.0 - p))
-    coeffs = _PE_COEFFS[rate_class]           # (..., 10)
-    exps = _PE_EXPONENTS[rate_class]          # (..., 10)
-    factor = _B_FACTOR[rate_class]
+    coeffs = jnp.asarray(_PE_COEFFS)[rate_class]           # (..., 10)
+    exps = jnp.asarray(_PE_EXPONENTS)[rate_class]          # (..., 10)
+    factor = jnp.asarray(_B_FACTOR)[rate_class]
     # stable evaluation: a_k D^e_k = exp(log a_k + e_k log D); D=0 → 0
     log_d = jnp.log(jnp.maximum(d, 1e-35))
     terms = jnp.where(
@@ -188,10 +195,11 @@ ALL_MODES = OFDM_MODES + HT_MODES
 MODES_BY_NAME = {m.name: m for m in ALL_MODES}
 
 #: constant per-mode lookup arrays for the kernel side — index with the
-#: integer mode id carried in packed tx tensors
-MODE_CONSTELLATION = jnp.array([m.constellation for m in ALL_MODES], dtype=jnp.float32)
-MODE_RATE_CLASS = jnp.array([m.rate_class for m in ALL_MODES], dtype=jnp.int32)
-MODE_DATA_RATE = jnp.array([m.data_rate_bps for m in ALL_MODES], dtype=jnp.float32)
+#: integer mode id carried in packed tx tensors (numpy at module scope;
+#: see the backend note above _B_FACTOR)
+MODE_CONSTELLATION = _np.array([m.constellation for m in ALL_MODES], dtype=_np.float32)
+MODE_RATE_CLASS = _np.array([m.rate_class for m in ALL_MODES], dtype=_np.int32)
+MODE_DATA_RATE = _np.array([m.data_rate_bps for m in ALL_MODES], dtype=_np.float32)
 
 
 def mode_chunk_success_rate(
@@ -199,8 +207,8 @@ def mode_chunk_success_rate(
 ) -> jax.Array:
     """Success rate with the mode resolved from the registry by index —
     the form the window kernel uses on packed tensors."""
-    constellation = MODE_CONSTELLATION[mode_index]
-    rate_class = MODE_RATE_CLASS[mode_index]
+    constellation = jnp.asarray(MODE_CONSTELLATION)[mode_index]
+    rate_class = jnp.asarray(MODE_RATE_CLASS)[mode_index]
     return chunk_success_rate(snr, nbits, constellation, rate_class)
 
 
@@ -217,7 +225,7 @@ def chunk_success_rate_py(snr: float, nbits: float, constellation: int, rate_cla
     else:
         m = float(constellation)
         z = math.sqrt(3.0 * snr / (2.0 * (m - 1.0)))
-        ber = (2.0 * (1.0 - 1.0 / math.sqrt(m)) / math.log2(m)) * 0.5 * math.erfc(z)
+        ber = (2.0 * (1.0 - 1.0 / math.sqrt(m)) / math.log2(m)) * math.erfc(z)
     p = min(max(ber, 0.0), 0.5)
     d = math.sqrt(4.0 * p * (1.0 - p))
     coeffs = PE_COEFFS_TABLE[rate_class]

@@ -52,15 +52,13 @@ def sharded_window_step(mesh: Mesh, params: WindowParams = WindowParams()):
     Returns ``step(positions, tx_active, mode_idx, frame_bytes, keys,
     next_ts, lookahead) -> (ok, sinr, delivered_total, grant)``.
     """
-    from jax.experimental.shard_map import shard_map
-
     @functools.partial(
-        shard_map,
+        jax.shard_map,
         mesh=mesh,
         in_specs=(P("replica"), P("replica"), P("replica"), P("replica"),
                   P("replica"), P("replica"), P()),
         out_specs=(P("replica"), P("replica"), P(), P()),
-        check_rep=False,
+        check_vma=False,
     )
     def step(positions, tx_active, mode_idx, frame_bytes, keys, next_ts, lookahead):
         from tpudes.parallel.kernels import replicated
