@@ -1,0 +1,222 @@
+"""Replica-axis engine vs the sequential DES (SURVEY.md §4: statistical
+— not bitwise — parity; §7 step 7 "prototype early").
+
+The scalar engine is the per-event oracle: the same BSS config is run
+(a) K times sequentially with distinct RngRun, (b) once with R replicas
+through the vectorized event-stepped program lowered from the SAME
+object graph.  Delivery-count distributions must agree.
+"""
+
+import math
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from tpudes.core import CommandLine, Seconds, Simulator
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.rng import RngSeedManager
+from tpudes.core.config import Names
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.models.mobility import ListPositionAllocator, MobilityHelper, Vector
+from tpudes.models.wifi import (
+    WifiHelper,
+    WifiMacHelper,
+    YansWifiChannelHelper,
+    YansWifiPhyHelper,
+)
+from tpudes.parallel.replicated import (
+    BssProgram,
+    INF,
+    lower_bss,
+    run_replicated_bss,
+)
+
+N_STAS = 5
+SIM_TIME = 1.8
+RADIUS = 25.0  # PSR ≈ 0.15/attempt at 54 Mbps: lossy, replicas diverge
+
+
+def _positions():
+    pos = [(0.0, 0.0, 0.0)]
+    for i in range(N_STAS):
+        a = 2 * math.pi * i / N_STAS
+        pos.append((RADIUS * math.cos(a), RADIUS * math.sin(a), 0.0))
+    return pos
+
+
+def _reset_world():
+    Simulator.Destroy()
+    GlobalValue.ResetAll()
+    RngSeedManager.Reset()
+    Names.Clear()
+    mod = sys.modules.get("tpudes.network.node")
+    if mod is not None:
+        mod.NodeList.Reset()
+    eng = sys.modules.get("tpudes.parallel.engine")
+    if eng is not None:
+        eng.BatchableRegistry.reset()
+
+
+def _build_bss():
+    """The wifi-bss.py topology with deterministic positions.  Returns
+    (sta_devices, ap_device, clients, server_rx_counter)."""
+    nodes = NodeContainer()
+    nodes.Create(N_STAS + 1)
+
+    mobility = MobilityHelper()
+    alloc = ListPositionAllocator()
+    for x, y, z in _positions():
+        alloc.Add(Vector(x, y, z))
+    mobility.SetPositionAllocator(alloc)
+    mobility.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mobility.Install(nodes)
+
+    channel = YansWifiChannelHelper.Default().Create()
+    phy = YansWifiPhyHelper()
+    phy.SetChannel(channel)
+    wifi = WifiHelper()
+    wifi.SetRemoteStationManager(
+        "tpudes::ConstantRateWifiManager", DataMode="OfdmRate54Mbps"
+    )
+
+    ap_mac = WifiMacHelper()
+    ap_mac.SetType("tpudes::ApWifiMac")
+    ap_devices = wifi.Install(phy, ap_mac, [nodes.Get(0)])
+    sta_mac = WifiMacHelper()
+    sta_mac.SetType("tpudes::StaWifiMac")
+    sta_devices = wifi.Install(
+        phy, sta_mac, [nodes.Get(i) for i in range(1, N_STAS + 1)]
+    )
+
+    stack = InternetStackHelper()
+    stack.Install(nodes)
+    address = Ipv4AddressHelper()
+    address.SetBase("10.1.3.0", "255.255.255.0")
+    devices = NetDeviceContainer()
+    devices.Add(ap_devices.Get(0))
+    for i in range(N_STAS):
+        devices.Add(sta_devices.Get(i))
+    interfaces = address.Assign(devices)
+
+    server = UdpEchoServerHelper(9)
+    server_apps = server.Install(nodes.Get(0))
+    server_apps.Start(Seconds(0.4))
+    server_apps.Stop(Seconds(SIM_TIME))
+    rx = [0]
+    server_apps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda pkt, *a: rx.__setitem__(0, rx[0] + 1)
+    )
+
+    clients = []
+    for i in range(N_STAS):
+        helper = UdpEchoClientHelper(interfaces.GetAddress(0), 9)
+        helper.SetAttribute("MaxPackets", 1_000_000)
+        helper.SetAttribute("Interval", Seconds(0.1))
+        helper.SetAttribute("PacketSize", 512)
+        apps = helper.Install(nodes.Get(1 + i))
+        apps.Start(Seconds(1.0 + 0.001 * i))
+        apps.Stop(Seconds(SIM_TIME))
+        clients.append(apps.Get(0))
+    return sta_devices, ap_devices.Get(0), clients, rx
+
+
+def _des_delivery_counts(runs):
+    counts = []
+    for run in range(1, runs + 1):
+        _reset_world()
+        RngSeedManager.SetRun(run)
+        _, _, _, rx = _build_bss()
+        Simulator.Stop(Seconds(SIM_TIME))
+        Simulator.Run()
+        counts.append(rx[0])
+    _reset_world()
+    return np.array(counts, dtype=np.float64)
+
+
+def _lowered_program():
+    _reset_world()
+    sta_devices, ap_device, clients, _ = _build_bss()
+    prog = lower_bss(
+        [sta_devices.Get(i) for i in range(N_STAS)], ap_device, clients, SIM_TIME
+    )
+    _reset_world()
+    return prog
+
+
+def test_lowering_reads_object_graph():
+    prog = _lowered_program()
+    assert prog.n == N_STAS + 1
+    np.testing.assert_allclose(prog.positions, np.array(_positions()), atol=1e-5)
+    # 54 Mbps ConstantRate → mode 7; payload 512 → PSDU 512+64
+    assert prog.data_mode_idx == 7
+    assert prog.data_bytes == 512 + 64
+    # clients: start 1.0 s + (i-1) ms, interval 100 ms, stop at SIM_TIME
+    assert prog.start_us[1] == 1_000_000
+    assert prog.start_us[2] == 1_001_000
+    assert prog.interval_us[1] == 100_000
+    assert prog.stop_us[1] == int(SIM_TIME * 1e6)
+    # AP slot carries the beacon schedule
+    assert prog.interval_us[0] == 102_400
+
+
+def test_statistical_parity_with_sequential_engine():
+    des = _des_delivery_counts(10)
+    prog = _lowered_program()
+    out = run_replicated_bss(prog, 256, jax.random.PRNGKey(42))
+    assert out["all_done"]
+    rep = np.asarray(out["srv_rx"], dtype=np.float64)
+
+    # per-STA offered load: 8 sends each (1.0→1.8 s, 0.1 s interval)
+    offered = N_STAS * 8
+    assert 0 < rep.mean() <= offered
+    assert 0 < des.mean() <= offered
+
+    # distribution-level agreement: means within 3× the combined spread
+    # of the two estimators (plus 1 frame of timing-model slack)
+    sem = math.sqrt(
+        des.var(ddof=1) / len(des) + rep.var(ddof=1) / len(rep)
+    )
+    assert abs(des.mean() - rep.mean()) <= 3.0 * sem + 1.5, (
+        f"DES mean {des.mean():.2f} vs replicated mean {rep.mean():.2f} "
+        f"(sem {sem:.2f}; des {des}, rep mean/std {rep.mean():.2f}/{rep.std():.2f})"
+    )
+
+
+def test_same_key_is_deterministic():
+    prog = _lowered_program()
+    a = run_replicated_bss(prog, 32, jax.random.PRNGKey(7))
+    b = run_replicated_bss(prog, 32, jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a["srv_rx"]), np.asarray(b["srv_rx"]))
+    c = run_replicated_bss(prog, 32, jax.random.PRNGKey(8))
+    assert not np.array_equal(np.asarray(a["srv_rx"]), np.asarray(c["srv_rx"]))
+
+
+def test_mesh_sharded_matches_single_device():
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = Mesh(np.array(devs[:8]), ("replica",))
+    prog = _lowered_program()
+    plain = run_replicated_bss(prog, 64, jax.random.PRNGKey(3))
+    sharded = run_replicated_bss(prog, 64, jax.random.PRNGKey(3), mesh=mesh)
+    assert sharded["all_done"]
+    np.testing.assert_array_equal(
+        np.asarray(plain["srv_rx"]), np.asarray(sharded["srv_rx"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain["cli_rx"]), np.asarray(sharded["cli_rx"])
+    )
+
+
+def test_echo_replies_bounded_by_requests():
+    prog = _lowered_program()
+    out = run_replicated_bss(prog, 64, jax.random.PRNGKey(5))
+    cli = np.asarray(out["cli_rx"]).sum(axis=1)
+    srv = np.asarray(out["srv_rx"])
+    assert (cli <= srv).all()

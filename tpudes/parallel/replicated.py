@@ -1,0 +1,487 @@
+"""Replica-axis execution of real simulations (SURVEY.md §7 step 7).
+
+The north star's headline capability: run R Monte-Carlo replicas of an
+*actual scenario* — not a synthetic kernel — on the TPU at once.
+
+Design (the "union schedule" of SURVEY.md §7 hard-part 6, taken to its
+TPU-native conclusion): replicas of one scenario share topology and the
+*candidate* event structure but diverge in RNG-driven data (PHY coin
+flips, backoff draws).  Because replicas are mutually independent, no
+cross-replica event ordering exists — so instead of forcing one host
+loop to drive R masked replicas, the scenario itself is **lowered to a
+vectorized event-stepped program**: per-replica state lives in (R, N)
+arrays, and one ``lax.while_loop`` iteration advances *every replica to
+its own next event time* (arrival, backoff expiry, transmission).  Time
+is a per-replica scalar, exactly as in a DES — just R of them at once.
+
+This mirrors upstream's granted-time-window engine
+(distributed-simulator-impl.cc, SURVEY.md §3.3) with the roles rotated:
+the "ranks" are replicas, the LBTS grant is the loop's global
+all-replicas-done reduction, and the per-rank event loop is the masked
+vector update.
+
+Scope: the infrastructure-BSS scenario (BASELINE.json config #3) — AP +
+N STAs, DCF MAC, Yans PHY with log-distance loss, NIST error model, UDP
+echo traffic, beacons.  ``lower_bss`` builds the program's static inputs
+from the *live object graph* a scenario script constructed (helpers,
+attributes, station manager), so ``wifi-bss.py --replicas=R`` runs the
+same config the sequential engine runs.  The scalar DES remains the
+per-event oracle; tests check distribution-level parity of delivery
+counts (SURVEY.md §4 — statistical, not bitwise, as f32 TPU replicas
+cannot bit-match the host MRG32k3a path).
+
+Timing model vs the scalar DES (all deviations are sub-slot or rare):
+- 1 µs integer clock (DES: 1 ns); durations are ceil'd to µs.
+- propagation delay (≤ ~83 ns at 25 m) is folded into the exchange
+  duration rather than modeled per-link.
+- on a failed exchange the medium frees after the data airtime (no ack
+  is sent) while the sender personally waits out its ack timeout before
+  recontending — as in the scalar DES.
+- acks are assumed decodable (they ride a mandatory low rate over the
+  same link that just decoded the data frame); association and ARP
+  warm-up exchanges are not modeled — compare post-warm-up windows.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudes.ops.interference import thermal_noise_w
+from tpudes.ops.wifi_error import MODES_BY_NAME, mode_chunk_success_rate
+
+# µs timing constants (models/wifi/mac.py; 802.11a OFDM 20 MHz)
+SLOT = 9
+SIFS = 16
+DIFS = 34
+CW_MIN = 15
+CW_MAX = 1023
+RETRY_LIMIT = 7
+INF = np.int32(2**30)
+
+
+@dataclass(frozen=True)
+class BssProgram:
+    """Static description of one BSS scenario, ready to execute on the
+    replica axis.  Produced by :func:`lower_bss` from a live object
+    graph, or directly by tests/benchmarks."""
+
+    positions: np.ndarray        # (N, 3) — node 0 is the AP
+    data_mode_idx: int           # WifiMode index for data frames
+    ack_mode_idx: int            # WifiMode index for the ack
+    data_bytes: int              # on-air PSDU bytes of a data frame
+    beacon_bytes: int            # on-air PSDU bytes of a beacon
+    start_us: np.ndarray         # (N,) first app event per node (AP: beacon)
+    interval_us: np.ndarray      # (N,) app period per node
+    stop_us: np.ndarray          # (N,) no arrivals at/after this time
+    sim_end_us: int
+    tx_power_dbm: float = 16.0206
+    path_loss_exponent: float = 3.0
+    reference_loss_db: float = 46.6777
+    noise_figure_db: float = 7.0
+    bandwidth_hz: float = 20e6
+    rx_sensitivity_dbm: float = -101.0
+
+    @property
+    def n(self) -> int:
+        return int(self.positions.shape[0])
+
+
+def _ppdu_us(size_bytes: int, mode) -> int:
+    """PPDU airtime in whole µs (ceil), matching phy.ppdu_duration_s."""
+    ndbps = mode.data_rate_bps * 4e-6
+    nsym = math.ceil((16 + 8 * size_bytes + 6) / ndbps)
+    return math.ceil((16e-6 + 4e-6 + nsym * 4e-6) * 1e6)
+
+
+def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProgram:
+    """Lower a constructed BSS object graph to a replicated program.
+
+    Reads positions from each node's mobility model, PHY attributes from
+    the AP's YansWifiPhy, the data mode from the devices' station
+    manager (ConstantRate), and traffic from the UdpEchoClient apps.
+    """
+    from tpudes.models.mobility import MobilityModel
+    from tpudes.models.wifi.mac import FCS_SIZE, MAC_HEADER_SIZE, control_answer_mode
+
+    ap_node = ap_device.GetNode()
+    nodes = [ap_node] + [d.GetNode() for d in sta_devices]
+    positions = np.array(
+        [
+            (lambda p: (p.x, p.y, p.z))(n.GetObject(MobilityModel).GetPosition())
+            for n in nodes
+        ],
+        dtype=np.float32,
+    )
+
+    phy = ap_device.GetPhy()
+    mac = ap_device.GetMac()
+    sm = mac._station_manager
+    if sm is not None and hasattr(sm, "get_data_mode"):
+        # ConstantRate answers without per-station state; adaptive
+        # managers fall back to their current mode for the first STA
+        try:
+            data_mode = sm.get_data_mode(None)
+        except Exception:
+            data_mode = MODES_BY_NAME["OfdmRate6Mbps"]
+    else:
+        data_mode = MODES_BY_NAME["OfdmRate6Mbps"]
+
+    n = len(nodes)
+    start = np.full((n,), INF, dtype=np.int64)
+    interval = np.full((n,), INF, dtype=np.int64)
+    stop = np.full((n,), INF, dtype=np.int64)
+    payload = 0
+    for app in echo_clients:
+        idx = nodes.index(app.GetNode())
+        start[idx] = int(app.start_time.ticks // 1000)
+        interval[idx] = max(1, int(app.interval.ticks // 1000))
+        stop[idx] = (
+            int(app.stop_time.ticks // 1000) if app.stop_time.ticks > 0 else INF
+        )
+        payload = int(app.packet_size)
+    # AP slot: beacons
+    if getattr(mac, "enable_beaconing", False) and int(mac.beacon_interval_us) > 0:
+        start[0] = 0
+        interval[0] = int(mac.beacon_interval_us)
+        stop[0] = INF
+
+    # on-air data PSDU: payload + UDP(8) + IPv4(20) + LLC/SNAP(8) + MAC(24) + FCS(4)
+    data_bytes = payload + 8 + 20 + 8 + MAC_HEADER_SIZE + FCS_SIZE
+    beacon_bytes = 50 + MAC_HEADER_SIZE + FCS_SIZE
+    ack_mode = control_answer_mode(data_mode)
+
+    return BssProgram(
+        positions=positions,
+        data_mode_idx=data_mode.index,
+        ack_mode_idx=ack_mode.index,
+        data_bytes=data_bytes,
+        beacon_bytes=beacon_bytes,
+        start_us=np.minimum(start, INF).astype(np.int32),
+        interval_us=np.minimum(interval, INF).astype(np.int32),
+        stop_us=np.minimum(stop, INF).astype(np.int32),
+        sim_end_us=int(sim_end_s * 1e6),
+        tx_power_dbm=float(phy.tx_power_start + phy.tx_gain),
+        rx_sensitivity_dbm=float(phy.rx_sensitivity),
+    )
+
+
+def _estimate_max_steps(prog: BssProgram) -> int:
+    total_arrivals = 0
+    for s1, iv, s2 in zip(prog.start_us, prog.interval_us, prog.stop_us):
+        if s1 >= INF or iv >= INF:
+            continue
+        horizon = min(int(s2), prog.sim_end_us)
+        if horizon > int(s1):
+            total_arrivals += (horizon - int(s1) + int(iv) - 1) // int(iv)
+    # one arrival event + up to 1+RETRY_LIMIT tx events per frame, plus
+    # same-instant arrival/tx splits; generous slack
+    return int(total_arrivals * (3 + RETRY_LIMIT) * 1.5) + 64
+
+
+def build_bss_step(prog: BssProgram, replicas: int):
+    """Return ``(init_state, cond_fn, step_fn, finalize)`` for the
+    vectorized event loop — exposed separately so the driver dryrun and
+    benchmarks can jit/shard the pieces themselves."""
+    n = prog.n
+    R = replicas
+    from tpudes.ops.wifi_error import ALL_MODES
+
+    data_mode = ALL_MODES[prog.data_mode_idx]
+    ack_mode = ALL_MODES[prog.ack_mode_idx]
+    data_dur = _ppdu_us(prog.data_bytes, data_mode)
+    ack_dur = _ppdu_us(14, ack_mode)
+    exch_data = data_dur + SIFS + ack_dur   # acked exchange airtime
+    # failed sender's personal wait (mac._send_current timeout budget)
+    ack_timeout = exch_data + SLOT + 4
+    exch_beacon = _ppdu_us(prog.beacon_bytes, MODES_BY_NAME["OfdmRate6Mbps"])
+    # DES convention (InterferenceHelper.calculate_per): the PER integral
+    # runs over the whole PPDU airtime at the payload rate, preamble
+    # included — nbits = rate × airtime, not 8 × PSDU bytes
+    ndbps = data_mode.data_rate_bps * 4e-6
+    data_airtime_s = 20e-6 + math.ceil((16 + 8 * prog.data_bytes + 6) / ndbps) * 4e-6
+    nbits_data = float(data_mode.data_rate_bps * data_airtime_s)
+
+    # --- static per-pair physics (positions are constant in this scenario)
+    pos = prog.positions.astype(np.float64)
+    d = np.sqrt(((pos[:, None, :] - pos[None, :, :]) ** 2).sum(-1))
+    np.fill_diagonal(d, 1.0)
+    loss = prog.reference_loss_db + 10.0 * prog.path_loss_exponent * np.log10(
+        np.maximum(d, 1.0)
+    )
+    rx_dbm_np = prog.tx_power_dbm - loss
+    rx_w_np = 10.0 ** ((rx_dbm_np - 30.0) / 10.0)
+    np.fill_diagonal(rx_w_np, 0.0)
+    noise_w = float(thermal_noise_w(prog.bandwidth_hz, prog.noise_figure_db))
+    detectable_np = rx_dbm_np >= prog.rx_sensitivity_dbm
+
+    rx_w = jnp.asarray(rx_w_np, dtype=jnp.float32)          # (N, N) tx→rx
+    detectable = jnp.asarray(detectable_np)                 # (N, N)
+    start0 = jnp.asarray(prog.start_us, dtype=jnp.int32)
+    interval = jnp.asarray(prog.interval_us, dtype=jnp.int32)
+    stop = jnp.asarray(prog.stop_us, dtype=jnp.int32)
+    sim_end = jnp.int32(prog.sim_end_us)
+    is_ap = jnp.arange(n) == 0
+
+    def init_state():
+        return dict(
+            t=jnp.zeros((R,), jnp.int32),
+            next_arr=jnp.broadcast_to(start0, (R, n)).astype(jnp.int32),
+            queue=jnp.zeros((R, n), jnp.int32),      # STA→AP requests waiting
+            ap_pend=jnp.zeros((R, n), jnp.int32),    # echoes waiting at AP per STA
+            bcn_pend=jnp.zeros((R,), jnp.int32),
+            backoff=jnp.zeros((R, n), jnp.int32),
+            hold=jnp.zeros((R, n), jnp.int32),       # personal recontend time
+            immediate=jnp.zeros((R, n), bool),       # zero-backoff grant armed
+            cw=jnp.full((R, n), CW_MIN, jnp.int32),
+            retries=jnp.zeros((R, n), jnp.int32),
+            busy_until=jnp.zeros((R,), jnp.int32),
+            srv_rx=jnp.zeros((R,), jnp.int32),
+            cli_rx=jnp.zeros((R, n), jnp.int32),
+            tx_data=jnp.zeros((R,), jnp.int32),
+            drops=jnp.zeros((R,), jnp.int32),
+            step=jnp.int32(0),
+        )
+
+    def has_frame(s):
+        sta_frame = (s["queue"] > 0) & ~is_ap[None, :]
+        ap_frame = is_ap[None, :] & (
+            (s["bcn_pend"] > 0) | (jnp.sum(s["ap_pend"], axis=1) > 0)
+        )[:, None]
+        return sta_frame | ap_frame
+
+    def tx_times(s):
+        """(R, N) earliest allowed tx instant per contender; INF else."""
+        frame = has_frame(s)
+        base = jnp.maximum(s["busy_until"][:, None], s["hold"])
+        countdown = base + DIFS + s["backoff"] * SLOT
+        t_imm = jnp.maximum(s["t"][:, None], base)
+        tx = jnp.where(s["immediate"], t_imm, countdown)
+        tx = jnp.maximum(tx, s["t"][:, None])  # never in the past
+        return jnp.where(frame, tx, INF)
+
+    def step_fn(s, key):
+        k = jax.random.fold_in(key, s["step"])
+        k_back, k_coin = jax.random.split(k)
+        u_back = jax.random.uniform(k_back, (R, n))
+        u_coin = jax.random.uniform(k_coin, (R, n))
+
+        frame = has_frame(s)
+        tx_t = tx_times(s)                               # (R, N)
+        tc = jnp.min(tx_t, axis=1)                       # (R,)
+        ta = jnp.min(s["next_arr"], axis=1)              # (R,)
+        live = s["t"] < sim_end
+        next_t = jnp.where(live, jnp.minimum(ta, tc), sim_end)
+        past_end = next_t >= sim_end
+        arrived = live & (ta <= tc) & (ta < INF) & ~past_end
+        transmit = live & (tc < ta) & (tc < INF) & ~past_end
+
+        # ---------- arrival processing ----------
+        is_arr = arrived[:, None] & (s["next_arr"] == next_t[:, None])
+        new_queue = s["queue"] + jnp.where(is_arr & ~is_ap[None, :], 1, 0)
+        new_bcn = s["bcn_pend"] + jnp.sum(
+            jnp.where(is_arr & is_ap[None, :], 1, 0), axis=1
+        )
+        adv = jnp.where(
+            s["next_arr"] >= INF, INF, s["next_arr"] + interval[None, :]
+        )
+        adv = jnp.where(adv >= stop[None, :], INF, adv)
+        new_next_arr = jnp.where(is_arr, adv, s["next_arr"])
+
+        # head-of-line transition: node had no frame, now has one
+        frame_after = jnp.where(is_arr & ~is_ap[None, :], new_queue > 0, frame)
+        frame_after = jnp.where(
+            is_arr & is_ap[None, :],
+            ((new_bcn > 0) | (jnp.sum(s["ap_pend"], 1) > 0))[:, None],
+            frame_after,
+        )
+        became_hol = is_arr & ~frame & frame_after
+        medium_idle = next_t >= s["busy_until"] + DIFS   # idle ≥ DIFS now
+        imm_grant = became_hol & medium_idle[:, None]
+        drawn = (u_back * (s["cw"] + 1).astype(jnp.float32)).astype(jnp.int32)
+        new_backoff = jnp.where(became_hol & ~imm_grant, drawn, s["backoff"])
+        new_immediate = jnp.where(became_hol, imm_grant, s["immediate"])
+
+        # ---------- transmission processing ----------
+        winners = transmit[:, None] & (tx_t == next_t[:, None]) & frame
+        any_win = jnp.any(winners, axis=1)
+        # countdown credit for non-winning contenders (freeze bookkeeping):
+        # idle slots elapsed since busy-end+DIFS is what everyone consumed
+        elapsed = jnp.maximum((next_t - s["busy_until"] - DIFS) // SLOT, 0)
+        counting = frame & ~winners & ~s["immediate"] & transmit[:, None]
+        new_backoff = jnp.where(
+            counting,
+            jnp.maximum(new_backoff - elapsed[:, None], 0),
+            new_backoff,
+        )
+        # a zero-backoff grant interrupted by someone else's tx redraws
+        interrupted = frame & ~winners & s["immediate"] & transmit[:, None]
+        new_backoff = jnp.where(interrupted, drawn, new_backoff)
+        new_immediate = jnp.where(interrupted, False, new_immediate)
+
+        # AP frame choice: beacon outranks echo (FIFO approximation)
+        ap_sends_beacon = winners[:, 0] & (s["bcn_pend"] > 0)
+        echo_dst = jnp.argmax(s["ap_pend"] > 0, axis=1)   # lowest pending STA
+        dst = jnp.where(is_ap[None, :], echo_dst[:, None], 0)   # (R, N)
+
+        # PHY: signal/interference at each transmitter's destination
+        w = winners.astype(jnp.float32)                  # (R, N)
+        total_at = w @ rx_w                              # (R, N): power at rx j
+        sig = rx_w[jnp.arange(n)[None, :], dst]          # (R, N): tx i → dst_i
+        interf = jnp.take_along_axis(total_at, dst, axis=1) - sig
+        sinr = sig / (noise_w + interf)
+        psr = mode_chunk_success_rate(
+            sinr, jnp.asarray(nbits_data, jnp.float32),
+            jnp.asarray(prog.data_mode_idx),
+        )
+        det = detectable[jnp.arange(n)[None, :], dst]
+        dst_idle = ~jnp.take_along_axis(winners, dst, axis=1)   # half-duplex
+        ok = winners & (u_coin < psr) & det & dst_idle
+        beacon_tx = winners & is_ap[None, :] & ap_sends_beacon[:, None]
+        data_tx = winners & ~beacon_tx
+        success = ok & data_tx
+        fail = data_tx & ~ok
+
+        # ---- outcome updates
+        sta_success = success & ~is_ap[None, :]
+        ap_success = success & is_ap[None, :]
+        new_srv = s["srv_rx"] + jnp.sum(sta_success, axis=1)
+        got_echo = jnp.any(ap_success, axis=1)
+        new_cli = s["cli_rx"].at[jnp.arange(R), echo_dst].add(
+            got_echo.astype(jnp.int32)
+        )
+        new_queue = new_queue - sta_success.astype(jnp.int32)
+        new_ap_pend = s["ap_pend"] + sta_success.astype(jnp.int32)
+        new_ap_pend = new_ap_pend.at[jnp.arange(R), echo_dst].add(
+            -got_echo.astype(jnp.int32)
+        )
+        new_bcn = new_bcn - jnp.where(ap_sends_beacon, 1, 0)
+
+        retry_exceeded = fail & (s["retries"] + 1 > RETRY_LIMIT)
+        new_drops = s["drops"] + jnp.sum(retry_exceeded, axis=1)
+        new_queue = new_queue - (retry_exceeded & ~is_ap[None, :]).astype(jnp.int32)
+        drop_echo = jnp.any(retry_exceeded & is_ap[None, :], axis=1)
+        new_ap_pend = new_ap_pend.at[jnp.arange(R), echo_dst].add(
+            -drop_echo.astype(jnp.int32)
+        )
+        new_retries = jnp.where(
+            success | retry_exceeded | beacon_tx,
+            0,
+            s["retries"] + fail.astype(jnp.int32),
+        )
+        new_cw = jnp.where(
+            success | retry_exceeded | beacon_tx,
+            CW_MIN,
+            jnp.where(fail, jnp.minimum(2 * (s["cw"] + 1) - 1, CW_MAX), s["cw"]),
+        )
+        # transmitters redraw backoff from the *post-outcome* CW (802.11:
+        # reset on success/final-drop, doubled after a failure); the
+        # medium was just busy with their own tx, so no immediate grant
+        drawn_post = (u_back * (new_cw + 1).astype(jnp.float32)).astype(jnp.int32)
+        new_backoff = jnp.where(winners, drawn_post, new_backoff)
+        new_immediate = jnp.where(winners, False, new_immediate)
+
+        # medium occupancy: full exchange when acked, bare data airtime on
+        # a failure (no ack goes out), beacon airtime for beacons; the
+        # failed sender personally waits its ack timeout before recontending
+        occ = jnp.where(
+            success, exch_data, jnp.where(beacon_tx, exch_beacon, data_dur)
+        )
+        new_busy = jnp.where(
+            any_win,
+            next_t + jnp.max(jnp.where(winners, occ, 0), axis=1),
+            s["busy_until"],
+        )
+        new_hold = jnp.where(
+            fail,
+            next_t[:, None] + ack_timeout,
+            jnp.where(winners, next_t[:, None] + occ, s["hold"]),
+        )
+
+        return dict(
+            t=jnp.maximum(next_t, s["t"]),
+            next_arr=new_next_arr,
+            queue=jnp.maximum(new_queue, 0),
+            ap_pend=jnp.maximum(new_ap_pend, 0),
+            bcn_pend=jnp.maximum(new_bcn, 0),
+            backoff=new_backoff,
+            hold=new_hold,
+            immediate=new_immediate,
+            cw=new_cw,
+            retries=new_retries,
+            busy_until=new_busy,
+            srv_rx=new_srv,
+            cli_rx=new_cli,
+            tx_data=s["tx_data"] + jnp.sum(data_tx, axis=1),
+            drops=new_drops,
+            step=s["step"] + 1,
+        )
+
+    def pending(s):
+        tx_t = jnp.min(tx_times(s), axis=1)
+        ta = jnp.min(s["next_arr"], axis=1)
+        return (s["t"] < sim_end) & (jnp.minimum(ta, tx_t) < sim_end)
+
+    return init_state, pending, step_fn
+
+
+def run_replicated_bss(
+    prog: BssProgram,
+    replicas: int,
+    key: jax.Array,
+    max_steps: int | None = None,
+    mesh=None,
+):
+    """Execute ``replicas`` Monte-Carlo replicas of the scenario.
+
+    Returns a dict of per-replica outcome arrays:
+      ``srv_rx``   (R,)   echo requests decoded at the AP
+      ``cli_rx``   (R,N)  echo replies decoded per STA (col 0 unused)
+      ``tx_data``  (R,)   data-frame transmission attempts
+      ``drops``    (R,)   frames dropped at retry limit
+      ``steps``    int    vector event-loop iterations executed
+      ``all_done`` bool   every replica reached sim_end (sanity flag)
+
+    With ``mesh`` (a 1-axis ``jax.sharding.Mesh`` named "replica"), the
+    replica axis of every state array is sharded over the mesh devices;
+    the only cross-device traffic is the loop's any-replica-pending
+    reduction (the LBTS-grant analog) and the final stats gather.
+    """
+    if max_steps is None:
+        max_steps = _estimate_max_steps(prog)
+    init_state, pending, step_fn = build_bss_step(prog, replicas)
+
+    s0 = init_state()
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def shard(v):
+            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == replicas:
+                spec = P("replica", *([None] * (v.ndim - 1)))
+                return jax.device_put(v, NamedSharding(mesh, spec))
+            return v
+
+        s0 = {k: shard(v) for k, v in s0.items()}
+
+    @jax.jit
+    def run(s, key):
+        def cond(s):
+            return jnp.logical_and(s["step"] < max_steps, jnp.any(pending(s)))
+
+        return jax.lax.while_loop(cond, lambda st: step_fn(st, key), s)
+
+    out = run(s0, key)
+    out["srv_rx"].block_until_ready()
+    all_done = not bool(jnp.any(pending(out)))
+    return dict(
+        srv_rx=out["srv_rx"],
+        cli_rx=out["cli_rx"],
+        tx_data=out["tx_data"],
+        drops=out["drops"],
+        steps=int(out["step"]),
+        all_done=all_done,
+    )
