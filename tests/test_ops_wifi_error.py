@@ -155,10 +155,30 @@ def test_nist_qam_ber_reference_values():
     got16 = float(WE.uncoded_ber(jnp.asarray(snr), jnp.asarray(16.0)))
     assert got16 == pytest.approx(want16, rel=1e-5)
 
-    # 64-QAM: 2(1-1/8)/6 * erfc(sqrt(3snr/126)) = (7/24) erfc(sqrt(snr/42))
-    want64 = (7.0 / 24.0) * math.erfc(math.sqrt(snr / 42.0))
+    # 64-QAM: upstream Get64QamBer uses z = sqrt(snr/(7*3)) = sqrt(snr/21)
+    # (ADVICE r2 medium — NOT the generic sqrt(snr/42)); prefactor 7/24
+    want64 = (7.0 / 24.0) * math.erfc(math.sqrt(snr / 21.0))
     got64 = float(WE.uncoded_ber(jnp.asarray(snr), jnp.asarray(64.0)))
     assert got64 == pytest.approx(want64, rel=1e-5)
+
+    # 256-QAM: z = sqrt(snr/60), prefactor 15/64; 1024-QAM: z =
+    # sqrt(snr/155), prefactor 31/160
+    want256 = (15.0 / 64.0) * math.erfc(math.sqrt(snr / 60.0))
+    got256 = float(WE.uncoded_ber(jnp.asarray(snr), jnp.asarray(256.0)))
+    assert got256 == pytest.approx(want256, rel=1e-5)
+    want1024 = (31.0 / 160.0) * math.erfc(math.sqrt(snr / 155.0))
+    got1024 = float(WE.uncoded_ber(jnp.asarray(snr), jnp.asarray(1024.0)))
+    assert got1024 == pytest.approx(want1024, rel=1e-5)
+    # f64 oracle and jnp kernel agree end-to-end on every QAM order
+    for m in (16, 64, 256, 1024):
+        oracle = WE.chunk_success_rate_py(snr, 4000.0, m, WE.RATE_3_4)
+        kernel = float(
+            WE.chunk_success_rate(
+                jnp.asarray(snr), jnp.asarray(4000.0), jnp.asarray(float(m)),
+                jnp.asarray(WE.RATE_3_4),
+            )
+        )
+        assert kernel == pytest.approx(oracle, rel=2e-3)
 
     # the f64 oracle must produce the success rate implied by the fixed
     # closed form end-to-end (catches a re-introduced 0.5 factor)

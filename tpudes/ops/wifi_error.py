@@ -68,13 +68,23 @@ _PE_EXPONENTS = _np.array(PE_EXPONENTS_TABLE)
 RATE_1_2, RATE_2_3, RATE_3_4, RATE_5_6 = 0, 1, 2, 3
 
 
+# erfc-argument divisors per upstream's GetMQamBer closed forms: z =
+# √(snr/div).  Only 16-QAM coincides with the textbook 2(M-1)/3; the
+# higher orders use (√M−1)·log2(√M)-family constants (ADVICE r2 medium).
+# Shared by the jnp kernel and the f64 oracle so they cannot drift.
+QAM_DIVISORS = {16.0: 10.0, 64.0: 21.0, 256.0: 60.0, 1024.0: 155.0}
+
+
 def _qam_ber(snr: jax.Array, m: jax.Array) -> jax.Array:
     """Gray-coded square M-QAM AWGN BER:
-    2(1-1/√M)/log2(M) · erfc(√(3·snr / (2(M-1)))).
-    Reproduces upstream's Get16/64/256/1024QamBer closed forms
-    (16-QAM: 0.75·erfc(√(snr/10)) — no extra ½ factor)."""
+    2(1-1/√M)/log2(M) · erfc(√(snr/div(M))) with upstream's per-M
+    divisors (QAM_DIVISORS) — no extra ½ factor."""
     log2m = jnp.log2(m)
-    z = jnp.sqrt(3.0 * snr / (2.0 * (m - 1.0)))
+    d16, d64, d256, d1024 = (QAM_DIVISORS[k] for k in (16.0, 64.0, 256.0, 1024.0))
+    div = jnp.where(
+        m <= 16.0, d16, jnp.where(m <= 64.0, d64, jnp.where(m <= 256.0, d256, d1024))
+    )
+    z = jnp.sqrt(snr / div)
     return (2.0 * (1.0 - 1.0 / jnp.sqrt(m)) / log2m) * erfc(z)
 
 
@@ -224,7 +234,7 @@ def chunk_success_rate_py(snr: float, nbits: float, constellation: int, rate_cla
         ber = 0.5 * math.erfc(math.sqrt(snr / 2.0))
     else:
         m = float(constellation)
-        z = math.sqrt(3.0 * snr / (2.0 * (m - 1.0)))
+        z = math.sqrt(snr / QAM_DIVISORS[m])
         ber = (2.0 * (1.0 - 1.0 / math.sqrt(m)) / math.log2(m)) * math.erfc(z)
     p = min(max(ber, 0.0), 0.5)
     d = math.sqrt(4.0 * p * (1.0 - p))
