@@ -1,0 +1,37 @@
+"""LTE / LENA module (SURVEY.md §2.6): spectrum PHY + MI error model +
+FF-MAC schedulers + RLC/PDCP + ideal RRC + EPC stub + helpers.
+
+The per-TTI hot path (SURVEY.md §3.4) runs batched over all cells and
+UEs in :mod:`tpudes.models.lte.controller`; the pure kernels live in
+:mod:`tpudes.ops.lte`.
+"""
+
+from tpudes.models.lte.controller import LteTtiController
+from tpudes.models.lte.device import (
+    LteEnbNetDevice,
+    LteEnbRrc,
+    LteUeNetDevice,
+    LteUeRrc,
+    RadioBearer,
+)
+from tpudes.models.lte.epc import EpcHelper, PgwNetDevice
+from tpudes.models.lte.helper import LteHelper, RadioEnvironmentMapHelper
+from tpudes.models.lte.phy import LteEnbPhy, LteSpectrumPhy, LteUePhy
+from tpudes.models.lte.rlc import (
+    LtePdcp,
+    LteRlcSm,
+    LteRlcTm,
+    LteRlcUm,
+)
+from tpudes.models.lte.scheduler import (
+    PfFfMacScheduler,
+    RrFfMacScheduler,
+)
+
+__all__ = [
+    "LteTtiController", "LteEnbNetDevice", "LteEnbRrc", "LteUeNetDevice",
+    "LteUeRrc", "RadioBearer", "EpcHelper", "PgwNetDevice", "LteHelper",
+    "RadioEnvironmentMapHelper", "LteEnbPhy", "LteSpectrumPhy", "LteUePhy",
+    "LtePdcp", "LteRlcSm", "LteRlcTm", "LteRlcUm", "PfFfMacScheduler",
+    "RrFfMacScheduler",
+]

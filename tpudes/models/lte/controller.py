@@ -1,0 +1,414 @@
+"""LteTtiController — the batched per-TTI engine for every cell at once.
+
+Reference parity (SURVEY.md §3.4 call stack): upstream clocks each eNB
+with per-subframe events (LteEnbPhy::StartSubFrame), each of which runs
+the FF-MAC scheduler, transmits over MultiModelSpectrumChannel (an
+O(eNB×UE) loop), collects interference chunks, and decodes TBs per UE
+(LteSpectrumPhy::StartRxData → LteInterference → LteMiErrorModel).
+
+TPU-first redesign: LTE subframes are *synchronous network-wide*, so
+the whole per-TTI PHY — every cell's PSD, every UE's per-RB SINR, MI,
+BLER and decode draw, both directions — is ONE jitted kernel call
+(ops/lte.py::tti_phy_step) driven by ONE simulator event per TTI.  The
+host side keeps what is genuinely sequential/stateful: FF-MAC
+scheduling decisions, RLC segmentation, HARQ bookkeeping, RRC state.
+This is the 1 ms natural conservative window SURVEY.md §7 hard-part 1
+identifies ("LTE is easier: 1 ms TTI is a natural window").
+
+Timing-model notes (deviations, all fixed offsets):
+- TB decode outcome is computed in the transmitting TTI's event; HARQ
+  retransmissions run at +8 TTIs (the upstream HARQ RTT), CQI feedback
+  applies after ``CQI_DELAY_TTIS``.
+- Uplink uses the same type-0 RBG allocation as downlink (upstream UL
+  is contiguous SC-FDMA allocation).
+- UE→eNB and eNB→UE path gains are reciprocal (same loss model, no
+  per-direction fading this round).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudes.core.nstime import MilliSeconds
+from tpudes.core.rng import RngSeedManager
+from tpudes.core.simulator import Simulator
+from tpudes.models.lte.scheduler import (
+    HARQ_MAX_TX,
+    HARQ_RTT_TTIS,
+    Allocation,
+    HarqTb,
+    SchedCandidate,
+    rbg_size_for,
+)
+from tpudes.ops.lte import RB_BANDWIDTH_HZ
+
+CQI_DELAY_TTIS = 3
+
+
+class LteTtiController:
+    """One instance per LteHelper: owns the synchronized TTI clock and
+    the batched PHY state for all installed cells and UEs."""
+
+    def __init__(self, pathloss_model, n_rb: int = 25):
+        self.pathloss = pathloss_model
+        self.n_rb = n_rb
+        self.rbg_size = rbg_size_for(n_rb)
+        self.n_rbg = (n_rb + self.rbg_size - 1) // self.rbg_size
+        self.enbs: list = []
+        self.ues: list = []
+        self.tti = 0
+        self._started = False
+        self._dirty = True
+        self._static_geometry = True
+        # device-side constants (built lazily)
+        self._gain_dl = None          # (E, U)
+        self._gain_ul_eff = None      # (U, U): v's gain at u's serving eNB
+        self._serving = None          # (U,)
+        self._harq_dl: dict[int, list[HarqTb]] = {}
+        self._harq_ul: dict[int, list[HarqTb]] = {}
+        self._cqi_dl = None           # (U,) applied CQI at the eNB
+        self._cqi_ul = None
+        self._cqi_queue: list = []    # (apply_tti, cqi_dl, cqi_ul)
+        self._key = None
+        self._jit_step = None
+        self.stats = {
+            "dl_tbs": 0, "dl_ok": 0, "dl_harq_retx": 0, "dl_drops": 0,
+            "ul_tbs": 0, "ul_ok": 0, "ul_harq_retx": 0, "ul_drops": 0,
+            "ttis": 0,
+        }
+
+    # --- wiring -----------------------------------------------------------
+    def add_enb(self, dev) -> None:
+        self.enbs.append(dev)
+        self._harq_dl[len(self.enbs) - 1] = []
+        self._harq_ul[len(self.enbs) - 1] = []
+        self._dirty = True
+
+    def add_ue(self, dev) -> None:
+        self.ues.append(dev)
+        self._dirty = True
+
+    def attach(self, ue_dev, enb_dev) -> None:
+        ctx = enb_dev.rrc.add_ue(ue_dev)
+        ue_dev.rrc.connect(enb_dev, ctx.rnti)
+        self._dirty = True
+        self.start()
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        import jax
+
+        self._key = jax.random.PRNGKey(
+            (RngSeedManager.GetSeed() * 2654435761 + RngSeedManager.GetRun())
+            & 0x7FFFFFFF
+        )
+        Simulator.Schedule(MilliSeconds(0), self._tti_event)
+
+    # --- geometry / arrays ------------------------------------------------
+    def _positions(self, devs) -> np.ndarray:
+        from tpudes.models.mobility import MobilityModel
+
+        pos = np.zeros((len(devs), 3), dtype=np.float64)
+        for i, d in enumerate(devs):
+            mob = d.GetNode().GetObject(MobilityModel)
+            if mob is None:
+                raise RuntimeError("LTE devices need a mobility model")
+            p = mob.GetPosition()
+            pos[i] = (p.x, p.y, p.z)
+            if "ConstantPosition" not in type(mob).__name__:
+                self._static_geometry = False
+        return pos
+
+    def _rebuild(self) -> None:
+        import jax.numpy as jnp
+
+        self._dirty = False
+        e, u = len(self.enbs), len(self.ues)
+        if e == 0 or u == 0:
+            return
+        self._static_geometry = True
+        pos_e = self._positions(self.enbs)
+        pos_u = self._positions(self.ues)
+        d = np.sqrt(
+            ((pos_e[:, None, :] - pos_u[None, :, :]) ** 2).sum(-1)
+        )  # (E, U)
+        # loss chain evaluated as one batched kernel call: gain below
+        # unity, reciprocal between directions
+        loss_db = -np.asarray(
+            self.pathloss.batch_rx_power(jnp.zeros(()), jnp.asarray(d))
+        )
+        self._gain_dl = 10.0 ** (-loss_db / 10.0)               # (E, U)
+        serving = np.full((u,), -1, dtype=np.int64)
+        enb_index = {id(dev): i for i, dev in enumerate(self.enbs)}
+        for i, ue in enumerate(self.ues):
+            s = ue.rrc.serving_enb
+            if s is not None:
+                serving[i] = enb_index[id(s)]
+        self._serving = serving
+        self._ue_index = {id(dev): i for i, dev in enumerate(self.ues)}
+        # v transmitting → power at u's serving eNB: (U, U)
+        safe = np.maximum(serving, 0)
+        self._gain_ul_eff = self._gain_dl.T[:, safe].astype(np.float64)
+        if self._cqi_dl is None or len(self._cqi_dl) != u:
+            self._cqi_dl = np.zeros((u,), dtype=np.int64)
+            self._cqi_ul = np.zeros((u,), dtype=np.int64)
+        # full-power reference PSDs (RS-like) for CQI measurement
+        self._ref_psd_dl = np.zeros((e, self.n_rb))
+        for i, enb in enumerate(self.enbs):
+            p_w = 10.0 ** ((enb.phy.tx_power_dbm - 30.0) / 10.0)
+            self._ref_psd_dl[i, :] = p_w / (self.n_rb * RB_BANDWIDTH_HZ)
+        self._ref_psd_ul = np.zeros((u, self.n_rb))
+        for i, ue in enumerate(self.ues):
+            p_w = 10.0 ** ((ue.phy.tx_power_dbm - 30.0) / 10.0)
+            self._ref_psd_ul[i, :] = p_w / (self.n_rb * RB_BANDWIDTH_HZ)
+        nf_ue = {float(ue.phy.noise_figure_db) for ue in self.ues}
+        nf_enb = {float(enb.phy.noise_figure_db) for enb in self.enbs}
+        if len(nf_ue) > 1 or len(nf_enb) > 1:
+            raise RuntimeError(
+                "batched TTI path assumes uniform noise figures per side"
+            )
+        self._noise_dl = self.ues[0].phy.noise_psd
+        self._noise_ul = self.enbs[0].phy.noise_psd
+        if self._jit_step is None:
+            import jax
+
+            from tpudes.ops.lte import tti_phy_step
+
+            # both directions fused into ONE device call per TTI: over a
+            # remote accelerator (axon tunnel) each host↔device round
+            # trip costs ~100 ms, so the TTI event makes exactly one
+            # dispatch and one device_get (SURVEY.md §7 hard part 3)
+            def both(dl_args, ul_args, noise_dl, noise_ul, k):
+                import jax as _jax
+
+                k_dl, k_ul = _jax.random.split(k)
+                return (
+                    tti_phy_step(*dl_args, k_dl, noise_dl),
+                    tti_phy_step(*ul_args, k_ul, noise_ul),
+                )
+
+            self._jit_step = jax.jit(both)
+
+    # --- per-TTI scheduling (host side) -----------------------------------
+    def _cell_ue_indices(self, e_idx: int) -> list[int]:
+        return [i for i in range(len(self.ues)) if self._serving[i] == e_idx]
+
+    def _schedule_direction(self, direction: str):
+        """Run HARQ-first + FF-MAC allocation for every cell; returns the
+        packed (alloc, mcs, tb_bits, mi_acc, tx_psd, served) arrays."""
+        u = len(self.ues)
+        e = len(self.enbs)
+        alloc = np.zeros((u, self.n_rb), dtype=bool)
+        mcs = np.zeros((u,), dtype=np.int64)
+        tb_bits = np.zeros((u,), dtype=np.float64)
+        mi_acc = np.zeros((u,), dtype=np.float64)
+        tx_psd = np.zeros((e, self.n_rb)) if direction == "dl" else np.zeros(
+            (u, self.n_rb)
+        )
+        tb_by_ue: dict[int, HarqTb] = {}
+        harq_map = self._harq_dl if direction == "dl" else self._harq_ul
+        cqi = self._cqi_dl if direction == "dl" else self._cqi_ul
+
+        for e_idx, enb in enumerate(self.enbs):
+            members = self._cell_ue_indices(e_idx)
+            if not members:
+                continue
+            free = list(range(self.n_rbg))
+            allocs: list[Allocation] = []
+            # 1. HARQ retransmissions due this TTI
+            pending = harq_map[e_idx]
+            still: list[HarqTb] = []
+            for tb in pending:
+                ue_i = tb.rnti_ue_index
+                if tb.due_tti > self.tti or ue_i in tb_by_ue:
+                    still.append(tb)
+                    continue
+                if len(free) < tb.n_rbg:
+                    tb.due_tti = self.tti + 1
+                    still.append(tb)
+                    continue
+                take, free = free[: tb.n_rbg], free[tb.n_rbg:]
+                allocs.append(
+                    Allocation(tb.rnti, take, tb.mcs, tb.tb_bytes, harq=tb)
+                )
+                self.stats[f"{direction}_harq_retx"] += 1
+            harq_map[e_idx] = still
+            # 2. new transmissions
+            scheduler = (
+                enb.scheduler if direction == "dl" else enb.ul_scheduler
+            )
+            rnti_to_ue = {
+                ctx.rnti: self._ue_index[id(ctx.ue_device)]
+                for ctx in enb.rrc.ues.values()
+            }
+            candidates = []
+            for rnti, ctx in enb.rrc.ues.items():
+                ue_i = rnti_to_ue[rnti]
+                if ue_i in tb_by_ue or any(
+                    tb.rnti == rnti for tb in allocs
+                ):
+                    continue  # one TB per UE per TTI
+                queue = sum(
+                    (b.dl_tx if direction == "dl" else b.ul_tx).BufferBytes()
+                    for b in ctx.bearers.values()
+                )
+                if queue <= 0 or cqi[ue_i] < 1:
+                    continue
+                candidates.append(
+                    SchedCandidate(rnti, int(cqi[ue_i]), queue)
+                )
+            allocs.extend(
+                scheduler.schedule(self.tti, candidates, free, self.rbg_size)
+            )
+            # 3. pack allocations into arrays + pull RLC PDUs
+            for a in allocs:
+                ue_i = rnti_to_ue.get(a.rnti)
+                if ue_i is None or ue_i in tb_by_ue:
+                    continue
+                ctx = enb.rrc.ues[a.rnti]
+                if a.harq is None:
+                    pdu = None
+                    for b in sorted(ctx.bearers):
+                        rlc = (
+                            ctx.bearers[b].dl_tx
+                            if direction == "dl"
+                            else ctx.bearers[b].ul_tx
+                        )
+                        pdu = rlc.NotifyTxOpportunity(a.tb_bytes)
+                        if pdu is not None:
+                            tb = HarqTb(
+                                a.rnti, pdu, a.mcs, len(a.rbgs), a.tb_bytes
+                            )
+                            tb.bearer = ctx.bearers[b]
+                            tb.tx_count = 1
+                            break
+                    if pdu is None:
+                        continue
+                    self.stats[f"{direction}_tbs"] += 1
+                else:
+                    tb = a.harq
+                    tb.tx_count += 1
+                tb.rnti_ue_index = ue_i
+                tb_by_ue[ue_i] = tb
+                rbs = [
+                    r
+                    for g in a.rbgs
+                    for r in range(
+                        g * self.rbg_size,
+                        min((g + 1) * self.rbg_size, self.n_rb),
+                    )
+                ]
+                alloc[ue_i, rbs] = True
+                mcs[ue_i] = a.mcs
+                tb_bits[ue_i] = a.tb_bytes * 8.0
+                mi_acc[ue_i] = tb.mi_acc
+                if direction == "dl":
+                    p_w = 10.0 ** ((enb.phy.tx_power_dbm - 30.0) / 10.0)
+                    tx_psd[e_idx, rbs] += p_w / (self.n_rb * RB_BANDWIDTH_HZ)
+                else:
+                    ue = self.ues[ue_i]
+                    p_w = 10.0 ** ((ue.phy.tx_power_dbm - 30.0) / 10.0)
+                    # UL concentrates the UE's power in its allocated RBs
+                    tx_psd[ue_i, rbs] = p_w / (len(rbs) * RB_BANDWIDTH_HZ)
+        return alloc, mcs, tb_bits, mi_acc, tx_psd, tb_by_ue
+
+    # --- the TTI event ----------------------------------------------------
+    def _tti_event(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if self._dirty:
+            self._rebuild()
+        elif not self._static_geometry:
+            self._rebuild()
+        u, e = len(self.ues), len(self.enbs)
+        if u and e:
+            self.stats["ttis"] += 1
+            key = jax.random.fold_in(self._key, self.tti)
+            served_bits_by_cell: dict[str, dict[int, dict[int, int]]] = {}
+
+            # host side: both directions' scheduling first, then ONE
+            # fused device call and ONE device_get
+            sched = {d: self._schedule_direction(d) for d in ("dl", "ul")}
+
+            def pack(direction):
+                alloc, mcs, tb_bits, mi_acc, tx_psd, _ = sched[direction]
+                if direction == "dl":
+                    gain, serving, ref = (
+                        self._gain_dl, self._serving, self._ref_psd_dl,
+                    )
+                else:
+                    gain, serving, ref = (
+                        self._gain_ul_eff, np.arange(u), self._ref_psd_ul,
+                    )
+                return (
+                    jnp.asarray(tx_psd),
+                    jnp.asarray(ref),
+                    jnp.asarray(gain),
+                    jnp.asarray(np.maximum(serving, 0), dtype=jnp.int32),
+                    jnp.asarray(alloc),
+                    jnp.asarray(mcs, dtype=jnp.int32),
+                    jnp.asarray(tb_bits, dtype=jnp.float32),
+                    jnp.asarray(mi_acc, dtype=jnp.float32),
+                )
+
+            out_dl, out_ul = jax.device_get(
+                self._jit_step(
+                    pack("dl"), pack("ul"), self._noise_dl, self._noise_ul, key
+                )
+            )
+            for direction, (ok, _bler, cqi_meas, mi_new) in (
+                ("dl", out_dl), ("ul", out_ul)
+            ):
+                tb_by_ue = sched[direction][5]
+                served: dict[int, dict[int, int]] = {}
+                for ue_i, tb in tb_by_ue.items():
+                    e_idx = int(self._serving[ue_i])
+                    if ok[ue_i]:
+                        rx = (
+                            tb.bearer.dl_rx
+                            if direction == "dl"
+                            else tb.bearer.ul_rx
+                        )
+                        rx.ReceivePdu(tb.pdu)
+                        self.stats[f"{direction}_ok"] += 1
+                        served.setdefault(e_idx, {})[tb.rnti] = int(
+                            tb.tb_bytes * 8
+                        )
+                    elif tb.tx_count < HARQ_MAX_TX:
+                        tb.mi_acc = float(mi_new[ue_i])
+                        tb.due_tti = self.tti + HARQ_RTT_TTIS
+                        harq_map = (
+                            self._harq_dl if direction == "dl" else self._harq_ul
+                        )
+                        harq_map[e_idx].append(tb)
+                    else:
+                        self.stats[f"{direction}_drops"] += 1
+                served_bits_by_cell[direction] = served
+                if direction == "dl":
+                    self._pending_cqi_dl = cqi_meas
+                else:
+                    self._pending_cqi_ul = cqi_meas
+
+            # CQI feedback delay
+            self._cqi_queue.append(
+                (self.tti + CQI_DELAY_TTIS, self._pending_cqi_dl,
+                 self._pending_cqi_ul)
+            )
+            while self._cqi_queue and self._cqi_queue[0][0] <= self.tti + 1:
+                _, cqi_dl, cqi_ul = self._cqi_queue.pop(0)
+                self._cqi_dl = cqi_dl
+                self._cqi_ul = cqi_ul
+            # PF averages (both directions)
+            for e_idx, enb in enumerate(self.enbs):
+                rntis = [c.rnti for c in enb.rrc.ues.values()]
+                for sched, dirn in ((enb.scheduler, "dl"), (enb.ul_scheduler, "ul")):
+                    if hasattr(sched, "end_tti"):
+                        sched.end_tti(
+                            served_bits_by_cell.get(dirn, {}).get(e_idx, {}),
+                            rntis,
+                        )
+        self.tti += 1
+        Simulator.Schedule(MilliSeconds(1), self._tti_event)

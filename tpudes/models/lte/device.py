@@ -1,0 +1,215 @@
+"""LTE net devices + ideal RRC + radio bearers.
+
+Reference parity: src/lte/model/lte-enb-net-device.{h,cc},
+lte-ue-net-device.{h,cc}, lte-enb-rrc.{h,cc}, lte-ue-rrc.{h,cc},
+lte-rrc-protocol-ideal.{h,cc}, eps-bearer.{h,cc} (upstream paths; mount
+empty at survey — SURVEY.md §0, §2.6 "RRC" row).
+
+RRC here is the *ideal* protocol variant: connection setup, RNTI
+assignment and bearer establishment happen by direct state mutation
+with no over-the-air RRC messages — exactly the fixture upstream ships
+for tests (SURVEY.md §4 "ideal RRC protocol to bypass real message
+exchange").  The real-message RRC state machine is an explicit
+out-of-scope note for this round.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpudes.core.object import TypeId
+from tpudes.models.internet.ipv4 import Ipv4Header
+from tpudes.models.lte.phy import LteEnbPhy, LteUePhy
+from tpudes.models.lte.rlc import LtePdcp, LteRlc, make_rlc
+from tpudes.network.net_device import NetDevice
+
+
+@dataclass
+class RadioBearer:
+    """One EPS data radio bearer: RLC+PDCP entities for both directions
+    (DL tx lives at the eNB, UL tx at the UE)."""
+
+    lcid: int
+    mode: str                      # "sm" | "um" | "tm"
+    dl_tx: LteRlc = None
+    dl_rx: LteRlc = None
+    ul_tx: LteRlc = None
+    ul_rx: LteRlc = None
+    dl_pdcp: LtePdcp = None
+    ul_pdcp: LtePdcp = None
+
+    @classmethod
+    def create(cls, lcid: int, mode: str) -> "RadioBearer":
+        b = cls(lcid, mode)
+        b.dl_tx, b.dl_rx = make_rlc(mode), make_rlc(mode)
+        b.ul_tx, b.ul_rx = make_rlc(mode), make_rlc(mode)
+        b.dl_pdcp = LtePdcp(b.dl_tx)
+        b.ul_pdcp = LtePdcp(b.ul_tx)
+        return b
+
+
+@dataclass
+class UeContext:
+    """Per-UE state at the eNB (lte-enb-rrc.cc UeManager)."""
+
+    rnti: int
+    ue_device: "LteUeNetDevice"
+    bearers: dict[int, RadioBearer] = field(default_factory=dict)
+
+
+class LteEnbRrc:
+    """eNB-side ideal RRC: RNTI allocation + bearer setup."""
+
+    def __init__(self, enb_device: "LteEnbNetDevice"):
+        self.device = enb_device
+        self.ues: dict[int, UeContext] = {}
+        self._next_rnti = 1
+
+    def add_ue(self, ue_device: "LteUeNetDevice") -> UeContext:
+        rnti = self._next_rnti
+        self._next_rnti += 1
+        ctx = UeContext(rnti, ue_device)
+        self.ues[rnti] = ctx
+        return ctx
+
+    def setup_bearer(self, ctx: UeContext, mode: str) -> RadioBearer:
+        lcid = 3 + len(ctx.bearers)  # LCID 1-2 reserved for SRBs
+        bearer = RadioBearer.create(lcid, mode)
+        ctx.bearers[lcid] = bearer
+        ue_rrc = ctx.ue_device.rrc
+        ue_rrc.bearers[lcid] = bearer
+        # DL SDUs reassembled at the UE surface through its net device
+        bearer.dl_rx.rx_sdu_callback = ctx.ue_device.receive_dl_sdu
+        # UL SDUs reassembled at the eNB are forwarded to the core
+        bearer.ul_rx.rx_sdu_callback = self.device.receive_ul_sdu
+        return bearer
+
+
+class LteUeRrc:
+    """UE-side ideal RRC: serving-cell + bearer registry."""
+
+    IDLE, CONNECTED = 0, 1
+
+    def __init__(self, ue_device: "LteUeNetDevice"):
+        self.device = ue_device
+        self.state = self.IDLE
+        self.serving_enb: "LteEnbNetDevice | None" = None
+        self.rnti = 0
+        self.bearers: dict[int, RadioBearer] = {}
+
+    def connect(self, enb_device: "LteEnbNetDevice", rnti: int) -> None:
+        self.serving_enb = enb_device
+        self.rnti = rnti
+        self.state = self.CONNECTED
+
+
+class LteEnbNetDevice(NetDevice):
+    """eNB device (lte-enb-net-device.cc): cell identity + PHY + RRC;
+    the MAC scheduler instance is attached by LteHelper."""
+
+    tid = (
+        TypeId("tpudes::LteEnbNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddAttribute("CellId", "physical cell id", 0, field="cell_id")
+    )
+
+    _next_cell_id = 1
+
+    def __init__(self, n_rb: int = 25, **attributes):
+        super().__init__(**attributes)
+        self.cell_id = LteEnbNetDevice._next_cell_id
+        LteEnbNetDevice._next_cell_id += 1
+        self.phy = LteEnbPhy(n_rb=n_rb)
+        self.rrc = LteEnbRrc(self)
+        self.scheduler = None          # FfMacScheduler, set by helper
+        self.ul_scheduler = None
+        self.controller = None         # LteTtiController, set by helper
+        self.ul_sdu_callback = None    # EPC hook: cb(packet) for UL IP SDUs
+
+    def GetCellId(self) -> int:
+        return self.cell_id
+
+    def GetPhy(self) -> LteEnbPhy:
+        return self.phy
+
+    def IsBroadcast(self) -> bool:
+        return False
+
+    def NeedsArp(self) -> bool:
+        return False
+
+    def receive_ul_sdu(self, packet) -> None:
+        """Reassembled uplink IP SDU: hand to the EPC (or local stack
+        when the eNB itself terminates IP, as in test topologies)."""
+        if self.ul_sdu_callback is not None:
+            self.ul_sdu_callback(packet)
+        else:
+            self._deliver_up(packet, 0x0800, self._address, self._address, 0)
+
+    def dl_enqueue(self, ue_device: "LteUeNetDevice", packet) -> bool:
+        """EPC downlink entry: push an IP packet into the UE's default
+        DL bearer at this eNB."""
+        ctx = next(
+            (c for c in self.rrc.ues.values() if c.ue_device is ue_device), None
+        )
+        if ctx is None or not ctx.bearers:
+            return False
+        bearer = ctx.bearers[min(ctx.bearers)]
+        bearer.dl_pdcp.TransmitSdu(packet)
+        return True
+
+    def Send(self, packet, dest, protocol: int) -> bool:
+        """IP-level send from the eNB node itself: route by destination
+        UE address (test topologies without an EPC)."""
+        header = packet.PeekHeader(Ipv4Header)
+        if header is None:
+            return False
+        for ctx in self.rrc.ues.values():
+            ue_ip = getattr(ctx.ue_device, "ue_ipv4", None)
+            if ue_ip is not None and ue_ip == header.GetDestination():
+                return self.dl_enqueue(ctx.ue_device, packet)
+        return False
+
+
+class LteUeNetDevice(NetDevice):
+    """UE device (lte-ue-net-device.cc): IMSI + PHY + RRC; IP packets
+    sent through it ride the default UL bearer."""
+
+    tid = (
+        TypeId("tpudes::LteUeNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddAttribute("Imsi", "subscriber id", 0, field="imsi")
+    )
+
+    _next_imsi = 1
+
+    def __init__(self, n_rb: int = 25, **attributes):
+        super().__init__(**attributes)
+        self.imsi = LteUeNetDevice._next_imsi
+        LteUeNetDevice._next_imsi += 1
+        self.phy = LteUePhy(n_rb=n_rb)
+        self.rrc = LteUeRrc(self)
+        self.ue_ipv4 = None            # assigned by EpcHelper
+
+    def GetImsi(self) -> int:
+        return self.imsi
+
+    def GetPhy(self) -> LteUePhy:
+        return self.phy
+
+    def IsBroadcast(self) -> bool:
+        return False
+
+    def NeedsArp(self) -> bool:
+        return False
+
+    def receive_dl_sdu(self, packet) -> None:
+        """Reassembled downlink IP SDU surfaces into the UE's stack."""
+        self._deliver_up(packet, 0x0800, self._address, self._address, 0)
+
+    def Send(self, packet, dest, protocol: int) -> bool:
+        if self.rrc.state != LteUeRrc.CONNECTED or not self.rrc.bearers:
+            return False
+        bearer = self.rrc.bearers[min(self.rrc.bearers)]
+        bearer.ul_pdcp.TransmitSdu(packet)
+        return True

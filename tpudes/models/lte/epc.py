@@ -1,0 +1,141 @@
+"""EPC core-network stub: PGW node + UE IP addressing + S1-U shortcut.
+
+Reference parity: src/lte/model/epc-{sgw,pgw,mme}-application.{h,cc},
+epc-gtpu-header.{h,cc}, helper/point-to-point-epc-helper.{h,cc}
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.6 "EPC core
+network" row).
+
+Scope note (explicit stub, per the round-3 plan): upstream tunnels IP
+packets through in-sim GTP-U/UDP links between eNB, SGW and PGW.  Here
+the PGW is a real Node with a real IP stack and a ``PgwNetDevice``
+claiming the UE subnet (7.0.0.0/8), but the S1-U leg PGW↔eNB is an
+ideal zero-delay shortcut (direct RLC enqueue) rather than a modeled
+GTP-U tunnel.  Remote hosts, routing, sockets and applications work
+exactly as with the full EPC; only the backhaul leg's delay/capacity is
+idealized.  GTP-U tunnel modeling is future work.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.object import TypeId
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper
+from tpudes.models.internet.ipv4 import (
+    Ipv4InterfaceAddress,
+    Ipv4L3Protocol,
+    Ipv4StaticRouting,
+)
+from tpudes.models.internet.ipv4 import Ipv4Header
+from tpudes.network.address import Ipv4Address, Ipv4Mask
+from tpudes.network.net_device import NetDevice
+from tpudes.network.node import Node
+
+
+class PgwNetDevice(NetDevice):
+    """The PGW's tunnel endpoint: IP packets routed to 7.0.0.0/8 exit
+    the PGW stack here and are pushed down the serving eNB's DL bearer;
+    uplink SDUs from eNBs enter the PGW stack through it."""
+
+    tid = TypeId("tpudes::PgwNetDevice").SetParent(NetDevice.tid)
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._ue_by_ip: dict[int, object] = {}
+
+    def register_ue(self, ip: Ipv4Address, ue_device) -> None:
+        self._ue_by_ip[ip.addr] = ue_device
+
+    def NeedsArp(self) -> bool:
+        return False
+
+    def IsBroadcast(self) -> bool:
+        return False
+
+    def Send(self, packet, dest, protocol: int) -> bool:
+        header = packet.PeekHeader(Ipv4Header)
+        if header is None:
+            return False
+        ue = self._ue_by_ip.get(header.GetDestination().addr)
+        if ue is None:
+            return False
+        enb = ue.rrc.serving_enb
+        if enb is None:
+            return False
+        return enb.dl_enqueue(ue, packet)
+
+    def receive_from_enb(self, packet) -> None:
+        """Uplink SDU arriving over the (ideal) S1-U leg."""
+        self._deliver_up(packet, 0x0800, self._address, self._address, 0)
+
+
+class EpcHelper:
+    """point-to-point-epc-helper.cc analog with the stubbed S1-U leg."""
+
+    UE_NETWORK = "7.0.0.0"
+    UE_MASK = "255.0.0.0"
+
+    def __init__(self):
+        self.pgw_node = Node()
+        InternetStackHelper().Install(self.pgw_node)
+        self.pgw_device = PgwNetDevice()
+        self.pgw_device.SetNode(self.pgw_node)
+        self.pgw_node.AddDevice(self.pgw_device)
+        ipv4 = self.pgw_node.GetObject(Ipv4L3Protocol)
+        if_index = ipv4.AddInterface(self.pgw_device)
+        ipv4.AddAddress(
+            if_index,
+            Ipv4InterfaceAddress(Ipv4Address("7.0.0.1"), Ipv4Mask(self.UE_MASK)),
+        )
+        routing = ipv4.GetRoutingProtocol()
+        assert isinstance(routing, Ipv4StaticRouting)
+        routing.AddNetworkRouteTo(
+            Ipv4Address(self.UE_NETWORK), Ipv4Mask(self.UE_MASK), if_index
+        )
+        self._next_ue_host = 2
+
+    def GetPgwNode(self) -> Node:
+        return self.pgw_node
+
+    def GetUeDefaultGatewayAddress(self) -> Ipv4Address:
+        return Ipv4Address("7.0.0.1")
+
+    def AssignUeIpv4Address(self, ue_devices) -> list[Ipv4Address]:
+        """Give each UE a 7.0.0.0/8 address on its LTE device and a
+        default route through it; register the UE at the PGW."""
+        addrs = []
+        for ue in ue_devices:
+            node = ue.GetNode()
+            ipv4 = node.GetObject(Ipv4L3Protocol)
+            if ipv4 is None:
+                raise RuntimeError(
+                    "install the internet stack on UE nodes before "
+                    "AssignUeIpv4Address"
+                )
+            n = self._next_ue_host - 2
+            self._next_ue_host += 1
+            # 253 hosts per /24, spilling across the /8 (avoids .0/.1/.255)
+            addr = Ipv4Address(f"7.0.{n // 253}.{2 + n % 253}")
+            if_index = ipv4.GetInterfaceForDevice(ue)
+            if if_index < 0:
+                if_index = ipv4.AddInterface(ue)
+            ipv4.AddAddress(
+                if_index, Ipv4InterfaceAddress(addr, Ipv4Mask(self.UE_MASK))
+            )
+            routing = ipv4.GetRoutingProtocol()
+            if isinstance(routing, Ipv4StaticRouting):
+                routing.SetDefaultRoute(
+                    self.GetUeDefaultGatewayAddress(), if_index
+                )
+            ue.ue_ipv4 = addr
+            self.pgw_device.register_ue(addr, ue)
+            # uplink: eNB forwards reassembled SDUs to the PGW stack
+            enb = ue.rrc.serving_enb
+            if enb is not None and enb.ul_sdu_callback is None:
+                enb.ul_sdu_callback = self.pgw_device.receive_from_enb
+            addrs.append(addr)
+        return addrs
+
+    def wire_enbs(self, enb_devices) -> None:
+        """Point every eNB's uplink exit at the PGW (ideal S1-U)."""
+        for enb in enb_devices:
+            enb.ul_sdu_callback = self.pgw_device.receive_from_enb

@@ -1,0 +1,199 @@
+"""LteHelper + RadioEnvironmentMapHelper.
+
+Reference parity: src/lte/helper/lte-helper.{h,cc},
+radio-environment-map-helper.{h,cc} (upstream paths; mount empty at
+survey — SURVEY.md §0, §2.6 "LteHelper" row).
+
+Usage mirrors upstream:
+
+    lte = LteHelper()
+    lte.SetSchedulerType("tpudes::PfFfMacScheduler")
+    enb_devs = lte.InstallEnbDevice(enb_nodes)
+    ue_devs = lte.InstallUeDevice(ue_nodes)
+    lte.Attach(ue_devs, enb_devs.Get(0))       # or closest-cell attach
+    lte.ActivateDataRadioBearer(ue_devs)       # RLC-SM full buffer
+
+The helper owns the one LteTtiController (the batched TTI engine) and
+the network-wide pathloss model (upstream default: Friis at the DL
+carrier frequency).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudes.helper.containers import NetDeviceContainer
+from tpudes.models.lte.controller import LteTtiController
+from tpudes.models.lte.device import LteEnbNetDevice, LteUeNetDevice
+from tpudes.models.lte.scheduler import SCHEDULERS, RrFfMacScheduler
+from tpudes.models.propagation import FriisPropagationLossModel
+from tpudes.ops.lte import RB_BANDWIDTH_HZ
+
+
+class LteHelper:
+    def __init__(self, n_rb: int = 25, pathloss_model=None):
+        self.n_rb = n_rb
+        self.pathloss = pathloss_model or FriisPropagationLossModel(
+            Frequency=2.12e9
+        )
+        self.controller = LteTtiController(self.pathloss, n_rb)
+        self._scheduler_type = "tpudes::PfFfMacScheduler"
+        self._ul_scheduler_type = "tpudes::RrFfMacScheduler"
+
+    def SetSchedulerType(self, type_name: str) -> None:
+        if type_name not in SCHEDULERS:
+            raise ValueError(f"unknown scheduler {type_name!r}")
+        self._scheduler_type = type_name
+
+    def SetUlSchedulerType(self, type_name: str) -> None:
+        if type_name not in SCHEDULERS:
+            raise ValueError(f"unknown scheduler {type_name!r}")
+        self._ul_scheduler_type = type_name
+
+    def SetPathlossModel(self, model) -> None:
+        self.pathloss = model
+        self.controller.pathloss = model
+
+    # --- install ----------------------------------------------------------
+    def InstallEnbDevice(self, nodes) -> NetDeviceContainer:
+        devices = NetDeviceContainer()
+        for node in nodes:
+            dev = LteEnbNetDevice(n_rb=self.n_rb)
+            dev.SetNode(node)
+            node.AddDevice(dev)
+            dev.scheduler = SCHEDULERS[self._scheduler_type]()
+            dev.ul_scheduler = SCHEDULERS[self._ul_scheduler_type]()
+            dev.controller = self.controller
+            self.controller.add_enb(dev)
+            devices.Add(dev)
+        return devices
+
+    def InstallUeDevice(self, nodes) -> NetDeviceContainer:
+        devices = NetDeviceContainer()
+        for node in nodes:
+            dev = LteUeNetDevice(n_rb=self.n_rb)
+            dev.SetNode(node)
+            node.AddDevice(dev)
+            self.controller.add_ue(dev)
+            devices.Add(dev)
+        return devices
+
+    # --- RRC control ------------------------------------------------------
+    def Attach(self, ue_devices, enb_device=None) -> None:
+        """Attach UE(s): to the given eNB, or to the strongest cell
+        (closest, under a monotone pathloss) when none is given —
+        upstream's automatic initial cell selection."""
+        if isinstance(ue_devices, LteUeNetDevice):
+            ue_devices = [ue_devices]
+        for ue in ue_devices:
+            enb = enb_device or self._closest_enb(ue)
+            self.controller.attach(ue, enb)
+
+    def _closest_enb(self, ue_dev) -> LteEnbNetDevice:
+        from tpudes.models.mobility import MobilityModel
+
+        if not self.controller.enbs:
+            raise RuntimeError("no eNBs installed")
+        up = ue_dev.GetNode().GetObject(MobilityModel).GetPosition()
+        best, best_d = None, float("inf")
+        for enb in self.controller.enbs:
+            ep = enb.GetNode().GetObject(MobilityModel).GetPosition()
+            d = (up.x - ep.x) ** 2 + (up.y - ep.y) ** 2 + (up.z - ep.z) ** 2
+            if d < best_d:
+                best, best_d = enb, d
+        return best
+
+    def ActivateDataRadioBearer(self, ue_devices, mode: str = "sm") -> None:
+        """Create the default data radio bearer (upstream
+        ActivateDataRadioBearer; mode "sm" = saturation/full-buffer)."""
+        if isinstance(ue_devices, LteUeNetDevice):
+            ue_devices = [ue_devices]
+        for ue in ue_devices:
+            enb = ue.rrc.serving_enb
+            if enb is None:
+                raise RuntimeError("attach the UE before activating bearers")
+            ctx = enb.rrc.ues[ue.rrc.rnti]
+            enb.rrc.setup_bearer(ctx, mode)
+        self.controller._dirty = True
+
+    # --- stats ------------------------------------------------------------
+    def GetRlcStats(self) -> list[dict]:
+        """Per-(UE, bearer) RLC counters — the RadioBearerStats analog."""
+        out = []
+        for enb in self.controller.enbs:
+            for ctx in enb.rrc.ues.values():
+                for lcid, b in ctx.bearers.items():
+                    out.append(
+                        dict(
+                            imsi=ctx.ue_device.GetImsi(),
+                            cell_id=enb.GetCellId(),
+                            lcid=lcid,
+                            dl_tx_bytes=b.dl_tx.stats_tx_bytes,
+                            dl_rx_bytes=b.dl_rx.stats_rx_bytes,
+                            ul_tx_bytes=b.ul_tx.stats_tx_bytes,
+                            ul_rx_bytes=b.ul_rx.stats_rx_bytes,
+                        )
+                    )
+        return out
+
+
+class RadioEnvironmentMapHelper:
+    """Downlink SINR over a ground grid in ONE kernel call
+    (radio-environment-map-helper.cc — upstream iterates a listener grid
+    through the spectrum channel; here the grid IS the batch)."""
+
+    def __init__(self, helper: LteHelper):
+        self.helper = helper
+
+    def Compute(self, x0, x1, y0, y1, resolution: int, z: float = 1.5):
+        """Returns (sinr_db, serving_cell) arrays of shape
+        (resolution, resolution) for the strongest-cell association."""
+        import jax.numpy as jnp
+
+        from tpudes.models.mobility import MobilityModel
+        from tpudes.ops.lte import tti_sinr
+
+        ctrl = self.helper.controller
+        enbs = ctrl.enbs
+        if not enbs:
+            raise RuntimeError("no eNBs installed")
+        xs = np.linspace(x0, x1, resolution)
+        ys = np.linspace(y0, y1, resolution)
+        gx, gy = np.meshgrid(xs, ys)
+        grid = np.stack(
+            [gx.ravel(), gy.ravel(), np.full(gx.size, z)], axis=-1
+        )  # (G, 3)
+        pos_e = np.array(
+            [
+                (lambda p: (p.x, p.y, p.z))(
+                    e.GetNode().GetObject(MobilityModel).GetPosition()
+                )
+                for e in enbs
+            ]
+        )
+        d = np.sqrt(((pos_e[:, None, :] - grid[None, :, :]) ** 2).sum(-1))
+        loss_db = -np.asarray(
+            self.helper.pathloss.batch_rx_power(jnp.zeros(()), jnp.asarray(d))
+        )
+        gain = 10.0 ** (-loss_db / 10.0)                     # (E, G)
+        psd = np.zeros((len(enbs), ctrl.n_rb))
+        for i, enb in enumerate(enbs):
+            p_w = 10.0 ** ((enb.phy.tx_power_dbm - 30.0) / 10.0)
+            psd[i, :] = p_w / (ctrl.n_rb * RB_BANDWIDTH_HZ)
+        serving = np.argmax(gain, axis=0)                    # strongest cell
+        noise = (
+            ctrl.ues[0].phy.noise_psd
+            if ctrl.ues
+            else 10.0 ** (9.0 / 10.0) * 1.380649e-23 * 290.0
+        )
+        sinr = np.asarray(
+            tti_sinr(
+                jnp.asarray(psd),
+                jnp.asarray(gain),
+                jnp.asarray(serving.astype(np.int32)),
+                noise,
+            )
+        ).mean(axis=1)
+        sinr_db = 10.0 * np.log10(np.maximum(sinr, 1e-30))
+        shape = (resolution, resolution)
+        return sinr_db.reshape(shape), serving.reshape(shape)

@@ -1,0 +1,111 @@
+"""LTE PHY objects: LteSpectrumPhy, LteEnbPhy, LteUePhy.
+
+Reference parity: src/lte/model/lte-spectrum-phy.{h,cc},
+lte-enb-phy.{h,cc}, lte-ue-phy.{h,cc}, lte-interference.{h,cc}
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.6, §3.4).
+
+TPU-first split: these objects carry per-device PHY *configuration*
+(power, noise figure, bandwidth, spectrum model) and the scalar
+SpectrumPhy interface; the per-TTI hot math — every cell's PSD × gain →
+per-RB SINR → MI → BLER → TB decode for ALL UEs at once — runs in
+:mod:`tpudes.models.lte.controller` as one jitted kernel call
+(ops/lte.py::tti_phy_step).  That controller is the batched equivalent
+of MultiModelSpectrumChannel::StartTx + LteSpectrumPhy::StartRxData +
+LteInterference chunk processing per subframe, exploiting that LTE
+subframes are synchronous across the network (the same observation
+upstream's 1 ms TTI clocking encodes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudes.core.object import Object, TypeId
+from tpudes.models.spectrum import (
+    SpectrumPhy,
+    SpectrumSignalParameters,
+    SpectrumValue,
+    lte_spectrum_model,
+)
+from tpudes.ops.lte import RB_BANDWIDTH_HZ, noise_psd_w
+
+
+class LteSpectrumPhy(SpectrumPhy):
+    """Per-device spectrum endpoint (lte-spectrum-phy.cc): builds tx
+    PSDs over the RB grid and accepts rx PSDs.  The batched controller
+    reads its configuration; the SpectrumPhy interface keeps the scalar
+    channel path available for spectrum-layer tests."""
+
+    tid = TypeId("tpudes::LteSpectrumPhy").SetParent(SpectrumPhy.tid)
+
+    def __init__(self, n_rb: int, carrier_hz: float, **attributes):
+        super().__init__(**attributes)
+        self.n_rb = n_rb
+        self.carrier_hz = carrier_hz
+        self.spectrum_model = lte_spectrum_model(n_rb, carrier_hz)
+        self.rx_psd_callback = None
+
+    def GetRxSpectrumModel(self):
+        return self.spectrum_model
+
+    def CreateTxPowerSpectralDensity(
+        self, tx_power_dbm: float, used_rbs
+    ) -> SpectrumValue:
+        """PSD with total power spread uniformly over the full grant
+        bandwidth, emitted only on the used RBs
+        (lte-spectrum-value-helper.cc semantics)."""
+        power_w = 10.0 ** ((tx_power_dbm - 30.0) / 10.0)
+        psd_per_hz = power_w / (self.n_rb * RB_BANDWIDTH_HZ)
+        values = np.zeros(self.n_rb)
+        values[np.asarray(list(used_rbs), dtype=np.int64)] = psd_per_hz
+        return SpectrumValue(self.spectrum_model, values)
+
+    def StartRx(self, params: SpectrumSignalParameters) -> None:
+        if self.rx_psd_callback is not None:
+            self.rx_psd_callback(params)
+
+
+class LteEnbPhy(Object):
+    """eNB PHY configuration (lte-enb-phy.cc defaults: TxPower 30 dBm,
+    NoiseFigure 5 dB)."""
+
+    tid = (
+        TypeId("tpudes::LteEnbPhy")
+        .AddConstructor(lambda **kw: LteEnbPhy(**kw))
+        .AddAttribute("TxPower", "dBm", 30.0, field="tx_power_dbm")
+        .AddAttribute("NoiseFigure", "dB", 5.0, field="noise_figure_db")
+    )
+
+    def __init__(self, n_rb: int = 25, carrier_hz: float = 2.12e9, **attributes):
+        super().__init__(**attributes)
+        self.n_rb = n_rb
+        self.carrier_hz = carrier_hz
+        self.spectrum_phy = LteSpectrumPhy(n_rb, carrier_hz)
+
+    @property
+    def noise_psd(self) -> float:
+        return noise_psd_w(self.noise_figure_db)
+
+
+class LteUePhy(Object):
+    """UE PHY configuration (lte-ue-phy.cc defaults: TxPower 10 dBm,
+    NoiseFigure 9 dB)."""
+
+    tid = (
+        TypeId("tpudes::LteUePhy")
+        .AddConstructor(lambda **kw: LteUePhy(**kw))
+        .AddAttribute("TxPower", "dBm", 10.0, field="tx_power_dbm")
+        .AddAttribute("NoiseFigure", "dB", 9.0, field="noise_figure_db")
+    )
+
+    def __init__(self, n_rb: int = 25, carrier_hz: float = 1.93e9, **attributes):
+        super().__init__(**attributes)
+        self.n_rb = n_rb
+        self.carrier_hz = carrier_hz
+        self.spectrum_phy = LteSpectrumPhy(n_rb, carrier_hz)
+        self.wideband_cqi = 0         # latest reported (after feedback delay)
+        self.last_dl_sinr_db = float("nan")
+
+    @property
+    def noise_psd(self) -> float:
+        return noise_psd_w(self.noise_figure_db)

@@ -1,0 +1,238 @@
+"""RLC (SM / UM / TM) and PDCP entities.
+
+Reference parity: src/lte/model/lte-rlc.{h,cc}, lte-rlc-sm.{h,cc},
+lte-rlc-um.{h,cc}, lte-rlc-tm.{h,cc}, lte-pdcp.{h,cc} (upstream paths;
+mount empty at survey — SURVEY.md §0, §2.6 "RLC / PDCP" row).
+
+Design notes (TPU-first, zero-copy): an RLC UM PDU carries *segment
+descriptors* — (packet, first_byte, last_byte) references into the COW
+packets — instead of materialized bytes.  Segmentation, concatenation
+and reassembly are pure bookkeeping on sizes; the payload bytes are
+never copied, which keeps the per-TTI host work O(segments), not
+O(bytes).  The MAC asks the tx entity for one PDU sized to the
+transport block via ``NotifyTxOpportunity`` exactly as the FF-MAC
+contract does upstream.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+RLC_UM_HEADER_BYTES = 2
+RLC_SEGMENT_OVERHEAD_BYTES = 2  # per extension (LI) field
+
+
+@dataclass
+class RlcSegment:
+    packet: object          # tpudes Packet (or None for SM filler)
+    start: int              # first payload byte carried
+    end: int                # one past the last byte carried
+    is_first: bool
+    is_last: bool
+
+    @property
+    def size(self) -> int:
+        return self.end - self.start
+
+
+@dataclass
+class RlcPdu:
+    sn: int
+    segments: list[RlcSegment] = field(default_factory=list)
+    size_bytes: int = 0     # on-air size incl. headers
+
+
+class LteRlc:
+    """Base tx/rx entity pair for one bearer direction."""
+
+    mode = "base"
+
+    def __init__(self):
+        self.tx_queue_bytes = 0
+        self.stats_tx_pdus = 0
+        self.stats_tx_bytes = 0
+        self.stats_rx_pdus = 0
+        self.stats_rx_bytes = 0
+        self.rx_sdu_callback = None   # cb(packet) on reassembled SDU
+
+    # --- tx side (sender) ---
+    def TransmitPdcpPdu(self, packet) -> None:
+        raise NotImplementedError
+
+    def BufferBytes(self) -> int:
+        """Ideal buffer-status report the MAC scheduler reads."""
+        return self.tx_queue_bytes
+
+    def NotifyTxOpportunity(self, nbytes: int) -> RlcPdu | None:
+        raise NotImplementedError
+
+    # --- rx side (receiver) ---
+    def ReceivePdu(self, pdu: RlcPdu) -> None:
+        raise NotImplementedError
+
+
+class LteRlcSm(LteRlc):
+    """Saturation-mode RLC (lte-rlc-sm.cc): the tx buffer is always
+    full, PDUs carry synthetic payload — the full-buffer traffic source
+    behind the classic ``lena-simple`` throughput studies."""
+
+    mode = "sm"
+
+    def BufferBytes(self) -> int:
+        return 1 << 30
+
+    def TransmitPdcpPdu(self, packet) -> None:  # pragma: no cover - unused
+        pass
+
+    def NotifyTxOpportunity(self, nbytes: int) -> RlcPdu | None:
+        if nbytes <= RLC_UM_HEADER_BYTES:
+            return None
+        self.stats_tx_pdus += 1
+        self.stats_tx_bytes += nbytes
+        seg = RlcSegment(None, 0, nbytes - RLC_UM_HEADER_BYTES, True, True)
+        return RlcPdu(sn=self.stats_tx_pdus, segments=[seg], size_bytes=nbytes)
+
+    def ReceivePdu(self, pdu: RlcPdu) -> None:
+        self.stats_rx_pdus += 1
+        self.stats_rx_bytes += pdu.size_bytes
+
+
+class LteRlcUm(LteRlc):
+    """Unacknowledged mode (lte-rlc-um.cc): segmentation + concatenation
+    on tx, SN-gap-aware reassembly on rx; lost PDUs drop exactly the
+    SDUs they carried bytes of."""
+
+    mode = "um"
+    SN_MOD = 1024  # 10-bit UM sequence numbering
+
+    def __init__(self):
+        super().__init__()
+        self._queue: deque = deque()   # (packet, offset)
+        self._vt_us = 0                # next SN to send
+        # rx state
+        self._vr_ur = 0                # next expected SN
+        self._acc: dict[int, list] = {}  # packet uid -> [packet, bytes_seen]
+
+    # --- tx ---
+    def TransmitPdcpPdu(self, packet) -> None:
+        self._queue.append([packet, 0])
+        self.tx_queue_bytes += packet.GetSize()
+
+    def NotifyTxOpportunity(self, nbytes: int) -> RlcPdu | None:
+        room = nbytes - RLC_UM_HEADER_BYTES
+        if room <= 0 or not self._queue:
+            return None
+        pdu = RlcPdu(sn=self._vt_us)
+        while room > 0 and self._queue:
+            entry = self._queue[0]
+            packet, offset = entry
+            remaining = packet.GetSize() - offset
+            take = min(room, remaining)
+            pdu.segments.append(
+                RlcSegment(
+                    packet,
+                    offset,
+                    offset + take,
+                    is_first=(offset == 0),
+                    is_last=(offset + take == packet.GetSize()),
+                )
+            )
+            entry[1] += take
+            room -= take
+            self.tx_queue_bytes -= take
+            if entry[1] == packet.GetSize():
+                self._queue.popleft()
+            if room > 0 and self._queue:
+                room -= RLC_SEGMENT_OVERHEAD_BYTES  # LI for the next SDU
+        if not pdu.segments:
+            return None
+        self._vt_us = (self._vt_us + 1) % self.SN_MOD
+        pdu.size_bytes = nbytes - room if room > 0 else nbytes
+        self.stats_tx_pdus += 1
+        self.stats_tx_bytes += pdu.size_bytes
+        return pdu
+
+    # --- rx ---
+    def ReceivePdu(self, pdu: RlcPdu) -> None:
+        self.stats_rx_pdus += 1
+        self.stats_rx_bytes += pdu.size_bytes
+        if pdu.sn != self._vr_ur:
+            # SN gap: every SDU with bytes in the lost PDU(s) is torn —
+            # drop all partially-assembled SDUs
+            self._acc.clear()
+        self._vr_ur = (pdu.sn + 1) % self.SN_MOD
+        for seg in pdu.segments:
+            uid = seg.packet.GetUid()
+            if seg.is_first:
+                self._acc[uid] = [seg.packet, 0]
+            slot = self._acc.get(uid)
+            if slot is None:
+                continue  # first segment was lost; discard the tail
+            slot[1] += seg.size
+            if seg.is_last:
+                packet, seen = self._acc.pop(uid)
+                if seen == packet.GetSize() and self.rx_sdu_callback is not None:
+                    self.rx_sdu_callback(packet.Copy())
+
+
+class LteRlcTm(LteRlc):
+    """Transparent mode (lte-rlc-tm.cc): whole SDUs only, no headers,
+    no segmentation — an SDU is sent when the opportunity fits it."""
+
+    mode = "tm"
+
+    def __init__(self):
+        super().__init__()
+        self._queue: deque = deque()
+        self._sn = 0
+
+    def TransmitPdcpPdu(self, packet) -> None:
+        self._queue.append(packet)
+        self.tx_queue_bytes += packet.GetSize()
+
+    def NotifyTxOpportunity(self, nbytes: int) -> RlcPdu | None:
+        if not self._queue or self._queue[0].GetSize() > nbytes:
+            return None
+        packet = self._queue.popleft()
+        self.tx_queue_bytes -= packet.GetSize()
+        self._sn += 1
+        self.stats_tx_pdus += 1
+        self.stats_tx_bytes += packet.GetSize()
+        return RlcPdu(
+            sn=self._sn,
+            segments=[RlcSegment(packet, 0, packet.GetSize(), True, True)],
+            size_bytes=packet.GetSize(),
+        )
+
+    def ReceivePdu(self, pdu: RlcPdu) -> None:
+        self.stats_rx_pdus += 1
+        self.stats_rx_bytes += pdu.size_bytes
+        if self.rx_sdu_callback is not None:
+            self.rx_sdu_callback(pdu.segments[0].packet.Copy())
+
+
+class LtePdcp:
+    """Sequence-numbering passthrough (lte-pdcp.cc): stamps tx SDUs,
+    counts, and forwards; header cost folded into the RLC accounting."""
+
+    def __init__(self, rlc_tx: LteRlc):
+        self.rlc_tx = rlc_tx
+        self.tx_sn = 0
+        self.stats_tx_sdus = 0
+        self.stats_rx_sdus = 0
+        self.rx_callback = None
+
+    def TransmitSdu(self, packet) -> None:
+        self.tx_sn = (self.tx_sn + 1) % 4096
+        self.stats_tx_sdus += 1
+        self.rlc_tx.TransmitPdcpPdu(packet)
+
+    def ReceiveSdu(self, packet) -> None:
+        self.stats_rx_sdus += 1
+        if self.rx_callback is not None:
+            self.rx_callback(packet)
+
+
+def make_rlc(mode: str) -> LteRlc:
+    return {"sm": LteRlcSm, "um": LteRlcUm, "tm": LteRlcTm}[mode]()

@@ -1,0 +1,169 @@
+"""FF-MAC schedulers (PF, RR) + HARQ bookkeeping.
+
+Reference parity: src/lte/model/ff-mac-scheduler.h (the FemtoForum
+scheduler API), pf-ff-mac-scheduler.{h,cc}, rr-ff-mac-scheduler.{h,cc},
+lte-harq-phy.{h,cc} (upstream paths; mount empty at survey — SURVEY.md
+§0, §2.6 "MAC + FF-MAC scheduler API" and "HARQ" rows).
+
+The scheduler works on resource-block *groups* (RBGs, TS 36.213 type-0
+allocation) and ideal buffer-status reports read straight from the RLC
+entities.  With wideband CQI the per-RBG PF metric is flat across
+frequency, so allocation is a greedy fill: best metric first, each flow
+takes only the RBGs its buffer needs, remainder to the next flow —
+which degenerates to winner-takes-all under full-buffer load and to
+frequency multiplexing under light load, matching upstream PF behavior
+at wideband-CQI fidelity.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from tpudes.ops.lte import mcs_from_cqi_py, tbs_bits_py
+
+HARQ_RTT_TTIS = 8
+HARQ_MAX_TX = 4  # 1 first tx + 3 retransmissions
+
+
+def rbg_size_for(n_rb: int) -> int:
+    """TS 36.213 table 7.1.6.1-1 type-0 RBG sizes."""
+    if n_rb <= 10:
+        return 1
+    if n_rb <= 26:
+        return 2
+    if n_rb <= 63:
+        return 3
+    return 4
+
+
+@dataclass
+class SchedCandidate:
+    """Per-flow scheduler input (the FF-MAC SchedDlTriggerReq view)."""
+
+    rnti: int
+    cqi: int
+    queue_bytes: int
+    avg_thr_bps: float = 1.0
+
+
+@dataclass
+class Allocation:
+    """One scheduled transport block."""
+
+    rnti: int
+    rbgs: list[int]
+    mcs: int
+    tb_bytes: int
+    harq: "HarqTb | None" = None  # set for retransmissions
+
+
+@dataclass
+class HarqTb:
+    """In-flight transport block awaiting ack (lte-harq-phy soft-buffer
+    entry): MI accumulates over retransmissions (IR combining)."""
+
+    rnti: int
+    pdu: object            # RlcPdu being carried
+    mcs: int
+    n_rbg: int
+    tb_bytes: int
+    mi_acc: float = 0.0
+    tx_count: int = 1
+    due_tti: int = 0       # next (re)tx TTI
+    bearer: object = None  # RadioBearer the PDU belongs to
+    rnti_ue_index: int = -1  # controller's global UE index
+
+
+class FfMacScheduler:
+    """Abstract FF-MAC scheduler: allocate free RBGs among candidates."""
+
+    name = "abstract"
+
+    def schedule(
+        self, tti: int, candidates: list[SchedCandidate], free_rbgs: list[int],
+        rbg_size: int,
+    ) -> list[Allocation]:
+        raise NotImplementedError
+
+    def update_served(self, rnti: int, bits: int) -> None:
+        """Post-TTI feedback hook (PF throughput averaging)."""
+
+    # --- shared helpers ---
+    @staticmethod
+    def _fill(
+        order: list[SchedCandidate], free_rbgs: list[int], rbg_size: int
+    ) -> list[Allocation]:
+        """Greedy fill in metric order; each flow takes only the RBGs
+        its buffer needs (+RLC header slack)."""
+        allocs: list[Allocation] = []
+        free = list(free_rbgs)
+        for cand in order:
+            if not free or cand.cqi < 1 or cand.queue_bytes <= 0:
+                continue
+            mcs = mcs_from_cqi_py(cand.cqi)
+            bytes_per_rbg = max(tbs_bits_py(mcs, rbg_size) // 8, 1)
+            need = min(
+                math.ceil((cand.queue_bytes + 4) / bytes_per_rbg), len(free)
+            )
+            take, free = free[:need], free[need:]
+            tb_bytes = tbs_bits_py(mcs, len(take) * rbg_size) // 8
+            allocs.append(Allocation(cand.rnti, take, mcs, tb_bytes))
+        return allocs
+
+
+class RrFfMacScheduler(FfMacScheduler):
+    """Round-robin (rr-ff-mac-scheduler.cc): rotate a pointer over the
+    active flows; equal opportunity, CQI only picks the MCS."""
+
+    name = "rr"
+
+    def __init__(self):
+        self._next = 0
+
+    def schedule(self, tti, candidates, free_rbgs, rbg_size):
+        if not candidates:
+            return []
+        order = sorted(candidates, key=lambda c: c.rnti)
+        start = self._next % len(order)
+        rotated = order[start:] + order[:start]
+        allocs = self._fill(rotated, free_rbgs, rbg_size)
+        if allocs:
+            self._next = (start + 1) % len(order)
+        return allocs
+
+
+class PfFfMacScheduler(FfMacScheduler):
+    """Proportional fair (pf-ff-mac-scheduler.cc): metric = achievable
+    rate / exponentially-averaged served throughput."""
+
+    name = "pf"
+
+    def __init__(self, alpha: float = 0.05):
+        self.alpha = alpha
+        self._avg: dict[int, float] = {}
+
+    def schedule(self, tti, candidates, free_rbgs, rbg_size):
+        def metric(c: SchedCandidate) -> float:
+            mcs = mcs_from_cqi_py(c.cqi)
+            rate = tbs_bits_py(mcs, rbg_size) * 1000.0  # bits/s if served
+            return rate / max(self._avg.get(c.rnti, 1.0), 1.0)
+
+        order = sorted(candidates, key=metric, reverse=True)
+        return self._fill(order, free_rbgs, rbg_size)
+
+    def end_tti(self, served_bits: dict[int, int], active_rntis) -> None:
+        """EMA update for every active flow: T ← (1−α)T + α·r, with r=0
+        for flows not served this TTI (the classic PF average)."""
+        for rnti in active_rntis:
+            old = self._avg.get(rnti, 1.0)
+            r = served_bits.get(rnti, 0) * 1000.0  # bits/s at 1 ms TTIs
+            self._avg[rnti] = (1.0 - self.alpha) * old + self.alpha * r
+
+
+SCHEDULERS = {
+    "tpudes::PfFfMacScheduler": PfFfMacScheduler,
+    "ns3::PfFfMacScheduler": PfFfMacScheduler,
+    "tpudes::RrFfMacScheduler": RrFfMacScheduler,
+    "ns3::RrFfMacScheduler": RrFfMacScheduler,
+}

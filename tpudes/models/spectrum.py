@@ -1,0 +1,262 @@
+"""Frequency-domain channel: SpectrumModel / SpectrumValue / channels.
+
+Reference parity: src/spectrum/model/spectrum-model.{h,cc},
+spectrum-value.{h,cc}, spectrum-channel.{h,cc},
+single-model-spectrum-channel.{h,cc}, multi-model-spectrum-channel.{h,cc},
+spectrum-phy.{h,cc}, spectrum-signal-parameters.{h,cc} (upstream paths;
+mount empty at survey — SURVEY.md §0, §2.4).
+
+TPU-first design: a ``SpectrumValue`` *is* an ndarray of PSD samples over
+its model's band grid — upstream's "already array math" observation
+(SURVEY.md §2.4) taken literally.  Channels keep the object-graph wiring
+(Add/StartTx/schedule-rx) host-side; the per-band arithmetic (loss
+application, PSD accumulation, integration) is numpy/jnp vector math so a
+window engine can batch the full (tx × rx × band) grid in one kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudes.core.nstime import Seconds
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+
+
+class BandInfo:
+    """One frequency band: [fl, fc, fh] (spectrum-model.h BandInfo)."""
+
+    __slots__ = ("fl", "fc", "fh")
+
+    def __init__(self, fl: float, fc: float, fh: float):
+        self.fl, self.fc, self.fh = fl, fc, fh
+
+    @property
+    def width(self) -> float:
+        return self.fh - self.fl
+
+
+class SpectrumModel:
+    """A band grid; identity (uid) keyed so values over the same model
+    can be combined without conversion (spectrum-model.cc)."""
+
+    _next_uid = 1
+
+    def __init__(self, bands: list[BandInfo]):
+        self.bands = bands
+        self.uid = SpectrumModel._next_uid
+        SpectrumModel._next_uid += 1
+        self.center_frequencies = np.array([b.fc for b in bands])
+        self.band_widths = np.array([b.width for b in bands])
+
+    @classmethod
+    def FromCenters(cls, centers, width: float) -> "SpectrumModel":
+        return cls([BandInfo(fc - width / 2.0, fc, fc + width / 2.0) for fc in centers])
+
+    def GetNumBands(self) -> int:
+        return len(self.bands)
+
+    def IsOrthogonal(self, other: "SpectrumModel") -> bool:
+        for a in self.bands:
+            for b in other.bands:
+                if a.fl < b.fh and b.fl < a.fh:
+                    return False
+        return True
+
+
+class SpectrumValue:
+    """PSD vector (W/Hz per band) over a SpectrumModel — a thin, mutable
+    array wrapper with elementwise arithmetic (spectrum-value.cc)."""
+
+    __slots__ = ("model", "values")
+
+    def __init__(self, model: SpectrumModel, values=None):
+        self.model = model
+        self.values = (
+            np.zeros(model.GetNumBands())
+            if values is None
+            else np.asarray(values, dtype=np.float64).copy()
+        )
+
+    def Copy(self) -> "SpectrumValue":
+        return SpectrumValue(self.model, self.values)
+
+    def _coerce(self, other):
+        if isinstance(other, SpectrumValue):
+            if other.model.uid != self.model.uid:
+                raise ValueError("SpectrumValue arithmetic across models")
+            return other.values
+        return other
+
+    def __add__(self, other):
+        return SpectrumValue(self.model, self.values + self._coerce(other))
+
+    def __sub__(self, other):
+        return SpectrumValue(self.model, self.values - self._coerce(other))
+
+    def __mul__(self, other):
+        return SpectrumValue(self.model, self.values * self._coerce(other))
+
+    def __truediv__(self, other):
+        return SpectrumValue(self.model, self.values / self._coerce(other))
+
+    __radd__ = __add__
+    __rmul__ = __mul__
+
+    def __iadd__(self, other):
+        self.values += self._coerce(other)
+        return self
+
+    def __getitem__(self, i):
+        return self.values[i]
+
+    def __setitem__(self, i, v):
+        self.values[i] = v
+
+    def TotalPowerW(self) -> float:
+        """∫ PSD df over the band grid (Integral(spectrumValue))."""
+        return float(np.sum(self.values * self.model.band_widths))
+
+
+class SpectrumSignalParameters:
+    """Tx descriptor handed to SpectrumChannel::StartTx
+    (spectrum-signal-parameters.h): psd + duration + sender."""
+
+    def __init__(self, psd: SpectrumValue, duration_s: float, tx_phy=None):
+        self.psd = psd
+        self.duration_s = duration_s
+        self.tx_phy = tx_phy
+        self.payload = None  # packet / transport block rider
+
+
+class SpectrumPhy(Object):
+    """Abstract endpoint on a SpectrumChannel (spectrum-phy.h)."""
+
+    tid = TypeId("tpudes::SpectrumPhy")
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._channel = None
+        self._mobility = None
+        self._device = None
+
+    def SetChannel(self, channel) -> None:
+        self._channel = channel
+        channel.AddRx(self)
+
+    def SetMobility(self, mobility) -> None:
+        self._mobility = mobility
+
+    def GetMobility(self):
+        return self._mobility
+
+    def SetDevice(self, device) -> None:
+        self._device = device
+
+    def GetDevice(self):
+        return self._device
+
+    def GetRxSpectrumModel(self) -> SpectrumModel | None:
+        raise NotImplementedError
+
+    def StartRx(self, params: SpectrumSignalParameters) -> None:
+        raise NotImplementedError
+
+
+class SingleModelSpectrumChannel(Object):
+    """All endpoints share one SpectrumModel
+    (single-model-spectrum-channel.cc): StartTx applies the loss-model
+    chain per receiver and schedules StartRx after the propagation
+    delay — the O(N_tx × N_rx) spectrum hot loop (SURVEY.md §3.4)."""
+
+    tid = (
+        TypeId("tpudes::SingleModelSpectrumChannel")
+        .AddConstructor(lambda **kw: SingleModelSpectrumChannel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._phys: list[SpectrumPhy] = []
+        self._loss = None            # single-frequency PropagationLossModel
+        self._spectrum_loss = None   # frequency-selective loss (optional)
+        self._delay = None
+        self._model: SpectrumModel | None = None
+
+    def AddRx(self, phy: SpectrumPhy) -> None:
+        model = phy.GetRxSpectrumModel()
+        if model is not None:
+            if self._model is None:
+                self._model = model
+            elif self._model.uid != model.uid:
+                raise ValueError(
+                    "SingleModelSpectrumChannel: mixed SpectrumModels "
+                    "(use MultiModelSpectrumChannel)"
+                )
+        if phy not in self._phys:
+            self._phys.append(phy)
+
+    def AddPropagationLossModel(self, loss) -> None:
+        self._loss = loss
+
+    def AddSpectrumPropagationLossModel(self, loss) -> None:
+        self._spectrum_loss = loss
+
+    def SetPropagationDelayModel(self, delay) -> None:
+        self._delay = delay
+
+    def GetNDevices(self) -> int:
+        return len(self._phys)
+
+    def GetDevice(self, i: int):
+        return self._phys[i].GetDevice()
+
+    def StartTx(self, params: SpectrumSignalParameters) -> None:
+        sender = params.tx_phy
+        sender_mob = sender.GetMobility() if sender is not None else None
+        for phy in self._phys:
+            if phy is sender:
+                continue
+            rx_mob = phy.GetMobility()
+            psd = params.psd.Copy()
+            delay_s = 0.0
+            if sender_mob is not None and rx_mob is not None:
+                if self._loss is not None:
+                    gain_db = self._loss.CalcRxPower(0.0, sender_mob, rx_mob)
+                    psd.values *= 10.0 ** (gain_db / 10.0)
+                if self._spectrum_loss is not None:
+                    psd = self._spectrum_loss.CalcRxPowerSpectralDensity(
+                        psd, sender_mob, rx_mob
+                    )
+                if self._delay is not None:
+                    delay_s = self._delay.GetDelay(sender_mob, rx_mob)
+            rx_params = SpectrumSignalParameters(psd, params.duration_s, sender)
+            rx_params.payload = params.payload
+            node = phy.GetDevice().GetNode() if phy.GetDevice() else None
+            Simulator.ScheduleWithContext(
+                node.GetId() if node else 0,
+                Seconds(delay_s),
+                phy.StartRx,
+                rx_params,
+            )
+
+
+class ConstantSpectrumPropagationLossModel:
+    """Frequency-flat spectrum loss (constant-spectrum-propagation-loss.cc)."""
+
+    def __init__(self, loss_db: float = 0.0):
+        self.loss_db = loss_db
+
+    def CalcRxPowerSpectralDensity(self, psd: SpectrumValue, a, b) -> SpectrumValue:
+        out = psd.Copy()
+        out.values *= 10.0 ** (-self.loss_db / 10.0)
+        return out
+
+
+def lte_spectrum_model(n_rb: int, carrier_hz: float) -> SpectrumModel:
+    """The LTE RB grid as a SpectrumModel: n_rb bands of 180 kHz around
+    the carrier (lte-spectrum-value-helper.cc)."""
+    from tpudes.ops.lte import RB_BANDWIDTH_HZ
+
+    low = carrier_hz - n_rb * RB_BANDWIDTH_HZ / 2.0
+    centers = [low + (i + 0.5) * RB_BANDWIDTH_HZ for i in range(n_rb)]
+    return SpectrumModel.FromCenters(centers, RB_BANDWIDTH_HZ)
