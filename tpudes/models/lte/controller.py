@@ -151,6 +151,20 @@ class LteTtiController:
         # v transmitting → power at u's serving eNB: (U, U)
         safe = np.maximum(serving, 0)
         self._gain_ul_eff = self._gain_dl.T[:, safe].astype(np.float64)
+        # UL CQI is measured SRS-style: intra-cell sounding is orthogonal,
+        # so co-served transmitters must NOT appear as interferers in the
+        # reference scenario (only inter-cell UEs + noise do).  Without
+        # this mask every same-cell UE looks like a full-band interferer
+        # and all but one UE per cell report CQI 0 permanently.
+        # attachment-aware: an unattached UE (serving -1) is nobody's
+        # cell-mate — it stays a real interferer everywhere
+        same_cell = (serving[:, None] == serving[None, :]) & (
+            serving[:, None] >= 0
+        )                                                   # (v, u)
+        srs_mask = np.where(
+            same_cell & ~np.eye(u, dtype=bool), 0.0, 1.0
+        )
+        self._gain_ul_ref = self._gain_ul_eff * srs_mask
         if self._cqi_dl is None or len(self._cqi_dl) != u:
             self._cqi_dl = np.zeros((u,), dtype=np.int64)
             self._cqi_ul = np.zeros((u,), dtype=np.int64)
@@ -180,13 +194,13 @@ class LteTtiController:
             # remote accelerator (axon tunnel) each host↔device round
             # trip costs ~100 ms, so the TTI event makes exactly one
             # dispatch and one device_get (SURVEY.md §7 hard part 3)
-            def both(dl_args, ul_args, noise_dl, noise_ul, k):
+            def both(dl_args, ul_args, ul_ref_gain, noise_dl, noise_ul, k):
                 import jax as _jax
 
                 k_dl, k_ul = _jax.random.split(k)
                 return (
                     tti_phy_step(*dl_args, k_dl, noise_dl),
-                    tti_phy_step(*ul_args, k_ul, noise_ul),
+                    tti_phy_step(*ul_args, k_ul, noise_ul, ul_ref_gain),
                 )
 
             self._jit_step = jax.jit(both)
@@ -356,7 +370,8 @@ class LteTtiController:
 
             out_dl, out_ul = jax.device_get(
                 self._jit_step(
-                    pack("dl"), pack("ul"), self._noise_dl, self._noise_ul, key
+                    pack("dl"), pack("ul"), jnp.asarray(self._gain_ul_ref),
+                    self._noise_dl, self._noise_ul, key
                 )
             )
             for direction, (ok, _bler, cqi_meas, mi_new) in (

@@ -226,6 +226,7 @@ def tti_phy_step(
     mi_acc: jax.Array,     # (U,) float32 accumulated HARQ-IR MI
     key: jax.Array,
     noise_psd: float,
+    ref_gain: jax.Array | None = None,  # (T, U) gain for CQI measurement
 ):
     """One TTI of the LTE PHY for every receiver at once.
 
@@ -234,7 +235,10 @@ def tti_phy_step(
     reference-signal PSD, as upstream UEs measure RS under the
     worst-case all-cells-loaded assumption — otherwise an idle serving
     cell could never report a CQI and an idle interferer would inflate
-    one.
+    one.  ``ref_gain`` (default: ``gain``) lets the CQI measurement see
+    a different interference geometry than data decoding — uplink SRS
+    sounding is orthogonal within a cell, so the UL caller passes a
+    gain matrix with co-served transmitters masked out.
 
     Returns ``(ok, bler, cqi, mi_new)``:
       ok     (U,) bool — TB decoded this TTI (False where tb_bits==0)
@@ -254,6 +258,8 @@ def tti_phy_step(
     coin = jax.random.uniform(key, bler.shape)
     has_tb = tb_bits_ > 0.0
     ok = has_tb & (coin >= bler)
-    ref_sinr = tti_sinr(ref_psd_w, gain, serving, noise_psd)
+    ref_sinr = tti_sinr(
+        ref_psd_w, gain if ref_gain is None else ref_gain, serving, noise_psd
+    )
     cqi = cqi_from_sinr(jnp.mean(ref_sinr, axis=1))
     return ok, bler, cqi, mi_new

@@ -15,7 +15,7 @@ hence SINR, CQI, MCS, per-RB MI) static for a static topology: they are
 precomputed once at lowering time.
 
 Timing-model deviations vs the host TTI loop (controller.py), all
-bounded and test-checked:
+bounded fixed offsets:
 - one HARQ process per UE: a UE awaiting retransmission is not
   scheduled new data during the 8 ms HARQ RTT (the host loop, like
   upstream's 8 processes, can overlap);
@@ -171,7 +171,11 @@ def build_sm_step(prog: LteSmProgram):
             p_mi=z_f, p_tbb=z_f,
             p_mcs=z_i, p_nrbg=z_i, p_txc=z_i, p_due=z_i,
             rr_ptr=jnp.zeros((E,), jnp.int32),
-            rx_bits=z_i, new_tbs=z_i, retx=z_i, drops=z_i, ok_cnt=z_i,
+            # exact bit accounting without int32 overflow on long runs:
+            # rx_lo rolls over into rx_hi at 2^20 (≤1e5 bits/TTI, so
+            # rx_lo never exceeds 2^21 before the carry)
+            rx_lo=z_i, rx_hi=z_i,
+            new_tbs=z_i, retx=z_i, drops=z_i, ok_cnt=z_i,
         )
 
     def step_fn(s, xs):
@@ -219,13 +223,17 @@ def build_sm_step(prog: LteSmProgram):
         txc_after = jnp.where(retx_fit, s["p_txc"] + 1, 1)
         dropped = fail & (txc_after >= HARQ_MAX_TX)
         repend = fail & ~dropped
-        keep = s["pend"] & ~due
+        # a due TB that didn't fit the RBG budget stays pending (its
+        # p_due is already ≤ t, so it retries next TTI) — clearing on
+        # `due` alone would silently erase it
+        keep = s["pend"] & ~retx_fit
 
         served_bits = jnp.where(ok, tbb_tx, 0.0)
         ptr_winner = jnp.sum(winner_oh * pos[None, :], axis=1)
         new_ptr = jnp.where(
             has_win, jnp.mod(ptr_winner + 1, count_c), s["rr_ptr"]
         )
+        lo = s["rx_lo"] + served_bits.astype(jnp.int32)
         return dict(
             avg=(1.0 - prog.pf_alpha) * s["avg"]
             + prog.pf_alpha * served_bits * 1000.0,
@@ -239,7 +247,8 @@ def build_sm_step(prog: LteSmProgram):
             p_txc=jnp.where(repend, txc_after, s["p_txc"]),
             p_due=jnp.where(repend, t + HARQ_RTT_TTIS, s["p_due"]),
             rr_ptr=new_ptr,
-            rx_bits=s["rx_bits"] + jnp.where(ok, tbb_tx, 0.0).astype(jnp.int32),
+            rx_lo=lo & 0xFFFFF,
+            rx_hi=s["rx_hi"] + (lo >> 20),
             new_tbs=s["new_tbs"] + is_winner.astype(jnp.int32),
             retx=s["retx"] + retx_fit.astype(jnp.int32),
             drops=s["drops"] + dropped.astype(jnp.int32),
@@ -301,9 +310,12 @@ def run_lte_sm(prog: LteSmProgram, key, replicas: int | None = None, mesh=None):
         out = fn(keys)
     else:
         out = fn(key)
-    out["rx_bits"].block_until_ready()
+    out["rx_lo"].block_until_ready()
     result = {k: np.asarray(v) for k, v in jax.device_get(out).items()
-              if k in ("rx_bits", "new_tbs", "retx", "drops", "ok_cnt")}
+              if k in ("rx_lo", "rx_hi", "new_tbs", "retx", "drops", "ok_cnt")}
+    result["rx_bits"] = (
+        result.pop("rx_hi").astype(np.int64) << 20
+    ) + result.pop("rx_lo").astype(np.int64)
     result["ok"] = result.pop("ok_cnt")
     result["cqi"] = np.asarray(consts["cqi"])
     result["mcs"] = np.asarray(consts["mcs"])
