@@ -1,0 +1,193 @@
+"""Spectrum layer tests.
+
+SURVEY.md §2.4: upstream's spectrum module is validated by value-algebra
+unit tests (spectrum-value arithmetic, integration) and by delivery
+tests through SingleModelSpectrumChannel (tx PSD → loss chain → rx PSD
+at every endpoint after the propagation delay).  Same coverage here.
+"""
+
+import numpy as np
+import pytest
+
+from tpudes.models.spectrum import (
+    BandInfo,
+    ConstantSpectrumPropagationLossModel,
+    SingleModelSpectrumChannel,
+    SpectrumModel,
+    SpectrumPhy,
+    SpectrumSignalParameters,
+    SpectrumValue,
+    lte_spectrum_model,
+)
+
+
+def _model(n=4, f0=2.0e9, width=180e3):
+    return SpectrumModel.FromCenters(
+        [f0 + i * width for i in range(n)], width
+    )
+
+
+class TestSpectrumValue:
+    def test_arithmetic_elementwise(self):
+        m = _model()
+        a = SpectrumValue(m, [1.0, 2.0, 3.0, 4.0])
+        b = SpectrumValue(m, [4.0, 3.0, 2.0, 1.0])
+        np.testing.assert_allclose((a + b).values, 5.0)
+        np.testing.assert_allclose((a - b).values, [-3.0, -1.0, 1.0, 3.0])
+        np.testing.assert_allclose((a * 2.0).values, [2.0, 4.0, 6.0, 8.0])
+        np.testing.assert_allclose((a / b).values, [0.25, 2 / 3, 1.5, 4.0])
+        a += b
+        np.testing.assert_allclose(a.values, 5.0)
+
+    def test_cross_model_arithmetic_rejected(self):
+        a = SpectrumValue(_model(), [1.0] * 4)
+        b = SpectrumValue(_model(), [1.0] * 4)  # different uid
+        with pytest.raises(ValueError):
+            _ = a + b
+
+    def test_copy_isolated(self):
+        a = SpectrumValue(_model(), [1.0] * 4)
+        c = a.Copy()
+        c[0] = 99.0
+        assert a[0] == 1.0
+
+    def test_total_power_integrates_bandwidth(self):
+        m = _model(n=3, width=100.0)
+        v = SpectrumValue(m, [1.0, 2.0, 3.0])  # W/Hz over 100 Hz bands
+        assert v.TotalPowerW() == pytest.approx(600.0)
+
+    def test_band_info(self):
+        b = BandInfo(90.0, 100.0, 110.0)
+        assert b.width == pytest.approx(20.0)
+
+
+class TestSpectrumModel:
+    def test_orthogonality(self):
+        a = SpectrumModel.FromCenters([1e9, 1.001e9], 1e6)
+        b = SpectrumModel.FromCenters([2e9, 2.001e9], 1e6)
+        assert a.IsOrthogonal(b)
+        assert not a.IsOrthogonal(a)
+
+    def test_lte_grid(self):
+        m = lte_spectrum_model(25, 2.12e9)
+        assert m.GetNumBands() == 25
+        np.testing.assert_allclose(m.band_widths, 180e3)
+        # grid is centered on the carrier
+        assert np.mean(m.center_frequencies) == pytest.approx(2.12e9)
+
+
+class _ProbePhy(SpectrumPhy):
+    """Records every StartRx delivery (psd values + arrival time)."""
+
+    def __init__(self, model):
+        super().__init__()
+        self._model = model
+        self.rx = []
+
+    def GetRxSpectrumModel(self):
+        return self._model
+
+    def StartRx(self, params):
+        from tpudes.core.simulator import Simulator
+
+        self.rx.append(
+            (Simulator.Now().GetSeconds(), params.psd.values.copy(),
+             params.payload)
+        )
+
+
+def _node_with_phy(model, channel, x):
+    from tpudes.models.mobility import ConstantPositionMobilityModel, Vector
+    from tpudes.network.node import Node
+
+    node = Node()
+    mob = ConstantPositionMobilityModel()
+    mob.SetPosition(Vector(x, 0.0, 0.0))
+    node.AggregateObject(mob)
+    phy = _ProbePhy(model)
+    phy.SetMobility(mob)
+
+    class _Dev:
+        def GetNode(self):
+            return node
+
+    phy.SetDevice(_Dev())
+    phy.SetChannel(channel)
+    return phy
+
+
+class TestSingleModelSpectrumChannel:
+    def test_delivery_applies_loss_and_delay(self):
+        from tpudes.core.simulator import Simulator
+        from tpudes.core.nstime import Seconds
+        from tpudes.models.propagation import (
+            ConstantSpeedPropagationDelayModel,
+            FriisPropagationLossModel,
+        )
+
+        model = _model(n=4, f0=2.12e9)
+        ch = SingleModelSpectrumChannel()
+        loss = FriisPropagationLossModel(Frequency=2.12e9)
+        ch.AddPropagationLossModel(loss)
+        ch.SetPropagationDelayModel(ConstantSpeedPropagationDelayModel())
+        tx = _node_with_phy(model, ch, 0.0)
+        rx1 = _node_with_phy(model, ch, 300.0)
+        rx2 = _node_with_phy(model, ch, 600.0)
+        assert ch.GetNDevices() == 3
+
+        psd = SpectrumValue(model, [1e-9] * 4)
+        params = SpectrumSignalParameters(psd, duration_s=1e-3, tx_phy=tx)
+        params.payload = "tb-1"
+
+        def fire():
+            ch.StartTx(params)
+
+        Simulator.Schedule(Seconds(0.0), fire)
+        Simulator.Stop(Seconds(0.1))
+        Simulator.Run()
+
+        # the sender does not hear itself; both receivers got one signal
+        assert tx.rx == []
+        assert len(rx1.rx) == 1 and len(rx2.rx) == 1
+        t1, psd1, payload1 = rx1.rx[0]
+        t2, psd2, _ = rx2.rx[0]
+        # propagation delay at c: 300 m → 1 µs, 600 m → 2 µs (the
+        # simulator clock quantizes to whole nanoseconds)
+        assert t1 == pytest.approx(300.0 / 299792458.0, abs=1e-9)
+        assert t2 == pytest.approx(600.0 / 299792458.0, abs=1e-9)
+        # Friis: doubling the distance costs 6.02 dB
+        ratio_db = 10 * np.log10(psd1[0] / psd2[0])
+        assert ratio_db == pytest.approx(6.0206, abs=0.01)
+        # rx PSD = tx PSD × linear gain from the loss model
+        gain_db = loss.CalcRxPower(0.0, tx.GetMobility(), rx1.GetMobility())
+        np.testing.assert_allclose(psd1, 1e-9 * 10 ** (gain_db / 10.0))
+        assert payload1 == "tb-1"
+        # original tx PSD untouched (per-rx copies)
+        np.testing.assert_allclose(psd.values, 1e-9)
+
+    def test_spectrum_loss_chain(self):
+        from tpudes.core.simulator import Simulator
+        from tpudes.core.nstime import Seconds
+
+        model = _model()
+        ch = SingleModelSpectrumChannel()
+        ch.AddSpectrumPropagationLossModel(
+            ConstantSpectrumPropagationLossModel(loss_db=13.0)
+        )
+        tx = _node_with_phy(model, ch, 0.0)
+        rx = _node_with_phy(model, ch, 10.0)
+        psd = SpectrumValue(model, [2e-9] * 4)
+        Simulator.Schedule(
+            Seconds(0.0),
+            lambda: ch.StartTx(SpectrumSignalParameters(psd, 1e-3, tx)),
+        )
+        Simulator.Stop(Seconds(0.01))
+        Simulator.Run()
+        _, got, _ = rx.rx[0]
+        np.testing.assert_allclose(got, 2e-9 * 10 ** (-1.3), rtol=1e-9)
+
+    def test_mixed_models_rejected(self):
+        ch = SingleModelSpectrumChannel()
+        _node_with_phy(_model(), ch, 0.0)
+        with pytest.raises(ValueError):
+            _node_with_phy(_model(), ch, 1.0)  # different uid

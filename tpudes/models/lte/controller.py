@@ -164,7 +164,11 @@ class LteTtiController:
         srs_mask = np.where(
             same_cell & ~np.eye(u, dtype=bool), 0.0, 1.0
         )
-        self._gain_ul_ref = self._gain_ul_eff * srs_mask
+        # static across TTIs → device-resident once, not re-shipped per
+        # dispatch (each host↔device payload byte costs on the tunnel)
+        self._gain_ul_ref = jnp.asarray(self._gain_ul_eff * srs_mask)
+        self._gain_dl_dev = jnp.asarray(self._gain_dl)
+        self._gain_ul_dev = jnp.asarray(self._gain_ul_eff)
         if self._cqi_dl is None or len(self._cqi_dl) != u:
             self._cqi_dl = np.zeros((u,), dtype=np.int64)
             self._cqi_ul = np.zeros((u,), dtype=np.int64)
@@ -351,11 +355,11 @@ class LteTtiController:
                 alloc, mcs, tb_bits, mi_acc, tx_psd, _ = sched[direction]
                 if direction == "dl":
                     gain, serving, ref = (
-                        self._gain_dl, self._serving, self._ref_psd_dl,
+                        self._gain_dl_dev, self._serving, self._ref_psd_dl,
                     )
                 else:
                     gain, serving, ref = (
-                        self._gain_ul_eff, np.arange(u), self._ref_psd_ul,
+                        self._gain_ul_dev, np.arange(u), self._ref_psd_ul,
                     )
                 return (
                     jnp.asarray(tx_psd),
@@ -370,7 +374,7 @@ class LteTtiController:
 
             out_dl, out_ul = jax.device_get(
                 self._jit_step(
-                    pack("dl"), pack("ul"), jnp.asarray(self._gain_ul_ref),
+                    pack("dl"), pack("ul"), self._gain_ul_ref,
                     self._noise_dl, self._noise_ul, key
                 )
             )

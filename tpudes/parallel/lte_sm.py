@@ -15,7 +15,9 @@ hence SINR, CQI, MCS, per-RB MI) static for a static topology: they are
 precomputed once at lowering time.
 
 Timing-model deviations vs the host TTI loop (controller.py), all
-bounded fixed offsets:
+bounded fixed offsets — tests/test_lte_sm.py pins host-vs-device
+throughput parity (aggregate and per-cell) and CQI equality on an
+identical lowered scenario:
 - one HARQ process per UE: a UE awaiting retransmission is not
   scheduled new data during the 8 ms HARQ RTT (the host loop, like
   upstream's 8 processes, can overlap);
