@@ -28,20 +28,7 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def fresh_world():
     """Reset all process-global simulator state between tests."""
-    from tpudes.core.simulator import Simulator
-    from tpudes.core.global_value import GlobalValue
-    from tpudes.core.rng import RngSeedManager
-    from tpudes.core.config import Names
+    from tpudes.core.world import reset_world
 
     yield
-    Simulator.Destroy()
-    GlobalValue.ResetAll()
-    RngSeedManager.Reset()
-    Names.Clear()
-    # network-layer globals (NodeList) reset lazily if the module is loaded
-    mod = sys.modules.get("tpudes.network.node")
-    if mod is not None:
-        mod.NodeList.Reset()
-    eng = sys.modules.get("tpudes.parallel.engine")
-    if eng is not None:
-        eng.BatchableRegistry.reset()
+    reset_world()

@@ -1,0 +1,187 @@
+"""Engine-seam lifting tests: one GlobalValue flip runs a stock
+scenario's object graph on the replica axis.
+
+The north-star contract (BASELINE.json): ``SimulatorImplementationType=
+tpudes::JaxSimulatorImpl`` + ``JaxReplicas=R`` — no per-example
+plumbing.  Unliftable graphs must fall back to the scalar engine with a
+loud warning, never a silent mis-lowering.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from tpudes.core import GlobalValue, Seconds, Simulator
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.models.mobility import (
+    ListPositionAllocator,
+    MobilityHelper,
+    Vector,
+)
+from tpudes.models.wifi import (
+    WifiHelper,
+    WifiMacHelper,
+    YansWifiChannelHelper,
+    YansWifiPhyHelper,
+)
+
+from tests.test_lte_sm import _build_helper_scenario
+
+
+def _use_jax_engine(replicas):
+    GlobalValue.Bind(
+        "SimulatorImplementationType", "tpudes::JaxSimulatorImpl"
+    )
+    GlobalValue.Bind("JaxReplicas", replicas)
+
+
+def _build_small_bss(n_stas=4, sim_time=1.5):
+    nodes = NodeContainer()
+    nodes.Create(n_stas + 1)
+    alloc = ListPositionAllocator()
+    alloc.Add(Vector(0.0, 0.0, 0.0))
+    for i in range(n_stas):
+        a = 2 * math.pi * i / n_stas
+        alloc.Add(Vector(20.0 * math.cos(a), 20.0 * math.sin(a), 0.0))
+    mob = MobilityHelper()
+    mob.SetPositionAllocator(alloc)
+    mob.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mob.Install(nodes)
+    channel = YansWifiChannelHelper.Default().Create()
+    phy = YansWifiPhyHelper()
+    phy.SetChannel(channel)
+    wifi = WifiHelper()
+    wifi.SetRemoteStationManager(
+        "tpudes::ConstantRateWifiManager", DataMode="OfdmRate54Mbps"
+    )
+    ap_mac = WifiMacHelper()
+    ap_mac.SetType("tpudes::ApWifiMac")
+    ap_devices = wifi.Install(phy, ap_mac, [nodes.Get(0)])
+    sta_mac = WifiMacHelper()
+    sta_mac.SetType("tpudes::StaWifiMac")
+    wifi.Install(phy, sta_mac, [nodes.Get(i) for i in range(1, n_stas + 1)])
+    stack = InternetStackHelper()
+    stack.Install(nodes)
+    address = Ipv4AddressHelper()
+    address.SetBase("10.1.3.0", "255.255.255.0")
+    devices = NetDeviceContainer()
+    for i in range(n_stas + 1):
+        devices.Add(nodes.Get(i).GetDevice(0))
+    interfaces = address.Assign(devices)
+    server = UdpEchoServerHelper(9)
+    server_apps = server.Install(nodes.Get(0))
+    server_apps.Start(Seconds(0.4))
+    server_apps.Stop(Seconds(sim_time))
+    rx = [0]
+    server_apps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda pkt, *a: rx.__setitem__(0, rx[0] + 1)
+    )
+    for i in range(n_stas):
+        helper = UdpEchoClientHelper(interfaces.GetAddress(0), 9)
+        helper.SetAttribute("MaxPackets", 1_000_000)
+        helper.SetAttribute("Interval", Seconds(0.1))
+        helper.SetAttribute("PacketSize", 512)
+        apps = helper.Install(nodes.Get(1 + i))
+        apps.Start(Seconds(1.0 + 0.001 * i))
+        apps.Stop(Seconds(sim_time))
+    return rx
+
+
+def test_bss_lift_via_engine_seam():
+    _use_jax_engine(8)
+    rx = _build_small_bss(sim_time=1.5)
+    Simulator.Stop(Seconds(1.5))
+    Simulator.Run()
+    res = Simulator.GetImpl().replicated_result
+    assert res is not None and res["kind"] == "bss"
+    assert res["replicas"] == 8
+    srv = np.asarray(res["out"]["srv_rx"])
+    assert srv.shape == (8,)
+    assert srv.mean() > 0
+    # the scalar event path did NOT run the scenario
+    assert rx[0] == 0
+    # the clock advanced to the stop horizon
+    assert Simulator.Now().GetSeconds() == pytest.approx(1.5)
+
+
+def test_lte_lift_via_engine_seam():
+    _use_jax_engine(4)
+    lte, _ = _build_helper_scenario(n_enbs=2, ues_per_cell=2)
+    Simulator.Stop(Seconds(0.2))
+    Simulator.Run()
+    res = Simulator.GetImpl().replicated_result
+    assert res is not None and res["kind"] == "lte_sm"
+    out = res["out"]
+    assert out["rx_bits"].shape == (4, 4)
+    assert (out["rx_bits"].sum(axis=1) > 0).all()
+    # the host TTI loop did not also run the scenario
+    assert lte.controller.stats["ttis"] == 0
+    assert lte.controller.lifted
+
+
+def test_unliftable_graph_falls_back_with_warning():
+    # a bare p2p echo slice: no lowering represents it
+    from tpudes.helper.applications import (
+        UdpEchoClientHelper as Client,
+        UdpEchoServerHelper as Server,
+    )
+    from tpudes.helper.internet import (
+        InternetStackHelper as Stack,
+        Ipv4AddressHelper as Addr,
+    )
+    from tpudes.helper.point_to_point import PointToPointHelper
+
+    _use_jax_engine(4)
+    nodes = NodeContainer()
+    nodes.Create(2)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "5Mbps")
+    p2p.SetChannelAttribute("Delay", Seconds(0.002))
+    devices = p2p.Install(nodes)
+    Stack().Install(nodes)
+    addr = Addr()
+    addr.SetBase("10.1.1.0", "255.255.255.0")
+    interfaces = addr.Assign(devices)
+    server_apps = Server(9).Install(nodes.Get(1))
+    server_apps.Start(Seconds(1.0))
+    server_apps.Stop(Seconds(10.0))
+    rx = [0]
+    server_apps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda pkt, *a: rx.__setitem__(0, rx[0] + 1)
+    )
+    client = Client(interfaces.GetAddress(1), 9)
+    client.SetAttribute("MaxPackets", 1)
+    client.SetAttribute("Interval", Seconds(1.0))
+    client.SetAttribute("PacketSize", 1024)
+    capps = client.Install(nodes.Get(0))
+    capps.Start(Seconds(2.0))
+    capps.Stop(Seconds(10.0))
+    Simulator.Stop(Seconds(10.0))
+    with pytest.warns(UserWarning, match="no lowering"):
+        Simulator.Run()
+    # the scalar fallback ran the scenario correctly
+    assert rx[0] == 1
+    assert Simulator.GetImpl().replicated_result is None
+
+
+def test_lift_without_stop_warns_and_falls_back():
+    _use_jax_engine(4)
+    fired = [0]
+    Simulator.Schedule(Seconds(0.1), lambda: fired.__setitem__(0, 1))
+    with pytest.warns(UserWarning, match="Stop"):
+        Simulator.Run()
+    assert fired[0] == 1
+
+
+def test_default_engine_ignores_jax_replicas():
+    # JaxReplicas without the engine flip is inert: the scalar default
+    # engine runs normally
+    GlobalValue.Bind("JaxReplicas", 8)
+    rx = _build_small_bss(n_stas=2, sim_time=1.3)
+    Simulator.Stop(Seconds(1.3))
+    Simulator.Run()
+    assert rx[0] > 0
+    assert not hasattr(Simulator.GetImpl(), "replicated_result")

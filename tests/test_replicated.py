@@ -50,16 +50,9 @@ def _positions():
 
 
 def _reset_world():
-    Simulator.Destroy()
-    GlobalValue.ResetAll()
-    RngSeedManager.Reset()
-    Names.Clear()
-    mod = sys.modules.get("tpudes.network.node")
-    if mod is not None:
-        mod.NodeList.Reset()
-    eng = sys.modules.get("tpudes.parallel.engine")
-    if eng is not None:
-        eng.BatchableRegistry.reset()
+    from tpudes.core.world import reset_world
+
+    reset_world()
 
 
 def _build_bss():

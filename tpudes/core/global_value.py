@@ -110,4 +110,25 @@ ChecksumEnabled = GlobalValue(
     "ChecksumEnabled", "Whether protocol checksums are computed.", False
 )
 
+# JaxSimulatorImpl knobs live here (not in tpudes.parallel.engine) so
+# CommandLine can bind them before the engine module is ever imported —
+# the whole point of the seam is that a stock scenario script flips
+# engines from the command line alone.
+JaxWindowNs = GlobalValue(
+    "JaxWindowNs",
+    "conservative window length (ns) for JaxSimulatorImpl",
+    1_000_000,
+)
+JaxBatchMinPhys = GlobalValue(
+    "JaxBatchMinPhys",
+    "smallest channel (phy count) that engages the batched window cache",
+    32,
+)
+JaxReplicas = GlobalValue(
+    "JaxReplicas",
+    "Monte-Carlo replica count for the lifted replica-axis path "
+    "(0 = windowed scalar engine)",
+    0,
+)
+
 GlobalValue.ApplyEnvironment()

@@ -41,6 +41,7 @@ class SimulatorImpl:
         scheduler_type = GlobalValue.GetValue("SchedulerType")
         self._events = create_scheduler(scheduler_type)
         self._event_count = 0  # total executed, for ShowProgress/bench
+        self._scheduled_stop_ts: int | None = None  # last Stop(delay) target
 
     # --- scheduling ---
     def Schedule(self, delay_ticks: int, fn, args) -> Event:
@@ -99,10 +100,20 @@ class SimulatorImpl:
         if delay_ticks is None:
             self._stop = True
             return None
+        # the earliest scheduled stop wins (ns-3: the first stop event to
+        # fire halts the run) — the lifted replica path reads this as its
+        # horizon.  Known limitation: Cancel() of a stop EventId does not
+        # retract the recorded horizon.
+        ts = self.current_ts + delay_ticks
+        if self._scheduled_stop_ts is None or ts < self._scheduled_stop_ts:
+            self._scheduled_stop_ts = ts
         return self.Schedule(delay_ticks, self._do_stop, ())
 
     def _do_stop(self):
         self._stop = True
+        # this horizon has been consumed; a later Stop() (segmented runs)
+        # records a fresh one
+        self._scheduled_stop_ts = None
 
     def Destroy(self) -> None:
         for ev in self._destroy_events:

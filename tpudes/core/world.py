@@ -1,0 +1,31 @@
+"""Process-global world reset.
+
+One simulation per process is the ns-3 contract; tests, benchmarks and
+multi-run drivers that build several worlds back-to-back reset ALL
+process-global state through this single function (conftest, bench.py
+and the parallel tests previously each carried their own copy — any new
+global registry must be added HERE only).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def reset_world() -> None:
+    from tpudes.core.config import Names
+    from tpudes.core.global_value import GlobalValue
+    from tpudes.core.rng import RngSeedManager
+    from tpudes.core.simulator import Simulator
+
+    Simulator.Destroy()
+    GlobalValue.ResetAll()
+    RngSeedManager.Reset()
+    Names.Clear()
+    # lazily-imported registries: only touch what the process loaded
+    mod = sys.modules.get("tpudes.network.node")
+    if mod is not None:
+        mod.NodeList.Reset()
+    eng = sys.modules.get("tpudes.parallel.engine")
+    if eng is not None:
+        eng.BatchableRegistry.reset()

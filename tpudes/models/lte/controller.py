@@ -58,6 +58,7 @@ class LteTtiController:
         self.ues: list = []
         self.tti = 0
         self._started = False
+        self.lifted = False   # set by parallel.lift: device engine owns the run
         self._dirty = True
         self._static_geometry = True
         # device-side constants (built lazily)
@@ -337,6 +338,8 @@ class LteTtiController:
         import jax
         import jax.numpy as jnp
 
+        if self.lifted:
+            return  # the lifted device program runs the scenario instead
         if self._dirty:
             self._rebuild()
         elif not self._static_geometry:

@@ -20,16 +20,9 @@ from __future__ import annotations
 from tpudes.core.global_value import GlobalValue
 from tpudes.core.simulator import DefaultSimulatorImpl, register_simulator_impl
 
-#: window length in ns: 1 ms default — the LTE TTI, and a fine geometry-
-#: refresh interval for WiFi mobility (SURVEY.md §7 hard part 1)
-if "JaxWindowNs" not in GlobalValue._registry:
-    GlobalValue("JaxWindowNs", "conservative window length (ns) for JaxSimulatorImpl", 1_000_000)
-if "JaxBatchMinPhys" not in GlobalValue._registry:
-    GlobalValue(
-        "JaxBatchMinPhys",
-        "smallest channel (phy count) that engages the batched window cache",
-        32,
-    )
+# the engine's GlobalValue knobs (JaxWindowNs, JaxBatchMinPhys,
+# JaxReplicas) are registered in tpudes.core.global_value so that
+# CommandLine can bind them before this module is imported
 
 
 class BatchableRegistry:
@@ -71,8 +64,70 @@ class JaxSimulatorImpl(DefaultSimulatorImpl):
         super().__init__()
         self.window_ticks = int(GlobalValue.GetValue("JaxWindowNs"))
         self.windows_run = 0
+        #: set by the lifted replica-axis path: {"kind", "replicas",
+        #: "out", "sim_end_s"} — scenario scripts read per-replica
+        #: outcomes from here after Run()
+        self.replicated_result = None
+
+    def _try_lift(self) -> bool:
+        """JaxReplicas > 0: lower the live object graph to a device
+        program and run every replica on the accelerator at once.
+        Returns True when the lifted path ran (the scalar queue is then
+        bypassed); False → loud warning, windowed scalar fallback."""
+        replicas = int(GlobalValue.GetValue("JaxReplicas"))
+        if replicas <= 0 or self.replicated_result is not None:
+            return False
+        if self._scheduled_stop_ts is None:
+            import warnings
+
+            warnings.warn(
+                "JaxReplicas set but Simulator.Stop(t) was never called; "
+                "the replica-axis path needs a bounded horizon — falling "
+                "back to the windowed scalar engine",
+                stacklevel=2,
+            )
+            return False
+        sim_end_s = self._scheduled_stop_ts / 1e9
+        from tpudes.parallel.lift import (
+            UnliftableScenarioError,
+            lift,
+            run_lifted,
+        )
+
+        try:
+            kind, prog, commit = lift(sim_end_s)
+        except UnliftableScenarioError as e:
+            import warnings
+
+            warnings.warn(
+                f"JaxReplicas={replicas} requested but no lowering can "
+                f"represent this object graph ({e}); falling back to the "
+                f"windowed scalar engine",
+                stacklevel=2,
+            )
+            return False
+        out = run_lifted(kind, prog, replicas)
+        commit()  # only a *successful* device run disarms the host path
+        self.replicated_result = dict(
+            kind=kind, replicas=replicas, out=out, sim_end_s=sim_end_s,
+            program=prog,
+        )
+        self.current_ts = self._scheduled_stop_ts
+        return True
+
+    def IsFinished(self) -> bool:
+        # a completed lifted run IS the whole simulation, even though the
+        # scalar queue was never drained
+        return self.replicated_result is not None or super().IsFinished()
 
     def Run(self) -> None:
+        if self.replicated_result is not None:
+            # the lifted run already covered the scenario; a second Run()
+            # must not replay the stale scalar queue with time moving
+            # backwards
+            return
+        if self._try_lift():
+            return
         self._stop = False
         events = self._events
         while not self._stop:

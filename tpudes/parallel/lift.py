@@ -1,0 +1,164 @@
+"""Scenario lifting at the SimulatorImplementationType seam.
+
+The north-star contract (BASELINE.json): a scenario script opts into the
+TPU engine with ONE GlobalValue flip —
+
+    python examples/wifi-bss.py \
+        --SimulatorImplementationType=tpudes::JaxSimulatorImpl \
+        --JaxReplicas=512
+
+No per-example plumbing: when ``JaxSimulatorImpl.Run`` sees
+``JaxReplicas > 0`` it walks the live object graph (NodeList), finds a
+scenario shape a registered lowering can represent, lowers it to a
+device program (replicated.py / lte_sm.py), and runs every replica on
+the accelerator at once.  Graphs no lowering can faithfully represent
+fall back to the windowed scalar engine with a loud warning — never a
+silent mis-lowering (the round-2 rule).
+
+Reference parity: upstream has no analog — this is the TPU-native
+replacement for running 512 separate ns-3 processes; the seam itself is
+simulator-impl.{h,cc}'s ObjectFactory (SURVEY.md §1, §7 step 7).
+"""
+
+from __future__ import annotations
+
+from tpudes.parallel.replicated import UnliftableScenarioError
+
+
+def _iter_nodes():
+    from tpudes.network.node import NodeList
+
+    for i in range(NodeList.GetNNodes()):
+        yield NodeList.GetNode(i)
+
+
+def _discover_bss(sim_end_s: float):
+    """Find an infrastructure-BSS shape (one AP, N STAs, echo clients)
+    in the global object graph and lower it."""
+    from tpudes.models.applications import UdpEchoClient
+    from tpudes.models.wifi.device import WifiNetDevice
+    from tpudes.models.wifi.mac import ApWifiMac, StaWifiMac
+    from tpudes.parallel.replicated import lower_bss
+
+    aps, stas, clients, stray_clients = [], [], [], 0
+    bss_nodes = set()
+    for node in _iter_nodes():
+        for d in range(node.GetNDevices()):
+            dev = node.GetDevice(d)
+            if isinstance(dev, WifiNetDevice):
+                mac = dev.GetMac()
+                if isinstance(mac, ApWifiMac):
+                    aps.append(dev)
+                    bss_nodes.add(node)
+                elif isinstance(mac, StaWifiMac):
+                    stas.append(dev)
+                    bss_nodes.add(node)
+    for node in _iter_nodes():
+        for a in range(node.GetNApplications()):
+            app = node.GetApplication(a)
+            if isinstance(app, UdpEchoClient):
+                if node in bss_nodes:
+                    clients.append(app)
+                else:
+                    stray_clients += 1
+    if len(aps) != 1 or not stas:
+        raise UnliftableScenarioError(
+            f"not an infrastructure BSS (found {len(aps)} APs, "
+            f"{len(stas)} STAs)"
+        )
+    if stray_clients:
+        # a client on a non-BSS node (mixed wired/wireless topology)
+        # would be silently dropped by the lowering — refuse instead
+        raise UnliftableScenarioError(
+            f"{stray_clients} echo client(s) live on non-BSS nodes; the "
+            "replica axis models only the BSS traffic"
+        )
+    return "bss", lower_bss(stas, aps[0], clients, sim_end_s), lambda: None
+
+
+def _discover_lte_sm(sim_end_s: float):
+    """Find a full-buffer LTE shape (eNBs with a TTI controller) and
+    lower it to the device-resident SM engine."""
+    from types import SimpleNamespace
+
+    from tpudes.models.lte.device import LteEnbNetDevice
+    from tpudes.parallel.lte_sm import (
+        UnliftableLteScenarioError,
+        lower_lte_sm,
+    )
+
+    controller = None
+    for node in _iter_nodes():
+        for d in range(node.GetNDevices()):
+            dev = node.GetDevice(d)
+            if isinstance(dev, LteEnbNetDevice) and dev.controller is not None:
+                controller = dev.controller
+                break
+        if controller is not None:
+            break
+    if controller is None:
+        raise UnliftableScenarioError("no LTE eNB devices in the graph")
+    try:
+        prog = lower_lte_sm(SimpleNamespace(controller=controller), sim_end_s)
+    except UnliftableLteScenarioError as e:
+        raise UnliftableScenarioError(str(e)) from e
+
+    def commit():
+        # the controller's own TTI events must not ALSO run the scenario;
+        # armed only after the device run succeeds, so a failed run (OOM,
+        # backend error) leaves the host path fully functional
+        controller.lifted = True
+
+    return "lte_sm", prog, commit
+
+
+#: discovery order: most specific first
+LOWERINGS = [_discover_lte_sm, _discover_bss]
+
+
+def lift(sim_end_s: float):
+    """Try every registered lowering; returns ``(kind, program, commit)``
+    — ``commit()`` is called by the engine after the device run succeeds
+    (it disarms any host-side duplicate of the scenario) — or raises
+    UnliftableScenarioError with every reason collected."""
+    reasons = []
+    for discover in LOWERINGS:
+        try:
+            return discover(sim_end_s)
+        except UnliftableScenarioError as e:
+            reasons.append(f"{discover.__name__}: {e}")
+    raise UnliftableScenarioError("; ".join(reasons))
+
+
+def run_lifted(kind: str, prog, replicas: int, key=None, mesh=None):
+    """Execute a lifted program on the replica axis.
+
+    ``mesh=None`` auto-selects: a 1-axis replica mesh over all local
+    devices when more than one is visible and divides ``replicas``.
+    Returns the program's per-replica outcome dict (see
+    run_replicated_bss / run_lte_sm).
+    """
+    import jax
+
+    if key is None:
+        from tpudes.core.rng import RngSeedManager
+
+        key = jax.random.PRNGKey(
+            (RngSeedManager.GetSeed() * 2654435761 + RngSeedManager.GetRun())
+            & 0x7FFFFFFF
+        )
+    if mesh is None:
+        n_dev = len(jax.devices())
+        if n_dev > 1 and replicas % n_dev == 0:
+            from tpudes.parallel.mesh import replica_mesh
+
+            mesh = replica_mesh(n_dev)
+    if kind == "bss":
+        from tpudes.parallel.replicated import run_replicated_bss
+
+        return run_replicated_bss(prog, replicas, key, mesh=mesh)
+    if kind == "lte_sm":
+        from tpudes.parallel.lte_sm import run_lte_sm
+
+        return run_lte_sm(prog, key, replicas=replicas, mesh=mesh)
+    raise ValueError(f"unknown lifted program kind {kind!r}")

@@ -1,0 +1,197 @@
+"""Canonical scenario builders for the BASELINE config shapes.
+
+Shared by ``bench.py`` and the test suite so each scenario definition
+exists once (r4 review: three drifting copies of the BSS/lena builders).
+The ``examples/`` scripts intentionally keep inline construction — they
+are user-facing documentation of the ns-3 idiom — but should match these
+shapes.
+
+Both builders return live object graphs; callers lower them via
+``tpudes.parallel.lift`` / run them on the scalar engine as needed.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def hex_grid(n: int, spacing: float) -> list[tuple[float, float]]:
+    """First n positions of a hexagonal ring layout (cell 0 centered) —
+    the lena macro-cell drop."""
+    pos = [(0.0, 0.0)]
+    ring = 1
+    while len(pos) < n:
+        for k in range(6 * ring):
+            a = 2 * math.pi * k / (6 * ring)
+            pos.append(
+                (ring * spacing * math.cos(a), ring * spacing * math.sin(a))
+            )
+            if len(pos) >= n:
+                break
+        ring += 1
+    return pos[:n]
+
+
+def build_bss(
+    n_stas: int,
+    sim_time: float,
+    radii: tuple = (10.0, 22.0, 34.0),
+    interval_s: float = 0.1,
+    packet_bytes: int = 512,
+    data_mode: str = "OfdmRate54Mbps",
+):
+    """BASELINE config #3: one AP at the origin, ``n_stas`` stations on
+    circles of ``radii`` (cycled), UDP echo upstream traffic.
+
+    Returns ``(sta_devices, ap_device, clients, server_rx)`` where
+    ``server_rx`` is a one-element list counting server deliveries on
+    the scalar engine.
+    """
+    from tpudes.core import Seconds
+    from tpudes.helper.applications import (
+        UdpEchoClientHelper,
+        UdpEchoServerHelper,
+    )
+    from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+    from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+    from tpudes.models.mobility import (
+        ListPositionAllocator,
+        MobilityHelper,
+        Vector,
+    )
+    from tpudes.models.wifi import (
+        WifiHelper,
+        WifiMacHelper,
+        YansWifiChannelHelper,
+        YansWifiPhyHelper,
+    )
+
+    nodes = NodeContainer()
+    nodes.Create(n_stas + 1)
+    alloc = ListPositionAllocator()
+    alloc.Add(Vector(0.0, 0.0, 0.0))
+    for i in range(n_stas):
+        a = 2 * math.pi * i / n_stas
+        r = radii[i % len(radii)]
+        alloc.Add(Vector(r * math.cos(a), r * math.sin(a), 0.0))
+    mob = MobilityHelper()
+    mob.SetPositionAllocator(alloc)
+    mob.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mob.Install(nodes)
+
+    channel = YansWifiChannelHelper.Default().Create()
+    phy = YansWifiPhyHelper()
+    phy.SetChannel(channel)
+    wifi = WifiHelper()
+    wifi.SetRemoteStationManager(
+        "tpudes::ConstantRateWifiManager", DataMode=data_mode
+    )
+    ap_mac = WifiMacHelper()
+    ap_mac.SetType("tpudes::ApWifiMac")
+    ap_devices = wifi.Install(phy, ap_mac, [nodes.Get(0)])
+    sta_mac = WifiMacHelper()
+    sta_mac.SetType("tpudes::StaWifiMac")
+    sta_devices = wifi.Install(
+        phy, sta_mac, [nodes.Get(i) for i in range(1, n_stas + 1)]
+    )
+
+    stack = InternetStackHelper()
+    stack.Install(nodes)
+    address = Ipv4AddressHelper()
+    address.SetBase("10.1.3.0", "255.255.255.0")
+    devices = NetDeviceContainer()
+    devices.Add(ap_devices.Get(0))
+    for i in range(n_stas):
+        devices.Add(sta_devices.Get(i))
+    interfaces = address.Assign(devices)
+
+    server = UdpEchoServerHelper(9)
+    server_apps = server.Install(nodes.Get(0))
+    server_apps.Start(Seconds(0.4))
+    server_apps.Stop(Seconds(sim_time))
+    server_rx = [0]
+    server_apps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda pkt, *a: server_rx.__setitem__(0, server_rx[0] + 1)
+    )
+
+    clients = []
+    for i in range(n_stas):
+        helper = UdpEchoClientHelper(interfaces.GetAddress(0), 9)
+        helper.SetAttribute("MaxPackets", 1_000_000)
+        helper.SetAttribute("Interval", Seconds(interval_s))
+        helper.SetAttribute("PacketSize", packet_bytes)
+        apps = helper.Install(nodes.Get(1 + i))
+        apps.Start(Seconds(1.0 + 0.001 * i))
+        apps.Stop(Seconds(sim_time))
+        clients.append(apps.Get(0))
+    return sta_devices, ap_devices.Get(0), clients, server_rx
+
+
+def build_lena(
+    n_enbs: int,
+    ues_per_cell: int,
+    scheduler: str = "pf",
+    bearer_mode: str = "sm",
+    inter_site: float = 500.0,
+    layout: str = "hex",
+    drop_seed: int = 7,
+    drop_radius_factor: float = 0.45,
+):
+    """BASELINE config #4: lena macro-cell grid with ``ues_per_cell``
+    UEs dropped uniformly in a disc around each site, strongest-cell
+    attach, one default bearer per UE.
+
+    Returns ``(lte_helper, ue_devices)``.
+    """
+    import random
+
+    from tpudes.helper.containers import NodeContainer
+    from tpudes.models.lte import LteHelper
+    from tpudes.models.mobility import (
+        ListPositionAllocator,
+        MobilityHelper,
+        Vector,
+    )
+
+    lte = LteHelper()
+    lte.SetSchedulerType(
+        "tpudes::PfFfMacScheduler"
+        if scheduler == "pf"
+        else "tpudes::RrFfMacScheduler"
+    )
+    enb_nodes = NodeContainer()
+    enb_nodes.Create(n_enbs)
+    ue_nodes = NodeContainer()
+    ue_nodes.Create(n_enbs * ues_per_cell)
+
+    if layout == "hex":
+        sites = hex_grid(n_enbs, inter_site)
+    else:  # "line"
+        sites = [(i * inter_site, 0.0) for i in range(n_enbs)]
+    ea = ListPositionAllocator()
+    for x, y in sites:
+        ea.Add(Vector(x, y, 30.0))
+    me = MobilityHelper()
+    me.SetPositionAllocator(ea)
+    me.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    me.Install(enb_nodes)
+
+    rng = random.Random(drop_seed)
+    ua = ListPositionAllocator()
+    for c in range(n_enbs):
+        cx, cy = sites[c]
+        for _ in range(ues_per_cell):
+            r = inter_site * drop_radius_factor * math.sqrt(rng.random())
+            a = 2 * math.pi * rng.random()
+            ua.Add(Vector(cx + r * math.cos(a), cy + r * math.sin(a), 1.5))
+    mu = MobilityHelper()
+    mu.SetPositionAllocator(ua)
+    mu.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mu.Install(ue_nodes)
+
+    lte.InstallEnbDevice(enb_nodes)
+    ue_devs = lte.InstallUeDevice(ue_nodes)
+    ue_list = [ue_devs.Get(i) for i in range(ue_devs.GetN())]
+    lte.Attach(ue_list)
+    lte.ActivateDataRadioBearer(ue_list, mode=bearer_mode)
+    return lte, ue_devs
