@@ -8,12 +8,15 @@ Both sides are *scenario-level* sim-seconds per wall-second; the ratio
 is the engine speedup the north star asks for (BASELINE.json: "one
 GlobalValue flag flips a stock scenario onto the TPU").
 
-Two scenarios:
+Three scenarios:
   - BSS (BASELINE config #3): 64-STA infrastructure WiFi, UDP echo,
     512 Monte-Carlo replicas at once (the headline metric).
   - LTE (BASELINE config #4): 7 eNB x 210 UE full-buffer hex grid,
     64 replicas of 10 simulated seconds on the device SM engine vs the
     host per-TTI controller loop.
+  - TCP dumbbell (BASELINE config #2): 8 bulk flows over a 10 Mbps
+    bottleneck, 256 replicas of 20 simulated seconds on the packet-slot
+    engine vs the host socket stack.
 
 Timing protocol: the device side compiles once, then runs N_TIMED=5
 timed repetitions with distinct PRNG keys; the reported value is the
@@ -43,6 +46,10 @@ LTE_REPLICAS = 64
 LTE_SIM_S = 10.0
 LTE_HOST_WARM_S = 0.01
 LTE_HOST_MEAS_S = 0.04
+TCP_FLOWS = 8
+TCP_REPLICAS = 256
+TCP_SIM_S = 20.0
+TCP_HOST_S = 5.0
 N_TIMED = 5
 
 
@@ -140,11 +147,58 @@ def bench_lte():
     )
 
 
+def bench_tcp():
+    import jax
+
+    from tpudes.core import Seconds, Simulator
+    from tpudes.core.world import reset_world
+    from tpudes.parallel.tcp_dumbbell import lower_dumbbell, run_tcp_dumbbell
+    from tpudes.scenarios import build_dumbbell
+
+    reset_world()
+    _, sinks = build_dumbbell(TCP_FLOWS, TCP_HOST_S, variant="TcpCubic")
+    # --- denominator: real TcpSocketBase over the scalar engine ----------
+    t0 = time.monotonic()
+    Simulator.Stop(Seconds(TCP_HOST_S))
+    Simulator.Run()
+    host_wall = time.monotonic() - t0
+    host_rx = sum(s.GetTotalRx() for s in sinks)
+    reset_world()
+    host_rate = TCP_HOST_S / host_wall
+
+    # --- numerator: packet-slot engine, median of N_TIMED -----------------
+    build_dumbbell(TCP_FLOWS, TCP_SIM_S, variant="TcpCubic")
+    prog = lower_dumbbell(TCP_SIM_S)
+    run_tcp_dumbbell(prog, jax.random.PRNGKey(0), replicas=TCP_REPLICAS)
+    walls, mbps = [], 0.0
+    for i in range(N_TIMED):
+        t0 = time.monotonic()
+        out = run_tcp_dumbbell(
+            prog, jax.random.PRNGKey(1 + i), replicas=TCP_REPLICAS
+        )
+        out["delivered"].block_until_ready()
+        walls.append(time.monotonic() - t0)
+        mbps += float(out["goodput_mbps"].sum(1).mean())
+    med = statistics.median(walls)
+    rate = TCP_REPLICAS * TCP_SIM_S / med
+    return dict(
+        sim_s_per_wall_s=rate,
+        vs_scalar=rate / host_rate,
+        wall_median_s=med,
+        wall_min_s=min(walls),
+        wall_max_s=max(walls),
+        scalar_sim_s_per_wall_s=host_rate,
+        scalar_goodput_mbps=host_rx * 8 / TCP_HOST_S / 1e6,
+        agg_goodput_mbps=mbps / N_TIMED,
+    )
+
+
 def main():
     import jax
 
     wifi = bench_wifi()
     lte = bench_lte()
+    tcp = bench_tcp()
     r3 = lambda d: {  # noqa: E731
         k: (round(v, 3) if isinstance(v, float) else v) for k, v in d.items()
     }
@@ -159,6 +213,7 @@ def main():
         "vs_baseline": round(wifi["vs_scalar"], 1),
         "wifi": r3(wifi),
         "lte": r3(lte),
+        "tcp": r3(tcp),
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
     }

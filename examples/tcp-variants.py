@@ -1,0 +1,118 @@
+"""tcp-variants: N TCP bulk flows across a dumbbell bottleneck.
+
+The TCP workload shape from BASELINE.json config #2; upstream analog:
+examples/tcp/tcp-variants-comparison.cc over the
+point-to-point-layout dumbbell.
+
+Run (scalar DES, one variant):
+    python examples/tcp-variants.py --nFlows=4 --variant=TcpCubic --simTime=5
+
+Sweep all six variants sequentially:
+    python examples/tcp-variants.py --nFlows=4 --variant=all --simTime=5
+
+The TPU engine is one GlobalValue flip away — 256 Monte-Carlo replicas
+of the whole dumbbell at once, per variant:
+
+    python examples/tcp-variants.py --nFlows=8 --variant=all --simTime=10 \
+        --SimulatorImplementationType=tpudes::JaxSimulatorImpl \
+        --JaxReplicas=256
+
+JaxSimulatorImpl lowers the SAME constructed object graph to the
+packet-slot program (tpudes/parallel/tcp_dumbbell.py): every slot of
+the bottleneck, every flow's cwnd evolution, and all drops/recoveries
+run as one lax.scan on the accelerator, vmapped over replicas.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpudes.core import CommandLine, Seconds, Simulator
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.world import reset_world
+from tpudes.models.internet.tcp_congestion import TCP_VARIANTS
+from tpudes.scenarios import build_dumbbell
+
+
+def jain(xs):
+    s = sum(xs)
+    q = sum(x * x for x in xs)
+    return (s * s) / (len(xs) * q) if q else 1.0
+
+
+def run_one(variant, n_flows, sim_time, bottleneck_rate, queue, engine):
+    reset_world()  # one world per variant; restore the engine choice
+    for name, value in engine.items():
+        GlobalValue.Bind(name, value)
+    db, sinks = build_dumbbell(
+        n_flows, sim_time, variant=variant,
+        bottleneck_rate=bottleneck_rate, queue=queue,
+    )
+    wall0 = time.monotonic()
+    Simulator.Stop(Seconds(sim_time))
+    Simulator.Run()
+    wall = time.monotonic() - wall0
+
+    res = getattr(Simulator.GetImpl(), "replicated_result", None)
+    if res is not None:
+        import numpy as np
+
+        out = res["out"]
+        g = np.asarray(out["goodput_mbps"])          # (R, F)
+        agg = g.sum(axis=1)
+        fair = [jain(list(row)) for row in g]
+        print(
+            f"{variant:14s} replicas={res['replicas']} "
+            f"agg={agg.mean():.2f}±{agg.std():.2f} Mbps "
+            f"jain={float(np.mean(fair)):.3f} "
+            f"drops={float(np.asarray(out['drops']).sum(1).mean()):.0f} "
+            f"queue={float(np.asarray(out['mean_queue']).mean()):.1f}p "
+            f"wall={wall:.2f}s "
+            f"sim-s/wall-s={res['replicas'] * sim_time / wall:,.0f}"
+        )
+        ok = agg.mean() > 0
+    else:
+        tput = [
+            s.GetTotalRx() * 8.0 / max(sim_time - 0.1, 1e-9) / 1e6
+            for s in sinks
+        ]
+        print(
+            f"{variant:14s} goodput/flow "
+            f"[{', '.join(f'{t:.2f}' for t in tput)}] Mbps "
+            f"agg={sum(tput):.2f} jain={jain(tput):.3f} "
+            f"events={Simulator.GetEventCount()} wall={wall:.2f}s"
+        )
+        ok = sum(tput) > 0
+    Simulator.Destroy()
+    return ok
+
+
+def main(argv=None):
+    cmd = CommandLine()
+    cmd.AddValue("nFlows", "flows per side", 4)
+    cmd.AddValue("variant", "TcpX | all", "TcpNewReno")
+    cmd.AddValue("simTime", "simulated seconds", 5.0)
+    cmd.AddValue("bottleneckRate", "bottleneck data rate", "10Mbps")
+    cmd.AddValue("queue", "bottleneck queue (packets)", "100p")
+    cmd.Parse(argv)
+
+    variants = (
+        list(TCP_VARIANTS) if cmd.variant == "all" else [str(cmd.variant)]
+    )
+    engine = {
+        name: GlobalValue.GetValue(name)
+        for name in ("SimulatorImplementationType", "JaxReplicas", "RngRun")
+    }
+    ok = True
+    for v in variants:
+        ok = run_one(
+            v, int(cmd.nFlows), float(cmd.simTime),
+            str(cmd.bottleneckRate), str(cmd.queue), engine,
+        ) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,121 @@
+"""Dumbbell layout + global SPF routing tests.
+
+Mirrors upstream's src/point-to-point-layout tests and
+src/internet/test/ipv4-global-routing-test-suite.cc strategy: build the
+canned topology, populate tables, assert end-to-end delivery through
+multi-hop forwarding.
+"""
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.helper.applications import (
+    BulkSendHelper,
+    PacketSinkHelper,
+    UdpEchoClientHelper,
+    UdpEchoServerHelper,
+)
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.helper.layout import PointToPointDumbbellHelper
+from tpudes.helper.point_to_point import PointToPointHelper
+from tpudes.models.internet.global_routing import (
+    GlobalRouteManager,
+    Ipv4GlobalRoutingHelper,
+)
+from tpudes.network.address import InetSocketAddress, Ipv4Address
+
+
+def _dumbbell(n=3, bottleneck_rate="2Mbps", bottleneck_delay="10ms"):
+    leaf = PointToPointHelper()
+    leaf.SetDeviceAttribute("DataRate", "10Mbps")
+    leaf.SetChannelAttribute("Delay", "1ms")
+    bott = PointToPointHelper()
+    bott.SetDeviceAttribute("DataRate", bottleneck_rate)
+    bott.SetChannelAttribute("Delay", bottleneck_delay)
+    db = PointToPointDumbbellHelper(n, leaf, n, leaf, bott)
+    stack = InternetStackHelper()
+    stack.SetRoutingHelper(Ipv4GlobalRoutingHelper())
+    db.InstallStack(stack)
+    db.AssignIpv4Addresses(
+        Ipv4AddressHelper("10.1.0.0", "255.255.255.0"),
+        Ipv4AddressHelper("10.2.0.0", "255.255.255.0"),
+        Ipv4AddressHelper("10.3.0.0", "255.255.255.0"),
+    )
+    Ipv4GlobalRoutingHelper.PopulateRoutingTables()
+    return db
+
+
+def test_dumbbell_shape_and_addresses():
+    db = _dumbbell(4)
+    assert db.LeftCount() == 4 and db.RightCount() == 4
+    # distinct leaf subnets on each side
+    lefts = {str(db.GetLeftIpv4Address(i)) for i in range(4)}
+    rights = {str(db.GetRightIpv4Address(i)) for i in range(4)}
+    assert len(lefts) == 4 and len(rights) == 4
+    assert all(a.startswith("10.1.") for a in lefts)
+    assert all(a.startswith("10.2.") for a in rights)
+    # routers carry 1 bottleneck + n access interfaces (+ loopback)
+    from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+
+    left_router = db.GetLeft()
+    assert left_router.GetObject(Ipv4L3Protocol).GetNInterfaces() == 1 + 1 + 4
+
+
+def test_spf_next_hops_cross_dumbbell():
+    db = _dumbbell(2)
+    mgr = GlobalRouteManager.Get()
+    left0 = db.GetLeft(0)
+    dst = db.GetRightIpv4Address(1)
+    hop = mgr.NextHop(left0.GetId(), Ipv4Address(str(dst)))
+    assert hop is not None
+    if_index, gw = hop
+    assert gw is not None  # leaf's first hop is its access router
+
+
+def test_udp_echo_across_dumbbell():
+    db = _dumbbell(2)
+    server = UdpEchoServerHelper(9)
+    apps = server.Install(db.GetRight(0))
+    apps.Start(Seconds(0.0))
+    client = UdpEchoClientHelper(
+        Ipv4Address(str(db.GetRightIpv4Address(0))), 9
+    )
+    client.SetAttribute("MaxPackets", 5)
+    client.SetAttribute("Interval", Seconds(0.1))
+    client.SetAttribute("PacketSize", 256)
+    capps = client.Install(db.GetLeft(0))
+    capps.Start(Seconds(0.1))
+    got = [0]
+    capps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda *a: got.__setitem__(0, got[0] + 1)
+    )
+    Simulator.Stop(Seconds(2.0))
+    Simulator.Run()
+    assert got[0] == 5
+
+
+def test_tcp_bulk_across_dumbbell_bottleneck():
+    db = _dumbbell(2, bottleneck_rate="1Mbps", bottleneck_delay="5ms")
+    sink = PacketSinkHelper(
+        "tpudes::TcpSocketFactory",
+        InetSocketAddress(Ipv4Address.GetAny(), 5000),
+    )
+    sapps = sink.Install(db.GetRight(0))
+    sapps.Start(Seconds(0.0))
+    bulk = BulkSendHelper(
+        "tpudes::TcpSocketFactory",
+        InetSocketAddress(Ipv4Address(str(db.GetRightIpv4Address(0))), 5000),
+    )
+    bulk.SetAttribute("MaxBytes", 200_000)
+    bapps = bulk.Install(db.GetLeft(0))
+    bapps.Start(Seconds(0.1))
+    Simulator.Stop(Seconds(6.0))
+    Simulator.Run()
+    assert sapps.Get(0).GetTotalRx() == 200_000
+
+
+def test_unreachable_destination_is_an_error_not_a_hang():
+    db = _dumbbell(2)
+    mgr = GlobalRouteManager.Get()
+    assert mgr.NextHop(db.GetLeft(0).GetId(), Ipv4Address("192.168.99.1")) is None

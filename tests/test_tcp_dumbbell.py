@@ -1,0 +1,190 @@
+"""TCP-dumbbell replica engine tests (BASELINE config #2).
+
+Mirrors upstream's tcp-variants-comparison validation strategy: the
+scalar DES (real sockets) is the oracle; the device packet-slot model
+must match it statistically (aggregate goodput) and reproduce each
+variant's qualitative signature (Vegas' empty queue, Scalable's
+aggression), plus structural invariants (conservation, determinism,
+mesh execution).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.parallel.tcp_dumbbell import (
+    UnliftableDumbbellError,
+    lower_dumbbell,
+    run_tcp_dumbbell,
+)
+from tpudes.scenarios import build_dumbbell
+
+SIM_S = 4.0
+
+
+def _lowered(n_flows=4, variant="TcpNewReno", rate="5Mbps", **kw):
+    build_dumbbell(n_flows, SIM_S, variant=variant, bottleneck_rate=rate, **kw)
+    return lower_dumbbell(SIM_S)
+
+
+def test_lowering_reads_graph_parameters():
+    prog = _lowered(3, rate="5Mbps", queue="50p", seg_bytes=500)
+    assert prog.n_flows == 3
+    assert prog.queue_cap == 50
+    assert prog.seg_bytes == 500
+    # τ = (500+40)·8 / 5e6
+    assert prog.slot_s == pytest.approx(540 * 8 / 5e6)
+    # access 100Mbps / bottleneck 5Mbps
+    assert prog.burst_cap == 20
+    assert prog.n_slots == pytest.approx(SIM_S / prog.slot_s, abs=1)
+
+
+def test_lowering_rejects_non_dumbbell_graphs():
+    from tpudes.core.world import reset_world
+    from tpudes.helper.containers import NodeContainer
+
+    nodes = NodeContainer()
+    nodes.Create(2)
+    with pytest.raises(UnliftableDumbbellError):
+        lower_dumbbell(1.0)
+    reset_world()
+    # access slower than bottleneck → leaf-side queueing unrepresentable
+    build_dumbbell(2, SIM_S, bottleneck_rate="5Mbps", access_rate="1Mbps")
+    with pytest.raises(UnliftableDumbbellError):
+        lower_dumbbell(SIM_S)
+
+
+def test_conservation_and_utilization():
+    prog = _lowered(4)
+    out = run_tcp_dumbbell(prog, jax.random.PRNGKey(0), replicas=8)
+    delivered = np.asarray(out["delivered"])
+    assert (delivered > 0).all(), "every flow must make progress"
+    # the bottleneck serves ≤ 1 packet per slot
+    assert (delivered.sum(1) <= prog.n_slots).all()
+    # backlogged loss-based flows fill the pipe: ≥ 85% utilization
+    util = delivered.sum(1) / prog.n_slots
+    assert (util > 0.85).all(), util
+
+
+def test_same_key_is_deterministic():
+    prog = _lowered(2)
+    a = run_tcp_dumbbell(prog, jax.random.PRNGKey(7), replicas=4)
+    b = run_tcp_dumbbell(prog, jax.random.PRNGKey(7), replicas=4)
+    np.testing.assert_array_equal(
+        np.asarray(a["delivered"]), np.asarray(b["delivered"])
+    )
+
+
+def test_variant_signatures():
+    from tpudes.core.world import reset_world
+
+    outs, progs = {}, {}
+    for v in ("TcpNewReno", "TcpScalable", "TcpVegas"):
+        reset_world()
+        progs[v] = _lowered(4, variant=v)
+        outs[v] = run_tcp_dumbbell(progs[v], jax.random.PRNGKey(1), replicas=8)
+    q_reno = float(np.mean(np.asarray(outs["TcpNewReno"]["mean_queue"])))
+    q_vegas = float(np.mean(np.asarray(outs["TcpVegas"]["mean_queue"])))
+    drops_vegas = int(np.asarray(outs["TcpVegas"]["drops"]).sum())
+    drops_reno = int(np.asarray(outs["TcpNewReno"]["drops"]).sum())
+    drops_scal = int(np.asarray(outs["TcpScalable"]["drops"]).sum())
+    # Vegas: delay-based — near-empty queue, no losses
+    assert q_vegas < 0.4 * q_reno
+    assert drops_vegas == 0
+    # Scalable backs off least → more overflow events than Reno
+    assert drops_scal > drops_reno
+    # and all three still fill the pipe
+    for v, o in outs.items():
+        util = np.asarray(o["delivered"]).sum(1) / progs[v].n_slots
+        assert (util > 0.85).all(), (v, util)
+
+
+def test_statistical_parity_with_scalar_des():
+    """Aggregate goodput of the slot model vs real TcpSocketBase over
+    the identical graph — the replica engine's oracle contract."""
+    from tpudes.core.world import reset_world
+
+    host = {}
+    for v in ("TcpNewReno", "TcpVegas"):
+        reset_world()
+        db, sinks = build_dumbbell(
+            3, SIM_S, variant=v, bottleneck_rate="3Mbps"
+        )
+        Simulator.Stop(Seconds(SIM_S))
+        Simulator.Run()
+        host[v] = sum(
+            s.GetTotalRx() * 8.0 / (SIM_S - 0.1) / 1e6 for s in sinks
+        )
+    for v in ("TcpNewReno", "TcpVegas"):
+        reset_world()
+        build_dumbbell(3, SIM_S, variant=v, bottleneck_rate="3Mbps")
+        prog = lower_dumbbell(SIM_S)
+        out = run_tcp_dumbbell(prog, jax.random.PRNGKey(3), replicas=8)
+        dev = float(np.asarray(out["goodput_mbps"]).sum(1).mean())
+        assert dev == pytest.approx(host[v], rel=0.25), (
+            f"{v}: device {dev:.2f} vs host {host[v]:.2f} Mbps"
+        )
+
+
+def test_early_app_stop_halts_flow():
+    """A flow stopped before sim end must stop occupying the bottleneck
+    (code-review r4: stop_time was silently ignored)."""
+    build_dumbbell(2, 2.0)  # apps Stop at 2.0 s
+    prog = lower_dumbbell(4.0)  # but the simulation runs to 4.0 s
+    assert (np.asarray(prog.stop_slot) < prog.n_slots).all()
+    out = run_tcp_dumbbell(prog, jax.random.PRNGKey(0), replicas=4)
+    util = np.asarray(out["delivered"]).sum(1) / prog.n_slots
+    # ~half the horizon is post-stop (plus drain): utilization well below 0.75
+    assert (util < 0.75).all() and (util > 0.3).all(), util
+
+
+def test_rejects_mixed_segment_sizes():
+    db, _ = build_dumbbell(2, SIM_S)
+    db.GetLeft(0).GetApplication(0).send_size = 700
+    with pytest.raises(UnliftableDumbbellError, match="SendSize"):
+        lower_dumbbell(SIM_S)
+
+
+def test_rejects_same_side_flow():
+    """A left→left flow never crosses the bottleneck — must be rejected,
+    not silently forced through the shared queue."""
+    from tpudes.core import Seconds
+    from tpudes.helper.applications import BulkSendHelper, PacketSinkHelper
+    from tpudes.network.address import InetSocketAddress, Ipv4Address
+
+    db, _ = build_dumbbell(3, SIM_S)
+    sink = PacketSinkHelper(
+        "tpudes::TcpSocketFactory",
+        InetSocketAddress(Ipv4Address.GetAny(), 7000),
+    )
+    sink.Install(db.GetLeft(1)).Start(Seconds(0.0))
+    bulk = BulkSendHelper(
+        "tpudes::TcpSocketFactory",
+        InetSocketAddress(
+            Ipv4Address(str(db.GetLeftIpv4Address(1))), 7000
+        ),
+    )
+    bulk.Install(db.GetLeft(0)).Start(Seconds(0.1))
+    with pytest.raises(UnliftableDumbbellError, match="cross"):
+        lower_dumbbell(SIM_S)
+
+
+def test_lift_discovers_dumbbell():
+    from tpudes.parallel.lift import lift
+
+    build_dumbbell(2, SIM_S)
+    kind, prog, commit = lift(SIM_S)
+    assert kind == "dumbbell"
+    assert prog.n_flows == 2
+    commit()
+
+
+def test_mesh_sharded_run():
+    from tpudes.parallel.mesh import replica_mesh
+
+    prog = _lowered(2)
+    mesh = replica_mesh(8)
+    out = run_tcp_dumbbell(prog, jax.random.PRNGKey(0), replicas=16, mesh=mesh)
+    assert np.asarray(out["delivered"]).shape == (16, 2)
+    assert int(np.asarray(out["delivered"]).sum()) > 0

@@ -29,3 +29,6 @@ def reset_world() -> None:
     eng = sys.modules.get("tpudes.parallel.engine")
     if eng is not None:
         eng.BatchableRegistry.reset()
+    gr = sys.modules.get("tpudes.models.internet.global_routing")
+    if gr is not None:
+        gr.GlobalRouteManager.Reset()

@@ -1,0 +1,100 @@
+"""Canned topology builders (the point-to-point-layout module).
+
+Reference parity: src/point-to-point-layout/model/
+point-to-point-dumbbell.{h,cc} and point-to-point-grid.{h,cc}
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.9).
+
+The dumbbell is BASELINE config #2's substrate: N left leaves feeding a
+single bottleneck link toward N right leaves — the classic TCP
+congestion-control arena.
+"""
+
+from __future__ import annotations
+
+from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+from tpudes.helper.internet import Ipv4AddressHelper
+
+
+class PointToPointDumbbellHelper:
+    """left leaves — left router ==bottleneck== right router — right
+    leaves, each leaf on its own access link."""
+
+    def __init__(self, n_left: int, left_helper, n_right: int, right_helper,
+                 bottleneck_helper):
+        self._routers = NodeContainer()
+        self._routers.Create(2)
+        self._left_leaves = NodeContainer()
+        self._left_leaves.Create(n_left)
+        self._right_leaves = NodeContainer()
+        self._right_leaves.Create(n_right)
+
+        self._router_devices = bottleneck_helper.Install(
+            self._routers.Get(0), self._routers.Get(1)
+        )
+        self._left_router_devices = NetDeviceContainer()
+        self._left_leaf_devices = NetDeviceContainer()
+        for i in range(n_left):
+            c = left_helper.Install(self._routers.Get(0), self._left_leaves.Get(i))
+            self._left_router_devices.Add(c.Get(0))
+            self._left_leaf_devices.Add(c.Get(1))
+        self._right_router_devices = NetDeviceContainer()
+        self._right_leaf_devices = NetDeviceContainer()
+        for i in range(n_right):
+            c = right_helper.Install(self._routers.Get(1), self._right_leaves.Get(i))
+            self._right_router_devices.Add(c.Get(0))
+            self._right_leaf_devices.Add(c.Get(1))
+
+        self._left_interfaces = None
+        self._right_interfaces = None
+        self._router_interfaces = None
+
+    # --- accessors (upstream names) -------------------------------------
+    def GetLeft(self, i: int | None = None):
+        return self._routers.Get(0) if i is None else self._left_leaves.Get(i)
+
+    def GetRight(self, i: int | None = None):
+        return self._routers.Get(1) if i is None else self._right_leaves.Get(i)
+
+    def LeftCount(self) -> int:
+        return self._left_leaves.GetN()
+
+    def RightCount(self) -> int:
+        return self._right_leaves.GetN()
+
+    def GetLeftIpv4Address(self, i: int):
+        return self._left_interfaces[i]
+
+    def GetRightIpv4Address(self, i: int):
+        return self._right_interfaces[i]
+
+    def GetBottleneckDevices(self) -> NetDeviceContainer:
+        return self._router_devices
+
+    # --- wiring ----------------------------------------------------------
+    def InstallStack(self, stack) -> None:
+        stack.Install(self._routers)
+        stack.Install(self._left_leaves)
+        stack.Install(self._right_leaves)
+
+    def AssignIpv4Addresses(self, left_ip: Ipv4AddressHelper,
+                            right_ip: Ipv4AddressHelper,
+                            router_ip: Ipv4AddressHelper) -> None:
+        """One subnet per access link, one for the bottleneck; leaf
+        addresses are recorded for GetLeft/RightIpv4Address."""
+        self._router_interfaces = router_ip.Assign(self._router_devices)
+        self._left_interfaces = []
+        for i in range(self.LeftCount()):
+            c = NetDeviceContainer(
+                self._left_router_devices.Get(i), self._left_leaf_devices.Get(i)
+            )
+            ifc = left_ip.Assign(c)
+            self._left_interfaces.append(ifc.GetAddress(1))
+            left_ip.NewNetwork()
+        self._right_interfaces = []
+        for i in range(self.RightCount()):
+            c = NetDeviceContainer(
+                self._right_router_devices.Get(i), self._right_leaf_devices.Get(i)
+            )
+            ifc = right_ip.Assign(c)
+            self._right_interfaces.append(ifc.GetAddress(1))
+            right_ip.NewNetwork()

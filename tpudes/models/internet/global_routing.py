@@ -1,0 +1,207 @@
+"""Global unicast routing: link-state SPF over the whole topology.
+
+Reference parity: src/internet/model/global-route-manager{,-impl}.{h,cc},
+ipv4-global-routing.{h,cc}, helper/ipv4-global-routing-helper.{h,cc}
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.7 routing row).
+Upstream exports every node as an OSPF-style LSA, runs one SPF per node,
+and pushes host/network routes into each node's Ipv4GlobalRouting table.
+
+TPU-native redesign: the LSDB here is one shared :class:`GlobalRouteManager`
+graph (nodes = vertices, channel adjacencies = edges, interface ``Metric``
+= cost) and the per-node table is *virtual* — each node's
+:class:`Ipv4GlobalRouting` resolves next hops from a lazily computed,
+cached shortest-path tree (Dijkstra per *source actually routing*, not
+per node).  A 10k-node AS graph "populates" in milliseconds because
+nothing is materialized until a packet leaves a node; sparse-traffic
+scenarios (BASELINE config #5) touch a handful of SPTs.  Equal-cost
+ties break on lower next-hop node id (upstream: first-added LSA),
+deterministically.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+from tpudes.core.object import Object, TypeId
+from tpudes.models.internet.ipv4 import (
+    Ipv4L3Protocol,
+    Ipv4Route,
+    Ipv4RoutingProtocol,
+)
+from tpudes.network.address import Ipv4Address
+
+
+class GlobalRouteManager:
+    """The shared link-state database + SPT cache (one per world)."""
+
+    _instance = None
+
+    def __init__(self):
+        # node id -> list of (peer_node_id, cost, if_index, peer_addr)
+        self.adjacency: dict[int, list[tuple[int, int, int, Ipv4Address]]] = {}
+        # destination ip (int) -> node id owning it
+        self.addr_to_node: dict[int, int] = {}
+        # source node id -> {dst node id: (if_index, gateway | None)}
+        self._spt_cache: dict[int, dict[int, tuple[int, Ipv4Address | None]]] = {}
+        self._built = False
+
+    @classmethod
+    def Get(cls) -> "GlobalRouteManager":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    @classmethod
+    def Reset(cls) -> None:
+        cls._instance = None
+
+    # --- database construction (BuildGlobalRoutingDatabase analog) -------
+    def Build(self) -> None:
+        from tpudes.network.node import NodeList
+
+        self.adjacency.clear()
+        self.addr_to_node.clear()
+        self._spt_cache.clear()
+        # device -> (node id, if_index, first address) over all stacks
+        dev_owner: dict[int, tuple[int, int, Ipv4Address]] = {}
+        stacks = []
+        for nid in range(NodeList.GetNNodes()):
+            node = NodeList.GetNode(nid)
+            ipv4 = node.GetObject(Ipv4L3Protocol)
+            if ipv4 is None:
+                continue
+            stacks.append((node.GetId(), ipv4))
+            for i, iface in enumerate(ipv4.interfaces):
+                if iface.device is None or not iface.IsUp():
+                    continue  # loopback / down
+                for a in iface.addresses:
+                    self.addr_to_node.setdefault(a.GetLocal().addr, node.GetId())
+                if iface.GetNAddresses():
+                    dev_owner[id(iface.device)] = (
+                        node.GetId(), i, iface.GetAddress(0).GetLocal()
+                    )
+        for nid, ipv4 in stacks:
+            adj = self.adjacency.setdefault(nid, [])
+            for i, iface in enumerate(ipv4.interfaces):
+                dev = iface.device
+                if dev is None or not iface.IsUp() or not iface.GetNAddresses():
+                    continue
+                channel = dev.GetChannel()
+                if channel is None:
+                    continue
+                cost = int(iface.GetAttribute("Metric"))
+                for d in range(channel.GetNDevices()):
+                    peer = channel.GetDevice(d)
+                    if peer is dev:
+                        continue
+                    owner = dev_owner.get(id(peer))
+                    if owner is None:
+                        continue  # peer has no stack/address — not routable
+                    peer_nid, _peer_if, peer_addr = owner
+                    adj.append((peer_nid, cost, i, peer_addr))
+        self._built = True
+
+    # --- SPF (one source, lazily; upstream SPFCalculate analog) ----------
+    def _spt(self, src: int) -> dict[int, tuple[int, Ipv4Address | None]]:
+        hit = self._spt_cache.get(src)
+        if hit is not None:
+            return hit
+        dist: dict[int, int] = {src: 0}
+        # dst node -> (if_index at src, gateway addr) of the FIRST hop
+        first: dict[int, tuple[int, Ipv4Address | None]] = {}
+        # heap entries carry the first-hop decision so it propagates; seq
+        # makes ties deterministic (insertion order — adjacency order is
+        # itself deterministic) and keeps the hop tuple out of comparisons
+        pq: list[tuple] = [(0, src, 0, src, None)]
+        seq = 1
+        seen: set[int] = set()
+        while pq:
+            d, _tie, _seq, u, hop = heapq.heappop(pq)
+            if u in seen:
+                continue
+            seen.add(u)
+            if hop is not None:
+                first[u] = hop
+            for peer, cost, if_index, peer_addr in self.adjacency.get(u, ()):
+                nd = d + cost
+                if peer not in dist or nd < dist[peer]:
+                    dist[peer] = nd
+                    nhop = hop if hop is not None else (if_index, peer_addr)
+                    heapq.heappush(pq, (nd, peer, seq, peer, nhop))
+                    seq += 1
+        self._spt_cache[src] = first
+        return first
+
+    def NextHop(self, src_node: int, dst_addr: Ipv4Address):
+        """-> (if_index, gateway | None) at ``src_node`` toward the node
+        owning ``dst_addr``, or None when unreachable/unknown."""
+        if not self._built:
+            return None
+        dst_node = self.addr_to_node.get(dst_addr.addr)
+        if dst_node is None:
+            return None
+        if dst_node == src_node:
+            return None  # local delivery, not ours to route
+        return self._spt(src_node).get(dst_node)
+
+
+class Ipv4GlobalRouting(Ipv4RoutingProtocol):
+    """Per-node face of the shared SPF database
+    (src/internet/model/ipv4-global-routing.{h,cc}).  Connected subnets
+    are matched directly (upstream: the stub LSA's own links); everything
+    else asks the GlobalRouteManager for the SPT next hop."""
+
+    tid = (
+        TypeId("tpudes::Ipv4GlobalRouting")
+        .SetParent(Ipv4RoutingProtocol.tid)
+        .AddConstructor(lambda **kw: Ipv4GlobalRouting(**kw))
+    )
+
+    def _connected(self, dest: Ipv4Address):
+        for i, iface in enumerate(self.ipv4.interfaces):
+            if iface.device is None or not iface.IsUp():
+                continue
+            for a in iface.addresses:
+                if a.GetMask().IsMatch(dest, a.GetLocal()):
+                    return i
+        return None
+
+    def RouteOutput(self, packet, header, oif=None):
+        dest = header.destination
+        if_index, gateway = None, None
+        i = self._connected(dest)
+        if i is not None:
+            if_index = i
+        else:
+            hop = GlobalRouteManager.Get().NextHop(
+                self.ipv4.GetNode().GetId(), dest
+            )
+            if hop is None:
+                return None, 10  # ERROR_NOROUTETOHOST
+            if_index, gateway = hop
+        iface = self.ipv4.GetInterface(if_index)
+        route = Ipv4Route(
+            destination=dest,
+            source=self.ipv4.SelectSourceAddress(if_index),
+            gateway=gateway,
+            output_device=iface.device,
+        )
+        route.if_index = if_index
+        return route, 0
+
+
+class Ipv4GlobalRoutingHelper:
+    """helper/ipv4-global-routing-helper.{h,cc}: hand to
+    InternetStackHelper.SetRoutingHelper, then PopulateRoutingTables()
+    once the topology and addresses exist."""
+
+    def Create(self, node) -> Ipv4GlobalRouting:
+        return Ipv4GlobalRouting()
+
+    @staticmethod
+    def PopulateRoutingTables() -> None:
+        GlobalRouteManager.Get().Build()
+
+    @staticmethod
+    def RecomputeRoutingTables() -> None:
+        GlobalRouteManager.Get().Build()

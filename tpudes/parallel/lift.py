@@ -112,8 +112,23 @@ def _discover_lte_sm(sim_end_s: float):
     return "lte_sm", prog, commit
 
 
+def _discover_dumbbell(sim_end_s: float):
+    """Find a TCP dumbbell (bulk flows over one router-router
+    bottleneck) and lower it to the packet-slot program."""
+    from tpudes.parallel.tcp_dumbbell import (
+        UnliftableDumbbellError,
+        lower_dumbbell,
+    )
+
+    try:
+        prog = lower_dumbbell(sim_end_s)
+    except UnliftableDumbbellError as e:
+        raise UnliftableScenarioError(str(e)) from e
+    return "dumbbell", prog, lambda: None
+
+
 #: discovery order: most specific first
-LOWERINGS = [_discover_lte_sm, _discover_bss]
+LOWERINGS = [_discover_lte_sm, _discover_dumbbell, _discover_bss]
 
 
 def lift(sim_end_s: float):
@@ -161,4 +176,8 @@ def run_lifted(kind: str, prog, replicas: int, key=None, mesh=None):
         from tpudes.parallel.lte_sm import run_lte_sm
 
         return run_lte_sm(prog, key, replicas=replicas, mesh=mesh)
+    if kind == "dumbbell":
+        from tpudes.parallel.tcp_dumbbell import run_tcp_dumbbell
+
+        return run_tcp_dumbbell(prog, key, replicas=replicas, mesh=mesh)
     raise ValueError(f"unknown lifted program kind {kind!r}")

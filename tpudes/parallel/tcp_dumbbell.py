@@ -1,0 +1,534 @@
+"""Replica-axis execution of the TCP dumbbell (BASELINE config #2).
+
+Lowers a dumbbell object graph — N left leaves bulk-sending TCP through
+one bottleneck toward N right leaves (tcp-variants-comparison's shape;
+SURVEY.md §2.7/§2.9) — to a device-resident **packet-slot** program: one
+``lax.scan`` step per bottleneck serialization time τ (= pkt_bytes·8/C),
+per-replica per-flow state in (R, F) arrays, all six TcpCongestionOps
+variants evaluated as masked vector rules in one fused step.
+
+The slot model (each deviation documented, mirrored on replicated.py's
+timing-model contract):
+- the bottleneck serves exactly one packet per slot when backlogged
+  (work-conserving FIFO); *which* flow's head departs is drawn with
+  probability proportional to per-flow queue occupancy — FIFO in
+  expectation, not in exact order.
+- the access links are required to be faster than the bottleneck (the
+  lowering rejects otherwise); their delay folds into the base RTT and
+  their serialization into a per-slot send-burst cap.
+- ACKs ride the uncongested reverse path: ack arrival = departure slot
+  + base-lag slots; reverse-direction queueing is not modeled.
+- loss detection is dupack-timed: a tail-dropped packet triggers one
+  window reduction per RTT (NewReno-style recovery window
+  ``recover_until``); every lost packet individually leaves the flight
+  so the ACK clock never stalls.  RTO timeouts are not modeled (with a
+  clocked recovery window they are unreachable for backlogged flows).
+- RTT samples (Vegas/Veno) are base_rtt + queue_wait with queue_wait
+  approximated by the instantaneous backlog at departure.
+
+The scalar DES (real TcpSocketBase over PointToPointNetDevice) stays
+the per-packet oracle; tests assert statistical parity of per-variant
+goodput, not per-packet equality.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# variant ids (order is the vector-rule dispatch table)
+VARIANTS = ("TcpNewReno", "TcpCubic", "TcpScalable", "TcpHighSpeed",
+            "TcpVegas", "TcpVeno")
+V_NEWRENO, V_CUBIC, V_SCALABLE, V_HIGHSPEED, V_VEGAS, V_VENO = range(6)
+
+INIT_CWND = 10.0          # segments (tcp_congestion.TcpSocketState default)
+SSTHRESH0 = 1e9
+CUBIC_C = 0.4
+CUBIC_BETA = 0.7
+SCALABLE_AI = 50.0
+SCALABLE_MD = 0.125
+HS_LOW_WINDOW = 38.0
+VEGAS_ALPHA, VEGAS_BETA, VEGAS_GAMMA = 2.0, 4.0, 1.0
+VENO_BETA = 3.0
+
+
+@dataclass(frozen=True)
+class DumbbellProgram:
+    """Static description of one dumbbell scenario on the replica axis."""
+
+    n_flows: int
+    variant_idx: np.ndarray      # (F,) index into VARIANTS
+    start_slot: np.ndarray       # (F,) first slot each flow may send
+    stop_slot: np.ndarray        # (F,) no new packets at/after this slot
+    max_pkts: np.ndarray         # (F,) segment budget (INT32_MAX = unlimited)
+    slot_s: float                # τ: bottleneck serialization time
+    n_slots: int                 # simulation horizon in slots
+    ack_lag: int                 # slots from departure to ack arrival
+    queue_cap: int               # bottleneck queue capacity (packets)
+    burst_cap: int               # per-flow packets enqueueable per slot
+    base_rtt_s: float            # unloaded RTT (for Vegas/Veno diff)
+    seg_bytes: int               # application payload per packet
+
+    @property
+    def buf_len(self) -> int:
+        return self.ack_lag + 2
+
+
+class UnliftableDumbbellError(ValueError):
+    """The object graph is not a dumbbell this lowering can faithfully
+    represent; callers fall back to the scalar DES."""
+
+
+def lower_dumbbell(sim_end_s: float) -> DumbbellProgram:
+    """Lower the live object graph (NodeList) to a DumbbellProgram.
+
+    Discovers the bottleneck as the unique p2p link whose BOTH endpoint
+    nodes forward (≥3 interfaces, no applications); flows are
+    BulkSendApplications on leaf nodes whose sink lives across the
+    bottleneck.  Rejects shapes the slot model cannot represent.
+    """
+    from tpudes.models.applications import BulkSendApplication, PacketSink
+    from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+    from tpudes.models.internet.tcp import TcpL4Protocol
+    from tpudes.models.p2p import PointToPointNetDevice
+    from tpudes.network.node import NodeList
+
+    nodes = [NodeList.GetNode(i) for i in range(NodeList.GetNNodes())]
+
+    def n_ifaces(node):
+        ipv4 = node.GetObject(Ipv4L3Protocol)
+        return len(ipv4.interfaces) - 1 if ipv4 else 0  # minus loopback
+
+    routers = [n for n in nodes if n_ifaces(n) >= 3 and n.GetNApplications() == 0]
+    candidates = []
+    for n in routers:
+        for d in range(n.GetNDevices()):
+            dev = n.GetDevice(d)
+            if not isinstance(dev, PointToPointNetDevice):
+                continue
+            ch = dev.GetChannel()
+            peer = ch.GetPeer(dev)
+            if peer.GetNode() in routers and peer.GetNode() is not n:
+                candidates.append((dev, peer, ch))
+    # each link appears once from each endpoint; a true dumbbell has
+    # exactly one router-router link
+    links = {id(c[2]) for c in candidates}
+    if not candidates:
+        raise UnliftableDumbbellError("no router-router bottleneck link found")
+    if len(links) > 1:
+        raise UnliftableDumbbellError(
+            f"{len(links)} router-router links (multi-path topology); the "
+            "slot model represents exactly one bottleneck"
+        )
+    bdev, bpeer, bchan = candidates[0]
+    left_router, right_router = bdev.GetNode(), bpeer.GetNode()
+    bn_rate = float(bdev.data_rate.GetBitRate())
+    bn_delay_s = bchan.GetDelay().GetSeconds()
+    qs = bdev.GetQueue().max_size
+    if qs.mode != qs.PACKETS:
+        raise UnliftableDumbbellError(
+            "slot model counts queue capacity in packets (byte-mode queue)"
+        )
+    queue_cap = int(qs.value)
+
+    # sinks by (address, port) so each bulk app can be paired
+    sinks = {}
+    for node in nodes:
+        for a in range(node.GetNApplications()):
+            app = node.GetApplication(a)
+            if isinstance(app, PacketSink):
+                port = app.local.GetPort()
+                ipv4 = node.GetObject(Ipv4L3Protocol)
+                for iface in ipv4.interfaces[1:]:
+                    for addr in iface.addresses:
+                        sinks[(addr.GetLocal().addr, port)] = node
+
+    def access_router(leaf):
+        """The router a leaf's single access link attaches to."""
+        acc = leaf.GetDevice(0)
+        if not isinstance(acc, PointToPointNetDevice):
+            raise UnliftableDumbbellError("leaf access link is not p2p")
+        return acc.GetChannel().GetPeer(acc).GetNode()
+
+    flows, variants, starts, stops, budgets = [], [], [], [], []
+    seg_sizes, access_rates, access_delays = set(), set(), []
+    directions: set[bool] = set()
+    for node in nodes:
+        for a in range(node.GetNApplications()):
+            app = node.GetApplication(a)
+            if not isinstance(app, BulkSendApplication):
+                continue
+            dst = app.remote  # InetSocketAddress
+            sink_node = sinks.get((dst.GetIpv4().addr, dst.GetPort()))
+            if sink_node is None:
+                raise UnliftableDumbbellError(
+                    f"bulk sender on node {node.GetId()} has no matching sink"
+                )
+            if n_ifaces(node) != 1 or n_ifaces(sink_node) != 1:
+                raise UnliftableDumbbellError(
+                    "bulk flows must run leaf-to-leaf (one access interface)"
+                )
+            # every flow must cross the bottleneck, all in the SAME
+            # direction: a same-side flow never touches the modeled
+            # queue, and opposing flows queue on the two different link
+            # directions — both would be silent mis-lowerings
+            src_r, dst_r = access_router(node), access_router(sink_node)
+            if {src_r, dst_r} != {left_router, right_router}:
+                raise UnliftableDumbbellError(
+                    f"flow node{node.GetId()}→node{sink_node.GetId()} does "
+                    "not cross the bottleneck; the slot model represents "
+                    "one shared queue"
+                )
+            directions.add(src_r is left_router)
+            acc = node.GetDevice(0)
+            access_rates.add(float(acc.data_rate.GetBitRate()))
+            access_delays.append(acc.GetChannel().GetDelay().GetSeconds())
+            sink_acc = sink_node.GetDevice(0)
+            access_delays.append(sink_acc.GetChannel().GetDelay().GetSeconds())
+            tcp = node.GetObject(TcpL4Protocol)
+            vname = tcp.GetAttribute("SocketType") if tcp else "TcpNewReno"
+            if vname not in VARIANTS:
+                raise UnliftableDumbbellError(f"unknown TCP variant {vname}")
+            seg_sizes.add(int(app.send_size))
+            flows.append(app)
+            variants.append(VARIANTS.index(vname))
+            starts.append(app.start_time.GetSeconds())
+            stops.append(
+                app.stop_time.GetSeconds()
+                if app.stop_time.GetTimeStep() > 0
+                else sim_end_s
+            )
+            budgets.append(int(app.max_bytes) if app.max_bytes else 0)
+    if not flows:
+        raise UnliftableDumbbellError("no TCP bulk flows found")
+    if len(directions) > 1:
+        raise UnliftableDumbbellError(
+            "flows cross the bottleneck in both directions; the slot "
+            "model represents one direction of one shared queue"
+        )
+    if len(seg_sizes) > 1:
+        raise UnliftableDumbbellError(
+            f"flows must share one SendSize — the slot is one on-wire "
+            f"packet time (got {sorted(seg_sizes)})"
+        )
+    if len(access_rates) != 1:
+        raise UnliftableDumbbellError(
+            f"access links must share one rate (got {sorted(access_rates)})"
+        )
+    access_rate = access_rates.pop()
+    if access_rate <= bn_rate:
+        raise UnliftableDumbbellError(
+            "access links must be faster than the bottleneck for the "
+            "slot model (queueing would form at the leaves)"
+        )
+    seg = max(seg_sizes) if seg_sizes else 536
+    pkt_bits = (seg + 40) * 8  # +IPv4/TCP headers on the wire
+    slot_s = pkt_bits / bn_rate
+    acc_d = float(np.mean(access_delays)) if access_delays else 0.0
+    # after leaving the queue: prop + far access (data), then the ack's
+    # reverse trip (access + bottleneck prop + access)
+    ack_lag_s = 2.0 * bn_delay_s + 4.0 * acc_d
+    base_rtt_s = ack_lag_s + slot_s
+    return DumbbellProgram(
+        n_flows=len(flows),
+        variant_idx=np.asarray(variants, np.int32),
+        start_slot=np.asarray(
+            [int(s / slot_s) for s in starts], np.int32
+        ),
+        stop_slot=np.asarray(
+            [int(min(s, sim_end_s) / slot_s) for s in stops], np.int32
+        ),
+        max_pkts=np.asarray(
+            [(b + seg - 1) // seg if b else 2**31 - 1 for b in budgets],
+            np.int32,
+        ),
+        slot_s=slot_s,
+        n_slots=int(math.ceil(sim_end_s / slot_s)),
+        ack_lag=max(1, int(round(ack_lag_s / slot_s))),
+        queue_cap=queue_cap,
+        burst_cap=max(1, int(access_rate / bn_rate)),
+        base_rtt_s=base_rtt_s,
+        seg_bytes=seg,
+    )
+
+
+def _cwnd_increase(var, cwnd, ssthresh, acked, t_s, rtt_s, st):
+    """Vectorized per-ack cwnd growth for all six variants (segments).
+
+    ``st`` carries the variant side-state dict; returns (new_cwnd, st').
+    Masked-dense: every rule computes, the variant index selects.
+    """
+    w = jnp.maximum(cwnd, 1.0)
+    a = acked.astype(jnp.float32)
+    in_ss = cwnd < ssthresh
+
+    # --- congestion avoidance rules (per ack batch) ---------------------
+    inc_reno = a / w
+    inc_scal = a / jnp.minimum(w, SCALABLE_AI)
+    a_hs = jnp.where(
+        w <= HS_LOW_WINDOW, 1.0, jnp.maximum(1.0, 0.156 * w**0.8 / 2.0)
+    )
+    inc_hs = a_hs * a / w
+
+    # cubic: (re)open an epoch on first CA ack after loss
+    fresh = (st["epoch_t"] < 0.0) & (a > 0) & ~in_ss
+    k = jnp.where(
+        st["w_max"] > w,
+        jnp.cbrt(jnp.maximum(st["w_max"] - w, 0.0) / CUBIC_C),
+        0.0,
+    )
+    origin = jnp.maximum(st["w_max"], w)
+    epoch_t = jnp.where(fresh, t_s, st["epoch_t"])
+    k = jnp.where(fresh, k, st["k"])
+    origin = jnp.where(fresh, origin, st["origin"])
+    w_est = jnp.where(fresh, w, st["w_est"])
+    te = t_s - epoch_t + rtt_s
+    target = origin + CUBIC_C * (te - k) ** 3
+    w_est = w_est + 3.0 * (1 - CUBIC_BETA) / (1 + CUBIC_BETA) * a / w
+    target = jnp.maximum(target, w_est)
+    inc_cubic = jnp.clip((target - w) / w, 0.0, 0.5) * a
+
+    # vegas / veno backlog estimate from the shared rtt sample
+    diff = w * (1.0 - st["base_rtt"] / jnp.maximum(rtt_s, st["base_rtt"]))
+    inc_vegas = jnp.where(
+        diff < VEGAS_ALPHA, a / w, jnp.where(diff > VEGAS_BETA, -a / w, 0.0)
+    )
+    inc_veno = jnp.where(diff < VENO_BETA, inc_reno, 0.5 * inc_reno)
+
+    inc_ca = jnp.select(
+        [var == V_NEWRENO, var == V_CUBIC, var == V_SCALABLE,
+         var == V_HIGHSPEED, var == V_VEGAS, var == V_VENO],
+        [inc_reno, inc_cubic, inc_scal, inc_hs, inc_vegas, inc_veno],
+    )
+    # slow start: +1 per ack; Vegas leaves SS once the backlog passes γ
+    vegas_exit = (var == V_VEGAS) & in_ss & (diff > VEGAS_GAMMA) & (a > 0)
+    ssthresh = jnp.where(vegas_exit, jnp.maximum(w - 1.0, 2.0), ssthresh)
+    inc = jnp.where(in_ss & ~vegas_exit, a, inc_ca)
+    new_cwnd = jnp.maximum(cwnd + jnp.where(a > 0, inc, 0.0), 2.0)
+    st = dict(st, epoch_t=epoch_t, k=k, origin=origin, w_est=w_est,
+              last_diff=jnp.where(a > 0, diff, st["last_diff"]))
+    return new_cwnd, ssthresh, st
+
+
+def _loss_response(var, cwnd, st):
+    """Vectorized GetSsThresh on a detected loss (segments)."""
+    w = jnp.maximum(cwnd, 1.0)
+    ss_reno = w / 2.0
+    # cubic fast convergence: remember a reduced w_max when still climbing
+    new_wmax = jnp.where(
+        w < st["w_max"], w * (1.0 + CUBIC_BETA) / 2.0, w
+    )
+    ss_cubic = w * CUBIC_BETA
+    ss_scal = w * (1.0 - SCALABLE_MD)
+    b_hs = jnp.where(
+        w <= HS_LOW_WINDOW,
+        0.5,
+        jnp.maximum(
+            0.5
+            - 0.4
+            * (jnp.log(w) - math.log(HS_LOW_WINDOW))
+            / (math.log(83000.0) - math.log(HS_LOW_WINDOW)),
+            0.1,
+        ),
+    )
+    ss_hs = w * (1.0 - b_hs)
+    ss_veno = jnp.where(st["last_diff"] < VENO_BETA, w * 0.8, w * 0.5)
+    ssthresh = jnp.select(
+        [var == V_NEWRENO, var == V_CUBIC, var == V_SCALABLE,
+         var == V_HIGHSPEED, var == V_VEGAS, var == V_VENO],
+        [ss_reno, ss_cubic, ss_scal, ss_hs, ss_reno, ss_veno],
+    )
+    ssthresh = jnp.maximum(ssthresh, 2.0)
+    st = dict(
+        st,
+        w_max=jnp.where(var == V_CUBIC, new_wmax, st["w_max"]),
+        epoch_t=jnp.full_like(st["epoch_t"], -1.0),
+    )
+    return ssthresh, st
+
+
+def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
+    """Return (init_state, step_fn) for the slot-stepped scan."""
+    R, F, L = replicas, prog.n_flows, prog.buf_len
+    var = jnp.asarray(prog.variant_idx)
+    start = jnp.asarray(prog.start_slot)
+    stop = jnp.asarray(prog.stop_slot)
+    max_pkts = jnp.asarray(prog.max_pkts)
+    slot_s = prog.slot_s
+    base_rtt = jnp.float32(prog.base_rtt_s)
+    rtt_slots = max(1, int(round(prog.base_rtt_s / slot_s)))
+    Q = prog.queue_cap
+    burst = prog.burst_cap
+
+    def init_state():
+        z = lambda *sh, dt=jnp.float32: jnp.zeros(sh, dt)  # noqa: E731
+        return dict(
+            cwnd=jnp.full((R, F), INIT_CWND, jnp.float32),
+            ssthresh=jnp.full((R, F), SSTHRESH0, jnp.float32),
+            inflight=z(R, F, dt=jnp.int32),
+            q=z(R, F, dt=jnp.int32),
+            delivered=z(R, F, dt=jnp.int32),
+            drops=z(R, F, dt=jnp.int32),
+            recover_until=z(R, F, dt=jnp.int32),
+            ack_buf=z(R, L, F, dt=jnp.int32),
+            loss_buf=z(R, L, F, dt=jnp.int32),
+            rtt_buf=jnp.full((R, L), prog.base_rtt_s, jnp.float32),
+            qsum=z(R),
+            side=dict(
+                w_max=z(R, F), epoch_t=jnp.full((R, F), -1.0), k=z(R, F),
+                origin=z(R, F), w_est=z(R, F),
+                base_rtt=jnp.broadcast_to(base_rtt, (R, F)),
+                last_diff=z(R, F),
+            ),
+        )
+
+    def step_fn(s, inp):
+        t, key = inp
+        idx = t % L
+
+        # 1. consume this slot's ack / loss arrivals
+        acks = s["ack_buf"][:, idx, :]
+        losses = s["loss_buf"][:, idx, :]
+        rtt = s["rtt_buf"][:, idx][:, None]
+        ack_buf = s["ack_buf"].at[:, idx, :].set(0)
+        loss_buf = s["loss_buf"].at[:, idx, :].set(0)
+        inflight = s["inflight"] - acks - losses
+
+        in_recovery = t < s["recover_until"]
+        cwnd, ssthresh, side = _cwnd_increase(
+            var[None, :], s["cwnd"], s["ssthresh"],
+            jnp.where(in_recovery, 0, acks), t * slot_s, rtt, s["side"],
+        )
+        # 2. one reduction per recovery window on detected loss
+        reduce = (losses > 0) & ~in_recovery
+        ss_loss, side_loss = _loss_response(var[None, :], cwnd, side)
+        ssthresh = jnp.where(reduce, ss_loss, ssthresh)
+        cwnd = jnp.where(reduce, ssthresh, cwnd)
+        side = {
+            k: jnp.where(reduce, side_loss[k], side[k]) for k in side
+        }
+        recover_until = jnp.where(
+            reduce, t + rtt_slots, s["recover_until"]
+        )
+
+        # 3. departure: serve one packet, flow ∝ queue occupancy
+        q = s["q"]
+        qtot = q.sum(axis=1)
+        backlogged = qtot > 0
+        u = jax.random.uniform(key, (R,))
+        cum = jnp.cumsum(q, axis=1)
+        thresh = (u * qtot.astype(jnp.float32)).astype(jnp.int32)
+        dep = jnp.argmax(cum > thresh[:, None], axis=1)  # (R,)
+        dep_oh = jax.nn.one_hot(dep, F, dtype=jnp.int32) * backlogged[
+            :, None
+        ].astype(jnp.int32)
+        q = q - dep_oh
+        delivered = s["delivered"] + dep_oh
+        aidx = (t + prog.ack_lag) % L
+        ack_buf = ack_buf.at[:, aidx, :].add(dep_oh)
+        rtt_buf = s["rtt_buf"].at[:, aidx].set(
+            prog.base_rtt_s + qtot.astype(jnp.float32) * slot_s
+        )
+
+        # 4. window-driven arrivals, tail-drop past capacity
+        want = jnp.clip(
+            cwnd.astype(jnp.int32) - inflight, 0, burst
+        )
+        live = (t >= start[None, :]) & (t < stop[None, :]) & (
+            delivered + inflight < max_pkts[None, :]
+        )
+        want = jnp.where(live, want, 0)
+        wtot = want.sum(axis=1)
+        free = jnp.maximum(Q - q.sum(axis=1), 0)
+        # proportional admission with largest-remainder rounding
+        scale = jnp.minimum(
+            free.astype(jnp.float32) / jnp.maximum(wtot, 1).astype(jnp.float32),
+            1.0,
+        )
+        exact = want.astype(jnp.float32) * scale[:, None]
+        acc = jnp.floor(exact).astype(jnp.int32)
+        rem = exact - acc
+        leftover = jnp.minimum(free - acc.sum(axis=1), wtot - acc.sum(axis=1))
+        order = jnp.argsort(-rem, axis=1)
+        rank = jnp.argsort(order, axis=1)
+        acc = acc + (
+            (rank < leftover[:, None]) & (acc < want)
+        ).astype(jnp.int32)
+        acc = jnp.minimum(acc, want)
+        rej = want - acc
+        q = q + acc
+        inflight = inflight + want
+        drops = s["drops"] + rej
+        lidx = (t + prog.ack_lag) % L  # dupack-timed detection
+        loss_buf = loss_buf.at[:, lidx, :].add(rej)
+
+        return dict(
+            cwnd=cwnd, ssthresh=ssthresh, inflight=inflight, q=q,
+            delivered=delivered, drops=drops, recover_until=recover_until,
+            ack_buf=ack_buf, loss_buf=loss_buf, rtt_buf=rtt_buf,
+            qsum=s["qsum"] + qtot.astype(jnp.float32),
+            side=side,
+        ), None
+
+    return init_state, step_fn
+
+
+_RUNNER_CACHE: dict = {}
+
+
+def run_tcp_dumbbell(prog: DumbbellProgram, key, replicas: int, mesh=None):
+    """Execute R replicas of the dumbbell program; returns per-replica
+    outcome arrays: goodput_mbps (R,F), delivered (R,F), drops (R,F),
+    mean_queue (R,), cwnd_final (R,F)."""
+    ck = (
+        tuple(prog.variant_idx.tolist()), tuple(prog.start_slot.tolist()),
+        tuple(prog.stop_slot.tolist()),
+        tuple(prog.max_pkts.tolist()), prog.slot_s, prog.n_slots,
+        prog.ack_lag, prog.queue_cap, prog.burst_cap, prog.base_rtt_s,
+        prog.seg_bytes, replicas,
+    )
+    hit = _RUNNER_CACHE.get(ck)
+    if hit is None:
+        init_state, step_fn = build_dumbbell_step(prog, replicas)
+
+        @jax.jit
+        def run(s0, key):
+            keys = jax.random.split(key, prog.n_slots)
+            ts = jnp.arange(prog.n_slots, dtype=jnp.int32)
+            out, _ = jax.lax.scan(step_fn, s0, (ts, keys))
+            return out
+
+        _RUNNER_CACHE[ck] = (init_state, run)
+        if len(_RUNNER_CACHE) > 32:
+            _RUNNER_CACHE.pop(next(iter(_RUNNER_CACHE)))
+        hit = _RUNNER_CACHE[ck]
+    init_state, run = hit
+
+    s0 = init_state()
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def shard(v):
+            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == replicas:
+                spec = P("replica", *([None] * (v.ndim - 1)))
+                return jax.device_put(v, NamedSharding(mesh, spec))
+            return v
+
+        s0 = jax.tree_util.tree_map(shard, s0)
+    out = run(s0, key)
+    sim_s = prog.n_slots * prog.slot_s
+    goodput = (
+        out["delivered"].astype(jnp.float32) * prog.seg_bytes * 8.0
+        / sim_s / 1e6
+    )
+    return dict(
+        goodput_mbps=goodput,
+        delivered=out["delivered"],
+        drops=out["drops"],
+        mean_queue=out["qsum"] / prog.n_slots,
+        cwnd_final=out["cwnd"],
+    )

@@ -127,6 +127,74 @@ def build_bss(
     return sta_devices, ap_devices.Get(0), clients, server_rx
 
 
+def build_dumbbell(
+    n_flows: int,
+    sim_time: float,
+    variant: str = "TcpNewReno",
+    bottleneck_rate: str = "10Mbps",
+    bottleneck_delay: str = "10ms",
+    access_rate: str = "100Mbps",
+    access_delay: str = "1ms",
+    queue: str = "100p",
+    seg_bytes: int = 1000,
+    variants: "list[str] | None" = None,
+):
+    """BASELINE config #2: ``n_flows`` TCP bulk flows left→right across
+    one bottleneck (the tcp-variants-comparison shape).  ``variants``
+    overrides ``variant`` per flow.  Returns ``(dumbbell, sinks)``."""
+    from tpudes.core import Seconds
+    from tpudes.helper.applications import BulkSendHelper, PacketSinkHelper
+    from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+    from tpudes.helper.layout import PointToPointDumbbellHelper
+    from tpudes.helper.point_to_point import PointToPointHelper
+    from tpudes.models.internet.global_routing import Ipv4GlobalRoutingHelper
+    from tpudes.models.internet.tcp import TcpL4Protocol
+    from tpudes.network.address import InetSocketAddress, Ipv4Address
+
+    leaf = PointToPointHelper()
+    leaf.SetDeviceAttribute("DataRate", access_rate)
+    leaf.SetChannelAttribute("Delay", access_delay)
+    bott = PointToPointHelper()
+    bott.SetDeviceAttribute("DataRate", bottleneck_rate)
+    bott.SetChannelAttribute("Delay", bottleneck_delay)
+    bott.SetQueue("tpudes::DropTailQueue", MaxSize=queue)
+    db = PointToPointDumbbellHelper(n_flows, leaf, n_flows, leaf, bott)
+    stack = InternetStackHelper()
+    stack.SetRoutingHelper(Ipv4GlobalRoutingHelper())
+    db.InstallStack(stack)
+    db.AssignIpv4Addresses(
+        Ipv4AddressHelper("10.1.0.0", "255.255.255.0"),
+        Ipv4AddressHelper("10.2.0.0", "255.255.255.0"),
+        Ipv4AddressHelper("10.3.0.0", "255.255.255.0"),
+    )
+    Ipv4GlobalRoutingHelper.PopulateRoutingTables()
+
+    per_flow = variants if variants is not None else [variant] * n_flows
+    sinks = []
+    for i in range(n_flows):
+        db.GetLeft(i).GetObject(TcpL4Protocol).SetAttribute(
+            "SocketType", per_flow[i]
+        )
+        sink = PacketSinkHelper(
+            "tpudes::TcpSocketFactory",
+            InetSocketAddress(Ipv4Address.GetAny(), 5000 + i),
+        )
+        sapps = sink.Install(db.GetRight(i))
+        sapps.Start(Seconds(0.0))
+        bulk = BulkSendHelper(
+            "tpudes::TcpSocketFactory",
+            InetSocketAddress(
+                Ipv4Address(str(db.GetRightIpv4Address(i))), 5000 + i
+            ),
+        )
+        bulk.SetAttribute("SendSize", seg_bytes)
+        bapps = bulk.Install(db.GetLeft(i))
+        bapps.Start(Seconds(0.1 + 0.01 * i))
+        bapps.Stop(Seconds(sim_time))
+        sinks.append(sapps.Get(0))
+    return db, sinks
+
+
 def build_lena(
     n_enbs: int,
     ues_per_cell: int,
