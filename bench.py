@@ -8,7 +8,7 @@ Both sides are *scenario-level* sim-seconds per wall-second; the ratio
 is the engine speedup the north star asks for (BASELINE.json: "one
 GlobalValue flag flips a stock scenario onto the TPU").
 
-Three scenarios:
+Four scenarios:
   - BSS (BASELINE config #3): 64-STA infrastructure WiFi, UDP echo,
     512 Monte-Carlo replicas at once (the headline metric).
   - LTE (BASELINE config #4): 7 eNB x 210 UE full-buffer hex grid,
@@ -17,6 +17,13 @@ Three scenarios:
   - TCP dumbbell (BASELINE config #2): 8 bulk flows over a 10 Mbps
     bottleneck, 256 replicas of 20 simulated seconds on the packet-slot
     engine vs the host socket stack.
+  - AS topology (BASELINE config #5): BRITE-style BA graph, 10k nodes,
+    128 sparse CBR flows, 1024 replicas on the flow engine vs one host
+    packet-level run of the same scenario.  The flow engine computes the
+    converged steady-state outcome directly (its cost does not scale
+    with simulated seconds), so this line reports **studies/s** — one
+    study = one replica's complete traffic outcome — not sim-s/wall-s;
+    the host side's study is its AS_HOST_S packet-level integration.
 
 Timing protocol: the device side compiles once, then runs N_TIMED=5
 timed repetitions with distinct PRNG keys; the reported value is the
@@ -50,6 +57,11 @@ TCP_FLOWS = 8
 TCP_REPLICAS = 256
 TCP_SIM_S = 20.0
 TCP_HOST_S = 5.0
+AS_NODES = 10_000
+AS_FLOWS = 128
+AS_REPLICAS = 1024
+AS_SIM_S = 10.0
+AS_HOST_S = 2.0
 N_TIMED = 5
 
 
@@ -193,12 +205,57 @@ def bench_tcp():
     )
 
 
+def bench_as():
+    import jax
+
+    from tpudes.core import Seconds, Simulator
+    from tpudes.core.world import reset_world
+    from tpudes.parallel.as_flows import lower_as_flows, run_as_flows
+    from tpudes.scenarios import build_as_network
+
+    reset_world()
+    _, servers = build_as_network(AS_NODES, AS_FLOWS, AS_HOST_S, seed=3)
+    prog = lower_as_flows(AS_SIM_S)
+    # --- denominator: one host packet-level run of the same graph --------
+    t0 = time.monotonic()
+    Simulator.Stop(Seconds(AS_HOST_S))
+    Simulator.Run()
+    host_wall = time.monotonic() - t0
+    host_rx = sum(s.received for s in servers)
+    reset_world()
+    host_studies_per_s = 1.0 / host_wall
+
+    # --- numerator: flow engine, median of N_TIMED ------------------------
+    run_as_flows(prog, jax.random.PRNGKey(0), replicas=AS_REPLICAS)
+    walls, frac = [], 0.0
+    for i in range(N_TIMED):
+        t0 = time.monotonic()
+        out = run_as_flows(
+            prog, jax.random.PRNGKey(1 + i), replicas=AS_REPLICAS
+        )
+        walls.append(time.monotonic() - t0)
+        frac += float(out["delivered_frac"].mean())
+    med = statistics.median(walls)
+    rate = AS_REPLICAS / med
+    return dict(
+        studies_per_s=rate,
+        vs_scalar=rate / host_studies_per_s,
+        wall_median_s=med,
+        wall_min_s=min(walls),
+        wall_max_s=max(walls),
+        scalar_studies_per_s=host_studies_per_s,
+        scalar_rx_pkts=host_rx,
+        delivered_frac=frac / N_TIMED,
+    )
+
+
 def main():
     import jax
 
     wifi = bench_wifi()
     lte = bench_lte()
     tcp = bench_tcp()
+    asn = bench_as()
     r3 = lambda d: {  # noqa: E731
         k: (round(v, 3) if isinstance(v, float) else v) for k, v in d.items()
     }
@@ -214,6 +271,7 @@ def main():
         "wifi": r3(wifi),
         "lte": r3(lte),
         "tcp": r3(tcp),
+        "as": r3(asn),
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
     }

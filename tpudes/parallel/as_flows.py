@@ -1,0 +1,317 @@
+"""Replica-axis execution of Internet-scale sparse traffic (config #5).
+
+The BASELINE #5 workload — a BRITE-style 10k-node AS topology with
+sparse CBR traffic × 1024 Monte-Carlo replicas — lowered TPU-first:
+
+- **SPF on device**: delay-weighted Bellman–Ford as K rounds of
+  edge-parallel scatter-min over a (D, N) distance table (D = distinct
+  destinations).  This replaces the host GlobalRouteManager Dijkstra
+  (tpudes/models/internet/global_routing.py), which stays the oracle.
+- **Next hops** from one more scatter pass (argmin over incident
+  edges), then each flow's path is unrolled with a bounded-hop walk —
+  all (F, H) link indices static across replicas.
+- **Replica axis = traffic uncertainty**: flow endpoints are fixed per
+  run (RngRun-seeded, as upstream's RngRun sweeps); per-replica draws
+  scale each flow's offered rate.  Link loads accumulate by H
+  scatter-adds of the (R, F) rate matrix.
+- **Flow-level (fluid) outcome model**, the documented deviation from
+  the packet oracle: per-link delivery min(1, capacity/load) compounds
+  along the path; queueing delay is M/M/1 ρ/(1-ρ) per transited link.
+  Under the sparse-traffic regime (ρ ≪ 1) this coincides with the
+  packet path — tests pin parity there and on overload direction.
+
+Scalar oracle: the same scenario at reduced n with real UDP sockets +
+Ipv4GlobalRouting (tests/test_as_flows.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INF = jnp.float32(1e30)
+
+
+@dataclass(frozen=True)
+class AsFlowsProgram:
+    """Static device program for one AS-topology traffic study."""
+
+    n: int                      # nodes
+    edges: np.ndarray           # (E, 2) undirected
+    delay_s: np.ndarray         # (E,)
+    rate_bps: np.ndarray        # (E,)
+    src: np.ndarray             # (F,) flow source node
+    dst: np.ndarray             # (F,) flow destination node
+    flow_bps: np.ndarray        # (F,) nominal offered rate
+    pkt_bytes: int
+    sim_s: float
+    max_hops: int = 32          # path-walk bound (≫ BA diameter)
+    spf_rounds: int = 48        # Bellman-Ford rounds (≥ weighted diameter)
+    rate_jitter: float = 0.3    # per-replica lognormal-ish rate spread
+    #: "hops" matches the host Ipv4GlobalRouting (interface Metric = 1);
+    #: "delay" routes on propagation delay instead
+    spf_metric: str = "hops"
+
+
+class UnliftableAsError(ValueError):
+    """Graph/traffic shape the flow engine cannot faithfully represent."""
+
+
+def lower_as_flows(sim_end_s: float) -> AsFlowsProgram:
+    """Lower the live object graph: p2p links → edge arrays, UdpClient
+    CBR apps → flows.  The scalar path stays authoritative for anything
+    this rejects."""
+    from tpudes.models.applications import UdpClient, UdpServer
+    from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+    from tpudes.models.p2p import PointToPointNetDevice
+    from tpudes.network.node import NodeList
+
+    nodes = [NodeList.GetNode(i) for i in range(NodeList.GetNNodes())]
+    addr_to_node: dict[int, int] = {}
+    for i, node in enumerate(nodes):
+        ipv4 = node.GetObject(Ipv4L3Protocol)
+        if ipv4 is None:
+            continue
+        for iface in ipv4.interfaces[1:]:
+            for a in iface.addresses:
+                addr_to_node[a.GetLocal().addr] = i
+
+    seen_ch: set[int] = set()
+    edges, delays, rates = [], [], []
+    for i, node in enumerate(nodes):
+        for d in range(node.GetNDevices()):
+            dev = node.GetDevice(d)
+            if not isinstance(dev, PointToPointNetDevice):
+                continue
+            ch = dev.GetChannel()
+            if ch is None or id(ch) in seen_ch:
+                continue
+            seen_ch.add(id(ch))
+            peer = ch.GetPeer(dev)
+            edges.append((i, peer.GetNode().GetId()))
+            delays.append(ch.GetDelay().GetSeconds())
+            rates.append(float(dev.data_rate.GetBitRate()))
+    if not edges:
+        raise UnliftableAsError("no p2p links in the object graph")
+
+    srcs, dsts, fbps, pkts = [], [], [], set()
+    for i, node in enumerate(nodes):
+        for a in range(node.GetNApplications()):
+            app = node.GetApplication(a)
+            if isinstance(app, UdpServer):
+                continue
+            if not isinstance(app, UdpClient):
+                # unrecognized traffic would silently vanish from the
+                # link loads — reject the graph instead
+                raise UnliftableAsError(
+                    f"unmodeled application {type(app).__name__} on node "
+                    f"{i} (its traffic would be dropped)"
+                )
+            from tpudes.network.address import Ipv4Address
+
+            dst_node = addr_to_node.get(Ipv4Address(app.remote_address).addr)
+            if dst_node is None:
+                raise UnliftableAsError(
+                    f"UdpClient on node {i}: unknown destination"
+                )
+            interval = app.interval.GetSeconds()
+            if interval <= 0:
+                raise UnliftableAsError("UdpClient with zero interval")
+            srcs.append(i)
+            dsts.append(dst_node)
+            fbps.append(8.0 * int(app.packet_size) / interval)
+            pkts.add(int(app.packet_size))
+    if not srcs:
+        raise UnliftableAsError("no UdpClient CBR flows found")
+    return AsFlowsProgram(
+        n=len(nodes),
+        edges=np.asarray(edges, np.int32),
+        delay_s=np.asarray(delays),
+        rate_bps=np.asarray(rates),
+        src=np.asarray(srcs, np.int32),
+        dst=np.asarray(dsts, np.int32),
+        flow_bps=np.asarray(fbps),
+        pkt_bytes=max(pkts) if pkts else 512,
+        sim_s=sim_end_s,
+    )
+
+
+def device_spf(prog: AsFlowsProgram):
+    """(dist, nh_edge, nh_node) for the distinct destination set.
+
+    dist: (D, N) f32 shortest delay;  nh_edge/nh_node: (D, N) i32 —
+    the directed-edge index / next node toward each destination.
+    Returns (ddst, arrays): ddst maps flow → row in the tables.
+    """
+    e = np.concatenate([prog.edges, prog.edges[:, ::-1]])  # directed
+    if prog.spf_metric == "hops":
+        w_np = np.ones(e.shape[0], np.float32)
+    else:
+        w_np = np.concatenate([prog.delay_s, prog.delay_s]).astype(np.float32)
+    u, v = jnp.asarray(e[:, 0]), jnp.asarray(e[:, 1])
+    w = jnp.asarray(w_np)
+    dsts_np, inv = np.unique(prog.dst, return_inverse=True)
+    D, N = len(dsts_np), prog.n
+
+    dist0 = jnp.full((D, N), INF).at[jnp.arange(D), jnp.asarray(dsts_np)].set(0.0)
+
+    def bf_round(dist, _):
+        cand = dist[:, v] + w[None, :]          # relax u→v backwards
+        return dist.at[:, u].min(cand), None
+
+    dist, _ = jax.lax.scan(bf_round, dist0, None, length=prog.spf_rounds)
+    # next hop: the incident directed edge minimizing w(u,v) + dist[v]
+    score = w[None, :] + dist[:, v]             # (D, 2E)
+    best = jnp.full((D, N), INF).at[:, u].min(score)
+    eidx = jnp.arange(e.shape[0], dtype=jnp.int32)
+    BIG = jnp.int32(2**30)
+    cand_idx = jnp.where(score <= best[:, u] * (1 + 1e-6), eidx[None, :], BIG)
+    nh_edge = jnp.full((D, N), BIG).at[:, u].min(cand_idx)
+    nh_node = jnp.where(nh_edge < BIG, v[jnp.minimum(nh_edge, e.shape[0] - 1)], -1)
+    return jnp.asarray(inv, jnp.int32), dist, nh_edge, nh_node
+
+
+def _walk_paths(prog: AsFlowsProgram, ddst, nh_edge, nh_node):
+    """(F, H) directed-edge index per hop (2E = invalid/done), (F,) hop
+    counts, and (F,) arrived flags; static across replicas."""
+    F = len(prog.src)
+    E2 = 2 * prog.edges.shape[0]
+    BIG = jnp.int32(2**30)
+
+    def step(cur, _):
+        # cur: (F,) current node, or -1 once arrived
+        arrived = cur == jnp.asarray(prog.dst)
+        done = arrived | (cur < 0)
+        row = ddst
+        edge = jnp.where(done, BIG, nh_edge[row, jnp.maximum(cur, 0)])
+        nxt = jnp.where(done, -1, nh_node[row, jnp.maximum(cur, 0)])
+        return nxt, jnp.where(edge < BIG, edge, E2)
+
+    cur0 = jnp.asarray(prog.src)
+    cur_end, path = jax.lax.scan(step, cur0, None, length=prog.max_hops)
+    path = path.T                                # (F, H)
+    hops = jnp.sum(path < E2, axis=1)
+    # arrival = the walk terminated (-1) or ended ON the destination
+    # (a shortest path of exactly max_hops hops still arrives)
+    arrived = (cur_end == -1) | (cur_end == jnp.asarray(prog.dst))
+    return path, hops, arrived
+
+
+_RUNNER_CACHE: dict = {}
+
+
+def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
+    """Execute R replicas; returns per-replica outcome arrays:
+    ``goodput_bps`` (R,F), ``delay_s`` (R,F) fluid end-to-end delay,
+    ``delivered_frac`` (R,F), ``max_util`` (R,), ``hops`` (F,),
+    ``unreachable`` (F,) bool."""
+    ck = (
+        prog.edges.tobytes(), prog.delay_s.tobytes(),
+        prog.rate_bps.tobytes(), prog.src.tobytes(), prog.dst.tobytes(),
+        prog.flow_bps.tobytes(), prog.pkt_bytes, prog.sim_s,
+        prog.max_hops, prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
+        replicas,
+    )
+    run = _RUNNER_CACHE.get(ck)
+    if run is None:
+        E = prog.edges.shape[0]
+        E2 = 2 * E
+        cap = jnp.concatenate(
+            [jnp.asarray(prog.rate_bps), jnp.asarray(prog.rate_bps)]
+        ).astype(jnp.float32)
+        dly = jnp.concatenate(
+            [jnp.asarray(prog.delay_s), jnp.asarray(prog.delay_s)]
+        ).astype(jnp.float32)
+        fbps = jnp.asarray(prog.flow_bps, jnp.float32)
+        R, F, H = replicas, len(prog.src), prog.max_hops
+
+        @jax.jit
+        def _run(z):
+            ddst, dist, nh_edge, nh_node = device_spf(prog)
+            path, hops, arrived = _walk_paths(prog, ddst, nh_edge, nh_node)
+            reached = (
+                dist[ddst, jnp.asarray(prog.src)] < INF
+            ) & arrived
+
+            # per-replica offered rates: lognormal jitter around nominal
+            # (z enters sharded over the mesh's replica axis — every
+            # (R, ...) array downstream inherits that sharding)
+            rate = fbps[None, :] * jnp.exp(
+                prog.rate_jitter * z - 0.5 * prog.rate_jitter**2
+            )
+            rate = jnp.where(reached[None, :], rate, 0.0)
+
+            # fluid fixed point: a link's load is the SURVIVING rate of
+            # each transiting flow at that hop (loss upstream attenuates
+            # load downstream); K rounds converge fast on feed-forward
+            # paths (round k settles every ≤k-th-hop link exactly)
+            pad = lambda x: jnp.concatenate(  # noqa: E731
+                [x, jnp.zeros((R, 1), x.dtype)], axis=1
+            )
+            hs = jnp.arange(H, dtype=jnp.int32)
+
+            def fixed_point(lfrac_link, _):
+                # walk: per-flow surviving rate entering each hop, and
+                # accumulate this round's per-link loads
+                def walk(carry, h):
+                    lg, load = carry
+                    e_h = path[:, h]                       # (F,)
+                    load = load.at[:, e_h].add(rate * jnp.exp(lg))
+                    lg = lg + lfrac_link[:, e_h]
+                    return (lg, load), None
+
+                (lg, load), _ = jax.lax.scan(
+                    walk,
+                    (jnp.zeros((R, F)), jnp.zeros((R, E2 + 1), jnp.float32)),
+                    hs,
+                )
+                util = load[:, :E2] / cap[None, :]
+                new_lfrac = pad(
+                    jnp.log(jnp.minimum(1.0, 1.0 / jnp.maximum(util, 1e-9)))
+                )
+                return new_lfrac, (lg, util)
+
+            lfrac0 = jnp.zeros((R, E2 + 1), jnp.float32)
+            _, (lgs, utils) = jax.lax.scan(
+                fixed_point, lfrac0, None, length=4
+            )
+            lg, util = lgs[-1], utils[-1]
+
+            # M/M/1 queue delay along each path from the settled utils
+            rho = jnp.minimum(util, 0.99)
+            q_delay = (
+                rho / (1.0 - rho) * (8.0 * prog.pkt_bytes / cap)[None, :]
+            )
+            serial = (8.0 * prog.pkt_bytes / cap)[None, :]
+            ldel = pad(q_delay + serial + dly[None, :])
+
+            def acc_hop(dl, h):
+                return dl + ldel[:, path[:, h]], None
+
+            dl, _ = jax.lax.scan(acc_hop, jnp.zeros((R, F)), hs)
+            frac = jnp.where(reached[None, :], jnp.exp(lg), 0.0)
+            return dict(
+                goodput_bps=rate * frac,
+                delay_s=jnp.where(reached[None, :], dl, jnp.inf),
+                delivered_frac=frac,
+                max_util=util.max(axis=1),
+                hops=hops,
+                unreachable=~reached,
+            )
+
+        _RUNNER_CACHE[ck] = _run
+        if len(_RUNNER_CACHE) > 16:
+            _RUNNER_CACHE.pop(next(iter(_RUNNER_CACHE)))
+        run = _run
+
+    z = jax.random.normal(key, (replicas, len(prog.src)))
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        z = jax.device_put(z, NamedSharding(mesh, P("replica", None)))
+    out = run(z)
+    out["goodput_bps"].block_until_ready()
+    return out

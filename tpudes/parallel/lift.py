@@ -127,8 +127,21 @@ def _discover_dumbbell(sim_end_s: float):
     return "dumbbell", prog, lambda: None
 
 
-#: discovery order: most specific first
-LOWERINGS = [_discover_lte_sm, _discover_dumbbell, _discover_bss]
+def _discover_as_flows(sim_end_s: float):
+    """Find a routed p2p topology carrying sparse CBR UDP flows (the
+    config-#5 shape) and lower it to the flow-level device engine."""
+    from tpudes.parallel.as_flows import UnliftableAsError, lower_as_flows
+
+    try:
+        prog = lower_as_flows(sim_end_s)
+    except UnliftableAsError as e:
+        raise UnliftableScenarioError(str(e)) from e
+    return "as_flows", prog, lambda: None
+
+
+#: discovery order: most specific first (as_flows last — it accepts the
+#: most generic shape, any routed p2p graph with CBR UDP clients)
+LOWERINGS = [_discover_lte_sm, _discover_dumbbell, _discover_bss, _discover_as_flows]
 
 
 def lift(sim_end_s: float):
@@ -180,4 +193,8 @@ def run_lifted(kind: str, prog, replicas: int, key=None, mesh=None):
         from tpudes.parallel.tcp_dumbbell import run_tcp_dumbbell
 
         return run_tcp_dumbbell(prog, key, replicas=replicas, mesh=mesh)
+    if kind == "as_flows":
+        from tpudes.parallel.as_flows import run_as_flows
+
+        return run_as_flows(prog, key, replicas=replicas, mesh=mesh)
     raise ValueError(f"unknown lifted program kind {kind!r}")

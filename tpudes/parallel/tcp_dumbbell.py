@@ -104,6 +104,7 @@ def lower_dumbbell(sim_end_s: float) -> DumbbellProgram:
         return len(ipv4.interfaces) - 1 if ipv4 else 0  # minus loopback
 
     routers = [n for n in nodes if n_ifaces(n) >= 3 and n.GetNApplications() == 0]
+    router_ids = {id(n) for n in routers}
     candidates = []
     for n in routers:
         for d in range(n.GetNDevices()):
@@ -112,7 +113,7 @@ def lower_dumbbell(sim_end_s: float) -> DumbbellProgram:
                 continue
             ch = dev.GetChannel()
             peer = ch.GetPeer(dev)
-            if peer.GetNode() in routers and peer.GetNode() is not n:
+            if id(peer.GetNode()) in router_ids and peer.GetNode() is not n:
                 candidates.append((dev, peer, ch))
     # each link appears once from each endpoint; a true dumbbell has
     # exactly one router-router link
@@ -135,11 +136,18 @@ def lower_dumbbell(sim_end_s: float) -> DumbbellProgram:
         )
     queue_cap = int(qs.value)
 
-    # sinks by (address, port) so each bulk app can be paired
+    # sinks by (address, port) so each bulk app can be paired; any app
+    # kind the slot model does not represent is cross-traffic that would
+    # silently vanish from the shared queue — reject, don't drop
     sinks = {}
     for node in nodes:
         for a in range(node.GetNApplications()):
             app = node.GetApplication(a)
+            if not isinstance(app, (BulkSendApplication, PacketSink)):
+                raise UnliftableDumbbellError(
+                    f"unmodeled application {type(app).__name__} on node "
+                    f"{node.GetId()} (cross-traffic would be dropped)"
+                )
             if isinstance(app, PacketSink):
                 port = app.local.GetPort()
                 ipv4 = node.GetObject(Ipv4L3Protocol)

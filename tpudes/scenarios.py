@@ -195,6 +195,65 @@ def build_dumbbell(
     return db, sinks
 
 
+def build_as_network(
+    n_nodes: int,
+    n_flows: int,
+    sim_time: float,
+    model: str = "BA",
+    m: int = 2,
+    flow_kbps: float = 400.0,
+    pkt_bytes: int = 512,
+    seed: int = 1,
+):
+    """BASELINE config #5: BRITE-style AS topology + sparse CBR traffic.
+
+    Flow endpoints are drawn from ``seed`` (the RngRun axis); returns
+    ``(helper, servers)`` where servers[i] counts flow i's deliveries.
+    """
+    import random as _random
+
+    from tpudes.core import Seconds
+    from tpudes.helper.applications import UdpClientHelper, UdpServerHelper
+    from tpudes.helper.internet import InternetStackHelper
+    from tpudes.helper.topology import BriteTopologyHelper
+    from tpudes.models.internet.global_routing import Ipv4GlobalRoutingHelper
+    from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+
+    topo = BriteTopologyHelper(model=model, n=n_nodes, m=m, seed=seed)
+    stack = InternetStackHelper()
+    stack.SetRoutingHelper(Ipv4GlobalRoutingHelper())
+    nodes = topo.BuildTopology(stack)
+    Ipv4GlobalRoutingHelper.PopulateRoutingTables()
+
+    rng = _random.Random(seed)
+    interval_s = pkt_bytes * 8.0 / (flow_kbps * 1e3)
+    servers = []
+    for f in range(n_flows):
+        src = rng.randrange(n_nodes)
+        dst = rng.randrange(n_nodes)
+        while dst == src:
+            dst = rng.randrange(n_nodes)
+        dst_addr = (
+            nodes.Get(dst)
+            .GetObject(Ipv4L3Protocol)
+            .GetInterface(1)
+            .GetAddress(0)
+            .GetLocal()
+        )
+        server = UdpServerHelper(4000 + f)
+        sapps = server.Install(nodes.Get(dst))
+        sapps.Start(Seconds(0.0))
+        client = UdpClientHelper(dst_addr, 4000 + f)
+        client.SetAttribute("MaxPackets", 0)
+        client.SetAttribute("Interval", Seconds(interval_s))
+        client.SetAttribute("PacketSize", pkt_bytes)
+        capps = client.Install(nodes.Get(src))
+        capps.Start(Seconds(0.05))
+        capps.Stop(Seconds(sim_time))
+        servers.append(sapps.Get(0))
+    return topo, servers
+
+
 def build_lena(
     n_enbs: int,
     ues_per_cell: int,
