@@ -1,0 +1,75 @@
+"""Space-parallel PDES tests (SURVEY.md §2.3/§3.3).
+
+The upstream yardstick (src/mpi/test, simple-distributed examples): a
+partitioned run must reproduce the sequential run's results exactly —
+same packets, same simulated timestamps — because the conservative
+grant never lets a rank outrun a message that could still reach it.
+"""
+
+import pytest
+
+import _distributed_targets as targets
+
+from tpudes.parallel.mpi import INF_TS, LaunchDistributed, MpiInterface
+
+
+def test_sequential_oracle_runs():
+    out = targets.run_chain(0, 1)
+    assert len(out["server_rx"]) == 5
+    assert len(out["client_rx"]) == 5
+    assert all(size == 333 for _, size in out["server_rx"])
+
+
+def test_two_rank_run_reproduces_sequential_traces_exactly():
+    seq = targets.run_chain(0, 1)
+    ranks = LaunchDistributed(targets.run_chain, 2)
+    # rank 0 owns the client, rank 1 the server
+    assert ranks[1]["server_rx"] == seq["server_rx"]
+    assert ranks[0]["client_rx"] == seq["client_rx"]
+    assert ranks[0]["server_rx"] == [] and ranks[1]["client_rx"] == []
+    # both ranks actually ran granted windows
+    assert ranks[0]["windows"] > 1 and ranks[1]["windows"] > 1
+
+
+def test_three_rank_chain_delivers():
+    ranks = LaunchDistributed(targets.run_chain_three_ranks, 3)
+    assert len(ranks[2]["server_rx"]) == 3
+    assert ranks[0]["server_rx"] == [] and ranks[1]["server_rx"] == []
+
+
+def test_asymmetric_stop_closes_out_cleanly():
+    """An immediate rank-local Simulator.Stop() must not strand peers
+    in the collective (r4 review: EOFError / 120 s hang)."""
+    ranks = LaunchDistributed(targets.run_asymmetric_stop, 2, timeout_s=60)
+    assert ranks[1]["server_rx"] == 3
+
+
+def test_bursty_window_exceeding_pipe_buffer_does_not_deadlock():
+    """300 x 512B messages in one granted window ≫ the OS pipe buffer;
+    the spooled threaded flush must drain it (r4 review)."""
+    ranks = LaunchDistributed(targets.run_bursty_window, 2, timeout_s=60)
+    assert ranks[1]["rx"] == 300
+    # tpudes must not drag its jax-heavy engine modules into the ranks
+    assert not ranks[0]["heavy_loaded"] and not ranks[1]["heavy_loaded"]
+
+
+def test_zero_delay_remote_link_is_rejected():
+    MpiInterface._enabled = True  # simulate an enabled rank
+    try:
+        with pytest.raises(ValueError, match="positive delay"):
+            MpiInterface.RegisterLookahead(0)
+    finally:
+        MpiInterface._enabled = False
+
+
+def test_lookahead_registry_tracks_minimum():
+    MpiInterface._enabled = True
+    try:
+        MpiInterface._lookahead_ts = INF_TS
+        MpiInterface.RegisterLookahead(5_000_000)
+        MpiInterface.RegisterLookahead(2_000_000)
+        MpiInterface.RegisterLookahead(9_000_000)
+        assert MpiInterface.MinLookahead() == 2_000_000
+    finally:
+        MpiInterface._enabled = False
+        MpiInterface._lookahead_ts = INF_TS

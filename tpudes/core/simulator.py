@@ -246,9 +246,14 @@ class Simulator:
             name = GlobalValue.GetValue("SimulatorImplementationType")
             impl_cls = SIMULATOR_IMPL_TYPES.get(name)
             if impl_cls is None:
-                # late registration: the JAX engine lives in tpudes.parallel
+                # late registration: the JAX and distributed engines live
+                # in tpudes.parallel and register on import
                 if "Jax" in name:
                     import tpudes.parallel  # noqa: F401  (registers itself)
+
+                    impl_cls = SIMULATOR_IMPL_TYPES.get(name)
+                elif "Distributed" in name:
+                    import tpudes.parallel.distributed  # noqa: F401
 
                     impl_cls = SIMULATOR_IMPL_TYPES.get(name)
             if impl_cls is None:

@@ -39,7 +39,19 @@ class PointToPointHelper:
         dev_b.SetQueue(DropTailQueue(**self._queue_attrs))
         a.AddDevice(dev_a)
         b.AddDevice(dev_b)
-        channel = PointToPointChannel(**self._channel_attrs)
+        # a link spanning two partitions becomes a remote channel (the
+        # upstream helper does the same systemId check under MPI)
+        from tpudes.parallel.mpi import MpiInterface
+
+        if (
+            MpiInterface.IsEnabled()
+            and a.GetSystemId() != b.GetSystemId()
+        ):
+            from tpudes.models.p2p import PointToPointRemoteChannel
+
+            channel = PointToPointRemoteChannel(**self._channel_attrs)
+        else:
+            channel = PointToPointChannel(**self._channel_attrs)
         dev_a.Attach(channel)
         dev_b.Attach(channel)
         return NetDeviceContainer(dev_a, dev_b)

@@ -6,9 +6,9 @@ Serialization delay = size/DataRate on the device; propagation delay on
 the channel; PPP framing; drop-tail tx queue; full phy/mac trace-source
 set so pcap/ascii helpers and FlowMonitor can hook in.
 
-The remote-channel variant for partitioned topologies lives in
-tpudes/parallel/remote_channel.py (parity:
-point-to-point-remote-channel.{h,cc}).
+:class:`PointToPointRemoteChannel` (below) is the cross-partition
+variant (parity: src/mpi/model/point-to-point-remote-channel.{h,cc});
+it rides the MpiInterface transport in tpudes/parallel/mpi.py.
 """
 
 from __future__ import annotations
@@ -74,6 +74,50 @@ class PointToPointChannel(Channel):
             peer.GetNode().GetId(), tx_time + self.delay, peer.Receive, packet
         )
         return True
+
+
+class PointToPointRemoteChannel(PointToPointChannel):
+    """Cross-partition half of a p2p link
+    (src/mpi/model/point-to-point-remote-channel.{h,cc}).
+
+    Both ranks construct the full link (ghost topology, the upstream
+    distributed idiom); when the receiving device's node is owned by
+    another rank, the receive event travels through MpiInterface instead
+    of the local queue.  The channel delay is this link's lookahead
+    contribution and must be positive.
+    """
+
+    tid = (
+        TypeId("tpudes::PointToPointRemoteChannel")
+        .SetParent(PointToPointChannel.tid)
+        .AddConstructor(lambda **kw: PointToPointRemoteChannel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        from tpudes.parallel.mpi import MpiInterface
+
+        if MpiInterface.IsEnabled():
+            MpiInterface.RegisterLookahead(self.delay.GetTimeStep())
+
+    def TransmitStart(self, packet, src_device, tx_time: Time) -> bool:
+        from tpudes.parallel.mpi import MpiInterface
+
+        peer = self.GetPeer(src_device)
+        peer_node = peer.GetNode()
+        if (
+            MpiInterface.IsEnabled()
+            and peer_node.GetSystemId() != MpiInterface.GetSystemId()
+        ):
+            rx_ts = (
+                Simulator.Now() + tx_time + self.delay
+            ).GetTimeStep()
+            MpiInterface.SendPacket(
+                peer_node.GetSystemId(), rx_ts,
+                peer_node.GetId(), peer.GetIfIndex(), packet,
+            )
+            return True
+        return super().TransmitStart(packet, src_device, tx_time)
 
 
 class PointToPointNetDevice(NetDevice):

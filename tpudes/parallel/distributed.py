@@ -1,0 +1,84 @@
+"""DistributedSimulatorImpl: conservative granted-time-window PDES.
+
+Reference parity: src/mpi/model/distributed-simulator-impl.{h,cc}
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.3, §3.3).
+The algorithm is upstream's: each rank owns the nodes whose
+``systemId`` equals its rank; cross-partition links are
+PointToPointRemoteChannels whose minimum delay is the **lookahead**;
+each round the ranks agree on
+
+    grant = min over ranks (next-local-event time + lookahead)
+
+and every rank safely executes all events strictly below the grant —
+any message a peer may still send arrives at or after it.  Termination:
+every rank idle (candidate = ∞) and all pipes drained.
+
+The windowed loop reuses the DefaultSimulatorImpl event core, so a
+1-rank run is event-identical to the sequential engine, and an N-rank
+run reproduces the sequential *timestamps* exactly for deterministic
+models (tests/test_distributed.py pins this).
+"""
+
+from __future__ import annotations
+
+from tpudes.core.simulator import DefaultSimulatorImpl, register_simulator_impl
+from tpudes.parallel.mpi import INF_TS, MpiInterface
+
+
+class DistributedSimulatorImpl(DefaultSimulatorImpl):
+    """Granted-time-window engine over MpiInterface ranks."""
+
+    def __init__(self):
+        super().__init__()
+        if not MpiInterface.IsEnabled():
+            raise RuntimeError(
+                "DistributedSimulatorImpl needs MpiInterface.Enable "
+                "(launch ranks via tpudes.parallel.mpi.LaunchDistributed)"
+            )
+        self.windows_run = 0
+
+    def _deliver(self, rx_ts, node_id, if_index, packet):
+        from tpudes.network.node import NodeList
+
+        dev = NodeList.GetNode(node_id).GetDevice(if_index)
+        if rx_ts < self.current_ts:
+            raise RuntimeError(
+                f"causality violation: remote packet for t={rx_ts} arrived "
+                f"at t={self.current_ts} (lookahead too small)"
+            )
+        self.ScheduleAt(node_id, rx_ts, dev.Receive, (packet,))
+
+    def Run(self) -> None:
+        self._stop = False
+        events = self._events
+        lookahead = MpiInterface.MinLookahead()
+        while True:
+            self._process_events_with_context()
+            # phase 1: land ALL in-flight traffic, then bound future sends
+            # — a candidate computed before the flush could overstate the
+            # bound (a just-received packet may trigger an earlier send)
+            MpiInterface.Flush(self._deliver)
+            # a stopped rank keeps participating in the collectives with
+            # an ∞ candidate (it will send nothing more) until EVERY rank
+            # reports ∞ — an asymmetric Stop() must not abandon peers
+            # mid-protocol (r4 review: they would block or EOFError)
+            if self._stop:
+                next_ts = INF_TS
+            else:
+                next_ts = INF_TS if events.IsEmpty() else events.PeekNext().ts
+            candidate = min(next_ts + lookahead, INF_TS)
+            grant = MpiInterface.AllReduceMin(candidate)
+            self.windows_run += 1
+            if grant >= INF_TS:
+                # every rank stopped-or-idle and nothing in flight
+                break
+            # safe horizon: strictly below the grant
+            while not self._stop:
+                self._process_events_with_context()
+                if events.IsEmpty() or events.PeekNext().ts >= grant:
+                    break
+                self._invoke(events.RemoveNext())
+
+
+register_simulator_impl("tpudes::DistributedSimulatorImpl", DistributedSimulatorImpl)
+register_simulator_impl("ns3::DistributedSimulatorImpl", DistributedSimulatorImpl)

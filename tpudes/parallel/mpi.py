@@ -1,0 +1,209 @@
+"""Cross-rank transport for space-parallel PDES (the MpiInterface analog).
+
+Reference parity: src/mpi/model/mpi-interface.{h,cc} and
+granted-time-window-mpi-interface.{h,cc} (upstream paths; mount empty at
+survey — SURVEY.md §0, §2.3, §3.3).  Upstream wraps MPI_Isend/Irecv +
+MPI_Allgather; this build targets N **local processes** joined by
+``multiprocessing`` pipes — the same conservative protocol without an
+MPI dependency (the transport seam is this module; an actual MPI backend
+would implement the same four calls).
+
+Protocol (one window round, two phases — the candidate must be computed
+AFTER all in-flight traffic lands, else a just-received packet can
+trigger a send below the reported bound, a real causality hole caught
+by tests/test_distributed.py):
+1. ``SendPacket`` spools outgoing messages locally as events execute
+   (the MPI_Isend analog — nothing blocks mid-window),
+2. **flush phase**: each rank writes its spool + a flush marker to
+   every peer from a sender thread while the main thread drains every
+   peer's pipe up to that marker (reads always progress, so a spool
+   larger than the OS pipe buffer cannot deadlock the exchange);
+   after this barrier no message is in flight anywhere,
+3. **grant phase**: each rank computes candidate = next-event-time +
+   lookahead over its now-complete queue and all-reduces the minimum.
+
+Packet wire format: pickle of the structured Packet (headers are plain
+objects); upstream uses its Buffer serialization — the pickle is this
+build's local-process equivalent.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+INF_TS = 1 << 62
+
+
+class MpiInterface:
+    """Process-global rank state + transport (mpi-interface.h API)."""
+
+    _enabled = False
+    _rank = 0
+    _size = 1
+    _conns: dict[int, object] = {}     # peer rank -> duplex Connection
+    _spool: dict[int, list] = {}       # peer rank -> pending wire blobs
+    _lookahead_ts: int = INF_TS        # min remote-channel delay (ticks)
+    _rx_count = 0
+    _tx_count = 0
+
+    @classmethod
+    def Enable(cls, rank: int, size: int, conns: dict[int, object]) -> None:
+        cls._enabled = True
+        cls._rank = rank
+        cls._size = size
+        cls._conns = dict(conns)
+        cls._spool = {}
+        cls._lookahead_ts = INF_TS
+        cls._rx_count = cls._tx_count = 0
+
+    @classmethod
+    def Disable(cls) -> None:
+        for c in cls._conns.values():
+            try:
+                c.close()
+            except OSError:
+                pass
+        cls._enabled = False
+        cls._rank, cls._size = 0, 1
+        cls._conns = {}
+        cls._spool = {}
+        cls._lookahead_ts = INF_TS
+
+    @classmethod
+    def IsEnabled(cls) -> bool:
+        return cls._enabled
+
+    @classmethod
+    def GetSystemId(cls) -> int:
+        return cls._rank
+
+    @classmethod
+    def GetSize(cls) -> int:
+        return cls._size
+
+    # --- lookahead registry (remote channels report their delay) ---------
+    @classmethod
+    def RegisterLookahead(cls, delay_ticks: int) -> None:
+        if delay_ticks <= 0:
+            raise ValueError(
+                "remote channels need a positive delay (zero lookahead "
+                "deadlocks the conservative grant)"
+            )
+        cls._lookahead_ts = min(cls._lookahead_ts, delay_ticks)
+
+    @classmethod
+    def MinLookahead(cls) -> int:
+        return cls._lookahead_ts
+
+    # --- data plane -------------------------------------------------------
+    @classmethod
+    def SendPacket(
+        cls, dst_rank: int, rx_ts: int, node_id: int, if_index: int, packet
+    ) -> None:
+        """Spool toward the owning rank (the MPI_Isend analog; the wire
+        write happens in the next Flush so a large window can never
+        block mid-event on a full pipe)."""
+        cls._spool.setdefault(dst_rank, []).append(
+            pickle.dumps(("pkt", rx_ts, node_id, if_index, packet))
+        )
+        cls._tx_count += 1
+
+    @classmethod
+    def Flush(cls, deliver) -> None:
+        """Phase 1: barrier-drain all in-flight packets (delivering via
+        ``deliver(rx_ts, node_id, if_index, packet)``).  Writes run on a
+        helper thread so this rank keeps reading while its own spool
+        drains — two ranks with >pipe-buffer spools would otherwise
+        block on send_bytes simultaneously."""
+        import threading
+
+        spool, cls._spool = cls._spool, {}
+        marker = pickle.dumps(("flush",))
+
+        def write_all():
+            for rank, c in cls._conns.items():
+                for blob in spool.get(rank, ()):
+                    c.send_bytes(blob)
+                c.send_bytes(marker)
+
+        writer = threading.Thread(target=write_all)
+        writer.start()
+        for c in cls._conns.values():
+            while True:
+                msg = pickle.loads(c.recv_bytes())
+                if msg[0] == "flush":
+                    break
+                _, rx_ts, node_id, if_index, packet = msg
+                cls._rx_count += 1
+                deliver(rx_ts, node_id, if_index, packet)
+        writer.join()
+
+    @classmethod
+    def AllReduceMin(cls, candidate_ts: int) -> int:
+        """Phase 2: global minimum of the per-rank grant candidates.
+        Call only with no traffic in flight (right after Flush)."""
+        for c in cls._conns.values():
+            c.send_bytes(pickle.dumps(("lbts", candidate_ts)))
+        grant = candidate_ts
+        for c in cls._conns.values():
+            msg = pickle.loads(c.recv_bytes())
+            assert msg[0] == "lbts", f"protocol desync: {msg[0]!r}"
+            grant = min(grant, msg[1])
+        return grant
+
+
+def LaunchDistributed(target, size: int, args: tuple = (), timeout_s: float = 120.0):
+    """Run ``target(rank, size, *args) -> result`` in ``size`` local
+    processes wired all-to-all; returns [result_0, ..., result_{size-1}].
+
+    The spawn start method keeps children free of the parent's JAX/TPU
+    state (a forked XLA client is not fork-safe).
+    """
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    # duplex pipe per unordered pair
+    pipes = {}
+    for i in range(size):
+        for j in range(i + 1, size):
+            a, b = ctx.Pipe(duplex=True)
+            pipes[(i, j)] = a
+            pipes[(j, i)] = b
+    result_q = ctx.Queue()
+    procs = []
+    for r in range(size):
+        conns = {p: pipes[(r, p)] for p in range(size) if p != r}
+        procs.append(
+            ctx.Process(
+                target=_rank_main,
+                args=(target, r, size, conns, args, result_q),
+            )
+        )
+    for p in procs:
+        p.start()
+    results: dict[int, object] = {}
+    try:
+        for _ in range(size):
+            rank, ok, payload = result_q.get(timeout=timeout_s)
+            if not ok:
+                raise RuntimeError(f"rank {rank} failed:\n{payload}")
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+    return [results[r] for r in range(size)]
+
+
+def _rank_main(target, rank, size, conns, args, result_q):
+    import traceback
+
+    try:
+        MpiInterface.Enable(rank, size, conns)
+        result = target(rank, size, *args)
+        result_q.put((rank, True, result))
+    except Exception:
+        result_q.put((rank, False, traceback.format_exc()))
+    finally:
+        MpiInterface.Disable()
