@@ -37,6 +37,7 @@ def main(argv=None):
     cmd.AddValue("simTime", "simulated seconds", 2.0)
     cmd.AddValue("model", "BA | Waxman", "BA")
     cmd.AddValue("flowKbps", "per-flow offered rate", 400.0)
+    cmd.AddValue("progress", "print a ShowProgress line each sim-second", False)
     cmd.Parse(argv)
     n, f, sim_time = int(cmd.nNodes), int(cmd.nFlows), float(cmd.simTime)
 
@@ -49,6 +50,11 @@ def main(argv=None):
         f"topology: {topo.GetNNodesTopology()} nodes, "
         f"{topo.GetNEdgesTopology()} links, built+routed in {build_wall:.1f}s"
     )
+
+    if cmd.GetValue("progress"):
+        from tpudes.core.show_progress import ShowProgress
+
+        ShowProgress(Seconds(1.0))
 
     wall0 = time.monotonic()
     Simulator.Stop(Seconds(sim_time))

@@ -27,6 +27,8 @@ from tpudes.helper import (
 def main(argv=None):
     cmd = CommandLine("first.py: 2-node point-to-point UDP echo")
     cmd.AddValue("packets", "number of echo packets", 1)
+    cmd.AddValue("pcap", "write first-<node>-<dev>.pcap traces", True)
+    cmd.AddValue("ascii", "write first.tr ascii trace", False)
     cmd.Parse(argv)
 
     Time.SetResolution(Time.NS)
@@ -45,6 +47,11 @@ def main(argv=None):
     address = Ipv4AddressHelper()
     address.SetBase("10.1.1.0", "255.255.255.0")
     interfaces = address.Assign(devices)
+
+    if cmd.GetValue("pcap"):
+        p2p.EnablePcapAll("first")
+    if cmd.GetValue("ascii"):
+        p2p.EnableAsciiAll("first.tr")
 
     echo_server = UdpEchoServerHelper(9)
     server_apps = echo_server.Install(nodes.Get(1))

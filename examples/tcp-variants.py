@@ -50,6 +50,10 @@ def run_one(variant, n_flows, sim_time, bottleneck_rate, queue, engine):
         n_flows, sim_time, variant=variant,
         bottleneck_rate=bottleneck_rate, queue=queue,
     )
+    from tpudes.models.flow_monitor import FlowMonitorHelper
+
+    fmh = FlowMonitorHelper()
+    monitor = fmh.InstallAll()
     wall0 = time.monotonic()
     Simulator.Stop(Seconds(sim_time))
     Simulator.Run()
@@ -78,10 +82,21 @@ def run_one(variant, n_flows, sim_time, bottleneck_rate, queue, engine):
             s.GetTotalRx() * 8.0 / max(sim_time - 0.1, 1e-9) / 1e6
             for s in sinks
         ]
+        monitor.CheckForLostPackets()
+        # data flows only: sink ports are 5000..5000+n (the reverse ACK
+        # flows land on ephemeral destination ports >= 49152)
+        fwd = [
+            s for fid, s in monitor.GetFlowStats().items()
+            if 5000
+            <= fmh.GetClassifier().FindFlow(fid).destination_port
+            < 5000 + n_flows
+        ]
         print(
             f"{variant:14s} goodput/flow "
             f"[{', '.join(f'{t:.2f}' for t in tput)}] Mbps "
             f"agg={sum(tput):.2f} jain={jain(tput):.3f} "
+            f"lost={sum(s.lost_packets for s in fwd)} "
+            f"mean_delay={sum(s.mean_delay_s for s in fwd) / max(len(fwd), 1) * 1e3:.1f}ms "
             f"events={Simulator.GetEventCount()} wall={wall:.2f}s"
         )
         ok = sum(tput) > 0

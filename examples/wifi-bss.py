@@ -100,6 +100,12 @@ def main(argv=None):
         apps.Stop(Seconds(sim_time))
         clients.append(apps.Get(0))
 
+    # per-flow KPIs on the scalar path (FlowMonitor rides the IP traces)
+    from tpudes.models.flow_monitor import FlowMonitorHelper
+
+    fmh = FlowMonitorHelper()
+    monitor = fmh.InstallAll()
+
     wall0 = time.monotonic()
     Simulator.Stop(Seconds(sim_time))
     Simulator.Run()
@@ -130,6 +136,18 @@ def main(argv=None):
     )
     print(f"stas={n_stas} associated={n_assoc} server_rx={rx_count[0]} "
           f"events={events} wall={wall:.2f}s events/s={events / max(wall, 1e-9):,.0f}")
+    monitor.CheckForLostPackets()
+    stats = monitor.GetFlowStats()
+    up = [s for fid, s in stats.items()
+          if fmh.GetClassifier().FindFlow(fid).destination_port == 9]
+    if up:
+        print(
+            f"flows={len(stats)} (uplink {len(up)}): "
+            f"rx={sum(s.rx_packets for s in up)}/{sum(s.tx_packets for s in up)} pkts "
+            f"lost={sum(s.lost_packets for s in up)} "
+            f"mean_delay={sum(s.mean_delay_s for s in up) / len(up) * 1e3:.2f}ms "
+            f"mean_jitter={sum(s.mean_jitter_s for s in up) / len(up) * 1e3:.2f}ms"
+        )
     Simulator.Destroy()
     return 0 if n_assoc == n_stas and rx_count[0] > 0 else 1
 

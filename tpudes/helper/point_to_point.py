@@ -8,9 +8,15 @@ from __future__ import annotations
 from tpudes.helper.containers import NetDeviceContainer, NodeContainer
 from tpudes.models.p2p import PointToPointChannel, PointToPointNetDevice
 from tpudes.network.queue import DropTailQueue
+from tpudes.network.trace_helper import DLT_PPP, PcapHelperForDevice
 
 
-class PointToPointHelper:
+class PointToPointHelper(PcapHelperForDevice):
+    pcap_dlt = DLT_PPP
+
+    def _pcap_device_ok(self, device) -> bool:
+        return isinstance(device, PointToPointNetDevice)
+
     def __init__(self):
         self._device_attrs: dict = {}
         self._channel_attrs: dict = {}

@@ -1,0 +1,227 @@
+"""FlowMonitor: per-flow delay/jitter/loss/throughput measurement.
+
+Reference parity: src/flow-monitor/model/flow-monitor.{h,cc},
+ipv4-flow-classifier.{h,cc}, ipv4-flow-probe.{h,cc},
+helper/flow-monitor-helper.{h,cc} (upstream paths; mount empty at
+survey — SURVEY.md §0, §2.10).
+
+Probes ride the Ipv4L3Protocol trace sources each node already fires
+(SendOutgoing / LocalDeliver / Drop — ipv4.py): first-tx classifies the
+packet into a 5-tuple flow, local-deliver matches it back by packet uid
+(the ns-3 probe uses a per-packet tag; this build's packets keep a
+stable uid through COW copies and forwarding, so the uid IS the tag).
+Delay = rx - tx sim-time; jitter = |delay - last_delay| (RFC 3550
+accumulation, as upstream); loss = tracked packets that were dropped,
+plus tx-without-rx at report time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpudes.core.nstime import Time
+from tpudes.core.simulator import Simulator
+
+
+@dataclass(frozen=True)
+class FiveTuple:
+    """ipv4-flow-classifier.h FiveTuple."""
+
+    source: str
+    destination: str
+    protocol: int
+    source_port: int
+    destination_port: int
+
+
+@dataclass
+class FlowStats:
+    """flow-monitor.h FlowStats (the fields the examples report)."""
+
+    tx_packets: int = 0
+    tx_bytes: int = 0
+    rx_packets: int = 0
+    rx_bytes: int = 0
+    lost_packets: int = 0
+    delay_sum_s: float = 0.0
+    jitter_sum_s: float = 0.0
+    last_delay_s: float | None = None
+    time_first_tx_s: float | None = None
+    time_last_rx_s: float | None = None
+
+    @property
+    def mean_delay_s(self) -> float:
+        return self.delay_sum_s / self.rx_packets if self.rx_packets else 0.0
+
+    @property
+    def mean_jitter_s(self) -> float:
+        return (
+            self.jitter_sum_s / (self.rx_packets - 1)
+            if self.rx_packets > 1
+            else 0.0
+        )
+
+    def throughput_bps(self) -> float:
+        if (
+            self.time_first_tx_s is None
+            or self.time_last_rx_s is None
+            or self.time_last_rx_s <= self.time_first_tx_s
+        ):
+            return 0.0
+        return 8.0 * self.rx_bytes / (self.time_last_rx_s - self.time_first_tx_s)
+
+
+class Ipv4FlowClassifier:
+    """5-tuple → flow id (ipv4-flow-classifier.{h,cc})."""
+
+    def __init__(self):
+        self._flows: dict[FiveTuple, int] = {}
+
+    def Classify(self, header, packet) -> tuple[int, FiveTuple]:
+        sport = dport = 0
+        front = packet.PeekHeader()
+        if front is not None:
+            sport = getattr(front, "source_port", 0)
+            dport = getattr(front, "destination_port", 0)
+        t = FiveTuple(
+            str(header.source), str(header.destination),
+            int(header.protocol), int(sport), int(dport),
+        )
+        fid = self._flows.get(t)
+        if fid is None:
+            fid = len(self._flows) + 1
+            self._flows[t] = fid
+        return fid, t
+
+    def FindFlow(self, flow_id: int) -> FiveTuple:
+        for t, fid in self._flows.items():
+            if fid == flow_id:
+                return t
+        raise KeyError(flow_id)
+
+
+class FlowMonitor:
+    """The collector; one per FlowMonitorHelper."""
+
+    def __init__(self):
+        self.classifier = Ipv4FlowClassifier()
+        self.stats: dict[int, FlowStats] = {}
+        #: packet uid -> (flow id, tx sim seconds) for in-flight packets
+        self._tracked: dict[int, tuple[int, float]] = {}
+
+    # --- probe callbacks --------------------------------------------------
+    def _now_s(self) -> float:
+        return Time(Simulator.NowTicks()).GetSeconds()
+
+    def _on_send(self, header, packet, if_index) -> None:
+        fid, _ = self.classifier.Classify(header, packet)
+        st = self.stats.setdefault(fid, FlowStats())
+        now = self._now_s()
+        st.tx_packets += 1
+        st.tx_bytes += packet.GetSize() + 20  # + the IP header going on
+        if st.time_first_tx_s is None:
+            st.time_first_tx_s = now
+        self._tracked[packet.GetUid()] = (fid, now)
+
+    def _on_deliver(self, header, packet, if_index) -> None:
+        hit = self._tracked.pop(packet.GetUid(), None)
+        if hit is None:
+            return  # not a monitored first-hop (e.g. loopback warm-up)
+        fid, tx_s = hit
+        st = self.stats[fid]
+        now = self._now_s()
+        delay = now - tx_s
+        st.rx_packets += 1
+        st.rx_bytes += packet.GetSize() + 20
+        st.delay_sum_s += delay
+        if st.last_delay_s is not None:
+            st.jitter_sum_s += abs(delay - st.last_delay_s)
+        st.last_delay_s = delay
+        st.time_last_rx_s = now
+
+    def _on_drop(self, header, packet, reason) -> None:
+        hit = self._tracked.pop(packet.GetUid(), None)
+        if hit is not None:
+            self.stats[hit[0]].lost_packets += 1
+
+    # --- reporting --------------------------------------------------------
+    def CheckForLostPackets(self, max_delay_s: float = 10.0) -> None:
+        """Fold overdue unmatched tx packets into loss.  As upstream
+        (m_maxPerHopDelay, default 10 s): a packet is lost only when it
+        has been in flight longer than ``max_delay_s`` — packets still
+        legitimately in transit when the run stops are NOT losses."""
+        now = self._now_s()
+        still_flying = {}
+        for uid, (fid, tx_s) in self._tracked.items():
+            if now - tx_s > max_delay_s:
+                self.stats[fid].lost_packets += 1
+            else:
+                still_flying[uid] = (fid, tx_s)
+        self._tracked = still_flying
+
+    def GetFlowStats(self) -> dict[int, FlowStats]:
+        return self.stats
+
+    def SerializeToXmlFile(self, filename: str, *_args) -> None:
+        """flow-monitor.cc SerializeToXmlFile: the standard FlowMonitor
+        XML shape (attribute names match upstream's parser ecosystem)."""
+        with open(filename, "w") as f:
+            f.write("<?xml version=\"1.0\" ?>\n<FlowMonitor>\n  <FlowStats>\n")
+            for fid, st in sorted(self.stats.items()):
+                f.write(
+                    f'    <Flow flowId="{fid}" '
+                    f'txPackets="{st.tx_packets}" txBytes="{st.tx_bytes}" '
+                    f'rxPackets="{st.rx_packets}" rxBytes="{st.rx_bytes}" '
+                    f'lostPackets="{st.lost_packets}" '
+                    f'delaySum="+{st.delay_sum_s * 1e9:.0f}ns" '
+                    f'jitterSum="+{st.jitter_sum_s * 1e9:.0f}ns" />\n'
+                )
+            f.write("  </FlowStats>\n  <Ipv4FlowClassifier>\n")
+            for t, fid in self.classifier._flows.items():
+                f.write(
+                    f'    <Flow flowId="{fid}" sourceAddress="{t.source}" '
+                    f'destinationAddress="{t.destination}" '
+                    f'protocol="{t.protocol}" sourcePort="{t.source_port}" '
+                    f'destinationPort="{t.destination_port}" />\n'
+                )
+            f.write("  </Ipv4FlowClassifier>\n</FlowMonitor>\n")
+
+
+class FlowMonitorHelper:
+    """helper/flow-monitor-helper.{h,cc}: InstallAll then GetMonitor."""
+
+    def __init__(self):
+        self._monitor: FlowMonitor | None = None
+
+    def GetMonitor(self) -> FlowMonitor:
+        if self._monitor is None:
+            self._monitor = FlowMonitor()
+        return self._monitor
+
+    def GetClassifier(self) -> Ipv4FlowClassifier:
+        return self.GetMonitor().classifier
+
+    def Install(self, nodes) -> FlowMonitor:
+        from tpudes.helper.containers import NodeContainer
+        from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+
+        if isinstance(nodes, NodeContainer):
+            nodes = list(nodes)
+        elif not isinstance(nodes, (list, tuple)):
+            nodes = [nodes]
+        mon = self.GetMonitor()
+        for node in nodes:
+            ipv4 = node.GetObject(Ipv4L3Protocol)
+            if ipv4 is None:
+                continue
+            ipv4.TraceConnectWithoutContext("SendOutgoing", mon._on_send)
+            ipv4.TraceConnectWithoutContext("LocalDeliver", mon._on_deliver)
+            ipv4.TraceConnectWithoutContext("Drop", mon._on_drop)
+        return mon
+
+    def InstallAll(self) -> FlowMonitor:
+        from tpudes.network.node import NodeList
+
+        return self.Install(
+            [NodeList.GetNode(i) for i in range(NodeList.GetNNodes())]
+        )

@@ -1,0 +1,203 @@
+"""Pcap and ascii trace writers (the helper-trace layer).
+
+Reference parity: src/network/helper/trace-helper.{h,cc} — the
+``PcapHelperForDevice`` / ``AsciiTraceHelperForDevice`` mixin that gives
+every device helper ``EnablePcap(All)`` / ``EnableAscii(All)`` — plus
+src/network/utils/pcap-file{,-wrapper}.{h,cc} (upstream paths; mount
+empty at survey — SURVEY.md §0, §2.10/§5.1).
+
+The pcap writer emits the classic libpcap format (magic 0xa1b2c3d4,
+version 2.4), one file per device, so the output opens in tcpdump /
+wireshark / scapy unchanged.  Point-to-point devices use DLT_PPP (9),
+matching upstream's PointToPointHelper::EnablePcapInternal; the frame
+bytes are the device's on-air serialization (PPP framing included) via
+``Packet.ToBytes`` hooked on the device's promiscuous sniffer.
+
+Ascii tracing mirrors upstream's single-file event stream: one line per
+queue/rx event — ``+`` enqueue, ``-`` dequeue, ``d`` drop, ``r``
+receive — with the simulated timestamp and the config path of the
+source.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import Time
+from tpudes.core.simulator import Simulator
+
+DLT_PPP = 9
+DLT_IEEE802_11 = 105
+DLT_RAW = 101
+
+PCAP_MAGIC = 0xA1B2C3D4
+PCAP_VERSION = (2, 4)
+
+
+class PcapFileWrapper:
+    """One .pcap output stream (pcap-file-wrapper.{h,cc})."""
+
+    def __init__(self, filename: str, data_link_type: int, snap_len: int = 65535):
+        self._f = open(filename, "wb")
+        self.filename = filename
+        self.snap_len = snap_len
+        self._f.write(
+            struct.pack(
+                "<IHHiIII",
+                PCAP_MAGIC, PCAP_VERSION[0], PCAP_VERSION[1],
+                0, 0, snap_len, data_link_type,
+            )
+        )
+        self.n_records = 0
+
+    def Write(self, packet) -> None:
+        data = packet.ToBytes()
+        ts = Simulator.NowTicks()  # ns ticks
+        sec, nsec = divmod(ts, 1_000_000_000)
+        usec = nsec // 1000
+        cap = min(len(data), self.snap_len)
+        self._f.write(
+            struct.pack("<IIII", sec, usec, cap, len(data)) + data[:cap]
+        )
+        self.n_records += 1
+
+    def Close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+
+class PcapHelper:
+    """Owns the open wrappers; files close at Simulator.Destroy."""
+
+    def __init__(self):
+        self._wrappers: list[PcapFileWrapper] = []
+
+    def CreateFile(self, filename: str, data_link_type: int) -> PcapFileWrapper:
+        w = PcapFileWrapper(filename, data_link_type)
+        self._wrappers.append(w)
+        Simulator.ScheduleDestroy(w.Close)
+        return w
+
+    @staticmethod
+    def GetFilenameFromDevice(prefix: str, device) -> str:
+        node = device.GetNode()
+        return f"{prefix}-{node.GetId()}-{device.GetIfIndex()}.pcap"
+
+
+class AsciiTraceHelper:
+    """Single shared ascii stream (ascii-trace-helper idiom).
+
+    The filename → stream cache is class-level so two EnableAscii calls
+    naming the same file append to ONE handle instead of the second
+    truncating the first (the upstream single-stream contract)."""
+
+    _streams: dict[str, object] = {}
+
+    def CreateFileStream(self, filename: str):
+        f = AsciiTraceHelper._streams.get(filename)
+        if f is None or f.closed:
+            f = open(filename, "w")
+            AsciiTraceHelper._streams[filename] = f
+
+            def close_and_forget():
+                if not f.closed:
+                    f.close()
+                AsciiTraceHelper._streams.pop(filename, None)
+
+            Simulator.ScheduleDestroy(close_and_forget)
+        return f
+
+    @staticmethod
+    def _line(stream, code: str, path: str, packet) -> None:
+        now_s = Time(Simulator.NowTicks()).GetSeconds()
+        stream.write(f"{code} {now_s:.9f} {path} {packet!r}\n")
+
+    def HookDevice(self, stream, device) -> None:
+        """Wire the standard four event letters for one device."""
+        node_id = device.GetNode().GetId()
+        dev_id = device.GetIfIndex()
+        base = f"/NodeList/{node_id}/DeviceList/{dev_id}"
+        queue = getattr(device, "GetQueue", lambda: None)()
+        if queue is not None:
+            queue.TraceConnectWithoutContext(
+                "Enqueue",
+                lambda p: self._line(stream, "+", f"{base}/TxQueue/Enqueue", p),
+            )
+            queue.TraceConnectWithoutContext(
+                "Dequeue",
+                lambda p: self._line(stream, "-", f"{base}/TxQueue/Dequeue", p),
+            )
+            queue.TraceConnectWithoutContext(
+                "Drop",
+                lambda p: self._line(stream, "d", f"{base}/TxQueue/Drop", p),
+            )
+        device.TraceConnectWithoutContext(
+            "MacRx", lambda p: self._line(stream, "r", f"{base}/MacRx", p)
+        )
+
+
+class PcapHelperForDevice:
+    """Mixin giving device helpers EnablePcap/EnablePcapAll
+    (trace-helper.h).  Subclasses set ``pcap_dlt`` and a device
+    type filter via ``_pcap_device_ok``."""
+
+    pcap_dlt = DLT_RAW
+
+    def _pcap_device_ok(self, device) -> bool:
+        return True
+
+    def EnablePcap(self, prefix: str, devices, promiscuous: bool = True):
+        """``devices``: a NetDeviceContainer, list, or single device."""
+        from tpudes.helper.containers import NetDeviceContainer
+
+        if isinstance(devices, NetDeviceContainer):
+            devices = list(devices)
+        elif not isinstance(devices, (list, tuple)):
+            devices = [devices]
+        helper = PcapHelper()
+        wrappers = []
+        for dev in devices:
+            if not self._pcap_device_ok(dev):
+                continue
+            w = helper.CreateFile(
+                PcapHelper.GetFilenameFromDevice(prefix, dev), self.pcap_dlt
+            )
+            source = "PromiscSniffer" if promiscuous else "Sniffer"
+            dev.TraceConnectWithoutContext(source, w.Write)
+            wrappers.append(w)
+        return wrappers
+
+    def EnablePcapAll(self, prefix: str, promiscuous: bool = True):
+        from tpudes.network.node import NodeList
+
+        devices = []
+        for i in range(NodeList.GetNNodes()):
+            node = NodeList.GetNode(i)
+            for d in range(node.GetNDevices()):
+                devices.append(node.GetDevice(d))
+        return self.EnablePcap(prefix, devices, promiscuous)
+
+    def EnableAscii(self, filename: str, devices):
+        from tpudes.helper.containers import NetDeviceContainer
+
+        if isinstance(devices, NetDeviceContainer):
+            devices = list(devices)
+        elif not isinstance(devices, (list, tuple)):
+            devices = [devices]
+        ascii_helper = AsciiTraceHelper()
+        stream = ascii_helper.CreateFileStream(filename)
+        for dev in devices:
+            if self._pcap_device_ok(dev):
+                ascii_helper.HookDevice(stream, dev)
+        return stream
+
+    def EnableAsciiAll(self, filename: str):
+        from tpudes.network.node import NodeList
+
+        devices = []
+        for i in range(NodeList.GetNNodes()):
+            node = NodeList.GetNode(i)
+            for d in range(node.GetNDevices()):
+                devices.append(node.GetDevice(d))
+        return self.EnableAscii(filename, devices)
