@@ -1,0 +1,371 @@
+/* tpudes native event core: binary-heap scheduler + C dispatch loop.
+ *
+ * Reference parity: src/core/model/heap-scheduler.{h,cc} and the
+ * event-dispatch inner loop of default-simulator-impl.cc (upstream
+ * paths; mount empty at survey - SURVEY.md section 0, 2.1).  Upstream's
+ * engine is C++ end to end; this extension moves the two hot pieces of
+ * the Python engine - the (ts, uid) priority queue and the
+ * pop/advance/invoke loop - into C, leaving model callbacks in Python.
+ *
+ * The heap stores (ts, uid, Event*) with strict (ts, uid) ordering,
+ * identical to Scheduler::EventKey.  Cancellation stays lazy: the loop
+ * checks ev->cancelled at the head, as the Python schedulers do.
+ *
+ * Built by tpudes/core/native.py on first use (plain cc -shared; no
+ * pybind11 dependency - CPython C API only).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+    long long ts;
+    long long uid;
+    PyObject *ev; /* owned reference */
+} HeapEntry;
+
+typedef struct {
+    PyObject_HEAD
+    HeapEntry *a;
+    Py_ssize_t size;
+    Py_ssize_t cap;
+} CHeapObject;
+
+/* interned attribute names, created at module init */
+static PyObject *s_cancelled, *s_fn, *s_args, *s_context, *s_current_ts,
+    *s_current_context, *s_current_uid, *s_event_count, *s_stop,
+    *s_injected;
+
+static inline int entry_lt(const HeapEntry *x, const HeapEntry *y)
+{
+    if (x->ts != y->ts)
+        return x->ts < y->ts;
+    return x->uid < y->uid;
+}
+
+static int cheap_grow(CHeapObject *self)
+{
+    Py_ssize_t ncap = self->cap ? self->cap * 2 : 256;
+    HeapEntry *na = (HeapEntry *)realloc(self->a, ncap * sizeof(HeapEntry));
+    if (!na) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    self->a = na;
+    self->cap = ncap;
+    return 0;
+}
+
+static void sift_up(HeapEntry *a, Py_ssize_t i)
+{
+    HeapEntry v = a[i];
+    while (i > 0) {
+        Py_ssize_t parent = (i - 1) / 2;
+        if (!entry_lt(&v, &a[parent]))
+            break;
+        a[i] = a[parent];
+        i = parent;
+    }
+    a[i] = v;
+}
+
+static void sift_down(HeapEntry *a, Py_ssize_t n, Py_ssize_t i)
+{
+    HeapEntry v = a[i];
+    for (;;) {
+        Py_ssize_t child = 2 * i + 1;
+        if (child >= n)
+            break;
+        if (child + 1 < n && entry_lt(&a[child + 1], &a[child]))
+            child++;
+        if (!entry_lt(&a[child], &v))
+            break;
+        a[i] = a[child];
+        i = child;
+    }
+    a[i] = v;
+}
+
+/* pop the minimum entry; caller takes ownership of the reference */
+static HeapEntry cheap_pop_entry(CHeapObject *self)
+{
+    HeapEntry top = self->a[0];
+    self->size--;
+    if (self->size > 0) {
+        self->a[0] = self->a[self->size];
+        sift_down(self->a, self->size, 0);
+    }
+    return top;
+}
+
+/* drop cancelled heads; returns 0 ok, -1 on python error */
+static int cheap_purge(CHeapObject *self)
+{
+    while (self->size > 0) {
+        PyObject *c = PyObject_GetAttr(self->a[0].ev, s_cancelled);
+        if (!c)
+            return -1;
+        int truth = PyObject_IsTrue(c);
+        Py_DECREF(c);
+        if (truth < 0)
+            return -1;
+        if (!truth)
+            return 0;
+        HeapEntry e = cheap_pop_entry(self);
+        Py_DECREF(e.ev);
+    }
+    return 0;
+}
+
+static PyObject *cheap_insert(CHeapObject *self, PyObject *args)
+{
+    long long ts, uid;
+    PyObject *ev;
+    if (!PyArg_ParseTuple(args, "LLO", &ts, &uid, &ev))
+        return NULL;
+    if (self->size == self->cap && cheap_grow(self) < 0)
+        return NULL;
+    Py_INCREF(ev);
+    self->a[self->size].ts = ts;
+    self->a[self->size].uid = uid;
+    self->a[self->size].ev = ev;
+    sift_up(self->a, self->size);
+    self->size++;
+    Py_RETURN_NONE;
+}
+
+static PyObject *cheap_is_empty(CHeapObject *self, PyObject *noarg)
+{
+    if (cheap_purge(self) < 0)
+        return NULL;
+    return PyBool_FromLong(self->size == 0);
+}
+
+static PyObject *cheap_peek(CHeapObject *self, PyObject *noarg)
+{
+    if (cheap_purge(self) < 0)
+        return NULL;
+    if (self->size == 0) {
+        PyErr_SetString(PyExc_IndexError, "peek on empty heap");
+        return NULL;
+    }
+    Py_INCREF(self->a[0].ev);
+    return self->a[0].ev;
+}
+
+static PyObject *cheap_pop(CHeapObject *self, PyObject *noarg)
+{
+    if (cheap_purge(self) < 0)
+        return NULL;
+    if (self->size == 0) {
+        PyErr_SetString(PyExc_IndexError, "pop on empty heap");
+        return NULL;
+    }
+    HeapEntry e = cheap_pop_entry(self);
+    return e.ev; /* ownership transferred */
+}
+
+static PyObject *cheap_size(CHeapObject *self, PyObject *noarg)
+{
+    return PyLong_FromSsize_t(self->size);
+}
+
+/* run(impl): the engine inner loop.  Pops and invokes events until the
+ * queue drains, impl._stop goes true, or impl._injected is non-empty
+ * (the Python wrapper drains cross-thread injections and re-enters).
+ * Returns the number of events invoked. */
+static PyObject *cheap_run(CHeapObject *self, PyObject *impl)
+{
+    long long invoked = 0;
+    long long base_count;
+    {
+        PyObject *cnt = PyObject_GetAttr(impl, s_event_count);
+        if (!cnt)
+            return NULL;
+        base_count = PyLong_AsLongLong(cnt);
+        Py_DECREF(cnt);
+        if (base_count == -1 && PyErr_Occurred())
+            return NULL;
+    }
+    for (;;) {
+        /* stop flag (callbacks may call Simulator.Stop()) */
+        PyObject *stop = PyObject_GetAttr(impl, s_stop);
+        if (!stop)
+            return NULL;
+        int stopped = PyObject_IsTrue(stop);
+        Py_DECREF(stop);
+        if (stopped < 0)
+            return NULL;
+        if (stopped)
+            break;
+        /* cross-thread injections pending? -> let Python drain them */
+        PyObject *inj = PyObject_GetAttr(impl, s_injected);
+        if (!inj)
+            return NULL;
+        Py_ssize_t n_inj = PyObject_Length(inj);
+        Py_DECREF(inj);
+        if (n_inj < 0)
+            return NULL;
+        if (n_inj > 0)
+            break;
+        if (cheap_purge(self) < 0)
+            return NULL;
+        if (self->size == 0)
+            break;
+        HeapEntry e = cheap_pop_entry(self);
+
+        /* advance engine clock/context/uid and the live event counter
+         * (Simulator.Now / GetEventCount read these from callbacks) */
+        PyObject *ts_o = PyLong_FromLongLong(e.ts);
+        PyObject *uid_o = PyLong_FromLongLong(e.uid);
+        PyObject *cnt_o = PyLong_FromLongLong(base_count + invoked + 1);
+        PyObject *ctx =
+            ts_o && uid_o && cnt_o ? PyObject_GetAttr(e.ev, s_context) : NULL;
+        if (!ctx || PyObject_SetAttr(impl, s_current_ts, ts_o) < 0 ||
+            PyObject_SetAttr(impl, s_current_context, ctx) < 0 ||
+            PyObject_SetAttr(impl, s_current_uid, uid_o) < 0 ||
+            PyObject_SetAttr(impl, s_event_count, cnt_o) < 0) {
+            Py_XDECREF(ts_o);
+            Py_XDECREF(uid_o);
+            Py_XDECREF(cnt_o);
+            Py_XDECREF(ctx);
+            Py_DECREF(e.ev);
+            return NULL;
+        }
+        Py_DECREF(ts_o);
+        Py_DECREF(uid_o);
+        Py_DECREF(cnt_o);
+        Py_DECREF(ctx);
+
+        PyObject *fn = PyObject_GetAttr(e.ev, s_fn);
+        PyObject *fargs = fn ? PyObject_GetAttr(e.ev, s_args) : NULL;
+        Py_DECREF(e.ev);
+        if (!fargs) {
+            Py_XDECREF(fn);
+            return NULL;
+        }
+        PyObject *res = PyObject_CallObject(fn, fargs);
+        Py_DECREF(fn);
+        Py_DECREF(fargs);
+        if (!res)
+            return NULL; /* callback raised */
+        Py_DECREF(res);
+        invoked++;
+    }
+    return PyLong_FromLongLong(invoked);
+}
+
+/* cyclic-GC support: events commonly close over the engine that owns
+ * this heap (impl -> scheduler -> heap -> event.fn -> impl), so the
+ * collector must be able to see through the C array */
+static int cheap_traverse(CHeapObject *self, visitproc visit, void *arg)
+{
+    for (Py_ssize_t i = 0; i < self->size; i++)
+        Py_VISIT(self->a[i].ev);
+    return 0;
+}
+
+static int cheap_clear(CHeapObject *self)
+{
+    Py_ssize_t n = self->size;
+    self->size = 0;
+    for (Py_ssize_t i = 0; i < n; i++)
+        Py_CLEAR(self->a[i].ev);
+    return 0;
+}
+
+static PyObject *cheap_live_count(CHeapObject *self, PyObject *noarg)
+{
+    /* read-only scan; no mutation (len() must not purge) */
+    Py_ssize_t live = 0;
+    for (Py_ssize_t i = 0; i < self->size; i++) {
+        PyObject *c = PyObject_GetAttr(self->a[i].ev, s_cancelled);
+        if (!c)
+            return NULL;
+        int truth = PyObject_IsTrue(c);
+        Py_DECREF(c);
+        if (truth < 0)
+            return NULL;
+        if (!truth)
+            live++;
+    }
+    return PyLong_FromSsize_t(live);
+}
+
+static void cheap_dealloc(CHeapObject *self)
+{
+    PyObject_GC_UnTrack(self);
+    cheap_clear(self);
+    free(self->a);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *cheap_new(PyTypeObject *type, PyObject *args, PyObject *kw)
+{
+    CHeapObject *self = (CHeapObject *)type->tp_alloc(type, 0);
+    if (self) {
+        self->a = NULL;
+        self->size = 0;
+        self->cap = 0;
+    }
+    return (PyObject *)self;
+}
+
+static PyMethodDef cheap_methods[] = {
+    {"insert", (PyCFunction)cheap_insert, METH_VARARGS, "insert(ts, uid, ev)"},
+    {"is_empty", (PyCFunction)cheap_is_empty, METH_NOARGS, "live queue empty?"},
+    {"peek", (PyCFunction)cheap_peek, METH_NOARGS, "next live event"},
+    {"pop", (PyCFunction)cheap_pop, METH_NOARGS, "pop next live event"},
+    {"size", (PyCFunction)cheap_size, METH_NOARGS, "raw entry count"},
+    {"live_count", (PyCFunction)cheap_live_count, METH_NOARGS,
+     "non-cancelled entry count (read-only scan)"},
+    {"run", (PyCFunction)cheap_run, METH_O,
+     "run(impl) -> events invoked; returns on stop/injection/empty"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PyTypeObject CHeapType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "tpudes_event_core.CHeap",
+    .tp_basicsize = sizeof(CHeapObject),
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_doc = "binary heap of (ts, uid, event) with a C dispatch loop",
+    .tp_new = cheap_new,
+    .tp_dealloc = (destructor)cheap_dealloc,
+    .tp_traverse = (traverseproc)cheap_traverse,
+    .tp_clear = (inquiry)cheap_clear,
+    .tp_methods = cheap_methods,
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "tpudes_event_core",
+    "native event heap + dispatch loop", -1, NULL,
+};
+
+PyMODINIT_FUNC PyInit_tpudes_event_core(void)
+{
+    PyObject *m = PyModule_Create(&moduledef);
+    if (!m)
+        return NULL;
+    if (PyType_Ready(&CHeapType) < 0)
+        return NULL;
+    Py_INCREF(&CHeapType);
+    PyModule_AddObject(m, "CHeap", (PyObject *)&CHeapType);
+#define INTERN(var, name)                                                     \
+    if (!(var = PyUnicode_InternFromString(name)))                            \
+        return NULL;
+    INTERN(s_cancelled, "cancelled")
+    INTERN(s_fn, "fn")
+    INTERN(s_args, "args")
+    INTERN(s_context, "context")
+    INTERN(s_current_ts, "current_ts")
+    INTERN(s_current_context, "current_context")
+    INTERN(s_current_uid, "current_uid")
+    INTERN(s_event_count, "_event_count")
+    INTERN(s_stop, "_stop")
+    INTERN(s_injected, "_injected")
+#undef INTERN
+    return m;
+}

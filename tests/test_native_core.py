@@ -1,0 +1,187 @@
+"""Native C event core tests.
+
+The contract: CppHeapScheduler + the C dispatch loop are drop-in
+replacements for the Python heap + Python loop — identical event
+ordering, cancel semantics, stop behavior, injection handling.  The
+rest of the suite exercises the native path implicitly (the default
+SchedulerType upgrades to it), so these tests pin the *equivalence*
+and the explicit fallbacks.
+"""
+
+import random
+
+import pytest
+
+from tpudes.core.event import Event
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.nstime import Seconds
+from tpudes.core.scheduler import HeapScheduler, create_scheduler
+from tpudes.core.simulator import Simulator
+
+native = pytest.importorskip("tpudes.core.native").get_native()
+if native is None:
+    pytest.skip("native event core not built", allow_module_level=True)
+
+from tpudes.core.scheduler import CppHeapScheduler  # noqa: E402
+
+
+def test_default_heap_upgrades_to_native():
+    assert isinstance(
+        create_scheduler("tpudes::HeapScheduler"), CppHeapScheduler
+    )
+    assert isinstance(
+        create_scheduler("tpudes::PyHeapScheduler"), HeapScheduler
+    )
+
+
+def test_native_and_python_heaps_pop_identically():
+    rnd = random.Random(3)
+    events = [
+        Event(rnd.randrange(10_000), uid, 0, lambda: None, ())
+        for uid in range(2_000)
+    ]
+    a, b = CppHeapScheduler(), HeapScheduler()
+    for ev in events:
+        a.Insert(ev)
+        b.Insert(ev)
+    # cancel a random subset through the shared Event objects
+    for ev in rnd.sample(events, 300):
+        ev.cancel()
+    out_a, out_b = [], []
+    while not a.IsEmpty():
+        out_a.append(a.RemoveNext())
+    while not b.IsEmpty():
+        out_b.append(b.RemoveNext())
+    assert [(e.ts, e.uid) for e in out_a] == [(e.ts, e.uid) for e in out_b]
+    assert len(out_a) == 1_700
+
+
+def test_native_run_equals_python_run_event_for_event():
+    """The same scenario through both loops produces the same invocation
+    sequence, timestamps, and final event count."""
+
+    def scenario():
+        log = []
+        impl = Simulator.GetImpl()
+
+        def tick(i):
+            log.append((Simulator.NowTicks(), i, impl.current_context))
+            if i < 50:
+                Simulator.Schedule(Seconds(0.001 * ((i * 7) % 5 + 1)), tick, i + 1)
+                Simulator.ScheduleWithContext(
+                    i % 4, Seconds(0.002), tick, i + 100
+                )
+
+        Simulator.Schedule(Seconds(0.01), tick, 0)
+        Simulator.Stop(Seconds(0.5))
+        Simulator.Run()
+        count = Simulator.GetEventCount()
+        Simulator.Destroy()
+        return log, count
+
+    from tpudes.core.world import reset_world
+
+    reset_world()
+    GlobalValue.Bind("SchedulerType", "tpudes::CppHeapScheduler")
+    log_c, count_c = scenario()
+    reset_world()
+    GlobalValue.Bind("SchedulerType", "tpudes::PyHeapScheduler")
+    log_py, count_py = scenario()
+    assert log_c == log_py
+    assert count_c == count_py
+    assert len(log_c) > 100
+
+
+def test_native_loop_honors_stop_and_event_count():
+    GlobalValue.Bind("SchedulerType", "tpudes::CppHeapScheduler")
+    seen = []
+
+    def cb(i):
+        seen.append((i, Simulator.GetEventCount()))
+        if i == 3:
+            Simulator.Stop()  # immediate stop from inside the C loop
+
+    for i in range(10):
+        Simulator.Schedule(Seconds(0.1 * (i + 1)), cb, i)
+    Simulator.Run()
+    assert [i for i, _ in seen] == [0, 1, 2, 3]
+    # GetEventCount was live inside each callback (ShowProgress contract)
+    assert [c for _, c in seen] == [1, 2, 3, 4]
+
+
+def test_native_loop_yields_for_cross_thread_injection():
+    import threading
+
+    GlobalValue.Bind("SchedulerType", "tpudes::CppHeapScheduler")
+    impl = Simulator.GetImpl()
+    hits = []
+
+    def slow_event():
+        # inject from another thread while the C loop is running
+        t = threading.Thread(
+            target=impl.ScheduleWithContextThreadSafe,
+            args=(7, 0, hits.append, ("injected",)),
+        )
+        t.start()
+        t.join()
+
+    Simulator.Schedule(Seconds(0.1), slow_event)
+    Simulator.Schedule(Seconds(0.2), hits.append, "second")
+    Simulator.Run()
+    assert hits == ["injected", "second"]
+
+
+def test_callback_exception_propagates_cleanly():
+    GlobalValue.Bind("SchedulerType", "tpudes::CppHeapScheduler")
+
+    def boom():
+        raise RuntimeError("inside C loop")
+
+    Simulator.Schedule(Seconds(0.1), boom)
+    with pytest.raises(RuntimeError, match="inside C loop"):
+        Simulator.Run()
+
+
+def test_engine_with_pending_events_is_collectable():
+    """impl → scheduler → CHeap → Event(fn=impl._do_stop) → impl is a
+    cycle; without GC support in the C type the engine leaked per
+    simulation (r4 review, reproduced with a weakref probe)."""
+    import gc
+    import weakref
+
+    GlobalValue.Bind("SchedulerType", "tpudes::CppHeapScheduler")
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Stop(Seconds(2.0))  # stays pending after the first fires
+    Simulator.Run()
+    ref = weakref.ref(Simulator.GetImpl())
+    Simulator.Destroy()
+    gc.collect()
+    assert ref() is None, "engine leaked through the native heap"
+
+
+def test_len_is_live_count_and_read_only():
+    s = CppHeapScheduler()
+    evs = [Event(i, i, 0, lambda: None, ()) for i in range(10)]
+    for ev in evs:
+        s.Insert(ev)
+    evs[0].cancel()
+    evs[5].cancel()
+    assert len(s) == 8
+    # len() must not purge: the cancelled head is still popped over
+    assert s._h.size() == 10
+    assert len(s) == 8
+
+
+def test_no_native_env_falls_back(monkeypatch):
+    import tpudes.core.native as nat
+
+    monkeypatch.setattr(nat, "_tried", False)
+    monkeypatch.setattr(nat, "_cached", None)
+    monkeypatch.setenv("TPUDES_NO_NATIVE", "1")
+    assert nat.get_native() is None
+    assert isinstance(
+        create_scheduler("tpudes::HeapScheduler"), HeapScheduler
+    )
+    # restore the real module for subsequent tests
+    monkeypatch.delenv("TPUDES_NO_NATIVE")
+    monkeypatch.setattr(nat, "_tried", False)

@@ -1,0 +1,104 @@
+"""Loader/builder for the native C event core (native/event_core.c).
+
+The extension is compiled on first use with the system compiler (plain
+``cc -O2 -shared -fPIC`` against the running interpreter's headers — no
+pybind11, no setuptools invocation) into ``native/build/`` and cached
+there keyed by interpreter version.  Everything degrades gracefully:
+if no compiler is present or the build fails, ``get_native()`` returns
+None once, warns once, and the pure-Python schedulers carry on — the
+native core is an accelerator, never a dependency.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+
+_cached: object = None
+_tried = False
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "event_core.c",
+)
+
+
+def _build_dir() -> str:
+    return os.path.join(os.path.dirname(_SRC), "build")
+
+
+def _so_path() -> str:
+    tag = f"cpython-{sys.version_info.major}{sys.version_info.minor}"
+    return os.path.join(_build_dir(), f"tpudes_event_core.{tag}.so")
+
+
+def _compile() -> str | None:
+    so = _so_path()
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
+        return so
+    os.makedirs(_build_dir(), exist_ok=True)
+    cc = (
+        os.environ.get("CC")
+        or sysconfig.get_config_var("CC")
+        or "cc"
+    ).split()[0]
+    include = sysconfig.get_paths()["include"]
+    # atomic publish: concurrent processes (distributed ranks on a fresh
+    # checkout) may race this build — compile to a per-process temp and
+    # rename into place so no one ever dlopens a half-written .so
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = [
+        cc, "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        os.replace(tmp, so)
+    except (OSError, subprocess.SubprocessError) as e:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        detail = getattr(e, "stderr", b"") or b""
+        raise RuntimeError(
+            f"native build failed ({' '.join(cmd)}): {detail.decode()[:500]}"
+        ) from e
+    return so
+
+
+def get_native():
+    """The ``tpudes_event_core`` module, or None when unavailable."""
+    global _cached, _tried
+    if _tried:
+        return _cached
+    _tried = True
+    if os.environ.get("TPUDES_NO_NATIVE"):
+        _cached = None
+        return None
+    try:
+        so = _compile()
+        loader = importlib.machinery.ExtensionFileLoader(
+            "tpudes_event_core", so
+        )
+        spec = importlib.util.spec_from_file_location(
+            "tpudes_event_core", so, loader=loader
+        )
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        _cached = mod
+    except Exception as e:  # noqa: BLE001 — any failure means fallback
+        import warnings
+
+        warnings.warn(
+            f"tpudes native event core unavailable ({e}); "
+            "using the pure-Python schedulers",
+            stacklevel=2,
+        )
+        _cached = None
+    return _cached

@@ -12,9 +12,13 @@ so ``IsEmpty``/``PeekNext``/``RemoveNext`` always reflect live events.
 This matches ns-3 semantics (a cancelled event stays queued and is skipped
 at invoke time) while keeping the queue state self-consistent.
 
-The default is the binary heap (fastest in CPython); a native C++ core
-(tpudes.core.native) replaces it in the engines when the shared library is
-built.
+The default is the binary heap; when the native C event core builds
+(native/event_core.c via tpudes.core.native), ``create_scheduler``
+transparently upgrades the heap selections to :class:`CppHeapScheduler`
+— identical (ts, uid) ordering and lazy-cancel semantics, with the heap
+AND the engine dispatch loop in C (DefaultSimulatorImpl.Run detects it
+and enters the native loop).  ``TPUDES_NO_NATIVE=1`` or
+SchedulerType=tpudes::PyHeapScheduler forces pure Python.
 """
 
 from __future__ import annotations
@@ -224,6 +228,45 @@ class CalendarScheduler(Scheduler):
         return sum(sum(1 for e in b if not e.cancelled) for b in self._buckets)
 
 
+class CppHeapScheduler(Scheduler):
+    """Native binary heap + C dispatch loop (native/event_core.c).
+
+    Same contract as HeapScheduler; ``run_native(impl)`` executes the
+    engine inner loop in C, returning when the queue drains, the stop
+    flag rises, or a cross-thread injection needs the Python drain.
+    """
+
+    __slots__ = ("_h",)
+
+    def __init__(self):
+        from tpudes.core.native import get_native
+
+        native = get_native()
+        if native is None:
+            raise RuntimeError("native event core unavailable")
+        self._h = native.CHeap()
+
+    def Insert(self, ev: Event) -> None:
+        self._h.insert(ev.ts, ev.uid, ev)
+
+    def IsEmpty(self) -> bool:
+        return self._h.is_empty()
+
+    def PeekNext(self) -> Event:
+        return self._h.peek()
+
+    def RemoveNext(self) -> Event:
+        return self._h.pop()
+
+    def run_native(self, impl) -> int:
+        return self._h.run(impl)
+
+    def __len__(self):
+        # live (non-cancelled) count, read-only C scan — matches the
+        # Python schedulers' contract without mutating the queue
+        return self._h.live_count()
+
+
 SCHEDULER_TYPES = {
     "tpudes::HeapScheduler": HeapScheduler,
     "tpudes::MapScheduler": MapScheduler,
@@ -236,11 +279,26 @@ SCHEDULER_TYPES = {
     "ns3::ListScheduler": ListScheduler,
     "ns3::CalendarScheduler": CalendarScheduler,
     "ns3::PriorityQueueScheduler": PriorityQueueScheduler,
+    # explicit selections bypassing the native upgrade / fallback
+    "tpudes::PyHeapScheduler": HeapScheduler,
+    "tpudes::CppHeapScheduler": CppHeapScheduler,
+}
+
+#: heap-semantics selections that silently upgrade to the native core
+_NATIVE_UPGRADABLE = {
+    "tpudes::HeapScheduler", "ns3::HeapScheduler",
+    "tpudes::MapScheduler", "ns3::MapScheduler",
+    "tpudes::PriorityQueueScheduler", "ns3::PriorityQueueScheduler",
 }
 
 
 def create_scheduler(type_name: str) -> Scheduler:
-    try:
-        return SCHEDULER_TYPES[type_name]()
-    except KeyError:
-        raise ValueError(f"unknown SchedulerType {type_name!r}") from None
+    cls = SCHEDULER_TYPES.get(type_name)
+    if cls is None:
+        raise ValueError(f"unknown SchedulerType {type_name!r}")
+    if type_name in _NATIVE_UPGRADABLE:
+        from tpudes.core.native import get_native
+
+        if get_native() is not None:
+            return CppHeapScheduler()
+    return cls()

@@ -164,6 +164,17 @@ class DefaultSimulatorImpl(SimulatorImpl):
     def Run(self) -> None:
         self._stop = False
         events = self._events
+        run_native = getattr(events, "run_native", None)
+        if run_native is not None:
+            # C dispatch loop; it hands control back whenever a
+            # cross-thread injection arrives, the stop flag rises, or
+            # the queue drains
+            while not self._stop:
+                self._process_events_with_context()
+                if events.IsEmpty():
+                    break
+                run_native(self)
+            return
         while not self._stop:
             self._process_events_with_context()
             if events.IsEmpty():
