@@ -1,0 +1,173 @@
+"""lena-simple-epc: LTE radio + EPC core + remote host traffic.
+
+The full BASELINE config #4 shape; upstream analog:
+src/lte/examples/lena-simple-epc.cc — a remote host behind a
+point-to-point backhaul to the PGW sends downlink UDP to every UE, and
+every UE sends uplink UDP back, all through the EPC bearers.
+
+Run: python examples/lena-simple-epc.py --nEnbs=2 --uesPerCell=3 --simTime=0.5
+
+With --speed > 0 the UEs drive toward the last cell and hand over
+mid-run (A3-RSRP + X2-lite):
+
+    python examples/lena-simple-epc.py --nEnbs=2 --uesPerCell=2 \
+        --simTime=2 --speed=50 --rlcMode=am
+"""
+
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpudes.core import CommandLine, Seconds, Simulator
+from tpudes.helper.applications import UdpClientHelper, UdpServerHelper
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.helper.point_to_point import PointToPointHelper
+from tpudes.models.internet.ipv4 import Ipv4L3Protocol, Ipv4StaticRouting
+from tpudes.models.lte import LteHelper
+from tpudes.models.lte.epc import EpcHelper
+from tpudes.models.mobility import (
+    ConstantVelocityMobilityModel,
+    ListPositionAllocator,
+    MobilityHelper,
+    Vector,
+)
+from tpudes.network.address import Ipv4Address, Ipv4Mask
+
+
+def main(argv=None):
+    cmd = CommandLine()
+    cmd.AddValue("nEnbs", "eNBs on a line", 2)
+    cmd.AddValue("uesPerCell", "UEs per cell", 3)
+    cmd.AddValue("simTime", "simulated seconds", 0.5)
+    cmd.AddValue("interSite", "inter-site distance (m)", 500.0)
+    cmd.AddValue("speed", "UE speed toward the last cell (m/s)", 0.0)
+    cmd.AddValue("rlcMode", "um | am", "um")
+    cmd.Parse(argv)
+    n_enbs = int(cmd.nEnbs)
+    per_cell = int(cmd.uesPerCell)
+    sim_time = float(cmd.simTime)
+    speed = float(cmd.speed)
+
+    lte = LteHelper()
+    epc = EpcHelper()
+
+    # remote host behind a 100 Gbps / 10 ms backhaul to the PGW
+    remote = NodeContainer()
+    remote.Create(1)
+    InternetStackHelper().Install(remote)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "100Gbps")
+    p2p.SetChannelAttribute("Delay", "10ms")
+    backhaul = p2p.Install(remote.Get(0), epc.GetPgwNode())
+    addr = Ipv4AddressHelper("1.0.0.0", "255.0.0.0")
+    internet_ifc = addr.Assign(backhaul)
+    # route the UE network through the PGW
+    remote_routing = remote.Get(0).GetObject(Ipv4L3Protocol).GetRoutingProtocol()
+    assert isinstance(remote_routing, Ipv4StaticRouting)
+    remote_routing.AddNetworkRouteTo(
+        Ipv4Address(EpcHelper.UE_NETWORK), Ipv4Mask(EpcHelper.UE_MASK),
+        remote.Get(0).GetObject(Ipv4L3Protocol).GetInterfaceForDevice(
+            backhaul.Get(0)
+        ),
+        gateway=internet_ifc.GetAddress(1),
+    )
+
+    enb_nodes = NodeContainer()
+    enb_nodes.Create(n_enbs)
+    ue_nodes = NodeContainer()
+    ue_nodes.Create(n_enbs * per_cell)
+    ea = ListPositionAllocator()
+    for i in range(n_enbs):
+        ea.Add(Vector(i * float(cmd.interSite), 0.0, 30.0))
+    me = MobilityHelper()
+    me.SetPositionAllocator(ea)
+    me.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    me.Install(enb_nodes)
+    ua = ListPositionAllocator()
+    for c in range(n_enbs):
+        for k in range(per_cell):
+            a = 2 * math.pi * k / max(per_cell, 1)
+            ua.Add(Vector(
+                c * float(cmd.interSite) + 80.0 * math.cos(a),
+                80.0 * math.sin(a), 1.5,
+            ))
+    mu = MobilityHelper()
+    mu.SetPositionAllocator(ua)
+    mu.SetMobilityModel(
+        "tpudes::ConstantVelocityMobilityModel"
+        if speed > 0
+        else "tpudes::ConstantPositionMobilityModel"
+    )
+    mu.Install(ue_nodes)
+    if speed > 0:
+        for i in range(ue_nodes.GetN()):
+            ue_nodes.Get(i).GetObject(ConstantVelocityMobilityModel).SetVelocity(
+                Vector(speed, 0.0, 0.0)
+            )
+        lte.SetHandoverAlgorithmType("tpudes::A3RsrpHandoverAlgorithm")
+        lte.SetHandoverAlgorithmAttribute("TimeToTrigger", 160)
+        lte.AddX2Interface(enb_nodes)
+
+    lte.InstallEnbDevice(enb_nodes)
+    ue_devs = lte.InstallUeDevice(ue_nodes)
+    InternetStackHelper().Install(ue_nodes)
+    ue_list = [ue_devs.Get(i) for i in range(ue_devs.GetN())]
+    lte.Attach(ue_list)
+    lte.ActivateDataRadioBearer(ue_list, mode=str(cmd.rlcMode))
+    ue_addrs = epc.AssignUeIpv4Address(ue_list)
+    epc.wire_enbs([lte.controller.enbs[i] for i in range(n_enbs)])
+
+    # downlink: remote host → each UE; uplink: each UE → remote host
+    dl_rx = [0] * len(ue_list)
+    ul_server = UdpServerHelper(2000)
+    ul_apps = ul_server.Install(remote.Get(0))
+    ul_apps.Start(Seconds(0.0))
+    for i, ue_addr in enumerate(ue_addrs):
+        server = UdpServerHelper(1000 + i)
+        sapps = server.Install(ue_nodes.Get(i))
+        sapps.Start(Seconds(0.0))
+        sapps.Get(0).TraceConnectWithoutContext(
+            "Rx", lambda pkt, *a, i=i: dl_rx.__setitem__(i, dl_rx[i] + 1)
+        )
+        dl = UdpClientHelper(ue_addr, 1000 + i)
+        dl.SetAttribute("MaxPackets", 0)
+        dl.SetAttribute("Interval", Seconds(0.02))
+        dl.SetAttribute("PacketSize", 400)
+        dapps = dl.Install(remote.Get(0))
+        dapps.Start(Seconds(0.05))
+        dapps.Stop(Seconds(sim_time))
+        ul = UdpClientHelper(internet_ifc.GetAddress(0), 2000)
+        ul.SetAttribute("MaxPackets", 0)
+        ul.SetAttribute("Interval", Seconds(0.04))
+        ul.SetAttribute("PacketSize", 200)
+        uapps = ul.Install(ue_nodes.Get(i))
+        uapps.Start(Seconds(0.06))
+        uapps.Stop(Seconds(sim_time))
+
+    wall0 = time.monotonic()
+    Simulator.Stop(Seconds(sim_time))
+    Simulator.Run()
+    wall = time.monotonic() - wall0
+
+    ul_rx = ul_apps.Get(0).received
+    c = lte.controller
+    print(
+        f"enbs={n_enbs} ues={len(ue_list)} rlc={cmd.rlcMode} "
+        f"dl_rx={sum(dl_rx)} (per-UE min={min(dl_rx)}) ul_rx={ul_rx} "
+        f"handovers={c.stats['handovers']} "
+        f"ttis={c.stats['ttis']} wall={wall:.1f}s"
+    )
+    if c.handover_log:
+        for tti, imsi, src, dst in c.handover_log:
+            print(f"  t={tti / 1000.0:.3f}s imsi={imsi} cell {src} -> {dst}")
+    ok = sum(dl_rx) > 0 and ul_rx > 0 and min(dl_rx) > 0
+    Simulator.Destroy()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,10 +15,12 @@ from tpudes.models.lte.device import (
     RadioBearer,
 )
 from tpudes.models.lte.epc import EpcHelper, PgwNetDevice
+from tpudes.models.lte.handover import A3RsrpHandoverAlgorithm
 from tpudes.models.lte.helper import LteHelper, RadioEnvironmentMapHelper
 from tpudes.models.lte.phy import LteEnbPhy, LteSpectrumPhy, LteUePhy
 from tpudes.models.lte.rlc import (
     LtePdcp,
+    LteRlcAm,
     LteRlcSm,
     LteRlcTm,
     LteRlcUm,
@@ -32,6 +34,6 @@ __all__ = [
     "LteTtiController", "LteEnbNetDevice", "LteEnbRrc", "LteUeNetDevice",
     "LteUeRrc", "RadioBearer", "EpcHelper", "PgwNetDevice", "LteHelper",
     "RadioEnvironmentMapHelper", "LteEnbPhy", "LteSpectrumPhy", "LteUePhy",
-    "LtePdcp", "LteRlcSm", "LteRlcTm", "LteRlcUm", "PfFfMacScheduler",
-    "RrFfMacScheduler",
+    "LtePdcp", "LteRlcAm", "LteRlcSm", "LteRlcTm", "LteRlcUm",
+    "A3RsrpHandoverAlgorithm", "PfFfMacScheduler", "RrFfMacScheduler",
 ]

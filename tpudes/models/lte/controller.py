@@ -72,10 +72,13 @@ class LteTtiController:
         self._cqi_queue: list = []    # (apply_tti, cqi_dl, cqi_ul)
         self._key = None
         self._jit_step = None
+        self.handover_algorithm = None   # set via LteHelper
+        self.x2_enabled = False          # AddX2Interface arms execution
+        self.handover_log: list = []     # (tti, imsi, from_cell, to_cell)
         self.stats = {
             "dl_tbs": 0, "dl_ok": 0, "dl_harq_retx": 0, "dl_drops": 0,
             "ul_tbs": 0, "ul_ok": 0, "ul_harq_retx": 0, "ul_drops": 0,
-            "ttis": 0,
+            "ttis": 0, "handovers": 0,
         }
 
     # --- wiring -----------------------------------------------------------
@@ -333,6 +336,61 @@ class LteTtiController:
                     tx_psd[ue_i, rbs] = p_w / (len(rbs) * RB_BANDWIDTH_HZ)
         return alloc, mcs, tb_bits, mi_acc, tx_psd, tb_by_ue
 
+    # --- handover (A3 measurement + X2-lite execution) --------------------
+    def _evaluate_handover(self) -> None:
+        from tpudes.models.lte.handover import MEASUREMENT_PERIOD_TTIS
+
+        if (
+            self.handover_algorithm is None
+            or not self.x2_enabled
+            or self.tti % MEASUREMENT_PERIOD_TTIS != 0
+            or self._gain_dl is None
+            or len(self.enbs) < 2
+        ):
+            return
+        # RSRP per (E, U) from the already-batched gain matrix
+        tx_dbm = np.array([e.phy.tx_power_dbm for e in self.enbs])
+        rsrp_dbm = tx_dbm[:, None] + 10.0 * np.log10(
+            np.maximum(self._gain_dl, 1e-30)
+        )
+        moves = []
+        for u_i, ue in enumerate(self.ues):
+            s = int(self._serving[u_i])
+            if s < 0:
+                continue
+            target = self.handover_algorithm.evaluate(
+                self.tti, u_i, s, rsrp_dbm[:, u_i]
+            )
+            if target is not None and target != s:
+                moves.append((u_i, s, target))
+        for u_i, s, target in moves:
+            self._execute_handover(u_i, s, target)
+
+    def _execute_handover(self, ue_index: int, src_idx: int, dst_idx: int):
+        """X2-lite: move the UeContext (bearers intact — the lossless
+        forwarding analog), flush in-flight HARQ at the source (the MAC
+        reset), reconnect the UE, mark geometry dirty."""
+        ue = self.ues[ue_index]
+        source, target = self.enbs[src_idx], self.enbs[dst_idx]
+        ctx = source.rrc.remove_ue(ue.rrc.rnti)
+        if ctx is None:
+            return
+        for harq_map in (self._harq_dl, self._harq_ul):
+            harq_map[src_idx] = [
+                tb for tb in harq_map[src_idx]
+                if tb.rnti_ue_index != ue_index
+            ]
+        new_ctx = target.rrc.add_ue(ue)
+        new_ctx.bearers = ctx.bearers
+        for b in new_ctx.bearers.values():
+            b.ul_rx.rx_sdu_callback = target.receive_ul_sdu
+        ue.rrc.connect(target, new_ctx.rnti)
+        self.stats["handovers"] += 1
+        self.handover_log.append(
+            (self.tti, ue.GetImsi(), source.GetCellId(), target.GetCellId())
+        )
+        self._dirty = True
+
     # --- the TTI event ----------------------------------------------------
     def _tti_event(self) -> None:
         import jax
@@ -344,6 +402,9 @@ class LteTtiController:
             self._rebuild()
         elif not self._static_geometry:
             self._rebuild()
+        self._evaluate_handover()
+        if self._dirty:
+            self._rebuild()  # a handover just moved serving cells
         u, e = len(self.ues), len(self.enbs)
         if u and e:
             self.stats["ttis"] += 1

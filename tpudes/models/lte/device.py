@@ -45,6 +45,11 @@ class RadioBearer:
         b.ul_tx, b.ul_rx = make_rlc(mode), make_rlc(mode)
         b.dl_pdcp = LtePdcp(b.dl_tx)
         b.ul_pdcp = LtePdcp(b.ul_tx)
+        if mode == "am":
+            # AM STATUS reports travel the reverse control channel back
+            # to the same direction's transmitter
+            b.dl_rx.status_callback = b.dl_tx.ReceiveStatus
+            b.ul_rx.status_callback = b.ul_tx.ReceiveStatus
         return b
 
 
@@ -71,6 +76,11 @@ class LteEnbRrc:
         ctx = UeContext(rnti, ue_device)
         self.ues[rnti] = ctx
         return ctx
+
+    def remove_ue(self, rnti: int) -> "UeContext | None":
+        """Handover departure: drop the context (the caller carries the
+        bearers to the target cell)."""
+        return self.ues.pop(rnti, None)
 
     def setup_bearer(self, ctx: UeContext, mode: str) -> RadioBearer:
         lcid = 3 + len(ctx.bearers)  # LCID 1-2 reserved for SRBs

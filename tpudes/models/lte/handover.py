@@ -1,0 +1,84 @@
+"""Handover algorithms + X2-lite execution.
+
+Reference parity: src/lte/model/a3-rsrp-handover-algorithm.{h,cc},
+a2-a4-rsrq-handover-algorithm.{h,cc}, lte-enb-rrc.cc handover
+preparation/execution and epc-x2.{h,cc} (upstream paths; mount empty at
+survey — SURVEY.md §0, §2.6 "Handover & FFR" row).
+
+The A3 event (TS 36.331): a neighbour's RSRP exceeds the serving cell's
+by ``Hysteresis`` continuously for ``TimeToTrigger`` → hand the UE
+over.  Measurements come from the controller's batched gain matrix
+(already rebuilt per TTI under mobility), evaluated every
+``MEASUREMENT_PERIOD_TTIS`` — the analog of upstream's filtered
+measurement reports.
+
+X2-lite execution: upstream runs an over-the-air RRC reconfiguration +
+X2 SN-status transfer + data forwarding.  Here the handover is the
+ideal-RRC equivalent (matching the module's ideal RRC everywhere): the
+UeContext's bearers move wholesale to the target cell in one event, so
+PDCP/RLC state (including AM retransmission buffers) survives — the
+"lossless handover" X2 forwarding achieves; in-flight HARQ processes at
+the source are flushed, as upstream's MAC reset does.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.object import Object, TypeId
+
+MEASUREMENT_PERIOD_TTIS = 40  # ≈ upstream's 200 ms layer-3 filter cadence / 5
+
+
+class LteHandoverAlgorithm(Object):
+    tid = TypeId("tpudes::LteHandoverAlgorithm")
+
+    def evaluate(self, tti: int, ue_index: int, serving: int,
+                 rsrp_dbm_row) -> int | None:
+        """-> target eNB index, or None to stay."""
+        raise NotImplementedError
+
+
+class A3RsrpHandoverAlgorithm(LteHandoverAlgorithm):
+    tid = (
+        TypeId("tpudes::A3RsrpHandoverAlgorithm")
+        .SetParent(LteHandoverAlgorithm.tid)
+        .AddConstructor(lambda **kw: A3RsrpHandoverAlgorithm(**kw))
+        .AddAttribute("Hysteresis", "A3 offset (dB)", 3.0, field="hysteresis_db")
+        .AddAttribute(
+            "TimeToTrigger", "sustained-condition time (ms)", 256,
+            field="time_to_trigger_ms",
+        )
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        #: (ue_index, target) -> tti when the A3 condition first held
+        self._entered: dict[tuple[int, int], int] = {}
+
+    def evaluate(self, tti: int, ue_index: int, serving: int, rsrp_dbm_row):
+        import numpy as np
+
+        best = int(np.argmax(rsrp_dbm_row))
+        # the A3 condition must hold CONTINUOUSLY for one target: any
+        # tracked entry for a different target has lapsed — drop it, or
+        # a stale start time re-triggers "instantly" on re-entry
+        # (r4 review)
+        for key in [k for k in self._entered
+                    if k[0] == ue_index and k[1] != best]:
+            del self._entered[key]
+        if best == serving:
+            return None
+        if rsrp_dbm_row[best] < rsrp_dbm_row[serving] + self.hysteresis_db:
+            self._entered.pop((ue_index, best), None)
+            return None
+        key = (ue_index, best)
+        start = self._entered.setdefault(key, tti)
+        if tti - start >= self.time_to_trigger_ms:  # 1 TTI = 1 ms
+            del self._entered[key]
+            return best
+        return None
+
+
+HANDOVER_ALGORITHMS = {
+    "tpudes::A3RsrpHandoverAlgorithm": A3RsrpHandoverAlgorithm,
+    "ns3::A3RsrpHandoverAlgorithm": A3RsrpHandoverAlgorithm,
+}

@@ -54,6 +54,24 @@ class LteHelper:
         self.pathloss = model
         self.controller.pathloss = model
 
+    # --- handover (upstream LteHelper API shape) --------------------------
+    def SetHandoverAlgorithmType(self, type_name: str) -> None:
+        from tpudes.models.lte.handover import HANDOVER_ALGORITHMS
+
+        if type_name not in HANDOVER_ALGORITHMS:
+            raise ValueError(f"unknown handover algorithm {type_name!r}")
+        self.controller.handover_algorithm = HANDOVER_ALGORITHMS[type_name]()
+
+    def SetHandoverAlgorithmAttribute(self, name: str, value) -> None:
+        if self.controller.handover_algorithm is None:
+            raise RuntimeError("SetHandoverAlgorithmType first")
+        self.controller.handover_algorithm.SetAttribute(name, value)
+
+    def AddX2Interface(self, _enb_nodes=None) -> None:
+        """Arm handover execution (the X2-lite path); without it the
+        algorithm never fires, as upstream without X2 links."""
+        self.controller.x2_enabled = True
+
     # --- install ----------------------------------------------------------
     def InstallEnbDevice(self, nodes) -> NetDeviceContainer:
         devices = NetDeviceContainer()

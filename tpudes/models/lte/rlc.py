@@ -41,6 +41,82 @@ class RlcPdu:
     sn: int
     segments: list[RlcSegment] = field(default_factory=list)
     size_bytes: int = 0     # on-air size incl. headers
+    # AM re-segmentation (TS 36.322 SO/LSF analog): a retransmitted PDU
+    # may be split into byte-range parts sharing the SN
+    part_start: int = 0     # first payload byte of the original PDU
+    sn_total_bytes: int = 0  # payload bytes of the whole original PDU
+
+
+def _segment_from_queue(queue: deque, room: int, pdu: RlcPdu) -> int:
+    """Shared UM/AM segmentation+concatenation loop: fill ``pdu`` with
+    up to ``room`` payload bytes from the SDU queue (entries are
+    ``[packet, offset]``); returns the unused room.  (r4 review: one
+    copy, not two drifting ones.)"""
+    while room > 0 and queue:
+        entry = queue[0]
+        packet, offset = entry
+        take = min(room, packet.GetSize() - offset)
+        pdu.segments.append(
+            RlcSegment(
+                packet, offset, offset + take,
+                is_first=(offset == 0),
+                is_last=(offset + take == packet.GetSize()),
+            )
+        )
+        entry[1] += take
+        room -= take
+        if entry[1] == packet.GetSize():
+            queue.popleft()
+        if room > 0 and queue:
+            room -= RLC_SEGMENT_OVERHEAD_BYTES  # LI for the next SDU
+    return room
+
+
+def _interval_subtract(iv: tuple, cov: list) -> list:
+    """Parts of [iv) not covered by the disjoint sorted interval list."""
+    out = []
+    a, b = iv
+    for ca, cb in cov:
+        if cb <= a or ca >= b:
+            continue
+        if ca > a:
+            out.append((a, ca))
+        a = max(a, cb)
+        if a >= b:
+            break
+    if a < b:
+        out.append((a, b))
+    return out
+
+
+def _interval_insert(cov: list, iv: tuple) -> None:
+    """Insert [iv) into the disjoint sorted interval list, merging."""
+    cov.append(iv)
+    cov.sort()
+    merged = [cov[0]]
+    for a, b in cov[1:]:
+        la, lb = merged[-1]
+        if a <= lb:
+            merged[-1] = (la, max(lb, b))
+        else:
+            merged.append((a, b))
+    cov[:] = merged
+
+
+def _reassemble_segment(acc: dict, seg: RlcSegment, deliver) -> None:
+    """Shared UM/AM reassembly step: account ``seg`` into the per-SDU
+    accumulator and hand complete SDUs to ``deliver``."""
+    uid = seg.packet.GetUid()
+    if seg.is_first:
+        acc[uid] = [seg.packet, 0]
+    slot = acc.get(uid)
+    if slot is None:
+        return  # head segment was lost; discard the tail
+    slot[1] += seg.size
+    if seg.is_last:
+        packet, seen = acc.pop(uid)
+        if seen == packet.GetSize() and deliver is not None:
+            deliver(packet.Copy())
 
 
 class LteRlc:
@@ -124,27 +200,10 @@ class LteRlcUm(LteRlc):
         if room <= 0 or not self._queue:
             return None
         pdu = RlcPdu(sn=self._vt_us)
-        while room > 0 and self._queue:
-            entry = self._queue[0]
-            packet, offset = entry
-            remaining = packet.GetSize() - offset
-            take = min(room, remaining)
-            pdu.segments.append(
-                RlcSegment(
-                    packet,
-                    offset,
-                    offset + take,
-                    is_first=(offset == 0),
-                    is_last=(offset + take == packet.GetSize()),
-                )
-            )
-            entry[1] += take
-            room -= take
-            self.tx_queue_bytes -= take
-            if entry[1] == packet.GetSize():
-                self._queue.popleft()
-            if room > 0 and self._queue:
-                room -= RLC_SEGMENT_OVERHEAD_BYTES  # LI for the next SDU
+        before = self.tx_queue_bytes
+        room = _segment_from_queue(self._queue, room, pdu)
+        taken = sum(s.size for s in pdu.segments)
+        self.tx_queue_bytes = before - taken
         if not pdu.segments:
             return None
         self._vt_us = (self._vt_us + 1) % self.SN_MOD
@@ -163,17 +222,295 @@ class LteRlcUm(LteRlc):
             self._acc.clear()
         self._vr_ur = (pdu.sn + 1) % self.SN_MOD
         for seg in pdu.segments:
-            uid = seg.packet.GetUid()
-            if seg.is_first:
-                self._acc[uid] = [seg.packet, 0]
-            slot = self._acc.get(uid)
-            if slot is None:
-                continue  # first segment was lost; discard the tail
-            slot[1] += seg.size
-            if seg.is_last:
-                packet, seen = self._acc.pop(uid)
-                if seen == packet.GetSize() and self.rx_sdu_callback is not None:
-                    self.rx_sdu_callback(packet.Copy())
+            _reassemble_segment(self._acc, seg, self.rx_sdu_callback)
+
+
+class LteRlcAm(LteRlc):
+    """Acknowledged mode (lte-rlc-am.cc): UM-style segmentation plus a
+    retransmission protocol — the receiver reports STATUS (ack + nack
+    list) over the reverse control channel and the sender retransmits
+    nacked PDUs up to ``MAX_RETX`` times, so SDUs survive PDU loss.
+
+    Protocol machinery mirrored from TS 36.322 (each with its upstream
+    analog named):
+    - **re-segmentation**: a retransmission that does not fit the MAC
+      opportunity is split into byte-range parts sharing the SN (the
+      SO/LSF resegmentation), so a shrinking CQI can never stall the
+      bearer behind an oversized PDU;
+    - **poll-retransmit timer**: ``POLL_RETRANSMIT_MS`` after a
+      transmission with data still unacknowledged, the oldest unacked
+      SN is retransmitted unprompted (t-PollRetransmit), covering the
+      lost-tail-PDU case STATUS alone cannot;
+    - **NACK suppression**: NACKs arriving within
+      ``NACK_IGNORE_WINDOW_MS`` of that SN's last (re)transmission are
+      ignored (the tx-side equivalent of t-StatusProhibit), so the
+      per-PDU STATUS cadence cannot flood duplicates to MAX_RETX.
+
+    Documented deviations: STATUS rides an ideal control channel with a
+    fixed ``STATUS_DELAY_MS`` latency (upstream multiplexes it into the
+    MAC uplink), and sequence numbers are unbounded ints (upstream:
+    10-bit with a 512-PDU window) — identical behavior while in-flight
+    stays below upstream's window, which the scheduler guarantees.
+    """
+
+    mode = "am"
+    RLC_AM_HEADER_BYTES = 4
+    MAX_RETX = 5
+    STATUS_DELAY_MS = 2
+    POLL_RETRANSMIT_MS = 40
+    NACK_IGNORE_WINDOW_MS = 12  # > STATUS_DELAY + HARQ RTT
+
+    def __init__(self):
+        super().__init__()
+        self._queue: deque = deque()       # [packet, offset] new SDUs
+        self._vt_s = 0                     # next new SN
+        #: sn -> list[RlcPdu] parts still unacknowledged (1 part unless
+        #: re-segmented)
+        self._unacked: dict[int, list] = {}
+        #: retx queue entries: [sn, list-of-parts-still-to-send]
+        self._retx: deque = deque()
+        self._retx_count: dict[int, int] = {}
+        self._last_tx_ms: dict[int, float] = {}
+        self._poll_gen = 0                 # invalidates stale poll timers
+        self.stats_retx_pdus = 0
+        self.stats_dropped_pdus = 0
+        # rx state: per-SN byte-interval coverage (the SO-based
+        # reassembly — overlapping retransmitted parts contribute only
+        # their novel byte ranges)
+        self._rx_cov: dict[int, list] = {}     # sn -> [(a, b)] disjoint
+        self._rx_segs: dict[int, list] = {}    # sn -> [(pdu_off, seg)]
+        self._rx_total: dict[int, int] = {}    # sn -> sn_total_bytes
+        self._vr_r = 0                     # next in-order SN to deliver
+        self._vr_h = 0                     # highest received + 1
+        self._acc: dict[int, list] = {}
+        self.status_callback = None        # cb(ack_sn, nack_list) -> peer
+
+    # --- tx ---
+    def TransmitPdcpPdu(self, packet) -> None:
+        self._queue.append([packet, 0])
+        self.tx_queue_bytes += packet.GetSize()
+
+    def BufferBytes(self) -> int:
+        retx = sum(
+            p.size_bytes for _sn, pending in self._retx for p in pending
+        )
+        return self.tx_queue_bytes + retx
+
+    def _now_ms(self) -> float:
+        from tpudes.core.simulator import Simulator
+
+        return Simulator.NowTicks() / 1e6
+
+    def _arm_poll(self) -> None:
+        from tpudes.core.nstime import MilliSeconds
+        from tpudes.core.simulator import Simulator
+
+        self._poll_gen += 1
+        Simulator.Schedule(
+            MilliSeconds(self.POLL_RETRANSMIT_MS),
+            self._poll_timeout, self._poll_gen,
+        )
+
+    def _poll_timeout(self, gen: int) -> None:
+        """t-PollRetransmit: nothing acked since the last transmission —
+        nudge the oldest unacked SN back onto the retx queue."""
+        if gen != self._poll_gen or not self._unacked:
+            return
+        sn = min(self._unacked)
+        if sn not in self._retx:
+            self._bump_retx(sn)
+        if self._unacked:
+            self._arm_poll()
+
+    def _bump_retx(self, sn: int) -> None:
+        self._retx_count[sn] = self._retx_count.get(sn, 0) + 1
+        if self._retx_count[sn] > self.MAX_RETX:
+            self._unacked.pop(sn, None)
+            self._retx_count.pop(sn, None)
+            self.stats_dropped_pdus += 1
+        else:
+            self._retx.append([sn, list(self._unacked[sn])])
+
+    @staticmethod
+    def _split_pdu(pdu: RlcPdu, fit_bytes: int) -> tuple[RlcPdu, RlcPdu]:
+        """Re-segment ``pdu`` at ``fit_bytes`` payload bytes: two parts
+        sharing the SN, byte ranges contiguous (SO/LSF analog)."""
+        head = RlcPdu(
+            sn=pdu.sn, part_start=pdu.part_start,
+            sn_total_bytes=pdu.sn_total_bytes,
+        )
+        tail = RlcPdu(
+            sn=pdu.sn, part_start=pdu.part_start + fit_bytes,
+            sn_total_bytes=pdu.sn_total_bytes,
+        )
+        remaining = fit_bytes
+        for seg in pdu.segments:
+            if remaining >= seg.size:
+                head.segments.append(seg)
+                remaining -= seg.size
+            elif remaining > 0:
+                mid = seg.start + remaining
+                head.segments.append(
+                    RlcSegment(seg.packet, seg.start, mid, seg.is_first, False)
+                )
+                tail.segments.append(
+                    RlcSegment(seg.packet, mid, seg.end, False, seg.is_last)
+                )
+                remaining = 0
+            else:
+                tail.segments.append(seg)
+        head.size_bytes = fit_bytes + LteRlcAm.RLC_AM_HEADER_BYTES
+        tail.size_bytes = (
+            sum(s.size for s in tail.segments) + LteRlcAm.RLC_AM_HEADER_BYTES
+        )
+        return head, tail
+
+    def NotifyTxOpportunity(self, nbytes: int) -> RlcPdu | None:
+        # retransmissions first (upstream: retx queue outranks new data)
+        while self._retx:
+            entry = self._retx[0]
+            sn, pending = entry
+            if sn not in self._unacked:
+                self._retx.popleft()       # acked while queued
+                continue
+            if not pending:
+                self._retx.popleft()       # every part sent this round
+                continue
+            pdu = pending[0]
+            if pdu.size_bytes > nbytes:
+                fit = nbytes - self.RLC_AM_HEADER_BYTES
+                if fit <= 0:
+                    return None
+                head, tail = self._split_pdu(pdu, fit)
+                # refine the stored partition AND the pending list
+                stored = self._unacked[sn]
+                idx = next(
+                    (i for i, p in enumerate(stored)
+                     if p.part_start == pdu.part_start), None,
+                )
+                if idx is not None:
+                    stored[idx : idx + 1] = [head, tail]
+                pending[0:1] = [head, tail]
+                pdu = head
+            pending.pop(0)
+            self._last_tx_ms[sn] = self._now_ms()
+            self.stats_retx_pdus += 1
+            self.stats_tx_pdus += 1
+            self.stats_tx_bytes += pdu.size_bytes
+            self._arm_poll()
+            return pdu
+        room = nbytes - self.RLC_AM_HEADER_BYTES
+        if room <= 0 or not self._queue:
+            return None
+        pdu = RlcPdu(sn=self._vt_s)
+        room = _segment_from_queue(self._queue, room, pdu)
+        taken = sum(s.size for s in pdu.segments)
+        self.tx_queue_bytes -= taken
+        if not pdu.segments:
+            return None
+        pdu.size_bytes = nbytes - room if room > 0 else nbytes
+        pdu.sn_total_bytes = taken
+        self._unacked[self._vt_s] = [pdu]
+        self._retx_count[self._vt_s] = 0
+        self._last_tx_ms[self._vt_s] = self._now_ms()
+        self._vt_s += 1
+        self.stats_tx_pdus += 1
+        self.stats_tx_bytes += pdu.size_bytes
+        self._arm_poll()
+        return pdu
+
+    def ReceiveStatus(self, ack_sn: int, nacks: list[int]) -> None:
+        """STATUS from the peer: everything below ``ack_sn`` arrived
+        except the SNs in ``nacks``."""
+        nackset = set(nacks)
+        for sn in [s for s in self._unacked if s < ack_sn and s not in nackset]:
+            self._unacked.pop(sn)
+            self._retx_count.pop(sn, None)
+            self._last_tx_ms.pop(sn, None)
+        now = self._now_ms()
+        for sn in nacks:
+            if sn not in self._unacked or sn in self._retx:
+                continue
+            if now - self._last_tx_ms.get(sn, -1e9) < self.NACK_IGNORE_WINDOW_MS:
+                continue  # a copy is (likely) still in flight
+            self._bump_retx(sn)
+        if self._unacked:
+            self._arm_poll()
+        else:
+            self._poll_gen += 1  # all clear: cancel outstanding polls
+
+    # --- rx ---
+    def ReceivePdu(self, pdu: RlcPdu) -> None:
+        self.stats_rx_pdus += 1
+        self.stats_rx_bytes += pdu.size_bytes
+        sn = pdu.sn
+        if sn >= self._vr_r:
+            self._absorb_part(sn, pdu)
+            self._vr_h = max(self._vr_h, sn + 1)
+        # in-order delivery of every now-complete SN
+        while self._sn_complete(self._vr_r):
+            for _off, seg in sorted(
+                self._rx_segs.pop(self._vr_r), key=lambda t: t[0]
+            ):
+                _reassemble_segment(self._acc, seg, self.rx_sdu_callback)
+            self._rx_cov.pop(self._vr_r, None)
+            self._rx_total.pop(self._vr_r, None)
+            self._vr_r += 1
+        self._send_status()
+
+    def _absorb_part(self, sn: int, pdu: RlcPdu) -> None:
+        """Merge a (possibly re-segmented, possibly overlapping) part:
+        only byte ranges not yet covered contribute segments — a stale
+        duplicate can never double-count (SO-based reassembly)."""
+        cov = self._rx_cov.setdefault(sn, [])
+        segs = self._rx_segs.setdefault(sn, [])
+        if pdu.sn_total_bytes:
+            self._rx_total[sn] = pdu.sn_total_bytes
+        part_size = sum(s.size for s in pdu.segments)
+        novel = _interval_subtract(
+            (pdu.part_start, pdu.part_start + part_size), cov
+        )
+        for a, b in novel:
+            # clip this part's segments to pdu-byte range [a, b)
+            off = pdu.part_start
+            for seg in pdu.segments:
+                lo, hi = max(off, a), min(off + seg.size, b)
+                if lo < hi:
+                    s0 = seg.start + (lo - off)
+                    e0 = seg.start + (hi - off)
+                    segs.append((
+                        lo,
+                        RlcSegment(
+                            seg.packet, s0, e0,
+                            is_first=(s0 == 0),
+                            is_last=(e0 == seg.packet.GetSize()),
+                        ),
+                    ))
+                off += seg.size
+            _interval_insert(cov, (a, b))
+
+    def _sn_complete(self, sn: int) -> bool:
+        total = self._rx_total.get(sn)
+        if total is None:
+            return False
+        cov = self._rx_cov.get(sn, [])
+        return len(cov) == 1 and cov[0][0] == 0 and cov[0][1] >= total
+
+    def _send_status(self) -> None:
+        if self.status_callback is None:
+            return
+        from tpudes.core.nstime import MilliSeconds
+        from tpudes.core.simulator import Simulator
+
+        ack_sn = self._vr_h
+        nacks = [
+            sn for sn in range(self._vr_r, self._vr_h)
+            if not self._sn_complete(sn)
+        ]
+        Simulator.Schedule(
+            MilliSeconds(self.STATUS_DELAY_MS),
+            self.status_callback, ack_sn, nacks,
+        )
 
 
 class LteRlcTm(LteRlc):
@@ -235,4 +572,6 @@ class LtePdcp:
 
 
 def make_rlc(mode: str) -> LteRlc:
-    return {"sm": LteRlcSm, "um": LteRlcUm, "tm": LteRlcTm}[mode]()
+    return {
+        "sm": LteRlcSm, "um": LteRlcUm, "tm": LteRlcTm, "am": LteRlcAm,
+    }[mode]()
