@@ -1,0 +1,113 @@
+"""second.py — p2p + CSMA LAN (the tutorial's second.cc).
+
+Reference parity: examples/tutorial/second.cc — node n0 reaches a
+CSMA LAN (n2..n2+nCsma) across a point-to-point link to n1, which
+bridges both networks via global routing; UDP echo to the last LAN
+host; optional pcap on the bus.
+
+Run:  python examples/second.py [--nCsma=3] [--pcap=1] [--ping=1]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpudes.core import CommandLine, Seconds, Simulator
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.helper.point_to_point import PointToPointHelper
+from tpudes.models.csma import CsmaHelper
+from tpudes.models.internet.global_routing import Ipv4GlobalRoutingHelper
+
+
+def main(argv=None):
+    cmd = CommandLine("second.py: p2p + CSMA LAN")
+    cmd.AddValue("nCsma", "LAN hosts beyond the router", 3)
+    cmd.AddValue("pcap", "write second-*.pcap on the bus", False)
+    cmd.AddValue("ping", "also ping the far host", False)
+    cmd.Parse(argv)
+    n_csma = int(cmd.nCsma)
+
+    p2p_nodes = NodeContainer()
+    p2p_nodes.Create(2)
+    csma_nodes = NodeContainer()
+    csma_nodes.Add(p2p_nodes.Get(1))
+    csma_nodes.Create(n_csma)
+
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "5Mbps")
+    p2p.SetChannelAttribute("Delay", "2ms")
+    p2p_devices = p2p.Install(p2p_nodes)
+
+    csma = CsmaHelper()
+    csma.SetChannelAttribute("DataRate", "100Mbps")
+    csma.SetChannelAttribute("Delay", Seconds(6.56e-6))
+    csma_devices = csma.Install(csma_nodes)
+
+    stack = InternetStackHelper()
+    stack.SetRoutingHelper(Ipv4GlobalRoutingHelper())
+    stack.Install(p2p_nodes.Get(0))
+    stack.Install(csma_nodes)
+
+    address = Ipv4AddressHelper()
+    address.SetBase("10.1.1.0", "255.255.255.0")
+    address.Assign(p2p_devices)
+    address.SetBase("10.1.2.0", "255.255.255.0")
+    csma_interfaces = address.Assign(csma_devices)
+    Ipv4GlobalRoutingHelper.PopulateRoutingTables()
+
+    echo_server = UdpEchoServerHelper(9)
+    server_apps = echo_server.Install(csma_nodes.Get(n_csma))
+    server_apps.Start(Seconds(1.0))
+    server_apps.Stop(Seconds(10.0))
+    rx = [0]
+    server_apps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda *a: rx.__setitem__(0, rx[0] + 1)
+    )
+
+    echo_client = UdpEchoClientHelper(csma_interfaces.GetAddress(n_csma), 9)
+    echo_client.SetAttribute("MaxPackets", 1)
+    echo_client.SetAttribute("Interval", Seconds(1.0))
+    echo_client.SetAttribute("PacketSize", 1024)
+    client_apps = echo_client.Install(p2p_nodes.Get(0))
+    client_apps.Start(Seconds(2.0))
+    client_apps.Stop(Seconds(10.0))
+    cli_rx = [0]
+    client_apps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda *a: cli_rx.__setitem__(0, cli_rx[0] + 1)
+    )
+
+    ping = None
+    if cmd.GetValue("ping"):
+        from tpudes.models.internet.icmp import V4Ping
+
+        ping = V4Ping(
+            Remote=str(csma_interfaces.GetAddress(n_csma)),
+            Interval=Seconds(1.0), Count=3,
+        )
+        p2p_nodes.Get(0).AddApplication(ping)
+        ping.SetStartTime(Seconds(2.5))
+
+    if cmd.GetValue("pcap"):
+        csma.EnablePcap("second", csma_devices.Get(1), promiscuous=True)
+
+    Simulator.Stop(Seconds(10.0))
+    Simulator.Run()
+    line = f"server_rx={rx[0]} client_rx={cli_rx[0]}"
+    if ping is not None:
+        line += (
+            f" ping {ping.received}/{ping.sent}"
+            f" rtt={ping.rtts[0] * 1e3:.2f}ms" if ping.rtts else " ping 0/3"
+        )
+    ok = rx[0] == 1 and cli_rx[0] == 1 and (
+        ping is None or ping.received == ping.sent
+    )
+    print(line + (" -> OK" if ok else " -> MISMATCH"))
+    Simulator.Destroy()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,101 @@
+"""ICMP + V4Ping tests — upstream src/internet/test/ipv4-icmp strategy:
+echo round trip with analytic RTT, TTL-exceeded from a mid-path router,
+unreachable generation."""
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.helper.point_to_point import PointToPointHelper
+from tpudes.models.internet.global_routing import Ipv4GlobalRoutingHelper
+from tpudes.models.internet.icmp import IcmpL4Protocol, Icmpv4Header, V4Ping
+from tpudes.network.address import Ipv4Address
+
+
+def _chain(n=3, rate="10Mbps", delay="2ms"):
+    nodes = NodeContainer()
+    nodes.Create(n)
+    stack = InternetStackHelper()
+    stack.SetRoutingHelper(Ipv4GlobalRoutingHelper())
+    stack.Install(nodes)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", rate)
+    p2p.SetChannelAttribute("Delay", delay)
+    addr = Ipv4AddressHelper("10.1.0.0", "255.255.255.0")
+    last = None
+    for i in range(n - 1):
+        devs = p2p.Install(nodes.Get(i), nodes.Get(i + 1))
+        last = addr.Assign(devs)
+        addr.NewNetwork()
+    Ipv4GlobalRoutingHelper.PopulateRoutingTables()
+    return nodes, last
+
+
+def test_ping_round_trip_rtt_is_analytic():
+    nodes, last = _chain(3)
+    ping = V4Ping(
+        Remote=str(last.GetAddress(1)), Interval=Seconds(0.1), Count=4
+    )
+    nodes.Get(0).AddApplication(ping)
+    ping.SetStartTime(Seconds(0.1))
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    assert ping.sent == 4 and ping.received == 4
+    # 2 hops × 2 ms × 2 directions + serialization (84B @ 10 Mbps ×4)
+    for rtt in ping.rtts:
+        assert rtt == pytest.approx(0.008, rel=0.1)
+
+
+def test_ttl_exceeded_comes_back_from_midpath_router():
+    nodes, last = _chain(4)
+    errors = []
+    icmp0 = nodes.Get(0).GetObject(IcmpL4Protocol)
+    icmp0.register_error_listener(
+        lambda t, c, inner, src: errors.append((t, c, str(src)))
+    )
+    # craft a 1-TTL packet toward the far end
+    from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+    from tpudes.network.packet import Packet
+
+    ipv4 = nodes.Get(0).GetObject(Ipv4L3Protocol)
+    ipv4.default_ttl = 1
+    icmp0.SendEcho(Ipv4Address(str(last.GetAddress(1))), 99, 0)
+    Simulator.Stop(Seconds(0.5))
+    Simulator.Run()
+    assert errors, "TTL-exceeded must return to the sender"
+    t, c, src = errors[0]
+    assert t == Icmpv4Header.TIME_EXCEEDED
+    # the first router (node 1) generated it
+    assert src.startswith("10.1.0.")
+
+
+def test_unreachable_destination_generates_icmp_error():
+    nodes, last = _chain(3)
+    errors = []
+    icmp0 = nodes.Get(0).GetObject(IcmpL4Protocol)
+    icmp0.register_error_listener(
+        lambda t, c, inner, src: errors.append((t, c))
+    )
+    # static-route a bogus prefix into the chain so the middle router
+    # has no route for it
+    from tpudes.models.internet.global_routing import GlobalRouteManager
+
+    mgr = GlobalRouteManager.Get()
+    mgr.addr_to_node[Ipv4Address("10.99.0.1").addr] = 2  # resolvable at n0
+    icmp0.SendEcho(Ipv4Address("10.99.0.1"), 77, 0)
+    Simulator.Stop(Seconds(0.5))
+    Simulator.Run()
+    assert (Icmpv4Header.DEST_UNREACH, Icmpv4Header.NET_UNREACHABLE) in errors
+
+
+def test_ping_counts_stop_at_count():
+    nodes, last = _chain(2)
+    ping = V4Ping(
+        Remote=str(last.GetAddress(1)), Interval=Seconds(0.05), Count=3
+    )
+    nodes.Get(0).AddApplication(ping)
+    ping.SetStartTime(Seconds(0.0))
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    assert ping.sent == 3 and ping.received == 3

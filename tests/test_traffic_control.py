@@ -1,0 +1,153 @@
+"""Traffic-control tests — upstream src/traffic-control/test strategy:
+qdisc unit behavior (RED probability regions, CoDel sojourn law) plus
+system-level behavior on the dumbbell bottleneck."""
+
+import pytest
+
+from tpudes.core import MilliSeconds, Seconds, Simulator
+from tpudes.models.traffic_control import (
+    CoDelQueueDisc,
+    FifoQueueDisc,
+    QueueDiscItem,
+    RedQueueDisc,
+    TrafficControlHelper,
+    TrafficControlLayer,
+)
+from tpudes.network.packet import Packet
+from tpudes.scenarios import build_dumbbell
+
+
+def _item(size=1000):
+    return QueueDiscItem(Packet(size), None, 0x0800)
+
+
+def test_fifo_tail_drops_at_capacity():
+    q = FifoQueueDisc(MaxSize=3)
+    assert all(q.Enqueue(_item()) for _ in range(3))
+    assert not q.Enqueue(_item())
+    assert q.GetNPackets() == 3
+    assert q.stats_dropped == 1
+
+
+def test_red_no_drops_below_min_threshold():
+    q = RedQueueDisc(MinTh=5.0, MaxTh=15.0, MaxSize=100)
+    for _ in range(200):  # queue never exceeds 3
+        q.Enqueue(_item())
+        q.Enqueue(_item())
+        q.Dequeue()
+        q.Dequeue()
+    assert q.stats_early_drops == 0
+    assert q.stats_forced_drops == 0
+
+
+def test_red_drops_probabilistically_between_thresholds():
+    q = RedQueueDisc(MinTh=2.0, MaxTh=6.0, MaxSize=100, QW=0.2, LInterm=5.0)
+    accepted = dropped = 0
+    for _ in range(600):
+        if q.Enqueue(_item()):
+            accepted += 1
+        else:
+            dropped += 1
+        if q.GetNPackets() > 4:   # hold the queue inside the band
+            q.Dequeue()
+    assert dropped > 10, "early drops must engage inside the band"
+    assert accepted > dropped, "but most packets pass"
+    assert q.stats_forced_drops == 0
+
+
+def test_codel_drops_on_persistent_sojourn():
+    q = CoDelQueueDisc(MaxSize=1000)
+    # fill, then drain slowly so sojourn >> target (5 ms)
+    for _ in range(50):
+        q.Enqueue(_item())
+    drops_before = q.stats_target_drops
+    for _ in range(50):
+        Simulator.Stop(MilliSeconds(20))
+        Simulator.Run()
+        q.Enqueue(_item())
+        q.Dequeue()
+    assert q.stats_target_drops > drops_before, "CoDel must engage"
+
+
+def test_codel_idle_below_target_never_drops():
+    q = CoDelQueueDisc(MaxSize=1000)
+    for _ in range(100):
+        q.Enqueue(_item())
+        q.Dequeue()  # zero sojourn
+    assert q.stats_target_drops == 0 and q.stats_dropped == 0
+
+
+@pytest.mark.parametrize("disc,kw", [
+    ("tpudes::RedQueueDisc",
+     dict(MinTh=5.0, MaxTh=15.0, MaxSize=25, LinkBandwidth="5Mbps")),
+    ("tpudes::CoDelQueueDisc", dict(MaxSize=200)),
+])
+def test_qdisc_on_dumbbell_keeps_throughput_and_sheds(disc, kw):
+    db, sinks = build_dumbbell(
+        4, 4.0, variant="TcpNewReno", bottleneck_rate="5Mbps"
+    )
+    tch = TrafficControlHelper()
+    tch.SetRootQueueDisc(disc, **kw)
+    (qdisc,) = tch.Install(db.GetBottleneckDevices().Get(0))
+    Simulator.Stop(Seconds(4.0))
+    Simulator.Run()
+    tput = sum(s.GetTotalRx() for s in sinks) * 8 / 3.9 / 1e6
+    assert tput > 3.0, f"{disc}: collapsed to {tput:.2f} Mbps"
+    assert qdisc.stats_dropped > 0, "an AQM at a bottleneck must shed"
+    # the backlog lived in the qdisc (flow control worked)
+    assert qdisc.stats_enqueued > 1000
+
+
+def test_qdisc_shapes_arp_resolved_csma_traffic():
+    """TC must intercept at the device boundary so ARP-resolved unicast
+    (CSMA/WiFi) rides the qdisc too (r4 review: the Ipv4Interface hook
+    missed the ArpL3Protocol send path entirely)."""
+    from tpudes.helper.applications import (
+        UdpEchoClientHelper,
+        UdpEchoServerHelper,
+    )
+    from tpudes.helper.containers import NodeContainer
+    from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+    from tpudes.models.csma import CsmaHelper
+
+    nodes = NodeContainer()
+    nodes.Create(2)
+    csma = CsmaHelper()
+    csma.SetChannelAttribute("DataRate", "10Mbps")
+    devices = csma.Install(nodes)
+    InternetStackHelper().Install(nodes)
+    ifc = Ipv4AddressHelper("10.1.9.0", "255.255.255.0").Assign(devices)
+    tch = TrafficControlHelper()
+    tch.SetRootQueueDisc("tpudes::FifoQueueDisc", MaxSize=100)
+    (qdisc,) = tch.Install(devices.Get(0))
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(1))
+    sapps.Start(Seconds(0.0))
+    rx = [0]
+    sapps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda *a: rx.__setitem__(0, rx[0] + 1)
+    )
+    c = UdpEchoClientHelper(ifc.GetAddress(1), 9)
+    c.SetAttribute("MaxPackets", 5)
+    c.SetAttribute("Interval", Seconds(0.01))
+    c.Install(nodes.Get(0)).Start(Seconds(0.1))
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    assert rx[0] == 5
+    # ARP request + 5 ARP-resolved UDP unicasts all rode the qdisc
+    assert qdisc.stats_enqueued >= 6, qdisc.stats_enqueued
+
+
+def test_tc_layer_routes_ip_sends_through_qdisc():
+    db, sinks = build_dumbbell(2, 2.0, bottleneck_rate="2Mbps")
+    tch = TrafficControlHelper()
+    tch.SetRootQueueDisc("tpudes::FifoQueueDisc", MaxSize=50)
+    (qdisc,) = tch.Install(db.GetBottleneckDevices().Get(0))
+    left_router = db.GetLeft()
+    tc = left_router.GetObject(TrafficControlLayer)
+    assert tc is not None
+    assert tc.GetRootQueueDisc(db.GetBottleneckDevices().Get(0)) is qdisc
+    Simulator.Stop(Seconds(2.0))
+    Simulator.Run()
+    assert qdisc.stats_enqueued > 0
+    assert sum(s.GetTotalRx() for s in sinks) > 0

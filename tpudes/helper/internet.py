@@ -50,6 +50,12 @@ class InternetStackHelper:
             udp.SetNode(node)
             ipv4.Insert(udp)
             node.AggregateObject(udp)
+            from tpudes.models.internet.icmp import IcmpL4Protocol
+
+            icmp = IcmpL4Protocol()
+            icmp.SetNode(node)
+            ipv4.Insert(icmp)
+            node.AggregateObject(icmp)
             # TCP (src/internet/model/tcp-l4-protocol) is installed when
             # available so sockets of both families work out of the box;
             # the spec probe (above) lets a broken tcp.py raise loudly

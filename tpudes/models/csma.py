@@ -1,0 +1,312 @@
+"""CSMA (Ethernet-like shared bus): channel, device, helper.
+
+Reference parity: src/csma/model/csma-net-device.{h,cc},
+csma-channel.{h,cc}, backoff.{h,cc}, src/csma/helper/csma-helper.{h,cc}
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.9 csma row).
+
+The upstream model (and this one): a broadcast bus with carrier sense
+and exponential backoff, NO collision detection — the channel admits
+one transmitter at a time; a device finding the channel busy backs off
+and retries, never corrupting bits.  Frames carry Ethernet II headers
+(dst/src/ethertype) and reach every other attached device after the
+channel delay; filtering happens at the receiver, so ARP broadcast and
+promiscuous taps work naturally.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import Time
+from tpudes.core.object import TypeId
+from tpudes.core.rng import UniformRandomVariable
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import Mac48Address
+from tpudes.network.data_rate import DataRate
+from tpudes.network.net_device import Channel, NetDevice
+from tpudes.network.packet import Header
+from tpudes.network.queue import DropTailQueue
+
+
+class EthernetHeader(Header):
+    """Ethernet II: dst(6) src(6) ethertype(2)."""
+
+    def __init__(self, destination=None, source=None, ether_type=0x0800):
+        self.destination = destination or Mac48Address.GetBroadcast()
+        self.source = source or Mac48Address.GetBroadcast()
+        self.ether_type = ether_type
+
+    def GetSerializedSize(self) -> int:
+        return 14
+
+    def Serialize(self) -> bytes:
+        return (
+            self.destination.to_bytes()
+            + self.source.to_bytes()
+            + struct.pack("!H", self.ether_type)
+        )
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        dst = Mac48Address.from_bytes(data[0:6])
+        src = Mac48Address.from_bytes(data[6:12])
+        (et,) = struct.unpack("!H", data[12:14])
+        return cls(dst, src, et)
+
+    def __repr__(self):
+        return f"EthernetHeader({self.source}->{self.destination}, 0x{self.ether_type:04x})"
+
+
+class Backoff:
+    """Exponential backoff (src/csma/model/backoff.{h,cc} defaults)."""
+
+    def __init__(self, slot_time=Time(1000), min_slots=1, max_slots=1000,
+                 ceiling=10, max_retries=1000):
+        self.slot_time = Time(slot_time)
+        self.min_slots = min_slots
+        self.max_slots = max_slots
+        self.ceiling = ceiling
+        self.max_retries = max_retries
+        self._retries = 0
+        self._rng = UniformRandomVariable()
+
+    def ResetBackoffTime(self) -> None:
+        self._retries = 0
+
+    def MaxRetriesReached(self) -> bool:
+        return self._retries >= self.max_retries
+
+    def IncrNumRetries(self) -> None:
+        self._retries += 1
+
+    def GetBackoffTime(self) -> Time:
+        ceiling = min(self._retries, self.ceiling)
+        hi = min(self.max_slots, max(self.min_slots, (1 << ceiling) - 1))
+        slots = int(self._rng.GetValue(self.min_slots, hi + 1))
+        return Time(self.slot_time.ticks * slots)
+
+
+class CsmaChannel(Channel):
+    IDLE, TRANSMITTING, PROPAGATING = 0, 1, 2
+
+    tid = (
+        TypeId("tpudes::CsmaChannel")
+        .SetParent(Channel.tid)
+        .AddConstructor(lambda **kw: CsmaChannel(**kw))
+        .AddAttribute("DataRate", "bus rate", "100Mbps", checker=DataRate)
+        .AddAttribute("Delay", "end-to-end propagation", Time(0), checker=Time)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._state = self.IDLE
+        self._current_src = None
+
+    def Attach(self, device: "CsmaNetDevice") -> None:
+        self._devices.append(device)
+
+    def IsBusy(self) -> bool:
+        return self._state != self.IDLE
+
+    def GetDataRate(self) -> DataRate:
+        return self.data_rate
+
+    def GetDelay(self) -> Time:
+        return self.delay
+
+    def TransmitStart(self, packet, src_device) -> bool:
+        if self._state != self.IDLE:
+            return False
+        self._state = self.TRANSMITTING
+        self._current_src = src_device
+        return True
+
+    def TransmitEnd(self, packet, src_device) -> bool:
+        """Serialization done at the source: the frame now propagates
+        to every other attached device."""
+        self._state = self.PROPAGATING
+        for dev in self._devices:
+            if dev is src_device:
+                continue
+            Simulator.ScheduleWithContext(
+                dev.GetNode().GetId(), self.delay, dev.Receive, packet.Copy()
+            )
+        Simulator.Schedule(self.delay, self._propagation_complete)
+        return True
+
+    def _propagation_complete(self) -> None:
+        self._state = self.IDLE
+        self._current_src = None
+
+
+class CsmaNetDevice(NetDevice):
+    tid = (
+        TypeId("tpudes::CsmaNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddConstructor(lambda **kw: CsmaNetDevice(**kw))
+        .AddTraceSource("MacTx", "packet arrived for transmission")
+        .AddTraceSource("MacTxDrop", "packet dropped before transmission")
+        .AddTraceSource("MacTxBackoff", "carrier busy; backing off")
+        .AddTraceSource("MacRx", "packet delivered up")
+        .AddTraceSource("PhyTxBegin", "transmission started")
+        .AddTraceSource("PhyTxEnd", "transmission finished")
+        .AddTraceSource("PhyRxEnd", "reception finished")
+        .AddTraceSource("PromiscSniffer", "promiscuous tap")
+        .AddTraceSource("Sniffer", "non-promiscuous tap")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._channel: CsmaChannel | None = None
+        self._queue = DropTailQueue()
+        self._backoff = Backoff()
+        self._tx_busy = False
+
+    # --- wiring ---
+    def Attach(self, channel: CsmaChannel) -> None:
+        self._channel = channel
+        channel.Attach(self)
+
+    def GetChannel(self):
+        return self._channel
+
+    def SetQueue(self, queue) -> None:
+        self._queue = queue
+
+    def GetQueue(self):
+        return self._queue
+
+    def IsBroadcast(self) -> bool:
+        return True
+
+    def NeedsArp(self) -> bool:
+        return True
+
+    # --- tx path ---
+    def Send(self, packet, dest=None, protocol: int = 0x0800) -> bool:
+        if not self._link_up:
+            self.mac_tx_drop(packet)
+            return False
+        self.mac_tx(packet)
+        packet.AddHeader(
+            EthernetHeader(
+                destination=dest if dest is not None else self.GetBroadcast(),
+                source=self._address,
+                ether_type=protocol,
+            )
+        )
+        if not self._queue.Enqueue(packet):
+            self.mac_tx_drop(packet)
+            return False
+        if not self._tx_busy:
+            self._transmit_next()
+        return True
+
+    def _transmit_next(self) -> None:
+        packet = self._queue.Dequeue()
+        if packet is None:
+            self._tx_busy = False
+            return
+        self._tx_busy = True
+        self._try_transmit(packet)
+
+    def _try_transmit(self, packet) -> None:
+        if not self._channel.TransmitStart(packet, self):
+            # carrier busy: exponential backoff, as upstream
+            self.mac_tx_backoff(packet)
+            self._backoff.IncrNumRetries()
+            if self._backoff.MaxRetriesReached():
+                self.mac_tx_drop(packet)
+                self._backoff.ResetBackoffTime()
+                self._transmit_next()
+                return
+            Simulator.Schedule(
+                self._backoff.GetBackoffTime(), self._try_transmit, packet
+            )
+            return
+        self._backoff.ResetBackoffTime()
+        self.phy_tx_begin(packet)
+        tx_time = self._channel.GetDataRate().CalculateBytesTxTime(
+            packet.GetSize()
+        )
+        Simulator.Schedule(tx_time, self._transmit_complete, packet)
+
+    def _transmit_complete(self, packet) -> None:
+        self.phy_tx_end(packet)
+        self.sniffer(packet)
+        self.promisc_sniffer(packet)
+        self._channel.TransmitEnd(packet, self)
+        self._transmit_next()
+
+    # --- rx path ---
+    def Receive(self, packet) -> None:
+        self.phy_rx_end(packet)
+        header = packet.RemoveHeader(EthernetHeader)
+        broadcast = header.destination == self.GetBroadcast()
+        to_me = header.destination == self._address
+        if not (broadcast or to_me):
+            # promiscuous taps still see other-host frames
+            self.promisc_sniffer(packet)
+            if self._promisc_callback is not None:
+                self._deliver_up(
+                    packet, header.ether_type, header.source,
+                    header.destination, self._node.PACKET_OTHERHOST,
+                )
+            return
+        self.sniffer(packet)
+        self.promisc_sniffer(packet)
+        self.mac_rx(packet)
+        ptype = (
+            self._node.PACKET_BROADCAST if broadcast else self._node.PACKET_HOST
+        )
+        self._deliver_up(
+            packet, header.ether_type, header.source, header.destination,
+            ptype,
+        )
+
+
+class CsmaHelper:
+    """src/csma/helper/csma-helper.{h,cc} + pcap/ascii via the shared
+    trace mixin (DLT_EN10MB)."""
+
+    def __init__(self):
+        from tpudes.network.trace_helper import PcapHelperForDevice
+
+        self._device_attrs: dict = {}
+        self._channel_attrs: dict = {}
+        # compose rather than inherit so pcap_dlt stays per-instance
+        self._pcap = type(
+            "_CsmaPcap", (PcapHelperForDevice,),
+            {"pcap_dlt": 1,  # DLT_EN10MB
+             "_pcap_device_ok": staticmethod(
+                 lambda d: isinstance(d, CsmaNetDevice))},
+        )()
+
+    def SetDeviceAttribute(self, name: str, value) -> None:
+        self._device_attrs[name] = value
+
+    def SetChannelAttribute(self, name: str, value) -> None:
+        self._channel_attrs[name] = value
+
+    def Install(self, nodes, channel: CsmaChannel | None = None):
+        from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+
+        if isinstance(nodes, NodeContainer):
+            nodes = list(nodes)
+        elif not isinstance(nodes, (list, tuple)):
+            nodes = [nodes]
+        if channel is None:
+            channel = CsmaChannel(**self._channel_attrs)
+        devices = NetDeviceContainer()
+        for node in nodes:
+            dev = CsmaNetDevice(**self._device_attrs)
+            node.AddDevice(dev)
+            dev.Attach(channel)
+            devices.Add(dev)
+        return devices
+
+    def EnablePcap(self, prefix, devices, promiscuous=True):
+        return self._pcap.EnablePcap(prefix, devices, promiscuous)
+
+    def EnablePcapAll(self, prefix, promiscuous=True):
+        return self._pcap.EnablePcapAll(prefix, promiscuous)

@@ -1,0 +1,217 @@
+"""ICMPv4: header, L4 protocol, and the V4Ping application.
+
+Reference parity: src/internet/model/icmpv4.{h,cc},
+icmpv4-l4-protocol.{h,cc} and src/internet-apps/model/v4ping.{h,cc}
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.7/§2.10
+internet-apps rows).
+
+Echo request/reply, TTL-exceeded and destination-unreachable are
+modeled; the L3 hooks fire from Ipv4L3Protocol's forwarding drop paths
+exactly where upstream calls the aggregated Icmpv4L4Protocol.  V4Ping
+talks to the ICMP protocol object directly (upstream uses a raw
+socket; the protocol IS the raw-socket surface here).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import Seconds, Time
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import Ipv4Address
+from tpudes.network.application import Application
+from tpudes.network.packet import Header, Packet
+
+
+class Icmpv4Header(Header):
+    ECHO_REPLY = 0
+    DEST_UNREACH = 3
+    TIME_EXCEEDED = 11
+    ECHO = 8
+
+    # codes
+    PORT_UNREACHABLE = 3
+    NET_UNREACHABLE = 0
+    TTL_EXPIRED = 0
+
+    def __init__(self, icmp_type=0, code=0):
+        self.icmp_type = icmp_type
+        self.code = code
+
+    def GetSerializedSize(self) -> int:
+        return 4
+
+    def Serialize(self) -> bytes:
+        return struct.pack("!BBH", self.icmp_type, self.code, 0)
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        t, c, _ck = struct.unpack("!BBH", data[:4])
+        return cls(t, c)
+
+    def __repr__(self):
+        return f"Icmpv4Header(type={self.icmp_type}, code={self.code})"
+
+
+class IcmpEcho(Header):
+    """Echo request/reply body: identifier + sequence."""
+
+    def __init__(self, identifier=0, sequence=0):
+        self.identifier = identifier
+        self.sequence = sequence
+
+    def GetSerializedSize(self) -> int:
+        return 4
+
+    def Serialize(self) -> bytes:
+        return struct.pack("!HH", self.identifier, self.sequence)
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        i, s = struct.unpack("!HH", data[:4])
+        return cls(i, s)
+
+
+class IcmpL4Protocol(Object):
+    PROT_NUMBER = 1
+
+    tid = (
+        TypeId("tpudes::IcmpL4Protocol")
+        .AddConstructor(lambda **kw: IcmpL4Protocol(**kw))
+        .AddTraceSource("Rx", "(icmp header, source) any icmp received")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        #: echo identifier -> cb(source, sequence, payload_packet)
+        self._echo_listeners: dict[int, object] = {}
+        #: cbs(icmp_type, code, original_header) for errors (traceroute)
+        self._error_listeners: list = []
+
+    def SetNode(self, node) -> None:
+        self._node = node
+
+    def register_echo_listener(self, identifier: int, cb) -> None:
+        self._echo_listeners[identifier] = cb
+
+    def register_error_listener(self, cb) -> None:
+        self._error_listeners.append(cb)
+
+    # --- send side ---------------------------------------------------------
+    def _ipv4(self):
+        from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+
+        return self._node.GetObject(Ipv4L3Protocol)
+
+    def SendEcho(self, dest: Ipv4Address, identifier: int, sequence: int,
+                 payload_bytes: int = 56) -> None:
+        packet = Packet(payload_bytes)
+        packet.AddHeader(IcmpEcho(identifier, sequence))
+        packet.AddHeader(Icmpv4Header(Icmpv4Header.ECHO, 0))
+        ipv4 = self._ipv4()
+        src = ipv4.SelectSourceAddress(1)
+        ipv4.Send(packet, src, dest, self.PROT_NUMBER)
+
+    def _send_error(self, icmp_type: int, code: int, offending_header,
+                    offending_packet) -> None:
+        """TTL-exceeded / unreachable back toward the offender's source,
+        carrying the original IP header + 8 payload bytes (RFC 792)."""
+        packet = Packet(offending_packet.ToBytes()[:8])
+        packet.AddHeader(offending_header)
+        packet.AddHeader(Icmpv4Header(icmp_type, code))
+        ipv4 = self._ipv4()
+        src = ipv4.SelectSourceAddress(1)
+        ipv4.Send(packet, src, offending_header.source, self.PROT_NUMBER)
+
+    def SendTimeExceeded(self, header, packet) -> None:
+        self._send_error(
+            Icmpv4Header.TIME_EXCEEDED, Icmpv4Header.TTL_EXPIRED,
+            header, packet,
+        )
+
+    def SendDestUnreachable(self, header, packet, code) -> None:
+        self._send_error(Icmpv4Header.DEST_UNREACH, code, header, packet)
+
+    # --- receive side -------------------------------------------------------
+    def Receive(self, packet, ip_header, iface) -> None:
+        icmp = packet.RemoveHeader(Icmpv4Header)
+        self.rx(icmp, ip_header.source)
+        if icmp.icmp_type == Icmpv4Header.ECHO:
+            echo = packet.RemoveHeader(IcmpEcho)
+            reply = Packet(packet.GetSize())
+            reply.AddHeader(IcmpEcho(echo.identifier, echo.sequence))
+            reply.AddHeader(Icmpv4Header(Icmpv4Header.ECHO_REPLY, 0))
+            ipv4 = self._ipv4()
+            ipv4.Send(
+                reply, ip_header.destination, ip_header.source,
+                self.PROT_NUMBER,
+            )
+        elif icmp.icmp_type == Icmpv4Header.ECHO_REPLY:
+            echo = packet.RemoveHeader(IcmpEcho)
+            cb = self._echo_listeners.get(echo.identifier)
+            if cb is not None:
+                cb(ip_header.source, echo.sequence, packet)
+        else:
+            inner = packet.PeekHeader()
+            for cb in self._error_listeners:
+                cb(icmp.icmp_type, icmp.code, inner, ip_header.source)
+
+
+class V4Ping(Application):
+    """src/internet-apps/model/v4ping.{h,cc}: periodic echo + RTT log."""
+
+    tid = (
+        TypeId("tpudes::V4Ping")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: V4Ping(**kw))
+        .AddAttribute("Remote", "destination address", None)
+        .AddAttribute("Interval", "between echoes", Seconds(1.0), checker=Time)
+        .AddAttribute("Size", "payload bytes", 56)
+        .AddAttribute("Count", "echoes to send (0 = forever)", 0)
+        .AddTraceSource("Rtt", "(sequence, rtt Time) reply received")
+    )
+
+    _next_ident = 1
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self.ident = V4Ping._next_ident
+        V4Ping._next_ident += 1
+        self.sent = 0
+        self.received = 0
+        self.rtts: list[float] = []
+        self._tx_ts: dict[int, int] = {}
+        self._event = None
+
+    def StartApplication(self) -> None:
+        icmp = self._node.GetObject(IcmpL4Protocol)
+        if icmp is None:
+            raise RuntimeError("V4Ping needs the ICMP protocol installed")
+        icmp.register_echo_listener(self.ident, self._on_reply)
+        self._send()
+
+    def StopApplication(self) -> None:
+        if self._event is not None:
+            self._event.Cancel()
+
+    def _send(self) -> None:
+        icmp = self._node.GetObject(IcmpL4Protocol)
+        seq = self.sent
+        self._tx_ts[seq] = Simulator.NowTicks()
+        icmp.SendEcho(
+            Ipv4Address(self.remote), self.ident, seq, int(self.size)
+        )
+        self.sent += 1
+        if self.count == 0 or self.sent < self.count:
+            self._event = Simulator.Schedule(self.interval, self._send)
+
+    def _on_reply(self, source, sequence, packet) -> None:
+        tx = self._tx_ts.pop(sequence, None)
+        if tx is None:
+            return
+        rtt_s = (Simulator.NowTicks() - tx) / 1e9
+        self.received += 1
+        self.rtts.append(rtt_s)
+        self.rtt(sequence, rtt_s)

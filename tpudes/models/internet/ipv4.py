@@ -151,7 +151,8 @@ class Ipv4Interface(Object):
                 node.GetId(), 0, node.GetObject(Ipv4L3Protocol)._receive_loopback, packet
             )
             return
-        device.Send(packet, dest_mac if dest_mac is not None else device.GetBroadcast(), Ipv4L3Protocol.PROT_NUMBER)
+        dest = dest_mac if dest_mac is not None else device.GetBroadcast()
+        device.Send(packet, dest, Ipv4L3Protocol.PROT_NUMBER)
 
 
 class Ipv4Route:
@@ -424,10 +425,12 @@ class Ipv4L3Protocol(Object):
         header.ttl -= 1
         if header.ttl <= 0:
             self.drop(header, packet, self.DROP_TTL_EXPIRED)
+            self._icmp_error(header, packet, "ttl")
             return
         route, errno = self._routing.RouteOutput(packet, header)
         if route is None:
             self.drop(header, packet, self.DROP_NO_ROUTE)
+            self._icmp_error(header, packet, "unreach")
             return
         if_index = getattr(route, "if_index", None)
         if if_index is None:
@@ -439,6 +442,33 @@ class Ipv4L3Protocol(Object):
         packet.AddHeader(header)
         self.tx(packet, if_index)
         self._send_via(self.interfaces[if_index], packet, header, route)
+
+    def _icmp_error(self, header, packet, kind: str) -> None:
+        """Forwarding drop → ICMP error back to the source (upstream:
+        Ipv4L3Protocol calls the aggregated Icmpv4L4Protocol here)."""
+        icmp = self._protocols.get(1)
+        if icmp is None or header.source.IsAny():
+            return
+        if header.protocol == 1:
+            # RFC 1122: never generate an ICMP error about an ICMP
+            # error — a routing loop would otherwise breed errors about
+            # errors unboundedly.  Echo request/reply may still elicit
+            # errors.
+            from tpudes.models.internet.icmp import Icmpv4Header
+
+            front = packet.PeekHeader(Icmpv4Header)
+            if front is None or front.icmp_type not in (
+                Icmpv4Header.ECHO, Icmpv4Header.ECHO_REPLY
+            ):
+                return
+        if kind == "ttl":
+            icmp.SendTimeExceeded(header, packet)
+        else:
+            from tpudes.models.internet.icmp import Icmpv4Header
+
+            icmp.SendDestUnreachable(
+                header, packet, Icmpv4Header.NET_UNREACHABLE
+            )
 
     def _send_via(self, iface, packet, header, route):
         """Hand the packet to the interface, resolving the next-hop MAC

@@ -324,6 +324,201 @@ class TcpVeno(TcpNewReno):
         return max(tcb.cwnd // 2, 2 * tcb.segment_size)
 
 
+class TcpLinuxReno(TcpNewReno):
+    """Linux-style Reno (tcp-linux-reno.cc): congestion avoidance counts
+    full-cwnd's worth of acks before the +1 segment (no fractional
+    byte-counting), matching the kernel's implementation."""
+
+    tid = (
+        TypeId("tpudes::TcpLinuxReno")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpLinuxReno(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._cwnd_cnt = 0
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        w = max(int(tcb.cwnd // tcb.segment_size), 1)
+        self._cwnd_cnt += segments_acked
+        if self._cwnd_cnt >= w:
+            self._cwnd_cnt -= w
+            tcb.cwnd += tcb.segment_size
+
+
+class TcpBic(TcpNewReno):
+    """BIC (tcp-bic.cc): binary-search window increase toward the last
+    w_max, switching to max-probing beyond it."""
+
+    tid = (
+        TypeId("tpudes::TcpBic")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpBic(**kw))
+        .AddAttribute("Beta", "multiplicative decrease", 0.8, field="beta")
+        .AddAttribute("LowWnd", "below: plain Reno", 14, field="low_wnd")
+        .AddAttribute("MaxIncr", "cap per RTT (segments)", 16, field="max_incr")
+        .AddAttribute("SMin", "binary search floor", 0.01, field="s_min")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._w_max = 0.0
+        self._cnt = 0.0
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        seg = tcb.segment_size
+        w = tcb.cwnd / seg
+        if w < self.low_wnd or self._w_max == 0.0:
+            super().CongestionAvoidance(tcb, segments_acked)
+            return
+        if w < self._w_max:
+            inc = min((self._w_max - w) / 2.0, float(self.max_incr))
+        else:
+            # max probing: slow start away from w_max
+            inc = min(w - self._w_max + 1.0, float(self.max_incr))
+        inc = max(inc, self.s_min)
+        tcb.cwnd += max(int(segments_acked * inc * seg / w), 1)
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        w = tcb.cwnd / tcb.segment_size
+        if w < self._w_max:
+            self._w_max = w * (1.0 + self.beta) / 2.0  # fast convergence
+        else:
+            self._w_max = w
+        return max(int(tcb.cwnd * self.beta), 2 * tcb.segment_size)
+
+
+class TcpWestwood(TcpNewReno):
+    """Westwood+ (tcp-westwood-plus.cc): EWMA bandwidth estimate from
+    acked bytes; on loss ssthresh = BWE · RTTmin (no blind halving)."""
+
+    tid = (
+        TypeId("tpudes::TcpWestwood")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpWestwood(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._bwe = 0.0            # bytes/s
+        self._acked_bytes = 0
+        self._min_rtt = math.inf
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if not rtt_s or rtt_s <= 0:
+            return
+        self._min_rtt = min(self._min_rtt, rtt_s)
+        self._acked_bytes += segments_acked * tcb.segment_size
+        # filter once we have ~an RTT (a cwnd's worth) of acks
+        if self._acked_bytes >= tcb.cwnd:
+            sample = self._acked_bytes / max(rtt_s, 1e-6)
+            self._bwe = (
+                sample if self._bwe == 0.0
+                else 0.9 * self._bwe + 0.1 * sample
+            )
+            self._acked_bytes = 0
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        if self._bwe > 0.0 and self._min_rtt < math.inf:
+            est = int(self._bwe * self._min_rtt)
+            return max(est, 2 * tcb.segment_size)
+        return max(bytes_in_flight // 2, 2 * tcb.segment_size)
+
+
+class TcpIllinois(TcpNewReno):
+    """Illinois (tcp-illinois.cc): queueing delay modulates the additive
+    increase alpha(d) and multiplicative decrease beta(d)."""
+
+    tid = (
+        TypeId("tpudes::TcpIllinois")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpIllinois(**kw))
+        .AddAttribute("AlphaMax", "", 10.0, field="alpha_max")
+        .AddAttribute("AlphaMin", "", 0.3, field="alpha_min")
+        .AddAttribute("BetaMax", "", 0.5, field="beta_max")
+        .AddAttribute("BetaMin", "", 0.125, field="beta_min")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._base_rtt = math.inf
+        self._max_rtt = 0.0
+        self._cur_rtt = 0.0
+        self._alpha = 1.0
+        self._beta = 0.5
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if not rtt_s or rtt_s <= 0:
+            return
+        self._base_rtt = min(self._base_rtt, rtt_s)
+        self._max_rtt = max(self._max_rtt, rtt_s)
+        self._cur_rtt = rtt_s
+        dm = self._max_rtt - self._base_rtt
+        if dm <= 0:
+            self._alpha, self._beta = self.alpha_max, self.beta_min
+            return
+        da = max(self._cur_rtt - self._base_rtt, 0.0)
+        d1 = 0.01 * dm
+        if da <= d1:
+            self._alpha = self.alpha_max
+        else:
+            # alpha decays toward alpha_min as delay approaches dm
+            k = (self.alpha_max - self.alpha_min) / max(dm - d1, 1e-9)
+            self._alpha = max(self.alpha_max - k * (da - d1), self.alpha_min)
+        self._beta = min(
+            max(self.beta_min, self.beta_min + (self.beta_max - self.beta_min)
+                * (da / dm)),
+            self.beta_max,
+        )
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        if segments_acked > 0:
+            add = self._alpha * segments_acked * tcb.segment_size \
+                * tcb.segment_size / tcb.cwnd
+            tcb.cwnd += max(int(add), 1)
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        return max(int(tcb.cwnd * (1.0 - self._beta)), 2 * tcb.segment_size)
+
+
+class TcpHybla(TcpNewReno):
+    """Hybla (tcp-hybla.cc): normalizes growth by rho = RTT/RTT0 so long
+    (satellite) RTT flows keep pace with short ones."""
+
+    tid = (
+        TypeId("tpudes::TcpHybla")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpHybla(**kw))
+        .AddAttribute("RRtt", "reference RTT (s)", 0.025, field="r_rtt")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._rho = 1.0
+        self._frac = 0.0
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if rtt_s and rtt_s > 0:
+            self._rho = max(rtt_s / self.r_rtt, 1.0)
+
+    def SlowStart(self, tcb, segments_acked) -> int:
+        # cwnd += (2^rho - 1) per ack
+        inc = (2.0 ** self._rho) - 1.0
+        tcb.cwnd += int(inc * tcb.segment_size)
+        return max(segments_acked - 1, 0)
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        if segments_acked <= 0:
+            return
+        seg = tcb.segment_size
+        self._frac += segments_acked * self._rho**2 * seg * seg / tcb.cwnd
+        if self._frac >= seg:
+            whole = int(self._frac // seg)
+            tcb.cwnd += whole * seg
+            self._frac -= whole * seg
+
+
 TCP_VARIANTS = {
     "TcpNewReno": TcpNewReno,
     "TcpCubic": TcpCubic,
@@ -331,4 +526,9 @@ TCP_VARIANTS = {
     "TcpHighSpeed": TcpHighSpeed,
     "TcpVegas": TcpVegas,
     "TcpVeno": TcpVeno,
+    "TcpLinuxReno": TcpLinuxReno,
+    "TcpBic": TcpBic,
+    "TcpWestwood": TcpWestwood,
+    "TcpIllinois": TcpIllinois,
+    "TcpHybla": TcpHybla,
 }

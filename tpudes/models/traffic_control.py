@@ -1,0 +1,374 @@
+"""Traffic control: queue discs between IP and the NetDevice.
+
+Reference parity: src/traffic-control/model/traffic-control-layer.{h,cc},
+queue-disc.{h,cc}, red-queue-disc.{h,cc}, codel-queue-disc.{h,cc},
+fifo-queue-disc.{h,cc}, helper/traffic-control-helper.{h,cc} (upstream
+paths; mount empty at survey — SURVEY.md §0, §2.7 traffic-control row).
+
+Architecture mirrors upstream's intent with one structural difference:
+upstream routes every L3 protocol (IPv4 AND ARP) through the
+TrafficControlLayer's send callback; here the layer intercepts at the
+device boundary — installing a root qdisc wraps ``device.Send`` — so
+EVERY sender (IPv4 forwarding, ARP requests and resolved unicasts,
+future protocols) goes through the qdisc with zero hot-path cost on
+uninstalled nodes.  The layer drains the qdisc into the device under
+flow control: "device ready" means its tx path is idle (one frame in
+flight), so the backlog lives in the qdisc where RED/CoDel can see it,
+not in the device's DropTail.  The drain re-arms off the device's
+PhyTxEnd trace (the DeviceQueueInterface wake analog).
+
+ECN marking is not modeled this round (RED drops where it would mark);
+the seam is QueueDisc._drop vs a future _mark.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from tpudes.core.nstime import Time
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+
+
+@dataclass
+class QueueDiscItem:
+    """queue-disc-item.h: packet + L2 addressing it will need."""
+
+    packet: object
+    dest: object
+    protocol: int
+    enqueue_ts: int = 0
+
+    def GetSize(self) -> int:
+        return self.packet.GetSize()
+
+
+class QueueDisc(Object):
+    tid = (
+        TypeId("tpudes::QueueDisc")
+        .AddAttribute("MaxSize", "queue limit (packets)", 1000, field="max_packets")
+        .AddTraceSource("Enqueue", "item queued")
+        .AddTraceSource("Dequeue", "item dequeued")
+        .AddTraceSource("Drop", "item dropped")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._items: list[QueueDiscItem] = []
+        self.stats_enqueued = 0
+        self.stats_dequeued = 0
+        self.stats_dropped = 0
+
+    def GetNPackets(self) -> int:
+        return len(self._items)
+
+    def GetNBytes(self) -> int:
+        return sum(i.GetSize() for i in self._items)
+
+    def Enqueue(self, item: QueueDiscItem) -> bool:
+        item.enqueue_ts = Simulator.NowTicks()
+        if not self.DoEnqueue(item):
+            self.stats_dropped += 1
+            self.drop(item.packet)
+            return False
+        self.stats_enqueued += 1
+        self.enqueue(item.packet)
+        return True
+
+    def Dequeue(self) -> QueueDiscItem | None:
+        item = self.DoDequeue()
+        if item is not None:
+            self.stats_dequeued += 1
+            self.dequeue(item.packet)
+        return item
+
+    # --- overridables -----------------------------------------------------
+    def DoEnqueue(self, item: QueueDiscItem) -> bool:
+        raise NotImplementedError
+
+    def DoDequeue(self) -> QueueDiscItem | None:
+        raise NotImplementedError
+
+
+class FifoQueueDisc(QueueDisc):
+    """fifo-queue-disc.{h,cc}: plain tail-drop FIFO."""
+
+    tid = (
+        TypeId("tpudes::FifoQueueDisc")
+        .SetParent(QueueDisc.tid)
+        .AddConstructor(lambda **kw: FifoQueueDisc(**kw))
+    )
+
+    def DoEnqueue(self, item) -> bool:
+        if len(self._items) >= self.max_packets:
+            return False
+        self._items.append(item)
+        return True
+
+    def DoDequeue(self):
+        return self._items.pop(0) if self._items else None
+
+
+class RedQueueDisc(QueueDisc):
+    """RED (Floyd & Jacobson 1993; red-queue-disc.{h,cc}): EWMA average
+    queue with probabilistic early drop between MinTh and MaxTh."""
+
+    tid = (
+        TypeId("tpudes::RedQueueDisc")
+        .SetParent(QueueDisc.tid)
+        .AddConstructor(lambda **kw: RedQueueDisc(**kw))
+        .AddAttribute("MinTh", "lower threshold (packets)", 5.0, field="min_th")
+        .AddAttribute("MaxTh", "upper threshold (packets)", 15.0, field="max_th")
+        .AddAttribute("QW", "EWMA weight", 0.002, field="qw")
+        .AddAttribute("LInterm", "1/max_p", 50.0, field="l_interm")
+        .AddAttribute("Gentle", "gentle RED above MaxTh", True, field="gentle")
+        .AddAttribute(
+            "LinkBandwidth", "for the idle-time EWMA decay",
+            "10Mbps", field="link_bw", checker=None,
+        )
+        .AddAttribute("MeanPktSize", "for the idle-time decay", 1000,
+                      field="mean_pkt_size")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        from tpudes.core.rng import UniformRandomVariable
+        from tpudes.network.data_rate import DataRate
+
+        self._avg = 0.0
+        self._count = 0          # packets since last drop
+        self._idle_since: int | None = 0
+        self._pkt_tx_ticks = max(
+            int(8 * self.mean_pkt_size / DataRate(self.link_bw).GetBitRate()
+                * 1e9),
+            1,
+        )
+        self._rng = UniformRandomVariable()
+        self.stats_early_drops = 0
+        self.stats_forced_drops = 0
+
+    def DoEnqueue(self, item) -> bool:
+        # Floyd's idle correction: while the queue sat empty the average
+        # decays as if m small packets had passed (red-queue-disc.cc)
+        if not self._items and self._idle_since is not None:
+            m = (Simulator.NowTicks() - self._idle_since) / self._pkt_tx_ticks
+            self._avg *= (1.0 - self.qw) ** min(m, 1e6)
+        self._idle_since = None
+        self._avg = (1 - self.qw) * self._avg + self.qw * len(self._items)
+        max_p = 1.0 / self.l_interm
+        if len(self._items) >= self.max_packets:
+            self.stats_forced_drops += 1
+            return False
+        drop = False
+        if self._avg >= self.max_th:
+            if self.gentle and self._avg < 2 * self.max_th:
+                p = max_p + (self._avg - self.max_th) / self.max_th * (
+                    1.0 - max_p
+                )
+                drop = self._rng.GetValue(0.0, 1.0) < p
+            else:
+                drop = True
+        elif self._avg > self.min_th:
+            p_b = max_p * (self._avg - self.min_th) / (
+                self.max_th - self.min_th
+            )
+            p_a = p_b / max(1.0 - self._count * p_b, 1e-9)
+            drop = self._rng.GetValue(0.0, 1.0) < p_a
+        else:
+            # below MinTh: the since-last-drop counter restarts (Floyd;
+            # without this, p_a saturates to 1 on re-entering the band)
+            self._count = 0
+        if drop:
+            self._count = 0
+            self.stats_early_drops += 1
+            return False
+        self._count += 1
+        self._items.append(item)
+        return True
+
+    def DoDequeue(self):
+        if not self._items:
+            return None
+        item = self._items.pop(0)
+        if not self._items:
+            self._idle_since = Simulator.NowTicks()
+        return item
+
+
+class CoDelQueueDisc(QueueDisc):
+    """CoDel (RFC 8289; codel-queue-disc.{h,cc}): sojourn-time keyed
+    dropping with the inverse-sqrt control law."""
+
+    tid = (
+        TypeId("tpudes::CoDelQueueDisc")
+        .SetParent(QueueDisc.tid)
+        .AddConstructor(lambda **kw: CoDelQueueDisc(**kw))
+        .AddAttribute("Target", "acceptable sojourn", Time(5_000_000), checker=Time)
+        .AddAttribute("Interval", "sliding window", Time(100_000_000), checker=Time)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._first_above_ts: int | None = None
+        self._dropping = False
+        self._drop_next = 0
+        self._drop_count = 0
+        self.stats_target_drops = 0
+
+    def DoEnqueue(self, item) -> bool:
+        if len(self._items) >= self.max_packets:
+            return False
+        self._items.append(item)
+        return True
+
+    def _sojourn_ok(self, item, now) -> bool:
+        return now - item.enqueue_ts < self.target.ticks
+
+    def _control_law(self, t: int) -> int:
+        return t + int(self.interval.ticks / math.sqrt(self._drop_count))
+
+    def DoDequeue(self):
+        now = Simulator.NowTicks()
+        item = self._pop_ok(now)
+        if item is None:
+            return None
+        if self._dropping:
+            while now >= self._drop_next and self._dropping:
+                self.stats_target_drops += 1
+                self.stats_dropped += 1
+                self.drop(item.packet)
+                self._drop_count += 1
+                item = self._pop_ok(now)
+                if item is None:
+                    self._dropping = False
+                    return None
+                if self._sojourn_ok(item, now):
+                    self._dropping = False
+                else:
+                    self._drop_next = self._control_law(self._drop_next)
+        return item
+
+    def _pop_ok(self, now):
+        """Pop the head, managing the first-above-time state machine."""
+        if not self._items:
+            self._first_above_ts = None
+            self._dropping = False
+            return None
+        item = self._items.pop(0)
+        if self._sojourn_ok(item, now) or len(self._items) == 0:
+            self._first_above_ts = None
+        else:
+            if self._first_above_ts is None:
+                self._first_above_ts = now + self.interval.ticks
+            elif now >= self._first_above_ts and not self._dropping:
+                self._dropping = True
+                self._drop_count = (
+                    self._drop_count - 2
+                    if self._drop_count > 2
+                    and now - self._drop_next < 8 * self.interval.ticks
+                    else 1
+                )
+                self._drop_next = self._control_law(now)
+        return item
+
+
+class TrafficControlLayer(Object):
+    """traffic-control-layer.{h,cc}: per-node, maps device → root qdisc
+    and drains under tx-idle flow control."""
+
+    tid = (
+        TypeId("tpudes::TrafficControlLayer")
+        .AddConstructor(lambda **kw: TrafficControlLayer(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._qdiscs: dict[int, QueueDisc] = {}   # id(device) -> qdisc
+        self._dev_send: dict[int, object] = {}    # id(device) -> raw Send
+
+    def SetRootQueueDisc(self, device, qdisc: QueueDisc) -> None:
+        if id(device) in self._qdiscs:
+            raise RuntimeError("device already has a root queue disc")
+        self._qdiscs[id(device)] = qdisc
+        self._dev_send[id(device)] = device.Send
+        # every sender now funnels through the qdisc
+        device.Send = (
+            lambda packet, dest=None, protocol=0x0800, _d=device:
+            self.Send(_d, packet, dest, protocol)
+        )
+        # wake the drain when the device finishes a frame; deferred one
+        # event because PhyTxEnd fires while the device still reports
+        # tx-busy (the devices clear the flag after the trace)
+        device.TraceConnectWithoutContext(
+            "PhyTxEnd",
+            lambda _p, d=device: Simulator.ScheduleNow(self._run, d),
+        )
+
+    def GetRootQueueDisc(self, device) -> QueueDisc | None:
+        return self._qdiscs.get(id(device))
+
+    def Send(self, device, packet, dest, protocol: int) -> bool:
+        ok = self._qdiscs[id(device)].Enqueue(
+            QueueDiscItem(packet, dest, protocol)
+        )
+        self._run(device)
+        return ok
+
+    def _device_ready(self, device) -> bool:
+        busy = getattr(device, "_tx_busy", False)
+        return not busy
+
+    def _run(self, device) -> None:
+        qdisc = self._qdiscs.get(id(device))
+        if qdisc is None:
+            return
+        raw_send = self._dev_send[id(device)]
+        while self._device_ready(device):
+            item = qdisc.Dequeue()
+            if item is None:
+                return
+            raw_send(item.packet, item.dest, item.protocol)
+
+
+QUEUE_DISCS = {
+    "tpudes::FifoQueueDisc": FifoQueueDisc,
+    "tpudes::RedQueueDisc": RedQueueDisc,
+    "tpudes::CoDelQueueDisc": CoDelQueueDisc,
+    "ns3::FifoQueueDisc": FifoQueueDisc,
+    "ns3::RedQueueDisc": RedQueueDisc,
+    "ns3::CoDelQueueDisc": CoDelQueueDisc,
+}
+
+
+class TrafficControlHelper:
+    """helper/traffic-control-helper.{h,cc}."""
+
+    def __init__(self):
+        self._type = "tpudes::FifoQueueDisc"
+        self._attrs: dict = {}
+
+    def SetRootQueueDisc(self, type_name: str, **attrs) -> None:
+        if type_name not in QUEUE_DISCS:
+            raise ValueError(f"unknown queue disc {type_name!r}")
+        self._type = type_name
+        self._attrs = attrs
+
+    def Install(self, devices):
+        from tpudes.helper.containers import NetDeviceContainer
+
+        if isinstance(devices, NetDeviceContainer):
+            devices = list(devices)
+        elif not isinstance(devices, (list, tuple)):
+            devices = [devices]
+        qdiscs = []
+        for dev in devices:
+            node = dev.GetNode()
+            tc = node.GetObject(TrafficControlLayer)
+            if tc is None:
+                tc = TrafficControlLayer()
+                node.AggregateObject(tc)
+            qdisc = QUEUE_DISCS[self._type](**self._attrs)
+            tc.SetRootQueueDisc(dev, qdisc)
+            qdiscs.append(qdisc)
+        return qdiscs
