@@ -1,0 +1,345 @@
+"""Emulation substrate: FdNetDevice + TapBridge (the "dnemu" axis).
+
+Reference parity: src/fd-net-device/model/fd-net-device.{h,cc},
+helper/fd-net-device-helper.{h,cc} and
+src/tap-bridge/model/tap-bridge.{h,cc} (upstream paths; mount empty at
+survey — SURVEY.md §0, §2.8: the fork-name's presumed
+distributed-network-EMUlation axis).
+
+FdNetDevice turns a file descriptor into a NetDevice: frames the
+simulation sends exit through ``os.write``; a reader thread blocks on
+``os.read`` and injects arriving frames through the engine's
+thread-safe context channel (``ScheduleWithContextThreadSafe`` — the
+exact seam DefaultSimulatorImpl carries for upstream's emulation read
+threads, SURVEY.md §5.2).  Pair it with RealtimeSimulatorImpl and the
+fd of a raw socket / tap to emulate against live hosts; pair it with a
+socketpair for in-process testing.
+
+TapBridge opens a kernel tap interface (/dev/net/tun, IFF_TAP) and
+ships its frames into the simulation — CONFIGURE-LOCAL flavor: the tap
+is created/owned here, the sim side responds through the bridged
+device's stack.  Gated: constructing it without tun access raises a
+clear error instead of half-working.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from tpudes.core.object import TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import Mac48Address
+from tpudes.network.net_device import NetDevice
+from tpudes.network.packet import Packet
+
+from tpudes.models.csma import EthernetHeader
+
+
+class FdNetDevice(NetDevice):
+    tid = (
+        TypeId("tpudes::FdNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddConstructor(lambda **kw: FdNetDevice(**kw))
+        .AddTraceSource("MacTx", "frame handed to the fd")
+        .AddTraceSource("MacRx", "frame read from the fd, delivered up")
+        .AddTraceSource("PhyRxDrop", "unparseable frame dropped")
+    )
+
+    MTU_GUARD = 65_536
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._fd: int | None = None
+        self._reader: threading.Thread | None = None
+        self._running = False
+
+    # --- wiring -----------------------------------------------------------
+    def SetFileDescriptor(self, fd: int) -> None:
+        if self._fd is not None:
+            raise RuntimeError("file descriptor already set")
+        self._fd = fd
+
+    def GetFileDescriptor(self) -> int | None:
+        return self._fd
+
+    def IsBroadcast(self) -> bool:
+        return True
+
+    def NeedsArp(self) -> bool:
+        return True
+
+    def GetChannel(self):
+        return None  # the "channel" is whatever the fd connects to
+
+    def Start(self) -> None:
+        """Spawn the blocking reader (upstream FdReader); idempotent.
+        A restart while the previous reader is still blocked on the fd
+        is refused — two readers would race and split frames."""
+        if self._running or self._fd is None:
+            return
+        if self._reader is not None and self._reader.is_alive():
+            raise RuntimeError(
+                "previous reader still blocked on the fd; close the fd "
+                "to release it before restarting"
+            )
+        self._running = True
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def Stop(self) -> None:
+        self._running = False
+        # the reader unblocks on fd close (callers own the fd lifecycle)
+
+    def _read_loop(self) -> None:
+        while self._running:
+            try:
+                data = os.read(self._fd, self.MTU_GUARD)
+            except OSError:
+                break
+            if not data:
+                break
+            impl = Simulator.GetImpl()
+            inject = getattr(impl, "ScheduleWithContextThreadSafe", None)
+            if inject is None:
+                break
+            node_id = self._node.GetId() if self._node else 0
+            inject(node_id, 0, self._forward_frame, (bytes(data),))
+
+    # --- rx path (sim side) ------------------------------------------------
+    @staticmethod
+    def parse_l3(data: bytes, ether_type: int) -> Packet:
+        """Raw wire bytes → structured Packet: the simulation's packets
+        carry header OBJECTS, so the fd boundary re-parses the protocol
+        chain (the inverse of Packet.ToBytes).  Unknown protocols stay
+        raw payload."""
+        if ether_type == 0x0806:  # ARP
+            from tpudes.models.internet.arp import ArpHeader
+
+            p = Packet(0)
+            p.AddHeader(ArpHeader.Deserialize(data))
+            return p
+        if ether_type != 0x0800 or len(data) < 20:
+            return Packet(data)
+        from tpudes.models.internet.ipv4 import Ipv4Header
+
+        ip, _n = Ipv4Header.Deserialize(data)
+        # honor IHL: a real kernel may send IP options (IHL > 5)
+        ihl = (data[0] & 0x0F) * 4
+        rest = data[ihl:]
+        headers = [ip]
+        if ip.protocol == 17 and len(rest) >= 8:
+            from tpudes.models.internet.udp import UdpHeader
+
+            udp, m = UdpHeader.Deserialize(rest)
+            headers.append(udp)
+            rest = rest[m:]
+        elif ip.protocol == 6 and len(rest) >= 20:
+            from tpudes.models.internet.tcp import TcpHeader
+
+            headers.append(TcpHeader.Deserialize(rest))
+            # honor the data offset: kernel TCP always carries options
+            # (MSS/wscale/timestamps); our structured header has no
+            # option fields, so they are consumed, not kept as payload
+            doff = ((rest[12] >> 4) & 0x0F) * 4
+            rest = rest[max(doff, 20):]
+        elif ip.protocol == 1 and len(rest) >= 4:
+            from tpudes.models.internet.icmp import IcmpEcho, Icmpv4Header
+
+            icmp = Icmpv4Header.Deserialize(rest)
+            headers.append(icmp)
+            rest = rest[4:]
+            if icmp.icmp_type in (0, 8) and len(rest) >= 4:
+                headers.append(IcmpEcho.Deserialize(rest))
+                rest = rest[4:]
+        p = Packet(rest)
+        for h in reversed(headers):
+            p.AddHeader(h)
+        return p
+
+    def _forward_frame(self, data: bytes) -> None:
+        if len(data) < 14:
+            self.phy_rx_drop(Packet(data))
+            return
+        header = EthernetHeader.Deserialize(data[:14])
+        packet = self.parse_l3(data[14:], header.ether_type)
+        self.mac_rx(packet)
+        broadcast = header.destination == Mac48Address.GetBroadcast()
+        to_me = header.destination == self._address
+        ptype = (
+            self._node.PACKET_BROADCAST if broadcast
+            else self._node.PACKET_HOST if to_me
+            else self._node.PACKET_OTHERHOST
+        )
+        self._deliver_up(
+            packet, header.ether_type, header.source, header.destination,
+            ptype,
+        )
+
+    # --- tx path ------------------------------------------------------------
+    @staticmethod
+    def fix_checksums(frame: bytes) -> bytes:
+        """Rewrite IPv4 / ICMP / TCP checksums so a REAL kernel accepts
+        the frame (in-sim serialization leaves them 0 unless the
+        ChecksumEnabled GlobalValue is on; UDP's 0 is legal for IPv4)."""
+        import struct
+
+        from tpudes.models.internet.ipv4 import internet_checksum
+
+        if len(frame) < 34 or frame[12:14] != b"\x08\x00":
+            return frame
+        ip_off = 14
+        ihl = (frame[ip_off] & 0x0F) * 4
+        ip_head = bytearray(frame[ip_off : ip_off + ihl])
+        ip_head[10:12] = b"\x00\x00"
+        ip_head[10:12] = struct.pack("!H", internet_checksum(bytes(ip_head)))
+        proto = frame[ip_off + 9]
+        l4_off = ip_off + ihl
+        l4 = bytearray(frame[l4_off:])
+        if proto == 1 and len(l4) >= 4:           # ICMP: over the message
+            l4[2:4] = b"\x00\x00"
+            l4[2:4] = struct.pack("!H", internet_checksum(bytes(l4)))
+        elif proto == 6 and len(l4) >= 20:        # TCP: pseudo-header sum
+            l4[16:18] = b"\x00\x00"
+            pseudo = (
+                frame[ip_off + 12 : ip_off + 20]
+                + struct.pack("!BBH", 0, 6, len(l4))
+            )
+            l4[16:18] = struct.pack(
+                "!H", internet_checksum(pseudo + bytes(l4))
+            )
+        return frame[:ip_off] + bytes(ip_head) + bytes(l4)
+
+    def Send(self, packet, dest=None, protocol: int = 0x0800) -> bool:
+        if self._fd is None or not self._link_up:
+            return False
+        self.mac_tx(packet)
+        frame = self.fix_checksums(
+            EthernetHeader(
+                destination=dest if dest is not None else self.GetBroadcast(),
+                source=self._address,
+                ether_type=protocol,
+            ).Serialize()
+            + packet.ToBytes()
+        )
+        try:
+            os.write(self._fd, frame)
+        except OSError:
+            return False
+        return True
+
+
+class FdNetDeviceHelper:
+    """helper/fd-net-device-helper.{h,cc}."""
+
+    def Install(self, node, fd: int | None = None) -> FdNetDevice:
+        dev = FdNetDevice()
+        node.AddDevice(dev)
+        if fd is not None:
+            dev.SetFileDescriptor(fd)
+        return dev
+
+
+# --- TapBridge --------------------------------------------------------------
+
+TUNSETIFF = 0x400454CA
+IFF_TAP = 0x0002
+IFF_NO_PI = 0x1000
+
+
+def create_tap(name: str = "") -> tuple[int, str]:
+    """Open /dev/net/tun and create an IFF_TAP interface; returns
+    (fd, interface name).  Raises OSError without tun access."""
+    import fcntl
+    import struct
+
+    fd = os.open("/dev/net/tun", os.O_RDWR)
+    ifr = struct.pack("16sH22x", name.encode(), IFF_TAP | IFF_NO_PI)
+    out = fcntl.ioctl(fd, TUNSETIFF, ifr)
+    ifname = out[:16].split(b"\x00", 1)[0].decode()
+    return fd, ifname
+
+
+class TapBridge(NetDevice):
+    """tap-bridge.{h,cc}, CONFIGURE-LOCAL mode: the kernel tap's frames
+    enter the simulation through the bridged device's node, and frames
+    the bridged device would deliver go back out the tap."""
+
+    tid = (
+        TypeId("tpudes::TapBridge")
+        .SetParent(NetDevice.tid)
+        .AddConstructor(lambda **kw: TapBridge(**kw))
+        .AddAttribute("DeviceName", "tap interface name", "", field="device_name")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._bridged: NetDevice | None = None
+        self._fd_dev = FdNetDevice()
+        self.tap_name: str | None = None
+
+    def SetBridgedNetDevice(self, device: NetDevice) -> None:
+        self._bridged = device
+        # sim → host: frames the bridged device delivers up go out the tap
+        device.SetPromiscReceiveCallback(self._to_tap)
+
+    def Start(self) -> None:
+        try:
+            fd, name = create_tap(self.device_name)
+        except OSError as e:
+            raise RuntimeError(
+                f"TapBridge needs /dev/net/tun access ({e}); run with "
+                "CAP_NET_ADMIN or use FdNetDevice with your own fd"
+            ) from e
+        self.tap_name = name
+        self._fd_dev.SetFileDescriptor(fd)
+        self._fd_dev.SetNode(self._bridged.GetNode())
+        self._fd_dev._rx_callback = None
+        self._fd_dev._deliver_up = self._from_tap  # raw frame hook
+        self._fd_dev.Start()
+
+    def Stop(self) -> None:
+        self._fd_dev.Stop()
+        fd = self._fd_dev.GetFileDescriptor()
+        if fd is not None:
+            os.close(fd)
+
+    # host → sim
+    def _from_tap(self, packet, protocol, sender, receiver, ptype) -> None:
+        if self._bridged is not None:
+            self._bridged.Send(packet, receiver, protocol)
+
+    # sim → host
+    def _to_tap(self, device, packet, protocol, sender, receiver=None,
+                ptype=None) -> bool:
+        fd = self._fd_dev.GetFileDescriptor()
+        if fd is None:
+            return False
+        frame = FdNetDevice.fix_checksums(
+            EthernetHeader(
+                destination=receiver or Mac48Address.GetBroadcast(),
+                source=sender,
+                ether_type=protocol,
+            ).Serialize()
+            + packet.ToBytes()
+        )
+        try:
+            os.write(fd, frame)
+        except OSError:
+            return False
+        return True
+
+
+class TapBridgeHelper:
+    def __init__(self):
+        self._attrs: dict = {}
+
+    def SetAttribute(self, name: str, value) -> None:
+        self._attrs[name] = value
+
+    def Install(self, node, device) -> TapBridge:
+        bridge = TapBridge(**self._attrs)
+        node.AddDevice(bridge)
+        bridge.SetBridgedNetDevice(device)
+        bridge.Start()
+        return bridge

@@ -44,7 +44,7 @@ class Ipv4Header(Header):
         return 20
 
     def Serialize(self) -> bytes:
-        return struct.pack(
+        head = struct.pack(
             "!BBHHHBBH4s4s",
             0x45,
             self.tos,
@@ -57,6 +57,16 @@ class Ipv4Header(Header):
             self.source.to_bytes(),
             self.destination.to_bytes(),
         )
+        # upstream parity: checksums are computed only under the
+        # ChecksumEnabled GlobalValue (in-sim receivers never validate);
+        # the emulation boundary (FdNetDevice) ALWAYS rewrites correct
+        # checksums before bytes reach a real kernel
+        from tpudes.core.global_value import GlobalValue
+
+        if GlobalValue.GetValueFailSafe("ChecksumEnabled", False):
+            ck = internet_checksum(head)
+            return head[:10] + struct.pack("!H", ck) + head[12:]
+        return head
 
     @classmethod
     def Deserialize(cls, data: bytes):
@@ -90,6 +100,16 @@ class Ipv4Header(Header):
 
     def SetTtl(self, ttl):
         self.ttl = ttl
+
+
+def internet_checksum(data: bytes) -> int:
+    """RFC 1071 one's-complement sum (zero-padded to even length)."""
+    if len(data) % 2:
+        data += b"\x00"
+    s = sum(struct.unpack(f"!{len(data) // 2}H", data))
+    s = (s & 0xFFFF) + (s >> 16)
+    s = (s & 0xFFFF) + (s >> 16)
+    return ~s & 0xFFFF
 
 
 class Ipv4InterfaceAddress:
