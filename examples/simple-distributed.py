@@ -22,7 +22,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def rank_main(rank: int, size: int, n_pairs: int, sim_time: float):
+def rank_main(rank: int, size: int, n_pairs: int, sim_time: float,
+              engine: str = "tpudes::DistributedSimulatorImpl"):
     from tpudes.core import Seconds, Simulator
     from tpudes.core.global_value import GlobalValue
     from tpudes.core.world import reset_world
@@ -38,9 +39,7 @@ def rank_main(rank: int, size: int, n_pairs: int, sim_time: float):
     reset_world()
     distributed = MpiInterface.IsEnabled() and size > 1
     if distributed:
-        GlobalValue.Bind(
-            "SimulatorImplementationType", "tpudes::DistributedSimulatorImpl"
-        )
+        GlobalValue.Bind("SimulatorImplementationType", engine)
     me = MpiInterface.GetSystemId() if distributed else 0
 
     left = NodeContainer()
@@ -83,6 +82,7 @@ def rank_main(rank: int, size: int, n_pairs: int, sim_time: float):
         rank=me,
         events=Simulator.GetEventCount(),
         windows=getattr(Simulator.GetImpl(), "windows_run", 0),
+        nulls=getattr(Simulator.GetImpl(), "null_messages_sent", 0),
         server_rx=rx_total[0],
         wall=wall,
     )
@@ -98,19 +98,31 @@ def main(argv=None):
     cmd.AddValue("ranks", "number of local ranks (processes)", 2)
     cmd.AddValue("nPairs", "echo pairs across the boundary", 8)
     cmd.AddValue("simTime", "simulated seconds", 1.0)
+    cmd.AddValue("nullMessage", "use the CMB null-message engine", False)
     cmd.Parse(argv)
     ranks, n_pairs, sim_time = int(cmd.ranks), int(cmd.nPairs), float(cmd.simTime)
+    engine = (
+        "tpudes::NullMessageSimulatorImpl"
+        if cmd.GetValue("nullMessage")
+        else "tpudes::DistributedSimulatorImpl"
+    )
 
     seq = rank_main(0, 1, n_pairs, sim_time)
     print(
         f"sequential: events={seq['events']} server_rx={seq['server_rx']} "
         f"wall={seq['wall']:.2f}s"
     )
-    results = LaunchDistributed(rank_main, ranks, args=(n_pairs, sim_time))
+    results = LaunchDistributed(
+        rank_main, ranks, args=(n_pairs, sim_time, engine)
+    )
     dist_rx = sum(r["server_rx"] for r in results)
     for r in results:
+        meter = (
+            f"nulls={r['nulls']}" if cmd.GetValue("nullMessage")
+            else f"windows={r['windows']}"
+        )
         print(
-            f"rank {r['rank']}: events={r['events']} windows={r['windows']} "
+            f"rank {r['rank']}: events={r['events']} {meter} "
             f"server_rx={r['server_rx']} wall={r['wall']:.2f}s"
         )
     ok = dist_rx == seq["server_rx"]

@@ -8,7 +8,8 @@ processes never touch the accelerator runtime.
 from __future__ import annotations
 
 
-def run_chain(rank: int, size: int, n_packets: int = 5, interval_s: float = 0.1):
+def run_chain(rank: int, size: int, n_packets: int = 5, interval_s: float = 0.1,
+              engine: str = "tpudes::DistributedSimulatorImpl"):
     """4-node p2p chain n0-n1-n2-n3, echo client on n0 → server on n3.
 
     Partitioning (size=2): n0,n1 → rank 0; n2,n3 → rank 1 (the middle
@@ -33,9 +34,7 @@ def run_chain(rank: int, size: int, n_packets: int = 5, interval_s: float = 0.1)
     reset_world()
     distributed = MpiInterface.IsEnabled() and MpiInterface.GetSize() > 1
     if distributed:
-        GlobalValue.Bind(
-            "SimulatorImplementationType", "tpudes::DistributedSimulatorImpl"
-        )
+        GlobalValue.Bind("SimulatorImplementationType", engine)
 
     left = NodeContainer()
     left.Create(2, system_id=0)
@@ -89,11 +88,13 @@ def run_chain(rank: int, size: int, n_packets: int = 5, interval_s: float = 0.1)
     Simulator.Stop(Seconds(2.0))
     Simulator.Run()
     events = Simulator.GetEventCount()
-    windows = getattr(Simulator.GetImpl(), "windows_run", 0)
+    impl = Simulator.GetImpl()
+    windows = getattr(impl, "windows_run", 0)
+    nulls = getattr(impl, "null_messages_sent", 0)
     Simulator.Destroy()
     return dict(
         server_rx=server_rx, client_rx=client_rx,
-        events=events, windows=windows,
+        events=events, windows=windows, nulls=nulls,
     )
 
 

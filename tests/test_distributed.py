@@ -31,6 +31,21 @@ def test_two_rank_run_reproduces_sequential_traces_exactly():
     assert ranks[0]["windows"] > 1 and ranks[1]["windows"] > 1
 
 
+def test_null_message_engine_reproduces_sequential_traces():
+    """The CMB engine must match the sequential oracle exactly, like
+    the granted-window engine — but without any global barrier."""
+    seq = targets.run_chain(0, 1)
+    ranks = LaunchDistributed(
+        targets.run_chain, 2,
+        args=(5, 0.1, "tpudes::NullMessageSimulatorImpl"),
+    )
+    assert ranks[1]["server_rx"] == seq["server_rx"]
+    assert ranks[0]["client_rx"] == seq["client_rx"]
+    assert ranks[0]["nulls"] > 0 and ranks[1]["nulls"] > 0
+    # no granted windows — the null-message loop doesn't use them
+    assert ranks[0]["windows"] == 0
+
+
 def test_three_rank_chain_delivers():
     ranks = LaunchDistributed(targets.run_chain_three_ranks, 3)
     assert len(ranks[2]["server_rx"]) == 3

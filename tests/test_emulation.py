@@ -268,6 +268,21 @@ def test_parse_l3_honors_tcp_data_offset_and_ihl():
     assert pkt.GetPayload() == payload, "options leaked into payload"
 
 
+def test_parse_l3_trims_ethernet_padding():
+    """Real NICs pad short frames to 60 bytes; the padding must not
+    leak into the UDP payload (r4 review)."""
+    payload = b"tiny"
+    wire = _udp_frame(
+        Mac48Address(2), Mac48Address(3), "10.5.0.9", "10.5.0.1", 7, 9,
+        payload,
+    )
+    padded = wire + b"\x00" * (60 - len(wire)) if len(wire) < 60 else wire
+    pkt = FdNetDevice.parse_l3(padded[14:], 0x0800)
+    pkt.RemoveHeader(Ipv4Header)
+    pkt.RemoveHeader(UdpHeader)
+    assert pkt.GetPayload() == payload
+
+
 def test_reader_restart_while_blocked_is_refused():
     sim_sock, world_sock = socket.socketpair(socket.AF_UNIX, socket.SOCK_DGRAM)
     dev = FdNetDevice()

@@ -1,0 +1,160 @@
+"""ConfigStore, stats pipeline, NetAnim XML, null-message support tests."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.core.config import Config
+from tpudes.core.config_store import ConfigStore
+from tpudes.core.global_value import GlobalValue
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.helper.point_to_point import PointToPointHelper
+
+
+def _echo_pair(packets=3):
+    nodes = NodeContainer()
+    nodes.Create(2)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "5Mbps")
+    p2p.SetChannelAttribute("Delay", "2ms")
+    devices = p2p.Install(nodes)
+    InternetStackHelper().Install(nodes)
+    ifc = Ipv4AddressHelper("10.1.1.0", "255.255.255.0").Assign(devices)
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(1))
+    sapps.Start(Seconds(0.0))
+    client = UdpEchoClientHelper(ifc.GetAddress(1), 9)
+    client.SetAttribute("MaxPackets", packets)
+    client.SetAttribute("Interval", Seconds(0.1))
+    client.SetAttribute("PacketSize", 400)
+    client.Install(nodes.Get(0)).Start(Seconds(0.1))
+    return nodes, devices, sapps
+
+
+# --- ConfigStore ------------------------------------------------------------
+def test_config_store_save_load_round_trip(tmp_path):
+    path = str(tmp_path / "config.txt")
+    Config.SetDefault("tpudes::PointToPointNetDevice::DataRate", "42Mbps")
+    GlobalValue.Bind("RngRun", 77)
+    ConfigStore(Mode="Save", Filename=path).ConfigureDefaults()
+    text = open(path).read()
+    assert 'default tpudes::PointToPointNetDevice::DataRate "42Mbps"' in text
+    assert 'global RngRun "77"' in text
+
+    # wipe, then replay the file
+    from tpudes.core.world import reset_world
+
+    reset_world()
+    from tpudes.core.object import _DEFAULT_OVERRIDES
+
+    _DEFAULT_OVERRIDES.clear()
+    assert GlobalValue.GetValue("RngRun") == 1
+    ConfigStore(Mode="Load", Filename=path).ConfigureDefaults()
+    assert GlobalValue.GetValue("RngRun") == 77
+    from tpudes.models.p2p import PointToPointNetDevice
+
+    dev = PointToPointNetDevice()
+    assert dev.GetAttribute("DataRate").GetBitRate() == 42_000_000
+
+
+def test_config_store_rejects_unknown_format():
+    with pytest.raises(ValueError, match="RawText"):
+        ConfigStore(Mode="Save", FileFormat="Xml")
+
+
+# --- stats pipeline ---------------------------------------------------------
+def test_probe_calculator_pipeline():
+    from tpudes.models.stats import (
+        CounterCalculator,
+        MinMaxAvgTotalCalculator,
+        Probe,
+    )
+
+    nodes, devices, sapps = _echo_pair(packets=5)
+    calc = MinMaxAvgTotalCalculator()
+    counter = CounterCalculator()
+    probe = Probe(
+        sapps.Get(0), "Rx", lambda pkt, *a: pkt.GetSize()
+    )
+    probe.Connect(calc.Update)
+    probe.Connect(counter.Update)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    assert counter.getCount() == 5
+    assert calc.getCount() == 5
+    assert calc.getMin() == calc.getMax() == 400.0
+    assert calc.getMean() == pytest.approx(400.0)
+    assert calc.getSum() == 2000.0
+    assert calc.getStddev() == pytest.approx(0.0)
+
+
+def test_gnuplot_helper_emits_plt_and_dat(tmp_path):
+    from tpudes.models.stats import GnuplotHelper
+
+    nodes, devices, sapps = _echo_pair(packets=4)
+    base = str(tmp_path / "rxbytes")
+    helper = GnuplotHelper(base, title="rx", ylabel="bytes")
+    helper.PlotProbe(
+        sapps.Get(0), "Rx", "server-rx", lambda pkt, *a: pkt.GetSize()
+    )
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    helper.Finish()
+    plt = open(base + ".plt").read()
+    assert "set terminal png" in plt and "server-rx" in plt
+    rows = open(base + "-0.dat").read().splitlines()
+    assert len(rows) == 4
+    t0, v0 = rows[0].split()
+    assert float(t0) > 0.1 and float(v0) == 400.0
+
+
+def test_file_aggregator(tmp_path):
+    from tpudes.models.stats import FileAggregator
+
+    agg = FileAggregator(str(tmp_path / "a.dat"))
+    agg.Write(1.5, t=0.25)
+    agg.Write(2.5, t=0.50)
+    agg.Close()
+    lines = open(tmp_path / "a.dat").read().splitlines()
+    assert lines[0].split()[1] == "1.5"
+    assert len(lines) == 2
+
+
+# --- NetAnim ----------------------------------------------------------------
+def test_netanim_xml_has_topology_and_packets(tmp_path):
+    from tpudes.models.netanim import AnimationInterface
+    from tpudes.models.mobility import (
+        ListPositionAllocator,
+        MobilityHelper,
+        Vector,
+    )
+
+    nodes, devices, sapps = _echo_pair(packets=3)
+    alloc = ListPositionAllocator()
+    alloc.Add(Vector(10.0, 20.0, 0.0))
+    alloc.Add(Vector(50.0, 20.0, 0.0))
+    mob = MobilityHelper()
+    mob.SetPositionAllocator(alloc)
+    mob.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mob.Install(nodes)
+    path = str(tmp_path / "anim.xml")
+    anim = AnimationInterface(path)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    Simulator.Destroy()  # flushes + closes the file
+    root = ET.parse(path).getroot()
+    assert root.tag == "anim"
+    node_els = root.findall("node")
+    assert len(node_els) == 2
+    assert node_els[0].get("locX") == "10.0"
+    links = root.findall("link")
+    assert len(links) == 1
+    pkts = root.findall("p")
+    # 3 requests + 3 echoes, tx/rx matched with ordered times
+    assert len(pkts) == 6
+    for p in pkts:
+        assert float(p.get("fbRx")) > float(p.get("fbTx"))
+    assert anim.packets_written == 6

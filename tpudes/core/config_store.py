@@ -1,0 +1,124 @@
+"""ConfigStore: save/load the attribute-default + GlobalValue universe.
+
+Reference parity: src/config-store/model/config-store.{h,cc},
+raw-text-config.{h,cc} (upstream paths; mount empty at survey —
+SURVEY.md §0, §2.10, §5.6 "ConfigStore missing" row).
+
+RawText format, upstream-shaped::
+
+    default tpudes::PointToPointNetDevice::DataRate "5Mbps"
+    global RngRun "7"
+    value /NodeList/3/$tpudes::Node/Id "3"        # per-object dump
+
+``Mode=Save`` writes the whole registered attribute universe (every
+TypeId attribute's effective default, every GlobalValue) so a run's
+parameter set is reproducible; ``Mode=Load`` replays a saved file
+through Config.SetDefault / GlobalValue.Bind before the scenario
+constructs objects.  Values are stored as strings and coerced toward
+the registered initial's type on load, exactly like the
+NS_GLOBAL_VALUE environment hook.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.object import TypeId, _DEFAULT_OVERRIDES
+
+
+def _coerce(initial, text: str):
+    if isinstance(initial, bool):
+        return text.lower() in ("1", "true", "t", "yes", "y")
+    if isinstance(initial, int) and not isinstance(initial, bool):
+        try:
+            return int(text)
+        except ValueError:
+            return text
+    if isinstance(initial, float):
+        try:
+            return float(text)
+        except ValueError:
+            return text
+    return text
+
+
+def _storable(value) -> bool:
+    return isinstance(value, (bool, int, float, str))
+
+
+class ConfigStore:
+    tid = (
+        TypeId("tpudes::ConfigStore")
+        .AddConstructor(lambda **kw: ConfigStore(**kw))
+        .AddAttribute("Mode", "Save | Load | None", "None")
+        .AddAttribute("Filename", "raw-text file", "config.txt")
+        .AddAttribute("FileFormat", "RawText (the only format)", "RawText")
+    )
+
+    def __init__(self, **attributes):
+        # plain object (not Object) keeps ConfigStore constructible
+        # before any simulator state exists, as upstream
+        spec = {a.name: a.initial for a in self.tid.attributes.values()}
+        for k, v in attributes.items():
+            if k not in spec:
+                raise ValueError(f"unknown ConfigStore attribute {k!r}")
+            spec[k] = v
+        self.mode = spec["Mode"]
+        self.filename = spec["Filename"]
+        if spec["FileFormat"] != "RawText":
+            raise ValueError("only the RawText format is implemented")
+
+    # --- the upstream entry point ----------------------------------------
+    def ConfigureDefaults(self) -> None:
+        if self.mode == "Save":
+            self._save()
+        elif self.mode == "Load":
+            self._load()
+
+    ConfigureAttributes = ConfigureDefaults  # one pass covers both here
+
+    # --- save -------------------------------------------------------------
+    def _save(self) -> None:
+        seen: set[int] = set()
+        with open(self.filename, "w") as f:
+            for name, tid in sorted(TypeId._registry.items()):
+                if name.startswith("ns3::") or id(tid) in seen:
+                    continue  # skip alias spellings, each tid once
+                seen.add(id(tid))
+                for attr in tid.attributes.values():
+                    value = _DEFAULT_OVERRIDES.get(
+                        (tid.name, attr.name), attr.initial
+                    )
+                    if _storable(value):
+                        f.write(f'default {name}::{attr.name} "{value}"\n')
+            for gv in GlobalValue.Iterate():
+                if _storable(gv.value):
+                    f.write(f'global {gv.name} "{gv.value}"\n')
+
+    # --- load -------------------------------------------------------------
+    def _load(self) -> None:
+        from tpudes.core.config import Config
+
+        with open(self.filename) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                kind, _, rest = line.partition(" ")
+                path, _, quoted = rest.partition(" ")
+                text = quoted.strip().strip('"')
+                if kind == "default":
+                    tid_name, _, attr = path.rpartition("::")
+                    tid = TypeId._registry.get(tid_name)
+                    if tid is None or attr not in tid.attributes:
+                        continue  # a build without that model
+                    Config.SetDefault(
+                        path, _coerce(tid.attributes[attr].initial, text)
+                    )
+                elif kind == "global":
+                    gv = GlobalValue._registry.get(path)
+                    if gv is not None:
+                        GlobalValue.Bind(path, _coerce(gv.initial, text))
+                else:
+                    raise ValueError(
+                        f"{self.filename}:{lineno}: unknown directive {kind!r}"
+                    )

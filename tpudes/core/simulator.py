@@ -263,7 +263,7 @@ class Simulator:
                     import tpudes.parallel  # noqa: F401  (registers itself)
 
                     impl_cls = SIMULATOR_IMPL_TYPES.get(name)
-                elif "Distributed" in name:
+                elif "Distributed" in name or "NullMessage" in name:
                     import tpudes.parallel.distributed  # noqa: F401
 
                     impl_cls = SIMULATOR_IMPL_TYPES.get(name)

@@ -53,6 +53,9 @@ class FdNetDevice(NetDevice):
         self._fd: int | None = None
         self._reader: threading.Thread | None = None
         self._running = False
+        #: supported raw hook (TapBridge): cb(bytes) consumes the frame
+        #: INSTEAD of the normal parse-and-deliver path
+        self.raw_frame_callback = None
 
     # --- wiring -----------------------------------------------------------
     def SetFileDescriptor(self, fd: int) -> None:
@@ -124,9 +127,14 @@ class FdNetDevice(NetDevice):
         from tpudes.models.internet.ipv4 import Ipv4Header
 
         ip, _n = Ipv4Header.Deserialize(data)
-        # honor IHL: a real kernel may send IP options (IHL > 5)
+        # honor IHL (a real kernel may send IP options) AND total-length
+        # (real NICs pad short frames to the Ethernet minimum — padding
+        # past the datagram must not leak into the payload)
         ihl = (data[0] & 0x0F) * 4
-        rest = data[ihl:]
+        import struct as _struct
+
+        total_len = _struct.unpack("!H", data[2:4])[0]
+        rest = data[ihl:max(min(total_len, len(data)), ihl)]
         headers = [ip]
         if ip.protocol == 17 and len(rest) >= 8:
             from tpudes.models.internet.udp import UdpHeader
@@ -158,6 +166,9 @@ class FdNetDevice(NetDevice):
         return p
 
     def _forward_frame(self, data: bytes) -> None:
+        if self.raw_frame_callback is not None:
+            self.raw_frame_callback(data)
+            return
         if len(data) < 14:
             self.phy_rx_drop(Packet(data))
             return
@@ -294,8 +305,7 @@ class TapBridge(NetDevice):
         self.tap_name = name
         self._fd_dev.SetFileDescriptor(fd)
         self._fd_dev.SetNode(self._bridged.GetNode())
-        self._fd_dev._rx_callback = None
-        self._fd_dev._deliver_up = self._from_tap  # raw frame hook
+        self._fd_dev.raw_frame_callback = self._from_tap
         self._fd_dev.Start()
 
     def Stop(self) -> None:
@@ -304,10 +314,13 @@ class TapBridge(NetDevice):
         if fd is not None:
             os.close(fd)
 
-    # host → sim
-    def _from_tap(self, packet, protocol, sender, receiver, ptype) -> None:
-        if self._bridged is not None:
-            self._bridged.Send(packet, receiver, protocol)
+    # host → sim: whole raw frames re-enter through the bridged device
+    def _from_tap(self, data: bytes) -> None:
+        if self._bridged is None or len(data) < 14:
+            return
+        header = EthernetHeader.Deserialize(data[:14])
+        packet = FdNetDevice.parse_l3(data[14:], header.ether_type)
+        self._bridged.Send(packet, header.destination, header.ether_type)
 
     # sim → host
     def _to_tap(self, device, packet, protocol, sender, receiver=None,

@@ -1,0 +1,114 @@
+"""AnimationInterface: NetAnim XML trace output.
+
+Reference parity: src/netanim/model/animation-interface.{h,cc}
+(upstream path; mount empty at survey — SURVEY.md §0, §2.10 netanim
+row).  Emits the NetAnim XML dialect the stock NetAnim GUI loads: node
+positions (<node>), wired links (<link>), per-packet animation records
+(<p> with first/last bit tx/rx times), and node counters.
+
+Hooks: device MacTx/MacRx traces on every p2p/CSMA device at
+construction time; positions come from each node's mobility model (or
+0,0).  Packet matching is by packet uid — tx records wait in a pending
+map until the matching rx fires, then the <p> row is written.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.simulator import Simulator
+
+
+class AnimationInterface:
+    def __init__(self, filename: str):
+        self.filename = filename
+        self._f = open(filename, "w")
+        self._f.write('<?xml version="1.0" encoding="utf-8"?>\n')
+        self._f.write(
+            '<anim ver="netanim-3.109" filetype="animation">\n'
+        )
+        self._pending_tx: dict[tuple, tuple] = {}
+        self.packets_written = 0
+        self._wrote_topology = False
+        self._hook_all_devices()
+        Simulator.ScheduleDestroy(self._finish)
+
+    # --- topology ---------------------------------------------------------
+    def _node_pos(self, node):
+        from tpudes.models.mobility import MobilityModel
+
+        mob = node.GetObject(MobilityModel)
+        if mob is None:
+            return 0.0, 0.0
+        p = mob.GetPosition()
+        return p.x, p.y
+
+    def _write_topology(self) -> None:
+        from tpudes.network.node import NodeList
+
+        seen_links = set()
+        for i in range(NodeList.GetNNodes()):
+            node = NodeList.GetNode(i)
+            x, y = self._node_pos(node)
+            self._f.write(
+                f'<node id="{node.GetId()}" locX="{x}" locY="{y}" />\n'
+            )
+        for i in range(NodeList.GetNNodes()):
+            node = NodeList.GetNode(i)
+            for d in range(node.GetNDevices()):
+                dev = node.GetDevice(d)
+                ch = getattr(dev, "GetChannel", lambda: None)()
+                if ch is None or id(ch) in seen_links:
+                    continue
+                seen_links.add(id(ch))
+                ids = sorted(
+                    ch.GetDevice(k).GetNode().GetId()
+                    for k in range(ch.GetNDevices())
+                )
+                for a, b in zip(ids, ids[1:]):
+                    self._f.write(
+                        f'<link fromId="{a}" toId="{b}" />\n'
+                    )
+        self._wrote_topology = True
+
+    # --- packet records ---------------------------------------------------
+    def _hook_all_devices(self) -> None:
+        from tpudes.network.node import NodeList
+
+        for i in range(NodeList.GetNNodes()):
+            node = NodeList.GetNode(i)
+            for d in range(node.GetNDevices()):
+                dev = node.GetDevice(d)
+                nid = node.GetId()
+                if not dev.tid.trace_sources.get("MacTx"):
+                    continue
+                dev.TraceConnectWithoutContext(
+                    "MacTx", lambda p, n=nid: self._on_tx(n, p)
+                )
+                dev.TraceConnectWithoutContext(
+                    "MacRx", lambda p, n=nid: self._on_rx(n, p)
+                )
+
+    def _now_s(self) -> float:
+        return Simulator.NowTicks() / 1e9
+
+    def _on_tx(self, node_id: int, packet) -> None:
+        self._pending_tx[packet.GetUid()] = (node_id, self._now_s())
+
+    def _on_rx(self, node_id: int, packet) -> None:
+        hit = self._pending_tx.pop(packet.GetUid(), None)
+        if hit is None:
+            return
+        if not self._wrote_topology:
+            self._write_topology()
+        tx_node, tx_t = hit
+        rx_t = self._now_s()
+        self._f.write(
+            f'<p fId="{tx_node}" fbTx="{tx_t:.9f}" lbTx="{tx_t:.9f}" '
+            f'tId="{node_id}" fbRx="{rx_t:.9f}" lbRx="{rx_t:.9f}" />\n'
+        )
+        self.packets_written += 1
+
+    def _finish(self) -> None:
+        if not self._wrote_topology:
+            self._write_topology()
+        self._f.write("</anim>\n")
+        self._f.close()

@@ -100,6 +100,21 @@ class PointToPointRemoteChannel(PointToPointChannel):
         if MpiInterface.IsEnabled():
             MpiInterface.RegisterLookahead(self.delay.GetTimeStep())
 
+    def Attach(self, device) -> None:
+        super().Attach(device)
+        # once both endpoints exist, the remote side's rank is known —
+        # record the per-link lookahead the null-message engine uses
+        from tpudes.parallel.mpi import MpiInterface
+
+        if len(self._devices) == 2 and MpiInterface.IsEnabled():
+            me = MpiInterface.GetSystemId()
+            for dev in self._devices:
+                sid = dev.GetNode().GetSystemId()
+                if sid != me:
+                    MpiInterface.RegisterLookahead(
+                        self.delay.GetTimeStep(), peer_rank=sid
+                    )
+
     def TransmitStart(self, packet, src_device, tx_time: Time) -> bool:
         from tpudes.parallel.mpi import MpiInterface
 

@@ -1,0 +1,186 @@
+"""Statistics framework: probes → collectors → aggregators.
+
+Reference parity: src/stats/model/{probe,data-collector,
+basic-data-calculators,gnuplot*,file-aggregator}.{h,cc} (upstream
+paths; mount empty at survey — SURVEY.md §0, §2.10 stats row).
+
+The upstream pipeline: a Probe attaches to a trace source and re-emits
+values; calculators (min/max/mean/stddev/count) and aggregators (file,
+gnuplot) consume them.  Here the same three stages exist with the
+trace system this build already has:
+
+    probe = Probe(node.GetApplication(0), "Rx", lambda pkt, *a: pkt.GetSize())
+    calc = MinMaxAvgTotalCalculator()
+    probe.Connect(calc.Update)
+    ...run...
+    calc.getMean()
+
+GnuplotHelper writes a .plt + .dat pair loadable by stock gnuplot.
+"""
+
+from __future__ import annotations
+
+import math
+
+from tpudes.core.simulator import Simulator
+
+
+class MinMaxAvgTotalCalculator:
+    """basic-data-calculators.h MinMaxAvgTotalCalculator + the stddev of
+    StatisticalSummary (Welford accumulation)."""
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._mean = 0.0
+        self._m2 = 0.0
+
+    def Update(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        d = value - self._mean
+        self._mean += d / self.count
+        self._m2 += d * (value - self._mean)
+
+    # upstream accessor spellings
+    def getCount(self) -> int:
+        return self.count
+
+    def getSum(self) -> float:
+        return self.total
+
+    def getMin(self) -> float:
+        return self.min
+
+    def getMax(self) -> float:
+        return self.max
+
+    def getMean(self) -> float:
+        return self._mean
+
+    def getStddev(self) -> float:
+        return math.sqrt(self._m2 / self.count) if self.count else 0.0
+
+
+class CounterCalculator:
+    """basic-data-calculators.h CounterCalculator."""
+
+    def __init__(self):
+        self.count = 0
+
+    def Update(self, *_args) -> None:
+        self.count += 1
+
+    def getCount(self) -> int:
+        return self.count
+
+
+class Probe:
+    """probe.h analog: attach to any trace source, map its arguments to
+    a numeric sample, fan out to sinks with the sample timestamp."""
+
+    def __init__(self, obj, trace_name: str, extractor=None):
+        self._sinks: list = []
+        self._extractor = extractor or (lambda *a: float(a[0]))
+        ok = obj.TraceConnectWithoutContext(trace_name, self._fire)
+        if not ok:
+            raise ValueError(f"no trace source {trace_name!r} on {obj!r}")
+
+    def Connect(self, sink) -> None:
+        """sink(value) — or sink(value, t_seconds) if it takes two."""
+        self._sinks.append(sink)
+
+    def _fire(self, *args) -> None:
+        value = self._extractor(*args)
+        if value is None:
+            return
+        t = Simulator.NowTicks() / 1e9
+        for sink in self._sinks:
+            try:
+                sink(value, t)
+            except TypeError:
+                sink(value)
+
+
+class FileAggregator:
+    """file-aggregator.h: (t, value) rows to a whitespace file."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self._rows: list[tuple[float, float]] = []
+
+    def Write(self, value: float, t: float = 0.0) -> None:
+        self._rows.append((t, float(value)))
+
+    def Close(self) -> None:
+        with open(self.filename, "w") as f:
+            for t, v in self._rows:
+                f.write(f"{t:.9f} {v}\n")
+
+
+class Gnuplot:
+    """gnuplot.h: datasets + a .plt driver file for stock gnuplot."""
+
+    def __init__(self, output_png: str = "plot.png", title: str = ""):
+        self.output = output_png
+        self.title = title
+        self.xlabel = ""
+        self.ylabel = ""
+        self._datasets: list[tuple[str, list]] = []
+
+    def SetTerminal(self, *_a) -> None:
+        pass  # png is the only emitted terminal
+
+    def SetLegend(self, xlabel: str, ylabel: str) -> None:
+        self.xlabel, self.ylabel = xlabel, ylabel
+
+    def AddDataset(self, title: str, xy_rows: list) -> None:
+        self._datasets.append((title, list(xy_rows)))
+
+    def GenerateOutput(self, plt_filename: str) -> None:
+        base = plt_filename.rsplit(".", 1)[0]
+        with open(plt_filename, "w") as f:
+            f.write("set terminal png\n")
+            f.write(f'set output "{self.output}"\n')
+            if self.title:
+                f.write(f'set title "{self.title}"\n')
+            if self.xlabel:
+                f.write(f'set xlabel "{self.xlabel}"\n')
+            if self.ylabel:
+                f.write(f'set ylabel "{self.ylabel}"\n')
+            plots = ", ".join(
+                f'"{base}-{i}.dat" using 1:2 title "{t}" with linespoints'
+                for i, (t, _) in enumerate(self._datasets)
+            )
+            f.write(f"plot {plots}\n")
+        for i, (_t, rows) in enumerate(self._datasets):
+            with open(f"{base}-{i}.dat", "w") as f:
+                for x, y in rows:
+                    f.write(f"{x} {y}\n")
+
+
+class GnuplotHelper:
+    """gnuplot-helper.h: probe a trace source into a time-series plot."""
+
+    def __init__(self, base_name: str, title: str = "", xlabel: str = "time (s)",
+                 ylabel: str = ""):
+        self.base_name = base_name
+        self.plot = Gnuplot(f"{base_name}.png", title)
+        self.plot.SetLegend(xlabel, ylabel)
+        self._series: dict[str, list] = {}
+
+    def PlotProbe(self, obj, trace_name: str, series: str, extractor=None):
+        rows = self._series.setdefault(series, [])
+        probe = Probe(obj, trace_name, extractor)
+        probe.Connect(lambda v, t: rows.append((t, v)))
+        return probe
+
+    def Finish(self) -> None:
+        for name, rows in self._series.items():
+            self.plot.AddDataset(name, rows)
+        self.plot.GenerateOutput(f"{self.base_name}.plt")

@@ -80,5 +80,99 @@ class DistributedSimulatorImpl(DefaultSimulatorImpl):
                 self._invoke(events.RemoveNext())
 
 
+class NullMessageSimulatorImpl(DistributedSimulatorImpl):
+    """Null-message (Chandy–Misra–Bryant) PDES engine.
+
+    Reference parity: src/mpi/model/null-message-simulator-impl.{h,cc}
+    + remote-channel-bundle (upstream paths; mount empty at survey —
+    SURVEY.md §0, §2.3).  Unlike the granted-time-window engine there is
+    NO global barrier: each rank tracks a per-peer inbound guarantee
+    ("peer p will send nothing arriving before g_p") and safely executes
+    events strictly below min(g_p).  Outbound guarantees ride data
+    messages implicitly and explicit null messages otherwise:
+
+        g_out = min(next local event, min inbound guarantee) + lookahead(p)
+
+    so sparse topologies progress at per-LINK lookahead granularity
+    instead of the global minimum.  Transport is the async pump
+    (MpiInterface.AsyncSend) — no flush barrier exists to pair writers
+    with readers, so sends must never block the event loop.
+
+    Termination: when a rank stops (its Stop event fired) it announces
+    an infinite guarantee; a peer whose pipe reaches EOF counts the
+    same.  Ranks therefore drain independently — no closing collective.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.null_messages_sent = 0
+
+    def Run(self) -> None:
+        self._stop = False
+        events = self._events
+        peers = list(MpiInterface._conns)
+        guarantee_in = {p: MpiInterface.PeerLookahead(p) for p in peers}
+        last_out = {p: -1 for p in peers}
+
+        def absorb(msgs):
+            for rank, msg in msgs:
+                if msg[0] == "null":
+                    guarantee_in[rank] = max(guarantee_in[rank], msg[1])
+                elif msg[0] == "pkt":
+                    _, rx_ts, node_id, if_index, packet = msg
+                    self._deliver(rx_ts, node_id, if_index, packet)
+                    # NOTE: a data message's rx_ts is NOT a guarantee —
+                    # with two different-delay channels to the same rank
+                    # a later-sent fast-link packet can carry an earlier
+                    # rx_ts (upstream tracks guarantees per channel
+                    # bundle; here only explicit nulls advance them)
+                elif msg[0] == "eof":
+                    guarantee_in[rank] = INF_TS
+
+        def send_nulls():
+            next_ts = INF_TS if events.IsEmpty() else events.PeekNext().ts
+            inbound = min(guarantee_in.values(), default=INF_TS)
+            for p in peers:
+                if self._stop:
+                    g = INF_TS
+                else:
+                    g = min(
+                        min(next_ts, inbound) + MpiInterface.PeerLookahead(p),
+                        INF_TS,
+                    )
+                if g > last_out[p]:
+                    last_out[p] = g
+                    MpiInterface.AsyncSend(p, ("null", g))
+                    self.null_messages_sent += 1
+
+        while True:
+            self._process_events_with_context()
+            absorb(MpiInterface.RecvReady(0))
+            safe = min(guarantee_in.values(), default=INF_TS)
+            progressed = False
+            while not self._stop:
+                self._process_events_with_context()
+                if events.IsEmpty() or events.PeekNext().ts >= safe:
+                    break
+                self._invoke(events.RemoveNext())
+                progressed = True
+            # ship whatever the processed events spooled cross-rank
+            MpiInterface.FlushAsync()
+            if self._stop:
+                send_nulls()          # the INF farewell
+                MpiInterface.DrainSender()
+                return
+            if events.IsEmpty() and safe >= INF_TS:
+                return                # globally drained
+            send_nulls()
+            if not progressed:
+                # stuck below a peer guarantee: block for traffic
+                absorb(MpiInterface.RecvReady(5.0))
+
+
 register_simulator_impl("tpudes::DistributedSimulatorImpl", DistributedSimulatorImpl)
 register_simulator_impl("ns3::DistributedSimulatorImpl", DistributedSimulatorImpl)
+register_simulator_impl(
+    "tpudes::NullMessageSimulatorImpl", NullMessageSimulatorImpl
+)
+register_simulator_impl("ns3::NullMessageSimulatorImpl", NullMessageSimulatorImpl)

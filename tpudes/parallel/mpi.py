@@ -43,6 +43,9 @@ class MpiInterface:
     _conns: dict[int, object] = {}     # peer rank -> duplex Connection
     _spool: dict[int, list] = {}       # peer rank -> pending wire blobs
     _lookahead_ts: int = INF_TS        # min remote-channel delay (ticks)
+    _peer_lookahead: dict[int, int] = {}
+    _sender: object = None             # async sender thread (null-message)
+    _send_q: object = None
     _rx_count = 0
     _tx_count = 0
 
@@ -54,10 +57,16 @@ class MpiInterface:
         cls._conns = dict(conns)
         cls._spool = {}
         cls._lookahead_ts = INF_TS
+        cls._peer_lookahead = {}
+        cls._sender = None
+        cls._send_q = None
         cls._rx_count = cls._tx_count = 0
 
     @classmethod
     def Disable(cls) -> None:
+        if cls._send_q is not None:
+            cls.DrainSender()
+            cls._send_q.put(None)
         for c in cls._conns.values():
             try:
                 c.close()
@@ -68,6 +77,9 @@ class MpiInterface:
         cls._conns = {}
         cls._spool = {}
         cls._lookahead_ts = INF_TS
+        cls._peer_lookahead = {}
+        cls._sender = None
+        cls._send_q = None
 
     @classmethod
     def IsEnabled(cls) -> bool:
@@ -83,17 +95,27 @@ class MpiInterface:
 
     # --- lookahead registry (remote channels report their delay) ---------
     @classmethod
-    def RegisterLookahead(cls, delay_ticks: int) -> None:
+    def RegisterLookahead(cls, delay_ticks: int, peer_rank: int | None = None) -> None:
         if delay_ticks <= 0:
             raise ValueError(
                 "remote channels need a positive delay (zero lookahead "
                 "deadlocks the conservative grant)"
             )
         cls._lookahead_ts = min(cls._lookahead_ts, delay_ticks)
+        if peer_rank is not None:
+            cls._peer_lookahead[peer_rank] = min(
+                cls._peer_lookahead.get(peer_rank, INF_TS), delay_ticks
+            )
 
     @classmethod
     def MinLookahead(cls) -> int:
         return cls._lookahead_ts
+
+    @classmethod
+    def PeerLookahead(cls, rank: int) -> int:
+        """Per-link lookahead toward ``rank`` (the null-message bound);
+        falls back to the global minimum when no link names the peer."""
+        return cls._peer_lookahead.get(rank, cls._lookahead_ts)
 
     # --- data plane -------------------------------------------------------
     @classmethod
@@ -137,6 +159,86 @@ class MpiInterface:
                 cls._rx_count += 1
                 deliver(rx_ts, node_id, if_index, packet)
         writer.join()
+
+    # --- async data plane (the null-message engine's transport) -----------
+    @classmethod
+    def _ensure_sender(cls) -> None:
+        if cls._sender is not None:
+            return
+        import queue
+        import threading
+
+        cls._send_q = queue.Queue()
+        dead: set[int] = set()
+
+        def pump():
+            while True:
+                item = cls._send_q.get()
+                try:
+                    if item is None:
+                        return
+                    rank, blob = item
+                    if rank in dead:
+                        continue
+                    try:
+                        cls._conns[rank].send_bytes(blob)
+                    except (OSError, KeyError):
+                        # ONE peer going away (it finished and closed its
+                        # pipes) must not kill delivery to the others
+                        dead.add(rank)
+                finally:
+                    cls._send_q.task_done()
+
+        cls._sender = threading.Thread(target=pump, daemon=True)
+        cls._sender.start()
+
+    @classmethod
+    def AsyncSend(cls, dst_rank: int, msg: tuple) -> None:
+        """Non-blocking send via the pump thread — a full pipe can never
+        wedge the event loop (the MPI_Isend analog for null-message
+        traffic, where no flush barrier exists to pair writers/readers)."""
+        cls._ensure_sender()
+        cls._send_q.put((dst_rank, pickle.dumps(msg)))
+        cls._tx_count += 1
+
+    @classmethod
+    def FlushAsync(cls) -> None:
+        """Hand the spool to the pump thread (the null-message engine's
+        per-iteration drain — no barrier ever pairs these sends)."""
+        spool, cls._spool = cls._spool, {}
+        if not spool:
+            return
+        cls._ensure_sender()
+        for rank, blobs in spool.items():
+            for blob in blobs:
+                cls._send_q.put((rank, blob))
+
+    @classmethod
+    def RecvReady(cls, timeout: float | None):
+        """Messages available within ``timeout`` seconds: list of
+        (peer_rank, msg).  A peer whose pipe closed yields
+        ('eof', peer)."""
+        from multiprocessing.connection import wait as mp_wait
+
+        by_conn = {id(c): r for r, c in cls._conns.items()}
+        ready = mp_wait(list(cls._conns.values()), timeout=timeout)
+        out = []
+        for c in ready:
+            rank = by_conn[id(c)]
+            try:
+                out.append((rank, pickle.loads(c.recv_bytes())))
+                cls._rx_count += 1
+            except (EOFError, OSError):
+                out.append((rank, ("eof",)))
+        return out
+
+    @classmethod
+    def DrainSender(cls) -> None:
+        """Block until the pump thread has fully WRITTEN everything
+        queued (task_done fires after send_bytes returns — an empty
+        queue alone races the final in-flight write)."""
+        if cls._send_q is not None:
+            cls._send_q.join()
 
     @classmethod
     def AllReduceMin(cls, candidate_ts: int) -> int:
