@@ -1,0 +1,290 @@
+"""Antenna, buildings, topology readers, CsvReader tests.
+
+Upstream analogs: src/antenna/test (pattern values at canonical
+angles), src/buildings/test (wall-loss classification), topology-read
+parsing tests, csv-reader test suite.
+"""
+
+import io
+import math
+
+import numpy as np
+import pytest
+
+from tpudes.core.csv_reader import CsvReader
+from tpudes.models.antenna import (
+    Angles,
+    CosineAntennaModel,
+    IsotropicAntennaModel,
+    ParabolicAntennaModel,
+    ThreeGppAntennaModel,
+)
+from tpudes.models.buildings import (
+    Building,
+    BuildingList,
+    BuildingsPropagationLossModel,
+    batch_wall_crossings,
+)
+from tpudes.helper.topology_read import TopologyReaderHelper
+
+
+# --- antenna ----------------------------------------------------------------
+def test_isotropic_gain_everywhere():
+    a = IsotropicAntennaModel(Gain=3.0)
+    for az in (-math.pi, 0.0, 1.0):
+        assert a.GetGainDb(Angles(az)) == 3.0
+
+
+def test_parabolic_pattern_values():
+    a = ParabolicAntennaModel(Orientation=0.0, Beamwidth=70.0,
+                              MaxAttenuation=20.0)
+    assert a.GetGainDb(Angles(0.0)) == pytest.approx(0.0)
+    # at the -3dB half-beamwidth (35°): 12·(35/70)² = 3 dB down
+    assert a.GetGainDb(Angles(math.radians(35))) == pytest.approx(-3.0)
+    # backlobe clamps at MaxAttenuation
+    assert a.GetGainDb(Angles(math.pi)) == pytest.approx(-20.0)
+
+
+def test_cosine_boresight_and_beamwidth():
+    a = CosineAntennaModel(Orientation=0.0, HorizontalBeamwidth=120.0,
+                           MaxGain=5.0)
+    assert a.GetGainDb(Angles(0.0)) == pytest.approx(5.0)
+    # the -3 dB point sits at half the beamwidth by construction
+    assert a.GetGainDb(Angles(math.radians(60))) == pytest.approx(5.0 - 3.0)
+
+
+def test_three_gpp_element_pattern():
+    a = ThreeGppAntennaModel(Orientation=0.0)
+    assert a.GetGainDb(Angles(0.0)) == pytest.approx(8.0)
+    # 65° horizontal: 12·(65/65)² = 12 dB down
+    assert a.GetGainDb(Angles(math.radians(65.0))) == pytest.approx(8.0 - 12.0)
+
+
+def test_angles_from_positions():
+    class V:
+        def __init__(self, x, y, z):
+            self.x, self.y, self.z = x, y, z
+
+    ang = Angles.FromPositions(V(0, 0, 0), V(1, 1, 0))
+    assert ang.azimuth == pytest.approx(math.pi / 4)
+    assert ang.inclination == pytest.approx(math.pi / 2)
+
+
+# --- buildings --------------------------------------------------------------
+def test_wall_crossings_through_and_inside():
+    Building(x_min=10, x_max=20, y_min=-5, y_max=5, z_min=0, z_max=10,
+             ExternalWallsType=Building.CONCRETE_WITH_WINDOWS)  # 7 dB walls
+    tx = np.array([[0.0, 0.0, 1.5]])
+    through = np.array([[30.0, 0.0, 1.5]])     # crosses both walls
+    inside = np.array([[15.0, 0.0, 1.5]])      # ends inside: one wall
+    clear = np.array([[0.0, 30.0, 1.5]])       # misses entirely
+    assert batch_wall_crossings(tx, through)[0, 0] == pytest.approx(14.0)
+    assert batch_wall_crossings(tx, inside)[0, 0] == pytest.approx(7.0)
+    assert batch_wall_crossings(tx, clear)[0, 0] == 0.0
+
+
+def test_buildings_loss_model_chains_on_outdoor():
+    from tpudes.models.propagation import LogDistancePropagationLossModel
+
+    Building(x_min=40, x_max=60, y_min=-10, y_max=10,
+             ExternalWallsType=Building.CONCRETE_WITHOUT_WINDOWS)  # 15 dB
+    model = BuildingsPropagationLossModel(
+        outdoor_model=LogDistancePropagationLossModel()
+    )
+    p_tx = np.array([[0.0, 0.0, 1.5]])
+    p_rx = np.array([[100.0, 0.0, 1.5]])    # through both walls: 30 dB
+    d = np.array([[100.0]])
+    base = model.outdoor.batch_rx_power(0.0, d)
+    full = model.batch_rx_power(0.0, d, p_tx, p_rx)
+    assert float(np.asarray(base - full)[0, 0]) == pytest.approx(30.0)
+
+
+def test_lte_controller_applies_buildings_and_antenna():
+    """A building between eNB and UE + a sector antenna pointed away
+    must both depress the DL gain matrix."""
+    from tpudes.helper.containers import NodeContainer
+    from tpudes.models.antenna import ParabolicAntennaModel
+    from tpudes.models.lte import LteHelper
+    from tpudes.models.mobility import (
+        ListPositionAllocator,
+        MobilityHelper,
+        Vector,
+    )
+
+    lte = LteHelper()
+    enbs = NodeContainer()
+    enbs.Create(1)
+    ues = NodeContainer()
+    ues.Create(2)
+    ea = ListPositionAllocator()
+    ea.Add(Vector(0, 0, 30))
+    me = MobilityHelper()
+    me.SetPositionAllocator(ea)
+    me.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    me.Install(enbs)
+    ua = ListPositionAllocator()
+    ua.Add(Vector(100, 0, 1.5))    # east
+    ua.Add(Vector(-100, 0, 1.5))   # west
+    mu = MobilityHelper()
+    mu.SetPositionAllocator(ua)
+    mu.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mu.Install(ues)
+    enb_devs = lte.InstallEnbDevice(enbs)
+    ue_devs = lte.InstallUeDevice(ues)
+    lte.Attach([ue_devs.Get(0), ue_devs.Get(1)])
+    ctrl = lte.controller
+    ctrl._rebuild()
+    sym = ctrl._gain_dl.copy()
+    assert sym[0, 0] == pytest.approx(sym[0, 1], rel=1e-6)
+
+    # east-facing sector: the west UE loses its backlobe attenuation
+    enb_devs.Get(0).phy.antenna = ParabolicAntennaModel(
+        Orientation=0.0, MaxAttenuation=20.0
+    )
+    ctrl._dirty = True
+    ctrl._rebuild()
+    with_ant = ctrl._gain_dl.copy()
+    assert with_ant[0, 0] == pytest.approx(sym[0, 0], rel=1e-6)
+    assert 10 * np.log10(with_ant[0, 1] / sym[0, 1]) == pytest.approx(-20.0)
+
+    # drop a tall building across the east path (the 30 m eNB clears a
+    # default 10 m roof): only the east UE suffers
+    Building(x_min=40, x_max=60, y_min=-10, y_max=10, z_min=0, z_max=50,
+             ExternalWallsType=Building.CONCRETE_WITH_WINDOWS)
+    ctrl._dirty = True
+    ctrl._rebuild()
+    with_bld = ctrl._gain_dl
+    assert 10 * np.log10(with_bld[0, 0] / with_ant[0, 0]) == pytest.approx(-14.0)
+    assert with_bld[0, 1] == pytest.approx(with_ant[0, 1], rel=1e-6)
+
+
+def test_rem_reflects_antenna_and_buildings():
+    """The REM grid must see the same scene the controller does."""
+    from tpudes.helper.containers import NodeContainer
+    from tpudes.models.lte import LteHelper, RadioEnvironmentMapHelper
+    from tpudes.models.antenna import ParabolicAntennaModel
+    from tpudes.models.mobility import (
+        ListPositionAllocator,
+        MobilityHelper,
+        Vector,
+    )
+
+    lte = LteHelper()
+    enbs = NodeContainer()
+    enbs.Create(1)
+    ea = ListPositionAllocator()
+    ea.Add(Vector(0, 0, 30))
+    me = MobilityHelper()
+    me.SetPositionAllocator(ea)
+    me.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    me.Install(enbs)
+    enb_devs = lte.InstallEnbDevice(enbs)
+    ues = NodeContainer()
+    ues.Create(1)
+    ua = ListPositionAllocator()
+    ua.Add(Vector(50, 0, 1.5))
+    mu = MobilityHelper()
+    mu.SetPositionAllocator(ua)
+    mu.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mu.Install(ues)
+    ue_devs = lte.InstallUeDevice(ues)
+    lte.Attach([ue_devs.Get(0)])
+    rem = RadioEnvironmentMapHelper(lte)
+    flat, _ = rem.Compute(-200, 200, -200, 200, 9)
+    enb_devs.Get(0).phy.antenna = ParabolicAntennaModel(
+        Orientation=0.0, MaxAttenuation=20.0
+    )
+    shaped, _ = rem.Compute(-200, 200, -200, 200, 9)
+    mid = 4  # the y=0 row; east column > west column under the sector
+    assert shaped[mid, -1] > shaped[mid, 0]
+    # a single-cell map has no interference: backlobe drops SINR by
+    # the full attenuation
+    assert flat[mid, 0] - shaped[mid, 0] == pytest.approx(20.0, abs=0.5)
+
+
+# --- topology readers -------------------------------------------------------
+def test_inet_reader_round_trip(tmp_path):
+    f = tmp_path / "topo.inet"
+    f.write_text(
+        "3 2\n"
+        "0 10.0 20.0\n"
+        "1 30.0 20.0\n"
+        "2 50.0 20.0\n"
+        "0 1 1.0\n"
+        "1 2 2.5\n"
+    )
+    h = TopologyReaderHelper()
+    h.SetFileName(str(f))
+    h.SetFileType("Inet")
+    reader = h.GetTopologyReader()
+    assert reader.NodesSize() == 3 and reader.LinksSize() == 2
+    g = reader.ToGraph()
+    assert g.n == 3 and g.m == 2
+    assert tuple(g.pos[1]) == (30.0, 20.0)
+    assert g.is_connected()
+
+
+def test_orbis_and_rocketfuel_readers(tmp_path):
+    orbis = tmp_path / "topo.orbis"
+    orbis.write_text("a b\nb c\nc a\n")
+    h = TopologyReaderHelper()
+    h.SetFileName(str(orbis))
+    h.SetFileType("Orbis")
+    r = h.GetTopologyReader()
+    assert r.NodesSize() == 3 and r.LinksSize() == 3
+
+    rf = tmp_path / "topo.rf"
+    rf.write_text("Seattle,WA Portland,OR 2.5\nPortland,OR Boise,ID 4\n")
+    h.SetFileName(str(rf))
+    h.SetFileType("Rocketfuel")
+    r = h.GetTopologyReader()
+    assert r.NodesSize() == 3 and r.LinksSize() == 2
+    assert r.GetLinks()[0][2]["weight"] == 2.5
+
+
+def test_topology_graph_runs_in_flow_engine(tmp_path):
+    """A read topology drops into the config-#5 flow engine."""
+    import jax
+
+    from tpudes.parallel.as_flows import AsFlowsProgram, run_as_flows
+
+    f = tmp_path / "line.inet"
+    f.write_text(
+        "4 3\n0 0 0\n1 1 0\n2 2 0\n3 3 0\n0 1 1\n1 2 1\n2 3 1\n"
+    )
+    h = TopologyReaderHelper()
+    h.SetFileName(str(f))
+    h.SetFileType("Inet")
+    g = h.GetTopologyReader().ToGraph()
+    prog = AsFlowsProgram(
+        n=g.n, edges=g.edges, delay_s=g.delay_s, rate_bps=g.rate_bps,
+        src=np.array([0], np.int32), dst=np.array([3], np.int32),
+        flow_bps=np.array([1e5]), pkt_bytes=512, sim_s=1.0,
+        max_hops=8, spf_rounds=8, rate_jitter=0.0,
+    )
+    out = run_as_flows(prog, jax.random.PRNGKey(0), replicas=2)
+    assert int(np.asarray(out["hops"])[0]) == 3
+    assert not np.asarray(out["unreachable"]).any()
+
+
+# --- csv reader -------------------------------------------------------------
+def test_csv_reader_types_comments_quotes():
+    src = io.StringIO(
+        "# a comment line\n"
+        "1,hello,3.5,true\n"
+        "\n"
+        '2,"with, comma",4.5,false\n'
+    )
+    r = CsvReader(src)
+    assert r.FetchNextRow()
+    assert r.GetValue(0, int) == 1
+    assert r.GetValue(1) == "hello"
+    assert r.GetValue(2, float) == 3.5
+    assert r.GetValue(3, bool) is True
+    assert r.FetchNextRow()
+    assert r.GetValue(1) == "with, comma"
+    assert r.GetValue(3, bool) is False
+    assert not r.FetchNextRow()
+    assert r.row_number == 2
+    with pytest.raises(IndexError):
+        r.GetValue(0)

@@ -1,0 +1,64 @@
+"""CsvReader: typed row-by-row CSV parsing.
+
+Reference parity: src/core/model/csv-reader.{h,cc} (upstream path;
+mount empty at survey — SURVEY.md §0, §2.1 misc row).  Same contract:
+``FetchNextRow`` advances, ``GetValue(col)`` coerces to the requested
+type, comment lines (#) and blank lines are skipped, quoted fields may
+contain the delimiter.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io
+
+
+class CsvReader:
+    def __init__(self, source: str, delimiter: str = ","):
+        """``source``: a filename, or a file-like object."""
+        if isinstance(source, str):
+            self._f = open(source, newline="")
+            self._owns = True
+        else:
+            self._f = source
+            self._owns = False
+        self._reader = _csv.reader(self._f, delimiter=delimiter)
+        self._row: list[str] | None = None
+        self.row_number = 0
+
+    def Close(self) -> None:
+        if self._owns and not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "CsvReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.Close()
+
+    def FetchNextRow(self) -> bool:
+        for row in self._reader:
+            if not row or (row[0].lstrip().startswith("#")):
+                continue
+            self._row = [c.strip() for c in row]
+            self.row_number += 1
+            return True
+        self._row = None
+        self.Close()
+        return False
+
+    def ColumnCount(self) -> int:
+        return len(self._row) if self._row else 0
+
+    def IsBlankRow(self) -> bool:
+        return not self._row or all(not c for c in self._row)
+
+    def GetValue(self, column: int, astype=str):
+        """Coerced cell value; raises ValueError on a bad cell, like
+        upstream's bool return + NS_ABORT idiom collapsed into raising."""
+        if self._row is None or column >= len(self._row):
+            raise IndexError(f"no column {column} in row {self.row_number}")
+        text = self._row[column]
+        if astype is bool:
+            return text.lower() in ("1", "true", "t", "yes", "y")
+        return astype(text)

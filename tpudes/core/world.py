@@ -32,3 +32,6 @@ def reset_world() -> None:
     gr = sys.modules.get("tpudes.models.internet.global_routing")
     if gr is not None:
         gr.GlobalRouteManager.Reset()
+    bl = sys.modules.get("tpudes.models.buildings")
+    if bl is not None:
+        bl.BuildingList.Reset()

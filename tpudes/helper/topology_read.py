@@ -1,0 +1,158 @@
+"""Topology readers: Inet / Orbis / Rocketfuel file formats.
+
+Reference parity: src/topology-read/model/{topology-reader,
+inet-topology-reader,orbis-topology-reader,rocketfuel-topology-
+reader}.{h,cc} + helper/topology-reader-helper.{h,cc} (upstream paths;
+mount empty at survey — SURVEY.md §0, §2.9 topology-read row).
+
+Readers parse the on-disk formats into (node names, links); the
+resulting graph feeds the same object-construction path as the BRITE
+generator (BriteGraph/BuildTopology), so a measured Internet topology
+drops into any scenario that takes a synthetic one.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+
+class TopologyReader:
+    """Base: after Read(), ``GetNodes()`` → ordered names, ``GetLinks()``
+    → (from_name, to_name, attrs) triples."""
+
+    def __init__(self, filename: str = ""):
+        self.filename = filename
+        self._nodes: list[str] = []
+        self._node_set: dict[str, int] = {}
+        self._links: list[tuple[str, str, dict]] = []
+
+    def SetFileName(self, filename: str) -> None:
+        self.filename = filename
+
+    def _add_node(self, name: str) -> None:
+        if name not in self._node_set:
+            self._node_set[name] = len(self._nodes)
+            self._nodes.append(name)
+
+    def _add_link(self, a: str, b: str, **attrs) -> None:
+        self._add_node(a)
+        self._add_node(b)
+        self._links.append((a, b, attrs))
+
+    def GetNodes(self) -> list[str]:
+        return list(self._nodes)
+
+    def GetLinks(self) -> list[tuple[str, str, dict]]:
+        return list(self._links)
+
+    def LinksSize(self) -> int:
+        return len(self._links)
+
+    def NodesSize(self) -> int:
+        return len(self._nodes)
+
+    def Read(self):
+        raise NotImplementedError
+
+    # --- shared materialization (the BRITE BuildTopology path) ------------
+    def ToGraph(self, default_rate_bps: float = 10e6,
+                default_delay_s: float = 2e-3):
+        """Arrays for the device flow engine / object construction."""
+        from tpudes.helper.topology import BriteGraph
+
+        idx = self._node_set
+        edges = np.asarray(
+            [(idx[a], idx[b]) for a, b, _ in self._links], np.int32
+        ).reshape(-1, 2)
+        delays = np.asarray(
+            [float(at.get("delay_s", default_delay_s))
+             for _a, _b, at in self._links]
+        )
+        rates = np.full(len(self._links), default_rate_bps)
+        pos = np.zeros((len(self._nodes), 2))
+        return BriteGraph(len(self._nodes), edges, delays, rates, pos)
+
+
+class InetTopologyReader(TopologyReader):
+    """inet-topology-reader.cc: header "n_nodes n_links", node lines
+    "id x y", link lines "from to weight"."""
+
+    def Read(self):
+        with open(self.filename) as f:
+            lines = [
+                s for s in (ln.strip() for ln in f)
+                if s and not s.startswith("#")
+            ]
+        n_nodes, _n_links = (int(v) for v in lines[0].split()[:2])
+        self._coords: dict[str, tuple[float, float]] = {}
+        for ln in lines[1 : 1 + n_nodes]:
+            parts = ln.split()
+            self._add_node(parts[0])
+            self._coords[parts[0]] = (float(parts[1]), float(parts[2]))
+        for ln in lines[1 + n_nodes :]:
+            parts = ln.split()
+            self._add_link(parts[0], parts[1],
+                           weight=float(parts[2]) if len(parts) > 2 else 1.0)
+        return self
+
+    def ToGraph(self, **kw):
+        g = super().ToGraph(**kw)
+        for name, (x, y) in self._coords.items():
+            g.pos[self._node_set[name]] = (x, y)
+        return g
+
+
+class OrbisTopologyReader(TopologyReader):
+    """orbis-topology-reader.cc: one "from to" pair per line."""
+
+    def Read(self):
+        with open(self.filename) as f:
+            for ln in f:
+                parts = ln.split()
+                if len(parts) >= 2:
+                    self._add_link(parts[0], parts[1])
+        return self
+
+
+class RocketfuelTopologyReader(TopologyReader):
+    """rocketfuel-topology-reader.cc, the 'weights' flavor the suite
+    ships: lines "node1 node2 weight" where names may contain commas
+    (city,country); the maps flavor's rich syntax is out of scope."""
+
+    _LINE = re.compile(r"^(\S+)\s+(\S+)\s+([0-9.]+)\s*$")
+
+    def Read(self):
+        with open(self.filename) as f:
+            for ln in f:
+                m = self._LINE.match(ln.strip())
+                if m:
+                    self._add_link(m.group(1), m.group(2),
+                                   weight=float(m.group(3)))
+        return self
+
+
+class TopologyReaderHelper:
+    FORMATS = {
+        "Inet": InetTopologyReader,
+        "Orbis": OrbisTopologyReader,
+        "Rocketfuel": RocketfuelTopologyReader,
+    }
+
+    def __init__(self):
+        self._filename = ""
+        self._format = "Inet"
+
+    def SetFileName(self, filename: str) -> None:
+        self._filename = filename
+
+    def SetFileType(self, fmt: str) -> None:
+        if fmt not in self.FORMATS:
+            raise ValueError(f"unknown topology format {fmt!r}")
+        self._format = fmt
+
+    def GetTopologyReader(self) -> TopologyReader:
+        reader = self.FORMATS[self._format](self._filename)
+        reader.Read()
+        return reader

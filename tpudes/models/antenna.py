@@ -1,0 +1,148 @@
+"""Antenna models: gain as a function of direction.
+
+Reference parity: src/antenna/model/{antenna-model,isotropic-antenna-
+model,cosine-antenna-model,parabolic-antenna-model,three-gpp-antenna-
+model}.{h,cc} (upstream paths; mount empty at survey — SURVEY.md §0,
+§2.4 antenna row).
+
+Angles follow upstream: azimuth φ ∈ (-π, π] measured in the horizontal
+plane, inclination θ ∈ [0, π] from the +z axis.  Every model exposes
+``GetGainDb(Angles)`` plus a vectorized ``batch_gain_db(az, incl)``
+(numpy arrays) — the batched form is what the LTE controller and the
+REM helper consume, one call for every eNB×UE pair.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from tpudes.core.object import Object, TypeId
+
+
+class Angles:
+    """angles.h: (azimuth, inclination) of a direction, or of b - a."""
+
+    __slots__ = ("azimuth", "inclination")
+
+    def __init__(self, azimuth=0.0, inclination=math.pi / 2):
+        self.azimuth = azimuth
+        self.inclination = inclination
+
+    @classmethod
+    def FromPositions(cls, a, b) -> "Angles":
+        """Direction of ``b`` as seen from ``a`` (Vector-likes)."""
+        dx, dy, dz = b.x - a.x, b.y - a.y, b.z - a.z
+        h = math.hypot(dx, dy)
+        return cls(math.atan2(dy, dx), math.atan2(h, dz))
+
+
+def _wrap_deg(delta: np.ndarray) -> np.ndarray:
+    """Wrap an angle difference into [-180, 180) degrees."""
+    return (delta + 180.0) % 360.0 - 180.0
+
+
+class AntennaModel(Object):
+    tid = TypeId("tpudes::AntennaModel")
+
+    def GetGainDb(self, angles: Angles) -> float:
+        return float(
+            self.batch_gain_db(
+                np.asarray([angles.azimuth]), np.asarray([angles.inclination])
+            )[0]
+        )
+
+    def batch_gain_db(self, az: np.ndarray, incl: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class IsotropicAntennaModel(AntennaModel):
+    tid = (
+        TypeId("tpudes::IsotropicAntennaModel")
+        .SetParent(AntennaModel.tid)
+        .AddConstructor(lambda **kw: IsotropicAntennaModel(**kw))
+        .AddAttribute("Gain", "flat gain (dB)", 0.0, field="gain_db")
+    )
+
+    def batch_gain_db(self, az, incl):
+        return np.full(np.shape(az), float(self.gain_db))
+
+
+class CosineAntennaModel(AntennaModel):
+    """cosine-antenna-model.cc: g(φ) = cosⁿ((φ-φ₀)/2) with n set by the
+    -3 dB beamwidth; vertical pattern flat, as upstream."""
+
+    tid = (
+        TypeId("tpudes::CosineAntennaModel")
+        .SetParent(AntennaModel.tid)
+        .AddConstructor(lambda **kw: CosineAntennaModel(**kw))
+        .AddAttribute("Orientation", "boresight azimuth (deg)", 0.0,
+                      field="orientation_deg")
+        .AddAttribute("HorizontalBeamwidth", "-3dB width (deg)", 120.0,
+                      field="beamwidth_deg")
+        .AddAttribute("MaxGain", "boresight gain (dB)", 0.0, field="max_gain_db")
+    )
+
+    def _exponent(self) -> float:
+        hw = math.radians(self.beamwidth_deg) / 2.0
+        return -3.0 / (20.0 * math.log10(math.cos(hw / 2.0)))
+
+    def batch_gain_db(self, az, incl):
+        n = self._exponent()
+        delta = np.radians(
+            _wrap_deg(np.degrees(np.asarray(az)) - self.orientation_deg)
+        )
+        c = np.cos(delta / 2.0)
+        gain = np.where(
+            c > 0, 20.0 * n * np.log10(np.maximum(c, 1e-12)), -np.inf
+        )
+        return self.max_gain_db + np.maximum(gain, -100.0)
+
+
+class ParabolicAntennaModel(AntennaModel):
+    """parabolic-antenna-model.cc: -min(12(φ/φ3dB)², A_max) dB — the
+    3GPP sectorized macro pattern."""
+
+    tid = (
+        TypeId("tpudes::ParabolicAntennaModel")
+        .SetParent(AntennaModel.tid)
+        .AddConstructor(lambda **kw: ParabolicAntennaModel(**kw))
+        .AddAttribute("Orientation", "boresight azimuth (deg)", 0.0,
+                      field="orientation_deg")
+        .AddAttribute("Beamwidth", "-3dB width (deg)", 70.0,
+                      field="beamwidth_deg")
+        .AddAttribute("MaxAttenuation", "backlobe floor (dB)", 20.0,
+                      field="max_attenuation_db")
+    )
+
+    def batch_gain_db(self, az, incl):
+        delta = _wrap_deg(np.degrees(np.asarray(az)) - self.orientation_deg)
+        att = 12.0 * (delta / self.beamwidth_deg) ** 2
+        return -np.minimum(att, float(self.max_attenuation_db))
+
+
+class ThreeGppAntennaModel(AntennaModel):
+    """three-gpp-antenna-model.cc (TR 38.901 single element): combined
+    horizontal + vertical parabolic cuts, 8 dBi element gain."""
+
+    tid = (
+        TypeId("tpudes::ThreeGppAntennaModel")
+        .SetParent(AntennaModel.tid)
+        .AddConstructor(lambda **kw: ThreeGppAntennaModel(**kw))
+        .AddAttribute("Orientation", "boresight azimuth (deg)", 0.0,
+                      field="orientation_deg")
+    )
+
+    ELEMENT_GAIN_DB = 8.0
+    A_MAX = 30.0
+    SLA_V = 30.0
+    BW_H = 65.0
+    BW_V = 65.0
+
+    def batch_gain_db(self, az, incl):
+        d_az = _wrap_deg(np.degrees(np.asarray(az)) - self.orientation_deg)
+        theta = np.degrees(np.asarray(incl))
+        a_h = -np.minimum(12.0 * (d_az / self.BW_H) ** 2, self.A_MAX)
+        a_v = -np.minimum(12.0 * ((theta - 90.0) / self.BW_V) ** 2, self.SLA_V)
+        return self.ELEMENT_GAIN_DB - np.minimum(-(a_h + a_v), self.A_MAX)

@@ -1,0 +1,183 @@
+"""Buildings: boxes in the scene + wall-aware propagation loss.
+
+Reference parity: src/buildings/model/{building,building-list,
+mobility-building-info,buildings-propagation-loss-model,
+hybrid-buildings-propagation-loss-model}.{h,cc} (upstream paths; mount
+empty at survey — SURVEY.md §0, §2.4 buildings row).
+
+A Building is an axis-aligned box with a type (residential/office/
+commercial) and external-wall material setting the per-wall penetration
+loss.  :class:`BuildingsPropagationLossModel` chains on any outdoor
+model and adds the penetration loss of every external wall the straight
+tx→rx segment crosses (indoor endpoints add their own wall) — the
+essential effect of upstream's hybrid model without its COST231/Okumura
+zoo (chain those separately if needed).
+
+TPU-first: the wall-crossing count is a vectorized slab test —
+``batch_wall_crossings`` answers every (tx, rx) pair against every
+building in one numpy pass, which is what the LTE controller and the
+REM helper call.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudes.core.object import Object, TypeId
+
+
+class BuildingList:
+    _buildings: list = []
+
+    @classmethod
+    def Add(cls, b) -> int:
+        cls._buildings.append(b)
+        return len(cls._buildings) - 1
+
+    @classmethod
+    def GetNBuildings(cls) -> int:
+        return len(cls._buildings)
+
+    @classmethod
+    def GetBuilding(cls, i: int):
+        return cls._buildings[i]
+
+    @classmethod
+    def All(cls) -> list:
+        return list(cls._buildings)
+
+    @classmethod
+    def Reset(cls) -> None:
+        cls._buildings = []
+
+
+class Building(Object):
+    RESIDENTIAL, OFFICE, COMMERCIAL = 0, 1, 2
+    WOOD, CONCRETE_WITH_WINDOWS, CONCRETE_WITHOUT_WINDOWS, STONE_BLOCKS = (
+        0, 1, 2, 3,
+    )
+    #: per-wall penetration loss (dB) by external-wall type (upstream
+    #: buildings-propagation-loss-model.cc ExternalWallLoss)
+    WALL_LOSS_DB = {0: 4.0, 1: 7.0, 2: 15.0, 3: 12.0}
+
+    tid = (
+        TypeId("tpudes::Building")
+        .AddConstructor(lambda **kw: Building(**kw))
+        .AddAttribute("Type", "residential/office/commercial", 0,
+                      field="building_type")
+        .AddAttribute("ExternalWallsType", "wall material", 1,
+                      field="walls_type")
+        .AddAttribute("NFloors", "floors", 1, field="n_floors")
+    )
+
+    def __init__(self, x_min=0.0, x_max=10.0, y_min=0.0, y_max=10.0,
+                 z_min=0.0, z_max=10.0, **attributes):
+        super().__init__(**attributes)
+        self.bounds = (
+            float(x_min), float(x_max), float(y_min), float(y_max),
+            float(z_min), float(z_max),
+        )
+        self.bid = BuildingList.Add(self)
+
+    def SetBoundaries(self, box) -> None:
+        self.bounds = tuple(float(v) for v in box)
+
+    def IsInside(self, pos) -> bool:
+        x0, x1, y0, y1, z0, z1 = self.bounds
+        return (
+            x0 <= pos.x <= x1 and y0 <= pos.y <= y1 and z0 <= pos.z <= z1
+        )
+
+    def wall_loss_db(self) -> float:
+        return self.WALL_LOSS_DB[self.walls_type]
+
+
+def batch_wall_crossings(p_tx: np.ndarray, p_rx: np.ndarray) -> np.ndarray:
+    """(T, R) penetration loss (dB): for every tx/rx pair, the summed
+    wall losses of every building whose box the straight segment
+    crosses (2 walls when passing through, 1 when an endpoint is
+    inside).  Vectorized slab intersection over all buildings."""
+    T, R = len(p_tx), len(p_rx)
+    loss = np.zeros((T, R))
+    if not BuildingList.GetNBuildings():
+        return loss
+    a = p_tx[:, None, :]                 # (T, 1, 3)
+    d = p_rx[None, :, :] - a             # (T, R, 3)
+    for b in BuildingList.All():
+        x0, x1, y0, y1, z0, z1 = b.bounds
+        lo = np.array([x0, y0, z0])
+        hi = np.array([x1, y1, z1])
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t1 = (lo - a) / d
+            t2 = (hi - a) / d
+        tmin_ax = np.minimum(t1, t2)
+        tmax_ax = np.maximum(t1, t2)
+        # parallel axes AFTER the min/max: inside -> (-inf, inf) (no
+        # constraint), outside -> (+inf, -inf) (empty interval)
+        parallel = d == 0
+        inside_axis = (a >= lo) & (a <= hi)
+        tmin_ax = np.where(
+            parallel, np.where(inside_axis, -np.inf, np.inf), tmin_ax
+        )
+        tmax_ax = np.where(
+            parallel, np.where(inside_axis, np.inf, -np.inf), tmax_ax
+        )
+        tmin = tmin_ax.max(axis=2)
+        tmax = tmax_ax.min(axis=2)
+        hit = (tmax >= tmin) & (tmax >= 0.0) & (tmin <= 1.0)
+        # walls crossed: entry (tmin in (0,1)) + exit (tmax in (0,1))
+        walls = (
+            ((tmin > 0.0) & (tmin < 1.0)).astype(int)
+            + ((tmax > 0.0) & (tmax < 1.0)).astype(int)
+        )
+        loss += np.where(hit, walls, 0) * b.wall_loss_db()
+    return loss
+
+
+class BuildingsPropagationLossModel(Object):
+    """Chainable wall-penetration loss on top of any outdoor model
+    (the HybridBuildings essence)."""
+
+    tid = (
+        TypeId("tpudes::BuildingsPropagationLossModel")
+        .AddConstructor(lambda **kw: BuildingsPropagationLossModel(**kw))
+    )
+
+    def __init__(self, outdoor_model=None, **attributes):
+        super().__init__(**attributes)
+        self.outdoor = outdoor_model
+
+    def batch_rx_power(self, tx_power_dbm, distance, p_tx=None, p_rx=None):
+        """Outdoor model's rx power minus wall penetration when the
+        endpoint geometry is given (positions as (N,3) arrays)."""
+        base = (
+            self.outdoor.batch_rx_power(tx_power_dbm, distance)
+            if self.outdoor is not None
+            else tx_power_dbm
+        )
+        if p_tx is None or p_rx is None:
+            return base
+        return base - batch_wall_crossings(
+            np.asarray(p_tx, float), np.asarray(p_rx, float)
+        )
+
+    def CalcRxPower(self, tx_power_dbm, mob_a, mob_b) -> float:
+        import math
+
+        pa, pb = mob_a.GetPosition(), mob_b.GetPosition()
+        d = math.dist((pa.x, pa.y, pa.z), (pb.x, pb.y, pb.z))
+        p_tx = np.array([[pa.x, pa.y, pa.z]])
+        p_rx = np.array([[pb.x, pb.y, pb.z]])
+        return float(
+            np.asarray(
+                self.batch_rx_power(tx_power_dbm, np.array([[d]]), p_tx, p_rx)
+            )[0, 0]
+        )
+
+
+class BuildingsHelper:
+    @staticmethod
+    def Install(_nodes) -> None:
+        """Upstream attaches MobilityBuildingInfo per node; position
+        classification here is computed on demand from BuildingList, so
+        Install is a compatibility no-op."""

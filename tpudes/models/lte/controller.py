@@ -143,6 +143,11 @@ class LteTtiController:
         loss_db = -np.asarray(
             self.pathloss.batch_rx_power(jnp.zeros(()), jnp.asarray(d))
         )
+        # buildings (wall penetration) + antennas (directional gain),
+        # one shared implementation with the REM helper
+        from tpudes.models.lte.scene import scene_loss_db
+
+        loss_db = loss_db + scene_loss_db(self.enbs, pos_e, pos_u)
         self._gain_dl = 10.0 ** (-loss_db / 10.0)               # (E, U)
         serving = np.full((u,), -1, dtype=np.int64)
         enb_index = {id(dev): i for i, dev in enumerate(self.enbs)}

@@ -193,6 +193,11 @@ class RadioEnvironmentMapHelper:
         loss_db = -np.asarray(
             self.helper.pathloss.batch_rx_power(jnp.zeros(()), jnp.asarray(d))
         )
+        # the same scene effects the TTI controller applies (shared
+        # implementation — tpudes/models/lte/scene.py)
+        from tpudes.models.lte.scene import scene_loss_db
+
+        loss_db = loss_db + scene_loss_db(enbs, pos_e, grid)
         gain = 10.0 ** (-loss_db / 10.0)                     # (E, G)
         psd = np.zeros((len(enbs), ctrl.n_rb))
         for i, enb in enumerate(enbs):

@@ -81,6 +81,9 @@ class LteEnbPhy(Object):
         self.n_rb = n_rb
         self.carrier_hz = carrier_hz
         self.spectrum_phy = LteSpectrumPhy(n_rb, carrier_hz)
+        #: optional AntennaModel (tpudes.models.antenna); when set, the
+        #: controller adds its directional gain into the link budget
+        self.antenna = None
 
     @property
     def noise_psd(self) -> float:

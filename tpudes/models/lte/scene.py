@@ -1,0 +1,39 @@
+"""Shared scene effects: buildings + antenna gain over batched geometry.
+
+One implementation consumed by both the TTI controller's link budget
+and the REM grid (r4 review: two hand-synced copies had already
+diverged on the inclination sign).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def batch_angles(pos_tx: np.ndarray, pos_rx: np.ndarray):
+    """(az, incl) of every rx seen from every tx — the batch companion
+    of Angles.FromPositions (same convention: inclination measured from
+    +z, so a below-horizon receiver is > π/2)."""
+    dx = pos_rx[None, :, 0] - pos_tx[:, None, 0]
+    dy = pos_rx[None, :, 1] - pos_tx[:, None, 1]
+    dz = pos_rx[None, :, 2] - pos_tx[:, None, 2]
+    az = np.arctan2(dy, dx)
+    incl = np.arctan2(np.hypot(dx, dy), dz)
+    return az, incl
+
+
+def scene_loss_db(enbs, pos_e: np.ndarray, pos_rx: np.ndarray) -> np.ndarray:
+    """(E, R) additional loss: building wall penetration on each
+    straight segment plus each eNB's (negative) antenna gain."""
+    loss = np.zeros((len(pos_e), len(pos_rx)))
+    bmod = sys.modules.get("tpudes.models.buildings")
+    if bmod is not None and bmod.BuildingList.GetNBuildings():
+        loss = loss + bmod.batch_wall_crossings(pos_e, pos_rx)
+    if any(e.phy.antenna is not None for e in enbs):
+        az, incl = batch_angles(pos_e, pos_rx)
+        for i, e in enumerate(enbs):
+            if e.phy.antenna is not None:
+                loss[i] -= e.phy.antenna.batch_gain_db(az[i], incl[i])
+    return loss
