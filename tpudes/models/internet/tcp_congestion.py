@@ -519,6 +519,138 @@ class TcpHybla(TcpNewReno):
             self._frac -= whole * seg
 
 
+class TcpBbr(TcpCongestionOps):
+    """BBR v1 (tcp-bbr.cc), cwnd-model form: windowed-max bandwidth ×
+    windowed-min RTT sets the BDP; the state machine (STARTUP → DRAIN →
+    PROBE_BW cycling, with PROBE_RTT dips) scales cwnd around it.
+
+    Documented deviation: upstream paces packets (pacing_rate = gain ×
+    BWE); this build's socket has no pacer, so BBR acts purely through
+    cwnd — same steady-state operating point, burstier within an RTT.
+    Loss does NOT halve the window (BBR ignores it beyond cwnd floors).
+    """
+
+    tid = (
+        TypeId("tpudes::TcpBbr")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpBbr(**kw))
+    )
+
+    STARTUP, DRAIN, PROBE_BW, PROBE_RTT = range(4)
+    HIGH_GAIN = 2.89           # 2/ln 2
+    CYCLE_GAINS = (1.25, 0.75, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0)
+    MIN_RTT_WINDOW_S = 10.0
+    PROBE_RTT_DURATION_S = 0.2
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._state = self.STARTUP
+        self._bw = 0.0                 # bytes/s, windowed max
+        self._bw_samples: list = []    # (round, sample)
+        self._min_rtt = math.inf
+        self._min_rtt_stamp = 0.0
+        self._round = 0
+        self._acked_this_round = 0
+        self._full_bw = 0.0
+        self._full_bw_count = 0
+        self._cycle_index = 0
+        self._clock = 0.0              # advanced by rtt per round
+        self._probe_rtt_done = 0.0
+        self._state_before_probe = self.PROBE_BW
+
+    def _bdp(self, tcb) -> float:
+        if self._bw <= 0 or self._min_rtt == math.inf:
+            return 4.0 * tcb.segment_size
+        return self._bw * self._min_rtt
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if not rtt_s or rtt_s <= 0:
+            return
+        self._clock += rtt_s * segments_acked / max(
+            tcb.cwnd / tcb.segment_size, 1.0
+        )
+        if rtt_s <= self._min_rtt:
+            self._min_rtt = rtt_s
+            self._min_rtt_stamp = self._clock
+        elif (
+            self._state != self.PROBE_RTT
+            and self._clock - self._min_rtt_stamp > self.MIN_RTT_WINDOW_S
+        ):
+            # stale min: dip into PROBE_RTT and REMEASURE with the queue
+            # drained (never adopt a queue-inflated sample wholesale —
+            # that ratchet was the r4 review's divergence scenario)
+            self._state_before_probe = (
+                self.PROBE_BW
+                if self._state == self.PROBE_BW
+                else self.STARTUP
+            )
+            self._state = self.PROBE_RTT
+            self._probe_rtt_done = self._clock + self.PROBE_RTT_DURATION_S
+        if self._state == self.PROBE_RTT and self._clock >= self._probe_rtt_done:
+            # the small window drained the queue: this sample IS the path
+            self._min_rtt = rtt_s
+            self._min_rtt_stamp = self._clock
+            self._state = self._state_before_probe
+        self._acked_this_round += segments_acked * tcb.segment_size
+        if self._acked_this_round >= tcb.cwnd:   # ~one round elapsed
+            sample = self._acked_this_round / max(rtt_s, 1e-6)
+            self._acked_this_round = 0
+            self._round += 1
+            self._bw_samples = [
+                (r, s) for r, s in self._bw_samples
+                if self._round - r < 10
+            ] + [(self._round, sample)]
+            self._bw = max(s for _r, s in self._bw_samples)
+            self._advance_state(sample)
+
+    def _advance_state(self, sample: float) -> None:
+        if self._state == self.STARTUP:
+            # bandwidth plateau: < 25% growth for 3 rounds → full pipe
+            if sample > self._full_bw * 1.25:
+                self._full_bw = sample
+                self._full_bw_count = 0
+            else:
+                self._full_bw_count += 1
+                if self._full_bw_count >= 3:
+                    self._state = self.DRAIN
+        elif self._state == self.DRAIN:
+            self._state = self.PROBE_BW
+            self._cycle_index = self._round % len(self.CYCLE_GAINS)
+        elif self._state == self.PROBE_BW:
+            self._cycle_index = (self._cycle_index + 1) % len(
+                self.CYCLE_GAINS
+            )
+
+    def _gain(self) -> float:
+        if self._state == self.STARTUP:
+            return self.HIGH_GAIN
+        if self._state == self.DRAIN:
+            return 1.0 / self.HIGH_GAIN
+        if self._state == self.PROBE_RTT:
+            return 0.5
+        return self.CYCLE_GAINS[self._cycle_index]
+
+    def IncreaseWindow(self, tcb, segments_acked) -> None:
+        if self._state == self.PROBE_RTT:
+            # upstream: cwnd pinned to 4 segments while remeasuring
+            tcb.cwnd = 4 * tcb.segment_size
+            return
+        target = max(self._gain() * self._bdp(tcb), 4.0 * tcb.segment_size)
+        if self._state == self.STARTUP and self._bw == 0.0:
+            tcb.cwnd += segments_acked * tcb.segment_size  # first RTTs
+        elif tcb.cwnd < target:
+            tcb.cwnd += min(
+                segments_acked * tcb.segment_size,
+                int(target - tcb.cwnd) + tcb.segment_size,
+            )
+        else:
+            tcb.cwnd = max(int(target), 4 * tcb.segment_size)
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        # BBR does not back off on loss; keep the model's floor
+        return max(int(self._bdp(tcb)), 4 * tcb.segment_size)
+
+
 TCP_VARIANTS = {
     "TcpNewReno": TcpNewReno,
     "TcpCubic": TcpCubic,
@@ -531,4 +663,5 @@ TCP_VARIANTS = {
     "TcpWestwood": TcpWestwood,
     "TcpIllinois": TcpIllinois,
     "TcpHybla": TcpHybla,
+    "TcpBbr": TcpBbr,
 }

@@ -37,6 +37,8 @@ CW_MIN = 15
 CW_MAX = 1023
 RETRY_LIMIT = 7
 ACK_SIZE = 14          # bytes incl. FCS
+RTS_SIZE = 20          # bytes incl. FCS
+CTS_SIZE = 14          # bytes incl. FCS
 MAC_HEADER_SIZE = 24   # data/mgmt header
 FCS_SIZE = 4
 BEACON_INTERVAL_US = 102400
@@ -124,6 +126,7 @@ class ChannelAccessManager:
         self._pending = False
         self._immediate = False  # zero-backoff grant in flight
         self._slot_event = None
+        self._nav_until = 0      # virtual carrier sense (802.11 NAV)
         phy.RegisterListener(self)
 
     # --- Txop API ---
@@ -177,12 +180,13 @@ class ChannelAccessManager:
             self._slot_event = None
 
     def _try_schedule(self):
-        """(Re)start the DIFS + slot countdown from now/busy-end."""
+        """(Re)start the DIFS + slot countdown from now/busy-end — the
+        later of physical (PHY) and virtual (NAV) carrier sense."""
         self._cancel_slot()
         if not self._pending:
             return
         now = Simulator.NowTicks()
-        idle_start = max(self._phy.busy_until(), now)
+        idle_start = max(self._phy.busy_until(), self._nav_until, now)
         wait = (idle_start - now) + MicroSeconds(DIFS_US).ticks
         self._slot_event = Simulator.GetImpl().Schedule(wait, self._tick, ())
 
@@ -190,8 +194,11 @@ class ChannelAccessManager:
         self._slot_event = None
         if not self._pending:
             return
-        if not self._phy.IsStateIdle():
-            self._try_schedule()  # went busy again: refreeze
+        if (
+            not self._phy.IsStateIdle()
+            or Simulator.NowTicks() < self._nav_until
+        ):
+            self._try_schedule()  # went busy again / NAV holds: refreeze
             return
         if self._slots_left > 0:
             self._slots_left -= 1
@@ -230,6 +237,14 @@ class ChannelAccessManager:
         self._on_medium_busy()
         self._try_schedule()  # reschedules from new busy end
 
+    def NotifyNav(self, end_ts):
+        """Virtual carrier sense: defer until ``end_ts`` regardless of
+        PHY state (an overheard duration field reserved the medium)."""
+        if end_ts > self._nav_until:
+            self._nav_until = end_ts
+            self._on_medium_busy()
+            self._try_schedule()
+
 
 class WifiMac(Object):
     """Base MAC with DCF + data/ack frame exchange (frame-exchange-
@@ -238,10 +253,18 @@ class WifiMac(Object):
 
     tid = (
         TypeId("tpudes::WifiMac")
+        .AddAttribute(
+            "RtsCtsThreshold",
+            "PSDU bytes above which the exchange is RTS/CTS-protected "
+            "(wifi-remote-station-manager.cc attribute; default off)",
+            65535, field="rts_cts_threshold",
+        )
         .AddTraceSource("MacTx", "frame handed to DCF (packet)")
         .AddTraceSource("MacRx", "frame delivered up (packet)")
         .AddTraceSource("MacTxDrop", "tx dropped after retries (packet)")
         .AddTraceSource("MacRxDrop", "rx dropped (packet)")
+        .AddTraceSource("RtsSent", "(to) RTS transmitted")
+        .AddTraceSource("CtsSent", "(to) CTS answered")
     )
 
     def __init__(self, **attributes):
@@ -254,6 +277,7 @@ class WifiMac(Object):
         self._current: tuple[Packet, WifiMacHeader] | None = None
         self._access: ChannelAccessManager | None = None
         self._ack_timeout_event = None
+        self._cts_timeout_event = None
         self._seq = 0
         self._retries = 0
         self._dup_cache: dict = {}  # ta -> last seq
@@ -306,7 +330,85 @@ class WifiMac(Object):
         if self._current is None:
             return
         packet, header = self._current
+        # TS: RTS/CTS protection for large unicast data (the
+        # frame-exchange-manager NeedRts path)
+        if (
+            header.IsData()
+            and not header.addr1.IsBroadcast()
+            and not header.addr1.IsGroup()
+            and packet.GetSize() + header.GetSerializedSize() + FCS_SIZE
+            > int(self.rts_cts_threshold)
+        ):
+            self._send_rts(header)
+            return
         self._send_current(packet, header)
+
+    @staticmethod
+    def _response_timeout_s(tx_dur_s: float, resp_size: int, resp_mode) -> float:
+        """One shared budget for 'I transmitted, where is the control
+        response': tx + SIFS + response airtime + slot + propagation
+        allowance (covers both ACK and CTS waits)."""
+        return (
+            tx_dur_s
+            + SIFS_US * 1e-6
+            + ppdu_duration_s(resp_size, resp_mode)
+            + SLOT_US * 1e-6
+            + 4e-6
+        )
+
+    def _exchange_tail_us(self, data_mode) -> float:
+        """CTS-to-end airtime: data + SIFS + ack (for NAV durations)."""
+        packet, header = self._current
+        size = packet.GetSize() + header.GetSerializedSize() + FCS_SIZE
+        ack_mode = control_answer_mode(data_mode)
+        return (
+            ppdu_duration_s(size, data_mode)
+            + SIFS_US * 1e-6
+            + ppdu_duration_s(ACK_SIZE, ack_mode)
+        ) * 1e6
+
+    def _send_rts(self, data_header):
+        mode = (
+            self._station_manager.get_data_mode(data_header.addr1)
+            if self._station_manager
+            else MODES_BY_NAME["OfdmRate6Mbps"]
+        )
+        ctrl_mode = control_answer_mode(mode)
+        cts_dur_s = ppdu_duration_s(CTS_SIZE, ctrl_mode)
+        # NAV the rest of the exchange: SIFS+CTS+SIFS+DATA+SIFS+ACK
+        nav_us = (
+            3 * SIFS_US + cts_dur_s * 1e6 + self._exchange_tail_us(mode)
+        )
+        rts = Packet(0)
+        rts.AddHeader(
+            WifiMacHeader(
+                WifiMacType.RTS, addr1=data_header.addr1,
+                addr2=self._address, duration_us=int(nav_us),
+            )
+        )
+        rts_dur_s = ppdu_duration_s(RTS_SIZE, ctrl_mode)
+        timeout_s = self._response_timeout_s(rts_dur_s, CTS_SIZE, ctrl_mode)
+        self._cts_timeout_event = Simulator.GetImpl().Schedule(
+            Seconds(timeout_s).ticks, self._on_cts_timeout, ()
+        )
+        self.rts_sent(data_header.addr1)
+        self._phy.Send(rts, ctrl_mode, size_bytes=RTS_SIZE)
+
+    def _on_cts_timeout(self):
+        # same budget as a data failure (upstream counts SSRC; the shared
+        # retry counter is this build's simplification)
+        self._cts_timeout_event = None
+        self._on_ack_timeout()
+
+    def _on_cts(self, from_addr):
+        if self._current is None or self._cts_timeout_event is None:
+            return
+        self._cts_timeout_event.cancel()
+        self._cts_timeout_event = None
+        packet, header = self._current
+        Simulator.GetImpl().Schedule(
+            MicroSeconds(SIFS_US).ticks, self._send_current, (packet, header)
+        )
 
     def _send_current(self, packet, header):
         if (
@@ -334,8 +436,7 @@ class WifiMac(Object):
             )
         else:
             ack_mode = control_answer_mode(mode)
-            ack_dur_s = ppdu_duration_s(ACK_SIZE, ack_mode)
-            timeout_s = tx_dur_s + SIFS_US * 1e-6 + ack_dur_s + SLOT_US * 1e-6 + 4e-6
+            timeout_s = self._response_timeout_s(tx_dur_s, ACK_SIZE, ack_mode)
             self._ack_timeout_event = Simulator.GetImpl().Schedule(
                 Seconds(timeout_s).ticks, self._on_ack_timeout, ()
             )
@@ -388,7 +489,22 @@ class WifiMac(Object):
             if header.addr1 == self._address:
                 self._on_ack(header.addr1)
             return
+        if header.frame_type == WifiMacType.RTS:
+            if header.addr1 == self._address:
+                self._send_cts(header.addr2, mode, header.duration_us)
+            else:
+                self._set_nav(header.duration_us)
+            return
+        if header.frame_type == WifiMacType.CTS:
+            if header.addr1 == self._address:
+                self._on_cts(header.addr1)
+            else:
+                self._set_nav(header.duration_us)
+            return
         if header.addr1 != self._address and not header.addr1.IsBroadcast():
+            # virtual carrier sense: an overheard frame's duration field
+            # reserves the medium (the NAV, 802.11 9.2.5)
+            self._set_nav(header.duration_us)
             return  # not for us
         if not header.addr1.IsBroadcast():
             # unicast data AND management frames are acked (SIFS, bypasses
@@ -403,6 +519,33 @@ class WifiMac(Object):
 
     def _rx_error(self, packet, snr):
         pass  # PHY already traced the drop
+
+    def _set_nav(self, duration_us: int) -> None:
+        if duration_us > 0 and self._access is not None:
+            self._access.NotifyNav(
+                Simulator.NowTicks() + int(duration_us) * 1000
+            )
+
+    def _send_cts(self, to, rts_mode, rts_duration_us: int):
+        cts_mode = control_answer_mode(rts_mode)
+        cts = Packet(0)
+        remaining = max(
+            int(rts_duration_us)
+            - SIFS_US
+            - int(ppdu_duration_s(CTS_SIZE, cts_mode) * 1e6),
+            0,
+        )
+        cts.AddHeader(
+            WifiMacHeader(
+                WifiMacType.CTS, addr1=to, addr2=self._address,
+                duration_us=remaining,
+            )
+        )
+        self.cts_sent(to)
+        Simulator.GetImpl().Schedule(
+            MicroSeconds(SIFS_US).ticks,
+            self._phy.Send, (cts, cts_mode, 0, CTS_SIZE),
+        )
 
     def _send_ack(self, to, data_mode):
         ack_mode = control_answer_mode(data_mode)

@@ -198,6 +198,12 @@ def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProg
 
     # on-air data PSDU: payload + UDP(8) + IPv4(20) + LLC/SNAP(8) + MAC(24) + FCS(4)
     data_bytes = payload + 8 + 20 + 8 + MAC_HEADER_SIZE + FCS_SIZE
+    # the MAC protects strictly-larger frames (size > threshold)
+    if int(getattr(mac, "rts_cts_threshold", 65535)) < data_bytes:
+        raise UnliftableScenarioError(
+            "RTS/CTS protection engages at this frame size; the replica "
+            "axis models the basic DATA/ACK exchange only"
+        )
     beacon_bytes = 50 + MAC_HEADER_SIZE + FCS_SIZE
     ack_mode = control_answer_mode(data_mode)
 
