@@ -53,6 +53,7 @@ def main(argv=None):
     cmd.AddValue("simTime", "simulated seconds", 0.5)
     cmd.AddValue("scheduler", "pf | rr", "pf")
     cmd.AddValue("interSite", "inter-site distance (m)", 500.0)
+    cmd.AddValue("ffr", "hard frequency reuse-3 (lena-dual-stripe idiom)", False)
     cmd.Parse(argv)
     n_enbs = int(cmd.nEnbs)
     ues_per_cell = int(cmd.uesPerCell)
@@ -62,6 +63,8 @@ def main(argv=None):
     lte.SetSchedulerType(
         "tpudes::PfFfMacScheduler" if cmd.scheduler == "pf" else "tpudes::RrFfMacScheduler"
     )
+    if cmd.GetValue("ffr"):
+        lte.SetFfrAlgorithmType("tpudes::LteFrHardAlgorithm")
 
     enb_nodes = NodeContainer()
     enb_nodes.Create(n_enbs)

@@ -73,6 +73,8 @@ class LteTtiController:
         self._key = None
         self._jit_step = None
         self.handover_algorithm = None   # set via LteHelper
+        self.ffr_algorithm = None        # set via LteHelper (RBG masks)
+        self.last_alloc: dict = {}       # per-direction (U, n_rb) masks
         self.x2_enabled = False          # AddX2Interface arms execution
         self.handover_log: list = []     # (tti, imsi, from_cell, to_cell)
         self.stats = {
@@ -181,15 +183,29 @@ class LteTtiController:
         if self._cqi_dl is None or len(self._cqi_dl) != u:
             self._cqi_dl = np.zeros((u,), dtype=np.int64)
             self._cqi_ul = np.zeros((u,), dtype=np.int64)
-        # full-power reference PSDs (RS-like) for CQI measurement
+        # full-power reference PSDs (RS-like) for CQI measurement; under
+        # FFR each cell's reference occupies only its allowed subband,
+        # so CQI (and hence MCS) sees the reuse pattern's interference
+        def _cell_rbs(e_idx: int) -> list[int]:
+            if self.ffr_algorithm is None:
+                return list(range(self.n_rb))
+            return self._rbgs_to_rbs(
+                self.ffr_algorithm.allowed_rbgs(e_idx, self.n_rbg)
+            )
+
         self._ref_psd_dl = np.zeros((e, self.n_rb))
         for i, enb in enumerate(self.enbs):
             p_w = 10.0 ** ((enb.phy.tx_power_dbm - 30.0) / 10.0)
-            self._ref_psd_dl[i, :] = p_w / (self.n_rb * RB_BANDWIDTH_HZ)
+            self._ref_psd_dl[i, _cell_rbs(i)] = p_w / (
+                self.n_rb * RB_BANDWIDTH_HZ
+            )
         self._ref_psd_ul = np.zeros((u, self.n_rb))
         for i, ue in enumerate(self.ues):
             p_w = 10.0 ** ((ue.phy.tx_power_dbm - 30.0) / 10.0)
-            self._ref_psd_ul[i, :] = p_w / (self.n_rb * RB_BANDWIDTH_HZ)
+            rbs = _cell_rbs(int(serving[i])) if serving[i] >= 0 else list(
+                range(self.n_rb)
+            )
+            self._ref_psd_ul[i, rbs] = p_w / (self.n_rb * RB_BANDWIDTH_HZ)
         nf_ue = {float(ue.phy.noise_figure_db) for ue in self.ues}
         nf_enb = {float(enb.phy.noise_figure_db) for enb in self.enbs}
         if len(nf_ue) > 1 or len(nf_enb) > 1:
@@ -218,6 +234,17 @@ class LteTtiController:
 
             self._jit_step = jax.jit(both)
 
+    def _rbgs_to_rbs(self, rbgs) -> list[int]:
+        """TS 36.213 type-0: expand RBG indices to RB indices (one
+        implementation for allocation AND the CQI reference grid)."""
+        return [
+            r
+            for g in rbgs
+            for r in range(
+                g * self.rbg_size, min((g + 1) * self.rbg_size, self.n_rb)
+            )
+        ]
+
     # --- per-TTI scheduling (host side) -----------------------------------
     def _cell_ue_indices(self, e_idx: int) -> list[int]:
         return [i for i in range(len(self.ues)) if self._serving[i] == e_idx]
@@ -242,7 +269,12 @@ class LteTtiController:
             members = self._cell_ue_indices(e_idx)
             if not members:
                 continue
-            free = list(range(self.n_rbg))
+            if self.ffr_algorithm is not None:
+                free = list(
+                    self.ffr_algorithm.allowed_rbgs(e_idx, self.n_rbg)
+                )
+            else:
+                free = list(range(self.n_rbg))
             allocs: list[Allocation] = []
             # 1. HARQ retransmissions due this TTI
             pending = harq_map[e_idx]
@@ -319,14 +351,7 @@ class LteTtiController:
                     tb.tx_count += 1
                 tb.rnti_ue_index = ue_i
                 tb_by_ue[ue_i] = tb
-                rbs = [
-                    r
-                    for g in a.rbgs
-                    for r in range(
-                        g * self.rbg_size,
-                        min((g + 1) * self.rbg_size, self.n_rb),
-                    )
-                ]
+                rbs = self._rbgs_to_rbs(a.rbgs)
                 alloc[ue_i, rbs] = True
                 mcs[ue_i] = a.mcs
                 tb_bits[ue_i] = a.tb_bytes * 8.0
@@ -419,6 +444,9 @@ class LteTtiController:
             # host side: both directions' scheduling first, then ONE
             # fused device call and ONE device_get
             sched = {d: self._schedule_direction(d) for d in ("dl", "ul")}
+            #: (U, n_rb) bool allocation masks of the last TTI, per
+            #: direction — stats/test visibility (RB-usage traces)
+            self.last_alloc = {d: sched[d][0] for d in ("dl", "ul")}
 
             def pack(direction):
                 alloc, mcs, tb_bits, mi_acc, tx_psd, _ = sched[direction]

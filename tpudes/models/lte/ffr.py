@@ -1,0 +1,73 @@
+"""Frequency-reuse (FFR) algorithms: per-cell RBG restriction.
+
+Reference parity: src/lte/model/lte-ffr-algorithm.{h,cc},
+lte-fr-no-op-algorithm.{h,cc}, lte-fr-hard-algorithm.{h,cc}
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.6
+"Handover & FFR algorithms" row).
+
+The seam matches upstream's: the FFR algorithm answers "which RBGs may
+this cell schedule" and the FF-MAC scheduler allocates inside that
+mask.  Hard reuse-3 trades peak rate (1/3 of the band per cell) for
+edge SINR (no first-tier co-channel interference); the no-op passes
+the full band through.  Soft/enhanced variants (per-UE edge/center
+power masks) keep their upstream names reserved but are not modeled.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.object import Object, TypeId
+
+
+class LteFfrAlgorithm(Object):
+    tid = TypeId("tpudes::LteFfrAlgorithm")
+
+    def allowed_rbgs(self, cell_index: int, n_rbg: int) -> list[int]:
+        raise NotImplementedError
+
+
+class LteFrNoOpAlgorithm(LteFfrAlgorithm):
+    """lte-fr-no-op-algorithm.cc: the full band, every cell."""
+
+    tid = (
+        TypeId("tpudes::LteFrNoOpAlgorithm")
+        .SetParent(LteFfrAlgorithm.tid)
+        .AddConstructor(lambda **kw: LteFrNoOpAlgorithm(**kw))
+    )
+
+    def allowed_rbgs(self, cell_index: int, n_rbg: int) -> list[int]:
+        return list(range(n_rbg))
+
+
+class LteFrHardAlgorithm(LteFfrAlgorithm):
+    """lte-fr-hard-algorithm.cc: disjoint 1/N subbands by cell index."""
+
+    tid = (
+        TypeId("tpudes::LteFrHardAlgorithm")
+        .SetParent(LteFfrAlgorithm.tid)
+        .AddConstructor(lambda **kw: LteFrHardAlgorithm(**kw))
+        .AddAttribute("ReuseFactor", "number of disjoint subbands", 3,
+                      field="reuse_factor")
+    )
+
+    def allowed_rbgs(self, cell_index: int, n_rbg: int) -> list[int]:
+        k = int(self.reuse_factor)
+        if k < 1:
+            raise ValueError(f"ReuseFactor must be >= 1 (got {k})")
+        band = cell_index % k
+        lo = (n_rbg * band) // k
+        hi = (n_rbg * (band + 1)) // k
+        if lo >= hi:
+            # a starved cell is a configuration error, not a quiet one
+            raise RuntimeError(
+                f"ReuseFactor={k} leaves cell index {cell_index} an empty "
+                f"subband ({n_rbg} RBGs available)"
+            )
+        return list(range(lo, hi))
+
+
+FFR_ALGORITHMS = {
+    "tpudes::LteFrNoOpAlgorithm": LteFrNoOpAlgorithm,
+    "tpudes::LteFrHardAlgorithm": LteFrHardAlgorithm,
+    "ns3::LteFrNoOpAlgorithm": LteFrNoOpAlgorithm,
+    "ns3::LteFrHardAlgorithm": LteFrHardAlgorithm,
+}

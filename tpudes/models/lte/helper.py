@@ -72,6 +72,20 @@ class LteHelper:
         algorithm never fires, as upstream without X2 links."""
         self.controller.x2_enabled = True
 
+    def SetFfrAlgorithmType(self, type_name: str) -> None:
+        from tpudes.models.lte.ffr import FFR_ALGORITHMS
+
+        if type_name not in FFR_ALGORITHMS:
+            raise ValueError(f"unknown FFR algorithm {type_name!r}")
+        self.controller.ffr_algorithm = FFR_ALGORITHMS[type_name]()
+        # the CQI reference PSDs are band-masked at rebuild time
+        self.controller._dirty = True
+
+    def SetFfrAlgorithmAttribute(self, name: str, value) -> None:
+        if self.controller.ffr_algorithm is None:
+            raise RuntimeError("SetFfrAlgorithmType first")
+        self.controller.ffr_algorithm.SetAttribute(name, value)
+
     # --- install ----------------------------------------------------------
     def InstallEnbDevice(self, nodes) -> NetDeviceContainer:
         devices = NetDeviceContainer()

@@ -261,5 +261,16 @@ def tti_phy_step(
     ref_sinr = tti_sinr(
         ref_psd_w, gain if ref_gain is None else ref_gain, serving, noise_psd
     )
-    cqi = cqi_from_sinr(jnp.mean(ref_sinr, axis=1))
+    # subband-aware wideband CQI: average only where the serving cell's
+    # reference actually transmits (under FFR each cell's RS occupies
+    # its subband; averaging silent RBs would report zero-signal CQI)
+    ref_on = jnp.take(ref_psd_w > 0.0, serving, axis=0)    # (U, RB)
+    n_on = jnp.sum(ref_on, axis=1)
+    mean_sinr = jnp.where(
+        n_on > 0,
+        jnp.sum(jnp.where(ref_on, ref_sinr, 0.0), axis=1)
+        / jnp.maximum(n_on, 1),
+        jnp.mean(ref_sinr, axis=1),
+    )
+    cqi = cqi_from_sinr(mean_sinr)
     return ok, bler, cqi, mi_new

@@ -82,6 +82,12 @@ def lower_lte_sm(helper, sim_time_s: float) -> LteSmProgram:
     ctrl = helper.controller
     if not ctrl.enbs or not ctrl.ues:
         raise UnliftableLteScenarioError("no eNBs or UEs installed")
+    if getattr(ctrl, "ffr_algorithm", None) is not None:
+        raise UnliftableLteScenarioError(
+            "an FFR algorithm restricts per-cell RBG masks; the device "
+            "SM engine models full-band reuse-1 only — run the scalar "
+            "engine for frequency-reuse studies"
+        )
     for enb in ctrl.enbs:
         for ctx in enb.rrc.ues.values():
             if not ctx.bearers:
