@@ -1,0 +1,175 @@
+"""MultiModelSpectrumChannel + SpectrumWifiPhy tests.
+
+Upstream analogs: spectrum-converter test (power conservation across
+model conversion), wifi-phy-interference tests, and the LTE/WiFi
+coexistence examples that motivate the multi-model channel.
+"""
+
+import math
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.models.spectrum import (
+    MultiModelSpectrumChannel,
+    SpectrumConverter,
+    SpectrumModel,
+    SpectrumSignalParameters,
+    SpectrumValue,
+    lte_spectrum_model,
+)
+from tpudes.models.wifi.spectrum_phy import (
+    SpectrumWifiPhy,
+    wifi_spectrum_model,
+)
+
+
+def test_converter_conserves_power_on_overlap():
+    a = SpectrumModel.FromCenters([100.0, 300.0], 200.0)   # [0,200),[200,400)
+    b = SpectrumModel.FromCenters([50.0, 150.0, 250.0, 350.0], 100.0)
+    v = SpectrumValue(a)
+    v.values[:] = (1.0, 3.0)
+    out = SpectrumConverter(a, b).Convert(v)
+    # finer model: each target band inherits its parent's PSD
+    assert list(out.values) == [1.0, 1.0, 3.0, 3.0]
+    assert out.TotalPowerW() == pytest.approx(v.TotalPowerW())
+
+    # and back: coarse bands average their children
+    back = SpectrumConverter(b, a).Convert(out)
+    assert list(back.values) == [1.0, 3.0]
+
+
+def test_converter_drops_power_outside_overlap():
+    a = SpectrumModel.FromCenters([100.0], 200.0)          # [0, 200)
+    b = SpectrumModel.FromCenters([250.0], 100.0)          # [200, 300)
+    v = SpectrumValue(a)
+    v.values[:] = 5.0
+    out = SpectrumConverter(a, b).Convert(v)
+    assert out.TotalPowerW() == 0.0
+
+
+def _spectrum_bss(n_stas=2):
+    """AP + STAs on SpectrumWifiPhy over a MultiModelSpectrumChannel."""
+    from tpudes.helper.applications import (
+        UdpEchoClientHelper,
+        UdpEchoServerHelper,
+    )
+    from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+    from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+    from tpudes.models.mobility import (
+        ListPositionAllocator,
+        MobilityHelper,
+        Vector,
+    )
+    from tpudes.models.propagation import LogDistancePropagationLossModel
+    from tpudes.models.wifi import WifiHelper, WifiMacHelper
+
+    nodes = NodeContainer()
+    nodes.Create(n_stas + 1)
+    alloc = ListPositionAllocator()
+    alloc.Add(Vector(0, 0, 0))
+    for i in range(n_stas):
+        alloc.Add(Vector(10.0 + 2 * i, 0, 0))
+    mob = MobilityHelper()
+    mob.SetPositionAllocator(alloc)
+    mob.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mob.Install(nodes)
+
+    channel = MultiModelSpectrumChannel()
+    channel.AddPropagationLossModel(LogDistancePropagationLossModel())
+
+    class SpectrumPhyHelper:
+        def Create(self, node, device):
+            phy = SpectrumWifiPhy()
+            phy.SetDevice(device)
+            phy.SetChannel(channel)
+            return phy
+
+    phy_helper = SpectrumPhyHelper()
+    wifi = WifiHelper()
+    wifi.SetRemoteStationManager(
+        "tpudes::ConstantRateWifiManager", DataMode="OfdmRate54Mbps"
+    )
+    ap_mac = WifiMacHelper()
+    ap_mac.SetType("tpudes::ApWifiMac")
+    ap_devs = wifi.Install(phy_helper, ap_mac, [nodes.Get(0)])
+    sta_mac = WifiMacHelper()
+    sta_mac.SetType("tpudes::StaWifiMac")
+    sta_devs = wifi.Install(
+        phy_helper, sta_mac, [nodes.Get(1 + i) for i in range(n_stas)]
+    )
+    InternetStackHelper().Install(nodes)
+    devices = NetDeviceContainer()
+    devices.Add(ap_devs.Get(0))
+    for i in range(n_stas):
+        devices.Add(sta_devs.Get(i))
+    ifc = Ipv4AddressHelper("10.1.4.0", "255.255.255.0").Assign(devices)
+
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(0))
+    sapps.Start(Seconds(0.1))
+    rx = [0]
+    sapps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda *a: rx.__setitem__(0, rx[0] + 1)
+    )
+    for i in range(n_stas):
+        c = UdpEchoClientHelper(ifc.GetAddress(0), 9)
+        c.SetAttribute("MaxPackets", 5)
+        c.SetAttribute("Interval", Seconds(0.05))
+        c.Install(nodes.Get(1 + i)).Start(Seconds(0.3 + 0.001 * i))
+    return nodes, channel, rx
+
+
+def test_wifi_over_spectrum_channel_delivers():
+    nodes, channel, rx = _spectrum_bss(2)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    assert rx[0] == 10, "echo traffic must flow over the spectrum medium"
+
+
+def test_foreign_psd_jams_the_wifi_band():
+    """An LTE-model PSD blasted onto the shared channel lands as
+    converted in-band interference and kills WiFi delivery — the
+    coexistence effect the multi-model channel exists to capture."""
+    from tpudes.models.mobility import MobilityModel
+    from tpudes.models.spectrum import SpectrumPhy
+
+    nodes, channel, rx = _spectrum_bss(2)
+    wifi_phy = nodes.Get(0).GetDevice(0).GetPhy()
+    center = float(wifi_phy.frequency)
+
+    class Jammer(SpectrumPhy):
+        def GetRxSpectrumModel(self):
+            return None
+
+        def GetMobility(self):
+            return nodes.Get(0).GetObject(MobilityModel)
+
+        def GetDevice(self):
+            return nodes.Get(0).GetDevice(0)
+
+        def StartRx(self, params):
+            pass
+
+    jammer = Jammer()
+    channel.AddRx(jammer)
+    model = lte_spectrum_model(25, center)  # overlapping the WiFi band
+    psd = SpectrumValue(model)
+    psd.values[:] = 1.0  # absurdly strong: guaranteed jam
+
+    def blast():
+        channel.StartTx(SpectrumSignalParameters(psd, 0.05, jammer))
+        Simulator.Schedule(Seconds(0.05), blast)
+
+    Simulator.Schedule(Seconds(0.0), blast)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    assert rx[0] == 0, "a saturating in-band jammer must block delivery"
+
+
+def test_wifi_spectrum_model_shape():
+    m = wifi_spectrum_model(5.18e9, 20)
+    assert m.GetNumBands() == 4
+    total = sum(b.width for b in m.bands)
+    assert total == pytest.approx(20e6)
+    assert m.bands[0].fl == pytest.approx(5.18e9 - 10e6)

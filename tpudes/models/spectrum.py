@@ -210,6 +210,11 @@ class SingleModelSpectrumChannel(Object):
     def GetDevice(self, i: int):
         return self._phys[i].GetDevice()
 
+    def _adapt_for_rx(self, psd: SpectrumValue, phy: SpectrumPhy):
+        """Per-receiver PSD adaptation hook; the single-model channel
+        delivers as-is, the multi-model subclass converts grids."""
+        return psd
+
     def StartTx(self, params: SpectrumSignalParameters) -> None:
         sender = params.tx_phy
         sender_mob = sender.GetMobility() if sender is not None else None
@@ -229,6 +234,7 @@ class SingleModelSpectrumChannel(Object):
                     )
                 if self._delay is not None:
                     delay_s = self._delay.GetDelay(sender_mob, rx_mob)
+            psd = self._adapt_for_rx(psd, phy)
             rx_params = SpectrumSignalParameters(psd, params.duration_s, sender)
             rx_params.payload = params.payload
             node = phy.GetDevice().GetNode() if phy.GetDevice() else None
@@ -238,6 +244,72 @@ class SingleModelSpectrumChannel(Object):
                 phy.StartRx,
                 rx_params,
             )
+
+
+class SpectrumConverter:
+    """PSD conversion between SpectrumModels
+    (src/spectrum/model/spectrum-converter.{h,cc}): each target band
+    collects the power of every overlapping source band weighted by the
+    overlap fraction, preserving total power over the shared range."""
+
+    def __init__(self, from_model: SpectrumModel, to_model: SpectrumModel):
+        import numpy as np
+
+        self.from_model = from_model
+        self.to_model = to_model
+        F, T = from_model.GetNumBands(), to_model.GetNumBands()
+        m = np.zeros((T, F))
+        for t, tb in enumerate(to_model.bands):
+            for f, fb in enumerate(from_model.bands):
+                overlap = min(tb.fh, fb.fh) - max(tb.fl, fb.fl)
+                if overlap > 0:
+                    # power (W) moved = psd_from · overlap; back to PSD
+                    # by the target band width
+                    m[t, f] = overlap / tb.width
+        self._matrix = m
+
+    def Convert(self, value: SpectrumValue) -> SpectrumValue:
+        out = SpectrumValue(self.to_model)
+        out.values = self._matrix @ value.values
+        return out
+
+
+class MultiModelSpectrumChannel(SingleModelSpectrumChannel):
+    """Heterogeneous-model channel
+    (src/spectrum/model/multi-model-spectrum-channel.{h,cc}): receivers
+    may use different SpectrumModels (LTE RB grid, WiFi band, …); the tx
+    PSD is converted per receiver model through converters cached by
+    model uid.  Everything else — loss chain, delay, delivery — is the
+    single-model channel's loop, specialized only at the per-receiver
+    adaptation hook."""
+
+    tid = (
+        TypeId("tpudes::MultiModelSpectrumChannel")
+        .SetParent(SingleModelSpectrumChannel.tid)
+        .AddConstructor(lambda **kw: MultiModelSpectrumChannel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._converters: dict[tuple[int, int], SpectrumConverter] = {}
+
+    def AddRx(self, phy: SpectrumPhy) -> None:
+        # no single-model restriction; direct backref (SetChannel calls
+        # AddRx, so calling it back would recurse)
+        if phy not in self._phys:
+            self._phys.append(phy)
+            phy._channel = self
+
+    def _adapt_for_rx(self, psd: SpectrumValue, phy: SpectrumPhy):
+        to_model = phy.GetRxSpectrumModel()
+        if to_model is None or psd.model.uid == to_model.uid:
+            return psd
+        key = (psd.model.uid, to_model.uid)
+        conv = self._converters.get(key)
+        if conv is None:
+            conv = SpectrumConverter(psd.model, to_model)
+            self._converters[key] = conv
+        return conv.Convert(psd)
 
 
 class ConstantSpectrumPropagationLossModel:

@@ -17,6 +17,7 @@ from tpudes.models.wifi.mac import (
     WifiMacType,
 )
 from tpudes.models.wifi.device import WifiNetDevice
+from tpudes.models.wifi.spectrum_phy import SpectrumWifiPhy, wifi_spectrum_model
 from tpudes.models.wifi.rate_control import (
     AarfWifiManager,
     ArfWifiManager,

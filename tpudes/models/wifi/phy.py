@@ -84,6 +84,13 @@ class InterferenceHelper:
         self._events.append(ev)
         return ev
 
+    def add_foreign(self, rx_power_w: float, start_ts: int, end_ts: int) -> None:
+        """Non-WiFi energy (cross-technology PSD from a spectrum
+        channel): pure interference — it joins every SNR chunk sum but
+        can never be locked onto (mode None is only read for the event
+        under decode, never for interferers)."""
+        self.add(None, None, start_ts, end_ts, rx_power_w)
+
     def gc(self, now_ts: int) -> None:
         """Drop events that can no longer overlap anything in flight."""
         self._events = [e for e in self._events if e.end_ts >= now_ts]
@@ -270,11 +277,17 @@ class YansWifiPhy(Object):
             self.phy_rx_drop(self._current_rx.packet, "tx-preempts-rx")
             self._current_rx = None
         self._set_state(WifiPhyState.TX, end)
-        self.phy_tx_begin(packet, 10 ** ((self.GetTxPowerDbm(tx_power_level) - 30) / 10))
+        tx_power_dbm = self.GetTxPowerDbm(tx_power_level)
+        self.phy_tx_begin(packet, 10 ** ((tx_power_dbm - 30) / 10))
         for listener in self._listeners:
             listener.NotifyTxStart(end)
-        self._channel.Send(self, packet, mode, self.GetTxPowerDbm(tx_power_level), duration_s)
+        self._transmit_to_channel(packet, mode, duration_s, tx_power_dbm)
         self._sim.GetImpl().Schedule(end - now, self._end_tx, (packet,))
+
+    def _transmit_to_channel(self, packet, mode, duration_s, tx_power_dbm):
+        """Medium handoff hook — SpectrumWifiPhy overrides with a PSD
+        onto the spectrum channel; everything else in Send is shared."""
+        self._channel.Send(self, packet, mode, tx_power_dbm, duration_s)
 
     def _end_tx(self, packet):
         self.phy_tx_end(packet)

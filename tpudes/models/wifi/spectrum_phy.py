@@ -1,0 +1,123 @@
+"""SpectrumWifiPhy: the WiFi PHY over a spectrum channel.
+
+Reference parity: src/wifi/model/spectrum-wifi-phy.{h,cc} +
+wifi-spectrum-value-helper.{h,cc} (upstream paths; mount empty at
+survey — SURVEY.md §0, §2.5 SpectrumWifiPhy row).
+
+Same state machine, interference bookkeeping and error model as
+YansWifiPhy (it IS a YansWifiPhy subclass); only the medium differs:
+transmissions leave as a PSD over the WiFi SpectrumModel through a
+Single- or MultiModelSpectrumChannel, and arrivals integrate the
+received PSD across this PHY's band into the scalar rx power the
+shared receive path consumes.  Cross-technology interference (e.g. an
+LTE PSD overlapping the WiFi band on a MultiModelSpectrumChannel)
+lands through the same conversion — the reason this PHY exists
+upstream.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.nstime import Seconds
+from tpudes.core.object import TypeId
+from tpudes.models.spectrum import (
+    SpectrumModel,
+    SpectrumPhy,
+    SpectrumSignalParameters,
+    SpectrumValue,
+)
+from tpudes.models.wifi.phy import WifiMode, YansWifiPhy, ppdu_duration_s
+
+
+def wifi_spectrum_model(center_hz: float, width_mhz: int,
+                        band_hz: float = 5e6) -> SpectrumModel:
+    """The channel as ``width/band`` equal sub-bands around the carrier
+    (wifi-spectrum-value-helper.cc's flat-in-band shape)."""
+    n = max(int(width_mhz * 1e6 / band_hz), 1)
+    low = center_hz - width_mhz * 1e6 / 2.0
+    centers = [low + (i + 0.5) * band_hz for i in range(n)]
+    return SpectrumModel.FromCenters(centers, band_hz)
+
+
+class _WifiSpectrumAdapter(SpectrumPhy):
+    """The SpectrumPhy face the channel talks to."""
+
+    def __init__(self, owner: "SpectrumWifiPhy"):
+        super().__init__()
+        self._owner = owner
+
+    def GetRxSpectrumModel(self):
+        return self._owner.spectrum_model
+
+    def GetMobility(self):
+        return self._owner.GetMobility()
+
+    def GetDevice(self):
+        return self._owner.GetDevice()
+
+    def StartRx(self, params: SpectrumSignalParameters) -> None:
+        self._owner._start_rx_spectrum(params)
+
+
+class SpectrumWifiPhy(YansWifiPhy):
+    tid = (
+        TypeId("tpudes::SpectrumWifiPhy")
+        .SetParent(YansWifiPhy.tid)
+        .AddConstructor(lambda **kw: SpectrumWifiPhy(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self.spectrum_model = wifi_spectrum_model(
+            float(self.frequency), int(self.channel_width)
+        )
+        self._adapter = _WifiSpectrumAdapter(self)
+        self._spectrum_channel = None
+
+    # --- wiring (spectrum flavor) ----------------------------------------
+    def SetChannel(self, channel) -> None:
+        """Accepts a Single/MultiModelSpectrumChannel."""
+        self._spectrum_channel = channel
+        channel.AddRx(self._adapter)
+
+    def GetChannel(self):
+        return self._spectrum_channel
+
+    # --- tx: only the medium handoff differs from YansWifiPhy -------------
+    def _transmit_to_channel(self, packet, mode, duration_s, tx_power_dbm):
+        psd = SpectrumValue(self.spectrum_model)
+        psd.values[:] = 10 ** ((tx_power_dbm - 30) / 10) / (
+            self.channel_width * 1e6
+        )
+        params = SpectrumSignalParameters(psd, duration_s, self._adapter)
+        params.payload = (packet.Copy(), mode)
+        self._spectrum_channel.StartTx(params)
+
+    # --- rx ---------------------------------------------------------------
+    def _start_rx_spectrum(self, params: SpectrumSignalParameters) -> None:
+        import math
+
+        # the channel already converted the PSD to our model; the band
+        # integral IS its total power
+        rx_w = params.psd.TotalPowerW()
+        # rx_gain is applied ONCE: StartReceivePreamble adds it to the
+        # dBm we pass, so the foreign path must apply it itself to stay
+        # consistent with the CCA/interference bookkeeping
+        rx_dbm = 10.0 * math.log10(max(rx_w, 1e-30)) + 30.0
+        payload = getattr(params, "payload", None)
+        if payload is None or not (
+            isinstance(payload, tuple) and len(payload) == 2
+            and isinstance(payload[1], WifiMode)
+        ):
+            # foreign-technology energy (no WiFi PPDU): interference to
+            # any decode in progress, aggregate CCA via the shared path
+            now = self._sim.NowTicks()
+            end = now + Seconds(params.duration_s).ticks
+            gained_w = rx_w * 10 ** (self.rx_gain / 10.0)
+            self._interference.gc(now)
+            self._interference.add_foreign(gained_w, now, end)
+            self._maybe_cca_busy()
+            return
+        packet, mode = payload
+        self.StartReceivePreamble(
+            packet.Copy(), mode, rx_dbm, params.duration_s
+        )
