@@ -1,0 +1,135 @@
+"""Energy framework tests — upstream src/energy/test strategy: exact
+integrals for known state timelines, depletion callback, WiFi wiring."""
+
+import pytest
+
+from tpudes.core import MicroSeconds, Seconds, Simulator
+from tpudes.models.energy import (
+    BasicEnergySource,
+    BasicEnergySourceHelper,
+    WifiRadioEnergyModel,
+    WifiRadioEnergyModelHelper,
+)
+
+
+class _FakePhy:
+    """Minimal State-trace emitter standing in for a WifiPhy."""
+
+    def __init__(self):
+        self._cb = None
+        self._state_until = 0
+
+    def TraceConnectWithoutContext(self, name, cb):
+        assert name == "State"
+        self._cb = cb
+        return True
+
+    def set_state(self, state, until_ticks):
+        self._state_until = until_ticks
+        self._cb(Simulator.NowTicks(), until_ticks - Simulator.NowTicks(), state)
+
+
+def test_energy_integral_is_exact_for_known_timeline():
+    from tpudes.models.wifi.phy import WifiPhyState
+
+    src = BasicEnergySource(
+        BasicEnergySourceInitialEnergyJ=100.0, BasicEnergySupplyVoltageV=3.0
+    )
+    model = WifiRadioEnergyModel(
+        IdleCurrentA=0.1, TxCurrentA=0.5, RxCurrentA=0.2
+    )
+    model.SetEnergySource(src)
+    phy = _FakePhy()
+    model.AttachPhy(phy)
+
+    # 1 ms idle, then 2 ms TX, then 3 ms RX, then idle again
+    Simulator.Schedule(
+        MicroSeconds(1000),
+        lambda: phy.set_state(
+            WifiPhyState.TX, Simulator.NowTicks() + MicroSeconds(2000).ticks
+        ),
+    )
+    Simulator.Schedule(
+        MicroSeconds(3000),
+        lambda: phy.set_state(
+            WifiPhyState.RX, Simulator.NowTicks() + MicroSeconds(3000).ticks
+        ),
+    )
+    Simulator.Stop(MicroSeconds(10_000))
+    Simulator.Run()
+    total = model.GetTotalEnergyConsumption()
+    # V * (1ms·0.1 + 2ms·0.5 + 3ms·0.2 + 4ms·0.1)
+    expect = 3.0 * (0.001 * 0.1 + 0.002 * 0.5 + 0.003 * 0.2 + 0.004 * 0.1)
+    assert total == pytest.approx(expect, rel=1e-6)
+    assert src.GetRemainingEnergy() == pytest.approx(100.0 - expect, rel=1e-6)
+
+
+def test_depletion_fires_once():
+    from tpudes.models.wifi.phy import WifiPhyState
+
+    src = BasicEnergySource(
+        BasicEnergySourceInitialEnergyJ=0.001, BasicEnergySupplyVoltageV=3.0
+    )
+    model = WifiRadioEnergyModel(TxCurrentA=1.0)
+    model.SetEnergySource(src)
+    phy = _FakePhy()
+    model.AttachPhy(phy)
+    fired = []
+    src.RegisterDepletionCallback(lambda: fired.append(Simulator.NowTicks()))
+    # 0.001 J / (1 A * 3 V) ≈ 333 µs of TX drains it
+    phy.set_state(WifiPhyState.TX, MicroSeconds(10_000).ticks)
+    Simulator.Stop(MicroSeconds(10_000))
+    Simulator.Run()
+    assert src.GetRemainingEnergy() == 0.0
+    assert src.IsDepleted()
+    assert len(fired) == 1
+
+
+def test_poll_at_state_boundary_bills_idle_after_decay():
+    """A poll landing exactly at the busy period's end must reset the
+    tracked state so later idle time bills at idle current (r4 review:
+    stale state billed idle hours at the RX rate)."""
+    from tpudes.models.wifi.phy import WifiPhyState
+
+    src = BasicEnergySource(
+        BasicEnergySourceInitialEnergyJ=100.0, BasicEnergySupplyVoltageV=1.0
+    )
+    model = WifiRadioEnergyModel(IdleCurrentA=0.1, RxCurrentA=1.0)
+    model.SetEnergySource(src)
+    phy = _FakePhy()
+    model.AttachPhy(phy)
+    end = MicroSeconds(1000).ticks
+    phy.set_state(WifiPhyState.RX, end)
+    # poll exactly at the decay boundary, then 9 ms later
+    Simulator.Schedule(MicroSeconds(1000), model.Update)
+    Simulator.Stop(MicroSeconds(10_000))
+    Simulator.Run()
+    total = model.GetTotalEnergyConsumption()
+    # 1 ms RX at 1 A + 9 ms idle at 0.1 A, 1 V
+    assert total == pytest.approx(0.001 * 1.0 + 0.009 * 0.1, rel=1e-6)
+
+
+def test_wifi_bss_drains_energy_through_real_phy():
+    from tpudes.scenarios import build_bss
+
+    sta_devices, ap_device, clients, _ = build_bss(3, 1.0)
+    helper = BasicEnergySourceHelper()
+    helper.Set("BasicEnergySourceInitialEnergyJ", 5.0)
+    sources = helper.Install(
+        [sta_devices.Get(i).GetNode() for i in range(3)]
+    )
+    radio = WifiRadioEnergyModelHelper()
+    models = radio.Install(
+        [sta_devices.Get(i) for i in range(3)], sources
+    )
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    for src, model in zip(sources, models):
+        spent = model.GetTotalEnergyConsumption()
+        # ~1 s mostly idle at 0.273 A × 3 V ≈ 0.82 J, plus tx/rx
+        assert 0.6 < spent < 2.0, spent
+        assert src.GetRemainingEnergy() == pytest.approx(
+            5.0 - spent, rel=1e-6
+        )
+        # radios that transmitted spent more than pure idle would
+        assert spent > 0.273 * 3.0 * 0.99

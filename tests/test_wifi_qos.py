@@ -1,0 +1,115 @@
+"""EDCA/QoS tests — upstream wifi-ac-mapping + EDCA parameter tests:
+TOS classification, per-AC parameters, and priority under saturation."""
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.helper.applications import UdpClientHelper, UdpServerHelper
+from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.models.mobility import MobilityHelper
+from tpudes.models.wifi import (
+    WifiHelper,
+    WifiMacHelper,
+    YansWifiChannelHelper,
+    YansWifiPhyHelper,
+)
+from tpudes.models.wifi.mac import EDCA_PARAMS, AcIndex, classify_ac
+from tpudes.models.internet.ipv4 import Ipv4Header
+from tpudes.network.packet import Packet
+
+
+def test_tos_to_ac_mapping():
+    # UP = TOS >> 5; the 802.11 table (qos-utils.cc)
+    cases = {
+        0xC0: AcIndex.AC_VO,  # UP 6
+        0xE0: AcIndex.AC_VO,  # UP 7
+        0x80: AcIndex.AC_VI,  # UP 4
+        0xA0: AcIndex.AC_VI,  # UP 5
+        0x00: AcIndex.AC_BE,  # UP 0
+        0x60: AcIndex.AC_BE,  # UP 3
+        0x20: AcIndex.AC_BK,  # UP 1
+        0x40: AcIndex.AC_BK,  # UP 2
+    }
+    for tos, ac in cases.items():
+        p = Packet(100)
+        p.AddHeader(Ipv4Header(tos=tos))
+        assert classify_ac(p) == ac, hex(tos)
+    # no IP header → best effort
+    assert classify_ac(Packet(10)) == AcIndex.AC_BE
+
+
+def test_edca_parameter_set_is_standard():
+    assert EDCA_PARAMS[AcIndex.AC_VO] == (2, 3, 7)
+    assert EDCA_PARAMS[AcIndex.AC_VI] == (2, 7, 15)
+    assert EDCA_PARAMS[AcIndex.AC_BE][0] == 3
+    assert EDCA_PARAMS[AcIndex.AC_BK][0] == 7
+
+
+def _qos_bss(sim_time=2.0):
+    """AP + 1 STA; the STA carries a VO flow and a BK flow, both at
+    rates that together saturate the medium."""
+    nodes = NodeContainer()
+    nodes.Create(2)
+    mobility = MobilityHelper()
+    mobility.SetPositionAllocator(
+        "tpudes::RandomDiscPositionAllocator", X=0.0, Y=0.0, Rho=5.0
+    )
+    mobility.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mobility.Install(nodes)
+    channel = YansWifiChannelHelper.Default().Create()
+    phy = YansWifiPhyHelper()
+    phy.SetChannel(channel)
+    wifi = WifiHelper()
+    wifi.SetRemoteStationManager(
+        "tpudes::ConstantRateWifiManager", DataMode="OfdmRate6Mbps"
+    )
+    ap_mac = WifiMacHelper()
+    ap_mac.SetType("tpudes::ApWifiMac", QosSupported=True)
+    ap_devs = wifi.Install(phy, ap_mac, [nodes.Get(0)])
+    sta_mac = WifiMacHelper()
+    sta_mac.SetType("tpudes::StaWifiMac", QosSupported=True)
+    sta_devs = wifi.Install(phy, sta_mac, [nodes.Get(1)])
+    InternetStackHelper().Install(nodes)
+    devs = NetDeviceContainer()
+    devs.Add(ap_devs.Get(0))
+    devs.Add(sta_devs.Get(0))
+    ifc = Ipv4AddressHelper("10.1.5.0", "255.255.255.0").Assign(devs)
+
+    rx = {"vo": 0, "bk": 0}
+    for key, port, tos in (("vo", 9, 0xC0), ("bk", 10, 0x20)):
+        server = UdpServerHelper(port)
+        sapps = server.Install(nodes.Get(0))
+        sapps.Start(Seconds(0.0))
+        sapps.Get(0).TraceConnectWithoutContext(
+            "Rx", lambda *a, k=key: rx.__setitem__(k, rx[k] + 1)
+        )
+        client = UdpClientHelper(ifc.GetAddress(0), port)
+        client.SetAttribute("MaxPackets", 0)
+        client.SetAttribute("Interval", Seconds(0.002))  # 2x overload each
+        client.SetAttribute("PacketSize", 1000)
+        client.SetAttribute("Tos", tos)
+        client.Install(nodes.Get(1)).Start(Seconds(0.2))
+    return nodes, rx
+
+
+def test_voice_outranks_background_under_saturation():
+    nodes, rx = _qos_bss()
+    Simulator.Stop(Seconds(2.0))
+    Simulator.Run()
+    assert rx["vo"] > 0 and rx["bk"] >= 0
+    # strict-priority head selection: VO drains first, BK gets leftovers
+    assert rx["vo"] >= 3 * max(rx["bk"], 1), rx
+
+
+def test_qos_off_treats_flows_equally():
+    nodes, rx = _qos_bss()
+    # flip QoS off on the STA: everything rides AC_BE FIFO, no
+    # differentiation (toggling is safe — one queue representation)
+    mac = nodes.Get(1).GetDevice(0).GetMac()
+    mac.SetAttribute("QosSupported", False)
+    Simulator.Stop(Seconds(2.0))
+    Simulator.Run()
+    assert rx["vo"] > 0 and rx["bk"] > 0
+    ratio = rx["vo"] / max(rx["bk"], 1)
+    assert 0.5 < ratio < 2.0, rx

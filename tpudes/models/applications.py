@@ -168,6 +168,7 @@ class UdpClient(Application):
         .AddAttribute("RemoteAddress", "destination address", None)
         .AddAttribute("RemotePort", "destination port", 100)
         .AddAttribute("PacketSize", "payload bytes", 1024)
+        .AddAttribute("Tos", "IP TOS of outgoing packets (QoS/EDCA input)", 0)
         .AddTraceSource("Tx", "a packet is sent")
     )
 
@@ -180,6 +181,7 @@ class UdpClient(Application):
     def StartApplication(self):
         if self._socket is None:
             self._socket = SocketFactory.CreateSocket(self._node, "tpudes::UdpSocketFactory")
+            self._socket.SetIpTos(int(self.tos))
             self._socket.Bind()
             self._socket.Connect(InetSocketAddress(Ipv4Address(self.remote_address), self.remote_port))
         self._send()

@@ -1,0 +1,203 @@
+"""Energy framework: sources + per-radio-state device energy models.
+
+Reference parity: src/energy/model/{energy-source,basic-energy-source,
+device-energy-model,wifi-radio-energy-model}.{h,cc} + helpers
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.9 energy row).
+
+BasicEnergySource holds Joules at a supply voltage and drains linearly
+through the attached device models' state currents;
+WifiRadioEnergyModel rides the PHY's State trace — every transition
+charges the elapsed interval at the PREVIOUS state's current draw, so
+the integral is exact for piecewise-constant currents regardless of
+when anyone asks.  Depletion fires the registered callbacks once.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+
+
+class BasicEnergySource(Object):
+    tid = (
+        TypeId("tpudes::BasicEnergySource")
+        .AddConstructor(lambda **kw: BasicEnergySource(**kw))
+        .AddAttribute("BasicEnergySourceInitialEnergyJ", "Joules", 10.0,
+                      field="initial_energy_j")
+        .AddAttribute("BasicEnergySupplyVoltageV", "Volts", 3.0,
+                      field="supply_voltage_v")
+        .AddTraceSource("RemainingEnergy", "(joules) after each update")
+        .AddTraceSource("EnergyDepleted", "() fired once at exhaustion")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._remaining_j = float(self.initial_energy_j)
+        self._models: list = []
+        self._depleted = False
+        self._depletion_callbacks: list = []
+
+    def GetSupplyVoltage(self) -> float:
+        return float(self.supply_voltage_v)
+
+    def GetRemainingEnergy(self) -> float:
+        # settle every attached model up to now first
+        for m in self._models:
+            m.Update()
+        return self._remaining_j
+
+    def GetEnergyFraction(self) -> float:
+        return self.GetRemainingEnergy() / float(self.initial_energy_j)
+
+    def AppendDeviceEnergyModel(self, model) -> None:
+        self._models.append(model)
+
+    def RegisterDepletionCallback(self, cb) -> None:
+        self._depletion_callbacks.append(cb)
+
+    def ConsumeEnergy(self, joules: float) -> None:
+        if self._depleted:
+            return
+        self._remaining_j -= joules
+        self.remaining_energy(max(self._remaining_j, 0.0))
+        if self._remaining_j <= 0.0:
+            self._remaining_j = 0.0
+            self._depleted = True
+            self.energy_depleted()
+            for cb in self._depletion_callbacks:
+                cb()
+
+    def IsDepleted(self) -> bool:
+        return self._depleted
+
+
+class WifiRadioEnergyModel(Object):
+    """Per-state current draw for one WiFi PHY (wifi-radio-energy-
+    model.cc defaults, Amperes)."""
+
+    tid = (
+        TypeId("tpudes::WifiRadioEnergyModel")
+        .AddConstructor(lambda **kw: WifiRadioEnergyModel(**kw))
+        .AddAttribute("IdleCurrentA", "", 0.273, field="idle_a")
+        .AddAttribute("CcaBusyCurrentA", "", 0.273, field="cca_a")
+        .AddAttribute("TxCurrentA", "", 0.380, field="tx_a")
+        .AddAttribute("RxCurrentA", "", 0.313, field="rx_a")
+        .AddAttribute("SleepCurrentA", "", 0.033, field="sleep_a")
+        .AddTraceSource("TotalEnergyConsumption", "(joules)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._source: BasicEnergySource | None = None
+        self._phy = None
+        self._state = 0  # WifiPhyState.IDLE
+        self._last_update_ts = 0
+        self.total_energy_j = 0.0
+
+    def _current_a(self, state: int) -> float:
+        from tpudes.models.wifi.phy import WifiPhyState
+
+        return {
+            WifiPhyState.IDLE: self.idle_a,
+            WifiPhyState.CCA_BUSY: self.cca_a,
+            WifiPhyState.TX: self.tx_a,
+            WifiPhyState.RX: self.rx_a,
+            WifiPhyState.SLEEP: self.sleep_a,
+        }.get(state, self.idle_a)
+
+    def SetEnergySource(self, source: BasicEnergySource) -> None:
+        self._source = source
+        source.AppendDeviceEnergyModel(self)
+
+    def AttachPhy(self, phy) -> None:
+        self._phy = phy
+        self._last_update_ts = Simulator.NowTicks()
+        phy.TraceConnectWithoutContext("State", self._on_state)
+
+    def _on_state(self, start_ts, duration_ticks, new_state) -> None:
+        self.Update()
+        self._state = new_state
+
+    def Update(self) -> None:
+        """Charge the interval since the last update at the (piecewise-
+        constant) current of the state held across it.  The PHY's state
+        decays to IDLE at ``_state_until`` without emitting a trace, so
+        the interval splits there — integer tick math, no float-derived
+        boundaries (an Update landing exactly at the decay must still
+        reset the tracked state, or later idle time bills at the busy
+        current)."""
+        now = Simulator.NowTicks()
+        prev = self._last_update_ts
+        self._last_update_ts = now
+        if now <= prev or self._source is None:
+            return
+        from tpudes.models.wifi.phy import WifiPhyState
+
+        state_end = getattr(self._phy, "_state_until", now)
+        if self._state != WifiPhyState.IDLE and state_end <= now:
+            busy_ticks = max(min(state_end, now) - prev, 0)
+            idle_ticks = (now - prev) - busy_ticks
+            joules = (
+                busy_ticks / 1e9 * self._current_a(self._state)
+                + idle_ticks / 1e9 * self._current_a(WifiPhyState.IDLE)
+            ) * self._source.GetSupplyVoltage()
+            self._state = WifiPhyState.IDLE
+        else:
+            joules = (
+                (now - prev) / 1e9 * self._current_a(self._state)
+                * self._source.GetSupplyVoltage()
+            )
+        self.total_energy_j += joules
+        self.total_energy_consumption(self.total_energy_j)
+        self._source.ConsumeEnergy(joules)
+
+    def GetTotalEnergyConsumption(self) -> float:
+        self.Update()
+        return self.total_energy_j
+
+
+class BasicEnergySourceHelper:
+    def __init__(self):
+        self._attrs: dict = {}
+
+    def Set(self, name: str, value) -> None:
+        self._attrs[name] = value
+
+    def Install(self, nodes) -> list[BasicEnergySource]:
+        from tpudes.helper.containers import NodeContainer
+
+        if isinstance(nodes, NodeContainer):
+            nodes = list(nodes)
+        elif not isinstance(nodes, (list, tuple)):
+            nodes = [nodes]
+        sources = []
+        for node in nodes:
+            src = BasicEnergySource(**self._attrs)
+            node.AggregateObject(src)
+            sources.append(src)
+        return sources
+
+
+class WifiRadioEnergyModelHelper:
+    def __init__(self):
+        self._attrs: dict = {}
+
+    def Set(self, name: str, value) -> None:
+        self._attrs[name] = value
+
+    def Install(self, devices, sources) -> list[WifiRadioEnergyModel]:
+        from tpudes.helper.containers import NetDeviceContainer
+
+        if isinstance(devices, NetDeviceContainer):
+            devices = list(devices)
+        elif not isinstance(devices, (list, tuple)):
+            devices = [devices]
+        if not isinstance(sources, (list, tuple)):
+            sources = [sources]
+        models = []
+        for dev, src in zip(devices, sources):
+            model = WifiRadioEnergyModel(**self._attrs)
+            model.SetEnergySource(src)
+            model.AttachPhy(dev.GetPhy())
+            models.append(model)
+        return models

@@ -379,7 +379,7 @@ class Ipv4L3Protocol(Object):
         return self.interfaces[i].IsUp()
 
     # --- send path (SURVEY.md 3.1) ---
-    def Send(self, packet, source: Ipv4Address, destination: Ipv4Address, protocol: int, route: Ipv4Route = None):
+    def Send(self, packet, source: Ipv4Address, destination: Ipv4Address, protocol: int, route: Ipv4Route = None, tos: int = 0):
         self._ident = (self._ident + 1) & 0xFFFF  # uint16_t wrap, as upstream
         header = Ipv4Header(
             source=source,
@@ -388,6 +388,7 @@ class Ipv4L3Protocol(Object):
             ttl=self.default_ttl,
             identification=self._ident,
             payload_size=packet.GetSize(),
+            tos=tos,
         )
         if destination.IsLocalhost():
             packet.AddHeader(header)

@@ -173,12 +173,12 @@ class UdpL4Protocol(Object):
         return sock
 
     # --- tx ---
-    def Send(self, packet, saddr: Ipv4Address, daddr: Ipv4Address, sport: int, dport: int, route=None):
+    def Send(self, packet, saddr: Ipv4Address, daddr: Ipv4Address, sport: int, dport: int, route=None, tos: int = 0):
         packet.AddHeader(UdpHeader(sport, dport, packet.GetSize()))
         from tpudes.models.internet.ipv4 import Ipv4L3Protocol
 
         ipv4 = self._node.GetObject(Ipv4L3Protocol)
-        ipv4.Send(packet, saddr, daddr, self.PROT_NUMBER, route)
+        ipv4.Send(packet, saddr, daddr, self.PROT_NUMBER, route, tos=tos)
 
     # --- rx (from Ipv4L3Protocol._deliver_l4) ---
     def Receive(self, packet, ip_header, incoming_interface):
@@ -273,7 +273,10 @@ class UdpSocketImpl(Socket):
                     return -1
                 saddr = route.source
         size = packet.GetSize()
-        self._udp.Send(packet, saddr, daddr, self._endpoint.local_port, to_address.GetPort())
+        self._udp.Send(
+            packet, saddr, daddr, self._endpoint.local_port,
+            to_address.GetPort(), tos=self._ip_tos,
+        )
         self.NotifyDataSent(size)
         self.NotifySend(self.GetTxAvailable())
         return size

@@ -324,11 +324,29 @@ class ConstantSpectrumPropagationLossModel:
         return out
 
 
+_UNIFORM_MODEL_CACHE: dict[tuple, SpectrumModel] = {}
+
+
+def uniform_spectrum_model(
+    center_hz: float, n_bands: int, band_hz: float
+) -> SpectrumModel:
+    """``n_bands`` equal bands around ``center_hz`` — CACHED by the grid
+    parameters, so identical PHYs share one model uid (two fresh uids
+    for the same grid would force needless conversion and break the
+    single-model channel's same-model check)."""
+    key = (float(center_hz), int(n_bands), float(band_hz))
+    model = _UNIFORM_MODEL_CACHE.get(key)
+    if model is None:
+        low = center_hz - n_bands * band_hz / 2.0
+        centers = [low + (i + 0.5) * band_hz for i in range(n_bands)]
+        model = SpectrumModel.FromCenters(centers, band_hz)
+        _UNIFORM_MODEL_CACHE[key] = model
+    return model
+
+
 def lte_spectrum_model(n_rb: int, carrier_hz: float) -> SpectrumModel:
     """The LTE RB grid as a SpectrumModel: n_rb bands of 180 kHz around
     the carrier (lte-spectrum-value-helper.cc)."""
     from tpudes.ops.lte import RB_BANDWIDTH_HZ
 
-    low = carrier_hz - n_rb * RB_BANDWIDTH_HZ / 2.0
-    centers = [low + (i + 0.5) * RB_BANDWIDTH_HZ for i in range(n_rb)]
-    return SpectrumModel.FromCenters(centers, RB_BANDWIDTH_HZ)
+    return uniform_spectrum_model(carrier_hz, n_rb, RB_BANDWIDTH_HZ)

@@ -113,6 +113,41 @@ class WifiMacHeader(Header):
         return f"WifiMacHeader({names.get(self.frame_type)}, to={self.addr1}, from={self.addr2}, seq={self.seq})"
 
 
+class AcIndex:
+    """Access categories (qos-utils.h), priority order."""
+
+    AC_VO, AC_VI, AC_BE, AC_BK = 0, 1, 2, 3
+
+
+#: 802.11 EDCA default parameter set for OFDM PHYs (wifi-mac.cc
+#: ConfigureDcf): (AIFSN, CWmin, CWmax)
+EDCA_PARAMS = {
+    AcIndex.AC_VO: (2, 3, 7),
+    AcIndex.AC_VI: (2, 7, 15),
+    AcIndex.AC_BE: (3, CW_MIN, CW_MAX),
+    AcIndex.AC_BK: (7, CW_MIN, CW_MAX),
+}
+
+#: user priority (TOS >> 5) → AC (qos-utils.cc QosUtilsMapTidToAc)
+UP_TO_AC = {
+    0: AcIndex.AC_BE, 3: AcIndex.AC_BE,
+    1: AcIndex.AC_BK, 2: AcIndex.AC_BK,
+    4: AcIndex.AC_VI, 5: AcIndex.AC_VI,
+    6: AcIndex.AC_VO, 7: AcIndex.AC_VO,
+}
+
+
+def classify_ac(packet: Packet) -> int:
+    """AC from the packet's IP TOS (the IP-DSCP→UP→AC path upstream
+    applies when no explicit TID rides the frame)."""
+    from tpudes.models.internet.ipv4 import Ipv4Header
+
+    ip = packet.FindHeader(Ipv4Header)
+    if ip is None:
+        return AcIndex.AC_BE
+    return UP_TO_AC.get((int(ip.tos) >> 5) & 0x7, AcIndex.AC_BE)
+
+
 class ChannelAccessManager:
     """DCF access (channel-access-manager.cc + txop.cc, folded): wait
     for DIFS of idle, count down backoff slots, freeze while busy."""
@@ -121,6 +156,10 @@ class ChannelAccessManager:
         self._phy = phy
         self._grant = grant_callback
         self._rng = UniformRandomVariable()
+        # contention parameters; EDCA sets per-AC values via set_params
+        self._aifs_us = DIFS_US
+        self._cw_min = CW_MIN
+        self._cw_max = CW_MAX
         self._cw = CW_MIN
         self._slots_left = 0
         self._pending = False
@@ -128,6 +167,14 @@ class ChannelAccessManager:
         self._slot_event = None
         self._nav_until = 0      # virtual carrier sense (802.11 NAV)
         phy.RegisterListener(self)
+
+    def set_params(self, aifs_us: int, cw_min: int, cw_max: int) -> None:
+        """EDCA access parameters (AIFS = SIFS + AIFSN·slot); clamps the
+        live CW into the new range."""
+        self._aifs_us = aifs_us
+        self._cw_min = cw_min
+        self._cw_max = cw_max
+        self._cw = min(max(self._cw, cw_min), cw_max)
 
     # --- Txop API ---
     def request_access(self, new_backoff: bool = True,
@@ -142,7 +189,7 @@ class ChannelAccessManager:
         self._pending = True
         if new_backoff:
             now = Simulator.NowTicks()
-            difs = MicroSeconds(DIFS_US).ticks
+            difs = MicroSeconds(self._aifs_us).ticks
             if (allow_immediate and self._phy.IsStateIdle()
                     and now - self._phy.idle_since() >= difs):
                 # medium already idle ≥ DIFS: grant immediately with no
@@ -159,15 +206,15 @@ class ChannelAccessManager:
         self._try_schedule()
 
     def notify_success(self) -> None:
-        self._cw = CW_MIN
+        self._cw = self._cw_min
 
     def notify_failure(self) -> int:
         """Double CW; returns the new CW."""
-        self._cw = min(2 * (self._cw + 1) - 1, CW_MAX)
+        self._cw = min(2 * (self._cw + 1) - 1, self._cw_max)
         return self._cw
 
     def reset_cw(self) -> None:
-        self._cw = CW_MIN
+        self._cw = self._cw_min
 
     def AssignStreams(self, stream: int) -> int:
         self._rng.SetStream(stream)
@@ -187,7 +234,7 @@ class ChannelAccessManager:
             return
         now = Simulator.NowTicks()
         idle_start = max(self._phy.busy_until(), self._nav_until, now)
-        wait = (idle_start - now) + MicroSeconds(DIFS_US).ticks
+        wait = (idle_start - now) + MicroSeconds(self._aifs_us).ticks
         self._slot_event = Simulator.GetImpl().Schedule(wait, self._tick, ())
 
     def _tick(self):
@@ -259,6 +306,14 @@ class WifiMac(Object):
             "(wifi-remote-station-manager.cc attribute; default off)",
             65535, field="rts_cts_threshold",
         )
+        .AddAttribute(
+            "QosSupported",
+            "EDCA: four AC queues with per-AC AIFS/CW, strict-priority "
+            "head selection (single shared exchange pipeline — parallel "
+            "per-AC countdowns/internal collisions are a documented "
+            "deviation from upstream's four Txops)",
+            False, field="qos_supported",
+        )
         .AddTraceSource("MacTx", "frame handed to DCF (packet)")
         .AddTraceSource("MacRx", "frame delivered up (packet)")
         .AddTraceSource("MacTxDrop", "tx dropped after retries (packet)")
@@ -273,7 +328,8 @@ class WifiMac(Object):
         self._device = None
         self._address = None
         self._station_manager = None
-        self._queue: list[tuple[Packet, WifiMacHeader]] = []
+        #: per-AC frame queues (non-QoS mode uses AC_BE only)
+        self._queue: dict[int, list] = {ac: [] for ac in range(4)}
         self._current: tuple[Packet, WifiMacHeader] | None = None
         self._access: ChannelAccessManager | None = None
         self._ack_timeout_event = None
@@ -315,14 +371,39 @@ class WifiMac(Object):
 
     def _enqueue_frame(self, packet: Packet, header: WifiMacHeader) -> None:
         self.mac_tx(packet)
-        self._queue.append((packet, header))
+        # one representation regardless of QosSupported (toggling the
+        # attribute mid-run must never strand or mangle queued frames):
+        # non-QoS traffic all rides AC_BE under legacy DCF parameters
+        if self.qos_supported:
+            ac = classify_ac(packet) if header.IsData() else AcIndex.AC_VO
+        else:
+            ac = AcIndex.AC_BE
+        self._queue[ac].append((packet, header))
         if self._current is None:
             self._dequeue()
 
+    def _pop_next_frame(self):
+        """Head-of-line frame by strict AC priority; arms the access
+        manager with the AC's EDCA parameters (QoS) or legacy DCF."""
+        for ac in (AcIndex.AC_VO, AcIndex.AC_VI, AcIndex.AC_BE, AcIndex.AC_BK):
+            if self._queue[ac]:
+                if self.qos_supported:
+                    aifsn, cw_min, cw_max = EDCA_PARAMS[ac]
+                    self._access.set_params(
+                        SIFS_US + aifsn * SLOT_US, cw_min, cw_max
+                    )
+                else:
+                    self._access.set_params(DIFS_US, CW_MIN, CW_MAX)
+                return self._queue[ac].pop(0)
+        return None
+
     def _dequeue(self):
-        if self._current is not None or not self._queue:
+        if self._current is not None:
             return
-        self._current = self._queue.pop(0)
+        frame = self._pop_next_frame()
+        if frame is None:
+            return
+        self._current = frame
         self._retries = 0
         self._access.request_access()
 

@@ -31,11 +31,12 @@ from tpudes.models.wifi.phy import WifiMode, YansWifiPhy, ppdu_duration_s
 def wifi_spectrum_model(center_hz: float, width_mhz: int,
                         band_hz: float = 5e6) -> SpectrumModel:
     """The channel as ``width/band`` equal sub-bands around the carrier
-    (wifi-spectrum-value-helper.cc's flat-in-band shape)."""
+    (wifi-spectrum-value-helper.cc's flat-in-band shape); the shared
+    cached factory gives identical PHYs one model uid."""
+    from tpudes.models.spectrum import uniform_spectrum_model
+
     n = max(int(width_mhz * 1e6 / band_hz), 1)
-    low = center_hz - width_mhz * 1e6 / 2.0
-    centers = [low + (i + 0.5) * band_hz for i in range(n)]
-    return SpectrumModel.FromCenters(centers, band_hz)
+    return uniform_spectrum_model(center_hz, n, band_hz)
 
 
 class _WifiSpectrumAdapter(SpectrumPhy):

@@ -34,6 +34,7 @@ class Socket(Object):
         super().__init__(**attributes)
         self._node = None
         self._errno = ERROR_NOTERROR
+        self._ip_tos = 0
         self._recv_callback = None
         self._connect_success_cb = None
         self._connect_fail_cb = None
@@ -87,6 +88,14 @@ class Socket(Object):
 
     def Listen(self) -> int:
         raise NotImplementedError
+
+    def SetIpTos(self, tos: int) -> None:
+        """IP TOS/DSCP for outgoing packets (socket.h SetIpTos) — the
+        QoS classification input (DSCP -> UP -> EDCA access category)."""
+        self._ip_tos = int(tos) & 0xFF
+
+    def GetIpTos(self) -> int:
+        return self._ip_tos
 
     def Send(self, packet, flags: int = 0) -> int:
         raise NotImplementedError
