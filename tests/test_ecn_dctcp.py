@@ -1,0 +1,131 @@
+"""ECN + DCTCP tests — upstream tcp-ecn-test / tcp-dctcp-test strategy:
+marking instead of dropping, ECE/CWR echo mechanics, DCTCP's
+fraction-scaled response keeping queues shallow at full throughput."""
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.models.internet.tcp import TcpL4Protocol
+from tpudes.models.internet.tcp_congestion import TcpDctcp, TcpSocketState
+from tpudes.models.traffic_control import RedQueueDisc, TrafficControlHelper
+from tpudes.scenarios import build_dumbbell
+
+
+def _ecn_dumbbell(variant, n_flows=3, min_th=5.0, max_th=15.0,
+                  max_size=1000):
+    db, sinks = build_dumbbell(
+        n_flows, 4.0, variant=variant, bottleneck_rate="5Mbps"
+    )
+    # both ends must speak ECN: senders (left leaves) get it from the
+    # variant/UseEcn, the sinks' listener forks inherit the sink node's
+    for i in range(n_flows):
+        db.GetLeft(i).GetObject(TcpL4Protocol).SetAttribute("UseEcn", True)
+        db.GetRight(i).GetObject(TcpL4Protocol).SetAttribute("UseEcn", True)
+    tch = TrafficControlHelper()
+    # deep hard cap: the AQM governs by marking, never by tail loss
+    # (the slow-start overshoot would otherwise hit the cap)
+    tch.SetRootQueueDisc(
+        "tpudes::RedQueueDisc", MinTh=min_th, MaxTh=max_th,
+        MaxSize=max_size, LinkBandwidth="5Mbps", UseEcn=True,
+        UseHardDrop=False,  # the upstream DCTCP configuration
+    )
+    (qdisc,) = tch.Install(db.GetBottleneckDevices().Get(0))
+    return db, sinks, qdisc
+
+
+def test_red_marks_ect_instead_of_dropping():
+    db, sinks, qdisc = _ecn_dumbbell("TcpNewReno")
+    Simulator.Stop(Seconds(4.0))
+    Simulator.Run()
+    tput = sum(s.GetTotalRx() for s in sinks) * 8 / 3.9 / 1e6
+    assert tput > 3.0
+    assert qdisc.stats_marked > 0, "ECT traffic must be CE-marked"
+    assert qdisc.stats_early_drops == 0, "marking replaces early drops"
+
+
+def test_ecn_reduces_cwnd_without_losses():
+    """The classic-ECN sender must respond to ECE with a window
+    reduction even though no packet was ever lost."""
+    db, sinks, qdisc = _ecn_dumbbell("TcpNewReno", n_flows=1)
+    events = []
+    # the bulk sender's socket exists after the app starts; sample cwnd
+    from tpudes.models.applications import BulkSendApplication
+
+    def sample():
+        app = db.GetLeft(0).GetApplication(0)
+        if isinstance(app, BulkSendApplication) and app._socket is not None:
+            events.append(app._socket._tcb.cwnd)
+        Simulator.Schedule(Seconds(0.05), sample)
+
+    Simulator.Schedule(Seconds(0.3), sample)
+    Simulator.Stop(Seconds(4.0))
+    Simulator.Run()
+    assert qdisc.stats_marked > 0
+    assert qdisc.stats_dropped == 0, "no real losses on this path"
+    # cwnd must have come back DOWN at least once purely from ECE
+    drops_in_cwnd = sum(
+        1 for a, b in zip(events, events[1:]) if b < a * 0.8
+    )
+    assert drops_in_cwnd >= 1, events
+
+
+def test_dctcp_keeps_queue_shallow_at_full_throughput():
+    db, sinks, qdisc = _ecn_dumbbell("TcpDctcp", min_th=5.0, max_th=15.0)
+    Simulator.Stop(Seconds(4.0))
+    Simulator.Run()
+    tput_dctcp = sum(s.GetTotalRx() for s in sinks) * 8 / 3.9 / 1e6
+
+    from tpudes.core.world import reset_world
+
+    reset_world()
+    # same AQM, loss-based Reno WITHOUT ECN for comparison
+    db2, sinks2 = build_dumbbell(
+        3, 4.0, variant="TcpNewReno", bottleneck_rate="5Mbps"
+    )
+    tch = TrafficControlHelper()
+    tch.SetRootQueueDisc(
+        "tpudes::RedQueueDisc", MinTh=5.0, MaxTh=15.0, MaxSize=100,
+        LinkBandwidth="5Mbps",
+    )
+    (qdisc2,) = tch.Install(db2.GetBottleneckDevices().Get(0))
+    Simulator.Stop(Seconds(4.0))
+    Simulator.Run()
+    tput_reno = sum(s.GetTotalRx() for s in sinks2) * 8 / 3.9 / 1e6
+
+    assert tput_dctcp > 3.0, f"DCTCP collapsed: {tput_dctcp:.2f}"
+    assert tput_dctcp >= tput_reno * 0.8
+    assert qdisc.stats_marked > 0 and qdisc.stats_dropped == 0
+    assert qdisc2.stats_dropped > 0, "the comparison baseline drops"
+
+
+def test_dctcp_alpha_tracks_marking_fraction():
+    ops = TcpDctcp()
+    tcb = TcpSocketState(segment_size=1000, initial_cwnd_segments=10)
+    assert ops._alpha == 1.0
+    # 10 windows with no marks: alpha decays toward 0
+    for _ in range(10):
+        ops.PktsAcked(tcb, 10, 0.01)
+    assert ops._alpha < 0.6
+    # fully marked windows drive it back toward 1 (g=1/16 EWMA)
+    for _ in range(40):
+        ops.EceReceived(tcb, 10)
+        ops.PktsAcked(tcb, 10, 0.01)
+    assert ops._alpha > 0.9
+    # reduction scales with alpha: near-1 alpha ≈ halving
+    assert ops.GetSsThresh(tcb, 0) == pytest.approx(
+        tcb.cwnd * (1 - ops._alpha / 2), abs=1000
+    )
+
+
+def test_non_ect_traffic_is_still_dropped_by_ecn_red():
+    from tpudes.models.traffic_control import QueueDiscItem, _mark_ce
+    from tpudes.network.packet import Packet
+    from tpudes.models.internet.ipv4 import Ipv4Header
+
+    p = Packet(100)
+    p.AddHeader(Ipv4Header(tos=0x00))   # not ECN-capable
+    assert not _mark_ce(p)
+    p2 = Packet(100)
+    p2.AddHeader(Ipv4Header(tos=0x02))  # ECT(0)
+    assert _mark_ce(p2)
+    assert p2.PeekHeader(Ipv4Header).tos & 0x3 == 0x3

@@ -38,6 +38,8 @@ class TcpHeader(Header):
     RST = 0x04
     PSH = 0x08
     ACK = 0x10
+    ECE = 0x40
+    CWR = 0x80
 
     def __init__(self, source_port=0, destination_port=0, seq=0, ack=0, flags=0, window=65535):
         self.source_port = source_port
@@ -80,6 +82,13 @@ class TcpL4Protocol(Object):
             "TcpNewReno",
             field="socket_type",
         )
+        .AddAttribute(
+            "UseEcn",
+            "new sockets mark data ECT and respond to ECE (RFC 3168); "
+            "DCTCP sockets enable it implicitly",
+            False,
+            field="use_ecn",
+        )
     )
 
     def __init__(self, **attributes):
@@ -99,7 +108,11 @@ class TcpL4Protocol(Object):
             variant = self.socket_type
         if isinstance(variant, str):
             variant = TCP_VARIANTS[variant.replace("tpudes::", "").replace("ns3::", "")]
-        sock.SetCongestionControl(variant())
+        ops = variant()
+        sock.SetCongestionControl(ops)
+        sock.use_ecn = bool(self.use_ecn) or getattr(
+            ops, "REQUIRES_ECN", False
+        )
         self._sockets.append(sock)
         return sock
 
@@ -107,10 +120,10 @@ class TcpL4Protocol(Object):
         header = TcpHeader()  # placeholder: sockets add their own header
         raise NotImplementedError("sockets serialize their own segments")
 
-    def SendPacket(self, packet, tcp_header, saddr, daddr, route=None):
+    def SendPacket(self, packet, tcp_header, saddr, daddr, route=None, tos=0):
         packet.AddHeader(tcp_header)
         ipv4 = self._node.GetObject(Ipv4L3Protocol)
-        ipv4.Send(packet, saddr, daddr, self.PROT_NUMBER, route)
+        ipv4.Send(packet, saddr, daddr, self.PROT_NUMBER, route, tos=tos)
 
     def Receive(self, packet, ip_header, incoming_interface):
         header = packet.RemoveHeader(TcpHeader)
@@ -187,6 +200,12 @@ class TcpSocketBase(Socket):
         self._fin_rcvd_seq = None
         self._sent_fin = False
         self._connected = False
+        # ECN (RFC 3168 data path; handshake negotiation elided — both
+        # ends opt in via the UseEcn attribute)
+        self.use_ecn = False
+        self._ece_to_send = False   # CE seen: echo ECE until CWR
+        self._ecn_cwr_seq = 0       # once-per-window response gate
+        self._send_cwr = False      # next data segment carries CWR
 
     # --- setup ---
     def SetCongestionControl(self, ops: TcpCongestionOps) -> None:
@@ -307,6 +326,11 @@ class TcpSocketBase(Socket):
         )
 
     def _send_flags(self, flags, seq=None, size=0):
+        if (
+            self.use_ecn and self._ece_to_send
+            and not flags & (TcpHeader.SYN | TcpHeader.FIN)
+        ):
+            flags |= TcpHeader.ECE
         header = self._header(flags, seq=seq)
         packet = Packet(size)
         self.tx(packet, header)
@@ -343,11 +367,17 @@ class TcpSocketBase(Socket):
                 "flags": TcpHeader.ACK,
             }
             self._snd_nxt += size
-            header = self._header(TcpHeader.ACK, seq=seq)
+            flags = TcpHeader.ACK
+            if self.use_ecn and self._send_cwr:
+                flags |= TcpHeader.CWR
+                self._send_cwr = False
+            header = self._header(flags, seq=seq)
             packet = Packet(size)
             self.tx(packet, header)
             self._tcp.SendPacket(
-                packet, header, self._endpoint.local_addr, self._endpoint.peer_addr
+                packet, header, self._endpoint.local_addr,
+                self._endpoint.peer_addr,
+                tos=0b10 if self.use_ecn else 0,  # ECT(0)
             )
             self._schedule_rto(only_if_unset=True)
         if (
@@ -371,8 +401,10 @@ class TcpSocketBase(Socket):
         header = self._header(flags, seq=seq)
         size = 0 if flags & (TcpHeader.SYN | TcpHeader.FIN) else seg["size"]
         packet = Packet(size)
+        # RFC 3168 §6.1.5: retransmissions MUST NOT be ECT — a CE mark
+        # on a retransmit would mask persistent congestion as a mere echo
         self._tcp.SendPacket(
-            packet, header, self._endpoint.local_addr, self._endpoint.peer_addr
+            packet, header, self._endpoint.local_addr, self._endpoint.peer_addr,
         )
 
     # --- RTO ---
@@ -423,6 +455,11 @@ class TcpSocketBase(Socket):
     # --- rx ---
     def _receive(self, packet, header: TcpHeader, ip_header):
         self._peer_rwnd = header.window
+        if self.use_ecn and ip_header is not None:
+            if packet.GetSize() > 0 and (ip_header.tos & 0x3) == 0x3:
+                self._ece_to_send = True   # CE-marked data arrived
+            if header.flags & TcpHeader.CWR:
+                self._ece_to_send = False  # sender responded
         if self._state == self.LISTEN:
             if header.flags & TcpHeader.SYN:
                 self._handle_listen_syn(packet, header, ip_header)
@@ -464,6 +501,7 @@ class TcpSocketBase(Socket):
         fork = self._tcp.CreateSocket()
         fork._cong = type(self._cong)()
         fork.SetCongestionControl(fork._cong)
+        fork.use_ecn = self.use_ecn
         fork.segment_size = self.segment_size
         fork._tcb = TcpSocketState(self.segment_size, self.initial_cwnd)
         fork._endpoint = self._tcp._demux.Allocate4(
@@ -500,7 +538,31 @@ class TcpSocketBase(Socket):
             self._snd_una = ack
             self._backoff = 0
             self._dupack_count = 0
+            if self.use_ecn and header.flags & TcpHeader.ECE and hasattr(
+                self._cong, "EceReceived"
+            ):
+                # marks credit the SAME observation window as the acked
+                # bytes — EceReceived must precede PktsAcked's window
+                # roll or the fraction can exceed 1
+                self._cong.EceReceived(self._tcb, segments_acked)
             self._cong.PktsAcked(self._tcb, segments_acked, self._tcb.last_rtt_s)
+            if self.use_ecn and header.flags & TcpHeader.ECE:
+                if self._snd_una > self._ecn_cwr_seq and self._tcb.cong_state in (
+                    TcpSocketState.CA_OPEN, TcpSocketState.CA_DISORDER
+                ):
+                    # one congestion response per window (RFC 3168)
+                    old = self._tcb.ssthresh
+                    self._tcb.ssthresh = self._cong.GetSsThresh(
+                        self._tcb, self._snd_nxt - self._snd_una
+                    )
+                    self.slow_start_threshold(old, self._tcb.ssthresh)
+                    old_cwnd = self._tcb.cwnd
+                    self._tcb.cwnd = max(
+                        self._tcb.ssthresh, self._tcb.segment_size
+                    )
+                    self.congestion_window(old_cwnd, self._tcb.cwnd)
+                    self._ecn_cwr_seq = self._snd_nxt
+                    self._send_cwr = True
             if self._tcb.cong_state == TcpSocketState.CA_RECOVERY:
                 if ack >= self._recover:  # full ack: leave recovery
                     old = self._tcb.cwnd
@@ -532,7 +594,10 @@ class TcpSocketBase(Socket):
             ack == self._snd_una
             and self._snd_nxt > self._snd_una
             and payload_size == 0
-            and header.flags == TcpHeader.ACK
+            # ECN echo bits ride ordinary acks — they must not disqualify
+            # the dupack count (or fast retransmit dies under marking)
+            and header.flags & ~(TcpHeader.ECE | TcpHeader.CWR)
+            == TcpHeader.ACK
         ):
             self._dupack_count += 1
             if self._tcb.cong_state == TcpSocketState.CA_RECOVERY:
@@ -549,6 +614,9 @@ class TcpSocketBase(Socket):
                 self._tcb.cong_state = TcpSocketState.CA_RECOVERY
                 self._cong.CongestionStateSet(self._tcb, TcpSocketState.CA_RECOVERY)
                 self._recover = self._snd_nxt
+                # RFC 3168 §6.1.2: the loss reduction covers this window
+                # — an ECE landing mid-recovery must not reduce again
+                self._ecn_cwr_seq = self._snd_nxt
                 self._retransmit_seq(self._snd_una)
 
     def _handle_all_acked(self):

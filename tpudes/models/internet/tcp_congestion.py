@@ -651,6 +651,49 @@ class TcpBbr(TcpCongestionOps):
         return max(int(self._bdp(tcb)), 4 * tcb.segment_size)
 
 
+class TcpDctcp(TcpLinuxReno):
+    """DCTCP (RFC 8257; tcp-dctcp.cc): the congestion response scales
+    with the FRACTION of CE-marked bytes — alpha ← (1-g)·alpha + g·F
+    per window, reduction factor (1 - alpha/2) — so a shallow ECN
+    marking threshold yields tiny queues at full throughput.  Requires
+    ECN (REQUIRES_ECN turns the socket's ECN machinery on) and an
+    ECN-marking AQM (RedQueueDisc UseEcn) at the bottleneck."""
+
+    REQUIRES_ECN = True
+
+    tid = (
+        TypeId("tpudes::TcpDctcp")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpDctcp(**kw))
+        .AddAttribute("DctcpShiftG", "alpha EWMA gain", 0.0625, field="g")
+        .AddAttribute("DctcpAlphaOnInit", "initial alpha", 1.0,
+                      field="alpha_init")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._alpha = float(self.alpha_init)
+        self._acked_bytes = 0
+        self._marked_bytes = 0
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        self._acked_bytes += segments_acked * tcb.segment_size
+        if self._acked_bytes >= tcb.cwnd:   # one observation window
+            frac = self._marked_bytes / max(self._acked_bytes, 1)
+            self._alpha = (1.0 - self.g) * self._alpha + self.g * frac
+            self._acked_bytes = 0
+            self._marked_bytes = 0
+
+    def EceReceived(self, tcb, segments_acked) -> None:
+        self._marked_bytes += segments_acked * tcb.segment_size
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        return max(
+            int(tcb.cwnd * (1.0 - self._alpha / 2.0)),
+            2 * tcb.segment_size,
+        )
+
+
 TCP_VARIANTS = {
     "TcpNewReno": TcpNewReno,
     "TcpCubic": TcpCubic,
@@ -664,4 +707,5 @@ TCP_VARIANTS = {
     "TcpIllinois": TcpIllinois,
     "TcpHybla": TcpHybla,
     "TcpBbr": TcpBbr,
+    "TcpDctcp": TcpDctcp,
 }

@@ -17,8 +17,9 @@ flight), so the backlog lives in the qdisc where RED/CoDel can see it,
 not in the device's DropTail.  The drain re-arms off the device's
 PhyTxEnd trace (the DeviceQueueInterface wake analog).
 
-ECN marking is not modeled this round (RED drops where it would mark);
-the seam is QueueDisc._drop vs a future _mark.
+RED marks ECT packets CE instead of early-dropping when its ``UseEcn``
+attribute is on (RFC 3168; forced-region and hard-cap losses still
+drop, the UseHardDrop parity) — the DCTCP test pins the behavior.
 """
 
 from __future__ import annotations
@@ -91,6 +92,24 @@ class QueueDisc(Object):
         raise NotImplementedError
 
 
+def _mark_ce(packet) -> bool:
+    """Set the CE codepoint on an ECT packet's IP header; returns False
+    for non-ECT traffic (which must be dropped instead, RFC 3168)."""
+    import copy
+
+    from tpudes.models.internet.ipv4 import Ipv4Header
+
+    front = packet.PeekHeader(Ipv4Header)
+    if front is None or (front.tos & 0x3) == 0:
+        return False  # not ECN-capable transport
+    # COW discipline: never mutate a header other holders may share
+    packet.RemoveHeader(Ipv4Header)
+    marked = copy.copy(front)
+    marked.tos = (marked.tos & ~0x3) | 0x3
+    packet.AddHeader(marked)
+    return True
+
+
 class FifoQueueDisc(QueueDisc):
     """fifo-queue-disc.{h,cc}: plain tail-drop FIFO."""
 
@@ -129,6 +148,19 @@ class RedQueueDisc(QueueDisc):
         )
         .AddAttribute("MeanPktSize", "for the idle-time decay", 1000,
                       field="mean_pkt_size")
+        .AddAttribute(
+            "UseEcn",
+            "mark ECT packets CE instead of early-dropping (RFC 3168; "
+            "forced drops at the hard limit still drop)",
+            False, field="use_ecn",
+        )
+        .AddAttribute(
+            "UseHardDrop",
+            "drop (even ECT) in the forced region avg >= MaxTh "
+            "(red-queue-disc.cc parity; DCTCP setups turn this off so "
+            "marking alone governs)",
+            True, field="use_hard_drop",
+        )
     )
 
     def __init__(self, **attributes):
@@ -147,6 +179,7 @@ class RedQueueDisc(QueueDisc):
         self._rng = UniformRandomVariable()
         self.stats_early_drops = 0
         self.stats_forced_drops = 0
+        self.stats_marked = 0
 
     def DoEnqueue(self, item) -> bool:
         # Floyd's idle correction: while the queue sat empty the average
@@ -161,6 +194,8 @@ class RedQueueDisc(QueueDisc):
             self.stats_forced_drops += 1
             return False
         drop = False
+        hard = False  # forced region: drop even ECT (UseHardDrop parity
+        # — marking there would let the standing queue run to the cap)
         if self._avg >= self.max_th:
             if self.gentle and self._avg < 2 * self.max_th:
                 p = max_p + (self._avg - self.max_th) / self.max_th * (
@@ -169,6 +204,7 @@ class RedQueueDisc(QueueDisc):
                 drop = self._rng.GetValue(0.0, 1.0) < p
             else:
                 drop = True
+                hard = bool(self.use_hard_drop)
         elif self._avg > self.min_th:
             p_b = max_p * (self._avg - self.min_th) / (
                 self.max_th - self.min_th
@@ -181,9 +217,13 @@ class RedQueueDisc(QueueDisc):
             self._count = 0
         if drop:
             self._count = 0
-            self.stats_early_drops += 1
-            return False
-        self._count += 1
+            if not hard and self.use_ecn and _mark_ce(item.packet):
+                self.stats_marked += 1
+            else:
+                self.stats_early_drops += 1
+                return False
+        else:
+            self._count += 1
         self._items.append(item)
         return True
 
