@@ -79,7 +79,7 @@ def test_codel_idle_below_target_never_drops():
 
 @pytest.mark.parametrize("disc,kw", [
     ("tpudes::RedQueueDisc",
-     dict(MinTh=5.0, MaxTh=15.0, MaxSize=25, LinkBandwidth="5Mbps")),
+     dict(MinTh=10.0, MaxTh=30.0, MaxSize=60, LinkBandwidth="5Mbps")),
     ("tpudes::CoDelQueueDisc", dict(MaxSize=200)),
 ])
 def test_qdisc_on_dumbbell_keeps_throughput_and_sheds(disc, kw):

@@ -22,6 +22,12 @@ def reset_world() -> None:
     GlobalValue.ResetAll()
     RngSeedManager.Reset()
     Names.Clear()
+    # Config.SetDefault overrides are process-global too — a leaked
+    # default (e.g. a test's buffer sizing) silently reshapes every
+    # later simulation
+    from tpudes.core.object import _DEFAULT_OVERRIDES
+
+    _DEFAULT_OVERRIDES.clear()
     # lazily-imported registries: only touch what the process loaded
     mod = sys.modules.get("tpudes.network.node")
     if mod is not None:

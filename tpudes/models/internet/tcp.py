@@ -48,6 +48,12 @@ class TcpHeader(Header):
         self.ack = ack
         self.flags = flags
         self.window = window
+        # virtual TCP options (tcp-option-sack / tcp-option-winscale):
+        # carried as structured fields, not serialized into the fixed
+        # 20-byte wire form (in-sim packets are structured; the
+        # emulation boundary would need real option encoding)
+        self.sack_blocks: list = []     # [(start, end)) received runs
+        self.window_scale = None        # shift count, SYN/SYN+ACK only
 
     def GetSerializedSize(self) -> int:
         return 20
@@ -165,6 +171,10 @@ class TcpSocketBase(Socket):
         .AddAttribute("RcvBufSize", "rx buffer (bytes)", 131072, field="rcv_buf_size")
         .AddAttribute("MinRto", "minimum RTO (s)", 1.0, field="min_rto_s")
         .AddAttribute("InitialRto", "initial RTO (s)", 1.0, field="initial_rto_s")
+        .AddAttribute("Sack", "selective acknowledgments (RFC 2018)", True,
+                      field="sack")
+        .AddAttribute("WindowScaling", "window scale option (RFC 7323)",
+                      True, field="window_scaling")
         .AddTraceSource("CongestionWindow", "(old, new)")
         .AddTraceSource("SlowStartThreshold", "(old, new)")
         .AddTraceSource("State", "(old, new)")
@@ -200,6 +210,14 @@ class TcpSocketBase(Socket):
         self._fin_rcvd_seq = None
         self._sent_fin = False
         self._connected = False
+        # SACK (RFC 2018): receiver advertises out-of-order runs,
+        # sender skips retransmitting SACKed segments
+        self._sacked: set[int] = set()
+        self._retx_this_recovery: set[int] = set()
+        # window scaling (RFC 7323): negotiated on SYN/SYN+ACK; shifts
+        # apply to every non-SYN window field thereafter
+        self._rcv_wscale_shift = 0     # what we apply to our adverts
+        self._snd_wscale_shift = 0     # what the peer applies to theirs
         # ECN (RFC 3168 data path; handshake negotiation elided — both
         # ends opt in via the UseEcn attribute)
         self.use_ecn = False
@@ -322,8 +340,18 @@ class TcpSocketBase(Socket):
             seq=seq if seq is not None else self._snd_nxt,
             ack=ack if ack is not None else self._rcv_nxt,
             flags=flags,
-            window=min(self.rcv_buf_size - self._rx_available, 65535),
+            window=min(
+                (self.rcv_buf_size - self._rx_available)
+                >> self._rcv_wscale_shift,
+                65535,
+            ),
         )
+
+    def _my_wscale_proposal(self) -> int:
+        shift = 0
+        while (self.rcv_buf_size >> shift) > 65535 and shift < 14:
+            shift += 1
+        return shift
 
     def _send_flags(self, flags, seq=None, size=0):
         if (
@@ -332,6 +360,15 @@ class TcpSocketBase(Socket):
         ):
             flags |= TcpHeader.ECE
         header = self._header(flags, seq=seq)
+        if flags & TcpHeader.SYN and self.window_scaling:
+            if not flags & TcpHeader.ACK or getattr(
+                self, "_peer_offered_wscale", False
+            ):
+                # RFC 7323: a SYN+ACK may carry the option only when the
+                # SYN did
+                header.window_scale = self._my_wscale_proposal()
+        if self.sack and self._ooo and not flags & TcpHeader.SYN:
+            header.sack_blocks = self._sack_block_list()
         packet = Packet(size)
         self.tx(packet, header)
         self._tcp.SendPacket(
@@ -390,6 +427,25 @@ class TcpSocketBase(Socket):
                 self.FIN_WAIT_1 if self._state == self.ESTABLISHED else self.LAST_ACK
             )
 
+    def _sack_retransmit_holes(self):
+        """RFC 2018 recovery: every unSACKed segment below the highest
+        SACKed byte is a known hole — retransmit each once per recovery
+        (NewReno fills one hole per RTT; this fills them all)."""
+        if not self.sack or not self._sacked:
+            return
+        horizon = max(
+            s + self._segments[s]["size"]
+            for s in self._sacked if s in self._segments
+        ) if any(s in self._segments for s in self._sacked) else 0
+        for seq in sorted(self._segments):
+            if seq >= horizon:
+                break
+            seg = self._segments[seq]
+            if seq in self._sacked or seq in self._retx_this_recovery:
+                continue
+            self._retx_this_recovery.add(seq)
+            self._retransmit_seq(seq)
+
     def _retransmit_seq(self, seq):
         seg = self._segments.get(seq)
         if seg is None:
@@ -399,6 +455,11 @@ class TcpSocketBase(Socket):
         self.retransmit(seq)
         flags = seg.get("flags", TcpHeader.ACK)
         header = self._header(flags, seq=seq)
+        if flags & TcpHeader.SYN and self.window_scaling:
+            if not flags & TcpHeader.ACK or getattr(
+                self, "_peer_offered_wscale", False
+            ):
+                header.window_scale = self._my_wscale_proposal()
         size = 0 if flags & (TcpHeader.SYN | TcpHeader.FIN) else seg["size"]
         packet = Packet(size)
         # RFC 3168 §6.1.5: retransmissions MUST NOT be ECT — a CE mark
@@ -438,6 +499,7 @@ class TcpSocketBase(Socket):
             self._tcb.cong_state = TcpSocketState.CA_LOSS
             self._cong.CongestionStateSet(self._tcb, TcpSocketState.CA_LOSS)
             self._dupack_count = 0
+            self._retx_this_recovery = set()  # RTO: new repair episode
         self._retransmit_seq(self._snd_una)
         self._schedule_rto()
 
@@ -453,8 +515,36 @@ class TcpSocketBase(Socket):
         self._tcb.min_rtt_s = min(self._tcb.min_rtt_s, rtt_s)
 
     # --- rx ---
+    def _sack_block_list(self):
+        """Up to 3 contiguous received runs above rcv_nxt (RFC 2018)."""
+        runs = []
+        for seq in sorted(self._ooo):
+            size = self._ooo[seq]
+            if runs and seq == runs[-1][1]:
+                runs[-1] = (runs[-1][0], seq + size)
+            else:
+                runs.append((seq, seq + size))
+        return runs[:3]
+
     def _receive(self, packet, header: TcpHeader, ip_header):
-        self._peer_rwnd = header.window
+        if header.flags & TcpHeader.SYN:
+            # RFC 7323: SYN windows are never scaled; scaling applies
+            # only when BOTH ends carried the option
+            self._peer_rwnd = header.window
+            self._peer_offered_wscale = header.window_scale is not None
+            if self.window_scaling and self._peer_offered_wscale:
+                self._snd_wscale_shift = header.window_scale
+                self._rcv_wscale_shift = self._my_wscale_proposal()
+            else:
+                self._snd_wscale_shift = 0
+                self._rcv_wscale_shift = 0
+        else:
+            self._peer_rwnd = header.window << self._snd_wscale_shift
+        if self.sack and header.sack_blocks:
+            for start, end in header.sack_blocks:
+                for seq, seg in self._segments.items():
+                    if start <= seq and seq + seg["size"] <= end:
+                        self._sacked.add(seq)
         if self.use_ecn and ip_header is not None:
             if packet.GetSize() > 0 and (ip_header.tos & 0x3) == 0x3:
                 self._ece_to_send = True   # CE-marked data arrived
@@ -503,6 +593,14 @@ class TcpSocketBase(Socket):
         fork.SetCongestionControl(fork._cong)
         fork.use_ecn = self.use_ecn
         fork.segment_size = self.segment_size
+        # negotiated/configured option state must follow the connection
+        fork.sack = self.sack
+        fork.window_scaling = self.window_scaling
+        fork.rcv_buf_size = self.rcv_buf_size
+        fork.snd_buf_size = self.snd_buf_size
+        fork._peer_offered_wscale = getattr(self, "_peer_offered_wscale", False)
+        fork._snd_wscale_shift = self._snd_wscale_shift
+        fork._rcv_wscale_shift = self._rcv_wscale_shift
         fork._tcb = TcpSocketState(self.segment_size, self.initial_cwnd)
         fork._endpoint = self._tcp._demux.Allocate4(
             ip_header.destination, self._endpoint.local_port,
@@ -536,6 +634,7 @@ class TcpSocketBase(Socket):
                         self._rtt_sample(now_s - seg["tx_ts"])
                     del self._segments[seq]
             self._snd_una = ack
+            self._sacked = {s for s in self._sacked if s >= ack}
             self._backoff = 0
             self._dupack_count = 0
             if self.use_ecn and header.flags & TcpHeader.ECE and hasattr(
@@ -565,6 +664,7 @@ class TcpSocketBase(Socket):
                     self._send_cwr = True
             if self._tcb.cong_state == TcpSocketState.CA_RECOVERY:
                 if ack >= self._recover:  # full ack: leave recovery
+                    self._retx_this_recovery.clear()
                     old = self._tcb.cwnd
                     self._tcb.cwnd = min(self._tcb.ssthresh, self._snd_nxt - self._snd_una + self._tcb.segment_size)
                     self.congestion_window(old, self._tcb.cwnd)
@@ -572,6 +672,7 @@ class TcpSocketBase(Socket):
                     self._cong.CongestionStateSet(self._tcb, TcpSocketState.CA_OPEN)
                 else:  # partial ack: retransmit next hole (NewReno)
                     self._retransmit_seq(self._snd_una)
+                    self._sack_retransmit_holes()
             elif self._tcb.cong_state == TcpSocketState.CA_LOSS:
                 self._tcb.cong_state = TcpSocketState.CA_OPEN
                 self._cong.CongestionStateSet(self._tcb, TcpSocketState.CA_OPEN)
@@ -614,10 +715,12 @@ class TcpSocketBase(Socket):
                 self._tcb.cong_state = TcpSocketState.CA_RECOVERY
                 self._cong.CongestionStateSet(self._tcb, TcpSocketState.CA_RECOVERY)
                 self._recover = self._snd_nxt
+                self._retx_this_recovery = set()  # fresh episode
                 # RFC 3168 §6.1.2: the loss reduction covers this window
                 # — an ECE landing mid-recovery must not reduce again
                 self._ecn_cwr_seq = self._snd_nxt
                 self._retransmit_seq(self._snd_una)
+                self._sack_retransmit_holes()
 
     def _handle_all_acked(self):
         if self._state == self.FIN_WAIT_1 and self._sent_fin:
