@@ -88,6 +88,12 @@ def lower_lte_sm(helper, sim_time_s: float) -> LteSmProgram:
             "SM engine models full-band reuse-1 only — run the scalar "
             "engine for frequency-reuse studies"
         )
+    if ctrl.handover_algorithm is not None and ctrl.x2_enabled:
+        raise UnliftableLteScenarioError(
+            "handover is armed (X2 + algorithm); the SM engine models a "
+            "fixed serving map — a mid-run handover (possible even with "
+            "static UEs attached off-best) would silently diverge"
+        )
     for enb in ctrl.enbs:
         for ctx in enb.rrc.ues.values():
             if not ctx.bearers:
