@@ -203,6 +203,42 @@ def test_unmodeled_cross_traffic_is_rejected():
         lower_as_flows(2.0)
 
 
+def test_flows_riding_other_technologies_are_rejected():
+    """A UDP flow whose path crosses a non-p2p technology (here: LTE
+    bearers behind the EPC) must NOT lift as the p2p backhaul graph
+    (r4: the generic backstop silently swallowed an LTE scenario)."""
+    from tpudes.helper.applications import UdpClientHelper, UdpServerHelper
+    from tpudes.helper.containers import NodeContainer
+    from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+    from tpudes.helper.point_to_point import PointToPointHelper
+    from tpudes.core import Seconds
+
+    # two p2p islands: remote--gw, and ue alone with an address the
+    # client can name but no p2p path to reach it
+    a = NodeContainer()
+    a.Create(2)
+    b = NodeContainer()
+    b.Create(2)
+    InternetStackHelper().Install(a)
+    InternetStackHelper().Install(b)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "10Mbps")
+    p2p.SetChannelAttribute("Delay", "1ms")
+    Ipv4AddressHelper("10.1.0.0", "255.255.255.0").Assign(
+        p2p.Install(a.Get(0), a.Get(1))
+    )
+    ifc_b = Ipv4AddressHelper("10.2.0.0", "255.255.255.0").Assign(
+        p2p.Install(b.Get(0), b.Get(1))
+    )
+    server = UdpServerHelper(9)
+    server.Install(b.Get(1)).Start(Seconds(0.0))
+    client = UdpClientHelper(ifc_b.GetAddress(1), 9)
+    client.SetAttribute("Interval", Seconds(0.01))
+    client.Install(a.Get(0)).Start(Seconds(0.1))
+    with pytest.raises(UnliftableAsError, match="not connected"):
+        lower_as_flows(1.0)
+
+
 def test_lowering_rejects_empty_and_lift_discovers():
     from tpudes.parallel.lift import lift
 

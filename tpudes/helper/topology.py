@@ -30,6 +30,25 @@ import math
 import numpy as np
 
 
+def component_labels(n: int, edges) -> np.ndarray:
+    """(n,) connected-component root label per vertex (path-halving
+    union-find) — the one shared implementation (generator connectivity,
+    BRITE component chaining, and lowering guards all use it)."""
+    parent = np.arange(n)
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for u, v in edges:
+        ru, rv = find(int(u)), find(int(v))
+        if ru != rv:
+            parent[ru] = rv
+    return np.asarray([find(i) for i in range(n)])
+
+
 class BriteGraph:
     """Plain arrays: ``edges`` (E, 2) int32, ``delay_s`` (E,) float64,
     ``rate_bps`` (E,) float64, ``pos`` (N, 2) float64."""
@@ -46,21 +65,8 @@ class BriteGraph:
         return int(self.edges.shape[0])
 
     def is_connected(self) -> bool:
-        # union-find over the edge list
-        parent = np.arange(self.n)
-
-        def find(x):
-            while parent[x] != x:
-                parent[x] = parent[parent[x]]
-                x = parent[x]
-            return x
-
-        for u, v in self.edges:
-            ru, rv = find(u), find(v)
-            if ru != rv:
-                parent[ru] = rv
-        root = find(0)
-        return all(find(i) == root for i in range(self.n))
+        labels = component_labels(self.n, self.edges)
+        return bool((labels == labels[0]).all())
 
 
 def barabasi_albert(n: int, m: int, rng: np.random.Generator) -> np.ndarray:
@@ -131,27 +137,11 @@ def waxman(
         np.concatenate(blocks) if blocks else np.empty((0, 2), np.int32)
     )
 
-    # connect components (BRITE post-pass): union-find, chain roots
-    parent = np.arange(n)
-
-    def find(x):
-        while parent[x] != x:
-            parent[x] = parent[parent[x]]
-            x = parent[x]
-        return x
-
-    for u, v in edges:
-        ru, rv = find(u), find(v)
-        if ru != rv:
-            parent[ru] = rv
-    extra = []
-    prev_root = None
-    for i in range(n):
-        if find(i) == i:
-            if prev_root is not None:
-                extra.append((prev_root, i))
-                parent[find(prev_root)] = find(i)
-            prev_root = i
+    # connect components (BRITE post-pass): chain one representative of
+    # each component to the previous one
+    labels = component_labels(n, edges)
+    roots = sorted(set(int(r) for r in labels))
+    extra = [(a, b) for a, b in zip(roots, roots[1:])]
     if extra:
         edges = np.concatenate([edges, np.asarray(extra, np.int32)])
     return pos, edges

@@ -85,7 +85,13 @@ def lower_as_flows(sim_end_s: float) -> AsFlowsProgram:
         for d in range(node.GetNDevices()):
             dev = node.GetDevice(d)
             if not isinstance(dev, PointToPointNetDevice):
-                continue
+                # another technology in the graph means routing may use
+                # a path this engine does not model — even when the p2p
+                # graph alone happens to connect the endpoints
+                raise UnliftableAsError(
+                    f"node {i} carries a {type(dev).__name__}; the flow "
+                    "engine models pure point-to-point graphs"
+                )
             ch = dev.GetChannel()
             if ch is None or id(ch) in seen_ch:
                 continue
@@ -126,6 +132,19 @@ def lower_as_flows(sim_end_s: float) -> AsFlowsProgram:
             pkts.add(int(app.packet_size))
     if not srcs:
         raise UnliftableAsError("no UdpClient CBR flows found")
+    # flows must also be p2p-connected end to end (isolated islands of
+    # an otherwise-pure p2p graph cannot carry the named traffic) —
+    # this closed the hole that let an LTE+EPC scenario lift as its
+    # p2p backhaul before the device-type rejection above existed
+    from tpudes.helper.topology import component_labels
+
+    labels = component_labels(len(nodes), edges)
+    for s, d in zip(srcs, dsts):
+        if labels[s] != labels[d]:
+            raise UnliftableAsError(
+                f"flow node{s}→node{d} is not connected by p2p links; "
+                "the flow engine models the p2p graph only"
+            )
     return AsFlowsProgram(
         n=len(nodes),
         edges=np.asarray(edges, np.int32),
