@@ -1,0 +1,146 @@
+"""BridgeNetDevice: a learning L2 switch over member devices.
+
+Reference parity: src/bridge/model/bridge-net-device.{h,cc},
+bridge-channel.{h,cc} + helper/bridge-helper.{h,cc} (upstream paths;
+mount empty at survey — SURVEY.md §0, §2.9 bridge row).
+
+The bridge aggregates member NetDevices (CSMA ports, typically): frames
+received promiscuously on one port are forwarded out the others —
+flooded while the destination is unknown, unicast once the source-MAC
+learning table has seen the station, with per-entry expiration.  The
+bridge device itself can carry the node's IP stack (the switch's
+management interface), exactly as upstream.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.nstime import Seconds, Time
+from tpudes.core.object import TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.net_device import NetDevice
+
+
+class BridgeNetDevice(NetDevice):
+    tid = (
+        TypeId("tpudes::BridgeNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddConstructor(lambda **kw: BridgeNetDevice(**kw))
+        .AddAttribute(
+            "ExpirationTime", "learning-table entry lifetime",
+            Seconds(300.0), checker=Time, field="expiration_time",
+        )
+        .AddTraceSource("MacTx", "frame sent through the bridge")
+        .AddTraceSource("MacRx", "frame delivered to the bridge itself")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._ports: list[NetDevice] = []
+        #: learned station location: mac addr -> (port, expire_ticks)
+        self._learn: dict[int, tuple] = {}
+
+    # --- wiring -----------------------------------------------------------
+    def AddBridgePort(self, device: NetDevice) -> None:
+        if device is self:
+            raise ValueError("a bridge cannot bridge itself")
+        self._ports.append(device)
+        device.SetPromiscReceiveCallback(self._receive_from_port)
+        # a port belongs to the bridge: its frames must NOT also climb
+        # into the node's stack directly (the bridge's own _deliver_up
+        # is the management plane)
+        device.SetReceiveCallback(lambda *a: True)
+
+    def GetNBridgePorts(self) -> int:
+        return len(self._ports)
+
+    def GetBridgePort(self, i: int) -> NetDevice:
+        return self._ports[i]
+
+    def IsBridge(self) -> bool:
+        return True
+
+    def IsBroadcast(self) -> bool:
+        return True
+
+    def NeedsArp(self) -> bool:
+        return True
+
+    # --- learning ---------------------------------------------------------
+    def _learn_station(self, src, port) -> None:
+        self._learn[src.addr] = (
+            port, Simulator.NowTicks() + self.expiration_time.ticks
+        )
+
+    def _lookup(self, dst):
+        hit = self._learn.get(dst.addr)
+        if hit is None:
+            return None
+        port, expires = hit
+        if Simulator.NowTicks() >= expires:
+            del self._learn[dst.addr]
+            return None
+        return port
+
+    # --- forwarding -------------------------------------------------------
+    def _receive_from_port(self, in_device, packet, protocol, src, dst,
+                           packet_type) -> bool:
+        self._learn_station(src, in_device)
+        node = self._node
+        if packet_type == node.PACKET_HOST or dst == self._address:
+            # addressed to the bridge itself (management plane)
+            self.mac_rx(packet)
+            self._deliver_up(packet.Copy(), protocol, src, dst, node.PACKET_HOST)
+            return True
+        if packet_type == node.PACKET_BROADCAST or packet_type == node.PACKET_MULTICAST:
+            # flood FIRST, and hand the stack a COPY: the node's ARP/IP
+            # handlers strip headers in place, and a stripped broadcast
+            # must never be what the other segment receives
+            self._flood(in_device, packet, src, dst, protocol)
+            self._deliver_up(packet.Copy(), protocol, src, dst, packet_type)
+            return True
+        # other-host unicast: forward learned, else flood
+        out = self._lookup(dst)
+        if out is not None and out is not in_device:
+            out.SendFrom(packet.Copy(), src, dst, protocol)
+        elif out is None:
+            self._flood(in_device, packet, src, dst, protocol)
+        return True
+
+    def _flood(self, in_device, packet, src, dst, protocol) -> None:
+        for port in self._ports:
+            if port is not in_device:
+                port.SendFrom(packet.Copy(), src, dst, protocol)
+
+    # --- the bridge as an interface itself ---------------------------------
+    def Send(self, packet, dest=None, protocol: int = 0x0800) -> bool:
+        return self.SendFrom(packet, self._address, dest, protocol)
+
+    def SendFrom(self, packet, source, dest, protocol: int) -> bool:
+        self.mac_tx(packet)
+        out = self._lookup(dest) if dest is not None else None
+        if out is not None:
+            return out.SendFrom(packet.Copy(), source, dest, protocol)
+        for port in self._ports:
+            port.SendFrom(packet.Copy(), source, dest, protocol)
+        return True
+
+
+class BridgeHelper:
+    """helper/bridge-helper.{h,cc}: Install(node, ports)."""
+
+    def __init__(self):
+        self._attrs: dict = {}
+
+    def SetDeviceAttribute(self, name: str, value) -> None:
+        self._attrs[name] = value
+
+    def Install(self, node, port_devices) -> BridgeNetDevice:
+        from tpudes.helper.containers import NetDeviceContainer
+
+        if isinstance(port_devices, NetDeviceContainer):
+            port_devices = list(port_devices)
+        bridge = BridgeNetDevice(**self._attrs)
+        node.AddDevice(bridge)
+        for dev in port_devices:
+            bridge.AddBridgePort(dev)
+        return bridge

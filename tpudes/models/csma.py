@@ -184,6 +184,11 @@ class CsmaNetDevice(NetDevice):
 
     # --- tx path ---
     def Send(self, packet, dest=None, protocol: int = 0x0800) -> bool:
+        return self.SendFrom(packet, self._address, dest, protocol)
+
+    def SendFrom(self, packet, source, dest, protocol: int = 0x0800) -> bool:
+        """Source-preserving send (bridged forwarding keeps the original
+        station's MAC, as upstream CsmaNetDevice::SendFrom)."""
         if not self._link_up:
             self.mac_tx_drop(packet)
             return False
@@ -191,7 +196,7 @@ class CsmaNetDevice(NetDevice):
         packet.AddHeader(
             EthernetHeader(
                 destination=dest if dest is not None else self.GetBroadcast(),
-                source=self._address,
+                source=source,
                 ether_type=protocol,
             )
         )

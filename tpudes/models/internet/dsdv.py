@@ -1,0 +1,238 @@
+"""DSDV: destination-sequenced distance-vector routing (MANET).
+
+Reference parity: src/dsdv/model/dsdv-routing-protocol.{h,cc},
+dsdv-packet.{h,cc} + helper (upstream paths; mount empty at survey —
+SURVEY.md §0, §2.7 routing-protocol-modules row).
+
+Perkins–Bhagwat DSDV, the proactive half of the upstream MANET quartet:
+every node owns a monotonically increasing EVEN sequence number and
+periodically broadcasts its full table (dst, hop count, dst-sequence);
+receivers adopt a route when its sequence is newer, or equally new with
+fewer hops, always via the advertising neighbor.  Stale routes age out
+after ``Holdtimes`` missed periodic updates; adoption of a changed
+route triggers a (coalesced) immediate update.  Updates travel as their
+own IP protocol (number 99 here; upstream multiplexes UDP port 269 —
+the structured-packet equivalent of the same on-wire shape).
+
+Link-layer failure feedback (upstream's WST/settling-time machinery) is
+not modeled; expiry is the only breakage detector — documented scope.
+"""
+
+from __future__ import annotations
+
+from tpudes.core.nstime import Seconds, Time
+from tpudes.core.object import TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.models.internet.ipv4 import (
+    Ipv4Route,
+    Ipv4RoutingProtocol,
+)
+from tpudes.network.address import Ipv4Address
+from tpudes.network.packet import Header, Packet
+
+DSDV_PROT_NUMBER = 99
+
+
+class DsdvHeader(Header):
+    """One update message: [(dst, hop_count, seq)]."""
+
+    def __init__(self, entries=None):
+        self.entries = entries or []
+
+    def GetSerializedSize(self) -> int:
+        return 12 * max(len(self.entries), 1)
+
+    def Serialize(self) -> bytes:
+        import struct
+
+        out = b""
+        for dst, hops, seq in self.entries:
+            out += struct.pack("!IIi", Ipv4Address(dst).addr, hops, seq)
+        return out
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        import struct
+
+        entries = []
+        for off in range(0, len(data) - 11, 12):
+            a, h, s = struct.unpack("!IIi", data[off : off + 12])
+            entries.append((Ipv4Address(a), h, s))
+        return cls(entries)
+
+
+class DsdvRoutingProtocol(Ipv4RoutingProtocol):
+    PROT_NUMBER = DSDV_PROT_NUMBER
+
+    tid = (
+        TypeId("tpudes::DsdvRoutingProtocol")
+        .SetParent(Ipv4RoutingProtocol.tid)
+        .AddConstructor(lambda **kw: DsdvRoutingProtocol(**kw))
+        .AddAttribute(
+            "PeriodicUpdateInterval", "full-dump period",
+            Seconds(15.0), checker=Time, field="period",
+        )
+        .AddAttribute("Holdtimes", "missed periods before expiry", 3,
+                      field="holdtimes")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        #: dst addr-int -> [next_hop Ipv4Address|None, if_index, hops,
+        #: seq, expire_ticks]  (next_hop None = self)
+        self._table: dict[int, list] = {}
+        self._seq = 0
+        self._started = False
+        self._trigger_pending = False
+        self._next_expiry = 1 << 62
+
+    # --- lifecycle --------------------------------------------------------
+    def NotifyAddAddress(self, if_index: int, iface_addr) -> None:
+        addr = iface_addr.GetLocal()
+        self._table[addr.addr] = [None, if_index, 0, self._seq, 1 << 62]
+        if not self._started:
+            self._started = True
+            self.ipv4.Insert(self)
+            # jittered start so neighbors don't collide forever
+            Simulator.Schedule(
+                Seconds(0.01 * (1 + self.ipv4.GetNode().GetId() % 10)),
+                self._periodic,
+            )
+
+    def _periodic(self) -> None:
+        self._seq += 2  # own destinations advertise an even, fresh seq
+        for row in self._table.values():
+            if row[0] is None:
+                row[3] = self._seq
+        self._expire()
+        self._broadcast_update()
+        Simulator.Schedule(self.period, self._periodic)
+
+    def _expire(self) -> None:
+        """Drop aged rows; O(1) on the forwarding hot path until the
+        earliest expiry actually arrives (r4 review: RouteOutput paid a
+        full table scan per packet)."""
+        now = Simulator.NowTicks()
+        if now < self._next_expiry:
+            return
+        dead = [a for a, row in self._table.items() if row[4] <= now]
+        for a in dead:
+            del self._table[a]
+        self._next_expiry = min(
+            (row[4] for row in self._table.values()), default=1 << 62
+        )
+
+    # --- update tx --------------------------------------------------------
+    def _broadcast_update(self) -> None:
+        entries = [
+            (Ipv4Address(a), row[2], row[3])
+            for a, row in self._table.items()
+        ]
+        if not entries:
+            return
+        for i, iface in enumerate(self.ipv4.interfaces):
+            if iface.device is None or not iface.IsUp() or not iface.GetNAddresses():
+                continue
+            packet = Packet(0)
+            packet.AddHeader(DsdvHeader(list(entries)))
+            route = Ipv4Route(
+                destination=Ipv4Address.GetBroadcast(),
+                source=iface.GetAddress(0).GetLocal(),
+                gateway=Ipv4Address.GetBroadcast(),
+                output_device=iface.device,
+            )
+            route.if_index = i
+            self.ipv4.Send(
+                packet, iface.GetAddress(0).GetLocal(),
+                Ipv4Address.GetBroadcast(), self.PROT_NUMBER, route,
+            )
+
+    def _trigger_update(self) -> None:
+        """Coalesced triggered update (upstream's immediate small dump)."""
+        if self._trigger_pending:
+            return
+        self._trigger_pending = True
+
+        def fire():
+            self._trigger_pending = False
+            self._broadcast_update()
+
+        Simulator.Schedule(Seconds(0.05), fire)
+
+    # --- update rx (as an L4 protocol) ------------------------------------
+    def Receive(self, packet, ip_header, incoming_interface) -> None:
+        header = packet.RemoveHeader(DsdvHeader)
+        via = ip_header.source
+        if_index = self.ipv4.interfaces.index(incoming_interface)
+        expire = Simulator.NowTicks() + self.holdtimes * self.period.ticks
+        changed = False
+        for dst, hops, seq in header.entries:
+            if self._is_own(dst):
+                continue
+            row = self._table.get(dst.addr)
+            new_hops = hops + 1
+            if (
+                row is None
+                or seq > row[3]
+                or (seq == row[3] and new_hops < row[2])
+            ):
+                if row is None or row[0] is None or row[0] != via or \
+                        row[2] != new_hops:
+                    changed = True
+                self._table[dst.addr] = [via, if_index, new_hops, seq, expire]
+                self._next_expiry = min(self._next_expiry, expire)
+            elif row is not None and row[0] is not None and row[0] == via:
+                row[4] = expire  # refresh the route we already use
+        if changed:
+            self._trigger_update()
+
+    def _is_own(self, addr: Ipv4Address) -> bool:
+        row = self._table.get(addr.addr)
+        return row is not None and row[0] is None
+
+    # --- forwarding -------------------------------------------------------
+    def GetNRoutes(self) -> int:
+        return len(self._table)
+
+    def RouteOutput(self, packet, header, oif=None):
+        dest = header.destination
+        if dest.IsBroadcast():
+            # local broadcast out the first real interface
+            for i, iface in enumerate(self.ipv4.interfaces):
+                if iface.device is not None and iface.IsUp():
+                    route = Ipv4Route(
+                        destination=dest,
+                        source=self.ipv4.SelectSourceAddress(i),
+                        gateway=Ipv4Address.GetBroadcast(),
+                        output_device=iface.device,
+                    )
+                    route.if_index = i
+                    return route, 0
+            return None, 10
+        # NO connected-subnet shortcut: a MANET shares one prefix but
+        # not reachability — the sequenced table alone decides (direct
+        # neighbors appear as 1-hop entries from their own updates)
+        self._expire()
+        row = self._table.get(dest.addr)
+        if row is None or row[0] is None:
+            return None, 10  # no route
+        iface = self.ipv4.GetInterface(row[1])
+        route = Ipv4Route(
+            destination=dest,
+            source=self.ipv4.SelectSourceAddress(row[1]),
+            gateway=row[0],
+            output_device=iface.device,
+        )
+        route.if_index = row[1]
+        return route, 0
+
+
+class DsdvHelper:
+    def __init__(self, **attrs):
+        self._attrs = attrs
+
+    def Set(self, name: str, value) -> None:
+        self._attrs[name] = value
+
+    def Create(self, node) -> DsdvRoutingProtocol:
+        return DsdvRoutingProtocol(**self._attrs)
