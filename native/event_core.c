@@ -294,6 +294,82 @@ static PyObject *cheap_live_count(CHeapObject *self, PyObject *noarg)
     return PyLong_FromSsize_t(live);
 }
 
+/* ---- MRG32k3a (L'Ecuyer 1999): the simulator's RandU01 hot path.
+ * Exact int64 arithmetic mirroring tpudes/core/rng.py bit for bit
+ * (python's %% is always nonnegative; C truncates, hence the fixups),
+ * so native and pure-Python streams are interchangeable mid-run. */
+
+#define MRG_M1 4294967087LL
+#define MRG_M2 4294944443LL
+#define MRG_A12 1403580LL
+#define MRG_A13N 810728LL
+#define MRG_A21 527612LL
+#define MRG_A23N 1370589LL
+
+typedef struct {
+    PyObject_HEAD
+    long long s1[3];
+    long long s2[3];
+} MrgObject;
+
+static PyObject *mrg_new(PyTypeObject *type, PyObject *args, PyObject *kw)
+{
+    MrgObject *self = (MrgObject *)type->tp_alloc(type, 0);
+    if (!self)
+        return NULL;
+    if (!PyArg_ParseTuple(
+            args, "LLLLLL", &self->s1[0], &self->s1[1], &self->s1[2],
+            &self->s2[0], &self->s2[1], &self->s2[2])) {
+        Py_DECREF(self);
+        return NULL;
+    }
+    return (PyObject *)self;
+}
+
+static PyObject *mrg_rand_u01(MrgObject *self, PyObject *noarg)
+{
+    long long p1 = (MRG_A12 * self->s1[1] - MRG_A13N * self->s1[0]) % MRG_M1;
+    if (p1 < 0)
+        p1 += MRG_M1;
+    self->s1[0] = self->s1[1];
+    self->s1[1] = self->s1[2];
+    self->s1[2] = p1;
+    long long p2 = (MRG_A21 * self->s2[2] - MRG_A23N * self->s2[0]) % MRG_M2;
+    if (p2 < 0)
+        p2 += MRG_M2;
+    self->s2[0] = self->s2[1];
+    self->s2[1] = self->s2[2];
+    self->s2[2] = p2;
+    long long d = p1 - p2;
+    if (d <= 0)
+        d += MRG_M1;
+    return PyFloat_FromDouble((double)d * (1.0 / (MRG_M1 + 1.0)));
+}
+
+static PyObject *mrg_get_state(MrgObject *self, PyObject *noarg)
+{
+    return Py_BuildValue(
+        "(LLLLLL)", self->s1[0], self->s1[1], self->s1[2],
+        self->s2[0], self->s2[1], self->s2[2]);
+}
+
+static PyMethodDef mrg_methods[] = {
+    {"rand_u01", (PyCFunction)mrg_rand_u01, METH_NOARGS, "next U(0,1)"},
+    {"get_state", (PyCFunction)mrg_get_state, METH_NOARGS,
+     "(s1_0..s2_2) current state"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PyTypeObject MrgType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "tpudes_event_core.Mrg32k3a",
+    .tp_basicsize = sizeof(MrgObject),
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "MRG32k3a stream (exact match of the Python reference)",
+    .tp_new = mrg_new,
+    .tp_methods = mrg_methods,
+};
+
 static void cheap_dealloc(CHeapObject *self)
 {
     PyObject_GC_UnTrack(self);
@@ -353,6 +429,10 @@ PyMODINIT_FUNC PyInit_tpudes_event_core(void)
         return NULL;
     Py_INCREF(&CHeapType);
     PyModule_AddObject(m, "CHeap", (PyObject *)&CHeapType);
+    if (PyType_Ready(&MrgType) < 0)
+        return NULL;
+    Py_INCREF(&MrgType);
+    PyModule_AddObject(m, "Mrg32k3a", (PyObject *)&MrgType);
 #define INTERN(var, name)                                                     \
     if (!(var = PyUnicode_InternFromString(name)))                            \
         return NULL;

@@ -115,6 +115,16 @@ def test_switch_with_management_stack_does_not_corrupt_floods():
     assert sapps.Get(0).received == 3
 
 
+def test_port_without_sendfrom_is_rejected():
+    """A port type that would re-stamp source MACs (base SendFrom
+    fallback) must be refused, as upstream's SupportsSendFrom abort."""
+    from tpudes.models.p2p import PointToPointNetDevice
+
+    bridge = BridgeNetDevice()
+    with pytest.raises(ValueError, match="SendFrom"):
+        bridge.AddBridgePort(PointToPointNetDevice())
+
+
 def test_learning_table_expires():
     from tpudes.network.address import Mac48Address
 

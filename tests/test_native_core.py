@@ -172,6 +172,42 @@ def test_len_is_live_count_and_read_only():
     assert len(s) == 8
 
 
+def test_native_mrg32k3a_is_bit_identical_to_python():
+    """Every simulation draw must be identical whichever RandU01
+    implementation runs — replica results cannot depend on whether the
+    C core built."""
+    from tpudes.core.rng import RngStream
+
+    a = RngStream(42, 5, 2)
+    b = RngStream(42, 5, 2)
+    b._native = False   # force the pure-Python recurrence
+    assert [a.RandU01() for _ in range(50_000)] == [
+        b.RandU01() for _ in range(50_000)
+    ]
+    assert a._native is not False, "native path did not engage"
+
+
+def test_rng_stream_state_survives_native_advancement_and_pickle():
+    """get_state()/pickle must reflect the TRUE position even after the
+    C recurrence has been advancing the stream (r4 review: _s1/_s2
+    froze at seed time)."""
+    import pickle
+
+    from tpudes.core.rng import RngStream
+
+    a = RngStream(9, 2, 1)
+    for _ in range(1234):
+        a.RandU01()
+    clone = pickle.loads(pickle.dumps(a))
+    assert [clone.RandU01() for _ in range(100)] == [
+        a.RandU01() for _ in range(100)
+    ], "a pickled stream must continue, not rewind"
+    s = a.get_state()
+    b = RngStream.__new__(RngStream)
+    b._s1, b._s2, b._native = list(s[:3]), list(s[3:]), False
+    assert b.RandU01() == a.RandU01()
+
+
 def test_no_native_env_falls_back(monkeypatch):
     import tpudes.core.native as nat
 

@@ -67,9 +67,14 @@ _A2_P76 = _mat_pow(_A2, 1 << 76, _M2)
 
 
 class RngStream:
-    """One MRG32k3a stream positioned at (seed, stream, substream)."""
+    """One MRG32k3a stream positioned at (seed, stream, substream).
 
-    __slots__ = ("_s1", "_s2")
+    The per-draw recurrence runs in the native C core when it is built
+    (bit-identical to the Python path — pinned by test); stream/
+    substream jump math stays in Python (cold path, big-int matrices).
+    """
+
+    __slots__ = ("_s1", "_s2", "_native")
 
     def __init__(self, seed: int, stream: int, substream: int):
         # ns-3 expands the scalar seed into the six-value package seed.
@@ -90,8 +95,20 @@ class RngStream:
             base2 = _mat_vec(j2, base2, _M2)
         self._s1 = base1
         self._s2 = base2
+        self._native = None
 
     def RandU01(self) -> float:
+        native = self._native
+        if native is None:
+            from tpudes.core.native import get_native
+
+            mod = get_native()
+            if mod is not None and hasattr(mod, "Mrg32k3a"):
+                native = self._native = mod.Mrg32k3a(*self._s1, *self._s2)
+            else:
+                native = self._native = False
+        if native is not False:
+            return native.rand_u01()
         s1 = self._s1
         s2 = self._s2
         p1 = (_A12 * s1[1] - _A13N * s1[0]) % _M1
@@ -108,6 +125,27 @@ class RngStream:
 
     def RandInt(self, low: int, high: int) -> int:
         return low + int(self.RandU01() * (high - low + 1))
+
+    # --- state visibility / pickling with the native path active ---------
+    def _sync_from_native(self) -> None:
+        if self._native not in (None, False):
+            s = self._native.get_state()
+            self._s1 = list(s[:3])
+            self._s2 = list(s[3:])
+
+    def get_state(self) -> tuple:
+        """Current six-value stream position (valid whichever RandU01
+        implementation has been advancing it)."""
+        self._sync_from_native()
+        return tuple(self._s1) + tuple(self._s2)
+
+    def __getstate__(self):
+        self._sync_from_native()
+        return (list(self._s1), list(self._s2))
+
+    def __setstate__(self, state):
+        self._s1, self._s2 = state
+        self._native = None
 
 
 class RngSeedManager:

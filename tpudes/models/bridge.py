@@ -43,6 +43,14 @@ class BridgeNetDevice(NetDevice):
     def AddBridgePort(self, device: NetDevice) -> None:
         if device is self:
             raise ValueError("a bridge cannot bridge itself")
+        if type(device).SendFrom is NetDevice.SendFrom:
+            # the base fallback discards the source MAC — forwarding
+            # through such a port would silently re-stamp every frame
+            # (upstream aborts unless SupportsSendFrom, same contract)
+            raise ValueError(
+                f"{type(device).__name__} does not support SendFrom; "
+                "bridge ports must preserve the source MAC"
+            )
         self._ports.append(device)
         device.SetPromiscReceiveCallback(self._receive_from_port)
         # a port belongs to the bridge: its frames must NOT also climb
@@ -120,8 +128,7 @@ class BridgeNetDevice(NetDevice):
         out = self._lookup(dest) if dest is not None else None
         if out is not None:
             return out.SendFrom(packet.Copy(), source, dest, protocol)
-        for port in self._ports:
-            port.SendFrom(packet.Copy(), source, dest, protocol)
+        self._flood(None, packet, source, dest, protocol)
         return True
 
 
