@@ -15,11 +15,11 @@ from tpudes.parallel import (
     WindowParams,
     make_replica_batch,
     replica_mesh,
-    replicated,
     shard_leading_axis,
     sharded_window_step,
     wifi_phy_window,
 )
+from tpudes.parallel.kernels import replicated
 
 
 def _first_slice_trace():

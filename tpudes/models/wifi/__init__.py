@@ -1,12 +1,22 @@
-"""WiFi module: Yans PHY/channel, DCF MAC, rate control, helpers.
+"""WiFi module: Yans/Spectrum PHY, DCF/EDCA MAC, aggregation, rate control.
 
-Reference parity: src/wifi/ (SURVEY.md §2.5). Round-1 scope: DCF +
-data/ack exchange, beacon/assoc state machines, NIST error model via
-:mod:`tpudes.ops.wifi_error`; EDCA/QoS, RTS/CTS+NAV, aggregation,
-BlockAck and the HT/VHT/HE FEM chain are later rounds.
+Reference parity: src/wifi/ (SURVEY.md §2.5).  Implemented: DCF +
+EDCA/QoS, RTS/CTS+NAV, data/ack exchange, beacon/assoc state machines,
+A-MPDU aggregation + BlockAck sessions, HT-family rates, NIST and
+table-based error models via :mod:`tpudes.ops.wifi_error`, six rate
+controllers incl. MinstrelHt.  Not modeled: multi-stream MIMO, A-MSDU,
+per-amendment FEM subclasses (one folded FEM serves all rates).
 """
 
-from tpudes.models.wifi.phy import YansWifiPhy, WifiPhyState, InterferenceHelper, ppdu_duration_s
+from tpudes.models.wifi.phy import (
+    AmpduTag,
+    InterferenceHelper,
+    NistErrorRateModel,
+    TableBasedErrorRateModel,
+    WifiPhyState,
+    YansWifiPhy,
+    ppdu_duration_s,
+)
 from tpudes.models.wifi.channel import YansWifiChannel
 from tpudes.models.wifi.mac import (
     AdhocWifiMac,
@@ -23,6 +33,7 @@ from tpudes.models.wifi.rate_control import (
     ArfWifiManager,
     ConstantRateWifiManager,
     IdealWifiManager,
+    MinstrelHtWifiManager,
     MinstrelWifiManager,
 )
 from tpudes.models.wifi.helper import (

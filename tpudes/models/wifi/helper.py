@@ -124,10 +124,23 @@ class WifiMacHelper:
         return self._MACS[self._type](**self._kwargs)
 
 
+#: standards whose Install defaults enable the HT feature set
+#: (QoS + A-MPDU aggregation under BlockAck) — WifiHelper::SetStandard
+HT_STANDARDS = ("80211n", "80211ac", "80211ax")
+
+
 class WifiHelper:
     def __init__(self):
         self._manager_type = "tpudes::ConstantRateWifiManager"
         self._manager_kwargs: dict = {}
+        self._standard = "80211a"
+
+    def SetStandard(self, standard: str) -> None:
+        """'80211a'/'80211g' (legacy OFDM) or an HT-family standard
+        ('80211n'/'80211ac'/'80211ax') — HT standards default installed
+        MACs to QosSupported + MaxAmpduSize=65535 (upstream
+        WifiHelper::SetStandard + the HT MAC defaults)."""
+        self._standard = standard.replace("WIFI_STANDARD_", "").replace("_", "").lower()
 
     def SetRemoteStationManager(self, name: str, **attributes) -> None:
         name = name.replace("ns3::", "tpudes::")
@@ -149,6 +162,12 @@ class WifiHelper:
             phy = phy_helper.Create(node, device)
             device.SetPhy(phy)
             mac = mac_helper.Create()
+            if self._standard in HT_STANDARDS:
+                mac.qos_supported = True
+                # only default aggregation on when the user did not set
+                # MaxAmpduSize explicitly (an explicit 0 disables it)
+                if "MaxAmpduSize" not in mac_helper._kwargs:
+                    mac.max_ampdu_size = 65535
             manager = RATE_MANAGERS[self._manager_type](**self._manager_kwargs)
             mac.SetWifiRemoteStationManager(manager)
             device.SetMac(mac)

@@ -9,10 +9,14 @@ Reference parity (upstream paths; mount empty at survey — SURVEY.md §0):
 - src/wifi/model/{adhoc,ap,sta}-wifi-mac.{h,cc} — high MACs (beacons,
   association state machine)
 
-Round-1 scope notes (SURVEY.md §7 step 6): DCF only (EDCA/QoS, RTS/CTS
-+ NAV, aggregation and BlockAck are later-round work — the seam is
-``FrameExchange``); association is the real two-frame exchange but
-without auth.  The 9 µs slot feedback loop stays host-side by design
+Implemented scope: DCF + EDCA/QoS (four AC queues, per-AC AIFS/CW),
+RTS/CTS with NAV, A-MPDU aggregation under BlockAck sessions (ADDBA
+handshake → aggregated exchanges → compressed-BlockAck per-MPDU
+acking — block-ack-manager.{h,cc} + mpdu-aggregator.{h,cc} analog),
+and HT-family rates via the shared mode registry.  Association is the
+real two-frame exchange but without auth.  Not modeled: multi-stream
+MIMO, A-MSDU, per-amendment FEM subclass chains (one folded FEM serves
+all rates).  The 9 µs slot feedback loop stays host-side by design
 (SURVEY.md §7 hard part 1).
 """
 
@@ -27,7 +31,7 @@ from tpudes.core.simulator import Simulator
 from tpudes.network.address import Mac48Address
 from tpudes.network.packet import Header, Packet
 from tpudes.ops.wifi_error import MODES_BY_NAME, WifiMode
-from tpudes.models.wifi.phy import ppdu_duration_s
+from tpudes.models.wifi.phy import AmpduTag, ppdu_duration_s
 
 # 802.11a OFDM 20 MHz MAC timing (wifi-phy.cc / wifi-mac.cc)
 SLOT_US = 9
@@ -64,6 +68,23 @@ class WifiMacType:
     ASSOC_RESP = 4
     RTS = 5
     CTS = 6
+    BLOCK_ACK = 7
+    ADDBA_REQ = 8
+    ADDBA_RESP = 9
+
+
+#: compressed BlockAck on-air size incl. FCS (ctrl-headers.cc): 2 (BA
+#: control) + 2 (starting seq) + 8 (bitmap) + 16 (fc/dur/ra/ta) + FCS
+BLOCK_ACK_SIZE = 32
+MPDU_DELIMITER_SIZE = 4
+MAX_AMPDU_FRAMES = 64   # BlockAck window (block-ack-window.cc)
+
+
+def _ampdu_subframe_bytes(mpdu_size: int) -> int:
+    """On-air bytes of one A-MPDU subframe: 4-byte delimiter + MPDU +
+    FCS, padded to a 4-byte boundary (mpdu-aggregator.cc)."""
+    raw = MPDU_DELIMITER_SIZE + mpdu_size + FCS_SIZE
+    return (raw + 3) & ~3
 
 
 class WifiMacHeader(Header):
@@ -80,10 +101,17 @@ class WifiMacHeader(Header):
         self.duration_us = duration_us
         self.to_ds = to_ds
         self.from_ds = from_ds
+        #: BLOCK_ACK only: sequence numbers acknowledged (the compressed
+        #: bitmap, kept structured; Serialize packs start+count)
+        self.ba_seqs: tuple = ()
+        #: sender-side retry count, rides the header through requeues
+        self.retry_count = 0
 
     def GetSerializedSize(self) -> int:
         if self.frame_type in (WifiMacType.ACK, WifiMacType.CTS):
             return 10  # fc+dur+ra (FCS added as size constant by callers)
+        if self.frame_type == WifiMacType.BLOCK_ACK:
+            return BLOCK_ACK_SIZE - FCS_SIZE
         return MAC_HEADER_SIZE
 
     def Serialize(self) -> bytes:
@@ -91,7 +119,27 @@ class WifiMacHeader(Header):
         fixed = struct.pack(
             ">BBHH", self.frame_type, flags, self.duration_us & 0xFFFF, self.seq & 0xFFF
         )
-        return fixed + self.addr1.to_bytes() + self.addr2.to_bytes() + self.addr3.to_bytes()[:2]
+        out = fixed + self.addr1.to_bytes() + self.addr2.to_bytes() + self.addr3.to_bytes()[:2]
+        if self.frame_type == WifiMacType.BLOCK_ACK:
+            # compressed-BlockAck info: starting seq + 64-bit bitmap
+            # relative to it (ctrl-headers.cc CtrlBAckResponseHeader).
+            # Wrap-aware start: pick the acked seq from which every other
+            # acked seq is < 64 modulo-4096 steps ahead, so an ack set
+            # straddling the 12-bit wrap (e.g. {4094, 4095, 0, 1}) still
+            # fits the bitmap.
+            start, bitmap = 0, 0
+            for cand in self.ba_seqs:
+                if all(((s - cand) & 0xFFF) < 64 for s in self.ba_seqs):
+                    start = cand
+                    break
+            for s in self.ba_seqs:
+                off = (s - start) & 0xFFF
+                if off < 64:
+                    bitmap |= 1 << off
+            out = out[: self.GetSerializedSize() - 10] + struct.pack(
+                ">HQ", start & 0xFFF, bitmap
+            )
+        return out
 
     @classmethod
     def Deserialize(cls, data: bytes):
@@ -100,6 +148,11 @@ class WifiMacHeader(Header):
                 retry=bool(flags & 1), to_ds=bool(flags & 2), from_ds=bool(flags & 4))
         h.addr1 = Mac48Address.from_bytes(data[6:12])
         h.addr2 = Mac48Address.from_bytes(data[12:18])
+        if frame_type == WifiMacType.BLOCK_ACK and len(data) >= 28:
+            start, bitmap = struct.unpack(">HQ", data[18:28])
+            h.ba_seqs = tuple(
+                (start + i) & 0xFFF for i in range(64) if bitmap & (1 << i)
+            )
         return h
 
     def IsData(self):
@@ -314,12 +367,22 @@ class WifiMac(Object):
             "deviation from upstream's four Txops)",
             False, field="qos_supported",
         )
+        .AddAttribute(
+            "MaxAmpduSize",
+            "A-MPDU aggregation limit in on-air bytes (0 disables; "
+            "upstream BE_MaxAmpduSize — HT default 65535).  Aggregated "
+            "exchanges run under a BlockAck session established by a "
+            "real ADDBA request/response handshake",
+            0, field="max_ampdu_size",
+        )
         .AddTraceSource("MacTx", "frame handed to DCF (packet)")
         .AddTraceSource("MacRx", "frame delivered up (packet)")
         .AddTraceSource("MacTxDrop", "tx dropped after retries (packet)")
         .AddTraceSource("MacRxDrop", "rx dropped (packet)")
         .AddTraceSource("RtsSent", "(to) RTS transmitted")
         .AddTraceSource("CtsSent", "(to) CTS answered")
+        .AddTraceSource("AmpduTxOk", "(to, n_mpdus_acked, n_mpdus_failed)")
+        .AddTraceSource("AgreementEstablished", "(peer) ADDBA done")
     )
 
     def __init__(self, **attributes):
@@ -338,6 +401,13 @@ class WifiMac(Object):
         self._retries = 0
         self._dup_cache: dict = {}  # ta -> last seq
         self._forward_up = None
+        self._current_ac = AcIndex.AC_BE
+        # --- BlockAck sessions (block-ack-manager.cc analog) ---
+        #: peer -> "established" | ("pending", ticks-of-request)
+        self._ba_tx: dict[str, object] = {}
+        self._ba_rx_seen: dict[str, dict] = {}  # peer -> ordered rx-seq window
+        self._current_ampdu: list | None = None  # [(packet, header), ...]
+        self._ba_timeout_event = None
 
     # --- wiring ---
     def SetPhy(self, phy) -> None:
@@ -378,9 +448,44 @@ class WifiMac(Object):
             ac = classify_ac(packet) if header.IsData() else AcIndex.AC_VO
         else:
             ac = AcIndex.AC_BE
+        if (
+            header.IsData()
+            and int(self.max_ampdu_size) > 0
+            and not header.addr1.IsBroadcast()
+            and not header.addr1.IsGroup()
+        ):
+            self._maybe_start_ba_session(header.addr1)
         self._queue[ac].append((packet, header))
         if self._current is None:
             self._dequeue()
+
+    #: re-attempt a stalled ADDBA handshake after this long (upstream
+    #: block-ack-manager re-establishes on inactivity)
+    ADDBA_RETRY_S = 1.0
+
+    def _maybe_start_ba_session(self, peer) -> None:
+        """First aggregatable data to ``peer``: run the ADDBA handshake
+        (block-ack-manager.cc); data stays unaggregated until the
+        response lands.  A handshake whose REQ or RESP died at the retry
+        limit is re-attempted after ADDBA_RETRY_S rather than pinning
+        the session 'pending' forever."""
+        key = str(peer)
+        state = self._ba_tx.get(key)
+        if state == "established":
+            return
+        now = Simulator.NowTicks()
+        if (
+            isinstance(state, tuple)
+            and now - state[1] < Seconds(self.ADDBA_RETRY_S).ticks
+        ):
+            return
+        self._ba_tx[key] = ("pending", now)
+        req = Packet(9)  # ADDBA action payload (category/action/params)
+        header = WifiMacHeader(
+            WifiMacType.ADDBA_REQ, addr1=peer, addr2=self._address,
+            addr3=peer, seq=self._next_seq(),
+        )
+        self._enqueue_frame(req, header)
 
     def _pop_next_frame(self):
         """Head-of-line frame by strict AC priority; arms the access
@@ -394,6 +499,7 @@ class WifiMac(Object):
                     )
                 else:
                     self._access.set_params(DIFS_US, CW_MIN, CW_MAX)
+                self._current_ac = ac
                 return self._queue[ac].pop(0)
         return None
 
@@ -411,6 +517,10 @@ class WifiMac(Object):
         if self._current is None:
             return
         packet, header = self._current
+        frames = self._maybe_aggregate(header)
+        if frames is not None:
+            self._send_ampdu(frames)
+            return
         # TS: RTS/CTS protection for large unicast data (the
         # frame-exchange-manager NeedRts path)
         if (
@@ -423,6 +533,169 @@ class WifiMac(Object):
             self._send_rts(header)
             return
         self._send_current(packet, header)
+
+    # --- A-MPDU exchange (mpdu-aggregator + block-ack-manager analog) ---
+    def _maybe_aggregate(self, header) -> list | None:
+        """Collect same-destination frames from the head AC queue into an
+        A-MPDU (returns [(packet, header), ...] incl. the current frame),
+        or None when the exchange must stay a single-MPDU DATA/ACK."""
+        if (
+            int(self.max_ampdu_size) <= 0
+            or not header.IsData()
+            or header.addr1.IsBroadcast()
+            or header.addr1.IsGroup()
+            or self._ba_tx.get(str(header.addr1)) != "established"
+        ):
+            return None
+        packet, _ = self._current
+        frames = [self._current]
+        onair = _ampdu_subframe_bytes(packet.GetSize() + header.GetSerializedSize())
+        queue = self._queue[self._current_ac]
+        i = 0
+        while i < len(queue) and len(frames) < MAX_AMPDU_FRAMES:
+            qp, qh = queue[i]
+            if qh.IsData() and qh.addr1 == header.addr1:
+                sub = _ampdu_subframe_bytes(qp.GetSize() + qh.GetSerializedSize())
+                if onair + sub > int(self.max_ampdu_size):
+                    break
+                onair += sub
+                frames.append(queue.pop(i))
+                continue
+            i += 1
+        return frames  # single-frame A-MPDUs still ride the BA session
+
+    def _send_ampdu(self, frames: list) -> None:
+        to = frames[0][1].addr1
+        mode = (
+            self._station_manager.get_data_mode(to)
+            if self._station_manager
+            else MODES_BY_NAME["OfdmRate6Mbps"]
+        )
+        ctrl_mode = control_answer_mode(mode)
+        ba_dur_s = ppdu_duration_s(BLOCK_ACK_SIZE, ctrl_mode)
+        nav_us = int(SIFS_US + ba_dur_s * 1e6)
+        subframes = []
+        for packet, header in frames:
+            header.retry = header.retry_count > 0
+            header.duration_us = nav_us
+            mpdu = packet.Copy()
+            mpdu.AddHeader(header)
+            subframes.append((mpdu, _ampdu_subframe_bytes(mpdu.GetSize())))
+        container = Packet(0)
+        container.AddPacketTag(AmpduTag(subframes))
+        total = sum(b for _, b in subframes)
+        tx_dur_s = ppdu_duration_s(total, mode)
+        timeout_s = self._response_timeout_s(tx_dur_s, BLOCK_ACK_SIZE, ctrl_mode)
+        self._current_ampdu = frames
+        self._ba_timeout_event = Simulator.GetImpl().Schedule(
+            Seconds(timeout_s).ticks, self._on_ba_timeout, ()
+        )
+        self._phy.Send(container, mode, size_bytes=total)
+
+    def _finish_ampdu(self, acked_seqs: set) -> None:
+        """Resolve the in-flight A-MPDU against a BlockAck bitmap (empty
+        set = BA never arrived): acked MPDUs complete, failed ones are
+        requeued at the head with their per-MPDU retry counts bumped;
+        retry-limit losers drop (block-ack-manager NotifyGotBlockAck /
+        MissedBlockAck)."""
+        frames = self._current_ampdu
+        self._current_ampdu = None
+        self._current = None
+        to = frames[0][1].addr1
+        n_ok, requeue = 0, []
+        for packet, header in frames:
+            if header.seq in acked_seqs:
+                n_ok += 1
+                continue
+            header.retry_count += 1
+            if header.retry_count > RETRY_LIMIT:
+                self.mac_tx_drop(packet)
+                if self._station_manager:
+                    self._station_manager.report_final_failed(header.addr1)
+            else:
+                requeue.append((packet, header))
+        n_fail = len(frames) - n_ok
+        self.ampdu_tx_ok(to, n_ok, n_fail)
+        if self._station_manager:
+            self._station_manager.report_ampdu_tx_status(to, n_ok, n_fail)
+        self._queue[self._current_ac][:0] = requeue
+        if n_ok:
+            self._access.notify_success()
+            self._dequeue()
+        else:
+            self._access.notify_failure()
+            if self._pop_current():
+                self._access.request_access(allow_immediate=False)
+
+    def _pop_current(self) -> bool:
+        """Load the next head-of-line frame into ``_current`` without
+        requesting access (retry path keeps its own access call)."""
+        frame = self._pop_next_frame()
+        if frame is None:
+            return False
+        self._current = frame
+        self._retries = 0
+        return True
+
+    def _on_ba_timeout(self):
+        self._ba_timeout_event = None
+        self._finish_ampdu(set())
+
+    def _on_block_ack(self, header) -> None:
+        if self._current_ampdu is None or self._ba_timeout_event is None:
+            return
+        self._ba_timeout_event.cancel()
+        self._ba_timeout_event = None
+        self._finish_ampdu(set(header.ba_seqs))
+
+    def _rx_ampdu(self, tag: AmpduTag, snr: float, mode) -> None:
+        """Receiver side of an aggregated exchange: deliver surviving
+        MPDUs, answer with a BlockAck covering exactly those seqs."""
+        hdr0 = tag.subframes[0][0].PeekHeader(WifiMacHeader)
+        if hdr0 is None:
+            return
+        if hdr0.addr1 != self._address:
+            self._set_nav(hdr0.duration_us)
+            return
+        acked = []
+        seen = self._ba_rx_seen.setdefault(str(hdr0.addr2), {})
+        for (mpdu, _), ok in zip(tag.subframes, tag.survivors or ()):
+            if not ok:
+                continue
+            packet = mpdu.Copy()
+            header = packet.RemoveHeader(WifiMacHeader)
+            acked.append(header.seq)
+            if self._station_manager:
+                self._station_manager.report_rx_snr(header.addr2, snr)
+            # dedup against BOTH windows: the A-MPDU window and the
+            # single-frame cache — a frame first sent (and acked-lost)
+            # before the BA session established retransmits aggregated
+            if header.seq in seen or self._dup_cache.get(str(hdr0.addr2)) == (
+                header.seq,
+                header.frame_type,
+            ):
+                self.mac_rx_drop(packet)
+                continue
+            # insertion-ordered dedup window (dict preserves order): the
+            # OLDEST seqs evict first, so 12-bit wraparound reuse of a
+            # seq is accepted once the old occurrence ages out
+            seen[header.seq] = True
+            while len(seen) > 2 * MAX_AMPDU_FRAMES:
+                seen.pop(next(iter(seen)))
+            self.Receive(packet, header)
+        if acked:
+            self._send_block_ack(hdr0.addr2, mode, acked)
+
+    def _send_block_ack(self, to, data_mode, acked_seqs) -> None:
+        ba_mode = control_answer_mode(data_mode)
+        ba = Packet(0)
+        header = WifiMacHeader(WifiMacType.BLOCK_ACK, addr1=to, addr2=self._address)
+        header.ba_seqs = tuple(acked_seqs)
+        ba.AddHeader(header)
+        Simulator.GetImpl().Schedule(
+            MicroSeconds(SIFS_US).ticks,
+            self._phy.Send, (ba, ba_mode, 0, BLOCK_ACK_SIZE),
+        )
 
     @staticmethod
     def _response_timeout_s(tx_dur_s: float, resp_size: int, resp_mode) -> float:
@@ -563,12 +836,20 @@ class WifiMac(Object):
 
     # --- rx path ---
     def _rx_ok(self, packet: Packet, snr: float, mode: WifiMode):
+        tag = packet.PeekPacketTag(AmpduTag)
+        if tag is not None:
+            self._rx_ampdu(tag, snr, mode)
+            return
         header = packet.RemoveHeader(WifiMacHeader)
         if self._station_manager:
             self._station_manager.report_rx_snr(header.addr2, snr)
         if header.IsAck():
             if header.addr1 == self._address:
                 self._on_ack(header.addr1)
+            return
+        if header.frame_type == WifiMacType.BLOCK_ACK:
+            if header.addr1 == self._address:
+                self._on_block_ack(header)
             return
         if header.frame_type == WifiMacType.RTS:
             if header.addr1 == self._address:
@@ -596,6 +877,19 @@ class WifiMac(Object):
                 self.mac_rx_drop(packet)
                 return
             self._dup_cache[str(header.addr2)] = (header.seq, header.frame_type)
+        if header.frame_type == WifiMacType.ADDBA_REQ:
+            resp = Packet(9)
+            rheader = WifiMacHeader(
+                WifiMacType.ADDBA_RESP, addr1=header.addr2,
+                addr2=self._address, addr3=header.addr2,
+                seq=self._next_seq(),
+            )
+            self._enqueue_frame(resp, rheader)
+            return
+        if header.frame_type == WifiMacType.ADDBA_RESP:
+            self._ba_tx[str(header.addr2)] = "established"
+            self.agreement_established(header.addr2)
+            return
         self.Receive(packet, header)
 
     def _rx_error(self, packet, snr):

@@ -21,7 +21,12 @@ import math
 from tpudes.core.nstime import Seconds, Time
 from tpudes.core.object import Object, TypeId
 from tpudes.core.rng import UniformRandomVariable
-from tpudes.ops.wifi_error import MODES_BY_NAME, WifiMode, chunk_success_rate_py
+from tpudes.ops.wifi_error import (
+    MODES_BY_NAME,
+    WifiMode,
+    chunk_success_rate_py,
+    table_chunk_success_rate_py,
+)
 
 BOLTZMANN = 1.380649e-23
 
@@ -31,14 +36,46 @@ SIGNAL_DURATION_S = 4e-6     # L-SIG
 SYMBOL_DURATION_S = 4e-6
 SERVICE_BITS = 16
 TAIL_BITS = 6
+#: HT-mixed preamble for 1 spatial stream (ht-phy.cc timing): L-STF(8) +
+#: L-LTF(8) + L-SIG(4) + HT-SIG(8) + HT-STF(4) + HT-LTF(4) = 36 µs total,
+#: i.e. 16 µs beyond the legacy preamble+L-SIG.  The registry's VHT/HE
+#: entries reuse it (1-SS 20 MHz studies; per-amendment preamble deltas
+#: are a documented simplification).
+HT_PREAMBLE_EXTRA_S = 16e-6
 
 
 def ppdu_duration_s(size_bytes: int, mode: WifiMode) -> float:
     """PPDU airtime: preamble + L-SIG + ceil((service+8·len+tail)/NDBPS)
-    OFDM symbols (WifiPhy::CalculateTxDuration for non-HT OFDM)."""
+    OFDM symbols (WifiPhy::CalculateTxDuration); HT-family modes add the
+    HT-mixed preamble fields."""
     ndbps = mode.data_rate_bps * SYMBOL_DURATION_S  # data bits per symbol
     nsym = math.ceil((SERVICE_BITS + 8 * size_bytes + TAIL_BITS) / ndbps)
-    return PREAMBLE_DURATION_S + SIGNAL_DURATION_S + nsym * SYMBOL_DURATION_S
+    extra = HT_PREAMBLE_EXTRA_S if mode.standard == "ht" else 0.0
+    return PREAMBLE_DURATION_S + SIGNAL_DURATION_S + extra + nsym * SYMBOL_DURATION_S
+
+
+class NistErrorRateModel:
+    """Closed-form NIST model (nist-error-rate-model.cc) — the default
+    ``chunk_success(mode, snr, nbits)`` provider."""
+
+    def chunk_success(self, mode: WifiMode, snr: float, nbits: float) -> float:
+        return chunk_success_rate_py(snr, nbits, mode.constellation, mode.rate_class)
+
+
+class TableBasedErrorRateModel:
+    """PER-LUT model (table-based-error-rate-model.cc — upstream's HE
+    default): SNR-dB-gridded PER table + linear interpolation + the
+    (1-PER)^(L/L_ref) size-scaling law.  Table provenance is documented
+    in ops/wifi_error.py (generated from the NIST forms, not copied)."""
+
+    def chunk_success(self, mode: WifiMode, snr: float, nbits: float) -> float:
+        return table_chunk_success_rate_py(snr, nbits, mode.index)
+
+
+ERROR_RATE_MODELS = {
+    "tpudes::NistErrorRateModel": NistErrorRateModel,
+    "tpudes::TableBasedErrorRateModel": TableBasedErrorRateModel,
+}
 
 
 class WifiPhyState:
@@ -73,6 +110,7 @@ class InterferenceHelper:
     def __init__(self, noise_figure_db: float = 7.0, bandwidth_hz: float = 20e6):
         self.set_noise(noise_figure_db, bandwidth_hz)
         self._events: list[_Event] = []
+        self.error_model = NistErrorRateModel()
 
     def set_noise(self, noise_figure_db: float, bandwidth_hz: float) -> None:
         self.noise_w = (
@@ -135,12 +173,44 @@ class InterferenceHelper:
         psr = 1.0
         for snr, dur_s in self.snr_chunks(event):
             nbits = mode.data_rate_bps * dur_s
-            psr *= chunk_success_rate_py(snr, nbits, mode.constellation, mode.rate_class)
+            psr *= self.error_model.chunk_success(mode, snr, nbits)
         return 1.0 - psr
+
+    def mpdu_success_probs(self, event: _Event, fractions) -> list[float]:
+        """Per-MPDU decode probabilities for an A-MPDU PPDU: each MPDU
+        owns ``fractions[i]`` of the PPDU's bits, so its success is the
+        chunk product with nbits scaled by that share (the per-MPDU PER
+        split upstream's interference helper performs per PSDU).
+
+        Both error models are exp(nbits·k(snr)) in nbits, so the scaled
+        product equals the full-frame PSR raised to the fraction — one
+        chunk pass serves every subframe."""
+        psr_full = 1.0 - self.calculate_per(event)
+        if psr_full <= 0.0:
+            return [0.0 for _ in fractions]
+        return [psr_full ** frac for frac in fractions]
 
     def first_snr(self, event: _Event) -> float:
         chunks = self.snr_chunks(event)
         return chunks[0][0] if chunks else 0.0
+
+
+class AmpduTag:
+    """Marks a PPDU as an A-MPDU (wifi-psdu/mpdu-aggregator analog).
+
+    ``subframes`` is a tuple of (mpdu_packet, onair_bytes) — each MPDU
+    packet already carries its WifiMacHeader; ``onair_bytes`` includes
+    the 4-byte MPDU delimiter, FCS, and pad-to-4.  The PHY fills
+    ``survivors`` (tuple[bool]) at decode time; the receiving MAC builds
+    its BlockAck bitmap from it."""
+
+    def __init__(self, subframes):
+        self.subframes = tuple(subframes)
+        self.survivors = None
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(b for _, b in self.subframes)
 
 
 class YansWifiPhy(Object):
@@ -163,6 +233,13 @@ class YansWifiPhy(Object):
         .AddAttribute("RxNoiseFigure", "dB", 7.0, field="noise_figure")
         .AddAttribute("ChannelWidth", "MHz", 20, field="channel_width")
         .AddAttribute("Frequency", "carrier (Hz)", 5.18e9, field="frequency")
+        .AddAttribute(
+            "ErrorRateModel",
+            "PER provider: tpudes::NistErrorRateModel (closed-form) or "
+            "tpudes::TableBasedErrorRateModel (PER LUT, the HE default "
+            "upstream)",
+            "tpudes::NistErrorRateModel", field="error_rate_model_name",
+        )
         .AddTraceSource("PhyTxBegin", "(packet, tx_power_w)")
         .AddTraceSource("PhyTxEnd", "(packet)")
         .AddTraceSource("PhyRxBegin", "(packet, rx_power_w)")
@@ -180,6 +257,9 @@ class YansWifiPhy(Object):
         self._state = WifiPhyState.IDLE
         self._state_until = 0  # ticks when TX/RX/CCA_BUSY ends
         self._interference = InterferenceHelper(self.noise_figure, self.channel_width * 1e6)
+        self._interference.error_model = ERROR_RATE_MODELS[
+            str(self.error_rate_model_name).replace("ns3::", "tpudes::")
+        ]()
         self._current_rx: _Event | None = None
         self._rx_ok_callback = None
         self._rx_error_callback = None
@@ -329,12 +409,41 @@ class YansWifiPhy(Object):
         if self._current_rx is not event:
             return  # aborted by our own TX
         self._current_rx = None
+        tag = event.packet.PeekPacketTag(AmpduTag) if hasattr(event.packet, "PeekPacketTag") else None
+        if tag is not None:
+            self._end_rx_ampdu(event, tag)
+            return
         per = self._interference.calculate_per(event)
         snr = self._interference.first_snr(event)
         self.phy_rx_end(event.packet)
         for listener in self._listeners:
             listener.NotifyRxEnd()
         if self._rng.GetValue() > per:
+            self.monitor_sniffer_rx(event.packet, snr, event.mode)
+            if self._rx_ok_callback is not None:
+                self._rx_ok_callback(event.packet, snr, event.mode)
+        else:
+            self.phy_rx_drop(event.packet, "error")
+            if self._rx_error_callback is not None:
+                self._rx_error_callback(event.packet, snr)
+        self._maybe_idle()
+
+    def _end_rx_ampdu(self, event, tag: AmpduTag):
+        """Per-MPDU decode of an A-MPDU PPDU: each subframe gets its own
+        success coin at its share of the PPDU bits; the PPDU is delivered
+        up (with ``tag.survivors`` filled) when at least one MPDU decodes
+        — the receiving MAC answers with a BlockAck covering exactly the
+        surviving sequence numbers."""
+        total = max(tag.total_bytes, 1)
+        fractions = [b / total for _, b in tag.subframes]
+        probs = self._interference.mpdu_success_probs(event, fractions)
+        snr = self._interference.first_snr(event)
+        self.phy_rx_end(event.packet)
+        for listener in self._listeners:
+            listener.NotifyRxEnd()
+        survivors = tuple(self._rng.GetValue() < p for p in probs)
+        tag.survivors = survivors
+        if any(survivors):
             self.monitor_sniffer_rx(event.packet, snr, event.mode)
             if self._rx_ok_callback is not None:
                 self._rx_ok_callback(event.packet, snr, event.mode)

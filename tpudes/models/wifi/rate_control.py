@@ -15,7 +15,13 @@ import math
 
 from tpudes.core.object import Object, TypeId
 from tpudes.core.rng import UniformRandomVariable
-from tpudes.ops.wifi_error import MODES_BY_NAME, OFDM_MODES, WifiMode, chunk_success_rate_py
+from tpudes.ops.wifi_error import (
+    HT_MODES,
+    MODES_BY_NAME,
+    OFDM_MODES,
+    WifiMode,
+    chunk_success_rate_py,
+)
 
 
 class WifiRemoteStationManager(Object):
@@ -50,6 +56,15 @@ class WifiRemoteStationManager(Object):
 
     def report_rx_snr(self, addr, snr: float) -> None:
         pass
+
+    def report_ampdu_tx_status(self, addr, n_ok: int, n_failed: int) -> None:
+        """A-MPDU outcome from a BlockAck bitmap; the default folds it
+        into the per-frame hooks (algorithms with native aggregate
+        statistics — MinstrelHt — override)."""
+        for _ in range(n_ok):
+            self.report_data_ok(addr)
+        for _ in range(n_failed):
+            self.report_data_failed(addr)
 
 
 class ConstantRateWifiManager(WifiRemoteStationManager):
@@ -248,10 +263,40 @@ class MinstrelWifiManager(WifiRemoteStationManager):
         return 1
 
 
+class MinstrelHtWifiManager(MinstrelWifiManager):
+    """MinstrelHt (minstrel-ht-wifi-manager.cc, simplified to the 1-SS
+    20 MHz rate group this build models): the Minstrel EWMA sampler over
+    the HT/VHT/HE MCS ladder, with aggregate-aware statistics — a
+    BlockAck reports per-MPDU (ok, failed) counts in one update rather
+    than upstream's per-frame report stream."""
+
+    tid = (
+        TypeId("tpudes::MinstrelHtWifiManager")
+        .SetParent(WifiRemoteStationManager.tid)
+        .AddConstructor(lambda **kw: MinstrelHtWifiManager(**kw))
+        .AddAttribute("LookAroundRate", "sampling fraction", 0.1, field="lookaround")
+        .AddAttribute("Ewma", "EWMA weight on history", 0.75, field="ewma")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._modes = list(HT_MODES)
+
+    def report_ampdu_tx_status(self, addr, n_ok: int, n_failed: int) -> None:
+        """A-MPDU outcome: one EWMA update at the observed MPDU success
+        ratio (minstrel-ht's UpdateRate over the BlockAck bitmap)."""
+        total = n_ok + n_failed
+        if total <= 0:
+            return
+        st = self._st(addr)
+        self._update(st, st["last_mode"], n_ok / total)
+
+
 RATE_MANAGERS = {
     "tpudes::ConstantRateWifiManager": ConstantRateWifiManager,
     "tpudes::ArfWifiManager": ArfWifiManager,
     "tpudes::AarfWifiManager": AarfWifiManager,
     "tpudes::IdealWifiManager": IdealWifiManager,
     "tpudes::MinstrelWifiManager": MinstrelWifiManager,
+    "tpudes::MinstrelHtWifiManager": MinstrelHtWifiManager,
 }

@@ -23,7 +23,10 @@ _LAZY = {
     "WindowParams": ("tpudes.parallel.kernels", "WindowParams"),
     "lte_tti_sinr": ("tpudes.parallel.kernels", "lte_tti_sinr"),
     "multi_window_scan": ("tpudes.parallel.kernels", "multi_window_scan"),
-    "replicated": ("tpudes.parallel.kernels", "replicated"),
+    # NOTE: the kernels.replicated vmap factory is NOT aliased here —
+    # the name would collide with the tpudes.parallel.replicated
+    # submodule (first import wins, making resolution order-dependent);
+    # import it from tpudes.parallel.kernels directly
     "wifi_phy_window": ("tpudes.parallel.kernels", "wifi_phy_window"),
     "lbts_grant": ("tpudes.parallel.mesh", "lbts_grant"),
     "make_replica_batch": ("tpudes.parallel.mesh", "make_replica_batch"),

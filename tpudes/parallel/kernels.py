@@ -30,7 +30,7 @@ import jax.numpy as jnp
 
 from tpudes.ops.interference import thermal_noise_w
 from tpudes.ops.propagation import dbm_to_w, log_distance, pairwise_distance
-from tpudes.ops.wifi_error import mode_chunk_success_rate
+from tpudes.ops.wifi_error import mode_chunk_success_rate, table_chunk_success_rate
 
 
 @dataclass(frozen=True)
@@ -43,6 +43,9 @@ class WindowParams:
     path_loss_exponent: float = 3.0
     reference_loss_db: float = 46.6777
     rx_sensitivity_dbm: float = -101.0
+    #: PER provider: "nist" (closed form) or "table" (PER LUT — the
+    #: TableBasedErrorRateModel kernel form)
+    error_model: str = "nist"
 
     @property
     def noise_w(self) -> float:
@@ -82,7 +85,12 @@ def wifi_phy_window(
     sinr = rx_w / (params.noise_w + interference)
 
     nbits = 8.0 * frame_bytes[:, None]
-    psr = mode_chunk_success_rate(sinr, nbits, mode_idx[:, None])
+    success = (
+        table_chunk_success_rate
+        if params.error_model == "table"
+        else mode_chunk_success_rate
+    )
+    psr = success(sinr, nbits, mode_idx[:, None])
     coin = jax.random.uniform(key, (n, n))
     detectable = rx_dbm >= params.rx_sensitivity_dbm
     receiving = (1.0 - tx_active)[None, :] > 0             # half-duplex rx

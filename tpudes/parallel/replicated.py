@@ -103,11 +103,17 @@ class BssProgram:
         return int(self.positions.shape[0])
 
 
+def _preamble_us(mode) -> int:
+    """20 µs legacy preamble+L-SIG; HT-family adds the 16 µs HT-mixed
+    fields (phy.HT_PREAMBLE_EXTRA_S)."""
+    return 36 if mode.standard == "ht" else 20
+
+
 def _ppdu_us(size_bytes: int, mode) -> int:
     """PPDU airtime in whole µs (ceil), matching phy.ppdu_duration_s."""
     ndbps = mode.data_rate_bps * 4e-6
     nsym = math.ceil((16 + 8 * size_bytes + 6) / ndbps)
-    return math.ceil((16e-6 + 4e-6 + nsym * 4e-6) * 1e6)
+    return _preamble_us(mode) + nsym * 4
 
 
 class UnliftableScenarioError(ValueError):
@@ -171,6 +177,13 @@ def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProg
             "adaptive rate control diverges per replica"
         )
     data_mode = sm.get_data_mode(None)
+    for dev in [ap_device] + list(sta_devices):
+        m = dev.GetMac()
+        if int(getattr(m, "max_ampdu_size", 0)) > 0:
+            raise UnliftableScenarioError(
+                "A-MPDU aggregation (MaxAmpduSize > 0) is not represented "
+                "by the replica engine's single-MPDU exchange model"
+            )
 
     n = len(nodes)
     start = np.full((n,), INF, dtype=np.int64)
@@ -286,7 +299,10 @@ def build_bss_step(prog: BssProgram, replicas: int):
     # runs over the whole PPDU airtime at the payload rate, preamble
     # included — nbits = rate × airtime, not 8 × PSDU bytes
     ndbps = data_mode.data_rate_bps * 4e-6
-    data_airtime_s = 20e-6 + math.ceil((16 + 8 * prog.data_bytes + 6) / ndbps) * 4e-6
+    data_airtime_s = (
+        _preamble_us(data_mode) * 1e-6
+        + math.ceil((16 + 8 * prog.data_bytes + 6) / ndbps) * 4e-6
+    )
     nbits_data = float(data_mode.data_rate_bps * data_airtime_s)
 
     # --- static per-pair physics (positions are constant in this scenario)
