@@ -44,9 +44,17 @@ def main(argv=None):
     cmd.AddValue("simTime", "simulated seconds", 2.0)
     cmd.AddValue("packetSize", "UDP payload bytes", 512)
     cmd.AddValue("interval", "client send interval (s)", 0.1)
+    cmd.AddValue("standard", "80211a (legacy) or 80211n (HT: QoS + A-MPDU)", "80211a")
+    cmd.AddValue("dataMode", "ConstantRate data mode ('' = per-standard default)", "")
     cmd.Parse(argv)
     n_stas = int(cmd.nStas)
     sim_time = float(cmd.simTime)
+    from tpudes.models.wifi.helper import HT_STANDARDS
+
+    standard = str(cmd.standard)
+    data_mode = str(cmd.dataMode) or (
+        "HtMcs7" if standard in HT_STANDARDS else "OfdmRate54Mbps"
+    )
 
     nodes = NodeContainer()
     nodes.Create(n_stas + 1)  # node 0 = AP
@@ -62,7 +70,8 @@ def main(argv=None):
     phy = YansWifiPhyHelper()
     phy.SetChannel(channel)
     wifi = WifiHelper()
-    wifi.SetRemoteStationManager("tpudes::ConstantRateWifiManager", DataMode="OfdmRate54Mbps")
+    wifi.SetStandard(standard)
+    wifi.SetRemoteStationManager("tpudes::ConstantRateWifiManager", DataMode=data_mode)
 
     ap_mac = WifiMacHelper()
     ap_mac.SetType("tpudes::ApWifiMac")

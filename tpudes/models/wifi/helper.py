@@ -163,9 +163,11 @@ class WifiHelper:
             device.SetPhy(phy)
             mac = mac_helper.Create()
             if self._standard in HT_STANDARDS:
-                mac.qos_supported = True
-                # only default aggregation on when the user did not set
-                # MaxAmpduSize explicitly (an explicit 0 disables it)
+                # HT defaults apply only where the user did not set the
+                # attribute explicitly (an explicit QosSupported=False /
+                # MaxAmpduSize=0 must win over the standard's default)
+                if "QosSupported" not in mac_helper._kwargs:
+                    mac.qos_supported = True
                 if "MaxAmpduSize" not in mac_helper._kwargs:
                     mac.max_ampdu_size = 65535
             manager = RATE_MANAGERS[self._manager_type](**self._manager_kwargs)

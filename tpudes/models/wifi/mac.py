@@ -126,11 +126,18 @@ class WifiMacHeader(Header):
             # Wrap-aware start: pick the acked seq from which every other
             # acked seq is < 64 modulo-4096 steps ahead, so an ack set
             # straddling the 12-bit wrap (e.g. {4094, 4095, 0, 1}) still
-            # fits the bitmap.
-            start, bitmap = 0, 0
+            # fits the bitmap.  Per-destination sequence spaces keep BA
+            # sets within one 64-window; if a pathological set still
+            # spans wider, keep the start covering the MOST acked seqs
+            # (never the silent start=0 that acks almost nothing).
+            start, bitmap, best_cover = 0, 0, -1
             for cand in self.ba_seqs:
-                if all(((s - cand) & 0xFFF) < 64 for s in self.ba_seqs):
-                    start = cand
+                cover = sum(
+                    1 for s in self.ba_seqs if ((s - cand) & 0xFFF) < 64
+                )
+                if cover > best_cover:
+                    best_cover, start = cover, cand
+                if cover == len(self.ba_seqs):
                     break
             for s in self.ba_seqs:
                 off = (s - start) & 0xFFF
@@ -397,7 +404,7 @@ class WifiMac(Object):
         self._access: ChannelAccessManager | None = None
         self._ack_timeout_event = None
         self._cts_timeout_event = None
-        self._seq = 0
+        self._seq_counters: dict[str, int] = {}
         self._retries = 0
         self._dup_cache: dict = {}  # ta -> last seq
         self._forward_up = None
@@ -483,7 +490,7 @@ class WifiMac(Object):
         req = Packet(9)  # ADDBA action payload (category/action/params)
         header = WifiMacHeader(
             WifiMacType.ADDBA_REQ, addr1=peer, addr2=self._address,
-            addr3=peer, seq=self._next_seq(),
+            addr3=peer, seq=self._next_seq(peer),
         )
         self._enqueue_frame(req, header)
 
@@ -622,6 +629,13 @@ class WifiMac(Object):
         if n_ok:
             self._access.notify_success()
             self._dequeue()
+        elif not requeue:
+            # every MPDU hit its retry limit and dropped — CW resets as
+            # on a single-MPDU final drop (_on_ack_timeout); the next
+            # head-of-line frame starts with a fresh window
+            self._access.reset_cw()
+            if self._pop_current():
+                self._access.request_access(allow_immediate=False)
         else:
             self._access.notify_failure()
             if self._pop_current():
@@ -830,9 +844,15 @@ class WifiMac(Object):
         self._access.notify_success()
         self._dequeue()
 
-    def _next_seq(self) -> int:
-        self._seq = (self._seq + 1) & 0xFFF
-        return self._seq
+    def _next_seq(self, to=None) -> int:
+        """Per-destination 12-bit sequence space (upstream keeps one
+        counter per RA/TID pair): BA sessions are per-destination, so a
+        shared counter would let one peer's A-MPDU carry seqs more than
+        64 modulo-4096 steps apart — unserializable in a compressed-BA
+        bitmap."""
+        key = str(to) if to is not None else "*"
+        self._seq_counters[key] = (self._seq_counters.get(key, 0) + 1) & 0xFFF
+        return self._seq_counters[key]
 
     # --- rx path ---
     def _rx_ok(self, packet: Packet, snr: float, mode: WifiMode):
@@ -882,7 +902,7 @@ class WifiMac(Object):
             rheader = WifiMacHeader(
                 WifiMacType.ADDBA_RESP, addr1=header.addr2,
                 addr2=self._address, addr3=header.addr2,
-                seq=self._next_seq(),
+                seq=self._next_seq(header.addr2),
             )
             self._enqueue_frame(resp, rheader)
             return
@@ -955,7 +975,7 @@ class AdhocWifiMac(WifiMac):
 
     def Enqueue(self, packet, to):
         header = WifiMacHeader(
-            WifiMacType.DATA, addr1=to, addr2=self._address, addr3=to, seq=self._next_seq()
+            WifiMacType.DATA, addr1=to, addr2=self._address, addr3=to, seq=self._next_seq(to)
         )
         self._enqueue_frame(packet, header)
 
@@ -996,7 +1016,7 @@ class ApWifiMac(WifiMac):
             addr1=Mac48Address.GetBroadcast(),
             addr2=self._address,
             addr3=self._address,
-            seq=self._next_seq(),
+            seq=self._next_seq(),  # broadcast: shared counter
         )
         self._enqueue_frame(beacon, header)
         Simulator.Schedule(MicroSeconds(self.beacon_interval_us), self._send_beacon)
@@ -1007,7 +1027,7 @@ class ApWifiMac(WifiMac):
             addr1=to,
             addr2=self._address,
             addr3=self._address,
-            seq=self._next_seq(),
+            seq=self._next_seq(to),
             from_ds=True,
         )
         self._enqueue_frame(packet, header)
@@ -1022,7 +1042,7 @@ class ApWifiMac(WifiMac):
                 addr1=header.addr2,
                 addr2=self._address,
                 addr3=self._address,
-                seq=self._next_seq(),
+                seq=self._next_seq(header.addr2),
             )
             self._enqueue_frame(resp, rheader)
         elif header.IsData():
@@ -1077,7 +1097,7 @@ class StaWifiMac(WifiMac):
             addr1=self._ap,
             addr2=self._address,
             addr3=to,
-            seq=self._next_seq(),
+            seq=self._next_seq(self._ap),
             to_ds=True,
         )
         self._enqueue_frame(packet, header)
@@ -1090,7 +1110,7 @@ class StaWifiMac(WifiMac):
             addr1=self._ap,
             addr2=self._address,
             addr3=self._ap,
-            seq=self._next_seq(),
+            seq=self._next_seq(self._ap),
         )
         self._enqueue_frame(req, rheader)
 

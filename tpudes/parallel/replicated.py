@@ -22,7 +22,12 @@ vector update.
 
 Scope: the infrastructure-BSS scenario (BASELINE.json config #3) — AP +
 N STAs, DCF MAC, Yans PHY with log-distance loss, NIST error model, UDP
-echo traffic, beacons.  ``lower_bss`` builds the program's static inputs
+echo traffic, beacons.  HT (802.11n) graphs lift too: QoS AC_BE AIFS,
+HT-mixed preamble timing, and A-MPDU aggregation under an established
+BlockAck session (every data exchange becomes backlog-sized A-MPDU +
+compressed BA, per-MPDU decode at the subframe bit share — the
+phy._end_rx_ampdu model vectorized).  The ADDBA handshake, like
+association/ARP, is warm-up and not modeled.  ``lower_bss`` builds the program's static inputs
 from the *live object graph* a scenario script constructed (helpers,
 attributes, station manager), so ``wifi-bss.py --replicas=R`` runs the
 same config the sequential engine runs.  The scalar DES remains the
@@ -97,6 +102,15 @@ class BssProgram:
     noise_figure_db: float = 7.0
     bandwidth_hz: float = 20e6
     rx_sensitivity_dbm: float = -101.0
+    #: contention AIFS for data (DIFS legacy; SIFS+3·SLOT for QoS AC_BE)
+    aifs_us: int = DIFS
+    #: A-MPDU cap: >1 turns every data exchange into an aggregated
+    #: PPDU + compressed BlockAck under an (assumed-established) BA
+    #: session; 1 = legacy single-MPDU DATA/ACK
+    max_mpdus: int = 1
+    #: on-air bytes of one A-MPDU subframe (delimiter + MPDU + FCS,
+    #: padded to 4) — used instead of data_bytes when max_mpdus > 1
+    subframe_bytes: int = 0
 
     @property
     def n(self) -> int:
@@ -177,13 +191,21 @@ def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProg
             "adaptive rate control diverges per replica"
         )
     data_mode = sm.get_data_mode(None)
-    for dev in [ap_device] + list(sta_devices):
-        m = dev.GetMac()
-        if int(getattr(m, "max_ampdu_size", 0)) > 0:
-            raise UnliftableScenarioError(
-                "A-MPDU aggregation (MaxAmpduSize > 0) is not represented "
-                "by the replica engine's single-MPDU exchange model"
-            )
+    ampdu_sizes = {
+        int(getattr(dev.GetMac(), "max_ampdu_size", 0))
+        for dev in [ap_device] + list(sta_devices)
+    }
+    qos_flags = {
+        bool(getattr(dev.GetMac(), "qos_supported", False))
+        for dev in [ap_device] + list(sta_devices)
+    }
+    if len(ampdu_sizes) > 1 or len(qos_flags) > 1:
+        raise UnliftableScenarioError(
+            f"mixed per-device MAC configs (MaxAmpduSize {sorted(ampdu_sizes)}, "
+            f"QosSupported {sorted(qos_flags)}) cannot ride one vector MAC model"
+        )
+    max_ampdu_size = ampdu_sizes.pop()
+    qos = qos_flags.pop()
 
     n = len(nodes)
     start = np.full((n,), INF, dtype=np.int64)
@@ -211,8 +233,21 @@ def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProg
 
     # on-air data PSDU: payload + UDP(8) + IPv4(20) + LLC/SNAP(8) + MAC(24) + FCS(4)
     data_bytes = payload + 8 + 20 + 8 + MAC_HEADER_SIZE + FCS_SIZE
-    # the MAC protects strictly-larger frames (size > threshold)
-    if int(getattr(mac, "rts_cts_threshold", 65535)) < data_bytes:
+    # aggregation (mpdu-aggregator analog): every data exchange to an
+    # established-BA peer becomes an A-MPDU + compressed BlockAck; the
+    # two-frame ADDBA handshake is warm-up, excluded like association/ARP
+    max_mpdus, subframe_bytes = 1, 0
+    if max_ampdu_size > 0:
+        from tpudes.models.wifi.mac import MAX_AMPDU_FRAMES, _ampdu_subframe_bytes
+
+        subframe_bytes = _ampdu_subframe_bytes(
+            payload + 8 + 20 + 8 + MAC_HEADER_SIZE
+        )
+        max_mpdus = max(1, min(MAX_AMPDU_FRAMES, max_ampdu_size // subframe_bytes))
+    # the MAC protects strictly-larger frames (size > threshold);
+    # A-MPDU exchanges never go through the RTS path (host
+    # _on_access_granted aggregates before the NeedRts check)
+    if max_mpdus <= 1 and int(getattr(mac, "rts_cts_threshold", 65535)) < data_bytes:
         raise UnliftableScenarioError(
             "RTS/CTS protection engages at this frame size; the replica "
             "axis models the basic DATA/ACK exchange only"
@@ -237,6 +272,11 @@ def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProg
         noise_figure_db=float(phy.noise_figure),
         bandwidth_hz=float(phy.channel_width) * 1e6,
         rx_sensitivity_dbm=float(phy.rx_sensitivity),
+        # QoS data rides AC_BE (AIFSN 3); beacons' AC_VO AIFS (34 µs)
+        # is approximated by the same value — ≤9 µs per beacon
+        aifs_us=(SIFS + 3 * SLOT) if qos else DIFS,
+        max_mpdus=max_mpdus,
+        subframe_bytes=subframe_bytes,
     )
 
     # --- mutual-sensing guard (documented carrier-sense deviation): the
@@ -289,18 +329,21 @@ def build_bss_step(prog: BssProgram, replicas: int):
 
     data_mode = ALL_MODES[prog.data_mode_idx]
     ack_mode = ALL_MODES[prog.ack_mode_idx]
+    AGG = prog.max_mpdus > 1
+    K = prog.max_mpdus
+    AIFS = int(prog.aifs_us)
     data_dur = _ppdu_us(prog.data_bytes, data_mode)
-    ack_dur = _ppdu_us(14, ack_mode)
-    exch_data = data_dur + SIFS + ack_dur   # acked exchange airtime
-    # failed sender's personal wait (mac._send_current timeout budget)
-    ack_timeout = exch_data + SLOT + 4
+    # under a BA session the response is a compressed BlockAck (32 B),
+    # else a normal ack (14 B) — both at the control answer rate
+    resp_dur = _ppdu_us(32 if AGG else 14, ack_mode)
     exch_beacon = _ppdu_us(prog.beacon_bytes, MODES_BY_NAME["OfdmRate6Mbps"])
+    preamble_data = _preamble_us(data_mode)
     # DES convention (InterferenceHelper.calculate_per): the PER integral
     # runs over the whole PPDU airtime at the payload rate, preamble
     # included — nbits = rate × airtime, not 8 × PSDU bytes
     ndbps = data_mode.data_rate_bps * 4e-6
     data_airtime_s = (
-        _preamble_us(data_mode) * 1e-6
+        preamble_data * 1e-6
         + math.ceil((16 + 8 * prog.data_bytes + 6) / ndbps) * 4e-6
     )
     nbits_data = float(data_mode.data_rate_bps * data_airtime_s)
@@ -351,7 +394,7 @@ def build_bss_step(prog: BssProgram, replicas: int):
         """(R, N) earliest allowed tx instant per contender; INF else."""
         frame = has_frame(s)
         base = jnp.maximum(s["busy_until"][:, None], s["hold"])
-        countdown = base + DIFS + s["backoff"] * SLOT
+        countdown = base + AIFS + s["backoff"] * SLOT
         t_imm = jnp.maximum(s["t"][:, None], base)
         tx = jnp.where(s["immediate"], t_imm, countdown)
         tx = jnp.maximum(tx, s["t"][:, None])  # never in the past
@@ -359,9 +402,14 @@ def build_bss_step(prog: BssProgram, replicas: int):
 
     def step_fn(s, key):
         k = jax.random.fold_in(key, s["step"])
-        k_back, k_coin = jax.random.split(k)
-        u_back = jax.random.uniform(k_back, (R, n))
-        u_coin = jax.random.uniform(k_coin, (R, n))
+        if AGG:
+            k_back, k_mpdu = jax.random.split(k)
+            u_back = jax.random.uniform(k_back, (R, n))
+            u_mpdu = jax.random.uniform(k_mpdu, (R, n, K))
+        else:
+            k_back, k_coin = jax.random.split(k)
+            u_back = jax.random.uniform(k_back, (R, n))
+            u_coin = jax.random.uniform(k_coin, (R, n))
 
         frame = has_frame(s)
         tx_t = tx_times(s)                               # (R, N)
@@ -393,7 +441,7 @@ def build_bss_step(prog: BssProgram, replicas: int):
             frame_after,
         )
         became_hol = is_arr & ~frame & frame_after
-        medium_idle = next_t >= s["busy_until"] + DIFS   # idle ≥ DIFS now
+        medium_idle = next_t >= s["busy_until"] + AIFS   # idle ≥ AIFS now
         imm_grant = became_hol & medium_idle[:, None]
         drawn = (u_back * (s["cw"] + 1).astype(jnp.float32)).astype(jnp.int32)
         new_backoff = jnp.where(became_hol & ~imm_grant, drawn, s["backoff"])
@@ -404,7 +452,7 @@ def build_bss_step(prog: BssProgram, replicas: int):
         any_win = jnp.any(winners, axis=1)
         # countdown credit for non-winning contenders (freeze bookkeeping):
         # idle slots elapsed since busy-end+DIFS is what everyone consumed
-        elapsed = jnp.maximum((next_t - s["busy_until"] - DIFS) // SLOT, 0)
+        elapsed = jnp.maximum((next_t - s["busy_until"] - AIFS) // SLOT, 0)
         counting = frame & ~winners & ~s["immediate"] & transmit[:, None]
         new_backoff = jnp.where(
             counting,
@@ -427,40 +475,72 @@ def build_bss_step(prog: BssProgram, replicas: int):
         sig = rx_w[jnp.arange(n)[None, :], dst]          # (R, N): tx i → dst_i
         interf = jnp.take_along_axis(total_at, dst, axis=1) - sig
         sinr = sig / (noise_w + interf)
-        psr = mode_chunk_success_rate(
-            sinr, jnp.asarray(nbits_data, jnp.float32),
-            jnp.asarray(prog.data_mode_idx),
-        )
         det = detectable[jnp.arange(n)[None, :], dst]
         dst_idle = ~jnp.take_along_axis(winners, dst, axis=1)   # half-duplex
-        ok = winners & (u_coin < psr) & det & dst_idle
         beacon_tx = winners & is_ap[None, :] & ap_sends_beacon[:, None]
         data_tx = winners & ~beacon_tx
-        success = ok & data_tx
-        fail = data_tx & ~ok
+        gate = data_tx & det & dst_idle
+        if AGG:
+            # A-MPDU: the winner aggregates its whole backlog (up to the
+            # BA-window/MaxAmpduSize cap) into one PPDU; per-MPDU decode
+            # is the full-PPDU PSR at each subframe's bit share
+            # (phy.mpdu_success_probs — equal shares → psr^(1/k))
+            k_sta = jnp.minimum(s["queue"], K)
+            k_ap = jnp.minimum(
+                jnp.take_along_axis(s["ap_pend"], dst, axis=1), K
+            )
+            k_agg = jnp.maximum(
+                jnp.where(is_ap[None, :], k_ap, k_sta), 1
+            ).astype(jnp.int32)
+            nsym = jnp.ceil(
+                (22.0 + 8.0 * prog.subframe_bytes * k_agg) / ndbps
+            )
+            dur_k = preamble_data + (nsym * 4).astype(jnp.int32)
+            nbits_k = (
+                jnp.float32(data_mode.data_rate_bps * 1e-6)
+                * dur_k.astype(jnp.float32)
+            )
+            psr = mode_chunk_success_rate(
+                sinr, nbits_k, jnp.asarray(prog.data_mode_idx)
+            )
+            p_mpdu = psr ** (1.0 / k_agg.astype(jnp.float32))
+            mpdu_ok = (u_mpdu < p_mpdu[..., None]) & (
+                jnp.arange(K)[None, None, :] < k_agg[..., None]
+            )
+            n_ok = jnp.where(gate, mpdu_ok.sum(-1, dtype=jnp.int32), 0)
+        else:
+            k_agg = jnp.ones((R, n), jnp.int32)
+            dur_k = jnp.full((R, n), data_dur, jnp.int32)
+            psr = mode_chunk_success_rate(
+                sinr, jnp.asarray(nbits_data, jnp.float32),
+                jnp.asarray(prog.data_mode_idx),
+            )
+            n_ok = jnp.where(gate & (u_coin < psr), 1, 0).astype(jnp.int32)
+        success = data_tx & (n_ok > 0)
+        fail = data_tx & (n_ok == 0)
 
-        # ---- outcome updates
-        sta_success = success & ~is_ap[None, :]
-        ap_success = success & is_ap[None, :]
-        new_srv = s["srv_rx"] + jnp.sum(sta_success, axis=1)
-        got_echo = jnp.any(ap_success, axis=1)
-        new_cli = s["cli_rx"].at[jnp.arange(R), echo_dst].add(
-            got_echo.astype(jnp.int32)
-        )
-        new_queue = new_queue - sta_success.astype(jnp.int32)
-        new_ap_pend = s["ap_pend"] + sta_success.astype(jnp.int32)
-        new_ap_pend = new_ap_pend.at[jnp.arange(R), echo_dst].add(
-            -got_echo.astype(jnp.int32)
-        )
+        # ---- outcome updates (counts generalize the single-MPDU 0/1)
+        sta_ok = jnp.where(~is_ap[None, :], n_ok, 0)
+        ap_ok = jnp.where(is_ap[None, :], n_ok, 0)
+        new_srv = s["srv_rx"] + jnp.sum(sta_ok, axis=1)
+        got_echo = jnp.sum(ap_ok, axis=1)
+        new_cli = s["cli_rx"].at[jnp.arange(R), echo_dst].add(got_echo)
+        new_queue = new_queue - sta_ok
+        new_ap_pend = s["ap_pend"] + sta_ok
+        new_ap_pend = new_ap_pend.at[jnp.arange(R), echo_dst].add(-got_echo)
         new_bcn = new_bcn - jnp.where(ap_sends_beacon, 1, 0)
 
+        # node-level retry counter: bumps on a zero-success exchange,
+        # resets on any success; at the limit the whole head A-MPDU
+        # drops (host: per-MPDU counts — coincides in the all-fail runs
+        # that actually reach the limit; partial-success histories drop
+        # slightly later here — documented deviation)
         retry_exceeded = fail & (s["retries"] + 1 > RETRY_LIMIT)
-        new_drops = s["drops"] + jnp.sum(retry_exceeded, axis=1)
-        new_queue = new_queue - (retry_exceeded & ~is_ap[None, :]).astype(jnp.int32)
-        drop_echo = jnp.any(retry_exceeded & is_ap[None, :], axis=1)
-        new_ap_pend = new_ap_pend.at[jnp.arange(R), echo_dst].add(
-            -drop_echo.astype(jnp.int32)
-        )
+        drop_n = jnp.where(retry_exceeded, k_agg, 0)
+        new_drops = s["drops"] + jnp.sum(drop_n, axis=1)
+        new_queue = new_queue - jnp.where(~is_ap[None, :], drop_n, 0)
+        drop_echo = jnp.sum(jnp.where(is_ap[None, :], drop_n, 0), axis=1)
+        new_ap_pend = new_ap_pend.at[jnp.arange(R), echo_dst].add(-drop_echo)
         new_retries = jnp.where(
             success | retry_exceeded | beacon_tx,
             0,
@@ -481,9 +561,10 @@ def build_bss_step(prog: BssProgram, replicas: int):
         # medium occupancy: full exchange when acked, bare data airtime on
         # a failure (no ack goes out), beacon airtime for beacons; the
         # failed sender personally waits its ack timeout before recontending
-        occ = jnp.where(
-            success, exch_data, jnp.where(beacon_tx, exch_beacon, data_dur)
-        )
+        exch = dur_k + SIFS + resp_dur       # acked/BA'd exchange airtime
+        # failed sender's personal wait (mac response-timeout budget)
+        resp_timeout = exch + SLOT + 4
+        occ = jnp.where(success, exch, jnp.where(beacon_tx, exch_beacon, dur_k))
         new_busy = jnp.where(
             any_win,
             next_t + jnp.max(jnp.where(winners, occ, 0), axis=1),
@@ -491,7 +572,7 @@ def build_bss_step(prog: BssProgram, replicas: int):
         )
         new_hold = jnp.where(
             fail,
-            next_t[:, None] + ack_timeout,
+            next_t[:, None] + resp_timeout,
             jnp.where(winners, next_t[:, None] + occ, s["hold"]),
         )
 

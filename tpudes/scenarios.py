@@ -39,6 +39,7 @@ def build_bss(
     interval_s: float = 0.1,
     packet_bytes: int = 512,
     data_mode: str = "OfdmRate54Mbps",
+    standard: str = "80211a",
 ):
     """BASELINE config #3: one AP at the origin, ``n_stas`` stations on
     circles of ``radii`` (cycled), UDP echo upstream traffic.
@@ -83,6 +84,7 @@ def build_bss(
     phy = YansWifiPhyHelper()
     phy.SetChannel(channel)
     wifi = WifiHelper()
+    wifi.SetStandard(standard)
     wifi.SetRemoteStationManager(
         "tpudes::ConstantRateWifiManager", DataMode=data_mode
     )
