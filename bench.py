@@ -67,7 +67,10 @@ AS_HOST_S = 2.0
 N_TIMED = 5
 
 
-def bench_wifi():
+def _bench_bss(sim_s, **build_kwargs):
+    """Shared BSS harness: scalar denominator + replica-engine numerator
+    on the SAME object graph, so the legacy and HT WiFi lines are
+    measured identically."""
     import jax
 
     from tpudes.core import Seconds, Simulator
@@ -76,20 +79,20 @@ def bench_wifi():
     from tpudes.scenarios import build_bss
 
     reset_world()
-    sta_devices, ap_device, clients, _ = build_bss(N_STAS, WIFI_SIM_S)
+    sta_devices, ap_device, clients, _ = build_bss(N_STAS, sim_s, **build_kwargs)
     n = sta_devices.GetN()
     prog = lower_bss(
-        [sta_devices.Get(i) for i in range(n)], ap_device, clients, WIFI_SIM_S
+        [sta_devices.Get(i) for i in range(n)], ap_device, clients, sim_s
     )
 
     # --- denominator: DefaultSimulatorImpl on the same graph ------------
     t0 = time.monotonic()
-    Simulator.Stop(Seconds(WIFI_SIM_S))
+    Simulator.Stop(Seconds(sim_s))
     Simulator.Run()
     scalar_wall = time.monotonic() - t0
     scalar_events = Simulator.GetEventCount()
     reset_world()
-    scalar_rate = WIFI_SIM_S / scalar_wall
+    scalar_rate = sim_s / scalar_wall
 
     # --- numerator: replica engine, median of N_TIMED ---------------------
     run_replicated_bss(prog, WIFI_REPLICAS, jax.random.PRNGKey(0))  # compile
@@ -101,8 +104,8 @@ def bench_wifi():
         delivered += int(out["srv_rx"].sum())
         assert out["all_done"]
     med = statistics.median(walls)
-    rate = WIFI_REPLICAS * WIFI_SIM_S / med
-    return dict(
+    rate = WIFI_REPLICAS * sim_s / med
+    return prog, dict(
         sim_s_per_wall_s=rate,
         vs_scalar=rate / scalar_rate,
         wall_median_s=med,
@@ -112,6 +115,11 @@ def bench_wifi():
         scalar_events_per_s=scalar_events / scalar_wall,
         srv_rx_mean=delivered / (N_TIMED * WIFI_REPLICAS),
     )
+
+
+def bench_wifi():
+    _, out = _bench_bss(WIFI_SIM_S)
+    return out
 
 
 def bench_wifi_ht():
@@ -119,53 +127,13 @@ def bench_wifi_ht():
     BlockAck, at an offered load (512 B / 10 ms per STA, doubled by
     echoes) that saturates single-MPDU exchanges so aggregation is
     actually exercised on both engines."""
-    import jax
-
-    from tpudes.core import Seconds, Simulator
-    from tpudes.core.world import reset_world
-    from tpudes.parallel.replicated import lower_bss, run_replicated_bss
-    from tpudes.scenarios import build_bss
-
-    reset_world()
-    sta_devices, ap_device, clients, _ = build_bss(
-        N_STAS, WIFI_HT_SIM_S, interval_s=WIFI_HT_INTERVAL_S,
+    prog, out = _bench_bss(
+        WIFI_HT_SIM_S, interval_s=WIFI_HT_INTERVAL_S,
         data_mode="HtMcs7", standard="80211n",
     )
-    n = sta_devices.GetN()
-    prog = lower_bss(
-        [sta_devices.Get(i) for i in range(n)], ap_device, clients, WIFI_HT_SIM_S
-    )
     assert prog.max_mpdus > 1, "HT bench must exercise aggregation"
-
-    t0 = time.monotonic()
-    Simulator.Stop(Seconds(WIFI_HT_SIM_S))
-    Simulator.Run()
-    scalar_wall = time.monotonic() - t0
-    scalar_events = Simulator.GetEventCount()
-    reset_world()
-    scalar_rate = WIFI_HT_SIM_S / scalar_wall
-
-    run_replicated_bss(prog, WIFI_REPLICAS, jax.random.PRNGKey(0))  # compile
-    walls, delivered = [], 0
-    for i in range(N_TIMED):
-        t0 = time.monotonic()
-        out = run_replicated_bss(prog, WIFI_REPLICAS, jax.random.PRNGKey(1 + i))
-        walls.append(time.monotonic() - t0)
-        delivered += int(out["srv_rx"].sum())
-        assert out["all_done"]
-    med = statistics.median(walls)
-    rate = WIFI_REPLICAS * WIFI_HT_SIM_S / med
-    return dict(
-        sim_s_per_wall_s=rate,
-        vs_scalar=rate / scalar_rate,
-        wall_median_s=med,
-        wall_min_s=min(walls),
-        wall_max_s=max(walls),
-        scalar_sim_s_per_wall_s=scalar_rate,
-        scalar_events_per_s=scalar_events / scalar_wall,
-        srv_rx_mean=delivered / (N_TIMED * WIFI_REPLICAS),
-        max_mpdus=prog.max_mpdus,
-    )
+    out["max_mpdus"] = prog.max_mpdus
+    return out
 
 
 def bench_lte():

@@ -51,7 +51,11 @@ def main(argv=None):
     sim_time = float(cmd.simTime)
     from tpudes.models.wifi.helper import HT_STANDARDS
 
-    standard = str(cmd.standard)
+    # normalize like WifiHelper.SetStandard so the ns-3 spelling
+    # (WIFI_STANDARD_80211n) picks the HT default rate too
+    standard = (
+        str(cmd.standard).replace("WIFI_STANDARD_", "").replace("_", "").lower()
+    )
     data_mode = str(cmd.dataMode) or (
         "HtMcs7" if standard in HT_STANDARDS else "OfdmRate54Mbps"
     )

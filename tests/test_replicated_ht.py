@@ -15,16 +15,6 @@ from dataclasses import replace
 
 from tpudes.core import Seconds, Simulator
 from tpudes.core.rng import RngSeedManager
-from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
-from tpudes.helper.containers import NetDeviceContainer, NodeContainer
-from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
-from tpudes.models.mobility import ListPositionAllocator, MobilityHelper, Vector
-from tpudes.models.wifi import (
-    WifiHelper,
-    WifiMacHelper,
-    YansWifiChannelHelper,
-    YansWifiPhyHelper,
-)
 from tpudes.parallel.replicated import lower_bss, run_replicated_bss
 
 N_STAS = 4
@@ -44,67 +34,13 @@ def _reset_world():
 
 
 def _build_ht_bss(interval=INTERVAL_MODERATE):
-    nodes = NodeContainer()
-    nodes.Create(N_STAS + 1)
+    """The shared config-#3 factory in HT trim (one 16 m ring)."""
+    from tpudes.scenarios import build_bss
 
-    mobility = MobilityHelper()
-    alloc = ListPositionAllocator()
-    alloc.Add(Vector(0.0, 0.0, 0.0))
-    for i in range(N_STAS):
-        a = 2 * math.pi * i / N_STAS
-        alloc.Add(Vector(RADIUS * math.cos(a), RADIUS * math.sin(a), 0.0))
-    mobility.SetPositionAllocator(alloc)
-    mobility.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
-    mobility.Install(nodes)
-
-    channel = YansWifiChannelHelper.Default().Create()
-    phy = YansWifiPhyHelper()
-    phy.SetChannel(channel)
-    wifi = WifiHelper()
-    wifi.SetStandard("80211n")
-    wifi.SetRemoteStationManager(
-        "tpudes::ConstantRateWifiManager", DataMode="HtMcs7"
+    return build_bss(
+        N_STAS, SIM_TIME, radii=(RADIUS,), interval_s=interval,
+        data_mode="HtMcs7", standard="80211n",
     )
-
-    ap_mac = WifiMacHelper()
-    ap_mac.SetType("tpudes::ApWifiMac")
-    ap_devices = wifi.Install(phy, ap_mac, [nodes.Get(0)])
-    sta_mac = WifiMacHelper()
-    sta_mac.SetType("tpudes::StaWifiMac")
-    sta_devices = wifi.Install(
-        phy, sta_mac, [nodes.Get(i) for i in range(1, N_STAS + 1)]
-    )
-
-    stack = InternetStackHelper()
-    stack.Install(nodes)
-    address = Ipv4AddressHelper()
-    address.SetBase("10.1.4.0", "255.255.255.0")
-    devices = NetDeviceContainer()
-    devices.Add(ap_devices.Get(0))
-    for i in range(N_STAS):
-        devices.Add(sta_devices.Get(i))
-    interfaces = address.Assign(devices)
-
-    server = UdpEchoServerHelper(9)
-    server_apps = server.Install(nodes.Get(0))
-    server_apps.Start(Seconds(0.4))
-    server_apps.Stop(Seconds(SIM_TIME))
-    rx = [0]
-    server_apps.Get(0).TraceConnectWithoutContext(
-        "Rx", lambda pkt, *a: rx.__setitem__(0, rx[0] + 1)
-    )
-
-    clients = []
-    for i in range(N_STAS):
-        helper = UdpEchoClientHelper(interfaces.GetAddress(0), 9)
-        helper.SetAttribute("MaxPackets", 1_000_000)
-        helper.SetAttribute("Interval", Seconds(interval))
-        helper.SetAttribute("PacketSize", 512)
-        apps = helper.Install(nodes.Get(1 + i))
-        apps.Start(Seconds(1.0 + 0.001 * i))
-        apps.Stop(Seconds(SIM_TIME))
-        clients.append(apps.Get(0))
-    return sta_devices, ap_devices.Get(0), clients, rx
 
 
 def _lowered_program(interval=INTERVAL_MODERATE):

@@ -630,12 +630,12 @@ class WifiMac(Object):
             self._access.notify_success()
             self._dequeue()
         elif not requeue:
-            # every MPDU hit its retry limit and dropped — CW resets as
-            # on a single-MPDU final drop (_on_ack_timeout); the next
-            # head-of-line frame starts with a fresh window
+            # every MPDU hit its retry limit and dropped — CW resets and
+            # the next head-of-line frame gets a fresh access request,
+            # exactly as on a single-MPDU final drop (_on_ack_timeout →
+            # _dequeue, immediate grant allowed on an idle medium)
             self._access.reset_cw()
-            if self._pop_current():
-                self._access.request_access(allow_immediate=False)
+            self._dequeue()
         else:
             self._access.notify_failure()
             if self._pop_current():
