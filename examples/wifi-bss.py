@@ -49,13 +49,9 @@ def main(argv=None):
     cmd.Parse(argv)
     n_stas = int(cmd.nStas)
     sim_time = float(cmd.simTime)
-    from tpudes.models.wifi.helper import HT_STANDARDS
+    from tpudes.models.wifi.helper import HT_STANDARDS, normalize_standard
 
-    # normalize like WifiHelper.SetStandard so the ns-3 spelling
-    # (WIFI_STANDARD_80211n) picks the HT default rate too
-    standard = (
-        str(cmd.standard).replace("WIFI_STANDARD_", "").replace("_", "").lower()
-    )
+    standard = normalize_standard(str(cmd.standard))
     data_mode = str(cmd.dataMode) or (
         "HtMcs7" if standard in HT_STANDARDS else "OfdmRate54Mbps"
     )

@@ -7,8 +7,6 @@ data exchange is a backlog-sized A-MPDU with per-MPDU decode.  Parity
 is statistical (SURVEY.md §4) on delivered-frame counts.
 """
 
-import math
-
 import jax
 import numpy as np
 from dataclasses import replace

@@ -63,6 +63,12 @@ class MobilityModel(Object):
         .AddTraceSource("CourseChange", "position/velocity changed (model)")
     )
 
+    #: True only when the position cannot change between CourseChange
+    #: notifications (ConstantPosition).  Gliding models (velocity,
+    #: walk, waypoint) move WITHOUT firing the trace, so their
+    #: geometry must never be snapshotted into channel pair tables.
+    is_static = False
+
     def __init__(self, **attributes):
         super().__init__(**attributes)
 
@@ -97,6 +103,8 @@ class MobilityModel(Object):
 
 
 class ConstantPositionMobilityModel(MobilityModel):
+    is_static = True
+
     tid = (
         TypeId("tpudes::ConstantPositionMobilityModel")
         .SetParent(MobilityModel.tid)

@@ -37,6 +37,11 @@ class PropagationLossModel(Object):
     def SetNext(self, next_model: "PropagationLossModel") -> None:
         self._next = next_model
 
+    #: True only on models whose result is a pure function of
+    #: geometry — opt-in, so a user subclass that draws randomness per
+    #: call is never silently frozen into the channel's pair tables
+    is_deterministic = False
+
     def GetNext(self):
         return self._next
 
@@ -68,6 +73,8 @@ class PropagationLossModel(Object):
 
 
 class FriisPropagationLossModel(PropagationLossModel):
+    is_deterministic = True
+
     tid = (
         TypeId("tpudes::FriisPropagationLossModel")
         .SetParent(PropagationLossModel.tid)
@@ -90,6 +97,8 @@ class FriisPropagationLossModel(PropagationLossModel):
 
 
 class LogDistancePropagationLossModel(PropagationLossModel):
+    is_deterministic = True
+
     tid = (
         TypeId("tpudes::LogDistancePropagationLossModel")
         .SetParent(PropagationLossModel.tid)
@@ -111,6 +120,8 @@ class LogDistancePropagationLossModel(PropagationLossModel):
 
 
 class ThreeLogDistancePropagationLossModel(PropagationLossModel):
+    is_deterministic = True
+
     tid = (
         TypeId("tpudes::ThreeLogDistancePropagationLossModel")
         .SetParent(PropagationLossModel.tid)
@@ -142,6 +153,8 @@ class ThreeLogDistancePropagationLossModel(PropagationLossModel):
 
 
 class FixedRssLossModel(PropagationLossModel):
+    is_deterministic = True
+
     tid = (
         TypeId("tpudes::FixedRssLossModel")
         .SetParent(PropagationLossModel.tid)
@@ -157,6 +170,8 @@ class FixedRssLossModel(PropagationLossModel):
 
 
 class RangePropagationLossModel(PropagationLossModel):
+    is_deterministic = True
+
     tid = (
         TypeId("tpudes::RangePropagationLossModel")
         .SetParent(PropagationLossModel.tid)
@@ -172,6 +187,8 @@ class RangePropagationLossModel(PropagationLossModel):
 
 
 class MatrixPropagationLossModel(PropagationLossModel):
+    is_deterministic = True
+
     """Explicit per-(mobility-pair) loss (matrix-propagation-loss-model.cc);
     pairs default to DefaultLoss."""
 
@@ -199,6 +216,10 @@ class MatrixPropagationLossModel(PropagationLossModel):
 
 
 class NakagamiPropagationLossModel(PropagationLossModel):
+    #: draws a fading sample per CalcRxPower call — results must never
+    #: be cached (YansWifiChannel pair tables check this flag)
+    is_deterministic = False
+
     tid = (
         TypeId("tpudes::NakagamiPropagationLossModel")
         .SetParent(PropagationLossModel.tid)
@@ -248,6 +269,9 @@ class NakagamiPropagationLossModel(PropagationLossModel):
 
 
 class PropagationDelayModel(Object):
+    #: mirrors PropagationLossModel.is_deterministic — opt-in cacheability
+    is_deterministic = False
+
     tid = TypeId("tpudes::PropagationDelayModel")
 
     def GetDelay(self, mob_a, mob_b) -> float:
@@ -256,6 +280,8 @@ class PropagationDelayModel(Object):
 
 
 class ConstantSpeedPropagationDelayModel(PropagationDelayModel):
+    is_deterministic = True
+
     tid = (
         TypeId("tpudes::ConstantSpeedPropagationDelayModel")
         .SetParent(PropagationDelayModel.tid)

@@ -29,13 +29,15 @@ class YansWifiChannel(Object):
         self._phys: list = []
         self._loss = None
         self._delay = None
-        # per-window batched caches (filled by JaxSimulatorImpl)
+        # pair-table caches (scalar lazy build / per-window refresh)
         self._rx_dbm_cache = None   # (N, N) host ndarray: [tx, rx]
-        self._delay_cache = None    # (N, N) seconds
         self._phy_index: dict[int, int] = {}
         self._geometry_dirty = True
         self._watched_mobilities: set[int] = set()
         self._tx_power_cache = None  # (N,) snapshot at refresh
+        self._delay_ticks_cache = None  # (N, N) int ticks (scalar fast loop)
+        self._context_cache: list = []  # (N,) node ids at refresh
+        self._lazy_refresh_tried = False
         self._no_batch_path = False  # loss chain lacks a batch form
         from tpudes.parallel.engine import BatchableRegistry
 
@@ -60,34 +62,66 @@ class YansWifiChannel(Object):
     # --- the hot loop ---
     def Send(self, sender_phy, packet, mode, tx_power_dbm: float, duration_s: float) -> None:
         cache = self._rx_dbm_cache
+        stale = (
+            cache is None
+            or self._geometry_dirty
+            or cache.shape[0] != len(self._phys)
+        )
+        if stale and not self._no_batch_path and (
+            cache is not None or not self._lazy_refresh_tried
+        ):
+            # first send, a discrete move (SetPosition fires
+            # CourseChange), or phys added since the snapshot: (re)build
+            # the pair tables with the models' own float64 scalar math —
+            # bit-identical to the uncached path, no accelerator round
+            # trip.  Static topologies then skip the per-receiver
+            # mobility + loss-chain work on every delivery; gliding
+            # mobility models and stochastic loss/delay chains are
+            # rejected by the builder and keep the exact per-send path.
+            self._lazy_refresh_tried = True
+            self._build_scalar_cache()
+            cache = self._rx_dbm_cache
         tx_idx = None
         if cache is not None:
             tx_idx = self._phy_index.get(id(sender_phy))
             if (
-                tx_idx is None
+                self._geometry_dirty
+                or tx_idx is None
                 or cache.shape[0] != len(self._phys)
                 or abs(tx_power_dbm - self._tx_power_cache[tx_idx]) > 1e-9
             ):
-                # phy added after refresh, or per-call power differs from
-                # the snapshot: this send takes the scalar path
+                # rebuild refused (e.g. gliding mobility), phy unknown,
+                # or per-call power differs from the snapshot: this send
+                # takes the exact per-pair path
                 cache = None
+        impl = Simulator.GetImpl()
+        if cache is not None:
+            # fully-cached fast loop: precomputed power/delay-ticks/
+            # context — no mobility, loss-chain, or Time churn per rx
+            row = cache[tx_idx]
+            trow = self._delay_ticks_cache[tx_idx]
+            ctxs = self._context_cache
+            for i, phy in enumerate(self._phys):
+                if phy is sender_phy:
+                    continue
+                impl.ScheduleWithContext(
+                    ctxs[i],
+                    int(trow[i]),
+                    phy.StartReceivePreamble,
+                    (packet.Copy(), mode, float(row[i]), duration_s),
+                )
+            return
         sender_mob = sender_phy.GetMobility()
         for i, phy in enumerate(self._phys):
             if phy is sender_phy:
                 continue
-            if cache is not None:
-                # window-cached row: the pair math already ran as one
-                # batched kernel at the window boundary
-                rx_dbm = float(cache[tx_idx, i])
-                delay_s = float(self._delay_cache[tx_idx, i])
-            else:
-                rx_mob = phy.GetMobility()
-                delay_s = self._delay.GetDelay(sender_mob, rx_mob) if self._delay else 0.0
-                rx_dbm = (
-                    self._loss.CalcRxPower(tx_power_dbm, sender_mob, rx_mob)
-                    if self._loss
-                    else tx_power_dbm
-                )
+            rx_mob = phy.GetMobility()
+            delay_s = self._delay.GetDelay(sender_mob, rx_mob) if self._delay else 0.0
+            rx_dbm = (
+                self._loss.CalcRxPower(tx_power_dbm, sender_mob, rx_mob)
+                if self._loss
+                else tx_power_dbm
+            )
             node = phy.GetDevice().GetNode() if phy.GetDevice() else None
             context = node.GetId() if node else 0
             Simulator.ScheduleWithContext(
@@ -99,6 +133,73 @@ class YansWifiChannel(Object):
                 rx_dbm,
                 duration_s,
             )
+
+    def _watch(self, mob) -> None:
+        if id(mob) not in self._watched_mobilities:
+            self._watched_mobilities.add(id(mob))
+            mob.TraceConnectWithoutContext(
+                "CourseChange",
+                lambda *_a: setattr(self, "_geometry_dirty", True),
+            )
+
+    def _finalize_pair_cache(self, rx, ticks, tx_power) -> None:
+        self._rx_dbm_cache = rx
+        self._delay_ticks_cache = ticks
+        self._tx_power_cache = tx_power
+        self._phy_index = {id(p): i for i, p in enumerate(self._phys)}
+        self._context_cache = [
+            p.GetDevice().GetNode().GetId()
+            if p.GetDevice() is not None and p.GetDevice().GetNode() is not None
+            else 0
+            for p in self._phys
+        ]
+        self._geometry_dirty = False
+
+    def _build_scalar_cache(self) -> None:
+        """Pair-table build for the scalar engine: N² calls of the
+        models' scalar CalcRxPower/GetDelay (float64 — results are
+        bit-identical to the per-send path), valid until the next
+        CourseChange.  Stochastic models must keep drawing per send and
+        gliding mobility moves without firing CourseChange — both leave
+        the cache unbuilt."""
+        import numpy as np
+
+        self._rx_dbm_cache = None
+        loss = self._loss
+        while loss is not None:
+            if not getattr(loss, "is_deterministic", False):
+                self._no_batch_path = True
+                return
+            loss = loss.GetNext()
+        if self._delay is not None and not getattr(
+            self._delay, "is_deterministic", False
+        ):
+            self._no_batch_path = True  # stochastic delay draws per send
+            return
+        mobs = [p.GetMobility() for p in self._phys]
+        if any(m is None or not getattr(m, "is_static", False) for m in mobs):
+            return  # unknown or gliding geometry: exact per-send path
+        for mob in mobs:
+            self._watch(mob)
+        n = len(self._phys)
+        tx_power = np.array(
+            [p.GetTxPowerDbm() for p in self._phys], dtype=np.float64
+        )
+        rx = np.zeros((n, n), dtype=np.float64)
+        ticks = np.zeros((n, n), dtype=np.int64)
+        for i, ma in enumerate(mobs):
+            for j, mb in enumerate(mobs):
+                if i == j:
+                    continue
+                rx[i, j] = (
+                    self._loss.CalcRxPower(tx_power[i], ma, mb)
+                    if self._loss
+                    else tx_power[i]
+                )
+                ticks[i, j] = Seconds(
+                    self._delay.GetDelay(ma, mb) if self._delay else 0.0
+                ).ticks
+        self._finalize_pair_cache(rx, ticks, tx_power)
 
     # --- per-window batched refresh (JaxSimulatorImpl contract) ---
     def refresh_window_cache(self) -> None:
@@ -117,19 +218,19 @@ class YansWifiChannel(Object):
             # small topologies: kernel dispatch + compile costs more than
             # the scalar loop saves — stay on the host path
             return
-        if self._delay is not None and not hasattr(self._delay, "speed"):
-            return  # stochastic delay model: host RNG must draw per send
+        if self._delay is not None and not (
+            getattr(self._delay, "is_deterministic", False)
+            and hasattr(self._delay, "speed")
+        ):
+            return  # stochastic (or non-distance-based) delay model
         # dirty-flag on CourseChange: static topologies pay ONE kernel
         # dispatch total instead of one per window (host↔device round
         # trips are the budget — SURVEY.md §7 hard part 3)
         for phy in self._phys:
             mob = phy.GetMobility()
             if mob is not None and id(mob) not in self._watched_mobilities:
-                self._watched_mobilities.add(id(mob))
                 self._geometry_dirty = True
-                mob.TraceConnectWithoutContext(
-                    "CourseChange", lambda *_a: setattr(self, "_geometry_dirty", True)
-                )
+                self._watch(mob)
         if not self._geometry_dirty and self._rx_dbm_cache is not None and len(
             self._phys
         ) == self._rx_dbm_cache.shape[0]:
@@ -139,11 +240,13 @@ class YansWifiChannel(Object):
             import numpy as np
             import jax.numpy as jnp
 
+            from tpudes.core.nstime import Time
             from tpudes.ops.propagation import pairwise_distance
 
             positions = np.zeros((len(self._phys), 3), dtype=np.float32)
-            tx_power = np.zeros((len(self._phys),), dtype=np.float32)
-            self._phy_index = {id(p): i for i, p in enumerate(self._phys)}
+            # float64 snapshot: Send compares per-call powers against it
+            # at 1e-9 — a float32 copy of e.g. 16.0206 would never match
+            tx_power = np.zeros((len(self._phys),), dtype=np.float64)
             for i, phy in enumerate(self._phys):
                 mob = phy.GetMobility()
                 if mob is None:
@@ -152,19 +255,21 @@ class YansWifiChannel(Object):
                 positions[i] = (pos.x, pos.y, pos.z)
                 tx_power[i] = phy.GetTxPowerDbm()
             d = pairwise_distance(jnp.asarray(positions))
-            rx = self._loss.batch_rx_power(jnp.asarray(tx_power)[:, None], d)
-            self._rx_dbm_cache = np.asarray(rx)
+            rx = self._loss.batch_rx_power(
+                jnp.asarray(tx_power, dtype=jnp.float32)[:, None], d
+            )
             if self._delay is not None:
-                self._delay_cache = np.asarray(d) / self._delay.speed
+                delay_s = np.asarray(d, dtype=np.float64) / self._delay.speed
             else:
-                self._delay_cache = np.zeros_like(np.asarray(d))  # scalar path uses 0.0
-            self._tx_power_cache = tx_power
+                delay_s = np.zeros((len(self._phys),) * 2)
+            # same rounding as Seconds(): round-half-even at resolution
+            ticks = np.rint(delay_s * 10.0 ** -Time._res_exp).astype(np.int64)
+            self._finalize_pair_cache(np.asarray(rx), ticks, tx_power)
         except NotImplementedError:
             # chain contains a model without a batch path: remember, so we
             # don't redo the failed build every window
             self._no_batch_path = True
             self._rx_dbm_cache = None
-            self._delay_cache = None
 
     # --- batched form (window engine) ---
     def rx_power_row(self, tx_power_dbm, tx_index: int, positions):

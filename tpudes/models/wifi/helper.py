@@ -129,6 +129,12 @@ class WifiMacHelper:
 HT_STANDARDS = ("80211n", "80211ac", "80211ax")
 
 
+def normalize_standard(standard: str) -> str:
+    """Canonical spelling: accepts '80211n', 'WIFI_STANDARD_80211n',
+    '802_11n' etc. — the single place both SetStandard and scripts use."""
+    return standard.replace("WIFI_STANDARD_", "").replace("_", "").lower()
+
+
 class WifiHelper:
     def __init__(self):
         self._manager_type = "tpudes::ConstantRateWifiManager"
@@ -140,7 +146,7 @@ class WifiHelper:
         ('80211n'/'80211ac'/'80211ax') — HT standards default installed
         MACs to QosSupported + MaxAmpduSize=65535 (upstream
         WifiHelper::SetStandard + the HT MAC defaults)."""
-        self._standard = standard.replace("WIFI_STANDARD_", "").replace("_", "").lower()
+        self._standard = normalize_standard(standard)
 
     def SetRemoteStationManager(self, name: str, **attributes) -> None:
         name = name.replace("ns3::", "tpudes::")

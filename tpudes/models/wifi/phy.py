@@ -111,11 +111,17 @@ class InterferenceHelper:
         self.set_noise(noise_figure_db, bandwidth_hz)
         self._events: list[_Event] = []
         self.error_model = NistErrorRateModel()
+        # interference-free PER memo: a static topology presents a small
+        # finite set of (mode, power, airtime) receptions, so the NIST
+        # product need only run once per distinct key (cleared when the
+        # noise or error model changes)
+        self._per_cache: dict = {}
 
     def set_noise(self, noise_figure_db: float, bandwidth_hz: float) -> None:
         self.noise_w = (
             10.0 ** (noise_figure_db / 10.0) * BOLTZMANN * 290.0 * bandwidth_hz
         )
+        self._per_cache = {}
 
     def add(self, packet, mode, start_ts, end_ts, rx_power_w) -> _Event:
         ev = _Event(packet, mode, start_ts, end_ts, rx_power_w)
@@ -167,14 +173,45 @@ class InterferenceHelper:
             chunks.append((snr, Time(t1 - t0).GetSeconds()))
         return chunks
 
+    def _overlapping(self, event: _Event) -> list:
+        return [
+            e
+            for e in self._events
+            if e is not event
+            and e.end_ts > event.start_ts
+            and e.start_ts < event.end_ts
+        ]
+
+    def per_and_snr(self, event: _Event) -> tuple:
+        """(PER, first-chunk SNR) in one chunk pass.  The no-interference
+        case — the overwhelming majority under CSMA — is one memo lookup."""
+        if not self._overlapping(event):
+            snr = event.rx_power_w / self.noise_w
+            key = (
+                event.mode.index,
+                event.rx_power_w,
+                event.end_ts - event.start_ts,
+            )
+            per = self._per_cache.get(key)
+            if per is None:
+                nbits = event.mode.data_rate_bps * Time(
+                    event.end_ts - event.start_ts
+                ).GetSeconds()
+                per = 1.0 - self.error_model.chunk_success(event.mode, snr, nbits)
+                if len(self._per_cache) > 4096:
+                    self._per_cache.clear()
+                self._per_cache[key] = per
+            return per, snr
+        chunks = self.snr_chunks(event)
+        psr = 1.0
+        for snr, dur_s in chunks:
+            nbits = event.mode.data_rate_bps * dur_s
+            psr *= self.error_model.chunk_success(event.mode, snr, nbits)
+        return 1.0 - psr, (chunks[0][0] if chunks else 0.0)
+
     def calculate_per(self, event: _Event) -> float:
         """1 - Π chunk success (InterferenceHelper::CalculatePayloadPer)."""
-        mode = event.mode
-        psr = 1.0
-        for snr, dur_s in self.snr_chunks(event):
-            nbits = mode.data_rate_bps * dur_s
-            psr *= self.error_model.chunk_success(mode, snr, nbits)
-        return 1.0 - psr
+        return self.per_and_snr(event)[0]
 
     def mpdu_success_probs(self, event: _Event, fractions) -> list[float]:
         """Per-MPDU decode probabilities for an A-MPDU PPDU: each MPDU
@@ -191,6 +228,8 @@ class InterferenceHelper:
         return [psr_full ** frac for frac in fractions]
 
     def first_snr(self, event: _Event) -> float:
+        if not self._overlapping(event):
+            return event.rx_power_w / self.noise_w
         chunks = self.snr_chunks(event)
         return chunks[0][0] if chunks else 0.0
 
@@ -413,8 +452,7 @@ class YansWifiPhy(Object):
         if tag is not None:
             self._end_rx_ampdu(event, tag)
             return
-        per = self._interference.calculate_per(event)
-        snr = self._interference.first_snr(event)
+        per, snr = self._interference.per_and_snr(event)
         self.phy_rx_end(event.packet)
         for listener in self._listeners:
             listener.NotifyRxEnd()
