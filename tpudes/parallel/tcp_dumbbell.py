@@ -4,8 +4,13 @@ Lowers a dumbbell object graph — N left leaves bulk-sending TCP through
 one bottleneck toward N right leaves (tcp-variants-comparison's shape;
 SURVEY.md §2.7/§2.9) — to a device-resident **packet-slot** program: one
 ``lax.scan`` step per bottleneck serialization time τ (= pkt_bytes·8/C),
-per-replica per-flow state in (R, F) arrays, all six TcpCongestionOps
-variants evaluated as masked vector rules in one fused step.
+per-replica per-flow state in (R, F) arrays, all THIRTEEN
+TcpCongestionOps variants (the full upstream family incl. BBR and
+DCTCP) evaluated as masked vector rules in one fused step.  A RED root
+qdisc on the bottleneck lowers too: EWMA average queue, early
+drop/CE-mark (RFC 3168 ECE triggers the variant's loss response; DCTCP
+scales its cut by the marked fraction), gentle mode, hard-drop forced
+region.
 
 The slot model (each deviation documented, mirrored on replicated.py's
 timing-model contract):
@@ -40,10 +45,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# variant ids (order is the vector-rule dispatch table)
+# variant ids (order is the vector-rule dispatch table; the full
+# upstream tcp-variants-comparison family, tcp_congestion.TCP_VARIANTS)
 VARIANTS = ("TcpNewReno", "TcpCubic", "TcpScalable", "TcpHighSpeed",
-            "TcpVegas", "TcpVeno")
-V_NEWRENO, V_CUBIC, V_SCALABLE, V_HIGHSPEED, V_VEGAS, V_VENO = range(6)
+            "TcpVegas", "TcpVeno", "TcpLinuxReno", "TcpBic", "TcpWestwood",
+            "TcpIllinois", "TcpHybla", "TcpBbr", "TcpDctcp")
+(V_NEWRENO, V_CUBIC, V_SCALABLE, V_HIGHSPEED, V_VEGAS, V_VENO,
+ V_LINUXRENO, V_BIC, V_WESTWOOD, V_ILLINOIS, V_HYBLA, V_BBR,
+ V_DCTCP) = range(13)
 
 INIT_CWND = 10.0          # segments (tcp_congestion.TcpSocketState default)
 SSTHRESH0 = 1e9
@@ -54,6 +63,15 @@ SCALABLE_MD = 0.125
 HS_LOW_WINDOW = 38.0
 VEGAS_ALPHA, VEGAS_BETA, VEGAS_GAMMA = 2.0, 4.0, 1.0
 VENO_BETA = 3.0
+BIC_BETA, BIC_LOW_WND, BIC_MAX_INCR, BIC_SMIN = 0.8, 14.0, 16.0, 0.01
+ILL_ALPHA_MAX, ILL_ALPHA_MIN = 10.0, 0.3
+ILL_BETA_MAX, ILL_BETA_MIN = 0.5, 0.125
+HYBLA_RRTT = 0.025
+BBR_HIGH_GAIN = 2.89
+BBR_CYCLE_GAINS = (1.25, 0.75, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0)
+BBR_STARTUP, BBR_DRAIN, BBR_PROBE_BW = range(3)
+BBR_BW_DECAY = 0.98       # per-round decaying-max ≈ the 10-round window
+DCTCP_G = 0.0625
 
 
 @dataclass(frozen=True)
@@ -72,6 +90,18 @@ class DumbbellProgram:
     burst_cap: int               # per-flow packets enqueueable per slot
     base_rtt_s: float            # unloaded RTT (for Vegas/Veno diff)
     seg_bytes: int               # application payload per packet
+    #: (F,) ECN-capable flows (variant REQUIRES_ECN or UseEcn socket
+    #: attribute): the AQM marks their packets instead of early-dropping
+    ecn: np.ndarray = None
+    #: bottleneck AQM: "fifo" (tail drop) or "red"
+    qdisc: str = "fifo"
+    red_min_th: float = 5.0
+    red_max_th: float = 15.0
+    red_max_p: float = 0.02      # 1 / LInterm
+    red_qw: float = 0.002
+    red_gentle: bool = True
+    red_use_ecn: bool = False
+    red_use_hard_drop: bool = True
 
     @property
     def buf_len(self) -> int:
@@ -162,7 +192,7 @@ def lower_dumbbell(sim_end_s: float) -> DumbbellProgram:
             raise UnliftableDumbbellError("leaf access link is not p2p")
         return acc.GetChannel().GetPeer(acc).GetNode()
 
-    flows, variants, starts, stops, budgets = [], [], [], [], []
+    flows, variants, starts, stops, budgets, ecns = [], [], [], [], [], []
     seg_sizes, access_rates, access_delays = set(), set(), []
     directions: set[bool] = set()
     for node in nodes:
@@ -203,6 +233,12 @@ def lower_dumbbell(sim_end_s: float) -> DumbbellProgram:
                 raise UnliftableDumbbellError(f"unknown TCP variant {vname}")
             seg_sizes.add(int(app.send_size))
             flows.append(app)
+            from tpudes.models.internet.tcp_congestion import TCP_VARIANTS
+
+            ecns.append(
+                bool(getattr(tcp, "use_ecn", False))
+                or bool(getattr(TCP_VARIANTS[vname], "REQUIRES_ECN", False))
+            )
             variants.append(VARIANTS.index(vname))
             starts.append(app.start_time.GetSeconds())
             stops.append(
@@ -241,6 +277,39 @@ def lower_dumbbell(sim_end_s: float) -> DumbbellProgram:
     # reverse trip (access + bottleneck prop + access)
     ack_lag_s = 2.0 * bn_delay_s + 4.0 * acc_d
     base_rtt_s = ack_lag_s + slot_s
+
+    # --- bottleneck AQM (traffic-control root qdisc on the tx device
+    # of the modeled direction) -----------------------------------------
+    from tpudes.models.traffic_control import (
+        FifoQueueDisc,
+        RedQueueDisc,
+        TrafficControlLayer,
+    )
+
+    src_is_left = directions.pop()
+    tx_dev = bdev if src_is_left else bpeer
+    tcl = tx_dev.GetNode().GetObject(TrafficControlLayer)
+    qd = tcl.GetRootQueueDisc(tx_dev) if tcl is not None else None
+    qdisc_kind, red_kw = "fifo", {}
+    if isinstance(qd, RedQueueDisc):
+        qdisc_kind = "red"
+        queue_cap = int(qd.max_packets)
+        red_kw = dict(
+            red_min_th=float(qd.min_th),
+            red_max_th=float(qd.max_th),
+            red_max_p=1.0 / float(qd.l_interm),
+            red_qw=float(qd.qw),
+            red_gentle=bool(qd.gentle),
+            red_use_ecn=bool(qd.use_ecn),
+            red_use_hard_drop=bool(qd.use_hard_drop),
+        )
+    elif isinstance(qd, FifoQueueDisc):
+        queue_cap = int(qd.max_packets)
+    elif qd is not None:
+        raise UnliftableDumbbellError(
+            f"bottleneck qdisc {type(qd).__name__} has no slot-model "
+            "analog (fifo and RED are modeled)"
+        )
     return DumbbellProgram(
         n_flows=len(flows),
         variant_idx=np.asarray(variants, np.int32),
@@ -261,18 +330,98 @@ def lower_dumbbell(sim_end_s: float) -> DumbbellProgram:
         burst_cap=max(1, int(access_rate / bn_rate)),
         base_rtt_s=base_rtt_s,
         seg_bytes=seg,
+        ecn=np.asarray(ecns, bool),
+        qdisc=qdisc_kind,
+        **red_kw,
     )
 
 
-def _cwnd_increase(var, cwnd, ssthresh, acked, t_s, rtt_s, st):
-    """Vectorized per-ack cwnd growth for all six variants (segments).
+def _cwnd_increase(var, cwnd, ssthresh, acked, t_s, rtt_s, st,
+                   acked_raw=None):
+    """Vectorized per-ack cwnd growth for all thirteen variants
+    (segments).
 
     ``st`` carries the variant side-state dict; returns (new_cwnd, st').
     Masked-dense: every rule computes, the variant index selects.
+    ``acked_raw`` (defaults to ``acked``) feeds the PktsAcked-analog
+    estimators (min-RTT, Westwood BWE, Illinois delay, BBR rounds) —
+    the host calls PktsAcked on every ack, recovery or not, while
+    window growth sees only the recovery-masked count.
     """
     w = jnp.maximum(cwnd, 1.0)
     a = acked.astype(jnp.float32)
+    ar = a if acked_raw is None else acked_raw.astype(jnp.float32)
     in_ss = cwnd < ssthresh
+
+    # --- PktsAcked-analog side estimators (raw acks) --------------------
+    sampled = ar > 0
+    min_rtt = jnp.where(
+        sampled, jnp.minimum(st["min_rtt"], rtt_s), st["min_rtt"]
+    )
+    # Westwood+: EWMA bandwidth once ~a cwnd's worth of acks arrived
+    ww_acc = st["ww_acc"] + ar
+    ww_done = sampled & (ww_acc >= w)
+    ww_sample = ww_acc / jnp.maximum(rtt_s, 1e-6)
+    bwe = jnp.where(
+        ww_done,
+        jnp.where(st["bwe"] == 0.0, ww_sample,
+                  0.9 * st["bwe"] + 0.1 * ww_sample),
+        st["bwe"],
+    )
+    ww_acc = jnp.where(ww_done, 0.0, ww_acc)
+    # Illinois: delay-modulated alpha/beta
+    ill_max = jnp.where(
+        sampled, jnp.maximum(st["ill_max_rtt"], rtt_s), st["ill_max_rtt"]
+    )
+    dm = ill_max - min_rtt
+    da = jnp.maximum(rtt_s - min_rtt, 0.0)
+    d1 = 0.01 * dm
+    k_ill = (ILL_ALPHA_MAX - ILL_ALPHA_MIN) / jnp.maximum(dm - d1, 1e-9)
+    alpha_raw = jnp.where(
+        da <= d1, ILL_ALPHA_MAX,
+        jnp.maximum(ILL_ALPHA_MAX - k_ill * (da - d1), ILL_ALPHA_MIN),
+    )
+    beta_raw = jnp.clip(
+        ILL_BETA_MIN
+        + (ILL_BETA_MAX - ILL_BETA_MIN) * da / jnp.maximum(dm, 1e-9),
+        ILL_BETA_MIN, ILL_BETA_MAX,
+    )
+    ill_alpha = jnp.where(
+        sampled, jnp.where(dm <= 0.0, ILL_ALPHA_MAX, alpha_raw),
+        st["ill_alpha"],
+    )
+    ill_beta = jnp.where(
+        sampled, jnp.where(dm <= 0.0, ILL_BETA_MIN, beta_raw),
+        st["ill_beta"],
+    )
+    # BBR: per-round max-filtered delivery rate + state machine
+    bbr_acc = st["bbr_acc"] + ar
+    round_done = sampled & (bbr_acc >= w)
+    bbr_sample = bbr_acc / jnp.maximum(rtt_s, 1e-6)
+    bbr_bw = jnp.where(
+        round_done,
+        jnp.maximum(st["bbr_bw"] * BBR_BW_DECAY, bbr_sample),
+        st["bbr_bw"],
+    )
+    bbr_acc = jnp.where(round_done, 0.0, bbr_acc)
+    grew = bbr_sample > st["bbr_full_bw"] * 1.25
+    bbr_full_bw = jnp.where(round_done & grew, bbr_sample, st["bbr_full_bw"])
+    bbr_full_cnt = jnp.where(
+        round_done,
+        jnp.where(grew, 0, st["bbr_full_cnt"] + 1),
+        st["bbr_full_cnt"],
+    )
+    state = st["bbr_state"]
+    pipe_full = round_done & (state == BBR_STARTUP) & (bbr_full_cnt >= 3)
+    state = jnp.where(pipe_full, BBR_DRAIN, state)
+    # one round of DRAIN, then PROBE_BW cycling
+    leave_drain = round_done & (st["bbr_state"] == BBR_DRAIN)
+    state = jnp.where(leave_drain, BBR_PROBE_BW, state)
+    bbr_cycle = jnp.where(
+        round_done & (state == BBR_PROBE_BW),
+        (st["bbr_cycle"] + 1) % len(BBR_CYCLE_GAINS),
+        st["bbr_cycle"],
+    )
 
     # --- congestion avoidance rules (per ack batch) ---------------------
     inc_reno = a / w
@@ -307,18 +456,76 @@ def _cwnd_increase(var, cwnd, ssthresh, acked, t_s, rtt_s, st):
     )
     inc_veno = jnp.where(diff < VENO_BETA, inc_reno, 0.5 * inc_reno)
 
+    # Linux reno (and DCTCP, which inherits it): whole-cwnd ack counting
+    is_lr = (var == V_LINUXRENO) | (var == V_DCTCP)
+    cnt = st["cwnd_cnt"] + a
+    whole = jnp.floor(cnt / w)
+    inc_lr = whole
+    new_cnt = jnp.where(
+        is_lr & ~in_ss & (a > 0), cnt - whole * w, st["cwnd_cnt"]
+    )
+
+    # BIC: binary search toward w_max, max-probe beyond it
+    bic_mid = jnp.minimum((st["w_max"] - w) / 2.0, BIC_MAX_INCR)
+    bic_probe = jnp.minimum(w - st["w_max"] + 1.0, BIC_MAX_INCR)
+    bic_inc = jnp.maximum(
+        jnp.where(w < st["w_max"], bic_mid, bic_probe), BIC_SMIN
+    )
+    inc_bic = jnp.where(
+        (w < BIC_LOW_WND) | (st["w_max"] == 0.0),
+        inc_reno, a * bic_inc / w,
+    )
+
+    inc_ill = ill_alpha * a / w
+
+    # Hybla: growth normalized by rho = RTT / 25 ms
+    rho = jnp.maximum(rtt_s / HYBLA_RRTT, 1.0)
+    inc_hybla = a * rho * rho / w
+
     inc_ca = jnp.select(
         [var == V_NEWRENO, var == V_CUBIC, var == V_SCALABLE,
-         var == V_HIGHSPEED, var == V_VEGAS, var == V_VENO],
-        [inc_reno, inc_cubic, inc_scal, inc_hs, inc_vegas, inc_veno],
+         var == V_HIGHSPEED, var == V_VEGAS, var == V_VENO,
+         is_lr, var == V_BIC, var == V_WESTWOOD,
+         var == V_ILLINOIS, var == V_HYBLA],
+        [inc_reno, inc_cubic, inc_scal, inc_hs, inc_vegas, inc_veno,
+         inc_lr, inc_bic, inc_reno, inc_ill, inc_hybla],
     )
-    # slow start: +1 per ack; Vegas leaves SS once the backlog passes γ
+    # slow start: +1 per ack (Hybla: 2^rho − 1 per ack); Vegas leaves SS
+    # once the backlog passes γ
     vegas_exit = (var == V_VEGAS) & in_ss & (diff > VEGAS_GAMMA) & (a > 0)
     ssthresh = jnp.where(vegas_exit, jnp.maximum(w - 1.0, 2.0), ssthresh)
-    inc = jnp.where(in_ss & ~vegas_exit, a, inc_ca)
+    inc_ss = jnp.where(var == V_HYBLA, a * (2.0**rho - 1.0), a)
+    inc = jnp.where(in_ss & ~vegas_exit, inc_ss, inc_ca)
     new_cwnd = jnp.maximum(cwnd + jnp.where(a > 0, inc, 0.0), 2.0)
+
+    # BBR replaces loss-driven AIMD entirely: cwnd tracks gain × BDP
+    gain = jnp.select(
+        [state == BBR_STARTUP, state == BBR_DRAIN],
+        [BBR_HIGH_GAIN, 1.0 / BBR_HIGH_GAIN],
+        jnp.asarray(BBR_CYCLE_GAINS)[bbr_cycle],
+    )
+    bdp = bbr_bw * min_rtt
+    target = jnp.maximum(gain * bdp, 4.0)
+    cwnd_bbr = jnp.where(
+        bbr_bw == 0.0,
+        cwnd + a,                                 # first RTTs
+        jnp.where(
+            cwnd < target,
+            cwnd + jnp.minimum(a, target - cwnd + 1.0),
+            jnp.maximum(target, 4.0),
+        ),
+    )
+    new_cwnd = jnp.where(
+        var == V_BBR, jnp.where(a > 0, cwnd_bbr, cwnd), new_cwnd
+    )
+
     st = dict(st, epoch_t=epoch_t, k=k, origin=origin, w_est=w_est,
-              last_diff=jnp.where(a > 0, diff, st["last_diff"]))
+              last_diff=jnp.where(a > 0, diff, st["last_diff"]),
+              min_rtt=min_rtt, ww_acc=ww_acc, bwe=bwe,
+              ill_max_rtt=ill_max, ill_alpha=ill_alpha, ill_beta=ill_beta,
+              bbr_acc=bbr_acc, bbr_bw=bbr_bw, bbr_full_bw=bbr_full_bw,
+              bbr_full_cnt=bbr_full_cnt, bbr_state=state,
+              bbr_cycle=bbr_cycle, cwnd_cnt=new_cnt)
     return new_cwnd, ssthresh, st
 
 
@@ -345,15 +552,38 @@ def _loss_response(var, cwnd, st):
     )
     ss_hs = w * (1.0 - b_hs)
     ss_veno = jnp.where(st["last_diff"] < VENO_BETA, w * 0.8, w * 0.5)
+    # BIC fast convergence mirrors cubic's w_max bookkeeping at β=0.8
+    bic_wmax = jnp.where(w < st["w_max"], w * (1.0 + BIC_BETA) / 2.0, w)
+    ss_bic = w * BIC_BETA
+    # Westwood+: BWE · RTTmin instead of blind halving
+    ss_west = jnp.where(
+        (st["bwe"] > 0.0) & jnp.isfinite(st["min_rtt"]),
+        st["bwe"] * st["min_rtt"], w / 2.0,
+    )
+    ss_ill = w * (1.0 - st["ill_beta"])
+    # BBR ignores loss beyond the BDP floor
+    ss_bbr = jnp.maximum(st["bbr_bw"] * jnp.where(
+        jnp.isfinite(st["min_rtt"]), st["min_rtt"], 0.0
+    ), 4.0)
+    # DCTCP: reduction fraction follows the marked-byte EWMA
+    ss_dctcp = w * (1.0 - st["dctcp_alpha"] / 2.0)
     ssthresh = jnp.select(
         [var == V_NEWRENO, var == V_CUBIC, var == V_SCALABLE,
-         var == V_HIGHSPEED, var == V_VEGAS, var == V_VENO],
-        [ss_reno, ss_cubic, ss_scal, ss_hs, ss_reno, ss_veno],
+         var == V_HIGHSPEED, var == V_VEGAS, var == V_VENO,
+         var == V_LINUXRENO, var == V_BIC, var == V_WESTWOOD,
+         var == V_ILLINOIS, var == V_HYBLA, var == V_BBR,
+         var == V_DCTCP],
+        [ss_reno, ss_cubic, ss_scal, ss_hs, ss_reno, ss_veno,
+         ss_reno, ss_bic, ss_west, ss_ill, ss_reno, ss_bbr, ss_dctcp],
     )
     ssthresh = jnp.maximum(ssthresh, 2.0)
     st = dict(
         st,
-        w_max=jnp.where(var == V_CUBIC, new_wmax, st["w_max"]),
+        w_max=jnp.select(
+            [var == V_CUBIC, var == V_BIC],
+            [new_wmax, bic_wmax],
+            st["w_max"],
+        ),
         epoch_t=jnp.full_like(st["epoch_t"], -1.0),
     )
     return ssthresh, st
@@ -371,6 +601,12 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
     rtt_slots = max(1, int(round(prog.base_rtt_s / slot_s)))
     Q = prog.queue_cap
     burst = prog.burst_cap
+    RED = prog.qdisc == "red"
+    ecn_cap = jnp.asarray(
+        prog.ecn
+        if prog.ecn is not None
+        else np.zeros(prog.n_flows, bool)
+    )
 
     def init_state():
         z = lambda *sh, dt=jnp.float32: jnp.zeros(sh, dt)  # noqa: E731
@@ -379,18 +615,34 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
             ssthresh=jnp.full((R, F), SSTHRESH0, jnp.float32),
             inflight=z(R, F, dt=jnp.int32),
             q=z(R, F, dt=jnp.int32),
+            q_marked=z(R, F),            # CE-marked packets in the queue
             delivered=z(R, F, dt=jnp.int32),
             drops=z(R, F, dt=jnp.int32),
             recover_until=z(R, F, dt=jnp.int32),
             ack_buf=z(R, L, F, dt=jnp.int32),
             loss_buf=z(R, L, F, dt=jnp.int32),
+            mark_buf=z(R, L, F),         # ECE echoes riding the acks
             rtt_buf=jnp.full((R, L), prog.base_rtt_s, jnp.float32),
             qsum=z(R),
+            red_avg=z(R),                # RED EWMA average queue
+            dctcp_acked=z(R, F),
+            dctcp_marked=z(R, F),
             side=dict(
                 w_max=z(R, F), epoch_t=jnp.full((R, F), -1.0), k=z(R, F),
                 origin=z(R, F), w_est=z(R, F),
                 base_rtt=jnp.broadcast_to(base_rtt, (R, F)),
                 last_diff=z(R, F),
+                min_rtt=jnp.full((R, F), jnp.inf),
+                ww_acc=z(R, F), bwe=z(R, F),
+                ill_max_rtt=z(R, F),
+                ill_alpha=jnp.full((R, F), ILL_ALPHA_MAX),
+                ill_beta=jnp.full((R, F), ILL_BETA_MIN),
+                bbr_acc=z(R, F), bbr_bw=z(R, F), bbr_full_bw=z(R, F),
+                bbr_full_cnt=z(R, F),
+                bbr_state=z(R, F, dt=jnp.int32),
+                bbr_cycle=z(R, F, dt=jnp.int32),
+                cwnd_cnt=z(R, F),
+                dctcp_alpha=jnp.ones((R, F)),
             ),
         )
 
@@ -398,21 +650,42 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
         t, key = inp
         idx = t % L
 
-        # 1. consume this slot's ack / loss arrivals
+        # 1. consume this slot's ack / loss / ECN-echo arrivals
         acks = s["ack_buf"][:, idx, :]
         losses = s["loss_buf"][:, idx, :]
+        marks = s["mark_buf"][:, idx, :]
         rtt = s["rtt_buf"][:, idx][:, None]
         ack_buf = s["ack_buf"].at[:, idx, :].set(0)
         loss_buf = s["loss_buf"].at[:, idx, :].set(0)
+        mark_buf = s["mark_buf"].at[:, idx, :].set(0.0)
         inflight = s["inflight"] - acks - losses
+
+        # DCTCP per-window marked-fraction EWMA (PktsAcked/EceReceived)
+        d_acked = s["dctcp_acked"] + acks.astype(jnp.float32)
+        d_marked = s["dctcp_marked"] + marks
+        win_done = d_acked >= s["cwnd"]
+        side = dict(
+            s["side"],
+            dctcp_alpha=jnp.where(
+                win_done,
+                (1.0 - DCTCP_G) * s["side"]["dctcp_alpha"]
+                + DCTCP_G * d_marked / jnp.maximum(d_acked, 1.0),
+                s["side"]["dctcp_alpha"],
+            ),
+        )
+        d_acked = jnp.where(win_done, 0.0, d_acked)
+        d_marked = jnp.where(win_done, 0.0, d_marked)
 
         in_recovery = t < s["recover_until"]
         cwnd, ssthresh, side = _cwnd_increase(
             var[None, :], s["cwnd"], s["ssthresh"],
-            jnp.where(in_recovery, 0, acks), t * slot_s, rtt, s["side"],
+            jnp.where(in_recovery, 0, acks), t * slot_s, rtt, side,
+            acked_raw=acks,
         )
-        # 2. one reduction per recovery window on detected loss
-        reduce = (losses > 0) & ~in_recovery
+        # 2. one reduction per recovery window on loss or ECN echo
+        # (RFC 3168: an ECE ack triggers the variant's loss response;
+        # DCTCP's response is the alpha-scaled cut via ss_dctcp)
+        reduce = ((losses > 0) | ((marks > 0) & ecn_cap[None, :])) & ~in_recovery
         ss_loss, side_loss = _loss_response(var[None, :], cwnd, side)
         ssthresh = jnp.where(reduce, ss_loss, ssthresh)
         cwnd = jnp.where(reduce, ssthresh, cwnd)
@@ -424,6 +697,8 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
         )
 
         # 3. departure: serve one packet, flow ∝ queue occupancy
+        if RED:
+            key, key_red, key_mark = jax.random.split(key, 3)
         q = s["q"]
         qtot = q.sum(axis=1)
         backlogged = qtot > 0
@@ -434,15 +709,30 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
         dep_oh = jax.nn.one_hot(dep, F, dtype=jnp.int32) * backlogged[
             :, None
         ].astype(jnp.int32)
+        # the departing packet carries a CE mark with probability equal
+        # to the flow's marked share — INTEGER marks only (a fractional
+        # residue would keep the `marks > 0` loss response firing for
+        # hundreds of RTTs after a marking episode)
+        if RED:
+            u_mark = jax.random.uniform(key_mark, (R,))
+            dep_marked = dep_oh.astype(jnp.float32) * (
+                u_mark[:, None]
+                < s["q_marked"] / jnp.maximum(q, 1).astype(jnp.float32)
+            ).astype(jnp.float32)
+        else:
+            dep_marked = jnp.zeros((R, F), jnp.float32)
+        q_marked = jnp.maximum(s["q_marked"] - dep_marked, 0.0)
         q = q - dep_oh
         delivered = s["delivered"] + dep_oh
         aidx = (t + prog.ack_lag) % L
         ack_buf = ack_buf.at[:, aidx, :].add(dep_oh)
+        mark_buf = mark_buf.at[:, aidx, :].add(dep_marked)
         rtt_buf = s["rtt_buf"].at[:, aidx].set(
             prog.base_rtt_s + qtot.astype(jnp.float32) * slot_s
         )
 
-        # 4. window-driven arrivals, tail-drop past capacity
+        # 4. window-driven arrivals; AQM (RED mark/early-drop) then
+        # tail-drop past capacity
         want = jnp.clip(
             cwnd.astype(jnp.int32) - inflight, 0, burst
         )
@@ -450,35 +740,92 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
             delivered + inflight < max_pkts[None, :]
         )
         want = jnp.where(live, want, 0)
-        wtot = want.sum(axis=1)
+        red_avg = s["red_avg"]
+        red_marks = jnp.zeros((R, F), jnp.float32)
+        red_drops = jnp.zeros((R, F), jnp.int32)
+        if RED:
+            # EWMA over this slot's arrivals against the instantaneous
+            # queue (per-arrival updates folded into one (1-qw)^n step;
+            # idle-time decay not modeled — the bottleneck is backlogged
+            # in every regime this engine targets)
+            qnow = q.sum(axis=1).astype(jnp.float32)
+            n_arr = want.sum(axis=1)
+            red_avg = jnp.where(
+                n_arr > 0,
+                qnow + (red_avg - qnow) * (1.0 - prog.red_qw) ** n_arr,
+                red_avg,
+            )
+            p = jnp.where(
+                red_avg < prog.red_min_th,
+                0.0,
+                prog.red_max_p
+                * (red_avg - prog.red_min_th)
+                / max(prog.red_max_th - prog.red_min_th, 1e-9),
+            )
+            if prog.red_gentle:
+                p = jnp.where(
+                    red_avg >= prog.red_max_th,
+                    prog.red_max_p
+                    + (1.0 - prog.red_max_p)
+                    * (red_avg - prog.red_max_th) / prog.red_max_th,
+                    p,
+                )
+                forced = red_avg >= 2.0 * prog.red_max_th
+            else:
+                forced = red_avg >= prog.red_max_th
+            p = jnp.clip(jnp.where(forced, 1.0, p), 0.0, 1.0)
+            # ECT packets are marked unless the forced region hard-drops
+            ect = ecn_cap[None, :] & prog.red_use_ecn
+            u_red = jax.random.uniform(key_red, (R, F))
+            n_act = jnp.minimum(
+                want,
+                jnp.floor(
+                    want.astype(jnp.float32) * p[:, None] + u_red
+                ).astype(jnp.int32),
+            )
+            mark_sel = ect & ~(
+                forced[:, None] & bool(prog.red_use_hard_drop)
+            )
+            red_drops = jnp.where(mark_sel, 0, n_act)
+            red_marks = jnp.where(mark_sel, n_act, 0).astype(jnp.float32)
+            want_q = want - red_drops
+        else:
+            want_q = want
+        wtot = want_q.sum(axis=1)
         free = jnp.maximum(Q - q.sum(axis=1), 0)
         # proportional admission with largest-remainder rounding
         scale = jnp.minimum(
             free.astype(jnp.float32) / jnp.maximum(wtot, 1).astype(jnp.float32),
             1.0,
         )
-        exact = want.astype(jnp.float32) * scale[:, None]
+        exact = want_q.astype(jnp.float32) * scale[:, None]
         acc = jnp.floor(exact).astype(jnp.int32)
         rem = exact - acc
         leftover = jnp.minimum(free - acc.sum(axis=1), wtot - acc.sum(axis=1))
         order = jnp.argsort(-rem, axis=1)
         rank = jnp.argsort(order, axis=1)
         acc = acc + (
-            (rank < leftover[:, None]) & (acc < want)
+            (rank < leftover[:, None]) & (acc < want_q)
         ).astype(jnp.int32)
-        acc = jnp.minimum(acc, want)
-        rej = want - acc
+        acc = jnp.minimum(acc, want_q)
+        rej = want_q - acc
         q = q + acc
+        # marked packets are among the admitted ones (integer count)
+        q_marked = q_marked + jnp.minimum(red_marks, acc.astype(jnp.float32))
         inflight = inflight + want
-        drops = s["drops"] + rej
+        drops = s["drops"] + rej + red_drops
         lidx = (t + prog.ack_lag) % L  # dupack-timed detection
-        loss_buf = loss_buf.at[:, lidx, :].add(rej)
+        loss_buf = loss_buf.at[:, lidx, :].add(rej + red_drops)
 
         return dict(
             cwnd=cwnd, ssthresh=ssthresh, inflight=inflight, q=q,
+            q_marked=q_marked,
             delivered=delivered, drops=drops, recover_until=recover_until,
-            ack_buf=ack_buf, loss_buf=loss_buf, rtt_buf=rtt_buf,
+            ack_buf=ack_buf, loss_buf=loss_buf, mark_buf=mark_buf,
+            rtt_buf=rtt_buf,
             qsum=s["qsum"] + qtot.astype(jnp.float32),
+            red_avg=red_avg,
+            dctcp_acked=d_acked, dctcp_marked=d_marked,
             side=side,
         ), None
 
@@ -492,13 +839,10 @@ def run_tcp_dumbbell(prog: DumbbellProgram, key, replicas: int, mesh=None):
     """Execute R replicas of the dumbbell program; returns per-replica
     outcome arrays: goodput_mbps (R,F), delivered (R,F), drops (R,F),
     mean_queue (R,), cwnd_final (R,F)."""
-    ck = (
-        tuple(prog.variant_idx.tolist()), tuple(prog.start_slot.tolist()),
-        tuple(prog.stop_slot.tolist()),
-        tuple(prog.max_pkts.tolist()), prog.slot_s, prog.n_slots,
-        prog.ack_lag, prog.queue_cap, prog.burst_cap, prog.base_rtt_s,
-        prog.seg_bytes, replicas,
-    )
+    ck = tuple(
+        v.tobytes() if isinstance(v, np.ndarray) else v
+        for v in prog.__dict__.values()
+    ) + (replicas,)
     hit = _RUNNER_CACHE.get(ck)
     if hit is None:
         init_state, step_fn = build_dumbbell_step(prog, replicas)
