@@ -1,0 +1,74 @@
+"""first.cc, IPv6 edition: two nodes, a point-to-point link, one UDP
+echo exchange over 2001:db8::/64 (upstream examples/tutorial/first.cc
+with Ipv6AddressHelper, the ns-3 dual-stack idiom).
+
+Run: python examples/first-v6.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpudes.core import CommandLine, Seconds, Simulator
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv6AddressHelper
+from tpudes.helper.point_to_point import PointToPointHelper
+
+
+def main(argv=None):
+    cmd = CommandLine()
+    cmd.AddValue("nPackets", "echo packets", 1)
+    cmd.Parse(argv)
+
+    nodes = NodeContainer()
+    nodes.Create(2)
+
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "5Mbps")
+    p2p.SetChannelAttribute("Delay", "2ms")
+    devices = p2p.Install(nodes)
+
+    stack = InternetStackHelper()
+    stack.Install(nodes)
+
+    address = Ipv6AddressHelper()
+    address.SetBase("2001:db8::", 64)
+    interfaces = address.Assign(devices)
+
+    server = UdpEchoServerHelper(9)
+    server_apps = server.Install(nodes.Get(1))
+    server_apps.Start(Seconds(1.0))
+    server_apps.Stop(Seconds(10.0))
+
+    client = UdpEchoClientHelper(interfaces.GetAddress(1, 1), 9)
+    client.SetAttribute("MaxPackets", int(cmd.nPackets))
+    client.SetAttribute("Interval", Seconds(1.0))
+    client.SetAttribute("PacketSize", 1024)
+    client_apps = client.Install(nodes.Get(0))
+    client_apps.Start(Seconds(2.0))
+    client_apps.Stop(Seconds(10.0))
+
+    cl, srv = client_apps.Get(0), server_apps.Get(0)
+    cl.TraceConnectWithoutContext(
+        "Tx", lambda p: print(f"At time {Simulator.Now().GetSeconds()}s client sent {p.GetSize()} bytes to {interfaces.GetAddress(1, 1)} port 9")
+    )
+    srv.TraceConnectWithoutContext(
+        "RxWithAddresses", lambda p, f, l: print(
+            f"At time {Simulator.Now().GetSeconds()}s server received {p.GetSize()} bytes from {f}"
+        )
+    )
+    cl.TraceConnectWithoutContext(
+        "Rx", lambda p: print(f"At time {Simulator.Now().GetSeconds()}s client received {p.GetSize()} bytes from {interfaces.GetAddress(1, 1)} port 9")
+    )
+
+    Simulator.Run()
+    Simulator.Destroy()
+    ok = cl.received >= int(cmd.nPackets)
+    print(f"client echoes received: {cl.received}/{cl.sent}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

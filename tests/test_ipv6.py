@@ -1,0 +1,319 @@
+"""IPv6: addressing, L3, ND, ICMPv6 echo, dual-stack sockets.
+
+Mirrors upstream's ipv6 test suites (SURVEY.md §4;
+src/internet/test/ipv6-address-helper-test-suite.cc,
+ipv6-forwarding-test.cc, icmpv6-redirect-test.cc strategy): unit pins
+on address algebra, then end-to-end exchanges over p2p (no ND), CSMA
+(real NS/NA resolution), and a forwarding chain with static routes.
+"""
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+from tpudes.helper.internet import (
+    InternetStackHelper,
+    Ipv4AddressHelper,
+    Ipv6AddressHelper,
+)
+from tpudes.helper.point_to_point import PointToPointHelper
+from tpudes.network.address import (
+    Inet6SocketAddress,
+    Ipv6Address,
+    Ipv6Prefix,
+    Mac48Address,
+)
+
+
+def _reset():
+    from tpudes.core.world import reset_world
+
+    reset_world()
+
+
+# --- address algebra -------------------------------------------------------
+
+def test_address_parsing_and_compression():
+    a = Ipv6Address("2001:db8::1")
+    assert str(a) == "2001:db8::1"
+    assert Ipv6Address(a.to_bytes()) == a
+    assert Ipv6Address("::").IsAny()
+    assert Ipv6Address("::1").IsLoopback()
+    assert Ipv6Address("ff02::1").IsMulticast()
+    assert Ipv6Address("fe80::42").IsLinkLocal()
+    assert not a.IsMulticast() and not a.IsLinkLocal()
+
+
+def test_prefix_match_and_combine():
+    p = Ipv6Prefix(64)
+    assert p.IsMatch(Ipv6Address("2001:db8::1"), Ipv6Address("2001:db8::ffff"))
+    assert not p.IsMatch(Ipv6Address("2001:db8:1::1"), Ipv6Address("2001:db8::1"))
+    assert str(Ipv6Address("2001:db8::1234").CombinePrefix(p)) == "2001:db8::"
+
+
+def test_eui64_autoconfiguration():
+    mac = Mac48Address("00:11:22:33:44:55")
+    ll = Ipv6Address.MakeAutoconfiguredLinkLocalAddress(mac)
+    # RFC 4291: flip the U/L bit, insert ff:fe
+    assert str(ll) == "fe80::211:22ff:fe33:4455"
+    g = Ipv6Address.MakeAutoconfiguredAddress(mac, Ipv6Address("2001:db8::"))
+    assert str(g) == "2001:db8::211:22ff:fe33:4455"
+    sol = Ipv6Address.MakeSolicitedAddress(Ipv6Address("2001:db8::abcd:1234"))
+    assert str(sol) == "ff02::1:ffcd:1234"
+    assert sol.IsSolicitedMulticast()
+
+
+# --- end-to-end builders ---------------------------------------------------
+
+def _p2p_pair():
+    nodes = NodeContainer()
+    nodes.Create(2)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "5Mbps")
+    p2p.SetChannelAttribute("Delay", "2ms")
+    devices = p2p.Install(nodes)
+    InternetStackHelper().Install(nodes)
+    addr = Ipv6AddressHelper()
+    addr.SetBase("2001:db8::", 64)
+    ifaces = addr.Assign(devices)
+    return nodes, devices, ifaces
+
+
+def test_v6_udp_echo_over_p2p():
+    _reset()
+    nodes, devices, ifaces = _p2p_pair()
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(1))
+    sapps.Start(Seconds(0.5))
+    client = UdpEchoClientHelper(ifaces.GetAddress(1, 1), 9)
+    client.SetAttribute("MaxPackets", 5)
+    client.SetAttribute("Interval", Seconds(0.1))
+    client.SetAttribute("PacketSize", 256)
+    capps = client.Install(nodes.Get(0))
+    capps.Start(Seconds(1.0))
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    assert sapps.Get(0).received == 5
+    assert capps.Get(0).received == 5
+    _reset()
+
+
+def test_link_local_auto_assigned():
+    _reset()
+    nodes, devices, ifaces = _p2p_pair()
+    from tpudes.models.internet.ipv6 import Ipv6L3Protocol
+
+    ipv6 = nodes.Get(0).GetObject(Ipv6L3Protocol)
+    iface = ipv6.GetInterface(1)
+    ll = iface.GetLinkLocalAddress()
+    assert ll is not None and ll.GetLocal().IsLinkLocal()
+    expected = Ipv6Address.MakeAutoconfiguredLinkLocalAddress(
+        devices.Get(0).GetAddress()
+    )
+    assert ll.GetLocal() == expected
+    _reset()
+
+
+def test_ping6_over_p2p():
+    _reset()
+    nodes, devices, ifaces = _p2p_pair()
+    from tpudes.models.internet.icmpv6 import Ping6
+
+    ping = Ping6(Remote=str(ifaces.GetAddress(1, 1)), Interval=0.2, Size=56)
+    nodes.Get(0).AddApplication(ping)
+    ping.SetStartTime(Seconds(1.0))
+    ping.SetStopTime(Seconds(2.0))
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    assert len(ping.rtts) >= 4
+    # 2 ms each way + serialization
+    assert all(0.004 <= r < 0.01 for r in ping.rtts), ping.rtts
+    _reset()
+
+
+def test_ping6_with_nd_over_csma():
+    """CSMA devices need ARP/ND: the first echo rides behind a real
+    NS/NA exchange (solicited-node multicast, EUI-64 learning)."""
+    _reset()
+    from tpudes.models.csma import CsmaHelper
+
+    nodes = NodeContainer()
+    nodes.Create(3)
+    csma = CsmaHelper()
+    csma.SetChannelAttribute("DataRate", "100Mbps")
+    csma.SetChannelAttribute("Delay", "6560ns")
+    devices = csma.Install(nodes)
+    InternetStackHelper().Install(nodes)
+    addr = Ipv6AddressHelper()
+    addr.SetBase("2001:db8:1::", 64)
+    ifaces = addr.Assign(devices)
+
+    from tpudes.models.internet.icmpv6 import Icmpv6L4Protocol, Ping6
+
+    ping = Ping6(Remote=str(ifaces.GetAddress(2, 1)), Interval=0.2)
+    nodes.Get(0).AddApplication(ping)
+    ping.SetStartTime(Seconds(1.0))
+    ping.SetStopTime(Seconds(2.0))
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    assert len(ping.rtts) >= 4
+    # the resolver learned the target's MAC
+    nd = nodes.Get(0).GetObject(Icmpv6L4Protocol)
+    learned = [
+        e.mac for cache in nd._caches.values() for e in cache.values()
+        if e.mac is not None
+    ]
+    assert devices.Get(2).GetAddress() in learned
+    _reset()
+
+
+def test_v6_forwarding_chain_with_static_routes():
+    """n0 -- r -- n1: hop limit decrements across the router; the
+    default routes point at the router's per-link addresses."""
+    _reset()
+    from tpudes.models.internet.ipv6 import Ipv6L3Protocol, Ipv6StaticRouting
+
+    nodes = NodeContainer()
+    nodes.Create(3)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "5Mbps")
+    p2p.SetChannelAttribute("Delay", "1ms")
+    d01 = p2p.Install(nodes.Get(0), nodes.Get(1))
+    d12 = p2p.Install(nodes.Get(1), nodes.Get(2))
+    InternetStackHelper().Install(nodes)
+    a = Ipv6AddressHelper()
+    a.SetBase("2001:db8:a::", 64)
+    i01 = a.Assign(d01)
+    a.SetBase("2001:db8:b::", 64)
+    i12 = a.Assign(d12)
+
+    # default routes toward the middle router
+    r0 = nodes.Get(0).GetObject(Ipv6L3Protocol).GetRoutingProtocol()
+    assert isinstance(r0, Ipv6StaticRouting)
+    r0.SetDefaultRoute(i01.GetAddress(1, 1), 1)
+    r2 = nodes.Get(2).GetObject(Ipv6L3Protocol).GetRoutingProtocol()
+    r2.SetDefaultRoute(i12.GetAddress(0, 1), 1)
+
+    server = UdpEchoServerHelper(7)
+    sapps = server.Install(nodes.Get(2))
+    sapps.Start(Seconds(0.5))
+    client = UdpEchoClientHelper(i12.GetAddress(1, 1), 7)
+    client.SetAttribute("MaxPackets", 3)
+    client.SetAttribute("Interval", Seconds(0.1))
+    capps = client.Install(nodes.Get(0))
+    capps.Start(Seconds(1.0))
+
+    hop_limits = []
+    nodes.Get(2).GetObject(Ipv6L3Protocol).TraceConnectWithoutContext(
+        "LocalDeliver", lambda h, p, i: hop_limits.append(h.hop_limit)
+    )
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    assert capps.Get(0).received == 3
+    # one forwarding hop: 64 - 1
+    assert hop_limits and all(h == 63 for h in hop_limits)
+    _reset()
+
+
+def test_hop_limit_expiry_generates_time_exceeded():
+    _reset()
+    from tpudes.models.internet.icmpv6 import Icmpv6L4Protocol
+    from tpudes.models.internet.ipv6 import Ipv6L3Protocol
+
+    nodes, devices, ifaces = _p2p_pair()
+    # send an echo with hop limit 1 through... a 2-node p2p delivers
+    # directly; instead set DefaultHopLimit=1 on a 3-node chain
+    _reset()
+    from tpudes.models.internet.ipv6 import Ipv6StaticRouting
+
+    nodes = NodeContainer()
+    nodes.Create(3)
+    p2p = PointToPointHelper()
+    d01 = p2p.Install(nodes.Get(0), nodes.Get(1))
+    d12 = p2p.Install(nodes.Get(1), nodes.Get(2))
+    InternetStackHelper().Install(nodes)
+    a = Ipv6AddressHelper()
+    a.SetBase("2001:db8:a::", 64)
+    i01 = a.Assign(d01)
+    a.SetBase("2001:db8:b::", 64)
+    i12 = a.Assign(d12)
+    r0 = nodes.Get(0).GetObject(Ipv6L3Protocol).GetRoutingProtocol()
+    r0.SetDefaultRoute(i01.GetAddress(1, 1), 1)
+    ipv6_0 = nodes.Get(0).GetObject(Ipv6L3Protocol)
+    ipv6_0.default_hop_limit = 1  # expires at the router
+
+    errors = []
+    icmp0 = nodes.Get(0).GetObject(Icmpv6L4Protocol)
+    icmp0.register_error_listener(
+        lambda t, c, inner, src: errors.append((t, c, src))
+    )
+    icmp0.SendEcho(i12.GetAddress(1, 1), 0x77, 1)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    from tpudes.models.internet.icmpv6 import Icmpv6Header
+
+    assert errors and errors[0][0] == Icmpv6Header.TIME_EXCEEDED
+    _reset()
+
+
+def test_dual_stack_same_port_both_families():
+    """One server node answers v4 and v6 echo on the same port."""
+    _reset()
+    nodes = NodeContainer()
+    nodes.Create(2)
+    p2p = PointToPointHelper()
+    devices = p2p.Install(nodes)
+    InternetStackHelper().Install(nodes)
+    a4 = Ipv4AddressHelper()
+    a4.SetBase("10.0.0.0", "255.255.255.0")
+    i4 = a4.Assign(devices)
+    a6 = Ipv6AddressHelper()
+    a6.SetBase("2001:db8::", 64)
+    i6 = a6.Assign(devices)
+
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(1))
+    sapps.Start(Seconds(0.2))
+
+    c4 = UdpEchoClientHelper(i4.GetAddress(1), 9)
+    c4.SetAttribute("MaxPackets", 2)
+    c4.SetAttribute("Interval", Seconds(0.1))
+    a4pps = c4.Install(nodes.Get(0))
+    a4pps.Start(Seconds(1.0))
+
+    c6 = UdpEchoClientHelper(i6.GetAddress(1, 1), 9)
+    c6.SetAttribute("MaxPackets", 2)
+    c6.SetAttribute("Interval", Seconds(0.1))
+    a6pps = c6.Install(nodes.Get(0))
+    a6pps.Start(Seconds(1.0))
+
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    assert sapps.Get(0).received == 4
+    assert a4pps.Get(0).received == 2
+    assert a6pps.Get(0).received == 2
+    _reset()
+
+
+def test_v6_socket_close_frees_port_and_family_mismatch_is_loud():
+    """r5 review regressions: Close() must deallocate v6 endpoints (the
+    port leaked and the dead rx_callback kept firing), and a v4-bound
+    socket given a v6 peer must fail with an error, not silently send
+    from an endpoint replies can never reach."""
+    _reset()
+    from tpudes.models.internet.udp import UdpL4Protocol
+
+    nodes = NodeContainer()
+    nodes.Create(1)
+    InternetStackHelper().Install(nodes)
+    udp = nodes.Get(0).GetObject(UdpL4Protocol)
+    s1 = udp.CreateSocket()
+    assert s1.Bind(Inet6SocketAddress(Ipv6Address.GetAny(), 9)) == 0
+    s1.Close()
+    s2 = udp.CreateSocket()
+    assert s2.Bind(Inet6SocketAddress(Ipv6Address.GetAny(), 9)) == 0
+    s3 = udp.CreateSocket()
+    assert s3.Bind() == 0  # v4 endpoint
+    assert s3.Connect(Inet6SocketAddress(Ipv6Address("2001:db8::1"), 5)) == -1
+    _reset()

@@ -22,6 +22,7 @@ from tpudes.network.address import Ipv4Address, Ipv4Mask
 class InternetStackHelper:
     def __init__(self):
         self._routing_factory = None
+        self._ipv6 = True  # dual stack by default, as upstream
 
     def SetRoutingHelper(self, routing_helper) -> None:
         self._routing_factory = routing_helper
@@ -66,8 +67,30 @@ class InternetStackHelper:
                 tcp.SetNode(node)
                 ipv4.Insert(tcp)
                 node.AggregateObject(tcp)
+            if self._ipv6:
+                from tpudes.models.internet.icmpv6 import Icmpv6L4Protocol
+                from tpudes.models.internet.ipv6 import (
+                    Ipv6L3Protocol,
+                    Ipv6StaticRouting,
+                )
+
+                ipv6 = Ipv6L3Protocol()
+                ipv6.SetNode(node)
+                node.AggregateObject(ipv6)
+                ipv6.SetRoutingProtocol(Ipv6StaticRouting())
+                icmp6 = Icmpv6L4Protocol()
+                icmp6.SetNode(node)
+                ipv6.Insert(icmp6)
+                node.AggregateObject(icmp6)
+                # dual stack: the SAME L4 protocol objects serve both
+                # families (their demux is per-family)
+                ipv6.Insert(udp)
 
     InstallAll = Install
+
+    def SetIpv6StackInstall(self, enable: bool) -> None:
+        """upstream InternetStackHelper::SetIpv6StackInstall."""
+        self._ipv6 = bool(enable)
 
 
 class Ipv4AddressHelper:
@@ -123,3 +146,103 @@ class Ipv4AddressHelper:
                     notify(if_index, Ipv4InterfaceAddress(addr, self._mask))
             container.Add((ipv4, if_index))
         return container
+
+
+class Ipv6AddressHelper:
+    """src/internet/helper/ipv6-address-helper.{h,cc}: sequential
+    interface ids under one /64 (or caller-chosen) prefix; Assign adds
+    the connected-prefix route like the v4 helper does."""
+
+    def __init__(self, network: str = "2001:db8::", prefix: int = 64):
+        self.SetBase(network, prefix)
+
+    def SetBase(self, network: str, prefix: int = 64) -> None:
+        from tpudes.network.address import Ipv6Address, Ipv6Prefix
+
+        self._prefix = Ipv6Prefix(prefix)
+        self._network = Ipv6Address(network).addr & self._prefix.mask_int()
+        self._next = 1
+
+    def NewNetwork(self) -> None:
+        self._network += 1 << (128 - self._prefix.length)
+        self._next = 1
+
+    def NewAddress(self):
+        from tpudes.network.address import Ipv6Address
+
+        host_max = (1 << (128 - self._prefix.length)) - 1
+        if self._next >= host_max:
+            raise RuntimeError("Ipv6AddressHelper: pool exhausted")
+        addr = Ipv6Address(self._network | self._next)
+        self._next += 1
+        return addr
+
+    def Assign(self, devices: NetDeviceContainer):
+        from tpudes.models.internet.ipv6 import (
+            Ipv6InterfaceAddress,
+            Ipv6L3Protocol,
+            Ipv6StaticRouting,
+        )
+        from tpudes.network.address import Ipv6Address
+
+        container = []
+        for device in devices:
+            node = device.GetNode()
+            ipv6 = node.GetObject(Ipv6L3Protocol)
+            if ipv6 is None:
+                raise RuntimeError(
+                    f"node {node.GetId()} has no IPv6 stack "
+                    "(InternetStackHelper dual-stack Install first)"
+                )
+            if_index = ipv6.GetInterfaceForDevice(device)
+            if if_index < 0:
+                if_index = ipv6.AddInterface(device)
+            addr = self.NewAddress()
+            ipv6.AddAddress(if_index, Ipv6InterfaceAddress(addr, self._prefix))
+            routing = ipv6.GetRoutingProtocol()
+            if isinstance(routing, Ipv6StaticRouting):
+                routing.AddNetworkRouteTo(
+                    Ipv6Address(addr.addr & self._prefix.mask_int()),
+                    self._prefix, if_index,
+                )
+            container.append((ipv6, if_index))
+        return Ipv6InterfaceContainer(container)
+
+
+class Ipv6InterfaceContainer:
+    def __init__(self, pairs=None):
+        self._pairs = list(pairs or [])
+
+    def Add(self, pair) -> None:
+        self._pairs.append(pair)
+
+    def GetN(self) -> int:
+        return len(self._pairs)
+
+    def Get(self, i: int):
+        return self._pairs[i]
+
+    def GetAddress(self, i: int, ad: int = 1):
+        """Address ``ad`` of interface i — index 0 is the link-local,
+        1 the first global (upstream convention)."""
+        ipv6, if_index = self._pairs[i]
+        iface = ipv6.GetInterface(if_index)
+        globals_ = [a for a in iface.addresses if not a.local.IsLinkLocal()]
+        locals_ = [a for a in iface.addresses if a.local.IsLinkLocal()]
+        ordered = locals_ + globals_
+        return ordered[ad].GetLocal()
+
+    def SetForwarding(self, i: int, enable: bool) -> None:
+        ipv6, _ = self._pairs[i]
+        ipv6.ip_forward = bool(enable)
+
+    def SetDefaultRouteInAllNodes(self, router_index: int) -> None:
+        from tpudes.models.internet.ipv6 import Ipv6StaticRouting
+
+        gw = self.GetAddress(router_index, 1)
+        for i, (ipv6, if_index) in enumerate(self._pairs):
+            if i == router_index:
+                continue
+            routing = ipv6.GetRoutingProtocol()
+            if isinstance(routing, Ipv6StaticRouting):
+                routing.SetDefaultRoute(gw, if_index)

@@ -31,18 +31,32 @@ class UdpEchoServer(Application):
     def __init__(self, **attributes):
         super().__init__(**attributes)
         self._socket = None
+        self._socket6 = None
         self.received = 0
 
     def StartApplication(self):
+        from tpudes.models.internet.ipv6 import Ipv6L3Protocol
+        from tpudes.network.address import Inet6SocketAddress, Ipv6Address
+
         if self._socket is None:
             self._socket = SocketFactory.CreateSocket(self._node, "tpudes::UdpSocketFactory")
             self._socket.Bind(InetSocketAddress(Ipv4Address.GetAny(), self.port))
         self._socket.SetRecvCallback(self._handle_read)
+        # dual stack: upstream UdpEchoServer listens on a v6 socket too
+        if self._socket6 is None and self._node.GetObject(Ipv6L3Protocol) is not None:
+            self._socket6 = SocketFactory.CreateSocket(
+                self._node, "tpudes::UdpSocketFactory"
+            )
+            self._socket6.Bind(Inet6SocketAddress(Ipv6Address.GetAny(), self.port))
+            self._socket6.SetRecvCallback(self._handle_read)
 
     def StopApplication(self):
         if self._socket is not None:
             self._socket.Close()
             self._socket = None
+        if self._socket6 is not None:
+            self._socket6.Close()
+            self._socket6 = None
 
     def _handle_read(self, socket):
         while True:
@@ -83,9 +97,19 @@ class UdpEchoClient(Application):
 
     def StartApplication(self):
         if self._socket is None:
+            from tpudes.network.address import Inet6SocketAddress, Ipv6Address
+
             self._socket = SocketFactory.CreateSocket(self._node, "tpudes::UdpSocketFactory")
-            self._socket.Bind()
-            self._socket.Connect(InetSocketAddress(Ipv4Address(self.remote_address), self.remote_port))
+            if isinstance(self.remote_address, str) and ":" in self.remote_address:
+                self.remote_address = Ipv6Address(self.remote_address)
+            if isinstance(self.remote_address, Ipv6Address):
+                self._socket.Bind6()
+                self._socket.Connect(
+                    Inet6SocketAddress(self.remote_address, self.remote_port)
+                )
+            else:
+                self._socket.Bind()
+                self._socket.Connect(InetSocketAddress(Ipv4Address(self.remote_address), self.remote_port))
         self._socket.SetRecvCallback(self._handle_read)
         self._schedule_transmit(Time(0))
 

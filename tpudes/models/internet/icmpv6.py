@@ -1,0 +1,347 @@
+"""ICMPv6: echo, errors, and neighbor discovery (the v6 ARP).
+
+Reference parity: src/internet/model/icmpv6-l4-protocol.{h,cc},
+icmpv6-header.{h,cc}, ndisc-cache.{h,cc} and
+src/internet-apps/model/ping6.{h,cc} (SURVEY.md §2.7).  Mirrors the
+split icmp.py + arp.py play in one protocol, as upstream does: ICMPv6
+carries both the ping machinery and the NS/NA resolution that replaces
+ARP on multi-access links.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import Seconds
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import Ipv6Address, Mac48Address
+from tpudes.network.application import Application
+from tpudes.network.packet import Header, Packet
+
+
+class Icmpv6Header(Header):
+    # RFC 4443 / 4861 type numbers
+    DEST_UNREACH = 1
+    TIME_EXCEEDED = 3
+    ECHO_REQUEST = 128
+    ECHO_REPLY = 129
+    NS = 135   # neighbor solicitation
+    NA = 136   # neighbor advertisement
+
+    def __init__(self, icmp_type=0, code=0):
+        self.icmp_type = icmp_type
+        self.code = code
+
+    def GetSerializedSize(self) -> int:
+        return 4
+
+    def Serialize(self) -> bytes:
+        return struct.pack("!BBH", self.icmp_type, self.code, 0)
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        t, c, _ = struct.unpack("!BBH", data[:4])
+        return cls(t, c), 4
+
+    def __repr__(self):
+        return f"Icmpv6(type={self.icmp_type}, code={self.code})"
+
+
+class Icmpv6Echo(Header):
+    def __init__(self, identifier=0, sequence=0):
+        self.identifier = identifier
+        self.sequence = sequence
+
+    def GetSerializedSize(self) -> int:
+        return 4
+
+    def Serialize(self) -> bytes:
+        return struct.pack("!HH", self.identifier, self.sequence)
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        i, s = struct.unpack("!HH", data[:4])
+        return cls(i, s), 4
+
+
+class Icmpv6NdHeader(Header):
+    """NS/NA body: target address + link-layer address option
+    (icmpv6-header.cc Icmpv6NS/Icmpv6NA + option, folded)."""
+
+    def __init__(self, target=None, lladdr=None):
+        self.target = target or Ipv6Address()
+        self.lladdr = lladdr or Mac48Address()
+
+    def GetSerializedSize(self) -> int:
+        return 4 + 16 + 8  # reserved + target + TLLA/SLLA option
+
+    def Serialize(self) -> bytes:
+        return (
+            struct.pack("!I", 0)
+            + self.target.to_bytes()
+            + struct.pack("!BB", 2, 1)
+            + self.lladdr.to_bytes()
+        )
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        target = Ipv6Address.from_bytes(data[4:20])
+        lladdr = Mac48Address.from_bytes(data[22:28])
+        return cls(target, lladdr), 28
+
+
+class NdiscEntry:
+    WAIT_REPLY = 0
+    REACHABLE = 1
+
+    __slots__ = ("state", "mac", "pending", "retries", "timeout_event")
+
+    def __init__(self):
+        self.state = self.WAIT_REPLY
+        self.mac = None
+        self.pending: list = []
+        self.retries = 0
+        self.timeout_event = None
+
+
+class Icmpv6L4Protocol(Object):
+    """Per-node ICMPv6 incl. the ndisc cache (one per interface)."""
+
+    PROT_NUMBER = 58
+
+    tid = (
+        TypeId("tpudes::Icmpv6L4Protocol")
+        .AddConstructor(lambda **kw: Icmpv6L4Protocol(**kw))
+        .AddAttribute("MaxMulticastSolicit", "NS retransmissions", 3,
+                      field="max_retries")
+        .AddAttribute("RetransTimer", "per-NS timeout (s)", 1.0,
+                      field="wait_timeout_s")
+        .AddTraceSource("Rx", "(icmpv6 header, source)")
+        .AddTraceSource("Drop", "packet dropped (no ND resolution)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        self._caches: dict[int, dict[int, NdiscEntry]] = {}
+        self._echo_listeners: dict[int, object] = {}
+        self._error_listeners: list = []
+
+    def SetNode(self, node) -> None:
+        self._node = node
+
+    def register_echo_listener(self, identifier: int, cb) -> None:
+        self._echo_listeners[identifier] = cb
+
+    def register_error_listener(self, cb) -> None:
+        self._error_listeners.append(cb)
+
+    def _ipv6(self):
+        from tpudes.models.internet.ipv6 import Ipv6L3Protocol
+
+        return self._node.GetObject(Ipv6L3Protocol)
+
+    # --- echo ---------------------------------------------------------------
+    def SendEcho(self, dest: Ipv6Address, identifier: int, sequence: int,
+                 payload_bytes: int = 56) -> None:
+        packet = Packet(payload_bytes)
+        packet.AddHeader(Icmpv6Echo(identifier, sequence))
+        packet.AddHeader(Icmpv6Header(Icmpv6Header.ECHO_REQUEST, 0))
+        from tpudes.models.internet.ipv6 import Ipv6Header
+
+        ipv6 = self._ipv6()
+        route, _ = ipv6.GetRoutingProtocol().RouteOutput(
+            packet, Ipv6Header(destination=dest)
+        )
+        src = route.source if route is not None else Ipv6Address.GetAny()
+        ipv6.Send(packet, src, dest, self.PROT_NUMBER)
+
+    # --- errors -------------------------------------------------------------
+    def _send_error(self, icmp_type: int, code: int, offending_header,
+                    offending_packet) -> None:
+        packet = Packet(offending_packet.ToBytes()[:8])
+        packet.AddHeader(offending_header)
+        packet.AddHeader(Icmpv6Header(icmp_type, code))
+        ipv6 = self._ipv6()
+        ipv6.Send(
+            packet, Ipv6Address.GetAny(), offending_header.source,
+            self.PROT_NUMBER,
+        )
+
+    def SendTimeExceeded(self, header, packet) -> None:
+        self._send_error(Icmpv6Header.TIME_EXCEEDED, 0, header, packet)
+
+    def SendDestUnreachable(self, header, packet) -> None:
+        self._send_error(Icmpv6Header.DEST_UNREACH, 0, header, packet)
+
+    # --- neighbor discovery (NdiscCache + Icmpv6L4Protocol::Lookup) ---------
+    def _cache(self, iface) -> dict:
+        return self._caches.setdefault(id(iface), {})
+
+    def LookupNeighbor(self, packet: Packet, dest: Ipv6Address, iface) -> None:
+        """Send ``packet`` once dest's MAC is known; NS on miss."""
+        cache = self._cache(iface)
+        entry = cache.get(dest.addr)
+        if entry is not None and entry.state == NdiscEntry.REACHABLE:
+            iface.device.Send(packet, entry.mac, 0x86DD)
+            return
+        if entry is None:
+            entry = NdiscEntry()
+            cache[dest.addr] = entry
+            self._send_ns(iface, dest)
+            entry.timeout_event = Simulator.Schedule(
+                Seconds(self.wait_timeout_s), self._on_timeout, iface, dest
+            )
+        entry.pending.append(packet)
+
+    def _send_ns(self, iface, target: Ipv6Address) -> None:
+        ns = Packet(0)
+        ns.AddHeader(Icmpv6NdHeader(target, iface.device.GetAddress()))
+        ns.AddHeader(Icmpv6Header(Icmpv6Header.NS, 0))
+        ipv6 = self._ipv6()
+        if_index = ipv6.GetInterfaceForDevice(iface.device)
+        src = ipv6.SelectSourceAddress(if_index, target)
+        from tpudes.models.internet.ipv6 import Ipv6Header
+
+        header = Ipv6Header(
+            source=src,
+            destination=Ipv6Address.MakeSolicitedAddress(target),
+            next_header=self.PROT_NUMBER,
+            hop_limit=255,
+            payload_size=ns.GetSize(),
+        )
+        ns.AddHeader(header)
+        iface.device.Send(ns, iface.device.GetBroadcast(), 0x86DD)
+
+    def _on_timeout(self, iface, dest):
+        cache = self._cache(iface)
+        entry = cache.get(dest.addr)
+        if entry is None or entry.state == NdiscEntry.REACHABLE:
+            return
+        entry.retries += 1
+        if entry.retries >= int(self.max_retries):
+            for pkt in entry.pending:
+                self.drop(pkt)
+            del cache[dest.addr]
+            return
+        self._send_ns(iface, dest)
+        entry.timeout_event = Simulator.Schedule(
+            Seconds(self.wait_timeout_s), self._on_timeout, iface, dest
+        )
+
+    def _learn(self, iface, addr: Ipv6Address, mac: Mac48Address) -> None:
+        cache = self._cache(iface)
+        entry = cache.get(addr.addr)
+        if entry is None:
+            entry = NdiscEntry()
+            cache[addr.addr] = entry
+        entry.state = NdiscEntry.REACHABLE
+        entry.mac = mac
+        if entry.timeout_event is not None:
+            entry.timeout_event.Cancel()
+            entry.timeout_event = None
+        pending, entry.pending = entry.pending, []
+        for pkt in pending:
+            iface.device.Send(pkt, mac, 0x86DD)
+
+    # --- receive ------------------------------------------------------------
+    def Receive(self, packet, ip_header, iface) -> None:
+        icmp = packet.RemoveHeader(Icmpv6Header)
+        self.rx(icmp, ip_header.source)
+        ipv6 = self._ipv6()
+        if icmp.icmp_type == Icmpv6Header.ECHO_REQUEST:
+            echo = packet.RemoveHeader(Icmpv6Echo)
+            reply = Packet(packet.GetSize())
+            reply.AddHeader(Icmpv6Echo(echo.identifier, echo.sequence))
+            reply.AddHeader(Icmpv6Header(Icmpv6Header.ECHO_REPLY, 0))
+            src = ip_header.destination
+            if src.IsMulticast():
+                if_index = ipv6.GetInterfaceForDevice(iface.device) if iface.device else 0
+                src = ipv6.SelectSourceAddress(if_index, ip_header.source)
+            ipv6.Send(reply, src, ip_header.source, self.PROT_NUMBER)
+        elif icmp.icmp_type == Icmpv6Header.ECHO_REPLY:
+            echo = packet.RemoveHeader(Icmpv6Echo)
+            cb = self._echo_listeners.get(echo.identifier)
+            if cb is not None:
+                cb(ip_header.source, echo.sequence, packet)
+        elif icmp.icmp_type == Icmpv6Header.NS:
+            nd = packet.RemoveHeader(Icmpv6NdHeader)
+            # learn the solicitor, answer if the target is ours
+            self._learn(iface, ip_header.source, nd.lladdr)
+            if ipv6.GetInterfaceForAddress(nd.target) >= 0:
+                na = Packet(0)
+                na.AddHeader(Icmpv6NdHeader(nd.target, iface.device.GetAddress()))
+                na.AddHeader(Icmpv6Header(Icmpv6Header.NA, 0))
+                from tpudes.models.internet.ipv6 import Ipv6Header
+
+                header = Ipv6Header(
+                    source=nd.target,
+                    destination=ip_header.source,
+                    next_header=self.PROT_NUMBER,
+                    hop_limit=255,
+                    payload_size=na.GetSize(),
+                )
+                na.AddHeader(header)
+                cache = self._cache(iface)
+                entry = cache.get(ip_header.source.addr)
+                iface.device.Send(na, entry.mac, 0x86DD)
+        elif icmp.icmp_type == Icmpv6Header.NA:
+            nd = packet.RemoveHeader(Icmpv6NdHeader)
+            self._learn(iface, nd.target, nd.lladdr)
+        else:
+            inner = packet.PeekHeader()
+            for cb in self._error_listeners:
+                cb(icmp.icmp_type, icmp.code, inner, ip_header.source)
+
+
+class Ping6(Application):
+    """src/internet-apps/model/ping6.{h,cc}: periodic ICMPv6 echo."""
+
+    tid = (
+        TypeId("tpudes::Ping6")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: Ping6(**kw))
+        .AddAttribute("Remote", "destination", "::1", field="remote")
+        .AddAttribute("Interval", "seconds between echoes", 1.0, field="interval_s")
+        .AddAttribute("Size", "payload bytes", 56, field="size")
+        .AddTraceSource("Rtt", "(sequence, rtt_seconds)")
+    )
+
+    _next_ident = 0x6000
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self.ident = Ping6._next_ident
+        Ping6._next_ident += 1
+        self._seq = 0
+        self._sent: dict[int, int] = {}  # seq -> tx ticks
+        self._event = None
+        self.rtts: list[float] = []
+
+    def StartApplication(self) -> None:
+        icmp = self._node.GetObject(Icmpv6L4Protocol)
+        if icmp is None:
+            raise RuntimeError("Ping6 needs the ICMPv6 protocol installed")
+        icmp.register_echo_listener(self.ident, self._on_reply)
+        self._send()
+
+    def StopApplication(self) -> None:
+        if self._event is not None:
+            self._event.Cancel()
+            self._event = None
+
+    def _send(self) -> None:
+        icmp = self._node.GetObject(Icmpv6L4Protocol)
+        self._seq += 1
+        self._sent[self._seq] = Simulator.NowTicks()
+        icmp.SendEcho(Ipv6Address(self.remote), self.ident, self._seq, int(self.size))
+        self._event = Simulator.Schedule(Seconds(self.interval_s), self._send)
+
+    def _on_reply(self, source, sequence, packet) -> None:
+        tx = self._sent.pop(sequence, None)
+        if tx is None:
+            return
+        rtt_s = (Simulator.NowTicks() - tx) / 1e9
+        self.rtts.append(rtt_s)
+        self.rtt(sequence, rtt_s)

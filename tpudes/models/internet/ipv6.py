@@ -1,0 +1,518 @@
+"""IPv6 L3: header, interfaces, static routing, forwarding.
+
+Reference parity: src/internet/model/ipv6-l3-protocol.{h,cc},
+ipv6-interface.{h,cc}, ipv6-static-routing.{h,cc},
+ipv6-route.{h,cc} (SURVEY.md §2.7 "IPv4/IPv6 L3" row).  Mirrors
+ipv4.py's structure; the deltas are the v6 semantics: 40-byte fixed
+header with hop limit, link-local autoconfiguration (EUI-64) on every
+interface, multicast in place of broadcast, and neighbor discovery
+(icmpv6.py) in place of ARP.  Extension headers are not modeled (the
+upstream core path without options is the same fixed header).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.object import Object, TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import Ipv6Address, Ipv6Prefix
+from tpudes.network.packet import Header
+
+
+class Ipv6Header(Header):
+    """40-byte fixed IPv6 header (src/internet/model/ipv6-header.cc).
+
+    ``protocol``/``ttl`` alias next-header/hop-limit so family-agnostic
+    L4 code (udp.py, tcp.py) reads one header shape.
+    """
+
+    def __init__(
+        self,
+        source: Ipv6Address = None,
+        destination: Ipv6Address = None,
+        next_header: int = 0,
+        hop_limit: int = 64,
+        payload_size: int = 0,
+        traffic_class: int = 0,
+    ):
+        self.source = source or Ipv6Address()
+        self.destination = destination or Ipv6Address()
+        self.next_header = next_header
+        self.hop_limit = hop_limit
+        self.payload_size = payload_size
+        self.traffic_class = traffic_class
+
+    # family-agnostic aliases (Ipv4Header API)
+    @property
+    def protocol(self) -> int:
+        return self.next_header
+
+    @property
+    def ttl(self) -> int:
+        return self.hop_limit
+
+    def GetSerializedSize(self) -> int:
+        return 40
+
+    def Serialize(self) -> bytes:
+        vtf = (6 << 28) | (self.traffic_class << 20)
+        return struct.pack(
+            "!IHBB16s16s",
+            vtf,
+            self.payload_size,
+            self.next_header,
+            self.hop_limit,
+            self.source.to_bytes(),
+            self.destination.to_bytes(),
+        )
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        vtf, plen, nh, hl, src, dst = struct.unpack("!IHBB16s16s", data[:40])
+        return cls(
+            Ipv6Address.from_bytes(src),
+            Ipv6Address.from_bytes(dst),
+            nh,
+            hl,
+            plen,
+            (vtf >> 20) & 0xFF,
+        ), 40
+
+    def GetSource(self):
+        return self.source
+
+    def GetDestination(self):
+        return self.destination
+
+    def GetNextHeader(self):
+        return self.next_header
+
+    def GetHopLimit(self):
+        return self.hop_limit
+
+
+class Ipv6InterfaceAddress:
+    __slots__ = ("local", "prefix")
+
+    def __init__(self, local: Ipv6Address, prefix: Ipv6Prefix = None):
+        self.local = Ipv6Address(local)
+        self.prefix = Ipv6Prefix(prefix if prefix is not None else 64)
+
+    def GetLocal(self) -> Ipv6Address:
+        return self.local
+
+    def GetPrefix(self) -> Ipv6Prefix:
+        return self.prefix
+
+    def GetBroadcast(self) -> Ipv6Address:
+        return Ipv6Address.GetAny()  # no broadcast in v6 (demux shim)
+
+    def __repr__(self):
+        return f"{self.local}/{self.prefix.length}"
+
+
+class Ipv6Interface(Object):
+    tid = (
+        TypeId("tpudes::Ipv6Interface")
+        .AddAttribute("Metric", "interface metric", 1)
+    )
+
+    def __init__(self, device=None, **attributes):
+        super().__init__(**attributes)
+        self.device = device
+        self.addresses: list[Ipv6InterfaceAddress] = []
+        self.up = True
+
+    def AddAddress(self, addr: Ipv6InterfaceAddress) -> None:
+        self.addresses.append(addr)
+
+    def GetAddress(self, i: int = 0) -> Ipv6InterfaceAddress:
+        return self.addresses[i]
+
+    def GetNAddresses(self) -> int:
+        return len(self.addresses)
+
+    def GetLinkLocalAddress(self) -> Ipv6InterfaceAddress | None:
+        for a in self.addresses:
+            if a.local.IsLinkLocal():
+                return a
+        return None
+
+    def IsUp(self) -> bool:
+        return self.up
+
+    def SetUp(self) -> None:
+        self.up = True
+
+    def SetDown(self) -> None:
+        self.up = False
+
+    def Send(self, packet, header, dest_mac=None) -> None:
+        device = self.device
+        if device is None:  # loopback
+            node = self._node
+            Simulator.ScheduleWithContext(
+                node.GetId(), 0,
+                node.GetObject(Ipv6L3Protocol)._receive_loopback, packet,
+            )
+            return
+        dest = dest_mac if dest_mac is not None else device.GetBroadcast()
+        device.Send(packet, dest, Ipv6L3Protocol.PROT_NUMBER)
+
+
+class Ipv6Route:
+    __slots__ = ("destination", "source", "gateway", "output_device", "if_index")
+
+    def __init__(self, destination=None, source=None, gateway=None, output_device=None):
+        self.destination = destination
+        self.source = source
+        self.gateway = gateway
+        self.output_device = output_device
+        self.if_index = None
+
+    def __repr__(self):
+        return f"Route6(dst={self.destination}, src={self.source}, gw={self.gateway})"
+
+
+class Ipv6RoutingProtocol(Object):
+    tid = TypeId("tpudes::Ipv6RoutingProtocol")
+
+    def SetIpv6(self, ipv6) -> None:
+        self.ipv6 = ipv6
+
+    def RouteOutput(self, packet, header, oif=None):
+        raise NotImplementedError
+
+
+class Ipv6StaticRouting(Ipv6RoutingProtocol):
+    """Longest-prefix-match static routing
+    (src/internet/model/ipv6-static-routing.{h,cc})."""
+
+    tid = (
+        TypeId("tpudes::Ipv6StaticRouting")
+        .SetParent(Ipv6RoutingProtocol.tid)
+        .AddConstructor(lambda **kw: Ipv6StaticRouting(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        # (network, prefix, gateway|None, ifindex, metric)
+        self.routes: list[tuple] = []
+
+    def AddNetworkRouteTo(self, network, prefix, if_index, gateway=None, metric=0):
+        self.routes.append(
+            (
+                Ipv6Address(network),
+                Ipv6Prefix(prefix),
+                Ipv6Address(gateway) if gateway is not None else None,
+                if_index,
+                metric,
+            )
+        )
+
+    def AddHostRouteTo(self, dest, if_index, gateway=None, metric=0):
+        self.AddNetworkRouteTo(dest, Ipv6Prefix(128), if_index, gateway, metric)
+
+    def SetDefaultRoute(self, gateway, if_index, metric=0):
+        self.AddNetworkRouteTo(Ipv6Address.GetAny(), Ipv6Prefix(0), if_index, gateway, metric)
+
+    def GetNRoutes(self) -> int:
+        return len(self.routes)
+
+    def LookupRoute(self, dest: Ipv6Address):
+        best, best_key = None, (-1, -(1 << 30))
+        for network, prefix, gateway, if_index, metric in self.routes:
+            if prefix.IsMatch(dest, network):
+                key = (prefix.GetPrefixLength(), -metric)
+                if key > best_key:
+                    best, best_key = (network, prefix, gateway, if_index, metric), key
+        return best
+
+    def RouteOutput(self, packet, header, oif=None):
+        dest = header.destination
+        if dest.IsLinkLocal() or dest.IsMulticast():
+            # link-local / multicast go out the (single) candidate
+            # interface directly — no table lookup
+            if_index = oif if oif is not None else self._first_up_index()
+            if if_index is None:
+                return None, 10
+            iface = self.ipv6.GetInterface(if_index)
+            route = Ipv6Route(
+                destination=dest,
+                source=self.ipv6.SelectSourceAddress(if_index, dest),
+                gateway=None,
+                output_device=iface.device,
+            )
+            route.if_index = if_index
+            return route, 0
+        found = self.LookupRoute(dest)
+        if found is None:
+            return None, 10
+        _, _, gateway, if_index, _ = found
+        iface = self.ipv6.GetInterface(if_index)
+        route = Ipv6Route(
+            destination=dest,
+            source=self.ipv6.SelectSourceAddress(if_index, dest),
+            gateway=gateway,
+            output_device=iface.device,
+        )
+        route.if_index = if_index
+        return route, 0
+
+    def _first_up_index(self):
+        for i in range(1, self.ipv6.GetNInterfaces()):
+            if self.ipv6.GetInterface(i).IsUp():
+                return i
+        return None
+
+
+class Ipv6L3Protocol(Object):
+    """The IPv6 layer aggregated on each node
+    (src/internet/model/ipv6-l3-protocol.{h,cc})."""
+
+    PROT_NUMBER = 0x86DD
+
+    tid = (
+        TypeId("tpudes::Ipv6L3Protocol")
+        .AddConstructor(lambda **kw: Ipv6L3Protocol(**kw))
+        .AddAttribute("DefaultHopLimit", "Default hop limit", 64)
+        .AddAttribute("IpForward", "Enable forwarding", True)
+        .AddTraceSource("Tx", "ip tx (packet, interface)")
+        .AddTraceSource("Rx", "ip rx (packet, interface)")
+        .AddTraceSource("Drop", "ip drop (header, packet, reason)")
+        .AddTraceSource("SendOutgoing", "(header, packet, interface)")
+        .AddTraceSource("UnicastForward", "(header, packet, interface)")
+        .AddTraceSource("LocalDeliver", "(header, packet, interface)")
+    )
+
+    DROP_TTL_EXPIRED = 1
+    DROP_NO_ROUTE = 2
+    DROP_INTERFACE_DOWN = 5
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._node = None
+        self.interfaces: list[Ipv6Interface] = []
+        self._protocols: dict[int, object] = {}
+        self._routing: Ipv6RoutingProtocol | None = None
+
+    # --- node wiring ---
+    def SetNode(self, node) -> None:
+        self._node = node
+        lo = Ipv6Interface(device=None)
+        lo._node = node
+        lo.AddAddress(Ipv6InterfaceAddress(Ipv6Address.GetLoopback(), Ipv6Prefix(128)))
+        self.interfaces.append(lo)
+
+    def GetNode(self):
+        return self._node
+
+    def SetRoutingProtocol(self, routing: Ipv6RoutingProtocol) -> None:
+        self._routing = routing
+        routing.SetIpv6(self)
+
+    def GetRoutingProtocol(self) -> Ipv6RoutingProtocol:
+        return self._routing
+
+    def Insert(self, l4_protocol) -> None:
+        self._protocols[l4_protocol.PROT_NUMBER] = l4_protocol
+
+    def GetProtocol(self, number: int):
+        return self._protocols.get(number)
+
+    # --- interfaces ---
+    def AddInterface(self, device) -> int:
+        index = len(self.interfaces)
+        iface = Ipv6Interface(device=device)
+        iface._node = self._node
+        self.interfaces.append(iface)
+        # RFC 4862: every interface gets an EUI-64 link-local address
+        mac = device.GetAddress()
+        if hasattr(mac, "to_bytes"):
+            iface.AddAddress(
+                Ipv6InterfaceAddress(
+                    Ipv6Address.MakeAutoconfiguredLinkLocalAddress(mac),
+                    Ipv6Prefix(64),
+                )
+            )
+        self._node.RegisterProtocolHandler(self._receive, self.PROT_NUMBER, device)
+        return index
+
+    def GetInterface(self, i: int) -> Ipv6Interface:
+        return self.interfaces[i]
+
+    def GetNInterfaces(self) -> int:
+        return len(self.interfaces)
+
+    def AddAddress(self, i: int, addr: Ipv6InterfaceAddress) -> None:
+        self.interfaces[i].AddAddress(addr)
+
+    def GetAddress(self, i: int, ad: int = 0) -> Ipv6InterfaceAddress:
+        return self.interfaces[i].GetAddress(ad)
+
+    def GetInterfaceForAddress(self, addr: Ipv6Address) -> int:
+        for i, iface in enumerate(self.interfaces):
+            for a in iface.addresses:
+                if a.local == addr:
+                    return i
+        return -1
+
+    def GetInterfaceForDevice(self, device) -> int:
+        for i, iface in enumerate(self.interfaces):
+            if iface.device is device:
+                return i
+        return -1
+
+    def SelectSourceAddress(self, if_index: int, dest: Ipv6Address = None) -> Ipv6Address:
+        """Global address for global destinations, link-local for
+        link-local ones (a one-rule RFC 6724)."""
+        iface = self.interfaces[if_index]
+        want_ll = dest is not None and (dest.IsLinkLocal() or dest.IsSolicitedMulticast())
+        for a in iface.addresses:
+            if a.local.IsLinkLocal() == want_ll:
+                return a.local
+        return iface.addresses[0].local if iface.addresses else Ipv6Address.GetAny()
+
+    def IsDestinationAddress(self, addr: Ipv6Address, iif: int) -> bool:
+        if addr.IsLoopback() or addr == Ipv6Address.GetAllNodesMulticast():
+            return True
+        if addr.IsSolicitedMulticast():
+            # ours iff a local address has the matching low 24 bits
+            for iface in self.interfaces:
+                for a in iface.addresses:
+                    if Ipv6Address.MakeSolicitedAddress(a.local) == addr:
+                        return True
+            return False
+        for iface in self.interfaces:
+            for a in iface.addresses:
+                if a.local == addr:
+                    return True
+        return False
+
+    def SetUp(self, i: int) -> None:
+        self.interfaces[i].SetUp()
+
+    def SetDown(self, i: int) -> None:
+        self.interfaces[i].SetDown()
+
+    def IsUp(self, i: int) -> bool:
+        return self.interfaces[i].IsUp()
+
+    # --- send path ---
+    def Send(self, packet, source: Ipv6Address, destination: Ipv6Address,
+             protocol: int, route: Ipv6Route = None, tos: int = 0):
+        header = Ipv6Header(
+            source=source,
+            destination=destination,
+            next_header=protocol,
+            hop_limit=self.default_hop_limit,
+            payload_size=packet.GetSize(),
+            traffic_class=tos,
+        )
+        if destination.IsLoopback():
+            packet.AddHeader(header)
+            Simulator.ScheduleWithContext(
+                self._node.GetId(), 0, self._receive_loopback, packet
+            )
+            return
+        if route is None:
+            route, errno = self._routing.RouteOutput(packet, header)
+            if route is None:
+                self.drop(header, packet, self.DROP_NO_ROUTE)
+                return
+        if_index = getattr(route, "if_index", None)
+        if if_index is None:
+            if_index = self.GetInterfaceForDevice(route.output_device)
+        iface = self.interfaces[if_index]
+        if not iface.IsUp():
+            self.drop(header, packet, self.DROP_INTERFACE_DOWN)
+            return
+        self.send_outgoing(header, packet, if_index)
+        packet.AddHeader(header)
+        self.tx(packet, if_index)
+        self._send_via(iface, packet, header, route)
+
+    # --- receive path ---
+    def _receive(self, device, packet, protocol, sender):
+        if_index = self.GetInterfaceForDevice(device)
+        if not self.interfaces[if_index].IsUp():
+            return
+        packet = packet.Copy()
+        self.rx(packet, if_index)
+        header = packet.RemoveHeader(Ipv6Header)
+        if self.IsDestinationAddress(header.destination, if_index):
+            self.local_deliver(header, packet, if_index)
+            self._deliver_l4(packet, header, if_index)
+        elif self.ip_forward and not header.destination.IsMulticast():
+            self._forward(packet, header, if_index)
+        else:
+            self.drop(header, packet, self.DROP_NO_ROUTE)
+
+    def _receive_loopback(self, packet):
+        header = packet.RemoveHeader(Ipv6Header)
+        self.local_deliver(header, packet, 0)
+        self._deliver_l4(packet, header, 0)
+
+    def _deliver_l4(self, packet, header, if_index):
+        l4 = self._protocols.get(header.next_header)
+        if l4 is not None:
+            l4.Receive(packet, header, self.interfaces[if_index])
+
+    def _forward(self, packet, header, in_if):
+        import copy as _copy
+
+        header = _copy.copy(header)
+        header.hop_limit -= 1
+        if header.hop_limit <= 0:
+            self.drop(header, packet, self.DROP_TTL_EXPIRED)
+            self._icmp_error(header, packet, "ttl")
+            return
+        route, errno = self._routing.RouteOutput(packet, header)
+        if route is None:
+            self.drop(header, packet, self.DROP_NO_ROUTE)
+            self._icmp_error(header, packet, "unreach")
+            return
+        if_index = getattr(route, "if_index", None)
+        if if_index is None:
+            if_index = self.GetInterfaceForDevice(route.output_device)
+        if not self.interfaces[if_index].IsUp():
+            self.drop(header, packet, self.DROP_INTERFACE_DOWN)
+            return
+        self.unicast_forward(header, packet, if_index)
+        packet.AddHeader(header)
+        self.tx(packet, if_index)
+        self._send_via(self.interfaces[if_index], packet, header, route)
+
+    def _icmp_error(self, header, packet, kind: str) -> None:
+        from tpudes.models.internet.icmpv6 import Icmpv6L4Protocol
+
+        icmp = self._protocols.get(Icmpv6L4Protocol.PROT_NUMBER)
+        if icmp is None or header.source.IsAny():
+            return
+        if kind == "ttl":
+            icmp.SendTimeExceeded(header, packet)
+        else:
+            icmp.SendDestUnreachable(header, packet)
+
+    def _send_via(self, iface, packet, header, route):
+        """Resolve the next-hop MAC through neighbor discovery on
+        devices that need it (Ipv6Interface::Send → NdiscCache)."""
+        device = iface.device
+        has_gateway = (
+            route is not None
+            and route.gateway is not None
+            and not route.gateway.IsAny()
+        )
+        next_hop = route.gateway if has_gateway else header.destination
+        if device is not None and not next_hop.IsMulticast() and device.NeedsArp():
+            from tpudes.models.internet.icmpv6 import Icmpv6L4Protocol
+
+            nd = self._protocols.get(Icmpv6L4Protocol.PROT_NUMBER)
+            if nd is not None:
+                nd.LookupNeighbor(packet, next_hop, iface)
+                return
+        iface.Send(packet, header)
+
+
+Ipv6 = Ipv6L3Protocol

@@ -12,7 +12,12 @@ from collections import deque
 
 from tpudes.core.object import TypeId
 from tpudes.core.simulator import Simulator
-from tpudes.network.address import InetSocketAddress, Ipv4Address
+from tpudes.network.address import (
+    Inet6SocketAddress,
+    InetSocketAddress,
+    Ipv4Address,
+    Ipv6Address,
+)
 from tpudes.network.packet import Header
 from tpudes.network.socket import (
     ERROR_ADDRINUSE,
@@ -162,6 +167,10 @@ class UdpL4Protocol(Object):
         super().__init__(**attributes)
         self._node = None
         self._demux = Ipv4EndPointDemux()
+        # v6 bindings live in their own demux (upstream keeps a separate
+        # Ipv6EndPointDemux); the endpoint/scoring machinery is
+        # family-agnostic, so the same class serves both
+        self._demux6 = Ipv4EndPointDemux()
 
     def SetNode(self, node) -> None:
         self._node = node
@@ -180,10 +189,29 @@ class UdpL4Protocol(Object):
         ipv4 = self._node.GetObject(Ipv4L3Protocol)
         ipv4.Send(packet, saddr, daddr, self.PROT_NUMBER, route, tos=tos)
 
-    # --- rx (from Ipv4L3Protocol._deliver_l4) ---
+    def Send6(self, packet, saddr: Ipv6Address, daddr: Ipv6Address,
+              sport: int, dport: int, route=None, tos: int = 0):
+        packet.AddHeader(UdpHeader(sport, dport, packet.GetSize()))
+        from tpudes.models.internet.ipv6 import Ipv6L3Protocol
+
+        ipv6 = self._node.GetObject(Ipv6L3Protocol)
+        ipv6.Send(packet, saddr, daddr, self.PROT_NUMBER, route, tos=tos)
+
+    # --- rx (from Ipv4L3Protocol._deliver_l4 / Ipv6 counterpart) ---
     def Receive(self, packet, ip_header, incoming_interface):
         udp_header = packet.RemoveHeader(UdpHeader)
         dst = ip_header.destination
+        if isinstance(dst, Ipv6Address):
+            ep = self._demux6.Lookup(
+                dst,
+                udp_header.destination_port,
+                ip_header.source,
+                udp_header.source_port,
+                dst == Ipv6Address.GetAllNodesMulticast(),
+            )
+            if ep is not None and ep.rx_callback is not None:
+                ep.rx_callback(packet, ip_header, udp_header)
+            return
         dst_is_broadcast = dst.IsBroadcast() or any(
             a.GetBroadcast() == dst for a in incoming_interface.addresses
         )
@@ -224,6 +252,10 @@ class UdpSocketImpl(Socket):
             return 0
         if address is None:
             self._endpoint = self._udp._demux.Allocate()
+        elif isinstance(address, Inet6SocketAddress):
+            self._endpoint = self._udp._demux6.Allocate(
+                address.GetIpv6(), address.GetPort()
+            )
         else:
             self._endpoint = self._udp._demux.Allocate(address.GetIpv4(), address.GetPort())
         if self._endpoint is None:
@@ -232,7 +264,28 @@ class UdpSocketImpl(Socket):
         self._endpoint.rx_callback = self._forward_up
         return 0
 
+    def Bind6(self) -> int:
+        """Unbound v6 socket (upstream UdpSocketImpl::Bind6)."""
+        if self._endpoint is not None:
+            return 0
+        self._endpoint = self._udp._demux6.Allocate(Ipv6Address.GetAny())
+        if self._endpoint is None:
+            self._errno = ERROR_ADDRINUSE
+            return -1
+        self._endpoint.rx_callback = self._forward_up
+        return 0
+
     def Connect(self, address: InetSocketAddress) -> int:
+        if isinstance(address, Inet6SocketAddress):
+            if self._endpoint is None and self.Bind6() != 0:
+                return -1
+            if not isinstance(self._endpoint.local_addr, Ipv6Address):
+                self._errno = ERROR_INVAL  # v4-bound socket, v6 peer
+                return -1
+            self._default_dest = address
+            self._endpoint.SetPeer(address.GetIpv6(), address.GetPort())
+            self.NotifyConnectionSucceeded()
+            return 0
         if self._endpoint is None and self.Bind() != 0:
             return -1
         self._default_dest = address
@@ -255,6 +308,8 @@ class UdpSocketImpl(Socket):
         if self._shutdown_send:
             self._errno = ERROR_SHUTDOWN
             return -1
+        if isinstance(to_address, Inet6SocketAddress):
+            return self._send_to6(packet, to_address)
         if self._endpoint is None and self.Bind() != 0:
             return -1
         from tpudes.models.internet.ipv4 import Ipv4L3Protocol, Ipv4Header
@@ -281,12 +336,47 @@ class UdpSocketImpl(Socket):
         self.NotifySend(self.GetTxAvailable())
         return size
 
+    def _send_to6(self, packet, to_address: Inet6SocketAddress) -> int:
+        if self._endpoint is None and self.Bind6() != 0:
+            return -1
+        if not isinstance(self._endpoint.local_addr, Ipv6Address):
+            self._errno = ERROR_INVAL  # v4-bound socket, v6 destination
+            return -1
+        from tpudes.models.internet.ipv6 import Ipv6L3Protocol
+
+        ipv6 = self._node.GetObject(Ipv6L3Protocol)
+        daddr = to_address.GetIpv6()
+        saddr = self._endpoint.local_addr
+        if not isinstance(saddr, Ipv6Address) or saddr.IsAny():
+            if daddr.IsLoopback():
+                saddr = Ipv6Address.GetLoopback()
+            else:
+                from tpudes.models.internet.ipv6 import Ipv6Header
+
+                probe = Ipv6Header(destination=daddr)
+                route, errno = ipv6.GetRoutingProtocol().RouteOutput(packet, probe)
+                if route is None:
+                    self._errno = ERROR_NOROUTETOHOST
+                    return -1
+                saddr = route.source
+        size = packet.GetSize()
+        self._udp.Send6(
+            packet, saddr, daddr, self._endpoint.local_port,
+            to_address.GetPort(), tos=self._ip_tos,
+        )
+        self.NotifyDataSent(size)
+        self.NotifySend(self.GetTxAvailable())
+        return size
+
     def _forward_up(self, packet, ip_header, udp_header):
         if self._shutdown_recv:
             return
         if self._rx_bytes + packet.GetSize() > self.rcv_buf_size:
             return  # drop on full buffer
-        src = InetSocketAddress(ip_header.source, udp_header.source_port)
+        if isinstance(ip_header.source, Ipv6Address):
+            src = Inet6SocketAddress(ip_header.source, udp_header.source_port)
+        else:
+            src = InetSocketAddress(ip_header.source, udp_header.source_port)
         self._rx_queue.append((packet, src))
         self._rx_bytes += packet.GetSize()
         self.NotifyDataRecv()
@@ -308,11 +398,19 @@ class UdpSocketImpl(Socket):
     def GetSockName(self) -> InetSocketAddress:
         if self._endpoint is None:
             return InetSocketAddress(Ipv4Address.GetAny(), 0)
+        if isinstance(self._endpoint.local_addr, Ipv6Address):
+            return Inet6SocketAddress(
+                self._endpoint.local_addr, self._endpoint.local_port
+            )
         return InetSocketAddress(self._endpoint.local_addr, self._endpoint.local_port)
 
     def Close(self) -> int:
         if self._endpoint is not None:
+            # DeAllocate is membership-checked; the endpoint lives in
+            # exactly one of the two family demuxes
             self._udp._demux.DeAllocate(self._endpoint)
+            self._udp._demux6.DeAllocate(self._endpoint)
+            self._endpoint.rx_callback = None
             self._endpoint = None
         self.NotifyNormalClose()
         return 0

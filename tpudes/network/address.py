@@ -253,3 +253,165 @@ class InetSocketAddress:
 
     def __repr__(self):
         return f"{self.ipv4}:{self.port}"
+
+
+class Ipv6Address:
+    """128-bit IPv6 address (src/network/utils/ipv6-address.{h,cc}).
+
+    Stored as one int; parsing/formatting via the stdlib ``ipaddress``
+    module (RFC 4291 text forms incl. '::' compression)."""
+
+    __slots__ = ("addr",)
+
+    def __init__(self, addr: "str | int | bytes | Ipv6Address" = 0):
+        if isinstance(addr, Ipv6Address):
+            self.addr = addr.addr
+        elif isinstance(addr, int):
+            self.addr = addr & (1 << 128) - 1
+        elif isinstance(addr, bytes):
+            self.addr = int.from_bytes(addr[:16], "big")
+        else:
+            import ipaddress
+
+            self.addr = int(ipaddress.IPv6Address(addr))
+
+    @classmethod
+    def GetAny(cls) -> "Ipv6Address":
+        return cls(0)
+
+    @classmethod
+    def GetLoopback(cls) -> "Ipv6Address":
+        return cls(1)
+
+    @classmethod
+    def GetAllNodesMulticast(cls) -> "Ipv6Address":
+        return cls("ff02::1")
+
+    @classmethod
+    def GetAllRoutersMulticast(cls) -> "Ipv6Address":
+        return cls("ff02::2")
+
+    @classmethod
+    def MakeAutoconfiguredLinkLocalAddress(cls, mac: Mac48Address) -> "Ipv6Address":
+        """fe80::/64 + modified EUI-64 from the MAC (RFC 4291 app. A)."""
+        return cls((0xFE80 << 112) | cls._eui64(mac))
+
+    @classmethod
+    def MakeAutoconfiguredAddress(cls, mac: Mac48Address, prefix: "Ipv6Address") -> "Ipv6Address":
+        return cls((Ipv6Address(prefix).addr & ~((1 << 64) - 1)) | cls._eui64(mac))
+
+    @staticmethod
+    def _eui64(mac: Mac48Address) -> int:
+        b = mac.to_bytes()
+        eui = bytes([b[0] ^ 0x02, b[1], b[2], 0xFF, 0xFE, b[3], b[4], b[5]])
+        return int.from_bytes(eui, "big")
+
+    @classmethod
+    def MakeSolicitedAddress(cls, addr: "Ipv6Address") -> "Ipv6Address":
+        """ff02::1:ffXX:XXXX from the target's low 24 bits (RFC 4291)."""
+        return cls(int(cls("ff02::1:ff00:0")) | (Ipv6Address(addr).addr & 0xFFFFFF))
+
+    def IsAny(self) -> bool:
+        return self.addr == 0
+
+    def IsLoopback(self) -> bool:
+        return self.addr == 1
+
+    IsLocalhost = IsLoopback
+
+    def IsBroadcast(self) -> bool:
+        return False  # IPv6 has no broadcast
+
+    def IsMulticast(self) -> bool:
+        return (self.addr >> 120) == 0xFF
+
+    def IsLinkLocal(self) -> bool:
+        return (self.addr >> 118) == (0xFE80 >> 6)
+
+    def IsSolicitedMulticast(self) -> bool:
+        return (self.addr >> 24) == (int(Ipv6Address("ff02::1:ff00:0")) >> 24)
+
+    def CombinePrefix(self, prefix: "Ipv6Prefix") -> "Ipv6Address":
+        return Ipv6Address(self.addr & prefix.mask_int())
+
+    def to_bytes(self) -> bytes:
+        return self.addr.to_bytes(16, "big")
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Ipv6Address":
+        return cls(int.from_bytes(b[:16], "big"))
+
+    def __int__(self):
+        return self.addr
+
+    def __eq__(self, other):
+        return isinstance(other, Ipv6Address) and self.addr == other.addr
+
+    def __hash__(self):
+        return hash(("ipv6", self.addr))
+
+    def __str__(self):
+        import ipaddress
+
+        return str(ipaddress.IPv6Address(self.addr))
+
+    __repr__ = __str__
+
+
+class Ipv6Prefix:
+    """Prefix length (src/network/utils/ipv6-address.h Ipv6Prefix)."""
+
+    __slots__ = ("length",)
+
+    def __init__(self, length: "int | Ipv6Prefix" = 64):
+        self.length = length.length if isinstance(length, Ipv6Prefix) else int(length)
+
+    def mask_int(self) -> int:
+        if self.length <= 0:
+            return 0
+        return ((1 << self.length) - 1) << (128 - self.length)
+
+    def GetPrefixLength(self) -> int:
+        return self.length
+
+    def IsMatch(self, a: Ipv6Address, b: Ipv6Address) -> bool:
+        m = self.mask_int()
+        return (Ipv6Address(a).addr & m) == (Ipv6Address(b).addr & m)
+
+    def __eq__(self, other):
+        return isinstance(other, Ipv6Prefix) and self.length == other.length
+
+    def __hash__(self):
+        return hash(("ipv6prefix", self.length))
+
+    def __repr__(self):
+        return f"/{self.length}"
+
+
+class Inet6SocketAddress:
+    """(Ipv6Address, port) pair (src/network/utils/inet6-socket-address.h)."""
+
+    __slots__ = ("ipv6", "port")
+
+    def __init__(self, ipv6: "Ipv6Address | str | int", port: int = 0):
+        self.ipv6 = ipv6 if isinstance(ipv6, Ipv6Address) else Ipv6Address(ipv6)
+        self.port = port
+
+    def GetIpv6(self) -> Ipv6Address:
+        return self.ipv6
+
+    def GetPort(self) -> int:
+        return self.port
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Inet6SocketAddress)
+            and self.ipv6 == other.ipv6
+            and self.port == other.port
+        )
+
+    def __hash__(self):
+        return hash((self.ipv6, self.port))
+
+    def __repr__(self):
+        return f"[{self.ipv6}]:{self.port}"
