@@ -46,6 +46,8 @@ def main(argv=None):
     cmd.AddValue("interSite", "inter-site distance (m)", 500.0)
     cmd.AddValue("speed", "UE speed toward the last cell (m/s)", 0.0)
     cmd.AddValue("rlcMode", "um | am", "um")
+    cmd.AddValue("s1uDelay", "S1-U link one-way delay", "0ms")
+    cmd.AddValue("s1uRate", "S1-U link capacity", "1Gbps")
     cmd.Parse(argv)
     n_enbs = int(cmd.nEnbs)
     per_cell = int(cmd.uesPerCell)
@@ -53,7 +55,7 @@ def main(argv=None):
     speed = float(cmd.speed)
 
     lte = LteHelper()
-    epc = EpcHelper()
+    epc = EpcHelper(s1u_rate=str(cmd.s1uRate), s1u_delay=str(cmd.s1uDelay))
 
     # remote host behind a 100 Gbps / 10 ms backhaul to the PGW
     remote = NodeContainer()
@@ -123,6 +125,11 @@ def main(argv=None):
 
     # downlink: remote host → each UE; uplink: each UE → remote host
     dl_rx = [0] * len(ue_list)
+    dl_delay = []  # per-packet one-way DL delay
+
+    class _TsTag:  # send timestamp rides the packet (loss-proof pairing)
+        def __init__(self, t):
+            self.t = t
     ul_server = UdpServerHelper(2000)
     ul_apps = ul_server.Install(remote.Get(0))
     ul_apps.Start(Seconds(0.0))
@@ -130,14 +137,22 @@ def main(argv=None):
         server = UdpServerHelper(1000 + i)
         sapps = server.Install(ue_nodes.Get(i))
         sapps.Start(Seconds(0.0))
-        sapps.Get(0).TraceConnectWithoutContext(
-            "Rx", lambda pkt, *a, i=i: dl_rx.__setitem__(i, dl_rx[i] + 1)
-        )
+        def on_dl(pkt, *a, i=i):
+            dl_rx[i] += 1
+            tag = pkt.PeekPacketTag(_TsTag)
+            if tag is not None:
+                dl_delay.append(Simulator.Now().GetSeconds() - tag.t)
+
+        sapps.Get(0).TraceConnectWithoutContext("Rx", on_dl)
         dl = UdpClientHelper(ue_addr, 1000 + i)
         dl.SetAttribute("MaxPackets", 0)
         dl.SetAttribute("Interval", Seconds(0.02))
         dl.SetAttribute("PacketSize", 400)
         dapps = dl.Install(remote.Get(0))
+        dapps.Get(0).TraceConnectWithoutContext(
+            "Tx",
+            lambda p: p.AddPacketTag(_TsTag(Simulator.Now().GetSeconds())),
+        )
         dapps.Start(Seconds(0.05))
         dapps.Stop(Seconds(sim_time))
         ul = UdpClientHelper(internet_ifc.GetAddress(0), 2000)
@@ -159,7 +174,9 @@ def main(argv=None):
         f"enbs={n_enbs} ues={len(ue_list)} rlc={cmd.rlcMode} "
         f"dl_rx={sum(dl_rx)} (per-UE min={min(dl_rx)}) ul_rx={ul_rx} "
         f"handovers={c.stats['handovers']} "
-        f"ttis={c.stats['ttis']} wall={wall:.1f}s"
+        f"ttis={c.stats['ttis']} "
+        f"dl_delay_mean={sum(dl_delay) / max(len(dl_delay), 1) * 1e3:.2f}ms "
+        f"wall={wall:.1f}s"
     )
     if c.handover_log:
         for tti, imsi, src, dst in c.handover_log:

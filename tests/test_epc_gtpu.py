@@ -1,0 +1,208 @@
+"""EPC backhaul: GTP-U over modeled S1-U/S5 links (VERDICT r4 #5).
+
+Written delay-first (the r4 instruction): the end-to-end test pins that
+the S1-U link's configured delay/capacity actually shows up in UE
+traffic — the property the old zero-delay shortcut could not satisfy —
+then the wire test decodes real GTP-U/UDP/IP bytes off the S1-U link.
+Upstream analogs: src/lte/test/test-epc-tdd-dl.cc strategy +
+epc-gtpu-header.cc round-trip.
+"""
+
+import math
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.helper.applications import UdpClientHelper, UdpServerHelper
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.helper.point_to_point import PointToPointHelper
+from tpudes.models.internet.ipv4 import Ipv4L3Protocol, Ipv4StaticRouting
+from tpudes.models.lte import LteHelper
+from tpudes.models.lte.epc import EpcHelper
+from tpudes.models.mobility import ListPositionAllocator, MobilityHelper, Vector
+from tpudes.network.address import Ipv4Address, Ipv4Mask
+
+
+def _reset():
+    from tpudes.core.world import reset_world
+
+    reset_world()
+
+
+def _build(s1u_delay="0ms", s1u_rate="1Gbps"):
+    """One eNB, one UE, one remote host behind a zero-delay backhaul;
+    returns (epc, remote_node, ue_node, ue_addr, ue_dev)."""
+    lte = LteHelper()
+    epc = EpcHelper(s1u_delay=s1u_delay, s1u_rate=s1u_rate)
+    remote = NodeContainer()
+    remote.Create(1)
+    InternetStackHelper().Install(remote)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "10Gbps")
+    p2p.SetChannelAttribute("Delay", "0ms")
+    backhaul = p2p.Install(remote.Get(0), epc.GetPgwNode())
+    ifc = Ipv4AddressHelper("1.0.0.0", "255.0.0.0").Assign(backhaul)
+    routing = remote.Get(0).GetObject(Ipv4L3Protocol).GetRoutingProtocol()
+    routing.AddNetworkRouteTo(
+        Ipv4Address(EpcHelper.UE_NETWORK), Ipv4Mask(EpcHelper.UE_MASK),
+        remote.Get(0).GetObject(Ipv4L3Protocol).GetInterfaceForDevice(
+            backhaul.Get(0)
+        ),
+        gateway=ifc.GetAddress(1),
+    )
+
+    enbs = NodeContainer()
+    enbs.Create(1)
+    ues = NodeContainer()
+    ues.Create(1)
+    ea = ListPositionAllocator()
+    ea.Add(Vector(0, 0, 30.0))
+    me = MobilityHelper()
+    me.SetPositionAllocator(ea)
+    me.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    me.Install(enbs)
+    ua = ListPositionAllocator()
+    ua.Add(Vector(70.0, 0, 1.5))
+    mu = MobilityHelper()
+    mu.SetPositionAllocator(ua)
+    mu.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mu.Install(ues)
+    lte.InstallEnbDevice(enbs)
+    ue_devs = lte.InstallUeDevice(ues)
+    InternetStackHelper().Install(ues)
+    lte.Attach([ue_devs.Get(0)])
+    lte.ActivateDataRadioBearer([ue_devs.Get(0)], mode="um")
+    (ue_addr,) = epc.AssignUeIpv4Address([ue_devs.Get(0)])
+    return epc, remote.Get(0), ues.Get(0), ue_addr, ue_devs.Get(0)
+
+
+def _dl_first_arrival(s1u_delay):
+    _reset()
+    epc, remote, ue, ue_addr, _ = _build(s1u_delay=s1u_delay)
+    arrivals = []
+    server = UdpServerHelper(1000)
+    sapps = server.Install(ue)
+    sapps.Start(Seconds(0.0))
+    sapps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda pkt, *a: arrivals.append(Simulator.Now().GetSeconds())
+    )
+    dl = UdpClientHelper(ue_addr, 1000)
+    dl.SetAttribute("MaxPackets", 3)
+    dl.SetAttribute("Interval", Seconds(0.05))
+    dl.SetAttribute("PacketSize", 300)
+    dl.Install(remote).Start(Seconds(0.1))
+    Simulator.Stop(Seconds(0.5))
+    Simulator.Run()
+    _reset()
+    assert len(arrivals) == 3, arrivals
+    return arrivals[0] - 0.1
+
+
+def test_s1u_delay_appears_in_end_to_end_latency():
+    """The delay-sensitive oracle (written BEFORE the GTP-U tunnel per
+    VERDICT r4 weak #8): a 20 ms S1-U link must shift DL delivery by
+    ~20 ms vs a 0 ms one.  The old shortcut fails this by design."""
+    base = _dl_first_arrival("0ms")
+    delayed = _dl_first_arrival("20ms")
+    assert delayed - base == pytest.approx(0.020, abs=0.004), (
+        f"S1-U delay invisible: {base*1e3:.2f} -> {delayed*1e3:.2f} ms"
+    )
+
+
+def test_s1u_capacity_bounds_downlink_rate():
+    """A 1 Mbps S1-U leg must throttle DL below what the radio allows."""
+    _reset()
+    epc, remote, ue, ue_addr, _ = _build(s1u_rate="1Mbps")
+    rx_bytes = [0]
+    server = UdpServerHelper(1000)
+    sapps = server.Install(ue)
+    sapps.Start(Seconds(0.0))
+    sapps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda pkt, *a: rx_bytes.__setitem__(0, rx_bytes[0] + pkt.GetSize())
+    )
+    dl = UdpClientHelper(ue_addr, 1000)
+    dl.SetAttribute("MaxPackets", 0)       # saturate
+    dl.SetAttribute("Interval", Seconds(0.001))  # 1000 B/ms ≈ 8 Mbps offered
+    dl.SetAttribute("PacketSize", 1000)
+    dl.Install(remote).Start(Seconds(0.05))
+    Simulator.Stop(Seconds(1.05))
+    Simulator.Run()
+    _reset()
+    mbps = rx_bytes[0] * 8 / 1.0 / 1e6
+    assert 0.5 < mbps <= 1.1, f"S1-U bottleneck not enforced: {mbps:.2f} Mbps"
+
+
+def test_gtpu_frames_decode_on_the_s1u_wire():
+    """Sniff the SGW-side S1-U device: outer IPv4/UDP:2152 + GTP-U with
+    the UE's TEID, inner IPv4 destined to the UE."""
+    from tpudes.models.internet.ipv4 import Ipv4Header
+    from tpudes.models.internet.udp import UdpHeader
+    from tpudes.models.lte.epc import GTPU_PORT, GtpuHeader
+
+    _reset()
+    epc, remote, ue, ue_addr, ue_dev = _build(s1u_delay="1ms")
+    frames = []
+    # the SGW's S1-U device towards the (single) eNB
+    sgw_dev = epc.s1u_sgw_devices[0]
+    sgw_dev.TraceConnectWithoutContext(
+        "PhyTxEnd", lambda pkt, *a: frames.append(pkt.ToBytes())
+    )
+    server = UdpServerHelper(1000)
+    sapps = server.Install(ue)
+    sapps.Start(Seconds(0.0))
+    dl = UdpClientHelper(ue_addr, 1000)
+    dl.SetAttribute("MaxPackets", 2)
+    dl.SetAttribute("Interval", Seconds(0.05))
+    dl.SetAttribute("PacketSize", 300)
+    dl.Install(remote).Start(Seconds(0.1))
+    Simulator.Stop(Seconds(0.4))
+    Simulator.Run()
+    _reset()
+    assert frames, "no frames crossed the S1-U link"
+    # decode the first data frame: outer IP / UDP / GTP-U / inner IP
+    decoded = 0
+    for raw in frames:
+        if raw[:2] == b"\x00\x21":  # PPP: IP protocol field
+            raw = raw[2:]
+        outer, n1 = Ipv4Header.Deserialize(raw)
+        if outer.protocol != 17:
+            continue
+        udp, n2 = UdpHeader.Deserialize(raw[n1:])
+        if udp.destination_port != GTPU_PORT:
+            continue
+        gtpu, n3 = GtpuHeader.Deserialize(raw[n1 + n2:])
+        inner, _ = Ipv4Header.Deserialize(raw[n1 + n2 + n3:])
+        assert gtpu.teid == epc.teid_for_ue(ue_addr)
+        assert inner.destination == Ipv4Address(ue_addr)
+        decoded += 1
+    assert decoded >= 2, "GTP-U data frames must decode"
+
+
+def test_gtpu_header_roundtrip():
+    from tpudes.models.lte.epc import GtpuHeader
+
+    h = GtpuHeader(teid=0xDEADBEEF, payload_size=321)
+    raw = h.Serialize()
+    assert len(raw) == h.GetSerializedSize() == 8
+    h2, n = GtpuHeader.Deserialize(raw)
+    assert n == 8 and h2.teid == 0xDEADBEEF and h2.payload_size == 321
+
+
+def test_uplink_through_sgw_and_pgw():
+    """UE → eNB → GTP-U S1-U → SGW → GTP-U S5 → PGW → remote host."""
+    _reset()
+    epc, remote, ue, ue_addr, _ = _build(s1u_delay="5ms")
+    ul_server = UdpServerHelper(2000)
+    ul_apps = ul_server.Install(remote)
+    ul_apps.Start(Seconds(0.0))
+    remote_addr = remote.GetObject(Ipv4L3Protocol).GetAddress(1).GetLocal()
+    ul = UdpClientHelper(remote_addr, 2000)
+    ul.SetAttribute("MaxPackets", 5)
+    ul.SetAttribute("Interval", Seconds(0.02))
+    ul.SetAttribute("PacketSize", 150)
+    ul.Install(ue).Start(Seconds(0.05))
+    Simulator.Stop(Seconds(0.6))
+    Simulator.Run()
+    _reset()
+    assert ul_apps.Get(0).received == 5

@@ -155,7 +155,7 @@ class Icmpv6L4Protocol(Object):
             packet, Ipv6Header(destination=dest)
         )
         src = route.source if route is not None else Ipv6Address.GetAny()
-        ipv6.Send(packet, src, dest, self.PROT_NUMBER)
+        ipv6.Send(packet, src, dest, self.PROT_NUMBER, route)
 
     # --- errors -------------------------------------------------------------
     def _send_error(self, icmp_type: int, code: int, offending_header,
@@ -163,11 +163,16 @@ class Icmpv6L4Protocol(Object):
         packet = Packet(offending_packet.ToBytes()[:8])
         packet.AddHeader(offending_header)
         packet.AddHeader(Icmpv6Header(icmp_type, code))
+        from tpudes.models.internet.ipv6 import Ipv6Header
+
         ipv6 = self._ipv6()
-        ipv6.Send(
-            packet, Ipv6Address.GetAny(), offending_header.source,
-            self.PROT_NUMBER,
+        # RFC 4443 §2.2: the error carries a real router address, so the
+        # offender can attribute it (traceroute) — select by route
+        route, _ = ipv6.GetRoutingProtocol().RouteOutput(
+            packet, Ipv6Header(destination=offending_header.source)
         )
+        src = route.source if route is not None else Ipv6Address.GetAny()
+        ipv6.Send(packet, src, offending_header.source, self.PROT_NUMBER, route)
 
     def SendTimeExceeded(self, header, packet) -> None:
         self._send_error(Icmpv6Header.TIME_EXCEEDED, 0, header, packet)

@@ -232,8 +232,9 @@ class Ipv6StaticRouting(Ipv6RoutingProtocol):
     def RouteOutput(self, packet, header, oif=None):
         dest = header.destination
         if dest.IsLinkLocal() or dest.IsMulticast():
-            # link-local / multicast go out the (single) candidate
-            # interface directly — no table lookup
+            # link-local / multicast go out the caller's interface, or
+            # (scope-id analog missing) the first up one — multi-homed
+            # link-local traffic must pass ``oif``
             if_index = oif if oif is not None else self._first_up_index()
             if if_index is None:
                 return None, 10
@@ -401,7 +402,8 @@ class Ipv6L3Protocol(Object):
 
     # --- send path ---
     def Send(self, packet, source: Ipv6Address, destination: Ipv6Address,
-             protocol: int, route: Ipv6Route = None, tos: int = 0):
+             protocol: int, route: Ipv6Route = None, tos: int = 0,
+             oif: int = None):
         header = Ipv6Header(
             source=source,
             destination=destination,
@@ -417,7 +419,7 @@ class Ipv6L3Protocol(Object):
             )
             return
         if route is None:
-            route, errno = self._routing.RouteOutput(packet, header)
+            route, errno = self._routing.RouteOutput(packet, header, oif)
             if route is None:
                 self.drop(header, packet, self.DROP_NO_ROUTE)
                 return

@@ -347,6 +347,7 @@ class UdpSocketImpl(Socket):
         ipv6 = self._node.GetObject(Ipv6L3Protocol)
         daddr = to_address.GetIpv6()
         saddr = self._endpoint.local_addr
+        route = None
         if not isinstance(saddr, Ipv6Address) or saddr.IsAny():
             if daddr.IsLoopback():
                 saddr = Ipv6Address.GetLoopback()
@@ -362,7 +363,7 @@ class UdpSocketImpl(Socket):
         size = packet.GetSize()
         self._udp.Send6(
             packet, saddr, daddr, self._endpoint.local_port,
-            to_address.GetPort(), tos=self._ip_tos,
+            to_address.GetPort(), route=route, tos=self._ip_tos,
         )
         self.NotifyDataSent(size)
         self.NotifySend(self.GetTxAvailable())

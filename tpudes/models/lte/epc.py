@@ -1,49 +1,72 @@
-"""EPC core-network stub: PGW node + UE IP addressing + S1-U shortcut.
+"""EPC core network: SGW + PGW nodes, GTP-U over modeled S1-U/S5 links.
 
-Reference parity: src/lte/model/epc-{sgw,pgw,mme}-application.{h,cc},
+Reference parity: src/lte/model/epc-{enb,sgw,pgw}-application.{h,cc},
 epc-gtpu-header.{h,cc}, helper/point-to-point-epc-helper.{h,cc}
 (upstream paths; mount empty at survey — SURVEY.md §0, §2.6 "EPC core
 network" row).
 
-Scope note (explicit stub, per the round-3 plan): upstream tunnels IP
-packets through in-sim GTP-U/UDP links between eNB, SGW and PGW.  Here
-the PGW is a real Node with a real IP stack and a ``PgwNetDevice``
-claiming the UE subnet (7.0.0.0/8), but the S1-U leg PGW↔eNB is an
-ideal zero-delay shortcut (direct RLC enqueue) rather than a modeled
-GTP-U tunnel.  Remote hosts, routing, sockets and applications work
-exactly as with the full EPC; only the backhaul leg's delay/capacity is
-idealized.  GTP-U tunnel modeling is future work.
+The data plane is real: every user packet crosses a point-to-point
+S1-U link (eNB ↔ SGW) and the S5 link (SGW ↔ PGW) as an in-sim
+IPv4/UDP:2152/GTP-U frame, so the backhaul's delay and capacity shape
+end-to-end traffic and a pcap on the S1-U wire decodes GTP-U
+(tests/test_epc_gtpu.py pins both).  Control plane stays ideal:
+S1-AP/S11 signaling and the handover path switch are in-memory (the
+SGW resolves a TEID's serving eNB through the live RRC state at
+forwarding time), and the MME is not a separate node — the upstream
+serialized S1AP/GTPv2-C message surface is out of scope.
 """
 
 from __future__ import annotations
 
+import struct
+
 from tpudes.core.object import TypeId
-from tpudes.helper.containers import NodeContainer
-from tpudes.helper.internet import InternetStackHelper
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
 from tpudes.models.internet.ipv4 import (
+    Ipv4Header,
     Ipv4InterfaceAddress,
     Ipv4L3Protocol,
     Ipv4StaticRouting,
 )
-from tpudes.models.internet.ipv4 import Ipv4Header
-from tpudes.network.address import Ipv4Address, Ipv4Mask
+from tpudes.models.internet.udp import UdpL4Protocol
+from tpudes.network.address import InetSocketAddress, Ipv4Address, Ipv4Mask
 from tpudes.network.net_device import NetDevice
 from tpudes.network.node import Node
 
+GTPU_PORT = 2152
+
+
+class GtpuHeader:
+    """8-byte GTPv1-U header (epc-gtpu-header.cc): version 1, PT=1,
+    message type 255 (G-PDU), length, TEID."""
+
+    def __init__(self, teid: int = 0, payload_size: int = 0):
+        self.teid = teid
+        self.payload_size = payload_size
+
+    def GetSerializedSize(self) -> int:
+        return 8
+
+    def Serialize(self) -> bytes:
+        return struct.pack("!BBHI", 0x30, 255, self.payload_size, self.teid)
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        _flags, _mtype, length, teid = struct.unpack("!BBHI", data[:8])
+        return cls(teid, length), 8
+
 
 class PgwNetDevice(NetDevice):
-    """The PGW's tunnel endpoint: IP packets routed to 7.0.0.0/8 exit
-    the PGW stack here and are pushed down the serving eNB's DL bearer;
-    uplink SDUs from eNBs enter the PGW stack through it."""
+    """The PGW's tunnel endpoint (epc-pgw-application.cc TFT side): IP
+    packets routed to the UE network exit the PGW stack here and are
+    GTP-U-encapsulated toward the SGW; uplink G-PDUs from the SGW are
+    decapsulated and re-enter the PGW stack through it."""
 
     tid = TypeId("tpudes::PgwNetDevice").SetParent(NetDevice.tid)
 
     def __init__(self, **attributes):
         super().__init__(**attributes)
-        self._ue_by_ip: dict[int, object] = {}
-
-    def register_ue(self, ip: Ipv4Address, ue_device) -> None:
-        self._ue_by_ip[ip.addr] = ue_device
+        self.epc: "EpcHelper | None" = None
 
     def NeedsArp(self) -> bool:
         return False
@@ -53,31 +76,40 @@ class PgwNetDevice(NetDevice):
 
     def Send(self, packet, dest, protocol: int) -> bool:
         header = packet.PeekHeader(Ipv4Header)
-        if header is None:
+        if header is None or self.epc is None:
             return False
-        ue = self._ue_by_ip.get(header.GetDestination().addr)
-        if ue is None:
+        teid = self.epc._teid_by_ueip.get(header.GetDestination().addr)
+        if teid is None:
             return False
-        enb = ue.rrc.serving_enb
-        if enb is None:
-            return False
-        return enb.dl_enqueue(ue, packet)
+        return self.epc._pgw_send_dl(packet, teid)
 
-    def receive_from_enb(self, packet) -> None:
-        """Uplink SDU arriving over the (ideal) S1-U leg."""
+    def inject_uplink(self, packet) -> None:
+        """Decapsulated uplink SDU re-enters the PGW's IP stack."""
         self._deliver_up(packet, 0x0800, self._address, self._address, 0)
 
 
 class EpcHelper:
-    """point-to-point-epc-helper.cc analog with the stubbed S1-U leg."""
+    """point-to-point-epc-helper.cc analog with a real GTP-U data plane.
+
+    ``s1u_rate``/``s1u_delay`` and ``s5_rate``/``s5_delay`` mirror the
+    upstream S1uLinkDataRate/S1uLinkDelay attributes.
+    """
 
     UE_NETWORK = "7.0.0.0"
     UE_MASK = "255.0.0.0"
 
-    def __init__(self):
+    def __init__(self, s1u_rate: str = "1Gbps", s1u_delay: str = "0ms",
+                 s5_rate: str = "10Gbps", s5_delay: str = "0ms"):
+        self._s1u_rate = s1u_rate
+        self._s1u_delay = s1u_delay
+
         self.pgw_node = Node()
-        InternetStackHelper().Install(self.pgw_node)
+        self.sgw_node = Node()
+        InternetStackHelper().Install([self.pgw_node, self.sgw_node])
+
+        # tunnel endpoint device claiming the UE network on the PGW
         self.pgw_device = PgwNetDevice()
+        self.pgw_device.epc = self
         self.pgw_device.SetNode(self.pgw_node)
         self.pgw_node.AddDevice(self.pgw_device)
         ipv4 = self.pgw_node.GetObject(Ipv4L3Protocol)
@@ -91,17 +123,145 @@ class EpcHelper:
         routing.AddNetworkRouteTo(
             Ipv4Address(self.UE_NETWORK), Ipv4Mask(self.UE_MASK), if_index
         )
+
+        # S5 link PGW ↔ SGW
+        from tpudes.helper.point_to_point import PointToPointHelper
+
+        p2p = PointToPointHelper()
+        p2p.SetDeviceAttribute("DataRate", s5_rate)
+        p2p.SetChannelAttribute("Delay", s5_delay)
+        s5 = p2p.Install(self.pgw_node, self.sgw_node)
+        s5_ifc = Ipv4AddressHelper("13.0.0.0", "255.255.255.252").Assign(s5)
+        self._pgw_s5_addr = s5_ifc.GetAddress(0)
+        self._sgw_s5_addr = s5_ifc.GetAddress(1)
+
+        # GTP-U sockets (epc-{sgw,pgw}-application.cc)
+        self._pgw_sock = self._gtpu_socket(self.pgw_node, self._on_pgw_rx)
+        self._sgw_sock = self._gtpu_socket(self.sgw_node, self._on_sgw_rx)
+
+        # S1-U bookkeeping
+        self._s1u_addr_helper = Ipv4AddressHelper("10.0.0.0", "255.255.255.252")
+        self._enb_socks: dict[int, object] = {}       # id(enb_dev) -> socket
+        self._enb_s1u_addr: dict[int, Ipv4Address] = {}   # eNB side
+        self._sgw_s1u_addr: dict[int, Ipv4Address] = {}   # SGW side, per eNB
+        self.s1u_enb_devices: list = []
+        self.s1u_sgw_devices: list = []
+
+        # bearer state
+        self._teid_by_ueip: dict[int, int] = {}
+        self._ue_by_teid: dict[int, object] = {}
+        self._next_teid = 1
         self._next_ue_host = 2
 
+    # --- plumbing -----------------------------------------------------------
+    @staticmethod
+    def _gtpu_socket(node, rx_cb):
+        sock = node.GetObject(UdpL4Protocol).CreateSocket()
+        if sock.Bind(InetSocketAddress(Ipv4Address.GetAny(), GTPU_PORT)) != 0:
+            raise RuntimeError("GTP-U port 2152 already bound on this node")
+
+        def drain(s):
+            while True:
+                pkt, src = s.RecvFrom()
+                if pkt is None:
+                    break
+                rx_cb(pkt, src)
+
+        sock.SetRecvCallback(drain)
+        return sock
+
+    def _ensure_enb(self, enb_device) -> None:
+        """Build the eNB's S1-U link + GTP-U endpoint once
+        (epc-enb-application.cc + the helper's AddEnb)."""
+        key = id(enb_device)
+        if key in self._enb_socks:
+            return
+        enb_node = enb_device.GetNode()
+        if enb_node.GetObject(Ipv4L3Protocol) is None:
+            InternetStackHelper().Install(enb_node)
+        from tpudes.helper.point_to_point import PointToPointHelper
+
+        p2p = PointToPointHelper()
+        p2p.SetDeviceAttribute("DataRate", self._s1u_rate)
+        p2p.SetChannelAttribute("Delay", self._s1u_delay)
+        link = p2p.Install(enb_node, self.sgw_node)
+        ifc = self._s1u_addr_helper.Assign(link)
+        self._s1u_addr_helper.NewNetwork()
+        self._enb_s1u_addr[key] = ifc.GetAddress(0)
+        self._sgw_s1u_addr[key] = ifc.GetAddress(1)
+        self.s1u_enb_devices.append(link.Get(0))
+        self.s1u_sgw_devices.append(link.Get(1))
+
+        sgw_addr = self._sgw_s1u_addr[key]
+
+        def on_enb_rx(pkt, src, _dev=enb_device):
+            gtpu = pkt.RemoveHeader(GtpuHeader)
+            ue = self._ue_by_teid.get(gtpu.teid)
+            if ue is not None:
+                _dev.dl_enqueue(ue, pkt)
+
+        sock = self._gtpu_socket(enb_node, on_enb_rx)
+        self._enb_socks[key] = sock
+
+        def on_ul_sdu(packet, _sock=sock, _sgw=sgw_addr):
+            header = packet.PeekHeader(Ipv4Header)
+            if header is None:
+                return
+            teid = self._teid_by_ueip.get(header.GetSource().addr)
+            if teid is None:
+                return
+            packet.AddHeader(GtpuHeader(teid, packet.GetSize()))
+            _sock.SendTo(packet, 0, InetSocketAddress(_sgw, GTPU_PORT))
+
+        enb_device.ul_sdu_callback = on_ul_sdu
+
+    # --- SGW data plane (epc-sgw-application.cc) ----------------------------
+    def _on_sgw_rx(self, pkt, src) -> None:
+        gtpu = pkt.PeekHeader(GtpuHeader)  # relay keeps the frame intact
+        if src.GetIpv4() == self._pgw_s5_addr:
+            # downlink: resolve the TEID's CURRENT serving eNB (the
+            # ideal S11/X2 path switch — upstream signals this; we read
+            # the live RRC state)
+            ue = self._ue_by_teid.get(gtpu.teid)
+            enb = ue.rrc.serving_enb if ue is not None else None
+            dst = self._enb_s1u_addr.get(id(enb))
+            if dst is None:
+                return  # serving eNB not wired: drop (loud in tests)
+            self._sgw_sock.SendTo(pkt, 0, InetSocketAddress(dst, GTPU_PORT))
+        else:
+            # uplink: forward over S5 to the PGW
+            self._sgw_sock.SendTo(
+                pkt, 0, InetSocketAddress(self._pgw_s5_addr, GTPU_PORT)
+            )
+
+    # --- PGW data plane (epc-pgw-application.cc) ----------------------------
+    def _on_pgw_rx(self, pkt, src) -> None:
+        pkt.RemoveHeader(GtpuHeader)
+        self.pgw_device.inject_uplink(pkt)
+
+    def _pgw_send_dl(self, packet, teid: int) -> bool:
+        packet.AddHeader(GtpuHeader(teid, packet.GetSize()))
+        self._pgw_sock.SendTo(
+            packet, 0, InetSocketAddress(self._sgw_s5_addr, GTPU_PORT)
+        )
+        return True
+
+    # --- public API ---------------------------------------------------------
     def GetPgwNode(self) -> Node:
         return self.pgw_node
+
+    def GetSgwNode(self) -> Node:
+        return self.sgw_node
 
     def GetUeDefaultGatewayAddress(self) -> Ipv4Address:
         return Ipv4Address("7.0.0.1")
 
+    def teid_for_ue(self, ue_addr: Ipv4Address) -> int | None:
+        return self._teid_by_ueip.get(Ipv4Address(ue_addr).addr)
+
     def AssignUeIpv4Address(self, ue_devices) -> list[Ipv4Address]:
-        """Give each UE a 7.0.0.0/8 address on its LTE device and a
-        default route through it; register the UE at the PGW."""
+        """Give each UE a 7.0.0.0/8 address + default route, allocate
+        its TEID, and wire its serving eNB's S1-U leg."""
         addrs = []
         for ue in ue_devices:
             node = ue.GetNode()
@@ -127,15 +287,18 @@ class EpcHelper:
                     self.GetUeDefaultGatewayAddress(), if_index
                 )
             ue.ue_ipv4 = addr
-            self.pgw_device.register_ue(addr, ue)
-            # uplink: eNB forwards reassembled SDUs to the PGW stack
+            teid = self._next_teid
+            self._next_teid += 1
+            self._teid_by_ueip[addr.addr] = teid
+            self._ue_by_teid[teid] = ue
             enb = ue.rrc.serving_enb
-            if enb is not None and enb.ul_sdu_callback is None:
-                enb.ul_sdu_callback = self.pgw_device.receive_from_enb
+            if enb is not None:
+                self._ensure_enb(enb)
             addrs.append(addr)
         return addrs
 
     def wire_enbs(self, enb_devices) -> None:
-        """Point every eNB's uplink exit at the PGW (ideal S1-U)."""
+        """Build every eNB's S1-U leg (the helper's AddEnb loop) —
+        required before handover so the target cell has a tunnel."""
         for enb in enb_devices:
-            enb.ul_sdu_callback = self.pgw_device.receive_from_enb
+            self._ensure_enb(enb)
