@@ -158,12 +158,19 @@ def lower_as_flows(sim_end_s: float) -> AsFlowsProgram:
     )
 
 
-def device_spf(prog: AsFlowsProgram):
+def device_spf(prog: AsFlowsProgram, mesh=None):
     """(dist, nh_edge, nh_node) for the distinct destination set.
 
     dist: (D, N) f32 shortest delay;  nh_edge/nh_node: (D, N) i32 —
     the directed-edge index / next node toward each destination.
     Returns (ddst, arrays): ddst maps flow → row in the tables.
+
+    With ``mesh``, the TOPOLOGY tables themselves are sharded: the
+    destination-row axis D spreads over the mesh devices (SURVEY.md
+    §5.7 "shard-ready layouts"), so a 10k-node AS graph's (D, N)
+    distance/next-hop state no longer replicates per device.  The
+    Bellman-Ford relaxation is row-independent — zero collectives —
+    and XLA inserts the gather where the flow walk reads rows.
     """
     e = np.concatenate([prog.edges, prog.edges[:, ::-1]])  # directed
     if prog.spf_metric == "hops":
@@ -175,7 +182,21 @@ def device_spf(prog: AsFlowsProgram):
     dsts_np, inv = np.unique(prog.dst, return_inverse=True)
     D, N = len(dsts_np), prog.n
 
-    dist0 = jnp.full((D, N), INF).at[jnp.arange(D), jnp.asarray(dsts_np)].set(0.0)
+    # pad the row axis to the mesh size so sharding never silently
+    # degrades to replication (padded rows are all-INF and unread)
+    D_pad = D
+    if mesh is not None:
+        n_dev = len(mesh.devices.flat)
+        D_pad = ((D + n_dev - 1) // n_dev) * n_dev
+    dist0 = jnp.full((D_pad, N), INF).at[
+        jnp.arange(D), jnp.asarray(dsts_np)
+    ].set(0.0)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        dist0 = jax.lax.with_sharding_constraint(
+            dist0, NamedSharding(mesh, P("replica", None))
+        )
 
     def bf_round(dist, _):
         cand = dist[:, v] + w[None, :]          # relax u→v backwards
@@ -183,12 +204,13 @@ def device_spf(prog: AsFlowsProgram):
 
     dist, _ = jax.lax.scan(bf_round, dist0, None, length=prog.spf_rounds)
     # next hop: the incident directed edge minimizing w(u,v) + dist[v]
-    score = w[None, :] + dist[:, v]             # (D, 2E)
-    best = jnp.full((D, N), INF).at[:, u].min(score)
+    # (tables stay at the padded row count; callers index rows < D)
+    score = w[None, :] + dist[:, v]             # (D_pad, 2E)
+    best = jnp.full((D_pad, N), INF).at[:, u].min(score)
     eidx = jnp.arange(e.shape[0], dtype=jnp.int32)
     BIG = jnp.int32(2**30)
     cand_idx = jnp.where(score <= best[:, u] * (1 + 1e-6), eidx[None, :], BIG)
-    nh_edge = jnp.full((D, N), BIG).at[:, u].min(cand_idx)
+    nh_edge = jnp.full((D_pad, N), BIG).at[:, u].min(cand_idx)
     nh_node = jnp.where(nh_edge < BIG, v[jnp.minimum(nh_edge, e.shape[0] - 1)], -1)
     return jnp.asarray(inv, jnp.int32), dist, nh_edge, nh_node
 
@@ -232,7 +254,7 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
         prog.rate_bps.tobytes(), prog.src.tobytes(), prog.dst.tobytes(),
         prog.flow_bps.tobytes(), prog.pkt_bytes, prog.sim_s,
         prog.max_hops, prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
-        replicas,
+        replicas, mesh,
     )
     run = _RUNNER_CACHE.get(ck)
     if run is None:
@@ -249,7 +271,7 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
 
         @jax.jit
         def _run(z):
-            ddst, dist, nh_edge, nh_node = device_spf(prog)
+            ddst, dist, nh_edge, nh_node = device_spf(prog, mesh)
             path, hops, arrived = _walk_paths(prog, ddst, nh_edge, nh_node)
             reached = (
                 dist[ddst, jnp.asarray(prog.src)] < INF

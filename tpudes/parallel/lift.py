@@ -176,11 +176,24 @@ def run_lifted(kind: str, prog, replicas: int, key=None, mesh=None):
             & 0x7FFFFFFF
         )
     if mesh is None:
+        import math
+
         n_dev = len(jax.devices())
-        if n_dev > 1 and replicas % n_dev == 0:
+        n_use = math.gcd(replicas, n_dev)
+        if n_use > 1:
             from tpudes.parallel.mesh import replica_mesh
 
-            mesh = replica_mesh(n_dev)
+            mesh = replica_mesh(n_use)
+        if 1 < n_use < n_dev or (n_use == 1 < n_dev and replicas > 1):
+            import warnings
+
+            warnings.warn(
+                f"JaxReplicas={replicas} is not divisible by the "
+                f"{n_dev} visible devices; running on {n_use} — "
+                f"pick a multiple of {n_dev} to use the whole mesh",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     if kind == "bss":
         from tpudes.parallel.replicated import run_replicated_bss
 
