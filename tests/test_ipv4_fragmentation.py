@@ -1,0 +1,217 @@
+"""IPv4 fragmentation/reassembly (VERDICT r4 missing #5).
+
+Upstream analog: src/internet/test/ipv4-fragmentation-test.cc strategy —
+a datagram larger than the egress MTU must cross the wire as real
+offset/MF fragments and reassemble only at the final destination; DF
+forbids it; a lost fragment kills the datagram; a smaller second hop
+re-fragments.
+"""
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.helper.point_to_point import PointToPointHelper
+from tpudes.models.internet.ipv4 import Ipv4Header, Ipv4L3Protocol
+from tpudes.network.address import Ipv4Address
+
+
+def _reset():
+    from tpudes.core.world import reset_world
+
+    reset_world()
+
+
+def _pair(mtu=600):
+    nodes = NodeContainer()
+    nodes.Create(2)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "10Mbps")
+    p2p.SetChannelAttribute("Delay", "1ms")
+    devices = p2p.Install(nodes)
+    for i in range(2):
+        devices.Get(i).SetMtu(mtu)
+    InternetStackHelper().Install(nodes)
+    ifc = Ipv4AddressHelper("10.1.1.0", "255.255.255.0").Assign(devices)
+    return nodes, devices, ifc
+
+
+def test_large_datagram_fragments_and_reassembles():
+    _reset()
+    nodes, devices, ifc = _pair(mtu=600)
+    frames = []
+    devices.Get(0).TraceConnectWithoutContext(
+        "PhyTxEnd", lambda pkt, *a: frames.append(pkt)
+    )
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(1))
+    sapps.Start(Seconds(0.1))
+    client = UdpEchoClientHelper(ifc.GetAddress(1), 9)
+    client.SetAttribute("MaxPackets", 1)
+    client.SetAttribute("PacketSize", 2000)  # 2028 B IP datagram
+    capps = client.Install(nodes.Get(0))
+    capps.Start(Seconds(0.5))
+    Simulator.Stop(Seconds(2.0))
+    Simulator.Run()
+    assert sapps.Get(0).received == 1
+    assert capps.Get(0).received == 1  # the echo reply fragments too
+    # the wire carried real fragments: offsets tile [0, 2008)
+    heads = [p.FindHeader(Ipv4Header) for p in frames]
+    heads = [h for h in heads if h is not None and h.protocol == 17]
+    assert len(heads) == 4  # 2008 payload bytes / 576 B 8-aligned chunks
+    offs = sorted((h.fragment_offset, h.payload_size, h.more_fragments)
+                  for h in heads)
+    covered = 0
+    for off, size, mf in offs:
+        assert off == covered, offs
+        assert off % 8 == 0
+        covered = off + size
+    assert covered == 2000 + 8  # UDP payload + UDP header
+    assert offs[-1][2] is False and all(mf for _, _, mf in offs[:-1])
+    _reset()
+
+
+def test_lost_fragment_kills_the_datagram():
+    from tpudes.network.error_model import ReceiveListErrorModel
+
+    _reset()
+    nodes, devices, ifc = _pair(mtu=600)
+    em = ReceiveListErrorModel()
+    em.SetList([1])  # second frame to arrive at the server = a fragment
+    devices.Get(1).SetReceiveErrorModel(em)
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(1))
+    sapps.Start(Seconds(0.1))
+    client = UdpEchoClientHelper(ifc.GetAddress(1), 9)
+    client.SetAttribute("MaxPackets", 1)
+    client.SetAttribute("PacketSize", 2000)
+    client.Install(nodes.Get(0)).Start(Seconds(0.5))
+    drops = []
+    nodes.Get(1).GetObject(Ipv4L3Protocol).TraceConnectWithoutContext(
+        "Drop", lambda h, p, r: drops.append(r)
+    )
+    Simulator.Stop(Seconds(40.0))  # past the 30 s reassembly timeout
+    Simulator.Run()
+    assert sapps.Get(0).received == 0
+    assert Ipv4L3Protocol.DROP_FRAGMENT_TIMEOUT in drops
+    _reset()
+
+
+def test_refragmentation_across_smaller_second_hop():
+    """n0 --1500-- r --400-- n1: the router re-fragments."""
+    _reset()
+    nodes = NodeContainer()
+    nodes.Create(3)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "10Mbps")
+    p2p.SetChannelAttribute("Delay", "1ms")
+    d01 = p2p.Install(nodes.Get(0), nodes.Get(1))
+    d12 = p2p.Install(nodes.Get(1), nodes.Get(2))
+    for i in range(2):
+        d12.Get(i).SetMtu(400)
+    InternetStackHelper().Install(nodes)
+    a = Ipv4AddressHelper("10.1.1.0", "255.255.255.0")
+    i01 = a.Assign(d01)
+    a.SetBase("10.1.2.0", "255.255.255.0")
+    i12 = a.Assign(d12)
+    from tpudes.models.internet.ipv4 import Ipv4StaticRouting
+
+    r0 = nodes.Get(0).GetObject(Ipv4L3Protocol).GetRoutingProtocol()
+    r0.SetDefaultRoute(i01.GetAddress(1), 1)
+    r2 = nodes.Get(2).GetObject(Ipv4L3Protocol).GetRoutingProtocol()
+    r2.SetDefaultRoute(i12.GetAddress(0), 1)
+
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(2))
+    sapps.Start(Seconds(0.1))
+    client = UdpEchoClientHelper(i12.GetAddress(1), 9)
+    client.SetAttribute("MaxPackets", 2)
+    client.SetAttribute("Interval", Seconds(0.2))
+    client.SetAttribute("PacketSize", 1200)
+    capps = client.Install(nodes.Get(0))
+    capps.Start(Seconds(0.5))
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    assert sapps.Get(0).received == 2
+    assert capps.Get(0).received == 2
+    _reset()
+
+
+def test_double_fragmentation_600_then_400():
+    """Both hops fragment (600 then 400): the router re-fragments
+    NON-first fragments, which must never overwrite the reassembler's
+    original-packet tag (r5 review regression)."""
+    _reset()
+    nodes = NodeContainer()
+    nodes.Create(3)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "10Mbps")
+    p2p.SetChannelAttribute("Delay", "1ms")
+    d01 = p2p.Install(nodes.Get(0), nodes.Get(1))
+    d12 = p2p.Install(nodes.Get(1), nodes.Get(2))
+    for i in range(2):
+        d01.Get(i).SetMtu(600)
+        d12.Get(i).SetMtu(400)
+    InternetStackHelper().Install(nodes)
+    a = Ipv4AddressHelper("10.1.1.0", "255.255.255.0")
+    i01 = a.Assign(d01)
+    a.SetBase("10.1.2.0", "255.255.255.0")
+    i12 = a.Assign(d12)
+    from tpudes.models.internet.ipv4 import Ipv4StaticRouting
+
+    nodes.Get(0).GetObject(Ipv4L3Protocol).GetRoutingProtocol(
+    ).SetDefaultRoute(i01.GetAddress(1), 1)
+    nodes.Get(2).GetObject(Ipv4L3Protocol).GetRoutingProtocol(
+    ).SetDefaultRoute(i12.GetAddress(0), 1)
+
+    got = []
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(2))
+    sapps.Start(Seconds(0.1))
+    sapps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda pkt, *a: got.append(pkt.GetSize())
+    )
+    client = UdpEchoClientHelper(i12.GetAddress(1), 9)
+    client.SetAttribute("MaxPackets", 1)
+    client.SetAttribute("PacketSize", 2000)
+    capps = client.Install(nodes.Get(0))
+    capps.Start(Seconds(0.5))
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    # delivered intact: the full 2000 B application payload
+    assert got == [2000], got
+    assert capps.Get(0).received == 1
+    _reset()
+
+
+def test_df_forbids_fragmentation_and_drops():
+    _reset()
+    nodes, devices, ifc = _pair(mtu=600)
+    l3 = nodes.Get(0).GetObject(Ipv4L3Protocol)
+    drops = []
+    l3.TraceConnectWithoutContext("Drop", lambda h, p, r: drops.append(r))
+    from tpudes.network.packet import Packet
+
+    header = Ipv4Header(
+        source=ifc.GetAddress(0), destination=ifc.GetAddress(1),
+        protocol=17, payload_size=1500,
+    )
+    header.dont_fragment = True
+    ok = l3._fragment_and_send(
+        l3.GetInterface(1), Packet(1500), header, None, 1
+    )
+    assert ok is False
+    assert Ipv4L3Protocol.DROP_FRAGMENT_DF in drops
+    _reset()
+
+
+def test_fragment_wire_bits_roundtrip():
+    h = Ipv4Header(protocol=17, payload_size=480)
+    h.more_fragments = True
+    h.fragment_offset = 1480
+    h2, n = Ipv4Header.Deserialize(h.Serialize())
+    assert n == 20
+    assert h2.more_fragments and h2.fragment_offset == 1480
+    assert not h2.dont_fragment

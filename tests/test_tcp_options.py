@@ -17,13 +17,15 @@ from tpudes.network.packet import Packet
 
 
 def _transfer(rate="10Mbps", delay="2ms", total=120_000, losses=None,
-              sack=True, wscale=True, queue="100p"):
+              sack=True, wscale=True, timestamp=True, queue="100p",
+              collect=None, tx_log=None):
     from tpudes.core.config import Config
     from tpudes.core.world import reset_world
 
     reset_world()
     Config.SetDefault("tpudes::TcpSocketBase::Sack", sack)
     Config.SetDefault("tpudes::TcpSocketBase::WindowScaling", wscale)
+    Config.SetDefault("tpudes::TcpSocketBase::Timestamp", timestamp)
     # buffers just above the largest BDP under test (the advertised
     # window, not the buffer, must bind — and slow-start overshoot
     # stays within the queue)
@@ -64,6 +66,12 @@ def _transfer(rate="10Mbps", delay="2ms", total=120_000, losses=None,
             sock.TraceConnectWithoutContext(
                 "Retransmit", lambda seq: retx.__setitem__(0, retx[0] + 1)
             )
+            if collect is not None:
+                collect.append(sock)
+            if tx_log is not None:
+                sock.TraceConnectWithoutContext(
+                    "Tx", lambda pkt, hdr: tx_log.append(hdr)
+                )
         else:
             Simulator.Schedule(Seconds(0.01), hook)
 
@@ -148,3 +156,77 @@ def test_wscale_negotiated_only_when_both_sides_offer():
     except AttributeError:
         pass
     assert s2._snd_wscale_shift == 0 and s2._rcv_wscale_shift == 0
+
+def test_timestamps_negotiated_only_when_both_sides_offer():
+    s = TcpSocketBase()
+    s._state = s.SYN_SENT
+    syn = TcpHeader(flags=TcpHeader.SYN)
+    syn.ts_val = 1.5
+    try:
+        s._receive(Packet(0), syn, None)
+    except AttributeError:
+        pass
+    assert s._peer_offered_ts and s._ts_enabled
+    assert s._ts_recent == 1.5
+    # peer without the option → disabled
+    s2 = TcpSocketBase()
+    s2._state = s2.SYN_SENT
+    try:
+        s2._receive(Packet(0), TcpHeader(flags=TcpHeader.SYN), None)
+    except AttributeError:
+        pass
+    assert not s2._ts_enabled
+    # local opt-out wins even when the peer offers
+    s3 = TcpSocketBase(Timestamp=False)
+    s3._state = s3.SYN_SENT
+    syn3 = TcpHeader(flags=TcpHeader.SYN)
+    syn3.ts_val = 2.0
+    try:
+        s3._receive(Packet(0), syn3, None)
+    except AttributeError:
+        pass
+    assert s3._peer_offered_ts and not s3._ts_enabled
+
+
+def test_timestamps_rtt_samples_survive_retransmission():
+    """Karn's rule forbids tx_ts samples on retransmits; TSecr restores
+    them — under loss, a timestamped connection keeps a sane SRTT near
+    the path RTT instead of freezing its estimator."""
+    from tpudes.core.config import Config
+    from tpudes.core.world import reset_world
+
+    srtt = {}
+    for ts_on in (True, False):
+        socks = []
+        rx, retx, done = _transfer(
+            total=60_000, losses=list(range(10, 60, 10)),
+            timestamp=ts_on, collect=socks,
+        )
+        assert rx >= 60_000
+        assert retx > 0, "losses must force retransmissions"
+        sender = socks[0]
+        assert sender._ts_enabled == ts_on
+        assert sender._srtt is not None
+        srtt[ts_on] = sender._srtt
+    reset_world()
+    # both estimators near the ~4.5 ms path RTT (sanity, not a race)
+    for v in srtt.values():
+        assert 0.003 < v < 0.2, srtt
+
+
+def test_timestamp_echo_rides_every_segment_once_agreed():
+    """After the handshake every data segment carries TSval and echoes
+    the peer's latest TSval (TS.Recent)."""
+    from tpudes.core.world import reset_world
+
+    reset_world()
+    socks = []
+    headers = []
+    rx, retx, done = _transfer(total=20_000, collect=socks, tx_log=headers)
+    reset_world()
+    assert rx >= 20_000
+    data = [h for h in headers if not h.flags & TcpHeader.SYN]
+    assert data, "no data segments traced"
+    assert all(h.ts_val is not None for h in data)
+    # once the peer has stamped anything, echoes are nonzero
+    assert any(h.ts_ecr and h.ts_ecr > 0 for h in data)

@@ -33,6 +33,7 @@ class Icmpv4Header(Header):
     # codes
     PORT_UNREACHABLE = 3
     NET_UNREACHABLE = 0
+    FRAG_NEEDED = 4      # DF set and fragmentation required (RFC 792)
     TTL_EXPIRED = 0
 
     def __init__(self, icmp_type=0, code=0):

@@ -12,10 +12,22 @@ from __future__ import annotations
 
 import struct
 
+from tpudes.core.nstime import Seconds
 from tpudes.core.object import Object, TypeId
 from tpudes.core.simulator import Simulator
 from tpudes.network.address import Ipv4Address, Ipv4Mask
-from tpudes.network.packet import Header
+from tpudes.network.packet import Header, Packet
+
+
+class _FragmentOriginal:
+    """In-sim tag on the first fragment carrying the original
+    structured packet (see _fragment_and_send's deviation note)."""
+
+    __slots__ = ("packet", "total")
+
+    def __init__(self, packet, total):
+        self.packet = packet
+        self.total = total
 
 
 class Ipv4Header(Header):
@@ -39,18 +51,25 @@ class Ipv4Header(Header):
         self.payload_size = payload_size
         self.tos = tos
         self.dont_fragment = False
+        self.more_fragments = False
+        self.fragment_offset = 0   # bytes (multiple of 8 on the wire)
 
     def GetSerializedSize(self) -> int:
         return 20
 
     def Serialize(self) -> bytes:
+        flags_frag = (
+            (0x4000 if self.dont_fragment else 0)
+            | (0x2000 if self.more_fragments else 0)
+            | ((self.fragment_offset >> 3) & 0x1FFF)
+        )
         head = struct.pack(
             "!BBHHHBBH4s4s",
             0x45,
             self.tos,
             20 + self.payload_size,
             self.identification,
-            0x4000 if self.dont_fragment else 0,
+            flags_frag,
             self.ttl,
             self.protocol,
             0,
@@ -83,6 +102,8 @@ class Ipv4Header(Header):
             tos,
         )
         h.dont_fragment = bool(flags & 0x4000)
+        h.more_fragments = bool(flags & 0x2000)
+        h.fragment_offset = (flags & 0x1FFF) << 3
         return h, 20
 
     # ns-3 accessor parity
@@ -287,7 +308,12 @@ class Ipv4L3Protocol(Object):
     # drop reasons (ns-3 Ipv4L3Protocol::DropReason)
     DROP_TTL_EXPIRED = 1
     DROP_NO_ROUTE = 2
+    DROP_FRAGMENT_TIMEOUT = 4
     DROP_INTERFACE_DOWN = 5
+    DROP_FRAGMENT_DF = 6
+
+    #: reassembly buffer lifetime (Ipv4L3Protocol::FragmentExpiration)
+    FRAGMENT_EXPIRATION_S = 30.0
 
     def __init__(self, **attributes):
         super().__init__(**attributes)
@@ -296,6 +322,8 @@ class Ipv4L3Protocol(Object):
         self._protocols: dict[int, object] = {}  # l4 protocol number -> protocol
         self._routing: Ipv4RoutingProtocol | None = None
         self._ident = 0
+        # (src, dst, ident, proto) -> reassembly buffer
+        self._frags: dict[tuple, dict] = {}
 
     # --- node wiring ---
     def SetNode(self, node) -> None:
@@ -407,9 +435,113 @@ class Ipv4L3Protocol(Object):
             self.drop(header, packet, self.DROP_INTERFACE_DOWN)
             return
         self.send_outgoing(header, packet, if_index)
-        packet.AddHeader(header)
-        self.tx(packet, if_index)
-        self._send_via(iface, packet, header, route)
+        self._fragment_and_send(iface, packet, header, route, if_index)
+
+    def _fragment_and_send(self, iface, packet, header, route, if_index) -> bool:
+        """Hand the packet to the interface, splitting it into
+        MTU-sized IP fragments first when the egress MTU binds
+        (Ipv4L3Protocol::DoFragmentation).
+
+        The in-sim fragments carry real offset/MF wire fields and
+        correctly-sized payloads; the ORIGINAL structured packet rides a
+        tag on the first fragment so the destination's reassembly can
+        deliver it intact (structured packets cannot be byte-spliced —
+        documented deviation from upstream's byte-level reassembly; the
+        timing/loss semantics are identical: delivery waits for the
+        last fragment and any loss kills the whole datagram)."""
+        mtu = iface.device.GetMtu() if iface.device is not None else 65535
+        total = packet.GetSize()
+        if total + 20 <= mtu:
+            packet.AddHeader(header)
+            self.tx(packet, if_index)
+            self._send_via(iface, packet, header, route)
+            return True
+        if header.dont_fragment:
+            self.drop(header, packet, self.DROP_FRAGMENT_DF)
+            return False
+        import copy as _copy
+
+        chunk = (mtu - 20) & ~7
+        if chunk <= 0:
+            # MTU below the minimum fragment (20 B header + 8 B): no
+            # forward progress is possible — drop instead of looping
+            self.drop(header, packet, self.DROP_FRAGMENT_DF)
+            return False
+        base_off = header.fragment_offset  # re-fragmenting a fragment
+        offset = 0
+        first = True
+        while offset < total:
+            flen = min(chunk, total - offset)
+            frag = Packet(flen)
+            if first:
+                # existing tags (incl. a _FragmentOriginal from an
+                # earlier hop) stay on the leading sub-fragment
+                for t in packet._packet_tags:
+                    frag.AddPacketTag(t)
+                if base_off == 0 and frag.PeekPacketTag(_FragmentOriginal) is None:
+                    # only the datagram's TRUE first fragment carries
+                    # the original; tagging a re-fragmented LATER
+                    # fragment would overwrite the real original with a
+                    # bare payload chunk at the reassembler
+                    frag.AddPacketTag(_FragmentOriginal(packet.Copy(), total))
+                first = False
+            fh = _copy.copy(header)
+            fh.payload_size = flen
+            fh.fragment_offset = base_off + offset
+            fh.more_fragments = header.more_fragments or (offset + flen < total)
+            frag.AddHeader(fh)
+            self.tx(frag, if_index)
+            self._send_via(iface, frag, fh, route)
+            offset += flen
+        return True
+
+    def _reassemble(self, packet, header):
+        """Collect fragments; returns (original_packet, full_header)
+        when the datagram is complete, else None."""
+        key = (
+            header.source.addr, header.destination.addr,
+            header.identification, header.protocol,
+        )
+        buf = self._frags.get(key)
+        if buf is None:
+            buf = {"ranges": [], "orig": None, "total": None}
+            buf["timer"] = Simulator.Schedule(
+                Seconds(self.FRAGMENT_EXPIRATION_S),
+                self._expire_fragments, key, header,
+            )
+            self._frags[key] = buf
+        tag = packet.PeekPacketTag(_FragmentOriginal)
+        if tag is not None:
+            buf["orig"] = tag.packet
+        buf["ranges"].append(
+            (header.fragment_offset, header.fragment_offset + header.payload_size)
+        )
+        if not header.more_fragments:
+            buf["total"] = header.fragment_offset + header.payload_size
+        if buf["total"] is None or buf["orig"] is None:
+            return None
+        # contiguous coverage of [0, total)?
+        covered = 0
+        for s, e in sorted(buf["ranges"]):
+            if s > covered:
+                return None
+            covered = max(covered, e)
+        if covered < buf["total"]:
+            return None
+        buf["timer"].Cancel()
+        del self._frags[key]
+        import copy as _copy
+
+        full = _copy.copy(header)
+        full.payload_size = buf["total"]
+        full.fragment_offset = 0
+        full.more_fragments = False
+        return buf["orig"], full
+
+    def _expire_fragments(self, key, header):
+        buf = self._frags.pop(key, None)
+        if buf is not None:
+            self.drop(header, Packet(0), self.DROP_FRAGMENT_TIMEOUT)
 
     # --- receive path ---
     def _receive(self, device, packet, protocol, sender):
@@ -420,6 +552,11 @@ class Ipv4L3Protocol(Object):
         self.rx(packet, if_index)
         header = packet.RemoveHeader(Ipv4Header)
         if self.IsDestinationAddress(header.destination, if_index):
+            if header.more_fragments or header.fragment_offset:
+                done = self._reassemble(packet, header)
+                if done is None:
+                    return
+                packet, header = done
             self.local_deliver(header, packet, if_index)
             self._deliver_l4(packet, header, if_index)
         elif self.ip_forward:
@@ -460,9 +597,11 @@ class Ipv4L3Protocol(Object):
             self.drop(header, packet, self.DROP_INTERFACE_DOWN)
             return
         self.unicast_forward(header, packet, if_index)
-        packet.AddHeader(header)
-        self.tx(packet, if_index)
-        self._send_via(self.interfaces[if_index], packet, header, route)
+        if not self._fragment_and_send(
+            self.interfaces[if_index], packet, header, route, if_index
+        ):
+            # DF set but the next link's MTU binds: ICMP frag-needed
+            self._icmp_error(header, packet, "frag")
 
     def _icmp_error(self, header, packet, kind: str) -> None:
         """Forwarding drop → ICMP error back to the source (upstream:
@@ -482,11 +621,13 @@ class Ipv4L3Protocol(Object):
                 Icmpv4Header.ECHO, Icmpv4Header.ECHO_REPLY
             ):
                 return
+        from tpudes.models.internet.icmp import Icmpv4Header
+
         if kind == "ttl":
             icmp.SendTimeExceeded(header, packet)
+        elif kind == "frag":
+            icmp.SendDestUnreachable(header, packet, Icmpv4Header.FRAG_NEEDED)
         else:
-            from tpudes.models.internet.icmp import Icmpv4Header
-
             icmp.SendDestUnreachable(
                 header, packet, Icmpv4Header.NET_UNREACHABLE
             )

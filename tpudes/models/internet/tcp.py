@@ -8,7 +8,7 @@ Round-1 scope (SURVEY.md §2.7): full 3-way handshake, byte-accurate
 sliding window with cumulative acks, RFC 6298 RTO with Karn's rule and
 exponential backoff, fast retransmit + NewReno fast recovery, pluggable
 TcpCongestionOps (see tcp_congestion.py), FIN teardown with TIME_WAIT.
-SACK, ECN/DCTCP, window scaling and timestamps are later rounds — the
+SACK, ECN/DCTCP, window scaling and timestamps are all in — the
 seams are the header option field and the buffer classes.
 """
 
@@ -54,6 +54,8 @@ class TcpHeader(Header):
         # emulation boundary would need real option encoding)
         self.sack_blocks: list = []     # [(start, end)) received runs
         self.window_scale = None        # shift count, SYN/SYN+ACK only
+        self.ts_val = None              # RFC 7323 TSval (seconds)
+        self.ts_ecr = None              # RFC 7323 TSecr (seconds)
 
     def GetSerializedSize(self) -> int:
         return 20
@@ -173,6 +175,10 @@ class TcpSocketBase(Socket):
         .AddAttribute("InitialRto", "initial RTO (s)", 1.0, field="initial_rto_s")
         .AddAttribute("Sack", "selective acknowledgments (RFC 2018)", True,
                       field="sack")
+        .AddAttribute("Timestamp", "timestamps option (RFC 7323): RTT "
+                      "samples from TSecr, incl. on retransmitted data "
+                      "where Karn's rule otherwise forbids them",
+                      True, field="timestamp")
         .AddAttribute("WindowScaling", "window scale option (RFC 7323)",
                       True, field="window_scaling")
         .AddTraceSource("CongestionWindow", "(old, new)")
@@ -218,6 +224,9 @@ class TcpSocketBase(Socket):
         # apply to every non-SYN window field thereafter
         self._rcv_wscale_shift = 0     # what we apply to our adverts
         self._snd_wscale_shift = 0     # what the peer applies to theirs
+        self._peer_offered_ts = False
+        self._ts_enabled = False       # both SYNs carried the option
+        self._ts_recent = 0.0          # peer TSval to echo (TS.Recent)
         # ECN (RFC 3168 data path; handshake negotiation elided — both
         # ends opt in via the UseEcn attribute)
         self.use_ecn = False
@@ -334,7 +343,7 @@ class TcpSocketBase(Socket):
 
     # --- segment tx ---
     def _header(self, flags, seq=None, ack=None):
-        return TcpHeader(
+        header = TcpHeader(
             source_port=self._endpoint.local_port,
             destination_port=self._endpoint.peer_port,
             seq=seq if seq is not None else self._snd_nxt,
@@ -346,6 +355,23 @@ class TcpSocketBase(Socket):
                 65535,
             ),
         )
+        # RFC 7323 timestamps: offered on the SYN (echoed on SYN+ACK
+        # only if the SYN carried it), then on every segment once agreed
+        if flags & TcpHeader.SYN:
+            if self.timestamp and (
+                not flags & TcpHeader.ACK or self._peer_offered_ts
+            ):
+                header.ts_val = Simulator.Now().GetSeconds()
+                # a bare SYN has nothing to echo: None, NOT 0.0 — the
+                # receiver must distinguish "no echo" from a legitimate
+                # echo of a segment stamped at sim time zero
+                header.ts_ecr = (
+                    self._ts_recent if flags & TcpHeader.ACK else None
+                )
+        elif self._ts_enabled:
+            header.ts_val = Simulator.Now().GetSeconds()
+            header.ts_ecr = self._ts_recent
+        return header
 
     def _my_wscale_proposal(self) -> int:
         shift = 0
@@ -538,8 +564,12 @@ class TcpSocketBase(Socket):
             else:
                 self._snd_wscale_shift = 0
                 self._rcv_wscale_shift = 0
+            self._peer_offered_ts = header.ts_val is not None
+            self._ts_enabled = bool(self.timestamp) and self._peer_offered_ts
         else:
             self._peer_rwnd = header.window << self._snd_wscale_shift
+        if header.ts_val is not None and header.seq <= self._rcv_nxt:
+            self._ts_recent = header.ts_val  # RFC 7323 TS.Recent rule
         if self.sack and header.sack_blocks:
             for start, end in header.sack_blocks:
                 for seq, seg in self._segments.items():
@@ -601,6 +631,10 @@ class TcpSocketBase(Socket):
         fork._peer_offered_wscale = getattr(self, "_peer_offered_wscale", False)
         fork._snd_wscale_shift = self._snd_wscale_shift
         fork._rcv_wscale_shift = self._rcv_wscale_shift
+        fork.timestamp = self.timestamp
+        fork._peer_offered_ts = self._peer_offered_ts
+        fork._ts_enabled = self._ts_enabled
+        fork._ts_recent = self._ts_recent
         fork._tcb = TcpSocketState(self.segment_size, self.initial_cwnd)
         fork._endpoint = self._tcp._demux.Allocate4(
             ip_header.destination, self._endpoint.local_port,
@@ -625,12 +659,17 @@ class TcpSocketBase(Socket):
             acked_bytes = 0
             segments_acked = 0
             now_s = Simulator.Now().GetSeconds()
+            if self._ts_enabled and header.ts_ecr is not None:
+                # timestamps give one clean sample per ack — valid even
+                # for retransmitted data (no Karn ambiguity: TSecr names
+                # the transmission the ack answers)
+                self._rtt_sample(now_s - header.ts_ecr)
             for seq in sorted(self._segments):
                 seg = self._segments[seq]
                 if seq + seg["size"] <= ack:
                     acked_bytes += seg["size"]
                     segments_acked += 1
-                    if seg["tx_ts"] is not None:
+                    if seg["tx_ts"] is not None and not self._ts_enabled:
                         self._rtt_sample(now_s - seg["tx_ts"])
                     del self._segments[seq]
             self._snd_una = ack

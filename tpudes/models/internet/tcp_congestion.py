@@ -694,6 +694,199 @@ class TcpDctcp(TcpLinuxReno):
         )
 
 
+class TcpHtcp(TcpNewReno):
+    """H-TCP (tcp-htcp.cc): the additive increase grows with the time
+    elapsed since the last congestion event, scaled by an adaptive
+    backoff beta = RTTmin/RTTmax clamped to [0.5, 0.8]."""
+
+    tid = (
+        TypeId("tpudes::TcpHtcp")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpHtcp(**kw))
+        .AddAttribute("DefaultBackoff", "beta before any RTT spread", 0.5,
+                      field="default_backoff")
+        .AddAttribute("ThroughputRatio", "beta adaptation guard", 0.2,
+                      field="throughput_ratio")
+    )
+
+    DELTA_B = 1.0  # s: low-speed regime boundary
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._last_congestion_s = 0.0
+        self._clock = 0.0
+        self._min_rtt = math.inf
+        self._max_rtt = 0.0
+        self._beta = float(self.default_backoff)
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if not rtt_s or rtt_s <= 0:
+            return
+        self._clock += rtt_s * segments_acked / max(
+            tcb.cwnd / tcb.segment_size, 1.0
+        )
+        self._min_rtt = min(self._min_rtt, rtt_s)
+        self._max_rtt = max(self._max_rtt, rtt_s)
+
+    def _alpha(self) -> float:
+        delta = max(self._clock - self._last_congestion_s - self.DELTA_B, 0.0)
+        alpha = 1.0 + 10.0 * delta + 0.25 * delta * delta
+        return max(2.0 * (1.0 - self._beta) * alpha, 1.0)
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        if segments_acked <= 0:
+            return
+        seg = tcb.segment_size
+        add = self._alpha() * segments_acked * seg * seg / tcb.cwnd
+        tcb.cwnd += max(int(add), 1)
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        if self._max_rtt > 0 and self._min_rtt < math.inf:
+            self._beta = min(max(self._min_rtt / self._max_rtt, 0.5), 0.8)
+        self._last_congestion_s = self._clock
+        return max(int(tcb.cwnd * self._beta), 2 * tcb.segment_size)
+
+
+class TcpYeah(TcpNewReno):
+    """YeAH (tcp-yeah.cc): STCP-style fast mode while the estimated
+    queue backlog stays under Q_max, Reno slow mode (and precautionary
+    decongestion) once the queue builds."""
+
+    tid = (
+        TypeId("tpudes::TcpYeah")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpYeah(**kw))
+        .AddAttribute("Alpha", "STCP ai cap", 80.0, field="alpha")
+        .AddAttribute("QMax", "max queued packets before slow mode", 8.0,
+                      field="q_max")
+        .AddAttribute("Rho", "min decongestion backlog share", 0.125,
+                      field="rho")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._base_rtt = math.inf
+        self._last_rtt = 0.0
+        self._queue = 0.0
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if not rtt_s or rtt_s <= 0:
+            return
+        self._base_rtt = min(self._base_rtt, rtt_s)
+        self._last_rtt = rtt_s
+        w = tcb.cwnd / tcb.segment_size
+        self._queue = w * max(1.0 - self._base_rtt / rtt_s, 0.0)
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        if segments_acked <= 0:
+            return
+        seg = tcb.segment_size
+        w = tcb.cwnd / seg
+        if self._queue < float(self.q_max):
+            # fast mode: STCP increase, capped at alpha acks per +1
+            inc = segments_acked * seg / min(w, float(self.alpha))
+        else:
+            inc = segments_acked * seg * seg / tcb.cwnd
+            # precautionary decongestion: shed the measured backlog
+            shed = max(self._queue * (1.0 - float(self.rho)), 0.0)
+            tcb.cwnd = max(int(tcb.cwnd - shed * seg), 2 * seg)
+            self._queue = 0.0
+        tcb.cwnd += max(int(inc), 1)
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        # reduce by the larger of the measured queue and cwnd/8
+        w = tcb.cwnd / tcb.segment_size
+        red = max(self._queue, w / 8.0)
+        return max(int(tcb.cwnd - red * tcb.segment_size),
+                   2 * tcb.segment_size)
+
+
+class TcpLedbat(TcpNewReno):
+    """LEDBAT (tcp-ledbat.cc; RFC 6817): scavenger congestion control —
+    the window tracks a 100 ms queueing-delay target and yields as the
+    measured one-way queueing delay approaches it."""
+
+    tid = (
+        TypeId("tpudes::TcpLedbat")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpLedbat(**kw))
+        .AddAttribute("TargetDelay", "queueing-delay target (s)", 0.1,
+                      field="target_s")
+        .AddAttribute("Gain", "cwnd gain", 1.0, field="gain")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._base_rtt = math.inf
+        self._qdelay = 0.0
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if not rtt_s or rtt_s <= 0:
+            return
+        self._base_rtt = min(self._base_rtt, rtt_s)
+        self._qdelay = max(rtt_s - self._base_rtt, 0.0)
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        if segments_acked <= 0:
+            return
+        seg = tcb.segment_size
+        off_target = (float(self.target_s) - self._qdelay) / float(self.target_s)
+        add = float(self.gain) * off_target * segments_acked * seg * seg / tcb.cwnd
+        tcb.cwnd = max(int(tcb.cwnd + add), 2 * seg)
+
+    def GetSsThresh(self, tcb, bytes_in_flight) -> int:
+        return max(tcb.cwnd // 2, 2 * tcb.segment_size)
+
+
+class TcpLp(TcpNewReno):
+    """TCP-LP (tcp-lp.cc): low-priority transfer — early congestion is
+    inferred from one-way delay crossing 15% of the observed delay
+    range; during the inference phase the window collapses to one
+    segment so best-effort traffic takes the capacity."""
+
+    tid = (
+        TypeId("tpudes::TcpLp")
+        .SetParent(TcpCongestionOps.tid)
+        .AddConstructor(lambda **kw: TcpLp(**kw))
+    )
+
+    INFERENCE_FRAC = 0.15
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._min_rtt = math.inf
+        self._max_rtt = 0.0
+        self._clock = 0.0
+        self._inference_until = 0.0
+
+    def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
+        if not rtt_s or rtt_s <= 0:
+            return
+        self._clock += rtt_s * segments_acked / max(
+            tcb.cwnd / tcb.segment_size, 1.0
+        )
+        self._min_rtt = min(self._min_rtt, rtt_s)
+        self._max_rtt = max(self._max_rtt, rtt_s)
+        thresh = self._min_rtt + self.INFERENCE_FRAC * (
+            self._max_rtt - self._min_rtt
+        )
+        if (
+            self._max_rtt > self._min_rtt
+            and rtt_s > thresh
+            and self._clock >= self._inference_until
+        ):
+            # early congestion indication: drop to one segment and hold
+            # the inference phase for one RTT
+            tcb.cwnd = tcb.segment_size
+            tcb.ssthresh = max(tcb.ssthresh // 2, 2 * tcb.segment_size)
+            self._inference_until = self._clock + rtt_s
+
+    def CongestionAvoidance(self, tcb, segments_acked) -> None:
+        if self._clock < self._inference_until:
+            return  # yielding
+        super().CongestionAvoidance(tcb, segments_acked)
+
+
 TCP_VARIANTS = {
     "TcpNewReno": TcpNewReno,
     "TcpCubic": TcpCubic,
@@ -708,4 +901,8 @@ TCP_VARIANTS = {
     "TcpHybla": TcpHybla,
     "TcpBbr": TcpBbr,
     "TcpDctcp": TcpDctcp,
+    "TcpHtcp": TcpHtcp,
+    "TcpYeah": TcpYeah,
+    "TcpLedbat": TcpLedbat,
+    "TcpLp": TcpLp,
 }
