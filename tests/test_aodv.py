@@ -1,0 +1,256 @@
+"""AODV tests — upstream src/aodv/test strategy (aodv-test-suite.cc +
+the chain regression): on-demand discovery (silent until traffic),
+RREQ flood dedup, RREP path setup, multihop data beyond radio range,
+queue-drain of the first packets, discovery failure drop, route expiry
++ re-discovery, and the structural contrast with proactive DSDV."""
+
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.models.internet.aodv import (
+    AODV_PROT_NUMBER,
+    AodvHeader,
+    AodvHelper,
+    AodvRoutingProtocol,
+)
+from tpudes.models.internet.ipv4 import Ipv4L3Protocol
+from tpudes.models.mobility import ListPositionAllocator, MobilityHelper, Vector
+from tpudes.network.address import Ipv4Address
+
+
+def _reset():
+    from tpudes.core.world import reset_world
+
+    reset_world()
+
+
+def _adhoc_chain(n=3, spacing=80.0, **aodv_attrs):
+    from tpudes.models.wifi import (
+        WifiHelper,
+        WifiMacHelper,
+        YansWifiChannelHelper,
+        YansWifiPhyHelper,
+    )
+
+    nodes = NodeContainer()
+    nodes.Create(n)
+    alloc = ListPositionAllocator()
+    for i in range(n):
+        alloc.Add(Vector(i * spacing, 0.0, 0.0))
+    mob = MobilityHelper()
+    mob.SetPositionAllocator(alloc)
+    mob.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mob.Install(nodes)
+
+    channel = YansWifiChannelHelper.Default().Create()
+    phy = YansWifiPhyHelper()
+    phy.SetChannel(channel)
+    wifi = WifiHelper()
+    wifi.SetRemoteStationManager(
+        "tpudes::ConstantRateWifiManager", DataMode="OfdmRate6Mbps"
+    )
+    mac = WifiMacHelper()
+    mac.SetType("tpudes::AdhocWifiMac")
+    devices = wifi.Install(phy, mac, [nodes.Get(i) for i in range(n)])
+
+    stack = InternetStackHelper()
+    stack.SetRoutingHelper(AodvHelper(**aodv_attrs))
+    stack.Install(nodes)
+    ifc = Ipv4AddressHelper("10.1.1.0", "255.255.255.0").Assign(devices)
+    return nodes, devices, ifc
+
+
+def _aodv(node) -> AodvRoutingProtocol:
+    return node.GetObject(Ipv4L3Protocol).GetRoutingProtocol()
+
+
+def test_silent_until_traffic_then_discovers():
+    """The reactive signature: zero control packets before the first
+    data send; RREQ/RREP only afterwards (DSDV floods from t=0)."""
+    _reset()
+    nodes, devices, ifc = _adhoc_chain(3)
+    ctrl = []
+    for i in range(3):
+        nodes.Get(i).GetObject(Ipv4L3Protocol).TraceConnectWithoutContext(
+            "Tx",
+            lambda pkt, idx: ctrl.append(Simulator.Now().GetSeconds())
+            if pkt.FindHeader(AodvHeader) is not None
+            else None,
+        )
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(2))
+    sapps.Start(Seconds(0.0))
+    client = UdpEchoClientHelper(ifc.GetAddress(2), 9)
+    client.SetAttribute("MaxPackets", 3)
+    client.SetAttribute("Interval", Seconds(0.2))
+    capps = client.Install(nodes.Get(0))
+    capps.Start(Seconds(2.0))
+    Simulator.Stop(Seconds(4.0))
+    Simulator.Run()
+    assert ctrl, "no AODV control traffic at all"
+    assert min(ctrl) >= 2.0, f"control traffic before first send: {min(ctrl)}"
+    assert sapps.Get(0).received == 3
+    assert capps.Get(0).received == 3
+    _reset()
+
+
+def test_multihop_beyond_radio_range():
+    """At 80 m spacing node 0 cannot hear node 4: data must relay
+    through the discovered 4-hop path, including the queued first
+    packet."""
+    _reset()
+    nodes, devices, ifc = _adhoc_chain(5)
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(4))
+    sapps.Start(Seconds(0.0))
+    client = UdpEchoClientHelper(ifc.GetAddress(4), 9)
+    client.SetAttribute("MaxPackets", 4)
+    client.SetAttribute("Interval", Seconds(0.25))
+    capps = client.Install(nodes.Get(0))
+    capps.Start(Seconds(1.0))
+    Simulator.Stop(Seconds(4.0))
+    Simulator.Run()
+    assert sapps.Get(0).received == 4
+    assert capps.Get(0).received == 4
+    # forwarders hold routes toward both endpoints
+    mid = _aodv(nodes.Get(2))
+    assert mid.GetNRoutes() >= 2
+    _reset()
+
+
+def test_rreq_flood_is_deduplicated():
+    """Every node forwards a given RREQ at most once — the flood is
+    O(N) per discovery, not exponential."""
+    _reset()
+    nodes, devices, ifc = _adhoc_chain(4, spacing=60.0)  # denser: overlap
+    rreq_tx = [0]
+    for i in range(4):
+        nodes.Get(i).GetObject(Ipv4L3Protocol).TraceConnectWithoutContext(
+            "Tx",
+            lambda pkt, idx: rreq_tx.__setitem__(0, rreq_tx[0] + 1)
+            if (
+                pkt.FindHeader(AodvHeader) is not None
+                and pkt.FindHeader(AodvHeader).msg_type == AodvHeader.RREQ
+            )
+            else None,
+        )
+    server = UdpEchoServerHelper(9)
+    server.Install(nodes.Get(3)).Start(Seconds(0.0))
+    client = UdpEchoClientHelper(ifc.GetAddress(3), 9)
+    client.SetAttribute("MaxPackets", 1)
+    client.Install(nodes.Get(0)).Start(Seconds(1.0))
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    # one discovery: at most one RREQ per node (4), plus the reply
+    # path's own discovery (the server answers to a known reverse
+    # route, so none) — allow retries headroom but forbid a storm
+    assert 1 <= rreq_tx[0] <= 8, rreq_tx[0]
+    _reset()
+
+
+def test_unreachable_destination_drops_after_retries():
+    _reset()
+    nodes, devices, ifc = _adhoc_chain(2)
+    drops = []
+    _aodv(nodes.Get(0)).TraceConnectWithoutContext(
+        "Drop", lambda pkt, dst: drops.append(dst)
+    )
+    client = UdpEchoClientHelper(Ipv4Address("10.1.1.200"), 9)  # nobody
+    client.SetAttribute("MaxPackets", 1)
+    client.Install(nodes.Get(0)).Start(Seconds(0.5))
+    Simulator.Stop(Seconds(12.0))  # 3 tries x 2.8 s net traversal
+    Simulator.Run()
+    assert drops and str(drops[0]) == "10.1.1.200"
+    _reset()
+
+
+def test_route_expires_and_rediscovers():
+    _reset()
+    nodes, devices, ifc = _adhoc_chain(
+        3, ActiveRouteTimeout=Seconds(0.5)
+    )
+    rreqs = []
+    _aodv(nodes.Get(0)).TraceConnectWithoutContext(
+        "Rreq", lambda orig, dst: rreqs.append(Simulator.Now().GetSeconds())
+    )
+    server = UdpEchoServerHelper(9)
+    sapps = server.Install(nodes.Get(2))
+    sapps.Start(Seconds(0.0))
+    # two bursts separated by > ActiveRouteTimeout
+    for t in (1.0, 3.0):
+        client = UdpEchoClientHelper(ifc.GetAddress(2), 9)
+        client.SetAttribute("MaxPackets", 1)
+        client.Install(nodes.Get(0)).Start(Seconds(t))
+    Simulator.Stop(Seconds(5.0))
+    Simulator.Run()
+    assert len(rreqs) >= 2, rreqs  # the second burst re-discovered
+    assert sapps.Get(0).received == 2
+    _reset()
+
+
+def test_intermediate_node_with_fresh_route_replies():
+    """Node 1 already holds a fresh route to node 2 (from earlier
+    traffic); a new discovery from node 0 is answered by node 1 without
+    the RREQ ever reaching node 2 — unless DestinationOnly."""
+    _reset()
+    nodes, devices, ifc = _adhoc_chain(3)
+    server = UdpEchoServerHelper(9)
+    server.Install(nodes.Get(2)).Start(Seconds(0.0))
+    # prime node 1's route to node 2
+    c1 = UdpEchoClientHelper(ifc.GetAddress(2), 9)
+    c1.SetAttribute("MaxPackets", 1)
+    c1.Install(nodes.Get(1)).Start(Seconds(0.5))
+    # then node 0 discovers; count RREQs arriving AT node 2
+    rreq_at_2 = [0]
+    nodes.Get(2).GetObject(Ipv4L3Protocol).TraceConnectWithoutContext(
+        "LocalDeliver",
+        lambda h, p, i: rreq_at_2.__setitem__(0, rreq_at_2[0] + 1)
+        if h.protocol == AODV_PROT_NUMBER
+        and Simulator.Now().GetSeconds() > 1.0
+        and p.PeekHeader(AodvHeader) is not None
+        and p.PeekHeader(AodvHeader).msg_type == AodvHeader.RREQ
+        else None,
+    )
+    c0 = UdpEchoClientHelper(ifc.GetAddress(2), 9)
+    c0.SetAttribute("MaxPackets", 1)
+    c0apps = c0.Install(nodes.Get(0))
+    c0apps.Start(Seconds(1.5))
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    assert c0apps.Get(0).received == 1
+    assert rreq_at_2[0] == 0, "intermediate reply should stop the flood"
+    _reset()
+
+
+def test_sequence_freshness_guards_the_table():
+    _reset()
+    nodes, devices, ifc = _adhoc_chain(2)
+    a = _aodv(nodes.Get(0))
+    via1 = Ipv4Address("10.1.1.7")
+    via2 = Ipv4Address("10.1.1.8")
+    dst = Ipv4Address("10.1.1.99")
+    a._learn(dst, via1, 1, hops=2, seq=10)
+    a._learn(dst, via2, 1, hops=1, seq=8)   # stale seq: ignored
+    assert a._table[dst.addr][0] == via1
+    a._learn(dst, via2, 1, hops=1, seq=10)  # same seq, fewer hops: wins
+    assert a._table[dst.addr][0] == via2
+    a._learn(dst, via1, 1, hops=5, seq=12)  # fresher seq wins regardless
+    assert a._table[dst.addr][0] == via1
+    _reset()
+
+
+def test_header_roundtrip():
+    h = AodvHeader(
+        AodvHeader.RREQ, hop_count=3, rreq_id=77,
+        dst=Ipv4Address("10.0.0.5"), dst_seq=9,
+        orig=Ipv4Address("10.0.0.1"), orig_seq=4,
+    )
+    raw = h.Serialize()
+    assert len(raw) == h.GetSerializedSize() == 24
+    h2 = AodvHeader.Deserialize(raw)
+    assert (h2.msg_type, h2.hop_count, h2.rreq_id) == (1, 3, 77)
+    assert h2.dst == h.dst and h2.orig == h.orig
+    assert (h2.dst_seq, h2.orig_seq) == (9, 4)

@@ -1,0 +1,407 @@
+"""AODV: ad-hoc on-demand distance-vector routing (RFC 3561).
+
+Reference parity: src/aodv/model/aodv-routing-protocol.{h,cc},
+aodv-packet.{h,cc}, aodv-rtable.{h,cc}, aodv-rqueue.{h,cc} + helper
+(upstream paths; mount empty at survey — SURVEY.md §0, §2.7
+routing-protocol-modules row).
+
+The reactive half of the MANET pair (DSDV is the proactive one): no
+control traffic until a packet needs a route; then the origin floods a
+RREQ (deduplicated by (origin, rreq-id)), every forwarder learns the
+reverse route, the destination — or an intermediate node holding a
+route with a fresh-enough destination sequence — unicasts a RREP back
+along it, and forwarders learn the forward route.  Data queued at the
+origin drains when the RREP lands; discovery retries RREQ_RETRIES
+times before dropping the queue.  A forwarding failure (route expired
+mid-flow) sends a RERR back to the source, which purges and
+re-discovers.
+
+Not modeled (documented scope, as dsdv.py's WST note): HELLO neighbor
+beacons and link-layer failure feedback — lifetime expiry and the
+forwarding-miss RERR are the breakage detectors; expanding-ring search
+starts network-wide.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import Seconds, Time
+from tpudes.core.object import TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.models.internet.ipv4 import Ipv4Route, Ipv4RoutingProtocol
+from tpudes.network.address import Ipv4Address
+from tpudes.network.packet import Header, Packet
+
+AODV_PROT_NUMBER = 100  # own IP protocol (upstream: UDP port 654)
+
+
+class AodvHeader(Header):
+    """One AODV control message (aodv-packet.cc, folded types)."""
+
+    RREQ = 1
+    RREP = 2
+    RERR = 3
+
+    def __init__(self, msg_type=1, hop_count=0, rreq_id=0, dst=None,
+                 dst_seq=0, orig=None, orig_seq=0):
+        self.msg_type = msg_type
+        self.hop_count = hop_count
+        self.rreq_id = rreq_id
+        self.dst = dst or Ipv4Address()
+        self.dst_seq = dst_seq
+        self.orig = orig or Ipv4Address()
+        self.orig_seq = orig_seq
+
+    def GetSerializedSize(self) -> int:
+        return 24
+
+    def Serialize(self) -> bytes:
+        return struct.pack(
+            "!BBHIiIi4x",
+            self.msg_type, self.hop_count, self.rreq_id & 0xFFFF,
+            self.dst.addr, self.dst_seq, self.orig.addr, self.orig_seq,
+        )
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        t, h, rid, dst, dseq, orig, oseq = struct.unpack(
+            "!BBHIiIi4x", data[:24]
+        )
+        return cls(t, h, rid, Ipv4Address(dst), dseq, Ipv4Address(orig), oseq)
+
+
+class AodvRoutingProtocol(Ipv4RoutingProtocol):
+    PROT_NUMBER = AODV_PROT_NUMBER
+
+    RREQ_RETRIES = 2
+    NET_TRAVERSAL_TIME_S = 2.8   # RFC 3561 defaults (2 * 1.4 s)
+    PATH_DISCOVERY_TIME_S = 5.6  # 2 * net traversal: RREQ-id dedup life
+    ACTIVE_ROUTE_TIMEOUT_S = 3.0
+
+    tid = (
+        TypeId("tpudes::AodvRoutingProtocol")
+        .SetParent(Ipv4RoutingProtocol.tid)
+        .AddConstructor(lambda **kw: AodvRoutingProtocol(**kw))
+        .AddAttribute("ActiveRouteTimeout", "route lifetime",
+                      Seconds(3.0), checker=Time, field="route_timeout")
+        .AddAttribute("DestinationOnly", "only the destination answers "
+                      "RREQs (upstream D flag)", False, field="dest_only")
+        .AddTraceSource("Rreq", "(origin, dst) originated")
+        .AddTraceSource("Rrep", "(dst, origin) answered")
+        .AddTraceSource("Rerr", "(dst) route error sent")
+        .AddTraceSource("Drop", "(packet, dst) discovery failed")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        #: dst addr-int -> [next_hop, if_index, hops, dst_seq, expire]
+        self._table: dict[int, list] = {}
+        self._seq = 0
+        self._rreq_id = 0
+        #: (orig, rreq_id) -> expiry ticks (PATH_DISCOVERY_TIME), so the
+        #: 16-bit wire id space can wrap safely on long runs
+        self._seen: dict[tuple, int] = {}
+        #: dst addr-int -> {"packets": [...], "retries": n, "timer": ev}
+        self._pending: dict[int, dict] = {}
+        self._started = False
+        self._own: set[int] = set()
+
+    # --- lifecycle ----------------------------------------------------------
+    def NotifyAddAddress(self, if_index: int, iface_addr) -> None:
+        self._own.add(iface_addr.GetLocal().addr)
+        if not self._started:
+            self._started = True
+            self.ipv4.Insert(self)
+
+    def _now(self) -> int:
+        return Simulator.NowTicks()
+
+    def _lifetime(self) -> int:
+        return self._now() + self.route_timeout.ticks
+
+    def _primary_addr(self) -> Ipv4Address:
+        for iface in self.ipv4.interfaces[1:]:
+            if iface.GetNAddresses():
+                return iface.GetAddress(0).GetLocal()
+        return Ipv4Address.GetAny()
+
+    # --- control tx ---------------------------------------------------------
+    def _broadcast(self, header: AodvHeader) -> None:
+        for i, iface in enumerate(self.ipv4.interfaces):
+            if iface.device is None or not iface.IsUp() or not iface.GetNAddresses():
+                continue
+            packet = Packet(0)
+            packet.AddHeader(header)
+            route = Ipv4Route(
+                destination=Ipv4Address.GetBroadcast(),
+                source=iface.GetAddress(0).GetLocal(),
+                gateway=Ipv4Address.GetBroadcast(),
+                output_device=iface.device,
+            )
+            route.if_index = i
+            self.ipv4.Send(
+                packet, route.source, Ipv4Address.GetBroadcast(),
+                self.PROT_NUMBER, route,
+            )
+
+    def _unicast(self, header: AodvHeader, next_hop: Ipv4Address,
+                 if_index: int) -> None:
+        packet = Packet(0)
+        packet.AddHeader(header)
+        iface = self.ipv4.GetInterface(if_index)
+        route = Ipv4Route(
+            destination=next_hop,
+            source=self.ipv4.SelectSourceAddress(if_index),
+            gateway=next_hop,
+            output_device=iface.device,
+        )
+        route.if_index = if_index
+        self.ipv4.Send(packet, route.source, next_hop, self.PROT_NUMBER, route)
+
+    # --- discovery ----------------------------------------------------------
+    def _start_discovery(self, dst: Ipv4Address) -> None:
+        self._seq += 1
+        self._rreq_id = (self._rreq_id + 1) & 0xFFFF  # wire field width
+        row = self._table.get(dst.addr)
+        header = AodvHeader(
+            AodvHeader.RREQ, hop_count=0, rreq_id=self._rreq_id,
+            dst=dst, dst_seq=row[3] if row else 0,
+            orig=self._primary_addr(), orig_seq=self._seq,
+        )
+        self._mark_seen(header.orig.addr, header.rreq_id)
+        self.rreq(header.orig, dst)
+        self._broadcast(header)
+        pend = self._pending[dst.addr]
+        pend["timer"] = Simulator.Schedule(
+            Seconds(self.NET_TRAVERSAL_TIME_S), self._discovery_timeout, dst
+        )
+
+    def _discovery_timeout(self, dst: Ipv4Address) -> None:
+        pend = self._pending.get(dst.addr)
+        if pend is None:
+            return
+        if self._route_fresh(dst.addr):
+            # a route surfaced without the RREP draining (e.g. learned
+            # from an overheard RREQ): drain now, never strand the queue
+            self._drain_queue(dst.addr)
+            return
+        pend["retries"] += 1
+        if pend["retries"] > self.RREQ_RETRIES:
+            for packet, header in pend["packets"]:
+                self.drop(packet, dst)
+            del self._pending[dst.addr]
+            return
+        self._start_discovery(dst)
+
+    def _route_fresh(self, dst_int: int):
+        row = self._table.get(dst_int)
+        if row is not None and row[4] > self._now():
+            return row
+        return None
+
+    def _queue_packet(self, packet, header) -> None:
+        dst = header.destination
+        pend = self._pending.get(dst.addr)
+        if pend is None:
+            self._pending[dst.addr] = {"packets": [], "retries": 0,
+                                       "timer": None}
+            self._pending[dst.addr]["packets"].append((packet, header))
+            self._start_discovery(dst)
+        else:
+            pend["packets"].append((packet, header))
+
+    def _drain_queue(self, dst_int: int) -> None:
+        pend = self._pending.pop(dst_int, None)
+        if pend is None:
+            return
+        if pend["timer"] is not None:
+            pend["timer"].Cancel()
+        row = self._table.get(dst_int)
+        if row is None:
+            return
+        for packet, header in pend["packets"]:
+            # re-enter the IP send path with the now-known route
+            route = self._route_from_row(Ipv4Address(dst_int), row)
+            self.ipv4.Send(
+                packet, header.source, header.destination,
+                header.protocol, route, tos=header.tos,
+            )
+
+    # --- table --------------------------------------------------------------
+    def _learn(self, dst: Ipv4Address, next_hop: Ipv4Address, if_index: int,
+               hops: int, seq: int) -> None:
+        if dst.addr in self._own:
+            return
+        row = self._table.get(dst.addr)
+        if (
+            row is None
+            or seq > row[3]
+            or (seq == row[3] and hops < row[2])
+            or row[4] <= self._now()
+        ):
+            self._table[dst.addr] = [
+                next_hop, if_index, hops, seq, self._lifetime()
+            ]
+        else:
+            row[4] = max(row[4], self._lifetime())
+
+    def _route_from_row(self, dst: Ipv4Address, row) -> Ipv4Route:
+        iface = self.ipv4.GetInterface(row[1])
+        route = Ipv4Route(
+            destination=dst,
+            source=self.ipv4.SelectSourceAddress(row[1]),
+            gateway=row[0],
+            output_device=iface.device,
+        )
+        route.if_index = row[1]
+        return route
+
+    # --- control rx (as an L4 protocol) -------------------------------------
+    def Receive(self, packet, ip_header, incoming_interface) -> None:
+        header = packet.RemoveHeader(AodvHeader)
+        if_index = self.ipv4.interfaces.index(incoming_interface)
+        via = ip_header.source
+        if header.msg_type == AodvHeader.RREQ:
+            self._on_rreq(header, via, if_index)
+        elif header.msg_type == AodvHeader.RREP:
+            self._on_rrep(header, via, if_index)
+        elif header.msg_type == AodvHeader.RERR:
+            self._on_rerr(header)
+
+    def _mark_seen(self, orig_int: int, rreq_id: int) -> None:
+        now = self._now()
+        if len(self._seen) > 1024:  # lazy purge keeps memory bounded
+            self._seen = {
+                k: e for k, e in self._seen.items() if e > now
+            }
+        self._seen[(orig_int, rreq_id)] = now + Seconds(
+            self.PATH_DISCOVERY_TIME_S
+        ).ticks
+
+    def _on_rreq(self, h: AodvHeader, via: Ipv4Address, if_index: int) -> None:
+        key = (h.orig.addr, h.rreq_id)
+        if self._seen.get(key, 0) > self._now():
+            return
+        self._mark_seen(h.orig.addr, h.rreq_id)
+        # reverse route to the origin through the sender
+        self._learn(h.orig, via, if_index, h.hop_count + 1, h.orig_seq)
+        if via.addr != h.orig.addr:
+            self._learn(via, via, if_index, 1, 0)
+        if h.dst.addr in self._own:
+            # RFC 3561 §6.6.1: the destination bumps its own seq to at
+            # least the one named in the RREQ
+            self._seq = max(self._seq, h.dst_seq)
+            rep = AodvHeader(
+                AodvHeader.RREP, hop_count=0, dst=h.dst,
+                dst_seq=self._seq, orig=h.orig,
+            )
+            self.rrep(h.dst, h.orig)
+            self._unicast(rep, via, if_index)
+            return
+        row = self._route_fresh(h.dst.addr)
+        if row is not None and row[3] >= h.dst_seq and not self.dest_only:
+            # intermediate reply from a fresh cached route (§6.6.2)
+            rep = AodvHeader(
+                AodvHeader.RREP, hop_count=row[2], dst=h.dst,
+                dst_seq=row[3], orig=h.orig,
+            )
+            self.rrep(h.dst, h.orig)
+            self._unicast(rep, via, if_index)
+            return
+        fwd = AodvHeader(
+            AodvHeader.RREQ, hop_count=h.hop_count + 1, rreq_id=h.rreq_id,
+            dst=h.dst, dst_seq=h.dst_seq, orig=h.orig, orig_seq=h.orig_seq,
+        )
+        self._broadcast(fwd)
+
+    def _on_rrep(self, h: AodvHeader, via: Ipv4Address, if_index: int) -> None:
+        # forward route to the destination through the sender
+        self._learn(h.dst, via, if_index, h.hop_count + 1, h.dst_seq)
+        if h.orig.addr in self._own:
+            self._drain_queue(h.dst.addr)
+            return
+        row = self._route_fresh(h.orig.addr)
+        if row is None:
+            return  # reverse route aged out: the discovery will retry
+        fwd = AodvHeader(
+            AodvHeader.RREP, hop_count=h.hop_count + 1, dst=h.dst,
+            dst_seq=h.dst_seq, orig=h.orig,
+        )
+        self._unicast(fwd, row[0], row[1])
+
+    def _on_rerr(self, h: AodvHeader) -> None:
+        row = self._table.get(h.dst.addr)
+        if row is not None and row[3] <= h.dst_seq:
+            del self._table[h.dst.addr]
+
+    def send_rerr(self, dst: Ipv4Address, toward: Ipv4Address) -> None:
+        """Forwarding failed for ``dst``: tell ``toward`` (the packet's
+        source) so it purges and re-discovers (§6.11)."""
+        row = self._route_fresh(dst.addr)
+        seq = (row[3] + 1) if row else (1 << 30)
+        err = AodvHeader(AodvHeader.RERR, dst=dst, dst_seq=seq)
+        self.rerr(dst)
+        back = self._route_fresh(toward.addr)
+        if back is not None:
+            self._unicast(err, back[0], back[1])
+        else:
+            self._broadcast(err)
+
+    # --- forwarding ---------------------------------------------------------
+    def GetNRoutes(self) -> int:
+        return len(self._table)
+
+    def RouteOutput(self, packet, header, oif=None):
+        dest = header.destination
+        if dest.IsBroadcast():
+            for i, iface in enumerate(self.ipv4.interfaces):
+                if iface.device is not None and iface.IsUp():
+                    route = Ipv4Route(
+                        destination=dest,
+                        source=self.ipv4.SelectSourceAddress(i),
+                        gateway=Ipv4Address.GetBroadcast(),
+                        output_device=iface.device,
+                    )
+                    route.if_index = i
+                    return route, 0
+            return None, 10
+        row = self._route_fresh(dest.addr)
+        if row is not None:
+            row[4] = self._lifetime()  # active traffic refreshes it
+            return self._route_from_row(dest, row), 0
+        if header.protocol == 0:
+            # a source-selection probe (udp SendTo builds a bare header
+            # to learn saddr): answer provisionally so the socket
+            # proceeds — the DATA send right after triggers the real
+            # queue-and-discover (the ns-3 deferred-route analog)
+            for i, iface in enumerate(self.ipv4.interfaces):
+                if iface.device is not None and iface.IsUp():
+                    route = Ipv4Route(
+                        destination=dest,
+                        source=self.ipv4.SelectSourceAddress(i),
+                        gateway=dest,
+                        output_device=iface.device,
+                    )
+                    route.if_index = i
+                    return route, 0
+            return None, 10
+        if header.source.IsAny() or header.source.addr in self._own:
+            # originating here: queue a copy + discover; the L3 caller
+            # drops its own copy (the queue owns delivery now)
+            self._queue_packet(packet.Copy(), header)
+            return None, 11  # ERROR_NOROUTETOHOST, packet queued
+        # forwarding miss: the path broke behind us — RERR to the source
+        self.send_rerr(dest, header.source)
+        return None, 10
+
+
+class AodvHelper:
+    def __init__(self, **attrs):
+        self._attrs = attrs
+
+    def Set(self, name: str, value) -> None:
+        self._attrs[name] = value
+
+    def Create(self, node) -> AodvRoutingProtocol:
+        return AodvRoutingProtocol(**self._attrs)

@@ -425,6 +425,10 @@ class Ipv4L3Protocol(Object):
         if route is None:
             route, errno = self._routing.RouteOutput(packet, header)
             if route is None:
+                if errno == 11:
+                    # deferred: a reactive protocol (AODV) queued a copy
+                    # and owns delivery — this is not a drop
+                    return
                 self.drop(header, packet, self.DROP_NO_ROUTE)
                 return
         if_index = getattr(route, "if_index", None)
