@@ -27,7 +27,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
-from tpudes.core.nstime import Time
+from tpudes.core.nstime import Seconds, Time
 from tpudes.core.object import Object, TypeId
 from tpudes.core.simulator import Simulator
 
@@ -331,6 +331,8 @@ class TrafficControlLayer(Object):
         if id(device) in self._qdiscs:
             raise RuntimeError("device already has a root queue disc")
         self._qdiscs[id(device)] = qdisc
+        # shaping discs (TBF) re-trigger the drain when credit returns
+        qdisc._wake = lambda _d=device: self._run(_d)
         self._dev_send[id(device)] = device.Send
         # every sender now funnels through the qdisc
         device.Send = (
@@ -371,13 +373,282 @@ class TrafficControlLayer(Object):
             raw_send(item.packet, item.dest, item.protocol)
 
 
+class FqCoDelQueueDisc(QueueDisc):
+    """FQ-CoDel (RFC 8290; fq-codel-queue-disc.{h,cc}): flows hashed
+    into their own CoDel queues, served by deficit round robin with
+    new-flow priority — a sparse flow never waits behind a bulk one."""
+
+    tid = (
+        TypeId("tpudes::FqCoDelQueueDisc")
+        .SetParent(QueueDisc.tid)
+        .AddConstructor(lambda **kw: FqCoDelQueueDisc(**kw))
+        .AddAttribute("Flows", "hash buckets", 1024, field="n_flows")
+        .AddAttribute("Quantum", "DRR quantum (bytes)", 1514, field="quantum")
+        .AddAttribute("Target", "per-flow CoDel target", Time(5_000_000),
+                      checker=Time)
+        .AddAttribute("Interval", "per-flow CoDel interval",
+                      Time(100_000_000), checker=Time)
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._flows: dict[int, CoDelQueueDisc] = {}
+        self._deficit: dict[int, int] = {}
+        self._new: list[int] = []
+        self._old: list[int] = []
+        self._npackets = 0   # O(1) total (the limit check is hot-path)
+
+    def _classify(self, item) -> int:
+        """5-tuple hash (fq-codel-queue-disc.cc's FlowIdHash)."""
+        from tpudes.models.internet.ipv4 import Ipv4Header
+        from tpudes.models.internet.tcp import TcpHeader
+        from tpudes.models.internet.udp import UdpHeader
+
+        ip = item.packet.FindHeader(Ipv4Header)
+        sport = dport = proto = 0
+        src = dst = 0
+        if ip is not None:
+            src, dst, proto = ip.source.addr, ip.destination.addr, ip.protocol
+            l4 = item.packet.FindHeader(UdpHeader) or item.packet.FindHeader(
+                TcpHeader
+            )
+            if l4 is not None:
+                sport, dport = l4.source_port, l4.destination_port
+        return hash((src, dst, proto, sport, dport)) % int(self.n_flows)
+
+    def _flow(self, fid: int) -> CoDelQueueDisc:
+        q = self._flows.get(fid)
+        if q is None:
+            q = CoDelQueueDisc(
+                MaxSize=self.max_packets, Target=self.target,
+                Interval=self.interval,
+            )
+            self._flows[fid] = q
+        return q
+
+    def GetNPackets(self) -> int:
+        return self._npackets
+
+    def GetNBytes(self) -> int:
+        return sum(q.GetNBytes() for q in self._flows.values())
+
+    def DoEnqueue(self, item) -> bool:
+        if self._npackets >= self.max_packets:
+            return False
+        fid = self._classify(item)
+        q = self._flow(fid)
+        if fid not in self._new and fid not in self._old:
+            self._new.append(fid)
+            self._deficit[fid] = int(self.quantum)
+        ok = q.DoEnqueue(item)
+        if ok:
+            self._npackets += 1
+        return ok
+
+    def DoDequeue(self):
+        while self._new or self._old:
+            lst = self._new if self._new else self._old
+            fid = lst[0]
+            q = self._flows.get(fid)
+            if q is None or q.GetNPackets() == 0:
+                # drained: a new flow becomes eligible as old next time
+                lst.pop(0)
+                if lst is self._new:
+                    self._old.append(fid)
+                continue
+            if self._deficit[fid] <= 0:
+                self._deficit[fid] += int(self.quantum)
+                lst.pop(0)
+                self._old.append(fid)
+                continue
+            before = q.GetNPackets()
+            item = q.Dequeue()          # per-flow CoDel law applies
+            self._npackets -= before - q.GetNPackets()
+            self.stats_dropped += q.stats_dropped
+            q.stats_dropped = 0
+            if item is None:
+                lst.pop(0)
+                if lst is self._new:
+                    self._old.append(fid)
+                continue
+            self._deficit[fid] -= item.GetSize()
+            return item
+        return None
+
+
+class PieQueueDisc(QueueDisc):
+    """PIE (RFC 8033; pie-queue-disc.{h,cc}): proportional-integral
+    controller steering the queue DELAY to a reference by random
+    enqueue-time drops; probability updated on a fixed timer from the
+    departure-rate-estimated delay."""
+
+    tid = (
+        TypeId("tpudes::PieQueueDisc")
+        .SetParent(QueueDisc.tid)
+        .AddConstructor(lambda **kw: PieQueueDisc(**kw))
+        .AddAttribute("QueueDelayReference", "target delay",
+                      Time(15_000_000), checker=Time, field="target")
+        .AddAttribute("Tupdate", "probability update period",
+                      Time(15_000_000), checker=Time, field="t_update")
+        .AddAttribute("A", "proportional gain", 0.125, field="a")
+        .AddAttribute("B", "integral gain", 1.25, field="b")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        from tpudes.core.rng import UniformRandomVariable
+
+        self._rng = UniformRandomVariable()
+        self._p = 0.0
+        self._qdelay_old = 0.0
+        self._depart_rate = 0.0     # bytes/s EWMA
+        self._last_dequeue_ts = None
+        self._timer_started = False
+        self.stats_early_drops = 0
+
+    def _qdelay(self) -> float:
+        if self._depart_rate <= 0.0:
+            return 0.0
+        return self.GetNBytes() / self._depart_rate
+
+    def _update_p(self):
+        qdelay = self._qdelay()
+        target = self.target.GetSeconds()
+        p = self._p + float(self.a) * (qdelay - target) + float(self.b) * (
+            qdelay - self._qdelay_old
+        )
+        # RFC 8033 §4.2 auto-tuning scale-down at small probabilities
+        if self._p < 0.000001:
+            p = self._p + (p - self._p) / 2048
+        elif self._p < 0.00001:
+            p = self._p + (p - self._p) / 512
+        elif self._p < 0.0001:
+            p = self._p + (p - self._p) / 128
+        elif self._p < 0.001:
+            p = self._p + (p - self._p) / 32
+        elif self._p < 0.01:
+            p = self._p + (p - self._p) / 8
+        elif self._p < 0.1:
+            p = self._p + (p - self._p) / 2
+        self._p = min(max(p, 0.0), 1.0)
+        if qdelay == 0.0 and self._qdelay_old == 0.0:
+            self._p *= 0.98          # decay when idle
+        self._qdelay_old = qdelay
+        if not self._items and self._p < 1e-9:
+            # idle and fully decayed: suspend (ns-3 PIE suspends its
+            # timer too) — otherwise the recurring event would keep
+            # Simulator.Run alive forever on event-queue exhaustion
+            self._timer_started = False
+            return
+        Simulator.Schedule(self.t_update, self._update_p)
+
+    def DoEnqueue(self, item) -> bool:
+        if not self._timer_started:
+            self._timer_started = True
+            Simulator.Schedule(self.t_update, self._update_p)
+        if len(self._items) >= self.max_packets:
+            return False
+        # RFC 8033 §4.1 safeguards: never drop when the queue is tiny
+        if (
+            self._p > 0.0
+            and self.GetNBytes() > 2 * item.GetSize()
+            and self._rng.GetValue() < self._p
+        ):
+            self.stats_early_drops += 1
+            return False
+        self._items.append(item)
+        return True
+
+    def DoDequeue(self):
+        if not self._items:
+            return None
+        item = self._items.pop(0)
+        now = Simulator.NowTicks()
+        if self._last_dequeue_ts is not None and now > self._last_dequeue_ts:
+            inst = item.GetSize() / ((now - self._last_dequeue_ts) / 1e9)
+            self._depart_rate = (
+                inst if self._depart_rate == 0.0
+                else 0.9 * self._depart_rate + 0.1 * inst
+            )
+        self._last_dequeue_ts = now
+        return item
+
+
+class TbfQueueDisc(QueueDisc):
+    """Token bucket filter (tbf-queue-disc.{h,cc}): shapes the dequeue
+    rate to Rate with Burst bytes of credit; when tokens run out the
+    head waits and the disc wakes the drain when credit accumulates."""
+
+    tid = (
+        TypeId("tpudes::TbfQueueDisc")
+        .SetParent(QueueDisc.tid)
+        .AddConstructor(lambda **kw: TbfQueueDisc(**kw))
+        .AddAttribute("Rate", "token rate", "1Mbps", field="rate_str")
+        .AddAttribute("Burst", "bucket size (bytes)", 32_000, field="burst")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        from tpudes.network.data_rate import DataRate
+
+        self._rate_bps = float(DataRate(self.rate_str).GetBitRate())
+        self._tokens = float(self.burst)
+        self._last_refill = 0
+        self._wake = None            # set by TrafficControlLayer
+        self._wake_pending = False
+
+    def _refill(self):
+        now = Simulator.NowTicks()
+        self._tokens = min(
+            float(self.burst),
+            self._tokens + (now - self._last_refill) / 1e9 * self._rate_bps / 8.0,
+        )
+        self._last_refill = now
+
+    def DoEnqueue(self, item) -> bool:
+        if len(self._items) >= self.max_packets:
+            return False
+        self._items.append(item)
+        return True
+
+    def DoDequeue(self):
+        if not self._items:
+            return None
+        self._refill()
+        head = self._items[0]
+        if head.GetSize() <= self._tokens:
+            self._tokens -= head.GetSize()
+            return self._items.pop(0)
+        # not enough credit: wake the drain when there will be.  The
+        # delay CEILs to >= 1 tick — round-to-nearest could leave the
+        # refill epsilon short of the head packet and respawn a 0-tick
+        # wake forever (livelock at e.g. Rate=3Mbps)
+        if not self._wake_pending and self._wake is not None:
+            self._wake_pending = True
+            deficit = head.GetSize() - self._tokens
+            ticks = max(1, int(math.ceil(deficit * 8.0 / self._rate_bps * 1e9)))
+
+            def wake():
+                self._wake_pending = False
+                self._wake()
+
+            Simulator.Schedule(Time(ticks), wake)
+        return None
+
+
 QUEUE_DISCS = {
     "tpudes::FifoQueueDisc": FifoQueueDisc,
     "tpudes::RedQueueDisc": RedQueueDisc,
     "tpudes::CoDelQueueDisc": CoDelQueueDisc,
+    "tpudes::FqCoDelQueueDisc": FqCoDelQueueDisc,
+    "tpudes::PieQueueDisc": PieQueueDisc,
+    "tpudes::TbfQueueDisc": TbfQueueDisc,
     "ns3::FifoQueueDisc": FifoQueueDisc,
     "ns3::RedQueueDisc": RedQueueDisc,
     "ns3::CoDelQueueDisc": CoDelQueueDisc,
+    "ns3::FqCoDelQueueDisc": FqCoDelQueueDisc,
+    "ns3::PieQueueDisc": PieQueueDisc,
+    "ns3::TbfQueueDisc": TbfQueueDisc,
 }
 
 
