@@ -4,7 +4,6 @@ RREQ flood dedup, RREP path setup, multihop data beyond radio range,
 queue-drain of the first packets, discovery failure drop, route expiry
 + re-discovery, and the structural contrast with proactive DSDV."""
 
-import pytest
 
 from tpudes.core import Seconds, Simulator
 from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper

@@ -8,7 +8,7 @@ from tpudes.core.command_line import CommandLine
 from tpudes.core.config import Config, Names
 from tpudes.core.global_value import GlobalValue
 from tpudes.core.object import Object, ObjectFactory, TypeId
-from tpudes.core.trace import TracedCallback, TracedValue
+from tpudes.core.trace import TracedValue
 
 
 class Gadget(Object):

@@ -2,10 +2,9 @@
 destroy, ScheduleWithContext — mirroring upstream simulator test suite
 behaviors (src/core/test/; SURVEY.md 4)."""
 
-import pytest
 
 from tpudes.core.global_value import GlobalValue
-from tpudes.core.nstime import MilliSeconds, Seconds, Time
+from tpudes.core.nstime import MilliSeconds, Seconds
 from tpudes.core.simulator import RealtimeSimulatorImpl, Simulator
 
 

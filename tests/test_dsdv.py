@@ -2,10 +2,9 @@
 an adhoc chain, multihop forwarding beyond radio range, sequence-number
 freshness, expiry of dead routes."""
 
-import pytest
 
 from tpudes.core import Seconds, Simulator
-from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+from tpudes.helper.containers import NodeContainer
 from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
 from tpudes.models.internet.dsdv import DsdvHelper, DsdvHeader, DsdvRoutingProtocol
 from tpudes.models.internet.ipv4 import Ipv4L3Protocol

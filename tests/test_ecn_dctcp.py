@@ -7,7 +7,7 @@ import pytest
 from tpudes.core import Seconds, Simulator
 from tpudes.models.internet.tcp import TcpL4Protocol
 from tpudes.models.internet.tcp_congestion import TcpDctcp, TcpSocketState
-from tpudes.models.traffic_control import RedQueueDisc, TrafficControlHelper
+from tpudes.models.traffic_control import TrafficControlHelper
 from tpudes.scenarios import build_dumbbell
 
 

@@ -8,7 +8,6 @@ Upstream analogs: src/lte/test/test-epc-tdd-dl.cc strategy +
 epc-gtpu-header.cc round-trip.
 """
 
-import math
 
 import pytest
 
@@ -17,7 +16,7 @@ from tpudes.helper.applications import UdpClientHelper, UdpServerHelper
 from tpudes.helper.containers import NodeContainer
 from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
 from tpudes.helper.point_to_point import PointToPointHelper
-from tpudes.models.internet.ipv4 import Ipv4L3Protocol, Ipv4StaticRouting
+from tpudes.models.internet.ipv4 import Ipv4L3Protocol
 from tpudes.models.lte import LteHelper
 from tpudes.models.lte.epc import EpcHelper
 from tpudes.models.mobility import ListPositionAllocator, MobilityHelper, Vector

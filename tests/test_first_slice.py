@@ -6,7 +6,6 @@ first.cc (2.00369s / 2.00737s): 1054 bytes (1024 payload + 8 UDP + 20
 IPv4 + 2 PPP) at 5 Mbps = 1.6864 ms serialization + 2 ms propagation.
 """
 
-import pytest
 
 from tpudes.core.nstime import MilliSeconds, Seconds
 from tpudes.core.simulator import Simulator

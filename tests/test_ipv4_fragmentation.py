@@ -7,7 +7,6 @@ forbids it; a lost fragment kills the datagram; a smaller second hop
 re-fragments.
 """
 
-import pytest
 
 from tpudes.core import Seconds, Simulator
 from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
@@ -15,7 +14,6 @@ from tpudes.helper.containers import NodeContainer
 from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
 from tpudes.helper.point_to_point import PointToPointHelper
 from tpudes.models.internet.ipv4 import Ipv4Header, Ipv4L3Protocol
-from tpudes.network.address import Ipv4Address
 
 
 def _reset():

@@ -7,11 +7,10 @@ on address algebra, then end-to-end exchanges over p2p (no ND), CSMA
 (real NS/NA resolution), and a forwarding chain with static routes.
 """
 
-import pytest
 
 from tpudes.core import Seconds, Simulator
 from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
-from tpudes.helper.containers import NetDeviceContainer, NodeContainer
+from tpudes.helper.containers import NodeContainer
 from tpudes.helper.internet import (
     InternetStackHelper,
     Ipv4AddressHelper,

@@ -6,7 +6,6 @@ canned topology, populate tables, assert end-to-end delivery through
 multi-hop forwarding.
 """
 
-import pytest
 
 from tpudes.core import Seconds, Simulator
 from tpudes.helper.applications import (
@@ -15,7 +14,6 @@ from tpudes.helper.applications import (
     UdpEchoClientHelper,
     UdpEchoServerHelper,
 )
-from tpudes.helper.containers import NodeContainer
 from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
 from tpudes.helper.layout import PointToPointDumbbellHelper
 from tpudes.helper.point_to_point import PointToPointHelper

@@ -17,12 +17,10 @@ import numpy as np
 import pytest
 
 from tpudes.ops.lte import (
-    BLER_TARGET_Q,
     CQI_EFFICIENCY,
     MCS_ECR,
     MCS_EFFICIENCY,
     MCS_QM,
-    SNR_GAP,
     cqi_from_sinr,
     cqi_from_sinr_py,
     mcs_from_cqi,

@@ -2,7 +2,6 @@
 confines each cell to its subband and lifts edge SINR/CQI."""
 
 import numpy as np
-import pytest
 
 from tpudes.core import Seconds, Simulator
 from tpudes.helper.containers import NodeContainer

@@ -5,12 +5,11 @@ lte-test-rlc-am-e2e.cc (AM delivers under loss), lte-test-handover-*
 (X2 handover moves a UE between cells without losing bearers).
 """
 
-import pytest
 
 from tpudes.core import MilliSeconds, Seconds, Simulator
 from tpudes.helper.containers import NodeContainer
 from tpudes.models.lte import LteHelper
-from tpudes.models.lte.rlc import LteRlcAm, LteRlcUm, RlcPdu, make_rlc
+from tpudes.models.lte.rlc import LteRlcAm, LteRlcUm, make_rlc
 from tpudes.models.mobility import (
     ConstantVelocityMobilityModel,
     ListPositionAllocator,

@@ -15,7 +15,6 @@ import pytest
 from tpudes.parallel.lte_sm import (
     LteSmProgram,
     UnliftableLteScenarioError,
-    build_sm_step,
     lower_lte_sm,
     run_lte_sm,
 )

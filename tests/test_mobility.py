@@ -1,7 +1,6 @@
 """Mobility model tests — mirrors upstream's mobility test suite style:
 closed-form kinematics checks, bounds containment, trace firing."""
 
-import math
 
 import pytest
 

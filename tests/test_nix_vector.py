@@ -6,7 +6,6 @@ per source (VERDICT r4 #8's 'faster than global SPF repair' pin)."""
 
 import time
 
-import pytest
 
 from tpudes.core import Seconds, Simulator
 from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
@@ -19,7 +18,6 @@ from tpudes.models.internet.nix_vector import (
     Ipv4NixVectorRouting,
     NixVector,
 )
-from tpudes.network.address import Ipv4Address
 
 
 def _reset():

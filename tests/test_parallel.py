@@ -7,12 +7,9 @@ trace equivalence is the determinism oracle."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tpudes.core import GlobalValue, Seconds, Simulator
 from tpudes.parallel import (
-    JaxSimulatorImpl,
-    WindowParams,
     make_replica_batch,
     replica_mesh,
     shard_leading_axis,

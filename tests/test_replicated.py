@@ -8,16 +8,13 @@ object graph.  Delivery-count distributions must agree.
 """
 
 import math
-import sys
 
 import jax
 import numpy as np
 import pytest
 
-from tpudes.core import CommandLine, Seconds, Simulator
-from tpudes.core.global_value import GlobalValue
+from tpudes.core import Seconds, Simulator
 from tpudes.core.rng import RngSeedManager
-from tpudes.core.config import Names
 from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
 from tpudes.helper.containers import NetDeviceContainer, NodeContainer
 from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
@@ -28,12 +25,7 @@ from tpudes.models.wifi import (
     YansWifiChannelHelper,
     YansWifiPhyHelper,
 )
-from tpudes.parallel.replicated import (
-    BssProgram,
-    INF,
-    lower_bss,
-    run_replicated_bss,
-)
+from tpudes.parallel.replicated import lower_bss, run_replicated_bss
 
 N_STAS = 5
 SIM_TIME = 1.8

@@ -21,7 +21,6 @@ from tpudes.models.antenna import (
 )
 from tpudes.models.buildings import (
     Building,
-    BuildingList,
     BuildingsPropagationLossModel,
     batch_wall_crossings,
 )

@@ -5,7 +5,6 @@ model conversion), wifi-phy-interference tests, and the LTE/WiFi
 coexistence examples that motivate the multi-model channel.
 """
 
-import math
 
 import pytest
 

@@ -3,7 +3,6 @@
 Upstream analogs: tcp-sack-* test suites (multi-hole recovery in one
 RTT) and tcp-wscaling tests (throughput beyond 64 KiB/RTT)."""
 
-import pytest
 
 from tpudes.core import Seconds, Simulator
 from tpudes.helper.applications import BulkSendHelper, PacketSinkHelper

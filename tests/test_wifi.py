@@ -10,22 +10,17 @@ import pytest
 from tpudes.core import Seconds, Simulator
 from tpudes.helper.containers import NodeContainer
 from tpudes.models.mobility import (
-    ConstantPositionMobilityModel,
     ListPositionAllocator,
     MobilityHelper,
     Vector,
 )
 from tpudes.models.wifi import (
-    AdhocWifiMac,
-    ApWifiMac,
-    StaWifiMac,
     WifiHelper,
     WifiMacHelper,
     YansWifiChannelHelper,
     YansWifiPhyHelper,
     ppdu_duration_s,
 )
-from tpudes.network.node import Node
 from tpudes.network.packet import Packet
 from tpudes.ops.wifi_error import MODES_BY_NAME
 

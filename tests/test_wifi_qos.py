@@ -1,7 +1,6 @@
 """EDCA/QoS tests — upstream wifi-ac-mapping + EDCA parameter tests:
 TOS classification, per-AC parameters, and priority under saturation."""
 
-import pytest
 
 from tpudes.core import Seconds, Simulator
 from tpudes.helper.applications import UdpClientHelper, UdpServerHelper

@@ -10,7 +10,6 @@ contain the delimiter.
 from __future__ import annotations
 
 import csv as _csv
-import io
 
 
 class CsvReader:

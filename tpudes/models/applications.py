@@ -15,7 +15,7 @@ from tpudes.network.application import Application
 from tpudes.network.data_rate import DataRate
 from tpudes.network.packet import Packet
 from tpudes.network.socket import SocketFactory
-from tpudes.core.rng import ConstantRandomVariable, ExponentialRandomVariable
+from tpudes.core.rng import ConstantRandomVariable
 
 
 class UdpEchoServer(Application):

@@ -17,7 +17,7 @@ plus tx-without-rx at report time.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tpudes.core.nstime import Time
 from tpudes.core.simulator import Simulator

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import heapq
 
-from tpudes.core.object import Object, TypeId
+from tpudes.core.object import TypeId
 from tpudes.models.internet.ipv4 import (
     Ipv4L3Protocol,
     Ipv4Route,

@@ -27,7 +27,7 @@ from tpudes.models.internet.tcp_congestion import (
     TcpSocketState,
 )
 from tpudes.models.internet.udp import Ipv4EndPointDemux
-from tpudes.network.address import InetSocketAddress, Ipv4Address
+from tpudes.network.address import InetSocketAddress
 from tpudes.network.packet import Header, Packet
 from tpudes.network.socket import Socket
 

@@ -11,7 +11,6 @@ import struct
 from collections import deque
 
 from tpudes.core.object import TypeId
-from tpudes.core.simulator import Simulator
 from tpudes.network.address import (
     Inet6SocketAddress,
     InetSocketAddress,

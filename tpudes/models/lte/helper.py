@@ -25,7 +25,7 @@ import numpy as np
 from tpudes.helper.containers import NetDeviceContainer
 from tpudes.models.lte.controller import LteTtiController
 from tpudes.models.lte.device import LteEnbNetDevice, LteUeNetDevice
-from tpudes.models.lte.scheduler import SCHEDULERS, RrFfMacScheduler
+from tpudes.models.lte.scheduler import SCHEDULERS
 from tpudes.models.propagation import FriisPropagationLossModel
 from tpudes.ops.lte import RB_BANDWIDTH_HZ
 

@@ -27,7 +27,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
-from tpudes.core.nstime import Seconds, Time
+from tpudes.core.nstime import Time
 from tpudes.core.object import Object, TypeId
 from tpudes.core.simulator import Simulator
 

@@ -22,7 +22,6 @@ from tpudes.core.nstime import Seconds, Time
 from tpudes.core.object import Object, TypeId
 from tpudes.core.rng import UniformRandomVariable
 from tpudes.ops.wifi_error import (
-    MODES_BY_NAME,
     WifiMode,
     chunk_success_rate_py,
     table_chunk_success_rate_py,

@@ -11,7 +11,6 @@ outcomes and rx SNRs through the ``report_*`` hooks.
 
 from __future__ import annotations
 
-import math
 
 from tpudes.core.object import Object, TypeId
 from tpudes.core.rng import UniformRandomVariable

@@ -25,7 +25,7 @@ from tpudes.models.spectrum import (
     SpectrumSignalParameters,
     SpectrumValue,
 )
-from tpudes.models.wifi.phy import WifiMode, YansWifiPhy, ppdu_duration_s
+from tpudes.models.wifi.phy import WifiMode, YansWifiPhy
 
 
 def wifi_spectrum_model(center_hz: float, width_mhz: int,

@@ -23,7 +23,7 @@ per-event oracle; tests compare the two at matched scenarios.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpudes.parallel.kernels import WindowParams, wifi_phy_window
+from tpudes.parallel.kernels import WindowParams
 
 
 def replica_mesh(n_devices: int | None = None, axis: str = "replica") -> Mesh:
