@@ -51,7 +51,7 @@ def main(argv=None):
     cmd.AddValue("nEnbs", "number of eNBs (hex grid)", 7)
     cmd.AddValue("uesPerCell", "UEs dropped per cell", 30)
     cmd.AddValue("simTime", "simulated seconds", 0.5)
-    cmd.AddValue("scheduler", "pf | rr", "pf")
+    cmd.AddValue("scheduler", "pf | rr | tdmt | fdmt | tta | tdbet | fdbet | cqa | pss", "pf")
     cmd.AddValue("interSite", "inter-site distance (m)", 500.0)
     cmd.AddValue("ffr", "hard frequency reuse-3 (lena-dual-stripe idiom)", False)
     cmd.Parse(argv)
@@ -60,9 +60,9 @@ def main(argv=None):
     sim_time = float(cmd.simTime)
 
     lte = LteHelper()
-    lte.SetSchedulerType(
-        "tpudes::PfFfMacScheduler" if cmd.scheduler == "pf" else "tpudes::RrFfMacScheduler"
-    )
+    from tpudes.models.lte.scheduler import resolve_scheduler
+
+    lte.SetSchedulerType(resolve_scheduler(str(cmd.scheduler)))
     if cmd.GetValue("ffr"):
         lte.SetFfrAlgorithmType("tpudes::LteFrHardAlgorithm")
 

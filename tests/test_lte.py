@@ -470,10 +470,10 @@ def _build_lena(n_enbs, ues_per_cell, scheduler="pf", bearer_mode="sm",
         Vector,
     )
 
+    from tpudes.models.lte.scheduler import resolve_scheduler
+
     lte = LteHelper()
-    lte.SetSchedulerType(
-        "tpudes::PfFfMacScheduler" if scheduler == "pf" else "tpudes::RrFfMacScheduler"
-    )
+    lte.SetSchedulerType(resolve_scheduler(scheduler))
     enbs = NodeContainer()
     enbs.Create(n_enbs)
     ues = NodeContainer()

@@ -1,7 +1,8 @@
-"""FF-MAC schedulers (PF, RR) + HARQ bookkeeping.
+"""FF-MAC schedulers (the full upstream family) + HARQ bookkeeping.
 
 Reference parity: src/lte/model/ff-mac-scheduler.h (the FemtoForum
-scheduler API), pf-ff-mac-scheduler.{h,cc}, rr-ff-mac-scheduler.{h,cc},
+scheduler API) and the per-algorithm implementations
+{pf,rr,tdmt,fdmt,tta,tdbet,fdbet,cqa,pss}-ff-mac-scheduler.{h,cc},
 lte-harq-phy.{h,cc} (upstream paths; mount empty at survey — SURVEY.md
 §0, §2.6 "MAC + FF-MAC scheduler API" and "HARQ" rows).
 
@@ -39,12 +40,17 @@ def rbg_size_for(n_rb: int) -> int:
 
 @dataclass
 class SchedCandidate:
-    """Per-flow scheduler input (the FF-MAC SchedDlTriggerReq view)."""
+    """Per-flow scheduler input (the FF-MAC SchedDlTriggerReq view).
+
+    ``hol_delay_ms`` (CQA) and ``tbr_bps`` (PSS) default to 0 — the
+    schedulers degrade gracefully when the caller has no QoS state."""
 
     rnti: int
     cqi: int
     queue_bytes: int
     avg_thr_bps: float = 1.0
+    hol_delay_ms: float = 0.0
+    tbr_bps: float = 0.0
 
 
 @dataclass
@@ -133,32 +139,165 @@ class RrFfMacScheduler(FfMacScheduler):
         return allocs
 
 
-class PfFfMacScheduler(FfMacScheduler):
-    """Proportional fair (pf-ff-mac-scheduler.cc): metric = achievable
-    rate / exponentially-averaged served throughput."""
-
-    name = "pf"
+class _ThroughputEma:
+    """Shared served-throughput EMA (the classic PF average): T ←
+    (1−α)T + α·r per TTI, r = 0 for unserved flows — one implementation
+    for every scheduler that consumes a past-throughput term."""
 
     def __init__(self, alpha: float = 0.05):
         self.alpha = alpha
         self._avg: dict[int, float] = {}
 
-    def schedule(self, tti, candidates, free_rbgs, rbg_size):
-        def metric(c: SchedCandidate) -> float:
-            mcs = mcs_from_cqi_py(c.cqi)
-            rate = tbs_bits_py(mcs, rbg_size) * 1000.0  # bits/s if served
-            return rate / max(self._avg.get(c.rnti, 1.0), 1.0)
-
-        order = sorted(candidates, key=metric, reverse=True)
-        return self._fill(order, free_rbgs, rbg_size)
+    def avg(self, rnti: int) -> float:
+        return max(self._avg.get(rnti, 1.0), 1.0)
 
     def end_tti(self, served_bits: dict[int, int], active_rntis) -> None:
-        """EMA update for every active flow: T ← (1−α)T + α·r, with r=0
-        for flows not served this TTI (the classic PF average)."""
         for rnti in active_rntis:
             old = self._avg.get(rnti, 1.0)
             r = served_bits.get(rnti, 0) * 1000.0  # bits/s at 1 ms TTIs
             self._avg[rnti] = (1.0 - self.alpha) * old + self.alpha * r
+
+
+class PfFfMacScheduler(_ThroughputEma, FfMacScheduler):
+    """Proportional fair (pf-ff-mac-scheduler.cc): metric = achievable
+    rate / exponentially-averaged served throughput."""
+
+    name = "pf"
+
+    def schedule(self, tti, candidates, free_rbgs, rbg_size):
+        order = sorted(
+            candidates,
+            key=lambda c: _rate_bps(c, rbg_size) / self.avg(c.rnti),
+            reverse=True,
+        )
+        return self._fill(order, free_rbgs, rbg_size)
+
+
+
+
+def _rate_bps(c: SchedCandidate, rbg_size: int) -> float:
+    """Achievable rate per RBG at the candidate's wideband CQI."""
+    return tbs_bits_py(mcs_from_cqi_py(c.cqi), rbg_size) * 1000.0
+
+
+class TdMtFfMacScheduler(FfMacScheduler):
+    """Time-domain max throughput (tdmt-ff-mac-scheduler.cc): ONE UE —
+    the one with the highest achievable rate — owns the whole TTI."""
+
+    name = "tdmt"
+
+    def schedule(self, tti, candidates, free_rbgs, rbg_size):
+        live = [c for c in candidates if c.cqi >= 1 and c.queue_bytes > 0]
+        if not live:
+            return []
+        best = max(live, key=lambda c: (_rate_bps(c, rbg_size), -c.rnti))
+        return self._fill([best], free_rbgs, rbg_size)
+
+
+class FdMtFfMacScheduler(FfMacScheduler):
+    """Frequency-domain max throughput (fdmt-ff-mac-scheduler.cc): RBGs
+    go to the highest-rate UE first; leftovers cascade down the rate
+    order (at wideband-CQI fidelity the per-RBG argmax is flat, so the
+    cascade IS the per-RBG rule)."""
+
+    name = "fdmt"
+
+    def schedule(self, tti, candidates, free_rbgs, rbg_size):
+        order = sorted(
+            candidates, key=lambda c: (_rate_bps(c, rbg_size), -c.rnti),
+            reverse=True,
+        )
+        return self._fill(order, free_rbgs, rbg_size)
+
+
+class TtaFfMacScheduler(RrFfMacScheduler):
+    """Throughput-to-average (tta-ff-mac-scheduler.cc): metric = subband
+    rate / wideband rate.  With wideband CQI the ratio is identically 1
+    for every UE (documented fidelity limit), so the scheduler reduces
+    exactly to RR rotation over live flows — inherited rather than
+    re-implemented; subband CQI would give the metric teeth."""
+
+    name = "tta"
+
+
+class _BetMixin(_ThroughputEma):
+    """Blind equal throughput: metric = 1 / past served throughput —
+    no channel term, so unequal-CQI UEs converge to equal BITS (where
+    RR converges to equal airtime)."""
+
+    def _metric(self, c: SchedCandidate) -> float:
+        return 1.0 / self.avg(c.rnti)
+
+
+class TdBetFfMacScheduler(_BetMixin, FfMacScheduler):
+    """Time-domain BET (tdbet-ff-mac-scheduler.cc): the UE with the
+    lowest past throughput owns the whole TTI."""
+
+    name = "tdbet"
+
+    def schedule(self, tti, candidates, free_rbgs, rbg_size):
+        live = [c for c in candidates if c.cqi >= 1 and c.queue_bytes > 0]
+        if not live:
+            return []
+        best = max(live, key=lambda c: (self._metric(c), -c.rnti))
+        return self._fill([best], free_rbgs, rbg_size)
+
+
+class FdBetFfMacScheduler(_BetMixin, FfMacScheduler):
+    """Frequency-domain BET (fdbet-ff-mac-scheduler.cc): fill in order
+    of lowest past throughput."""
+
+    name = "fdbet"
+
+    def schedule(self, tti, candidates, free_rbgs, rbg_size):
+        order = sorted(
+            candidates, key=lambda c: (self._metric(c), -c.rnti),
+            reverse=True,
+        )
+        return self._fill(order, free_rbgs, rbg_size)
+
+
+class CqaFfMacScheduler(_ThroughputEma, FfMacScheduler):
+    """Channel-and-QoS-aware (cqa-ff-mac-scheduler.cc, simplified to
+    the candidate fields available): flows are grouped by head-of-line
+    delay (larger = more urgent) and served PF-style inside a group —
+    upstream's d_HOL grouping with its per-group channel metric."""
+
+    name = "cqa"
+
+    def __init__(self, alpha: float = 0.05, group_ms: float = 10.0):
+        super().__init__(alpha)
+        self.group_ms = group_ms
+
+    def schedule(self, tti, candidates, free_rbgs, rbg_size):
+        def key(c: SchedCandidate):
+            group = int(c.hol_delay_ms // self.group_ms)
+            pf = _rate_bps(c, rbg_size) / self.avg(c.rnti)
+            return (group, pf)
+
+        order = sorted(candidates, key=key, reverse=True)
+        return self._fill(order, free_rbgs, rbg_size)
+
+
+class PssFfMacScheduler(_ThroughputEma, FfMacScheduler):
+    """Priority set scheduler (pss-ff-mac-scheduler.cc): flows whose
+    served throughput sits below their target bit rate form the
+    priority set (served first, most-starved first); the rest share
+    PF-style."""
+
+    name = "pss"
+
+    def schedule(self, tti, candidates, free_rbgs, rbg_size):
+        prio, rest = [], []
+        for c in candidates:  # single pass, no identity games
+            (prio if c.tbr_bps > 0 and self.avg(c.rnti) < c.tbr_bps
+             else rest).append(c)
+        prio.sort(key=lambda c: self.avg(c.rnti) / max(c.tbr_bps, 1.0))
+        rest.sort(
+            key=lambda c: _rate_bps(c, rbg_size) / self.avg(c.rnti),
+            reverse=True,
+        )
+        return self._fill(prio + rest, free_rbgs, rbg_size)
 
 
 SCHEDULERS = {
@@ -167,3 +306,25 @@ SCHEDULERS = {
     "tpudes::RrFfMacScheduler": RrFfMacScheduler,
     "ns3::RrFfMacScheduler": RrFfMacScheduler,
 }
+for _cls in (TdMtFfMacScheduler, FdMtFfMacScheduler, TtaFfMacScheduler,
+             TdBetFfMacScheduler, FdBetFfMacScheduler, CqaFfMacScheduler,
+             PssFfMacScheduler):
+    _name = _cls.__name__
+    SCHEDULERS[f"tpudes::{_name}"] = _cls
+    SCHEDULERS[f"ns3::{_name}"] = _cls
+
+
+def resolve_scheduler(name: str) -> str:
+    """Short name ('pf', 'tdbet', ...) or full TypeId → the canonical
+    TypeId string SetSchedulerType accepts; raises with the valid list."""
+    if name in SCHEDULERS:
+        return name
+    by_short = {
+        cls.name: f"tpudes::{cls.__name__}" for cls in set(SCHEDULERS.values())
+    }
+    if name in by_short:
+        return by_short[name]
+    raise ValueError(
+        f"unknown scheduler {name!r}; valid: {sorted(by_short)} "
+        "or any full TypeId"
+    )

@@ -108,7 +108,18 @@ def lower_lte_sm(helper, sim_time_s: float) -> LteSmProgram:
     sched_types = {type(enb.scheduler).__name__ for enb in ctrl.enbs}
     if len(sched_types) > 1:
         raise UnliftableLteScenarioError(f"mixed schedulers {sched_types}")
-    sched = "pf" if "Pf" in sched_types.pop() else "rr"
+    sched_name = sched_types.pop()
+    if sched_name == "PfFfMacScheduler":
+        sched = "pf"
+    elif sched_name == "RrFfMacScheduler":
+        sched = "rr"
+    else:
+        # never lower TDMT/BET/CQA/... to something else silently — the
+        # host controller runs them exactly (the round-2 rule)
+        raise UnliftableLteScenarioError(
+            f"SM engine implements pf/rr only (got {sched_name}); "
+            "run the host controller for the other algorithms"
+        )
 
     for dev in ctrl.enbs + ctrl.ues:
         mob = dev.GetNode().GetObject(MobilityModel)

@@ -282,12 +282,10 @@ def build_lena(
         Vector,
     )
 
+    from tpudes.models.lte.scheduler import resolve_scheduler
+
     lte = LteHelper()
-    lte.SetSchedulerType(
-        "tpudes::PfFfMacScheduler"
-        if scheduler == "pf"
-        else "tpudes::RrFfMacScheduler"
-    )
+    lte.SetSchedulerType(resolve_scheduler(scheduler))
     enb_nodes = NodeContainer()
     enb_nodes.Create(n_enbs)
     ue_nodes = NodeContainer()
