@@ -1,0 +1,205 @@
+"""internet-apps: DHCP and (next) Radvd/SLAAC — upstream
+src/internet-apps/test strategy: the handshake configures real
+interfaces that real traffic then uses."""
+
+from tpudes.core import Seconds, Simulator
+from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+from tpudes.helper.containers import NodeContainer
+from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+from tpudes.models.csma import CsmaHelper
+from tpudes.models.internet.dhcp import DhcpHeader, DhcpHelper
+from tpudes.network.address import Ipv4Address
+
+
+def _reset():
+    from tpudes.core.world import reset_world
+
+    reset_world()
+
+
+def _lan(n_clients=3):
+    nodes = NodeContainer()
+    nodes.Create(n_clients + 1)  # node 0 = DHCP server
+    csma = CsmaHelper()
+    csma.SetChannelAttribute("DataRate", "100Mbps")
+    csma.SetChannelAttribute("Delay", "6560ns")
+    devices = csma.Install(nodes)
+    InternetStackHelper().Install(nodes)
+    # only the server is statically configured
+    a = Ipv4AddressHelper("10.0.0.0", "255.255.255.0")
+    a.Assign([devices.Get(0)])
+    helper = DhcpHelper()
+    server = helper.InstallDhcpServer(
+        nodes.Get(0), PoolAddresses="10.0.0.10", LeaseTime=4.0
+    )
+    server.SetStartTime(Seconds(0.0))
+    clients = helper.InstallDhcpClient(
+        [nodes.Get(i) for i in range(1, n_clients + 1)]
+    )
+    for i, c in enumerate(clients):
+        c.SetStartTime(Seconds(0.1 + 0.05 * i))
+    return nodes, devices, server, clients
+
+
+def test_dhcp_handshake_assigns_distinct_pool_addresses():
+    _reset()
+    nodes, devices, server, clients = _lan(3)
+    Simulator.Stop(Seconds(2.0))
+    Simulator.Run()
+    addrs = [c.address for c in clients]
+    assert all(a is not None for a in addrs), addrs
+    assert len({a.addr for a in addrs}) == 3
+    pool = {Ipv4Address(f"10.0.0.{10 + i}").addr for i in range(3)}
+    assert {a.addr for a in addrs} == pool
+    _reset()
+
+
+def test_dhcp_configured_address_carries_real_traffic():
+    _reset()
+    nodes, devices, server, clients = _lan(2)
+    srv_rx = [0]
+    echo = UdpEchoServerHelper(9)
+    sapps = echo.Install(nodes.Get(0))
+    sapps.Start(Seconds(0.0))
+    sapps.Get(0).TraceConnectWithoutContext(
+        "Rx", lambda pkt, *a: srv_rx.__setitem__(0, srv_rx[0] + 1)
+    )
+    client = UdpEchoClientHelper(Ipv4Address("10.0.0.1"), 9)
+    client.SetAttribute("MaxPackets", 3)
+    client.SetAttribute("Interval", Seconds(0.1))
+    capps = client.Install(nodes.Get(1))
+    capps.Start(Seconds(1.0))  # after the lease
+    Simulator.Stop(Seconds(2.0))
+    Simulator.Run()
+    assert srv_rx[0] == 3
+    assert capps.Get(0).received == 3
+    _reset()
+
+
+def test_dhcp_lease_renews_at_half_lease():
+    _reset()
+    nodes, devices, server, clients = _lan(1)
+    leases = []
+    clients[0].TraceConnectWithoutContext(
+        "NewLease", lambda addr: leases.append(Simulator.Now().GetSeconds())
+    )
+    Simulator.Stop(Seconds(7.0))
+    Simulator.Run()
+    # initial lease + at least two T1 (= 2 s) renewals, same address
+    assert len(leases) >= 3, leases
+    assert clients[0].address == Ipv4Address("10.0.0.10")
+    _reset()
+
+
+def test_dhcp_header_roundtrip():
+    from tpudes.network.address import Ipv4Mask, Mac48Address
+
+    h = DhcpHeader(
+        DhcpHeader.ACK, xid=7, yiaddr=Ipv4Address("10.0.0.42"),
+        chaddr=Mac48Address("00:11:22:33:44:55"),
+        server_id=Ipv4Address("10.0.0.1"),
+        mask=Ipv4Mask("255.255.255.0"),
+        gateway=Ipv4Address("10.0.0.1"), lease_s=30,
+    )
+    raw = h.Serialize()
+    assert len(raw) == h.GetSerializedSize() == 36
+    h2, n = DhcpHeader.Deserialize(raw)
+    assert n == 36 and h2.msg_type == DhcpHeader.ACK and h2.xid == 7
+    assert h2.yiaddr == h.yiaddr and h2.chaddr == h.chaddr
+    assert h2.mask.mask == h.mask.mask and h2.lease_s == 30
+
+
+# --- Radvd + SLAAC ---------------------------------------------------------
+
+def test_radvd_slaac_autoconfigures_and_routes():
+    """host --csma-- router --p2p-- remote: the host starts with only a
+    link-local address; the router's RA gives it an EUI-64 global
+    address under the advertised prefix AND a default route good enough
+    to ping the remote's off-link address (RFC 4862 + 4861)."""
+    from tpudes.helper.internet import Ipv6AddressHelper
+    from tpudes.helper.point_to_point import PointToPointHelper
+    from tpudes.models.internet.icmpv6 import (
+        Icmpv6L4Protocol,
+        Ping6,
+        RadvdApplication,
+    )
+    from tpudes.models.internet.ipv6 import (
+        Ipv6InterfaceAddress,
+        Ipv6L3Protocol,
+        Ipv6StaticRouting,
+    )
+    from tpudes.network.address import Ipv6Address, Ipv6Prefix
+
+    _reset()
+    nodes = NodeContainer()
+    nodes.Create(3)  # 0 host, 1 router, 2 remote
+    csma = CsmaHelper()
+    lan = csma.Install([nodes.Get(0), nodes.Get(1)])
+    p2p = PointToPointHelper()
+    wan = p2p.Install(nodes.Get(1), nodes.Get(2))
+    InternetStackHelper().Install(nodes)
+
+    a = Ipv6AddressHelper()
+    a.SetBase("2001:db8:99::", 64)
+    wan_ifc = a.Assign(wan)
+    # router's LAN-side global address (the prefix it will advertise)
+    r6 = nodes.Get(1).GetObject(Ipv6L3Protocol)
+    r_lan_if = r6.AddInterface(lan.Get(1))
+    r6.AddAddress(
+        r_lan_if,
+        Ipv6InterfaceAddress(Ipv6Address("2001:db8:50::1"), Ipv6Prefix(64)),
+    )
+    r6.GetRoutingProtocol().AddNetworkRouteTo(
+        Ipv6Address("2001:db8:50::"), Ipv6Prefix(64), r_lan_if
+    )
+    # remote's route back to the LAN via the router
+    nodes.Get(2).GetObject(Ipv6L3Protocol).GetRoutingProtocol(
+    ).SetDefaultRoute(wan_ifc.GetAddress(0, 1), 1)
+    # the host only registers its v6 interface (no address assigned)
+    h6 = nodes.Get(0).GetObject(Ipv6L3Protocol)
+    h6.AddInterface(lan.Get(0))
+
+    radvd = RadvdApplication(Interval=0.5)
+    radvd.AddConfiguration(lan.Get(1), "2001:db8:50::", 64)
+    nodes.Get(1).AddApplication(radvd)
+    radvd.SetStartTime(Seconds(0.1))
+
+    autoconf = []
+    nodes.Get(0).GetObject(Icmpv6L4Protocol).TraceConnectWithoutContext(
+        "Autoconf", lambda addr: autoconf.append(addr)
+    )
+
+    ping = Ping6(Remote=str(wan_ifc.GetAddress(1, 1)), Interval=0.2)
+    nodes.Get(0).AddApplication(ping)
+    ping.SetStartTime(Seconds(1.0))  # after the first RA
+    ping.SetStopTime(Seconds(2.0))
+    Simulator.Stop(Seconds(2.5))
+    Simulator.Run()
+
+    assert len(autoconf) == 1  # one SLAAC event, not one per RA
+    expected = Ipv6Address.MakeAutoconfiguredAddress(
+        lan.Get(0).GetAddress(), Ipv6Address("2001:db8:50::")
+    )
+    assert autoconf[0] == expected
+    iface = h6.GetInterface(h6.GetInterfaceForDevice(lan.Get(0)))
+    assert any(a.GetLocal() == expected for a in iface.addresses)
+    assert len(ping.rtts) >= 4, ping.rtts
+    _reset()
+
+
+def test_dhcp_lease_expires_when_server_dies():
+    """r5 review: the Expiry trace must actually fire — stop the server
+    and the client loses its lease at the deadline, then restarts
+    discovery."""
+    _reset()
+    nodes, devices, server, clients = _lan(1)
+    expiries = []
+    clients[0].TraceConnectWithoutContext(
+        "Expiry", lambda *a: expiries.append(Simulator.Now().GetSeconds())
+    )
+    server.SetStopTime(Seconds(1.0))  # lease is 4 s: renewals go dark
+    Simulator.Stop(Seconds(8.0))
+    Simulator.Run()
+    assert expiries, "no expiry despite a dead server"
+    assert expiries[0] >= 4.0
+    _reset()

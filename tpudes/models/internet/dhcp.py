@@ -1,0 +1,328 @@
+"""DHCP: dynamic IPv4 configuration (DISCOVER/OFFER/REQUEST/ACK).
+
+Reference parity: src/internet-apps/model/dhcp-{server,client,header}
+.{h,cc} + helper (upstream paths; mount empty at survey — SURVEY.md §0,
+§2.7 internet-apps row).
+
+The handshake runs over UDP 67/68 as upstream: clients RECEIVE through
+a normal bound socket (the L3 layer delivers limited-broadcast frames
+to the stack even on an unconfigured interface), but TRANSMIT by
+crafting the IP/UDP headers onto the device directly — before the ACK
+there is no source address to route from, the same reason upstream's
+client opens a packet-level socket.  On ACK the client configures the
+interface (address, mask, default route via the server-supplied
+gateway) and re-REQUESTs at half the lease time."""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import Seconds
+from tpudes.core.object import TypeId
+from tpudes.core.simulator import Simulator
+from tpudes.models.internet.ipv4 import (
+    Ipv4Header,
+    Ipv4InterfaceAddress,
+    Ipv4L3Protocol,
+    Ipv4StaticRouting,
+)
+from tpudes.models.internet.udp import UdpHeader, UdpL4Protocol
+from tpudes.network.address import (
+    InetSocketAddress,
+    Ipv4Address,
+    Ipv4Mask,
+    Mac48Address,
+)
+from tpudes.network.application import Application
+from tpudes.network.packet import Header, Packet
+
+SERVER_PORT = 67
+CLIENT_PORT = 68
+
+
+class DhcpHeader(Header):
+    DISCOVER = 1
+    OFFER = 2
+    REQUEST = 3
+    ACK = 5
+
+    def __init__(self, msg_type=1, xid=0, yiaddr=None, chaddr=None,
+                 server_id=None, mask=None, gateway=None, lease_s=0):
+        self.msg_type = msg_type
+        self.xid = xid
+        self.yiaddr = yiaddr or Ipv4Address()
+        self.chaddr = chaddr or Mac48Address()
+        self.server_id = server_id or Ipv4Address()
+        self.mask = mask or Ipv4Mask("255.255.255.0")
+        self.gateway = gateway or Ipv4Address()
+        self.lease_s = lease_s
+
+    def GetSerializedSize(self) -> int:
+        return 36
+
+    def Serialize(self) -> bytes:
+        return struct.pack(
+            "!BxHI6s2xIIIII",
+            self.msg_type, 0, self.xid, self.chaddr.to_bytes(),
+            self.yiaddr.addr, self.server_id.addr, self.mask.mask,
+            self.gateway.addr, self.lease_s,
+        )
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        t, _x, xid, mac, yi, sid, mask, gw, lease = struct.unpack(
+            "!BxHI6s2xIIIII", data[:36]
+        )
+        return cls(t, xid, Ipv4Address(yi), Mac48Address.from_bytes(mac),
+                   Ipv4Address(sid), Ipv4Mask(mask), Ipv4Address(gw), lease), 36
+
+
+def _bcast_send(device, sport: int, dport: int, packet: Packet) -> None:
+    """Pre-configuration transmit: hand-built UDP/IP headers straight
+    onto the device (src 0.0.0.0, dst 255.255.255.255)."""
+    packet.AddHeader(UdpHeader(sport, dport, packet.GetSize()))
+    packet.AddHeader(
+        Ipv4Header(
+            source=Ipv4Address.GetAny(),
+            destination=Ipv4Address.GetBroadcast(),
+            protocol=UdpL4Protocol.PROT_NUMBER,
+            payload_size=packet.GetSize(),
+        )
+    )
+    device.Send(packet, device.GetBroadcast(), Ipv4L3Protocol.PROT_NUMBER)
+
+
+class DhcpServer(Application):
+    """Lease pool over one subnet (dhcp-server.cc)."""
+
+    tid = (
+        TypeId("tpudes::DhcpServer")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: DhcpServer(**kw))
+        .AddAttribute("PoolAddresses", "first leasable address",
+                      "10.0.0.10", field="pool_first")
+        .AddAttribute("PoolMask", "subnet mask", "255.255.255.0",
+                      field="pool_mask")
+        .AddAttribute("LeaseTime", "seconds", 30.0, field="lease_s")
+        .AddTraceSource("Lease", "(mac, address) granted")
+    )
+
+    def __init__(self, device=None, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self._dev = device   # None = node device 0
+        self._leases: dict[str, Ipv4Address] = {}   # chaddr -> address
+        self._next = Ipv4Address(self.pool_first).addr
+
+    def StartApplication(self):
+        if self._socket is None:
+            udp = self._node.GetObject(UdpL4Protocol)
+            self._socket = udp.CreateSocket()
+            self._socket.Bind(InetSocketAddress(Ipv4Address.GetAny(), SERVER_PORT))
+            self._socket.SetRecvCallback(self._on_read)
+
+    def StopApplication(self):
+        if self._socket is not None:
+            self._socket.Close()
+            self._socket = None
+
+    def _device(self):
+        return self._dev if self._dev is not None else self._node.GetDevice(0)
+
+    def _my_addr(self) -> Ipv4Address:
+        ipv4 = self._node.GetObject(Ipv4L3Protocol)
+        return ipv4.SelectSourceAddress(
+            ipv4.GetInterfaceForDevice(self._device())
+        )
+
+    def _lease_for(self, mac: Mac48Address) -> "Ipv4Address | None":
+        key = str(mac)
+        if key not in self._leases:
+            mask = Ipv4Mask(self.pool_mask)
+            host_max = (
+                Ipv4Address(self.pool_first).addr & mask.mask
+            ) | (~mask.mask & 0xFFFFFFFE)  # below the subnet broadcast
+            if self._next > host_max:
+                return None  # pool exhausted: stay silent (client retries)
+            self._leases[key] = Ipv4Address(self._next)
+            self._next += 1
+        return self._leases[key]
+
+    def _on_read(self, socket):
+        while True:
+            packet, src = socket.RecvFrom()
+            if packet is None:
+                break
+            h = packet.RemoveHeader(DhcpHeader)
+            if h.msg_type == DhcpHeader.DISCOVER:
+                self._answer(h, DhcpHeader.OFFER)
+            elif h.msg_type == DhcpHeader.REQUEST:
+                addr = self._answer(h, DhcpHeader.ACK)
+                if addr is not None:
+                    self.lease(h.chaddr, addr)
+
+    def _answer(self, req: DhcpHeader, msg_type: int) -> "Ipv4Address | None":
+        addr = self._lease_for(req.chaddr)
+        if addr is None:
+            return None
+        reply = Packet(0)
+        reply.AddHeader(
+            DhcpHeader(
+                msg_type, xid=req.xid, yiaddr=addr, chaddr=req.chaddr,
+                server_id=self._my_addr(), mask=Ipv4Mask(self.pool_mask),
+                gateway=self._my_addr(), lease_s=int(self.lease_s),
+            )
+        )
+        _bcast_send(self._device(), SERVER_PORT, CLIENT_PORT, reply)
+        return addr
+
+
+class DhcpClient(Application):
+    """Configures device 0's interface from the granted lease
+    (dhcp-client.cc state machine, collapsed to its happy path +
+    retransmission; lease renewal re-REQUESTs at T1 = lease/2)."""
+
+    RETRY_S = 1.0
+
+    tid = (
+        TypeId("tpudes::DhcpClient")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: DhcpClient(**kw))
+        .AddTraceSource("NewLease", "(address) configured")
+        .AddTraceSource("Expiry", "lease expired unrenewed")
+    )
+
+    def __init__(self, device=None, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self._dev = device   # None = node device 0
+        self._xid = 0
+        self._state = "INIT"
+        self._timer = None
+        self._lease_deadline = None   # ticks; None until bound
+        self.address: Ipv4Address | None = None
+
+    def StartApplication(self):
+        # an unconfigured device has no L3 interface yet, so inbound
+        # broadcasts would never reach the stack: create the (still
+        # address-less) interface first — upstream's client similarly
+        # listens before configuration
+        ipv4 = self._node.GetObject(Ipv4L3Protocol)
+        if ipv4.GetInterfaceForDevice(self._device()) < 0:
+            ipv4.AddInterface(self._device())
+        if self._socket is None:
+            udp = self._node.GetObject(UdpL4Protocol)
+            self._socket = udp.CreateSocket()
+            self._socket.Bind(InetSocketAddress(Ipv4Address.GetAny(), CLIENT_PORT))
+            self._socket.SetRecvCallback(self._on_read)
+        self._discover()
+
+    def StopApplication(self):
+        if self._timer is not None:
+            self._timer.Cancel()
+            self._timer = None
+        if self._socket is not None:
+            self._socket.Close()
+            self._socket = None
+
+    def _device(self):
+        return self._dev if self._dev is not None else self._node.GetDevice(0)
+
+    def _send(self, msg_type: int):
+        p = Packet(0)
+        p.AddHeader(
+            DhcpHeader(
+                msg_type, xid=self._xid,
+                chaddr=self._device().GetAddress(),
+            )
+        )
+        _bcast_send(self._device(), CLIENT_PORT, SERVER_PORT, p)
+
+    def _arm(self, delay_s: float, fn):
+        if self._timer is not None:
+            self._timer.Cancel()
+        self._timer = Simulator.Schedule(Seconds(delay_s), fn)
+
+    def _discover(self):
+        self._xid += 1
+        self._state = "SELECTING"
+        self._send(DhcpHeader.DISCOVER)
+        self._arm(self.RETRY_S, self._discover)  # lost OFFER: retry
+
+    def _on_read(self, socket):
+        while True:
+            packet, src = socket.RecvFrom()
+            if packet is None:
+                break
+            h = packet.RemoveHeader(DhcpHeader)
+            if h.chaddr != self._device().GetAddress() or h.xid != self._xid:
+                continue  # another client's exchange
+            if h.msg_type == DhcpHeader.OFFER and self._state == "SELECTING":
+                self._state = "REQUESTING"
+                self._send(DhcpHeader.REQUEST)
+                self._arm(self.RETRY_S, self._discover)  # lost ACK
+            elif h.msg_type == DhcpHeader.ACK and self._state in (
+                "REQUESTING", "RENEWING"
+            ):
+                self._configure(h)
+
+    def _configure(self, h: DhcpHeader):
+        first = self.address is None
+        self.address = h.yiaddr
+        self._state = "BOUND"
+        if first:
+            ipv4 = self._node.GetObject(Ipv4L3Protocol)
+            if_index = ipv4.GetInterfaceForDevice(self._device())
+            ipv4.AddAddress(
+                if_index, Ipv4InterfaceAddress(h.yiaddr, h.mask)
+            )
+            routing = ipv4.GetRoutingProtocol()
+            if isinstance(routing, Ipv4StaticRouting):
+                routing.AddNetworkRouteTo(
+                    h.yiaddr.CombineMask(h.mask), h.mask, if_index
+                )
+                routing.SetDefaultRoute(h.gateway, if_index)
+        self.new_lease(h.yiaddr)
+        self._lease_deadline = Simulator.NowTicks() + Seconds(h.lease_s).ticks
+
+        def renew():
+            if (
+                self._lease_deadline is not None
+                and Simulator.NowTicks() >= self._lease_deadline
+            ):
+                # the server stopped answering and the lease ran out:
+                # surface it and restart acquisition from scratch
+                self._lease_deadline = None
+                self.expiry()
+                self._discover()
+                return
+            self._state = "RENEWING"
+            self._send(DhcpHeader.REQUEST)
+            self._arm(self.RETRY_S, renew)  # lost ACK: keep trying
+
+        self._arm(max(h.lease_s / 2.0, 1.0), renew)
+
+
+class DhcpHelper:
+    """dhcp-helper.cc: install server/clients."""
+
+    def InstallDhcpServer(self, node, device=None, **attrs) -> DhcpServer:
+        app = DhcpServer(device=device, **attrs)
+        node.AddApplication(app)
+        return app
+
+    def InstallDhcpClient(self, nodes, devices=None) -> list[DhcpClient]:
+        """``devices`` (optional, parallel to ``nodes``) picks the DHCP
+        interface on multi-homed nodes — upstream's helper binds a
+        specific NetDevice too."""
+        apps = []
+        try:
+            it = list(iter(nodes))
+        except TypeError:
+            it = [nodes]
+        devs = list(devices) if devices is not None else [None] * len(it)
+        for node, dev in zip(it, devs):
+            app = DhcpClient(device=dev)
+            node.AddApplication(app)
+            apps.append(app)
+        return apps

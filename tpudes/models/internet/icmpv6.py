@@ -26,6 +26,7 @@ class Icmpv6Header(Header):
     TIME_EXCEEDED = 3
     ECHO_REQUEST = 128
     ECHO_REPLY = 129
+    RA = 134   # router advertisement (radvd)
     NS = 135   # neighbor solicitation
     NA = 136   # neighbor advertisement
 
@@ -91,6 +92,32 @@ class Icmpv6NdHeader(Header):
         return cls(target, lladdr), 28
 
 
+class Icmpv6RaHeader(Header):
+    """Router advertisement body: router lifetime + one prefix-info
+    option (icmpv6-header.cc Icmpv6RA + Icmpv6OptionPrefixInformation,
+    folded to the SLAAC-relevant fields)."""
+
+    def __init__(self, prefix=None, prefix_len=64, lifetime_s=1800):
+        self.prefix = prefix or Ipv6Address()
+        self.prefix_len = prefix_len
+        self.lifetime_s = lifetime_s
+
+    def GetSerializedSize(self) -> int:
+        return 4 + 16 + 4
+
+    def Serialize(self) -> bytes:
+        return (
+            struct.pack("!HBx", self.lifetime_s & 0xFFFF, self.prefix_len)
+            + self.prefix.to_bytes()
+            + b"\x00" * 4
+        )
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        lifetime, plen = struct.unpack("!HBx", data[:4])
+        return cls(Ipv6Address.from_bytes(data[4:20]), plen, lifetime), 24
+
+
 class NdiscEntry:
     WAIT_REPLY = 0
     REACHABLE = 1
@@ -119,6 +146,7 @@ class Icmpv6L4Protocol(Object):
                       field="wait_timeout_s")
         .AddTraceSource("Rx", "(icmpv6 header, source)")
         .AddTraceSource("Drop", "packet dropped (no ND resolution)")
+        .AddTraceSource("Autoconf", "(address) SLAAC configured")
     )
 
     def __init__(self, **attributes):
@@ -294,10 +322,97 @@ class Icmpv6L4Protocol(Object):
         elif icmp.icmp_type == Icmpv6Header.NA:
             nd = packet.RemoveHeader(Icmpv6NdHeader)
             self._learn(iface, nd.target, nd.lladdr)
+        elif icmp.icmp_type == Icmpv6Header.RA:
+            ra = packet.RemoveHeader(Icmpv6RaHeader)
+            self._slaac(iface, ra, ip_header.source)
         else:
             inner = packet.PeekHeader()
             for cb in self._error_listeners:
                 cb(icmp.icmp_type, icmp.code, inner, ip_header.source)
+
+
+    def _slaac(self, iface, ra: "Icmpv6RaHeader", router: Ipv6Address) -> None:
+        """RFC 4862 stateless autoconfiguration from a received RA:
+        derive the EUI-64 global address under the advertised prefix,
+        install the connected-prefix route and a default route via the
+        advertising router's link-local address."""
+        from tpudes.models.internet.ipv6 import (
+            Ipv6InterfaceAddress,
+            Ipv6StaticRouting,
+        )
+        from tpudes.network.address import Ipv6Prefix
+
+        ipv6 = self._ipv6()
+        prefix = Ipv6Prefix(ra.prefix_len)
+        for a in iface.addresses:
+            if not a.local.IsLinkLocal() and prefix.IsMatch(a.local, ra.prefix):
+                return  # already configured for this prefix
+        mac = iface.device.GetAddress()
+        addr = Ipv6Address.MakeAutoconfiguredAddress(mac, ra.prefix)
+        if_index = ipv6.GetInterfaceForDevice(iface.device)
+        ipv6.AddAddress(if_index, Ipv6InterfaceAddress(addr, prefix))
+        routing = ipv6.GetRoutingProtocol()
+        if isinstance(routing, Ipv6StaticRouting):
+            routing.AddNetworkRouteTo(
+                addr.CombinePrefix(prefix), prefix, if_index
+            )
+            if ra.lifetime_s > 0:
+                routing.SetDefaultRoute(router, if_index)
+        self.autoconf(addr)
+
+
+class RadvdApplication(Application):
+    """src/internet-apps/model/radvd.{h,cc}: periodic unsolicited RAs
+    advertising one prefix per configured interface."""
+
+    tid = (
+        TypeId("tpudes::Radvd")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: RadvdApplication(**kw))
+        .AddAttribute("Interval", "seconds between RAs", 2.0,
+                      field="interval_s")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        #: [(device, prefix Ipv6Address, prefix_len)]
+        self._configs: list = []
+        self._event = None
+
+    def AddConfiguration(self, device, prefix, prefix_len: int = 64) -> None:
+        self._configs.append((device, Ipv6Address(prefix), prefix_len))
+
+    def StartApplication(self):
+        self._send_ras()
+
+    def StopApplication(self):
+        if self._event is not None:
+            self._event.Cancel()
+            self._event = None
+
+    def _send_ras(self):
+        from tpudes.models.internet.ipv6 import Ipv6Header, Ipv6L3Protocol
+
+        ipv6 = self._node.GetObject(Ipv6L3Protocol)
+        for device, prefix, plen in self._configs:
+            if_index = ipv6.GetInterfaceForDevice(device)
+            if if_index < 0:
+                if_index = ipv6.AddInterface(device)
+            iface = ipv6.GetInterface(if_index)
+            ll = iface.GetLinkLocalAddress()
+            ra = Packet(0)
+            ra.AddHeader(Icmpv6RaHeader(prefix, plen))
+            ra.AddHeader(Icmpv6Header(Icmpv6Header.RA, 0))
+            header = Ipv6Header(
+                source=ll.GetLocal() if ll else Ipv6Address.GetAny(),
+                destination=Ipv6Address.GetAllNodesMulticast(),
+                next_header=Icmpv6L4Protocol.PROT_NUMBER,
+                hop_limit=255,
+                payload_size=ra.GetSize(),
+            )
+            ra.AddHeader(header)
+            device.Send(ra, device.GetBroadcast(), 0x86DD)
+        self._event = Simulator.Schedule(Seconds(self.interval_s), self._send_ras)
 
 
 class Ping6(Application):
