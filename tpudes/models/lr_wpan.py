@@ -1,0 +1,352 @@
+"""LR-WPAN: IEEE 802.15.4 low-rate wireless PAN (channel, PHY+MAC
+device, helper).
+
+Reference parity: src/lr-wpan/model/lr-wpan-{phy,mac,net-device,
+csmaca,error-model}.{h,cc} + helper (upstream paths; mount empty at
+survey — SURVEY.md §0, §2.9 "other link modules" row).
+
+Modeled: the 2.4 GHz O-QPSK PHY at 250 kb/s with a propagation-loss
+channel and rx sensitivity; unslotted CSMA/CA (random backoff in unit
+periods of 20 symbols, CCA, BE growth, NB limit); acked unicast data
+with macMaxFrameRetries; collision corruption when receptions overlap
+at a receiver (the SINR error model reduced to capture-less collision
+— documented simplification, as is using 48-bit addresses where
+upstream has short/extended 802.15.4 addresses).  Beacon-enabled
+(slotted) mode, PAN association and GTS are out of scope.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.nstime import MicroSeconds, Seconds
+from tpudes.core.object import TypeId
+from tpudes.core.rng import UniformRandomVariable
+from tpudes.core.simulator import Simulator
+from tpudes.network.address import Mac48Address
+from tpudes.network.net_device import Channel, NetDevice
+from tpudes.network.packet import Header, Packet
+from tpudes.network.queue import DropTailQueue
+
+#: 802.15.4 2.4 GHz O-QPSK
+BIT_RATE = 250_000           # b/s
+SYMBOL_RATE = 62_500         # 4 bits/symbol
+UNIT_BACKOFF_US = 320        # aUnitBackoffPeriod = 20 symbols
+ACK_WAIT_US = 864            # macAckWaitDuration (54 symbols)
+MAC_MIN_BE = 3
+MAC_MAX_BE = 5
+MAC_MAX_CSMA_BACKOFFS = 4
+MAC_MAX_FRAME_RETRIES = 3
+ACK_SIZE = 5                 # imm-ack frame bytes
+PHY_OVERHEAD = 6             # preamble(4) + SFD(1) + length(1)
+A_MAX_PHY_PACKET_SIZE = 127
+
+
+class LrWpanMacHeader(Header):
+    DATA = 1
+    ACK = 2
+
+    def __init__(self, frame_type=1, seq=0, dst=None, src=None,
+                 protocol=0x86DD):
+        self.frame_type = frame_type
+        self.seq = seq
+        self.dst = dst or Mac48Address.GetBroadcast()
+        self.src = src or Mac48Address()
+        #: in-sim demux field: 802.15.4 has no ethertype — upstream
+        #: distinguishes payloads by 6LoWPAN dispatch bytes; the
+        #: structured equivalent rides the header object (not the wire)
+        self.protocol = protocol
+
+    def GetSerializedSize(self) -> int:
+        # fc(2) + seq(1) + addressing (ack carries none)
+        return 3 if self.frame_type == self.ACK else 3 + 12
+
+    def Serialize(self) -> bytes:
+        head = struct.pack("!BH", self.frame_type, self.seq & 0xFF)
+        if self.frame_type == self.ACK:
+            return head
+        return head + self.dst.to_bytes() + self.src.to_bytes()
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        t, seq = struct.unpack("!BH", data[:3])
+        if t == cls.ACK:
+            return cls(t, seq), 3
+        return cls(
+            t, seq,
+            Mac48Address.from_bytes(data[3:9]),
+            Mac48Address.from_bytes(data[9:15]),
+        ), 15
+
+
+class LrWpanChannel(Channel):
+    """Wireless broadcast medium: every transmission reaches every
+    attached device at its rx power (single-model loss chain, like
+    YansWifiChannel's)."""
+
+    tid = (
+        TypeId("tpudes::LrWpanChannel")
+        .SetParent(Channel.tid)
+        .AddConstructor(lambda **kw: LrWpanChannel(**kw))
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._loss = None
+        self._delay = None
+
+    def SetPropagationLossModel(self, loss) -> None:
+        self._loss = loss
+
+    def SetPropagationDelayModel(self, delay) -> None:
+        self._delay = delay
+
+    def Attach(self, device) -> None:
+        self._devices.append(device)
+
+    def Send(self, sender, packet, duration_s: float, tx_power_dbm: float):
+        from tpudes.models.mobility import MobilityModel
+
+        tx_mob = sender.GetNode().GetObject(MobilityModel)
+        for dev in self._devices:
+            if dev is sender:
+                continue
+            rx_mob = dev.GetNode().GetObject(MobilityModel)
+            rx_dbm = tx_power_dbm
+            delay_s = 0.0
+            if self._loss is not None and tx_mob and rx_mob:
+                rx_dbm = self._loss.CalcRxPower(tx_power_dbm, tx_mob, rx_mob)
+                if self._delay is not None:
+                    delay_s = self._delay.GetDelay(tx_mob, rx_mob)
+            Simulator.ScheduleWithContext(
+                dev.GetNode().GetId(), Seconds(delay_s),
+                dev.phy_start_rx, packet.Copy(), rx_dbm, duration_s,
+            )
+
+
+class LrWpanNetDevice(NetDevice):
+    """PHY + unslotted CSMA/CA MAC in one device (the lr-wpan module's
+    phy/mac/csmaca trio folded; the split matters upstream for the
+    MLME/MCPS SAP surface, which this build expresses as the plain
+    NetDevice API)."""
+
+    tid = (
+        TypeId("tpudes::LrWpanNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddConstructor(lambda **kw: LrWpanNetDevice(**kw))
+        .AddAttribute("TxPower", "dBm", 0.0, field="tx_power_dbm")
+        .AddAttribute("RxSensitivity", "dBm", -106.58, field="rx_sensitivity")
+        .AddTraceSource("MacTx", "frame queued")
+        .AddTraceSource("MacTxDrop", "frame dropped (csma/ca or retries)")
+        .AddTraceSource("MacTxBackoff", "CCA busy; BE grows")
+        .AddTraceSource("MacRx", "frame delivered up")
+        .AddTraceSource("PhyTxBegin", "(packet)")
+        .AddTraceSource("PhyRxDrop", "(packet, reason)")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._channel: LrWpanChannel | None = None
+        self._queue = DropTailQueue()
+        self._rng = UniformRandomVariable()
+        self._seq = 0
+        self._tx_busy = False
+        self._current = None          # (packet_with_header, header)
+        self._nb = 0
+        self._be = MAC_MIN_BE
+        self._retries = 0
+        self._ack_timer = None
+        # rx state: overlapping receptions corrupt each other
+        self._rx_until = 0
+        self._rx_overlaps = 0
+        self._dup: dict[str, int] = {}  # src -> last seq delivered
+
+    # --- wiring ---
+    def Attach(self, channel: LrWpanChannel) -> None:
+        self._channel = channel
+        channel.Attach(self)
+
+    def GetChannel(self):
+        return self._channel
+
+    def IsBroadcast(self) -> bool:
+        return True
+
+    def NeedsArp(self) -> bool:
+        return True
+
+    def GetMtu(self) -> int:
+        # aMaxPhyPacketSize minus MAC header+FCS: the 6LoWPAN MTU
+        return A_MAX_PHY_PACKET_SIZE - 15 - 2
+
+    # --- tx path: unslotted CSMA/CA (lr-wpan-csmaca.cc) ---
+    def Send(self, packet, dest=None, protocol: int = 0x86DD) -> bool:
+        if not self._link_up:
+            self.mac_tx_drop(packet)
+            return False
+        self.mac_tx(packet)
+        self._seq = (self._seq + 1) & 0xFF
+        header = LrWpanMacHeader(
+            LrWpanMacHeader.DATA, self._seq,
+            dst=dest if dest is not None else self.GetBroadcast(),
+            src=self._address, protocol=protocol,
+        )
+        packet = packet.Copy()
+        packet.AddHeader(header)
+        if not self._queue.Enqueue(packet):
+            self.mac_tx_drop(packet)
+            return False
+        if not self._tx_busy:
+            self._next_frame()
+        return True
+
+    def _next_frame(self):
+        packet = self._queue.Dequeue()
+        if packet is None:
+            self._tx_busy = False
+            return
+        self._tx_busy = True
+        self._current = packet
+        self._nb = 0
+        self._be = MAC_MIN_BE
+        self._retries = 0
+        self._backoff()
+
+    def _backoff(self):
+        periods = int(self._rng.GetValue(0, (1 << self._be) - 1 + 1 - 1e-9))
+        Simulator.Schedule(
+            MicroSeconds(periods * UNIT_BACKOFF_US), self._cca
+        )
+
+    def _cca(self):
+        now = Simulator.NowTicks()
+        if now < self._rx_until:
+            # channel busy: grow BE, bounded attempts
+            self.mac_tx_backoff(self._current)
+            self._nb += 1
+            self._be = min(self._be + 1, MAC_MAX_BE)
+            if self._nb > MAC_MAX_CSMA_BACKOFFS:
+                self.mac_tx_drop(self._current)
+                self._current = None
+                self._next_frame()
+                return
+            self._backoff()
+            return
+        self._transmit()
+
+    def _transmit(self):
+        packet = self._current
+        self.phy_tx_begin(packet)
+        duration_s = (packet.GetSize() + PHY_OVERHEAD) * 8 / BIT_RATE
+        self._channel.Send(self, packet, duration_s, self.tx_power_dbm)
+        header = packet.PeekHeader(LrWpanMacHeader)
+        unicast = header.dst != self.GetBroadcast()
+        if unicast:
+            self._ack_timer = Simulator.Schedule(
+                MicroSeconds(int(duration_s * 1e6) + ACK_WAIT_US),
+                self._on_ack_timeout,
+            )
+        else:
+            Simulator.Schedule(Seconds(duration_s), self._tx_done)
+
+    def _tx_done(self):
+        self._current = None
+        self._next_frame()
+
+    def _on_ack_timeout(self):
+        self._ack_timer = None
+        self._retries += 1
+        if self._retries > MAC_MAX_FRAME_RETRIES:
+            self.mac_tx_drop(self._current)
+            self._tx_done()
+            return
+        self._nb = 0
+        self._be = MAC_MIN_BE
+        self._backoff()
+
+    # --- rx path ---
+    def phy_start_rx(self, packet, rx_dbm: float, duration_s: float):
+        now = Simulator.NowTicks()
+        end = now + Seconds(duration_s).ticks
+        if rx_dbm < self.rx_sensitivity:
+            self.phy_rx_drop(packet, "below-sensitivity")
+            return
+        overlapped = now < self._rx_until
+        if overlapped:
+            self._rx_overlaps += 1   # corrupts BOTH frames
+        self._rx_until = max(self._rx_until, end)
+        Simulator.Schedule(
+            Seconds(duration_s), self._phy_end_rx, packet, overlapped
+        )
+
+    def _phy_end_rx(self, packet, was_overlapped: bool):
+        if was_overlapped or self._rx_overlaps > 0:
+            if not was_overlapped:
+                self._rx_overlaps -= 1  # the first frame of the overlap
+            self.phy_rx_drop(packet, "collision")
+            return
+        header = packet.RemoveHeader(LrWpanMacHeader)
+        if header.frame_type == LrWpanMacHeader.ACK:
+            if self._ack_timer is not None:
+                self._ack_timer.Cancel()
+                self._ack_timer = None
+                self._tx_done()
+            return
+        broadcast = header.dst == self.GetBroadcast()
+        if not broadcast and header.dst != self._address:
+            return
+        if not broadcast:
+            # imm-ack rides back after the turnaround time (12 symbols)
+            ack = Packet(ACK_SIZE - 3)
+            ack.AddHeader(LrWpanMacHeader(LrWpanMacHeader.ACK, header.seq))
+            ack_dur = (ack.GetSize() + PHY_OVERHEAD) * 8 / BIT_RATE
+            Simulator.Schedule(
+                MicroSeconds(192),
+                self._channel.Send, self, ack, ack_dur, self.tx_power_dbm,
+            )
+            last = self._dup.get(str(header.src))
+            if last == header.seq:
+                return  # retransmission of a frame whose ack was lost
+            self._dup[str(header.src)] = header.seq
+        self.mac_rx(packet)
+        self._deliver_up(packet, header.protocol, header.src, header.dst, 0)
+
+
+class LrWpanHelper:
+    """lr-wpan-helper.cc: shared channel + per-node device."""
+
+    def __init__(self):
+        from tpudes.models.propagation import (
+            ConstantSpeedPropagationDelayModel,
+            LogDistancePropagationLossModel,
+        )
+
+        self._channel = LrWpanChannel()
+        self._channel.SetPropagationLossModel(
+            LogDistancePropagationLossModel()
+        )
+        self._channel.SetPropagationDelayModel(
+            ConstantSpeedPropagationDelayModel()
+        )
+
+    def SetChannel(self, channel: LrWpanChannel) -> None:
+        self._channel = channel
+
+    def GetChannel(self) -> LrWpanChannel:
+        return self._channel
+
+    def Install(self, nodes):
+        from tpudes.helper.containers import NetDeviceContainer
+
+        container = NetDeviceContainer()
+        try:
+            it = list(iter(nodes))
+        except TypeError:
+            it = [nodes]
+        for node in it:
+            dev = LrWpanNetDevice()
+            dev.SetAddress(Mac48Address.Allocate())
+            node.AddDevice(dev)
+            dev.Attach(self._channel)
+            container.Add(dev)
+        return container

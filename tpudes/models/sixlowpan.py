@@ -1,0 +1,261 @@
+"""6LoWPAN: IPv6 over 802.15.4 (RFC 6282 IPHC + RFC 4944 fragments).
+
+Reference parity: src/sixlowpan/model/sixlowpan-net-device.{h,cc},
+sixlowpan-header.{h,cc} + helper (upstream paths; mount empty at survey
+— SURVEY.md §0, §2.9 "other link modules" row).
+
+SixLowPanNetDevice wraps a link device (LrWpanNetDevice in practice)
+and adapts IPv6 to its 110-byte MTU:
+
+- IPHC header compression: when both interface identifiers are
+  EUI-64-derivable from the frame's MACs and the traffic class/flow
+  label are zero, the 40-byte IPv6 header shrinks to the 7-byte
+  compressed form (dispatch+IPHC(2) + hop limit(1) + context/prefix
+  nibble handling folded to 4).  Non-compressible headers ride the
+  uncompressed IPV6 dispatch (41 bytes).  In-sim the compressed header
+  CARRIES the original Ipv6Header object (structured packets cannot be
+  bit-sliced — the wire SIZE is what compression changes, and size is
+  what drives airtime on the 250 kb/s link).
+- FRAG1/FRAGN fragmentation for adapted frames beyond the link MTU,
+  with per-(src, tag) reassembly at the receiver and any-loss-kills-
+  the-datagram semantics.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tpudes.core.object import TypeId
+from tpudes.network.address import Ipv6Address
+from tpudes.network.net_device import NetDevice
+from tpudes.network.packet import Header, Packet
+
+#: 6LoWPAN ethertype on the wrapped link (upstream uses raw dispatch
+#: bytes; the wrapped device here multiplexes by protocol number)
+SIXLOWPAN_PROT = 0xA0ED
+
+IPHC_COMPRESSED_BYTES = 7
+IPV6_DISPATCH_BYTES = 41   # 1-byte dispatch + uncompressed header
+
+
+class SixLowPanIphc(Header):
+    """Compressed (or escaped-uncompressed) IPv6 header; carries the
+    original header object for reconstruction."""
+
+    def __init__(self, ipv6_header=None, compressed=True):
+        self.ipv6_header = ipv6_header
+        self.compressed = compressed
+
+    def GetSerializedSize(self) -> int:
+        return IPHC_COMPRESSED_BYTES if self.compressed else IPV6_DISPATCH_BYTES
+
+    def Serialize(self) -> bytes:
+        if self.compressed:
+            h = self.ipv6_header
+            return struct.pack(
+                "!BBBBBBB", 0x78, 0x33, h.next_header, h.hop_limit & 0xFF,
+                (h.payload_size >> 8) & 0xFF, h.payload_size & 0xFF, 0,
+            )
+        return b"\x41" + self.ipv6_header.Serialize()
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        # in-sim the object rides the header instance; wire decode is
+        # exercised for the uncompressed escape only
+        if data[:1] == b"\x41":
+            from tpudes.models.internet.ipv6 import Ipv6Header
+
+            h, n = Ipv6Header.Deserialize(data[1:])
+            return cls(h, compressed=False), 1 + n
+        return cls(None, compressed=True), IPHC_COMPRESSED_BYTES
+
+
+class SixLowPanFrag(Header):
+    """FRAG1/FRAGN (RFC 4944 §5.3): datagram size + tag (+offset)."""
+
+    def __init__(self, size=0, tag=0, offset=0, first=True):
+        self.size = size
+        self.tag = tag
+        self.offset = offset   # bytes (8-byte units on the wire)
+        self.first = first
+
+    def GetSerializedSize(self) -> int:
+        return 4 if self.first else 5
+
+    def Serialize(self) -> bytes:
+        disp = (0x18 if self.first else 0x1C) << 3
+        head = struct.pack("!HH", (disp << 8) | (self.size & 0x7FF), self.tag)
+        if self.first:
+            return head
+        return head + struct.pack("!B", self.offset >> 3)
+
+    @classmethod
+    def Deserialize(cls, data: bytes):
+        word, tag = struct.unpack("!HH", data[:4])
+        first = not bool(word & 0x2000)
+        size = word & 0x7FF
+        if first:
+            return cls(size, tag, 0, True), 4
+        return cls(size, tag, data[4] << 3, False), 5
+
+
+class SixLowPanNetDevice(NetDevice):
+    """The adaptation device: Sends IPv6, speaks compressed frames to
+    the wrapped link device underneath."""
+
+    tid = (
+        TypeId("tpudes::SixLowPanNetDevice")
+        .SetParent(NetDevice.tid)
+        .AddConstructor(lambda **kw: SixLowPanNetDevice(**kw))
+        .AddTraceSource("Tx", "(packet) adapted and sent")
+        .AddTraceSource("Rx", "(packet) reassembled and delivered")
+        .AddTraceSource("Drop", "(reason) adaptation drop")
+    )
+
+    def __init__(self, inner=None, **attributes):
+        super().__init__(**attributes)
+        self._inner = inner
+        self._tag = 0
+        #: (src-mac str, tag) -> {"ranges", "total", "packet"}
+        self._frags: dict = {}
+
+    def SetInnerDevice(self, inner) -> None:
+        self._inner = inner
+
+    def GetInnerDevice(self):
+        return self._inner
+
+    def SetNode(self, node) -> None:
+        super().SetNode(node)
+        # receive the inner device's 6LoWPAN frames
+        node.RegisterProtocolHandler(
+            self._receive_from_inner, SIXLOWPAN_PROT, self._inner
+        )
+
+    # the wrapper presents the inner link's identity
+    def GetAddress(self):
+        return self._inner.GetAddress()
+
+    def IsBroadcast(self) -> bool:
+        return True
+
+    def GetBroadcast(self):
+        return self._inner.GetBroadcast()
+
+    def NeedsArp(self) -> bool:
+        return True  # ICMPv6 ND runs over the adaptation layer
+
+    def GetMtu(self) -> int:
+        return 1280  # IPv6 minimum MTU: the adaptation layer fragments
+
+    # --- tx ---
+    def _compressible(self, h) -> bool:
+        if h is None or h.traffic_class != 0:
+            return False
+        # both IIDs derivable from the on-link MACs (we cannot see the
+        # peer's MAC for routed prefixes generally; link-local and
+        # EUI-64 global addresses qualify)
+        def iid_ok(addr: Ipv6Address) -> bool:
+            low = addr.addr & ((1 << 64) - 1)
+            return (low >> 24) & 0xFFFF == 0xFFFE or addr.IsMulticast()
+
+        return iid_ok(h.source) and iid_ok(h.destination)
+
+    def Send(self, packet, dest=None, protocol: int = 0x86DD) -> bool:
+        from tpudes.models.internet.ipv6 import Ipv6Header
+
+        packet = packet.Copy()
+        h = packet.PeekHeader(Ipv6Header)
+        if h is not None:
+            packet.RemoveHeader(Ipv6Header)
+            packet.AddHeader(SixLowPanIphc(h, compressed=self._compressible(h)))
+        self.tx(packet)
+        mtu = self._inner.GetMtu()
+        if packet.GetSize() <= mtu:
+            return self._inner.Send(packet, dest, SIXLOWPAN_PROT)
+        # RFC 4944 fragmentation of the ADAPTED datagram
+        total = packet.GetSize()
+        self._tag = (self._tag + 1) & 0xFFFF
+        offset = 0
+        first = True
+        while offset < total:
+            fh = SixLowPanFrag(total, self._tag, offset, first)
+            chunk = min((mtu - fh.GetSerializedSize()) & ~7, total - offset)
+            frag = Packet(chunk)
+            if first:
+                frag.AddPacketTag(_SixLowPanOriginal(packet.Copy(), total))
+            fh.offset = offset
+            frag.AddHeader(fh)
+            if not self._inner.Send(frag, dest, SIXLOWPAN_PROT):
+                self.drop("inner-tx")
+                return False
+            offset += chunk
+            first = False
+        return True
+
+    # --- rx ---
+    def _receive_from_inner(self, device, packet, protocol, sender):
+        packet = packet.Copy()
+        front = packet.PeekHeader(SixLowPanFrag)
+        if front is not None:
+            packet.RemoveHeader(SixLowPanFrag)
+            done = self._reassemble(front, packet, sender)
+            if done is None:
+                return
+            packet = done
+        self._deliver(packet, sender)
+
+    def _reassemble(self, fh: SixLowPanFrag, packet, sender):
+        key = (str(sender), fh.tag)
+        buf = self._frags.setdefault(
+            key, {"ranges": [], "total": fh.size, "packet": None}
+        )
+        tag = packet.PeekPacketTag(_SixLowPanOriginal)
+        if tag is not None:
+            buf["packet"] = tag.packet
+        length = packet.GetSize()
+        buf["ranges"].append((fh.offset, fh.offset + length))
+        covered = 0
+        for s, e in sorted(buf["ranges"]):
+            if s > covered:
+                return None
+            covered = max(covered, e)
+        if covered < buf["total"] or buf["packet"] is None:
+            return None
+        del self._frags[key]
+        return buf["packet"]
+
+    def _deliver(self, packet, sender):
+        from tpudes.models.internet.ipv6 import Ipv6Header
+
+        iphc = packet.PeekHeader(SixLowPanIphc)
+        if iphc is not None:
+            packet.RemoveHeader(SixLowPanIphc)
+            if iphc.ipv6_header is not None:
+                packet.AddHeader(iphc.ipv6_header)
+        self.rx(packet)
+        self._deliver_up(packet, 0x86DD, sender, self.GetAddress(), 0)
+
+
+class _SixLowPanOriginal:
+    __slots__ = ("packet", "total")
+
+    def __init__(self, packet, total):
+        self.packet = packet
+        self.total = total
+
+
+class SixLowPanHelper:
+    """sixlowpan-helper.cc: wrap each device, add the wrapper to the
+    node; assign IPv6 addresses to the WRAPPER devices."""
+
+    def Install(self, devices):
+        from tpudes.helper.containers import NetDeviceContainer
+
+        out = NetDeviceContainer()
+        for inner in devices:
+            node = inner.GetNode()
+            wrap = SixLowPanNetDevice(inner=inner)
+            node.AddDevice(wrap)
+            out.Add(wrap)
+        return out
