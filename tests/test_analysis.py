@@ -1,0 +1,539 @@
+"""tpudes.analysis pass fixtures: per rule, a true positive drawn from
+a tpudes/ idiom, a suppressed variant, and a clean case.
+
+These run the passes over in-memory snippets (analyze_source), so they
+pin the *rules*; tests/test_analysis_gate.py pins the repo-wide gate.
+"""
+
+import textwrap
+
+from tpudes.analysis import analyze_source
+
+
+def _codes(src, path="tpudes/models/fixture.py", select=None, extra=None):
+    findings = analyze_source(
+        textwrap.dedent(src), path=path, select=select, extra_modules=extra
+    )
+    return [f.code for f in findings]
+
+
+# --- jit-purity (JP) -------------------------------------------------------
+
+def test_jp_wall_clock_in_ops_scope():
+    src = """
+    import time
+
+    def airtime(n):
+        t0 = time.perf_counter()
+        return n * t0
+    """
+    assert _codes(src, path="tpudes/ops/fixture.py", select=["JP"]) == ["JP001"]
+
+
+def test_jp_wall_clock_outside_device_path_needs_tracing():
+    # same snippet in a models/ file is host code — not flagged
+    src = """
+    import time
+
+    def airtime(n):
+        t0 = time.perf_counter()
+        return n * t0
+    """
+    assert _codes(src, select=["JP"]) == []
+
+
+def test_jp_print_and_host_rng_in_traced_function():
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        print(x)
+        return x + np.random.uniform()
+    """
+    assert _codes(src, select=["JP"]) == ["JP002", "JP003"]
+
+
+def test_jp_captured_list_mutation_in_jitted_function():
+    src = """
+    import jax
+
+    _log = []
+
+    @jax.jit
+    def step(x):
+        _log.append(x)
+        return x + 1
+    """
+    assert _codes(src, select=["JP"]) == ["JP004"]
+
+
+def test_jp_self_mutation_in_scan_body():
+    src = """
+    import jax
+
+    class Engine:
+        def run(self, s0, keys):
+            def step(s, k):
+                self.steps += 1
+                return s, k
+            return jax.lax.scan(step, s0, keys)
+    """
+    assert _codes(src, select=["JP"]) == ["JP004"]
+
+
+def test_jp_suppressed_and_clean():
+    suppressed = """
+    import jax
+
+    _log = []
+
+    @jax.jit
+    def step(x):
+        _log.append(x)  # tpudes: ignore[JP004]
+        return x + 1
+    """
+    assert _codes(suppressed, select=["JP"]) == []
+    clean = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        local = []
+        local.append(x)
+        return jnp.sort(jnp.stack(local))
+    """
+    assert _codes(clean, select=["JP"]) == []
+
+
+# --- rng-discipline (RNG) --------------------------------------------------
+
+def test_rng_key_reuse_without_split():
+    src = """
+    import jax
+
+    def draw(key):
+        backoff = jax.random.uniform(key, (4,))
+        coin = jax.random.bernoulli(key)
+        return backoff, coin
+    """
+    assert _codes(src, select=["RNG001"]) == ["RNG001"]
+
+
+def test_rng_split_between_uses_is_clean():
+    src = """
+    import jax
+
+    def draw(key):
+        k1, k2 = jax.random.split(key)
+        backoff = jax.random.uniform(k1, (4,))
+        coin = jax.random.bernoulli(k2)
+        return backoff, coin
+    """
+    assert _codes(src, select=["RNG001"]) == []
+
+
+def test_rng_mutually_exclusive_branches_are_clean():
+    # the replicated.py step_fn idiom: both arms split the same key
+    src = """
+    import jax
+
+    def step(key, agg):
+        if agg:
+            k_back, k_mpdu = jax.random.split(key)
+            u = jax.random.uniform(k_back)
+        else:
+            k_back, k_coin = jax.random.split(key)
+            u = jax.random.uniform(k_coin)
+        return u
+    """
+    assert _codes(src, select=["RNG001"]) == []
+
+
+def test_rng_reuse_suppressed():
+    src = """
+    import jax
+
+    def draw(key):
+        a = jax.random.uniform(key)
+        b = jax.random.normal(key)  # tpudes: ignore[RNG001]
+        return a + b
+    """
+    assert _codes(src, select=["RNG001"]) == []
+
+
+def test_rng_stdlib_bypass_outside_core_rng():
+    src = """
+    import random
+
+    def jitter():
+        return random.uniform(0.0, 0.1)
+    """
+    assert _codes(src, select=["RNG002"]) == ["RNG002"]
+    # the seeded-stream home itself is exempt
+    assert _codes(src, path="tpudes/core/rng.py", select=["RNG002"]) == []
+
+
+# --- determinism (DET) -----------------------------------------------------
+
+def test_det_schedule_from_set_iteration():
+    src = """
+    from tpudes.core.simulator import Simulator
+
+    def arm(devices):
+        backlog = set(devices)
+        for dev in backlog:
+            Simulator.Schedule(1, dev.poll)
+    """
+    assert _codes(src, select=["DET"]) == ["DET001"]
+
+
+def test_det_sorted_set_iteration_is_clean():
+    src = """
+    from tpudes.core.simulator import Simulator
+
+    def arm(devices):
+        backlog = set(devices)
+        for dev in sorted(backlog, key=lambda d: d.node_id):
+            Simulator.Schedule(1, dev.poll)
+    """
+    assert _codes(src, select=["DET"]) == []
+
+
+def test_det_id_in_sort_key():
+    src = """
+    def rank(targets):
+        targets.sort(key=lambda d: (d.rssi, id(d)))
+        return targets
+    """
+    assert _codes(src, select=["DET"]) == ["DET002"]
+
+
+def test_det_suppressed_and_stable_key_clean():
+    suppressed = """
+    from tpudes.core.simulator import Simulator
+
+    def arm(devices):
+        backlog = set(devices)
+        for dev in backlog:
+            Simulator.Schedule(1, dev.poll)  # tpudes: ignore[DET001]
+    """
+    assert _codes(suppressed, select=["DET"]) == []
+    clean = """
+    def rank(targets):
+        targets.sort(key=lambda d: (d.rssi, d.node_id))
+        return targets
+    """
+    assert _codes(clean, select=["DET"]) == []
+
+
+# --- event-hygiene (EVT) ---------------------------------------------------
+
+def test_evt_dropped_schedule_in_class_with_teardown():
+    src = """
+    from tpudes.core.simulator import Simulator
+
+    class Pinger:
+        def StartApplication(self):
+            Simulator.Schedule(1.0, self._send)
+
+        def StopApplication(self):
+            pass
+    """
+    assert _codes(src, select=["EVT001"]) == ["EVT001"]
+
+
+def test_evt_kept_eventid_is_clean():
+    src = """
+    from tpudes.core.simulator import Simulator
+
+    class Pinger:
+        def StartApplication(self):
+            self._ev = Simulator.Schedule(1.0, self._send)
+
+        def StopApplication(self):
+            self._ev.Cancel()
+    """
+    assert _codes(src, select=["EVT001"]) == []
+
+
+def test_evt_swallowed_callback_exception():
+    src = """
+    from tpudes.core.simulator import Simulator
+
+    def on_timer(sock):
+        try:
+            sock.poll()
+        except Exception:
+            pass
+    """
+    assert _codes(src, select=["EVT002"]) == ["EVT002"]
+    handled = """
+    from tpudes.core.simulator import Simulator
+
+    def on_timer(sock, log):
+        try:
+            sock.poll()
+        except Exception as e:
+            log.warning(e)
+    """
+    assert _codes(handled, select=["EVT002"]) == []
+
+
+def test_evt_reassembly_buffer_without_expiry_matches_advice_bug():
+    # the PRE-fix tpudes/models/sixlowpan.py shape (ADVICE.md low):
+    # per-(src, tag) buffers deleted only on completed coverage, class
+    # schedules nothing -> a lost fragment strands the buffer forever
+    prefix = """
+    class SixLowPanNetDevice:
+        def __init__(self):
+            self._frags = {}
+
+        def _reassemble(self, fh, packet, sender):
+            key = (str(sender), fh.tag)
+            buf = self._frags.setdefault(key, {"ranges": [], "total": fh.size})
+            buf["ranges"].append((fh.offset, fh.offset + packet.GetSize()))
+            covered = 0
+            for s, e in sorted(buf["ranges"]):
+                if s > covered:
+                    return None
+                covered = max(covered, e)
+            if covered < buf["total"]:
+                return None
+            del self._frags[key]
+            return buf
+    """
+    assert _codes(prefix, select=["EVT003"]) == ["EVT003"]
+    # the POST-fix shape schedules an expiry event -> clean
+    fixed = """
+    from tpudes.core.simulator import Simulator
+
+    class SixLowPanNetDevice:
+        def __init__(self):
+            self._frags = {}
+
+        def _reassemble(self, fh, packet, sender):
+            key = (str(sender), fh.tag)
+            buf = self._frags.setdefault(key, {"ranges": []})
+            buf["timer"] = Simulator.Schedule(60.0, self._expire, key)
+            buf["ranges"].append(fh.offset)
+            if len(buf["ranges"]) < 2:
+                return None
+            del self._frags[key]
+            return buf
+
+        def _expire(self, key):
+            self._frags.pop(key, None)
+    """
+    assert _codes(fixed, select=["EVT003"]) == []
+
+
+# --- registry-parity (REG) -------------------------------------------------
+
+_DECL = """
+from tpudes.core.object import TypeId
+
+
+class FooDevice:
+    tid = (
+        TypeId("tpudes::FooDevice")
+        .AddAttribute("BeaconInterval", "beacon period", 0.1)
+        .AddTraceSource("PhyTxBegin", "(packet)")
+    )
+"""
+
+
+def test_reg_dead_declarations_flagged():
+    assert _codes(_DECL, select=["REG"]) == ["REG001", "REG001"]
+
+
+def test_reg_referenced_declarations_clean():
+    user = """
+    def configure(dev, pkt):
+        dev.SetAttribute("BeaconInterval", 0.2)
+        dev.phy_tx_begin(pkt)
+    """
+    assert _codes(
+        _DECL, select=["REG"],
+        extra=[("tests/fixture_user.py", textwrap.dedent(user))],
+    ) == []
+
+
+def test_reg_suppressed():
+    suppressed = _DECL.replace(
+        '.AddAttribute("BeaconInterval", "beacon period", 0.1)',
+        '.AddAttribute("BeaconInterval", "beacon period", 0.1)'
+        '  # tpudes: ignore[REG001]',
+    ).replace(
+        '.AddTraceSource("PhyTxBegin", "(packet)")',
+        '.AddTraceSource("PhyTxBegin", "(packet)")  # tpudes: ignore',
+    )
+    assert _codes(suppressed, select=["REG"]) == []
+
+
+# --- style (LNT, the ported lint.py gates) ---------------------------------
+
+def test_lnt_unused_import_and_bare_except():
+    src = """
+    import struct
+
+    def parse(data):
+        try:
+            return data[0]
+        except:
+            return None
+    """
+    assert _codes(src, select=["LNT"]) == ["LNT003", "LNT005"]
+
+
+def test_lnt_syntax_error_and_tab():
+    assert _codes("def broken(:\n", select=["LNT"]) == ["LNT001"]
+    assert sorted(_codes("x = 1\n\ty = 2\n", select=["LNT"])) == [
+        "LNT001", "LNT002",
+    ]  # the tab is also a syntax error here
+
+
+def test_lnt_duplicate_import():
+    src = """
+    import struct
+    import struct
+
+    def size(h):
+        return struct.calcsize(h)
+    """
+    assert _codes(src, select=["LNT"]) == ["LNT004"]
+
+
+def test_lnt_suppression_without_codes_silences_line():
+    src = """
+    import struct  # tpudes: ignore
+
+    def parse(data):
+        return data[0]
+    """
+    assert _codes(src, select=["LNT"]) == []
+
+
+# --- select/ignore plumbing ------------------------------------------------
+
+def test_select_prefix_filters_other_passes():
+    src = """
+    import struct
+
+    def jitter(key):
+        import jax
+
+        a = jax.random.uniform(key)
+        return a + jax.random.normal(key)
+    """
+    # unused import AND key reuse present; select narrows to one
+    assert _codes(src, select=["RNG"]) == ["RNG001"]
+    assert _codes(src, select=["LNT"]) == ["LNT003"]
+
+
+def test_jp_subscript_mutation_of_captured_dict():
+    src = """
+    import jax
+
+    _cache = {}
+
+    @jax.jit
+    def step(x):
+        _cache[0] = x
+        return x + 1
+    """
+    assert _codes(src, select=["JP"]) == ["JP004"]
+
+
+def test_jp_local_subscript_assignment_is_clean():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        scratch = {}
+        scratch[0] = x
+        return x + 1
+    """
+    assert _codes(src, select=["JP"]) == []
+
+
+def test_plugin_registration_keeps_builtin_passes():
+    from tpudes.analysis import Pass, register_pass
+    from tpudes.analysis.engine import ALL_PASSES
+
+    class _ProbePass(Pass):
+        name = "probe"
+        codes = {"PRB001": "probe rule (test-only)"}
+
+    register_pass(_ProbePass)
+    try:
+        # builtins must still run after a plugin registered first
+        assert _codes("try:\n    pass\nexcept:\n    pass\n",
+                      select=["LNT"]) == ["LNT005"]
+    finally:
+        ALL_PASSES[:] = [p for p in ALL_PASSES
+                         if not isinstance(p, _ProbePass)]
+
+
+def test_overlapping_paths_not_double_counted(tmp_path):
+    from tpudes.analysis import analyze_paths
+
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    f = sub / "mod.py"
+    f.write_text("try:\n    pass\nexcept:\n    pass\n")
+    findings = analyze_paths([sub, f], root=tmp_path, select=["LNT"])
+    assert [x.code for x in findings] == ["LNT005"]
+
+
+def test_rng_fold_in_fanout_from_one_parent_is_clean():
+    src = """
+    import jax
+
+    def derive(key):
+        k1 = jax.random.fold_in(key, 1)
+        k2 = jax.random.fold_in(key, 2)
+        return jax.random.uniform(k1), jax.random.uniform(k2)
+    """
+    assert _codes(src, select=["RNG001"]) == []
+
+
+def test_rng_split_of_already_drawn_key_is_flagged():
+    src = """
+    import jax
+
+    def draw(key):
+        u = jax.random.uniform(key)
+        k1, k2 = jax.random.split(key)
+        return u, k1, k2
+    """
+    assert _codes(src, select=["RNG001"]) == ["RNG001"]
+
+
+def test_rng_rebind_from_unknown_source_is_clean():
+    src = """
+    import jax
+
+    def draw(key, make_key):
+        a = jax.random.uniform(key)
+        key = make_key()
+        b = jax.random.uniform(key)
+        return a + b
+    """
+    assert _codes(src, select=["RNG001"]) == []
+
+
+def test_det_same_name_sorted_rebind_is_clean():
+    src = """
+    from tpudes.core.simulator import Simulator
+
+    def arm(devices):
+        backlog = set(devices)
+        backlog = sorted(backlog)
+        for dev in backlog:
+            Simulator.Schedule(1, dev.poll)
+    """
+    assert _codes(src, select=["DET"]) == []

@@ -1,0 +1,111 @@
+"""Tier-1 CI gate: ``python -m tpudes.analysis`` over the repo must be
+clean against tools/analysis_baseline.json, and the gate must actually
+bite — a file with a true positive exits nonzero.
+
+Runs inside the normal pytest tier-1 command, no extra CI wiring.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(*args, cwd=REPO):
+    # PYTHONPATH keeps tpudes importable when cwd is not the repo root
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "tpudes.analysis", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=300, env=env,
+    )
+
+
+def test_repo_clean_against_baseline():
+    proc = _run()
+    assert proc.returncode == 0, (
+        "new analysis findings (fix them or, for pre-existing debt, "
+        "re-baseline with --write-baseline):\n"
+        + proc.stdout + proc.stderr
+    )
+
+
+def test_true_positive_file_fails_the_gate(tmp_path):
+    bad = tmp_path / "bad_model.py"
+    bad.write_text(
+        "from tpudes.core.simulator import Simulator\n"
+        "\n"
+        "def arm(devices):\n"
+        "    backlog = set(devices)\n"
+        "    for dev in backlog:\n"
+        "        Simulator.Schedule(1, dev.poll)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "DET001" in proc.stdout
+
+
+def test_json_output_is_machine_readable(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    pass\nexcept:\n    pass\n")
+    proc = _run(str(bad), "--json")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["findings"][0]["code"] == "LNT005"
+
+
+def test_list_rules_covers_every_pass():
+    proc = _run("--list-rules")
+    assert proc.returncode == 0
+    for code in ("JP001", "RNG001", "DET001", "EVT001", "REG001", "LNT001"):
+        assert code in proc.stdout
+
+
+def test_baseline_file_is_wellformed():
+    data = json.loads((REPO / "tools" / "analysis_baseline.json").read_text())
+    assert data["version"] == 1
+    assert all(
+        isinstance(v, int) and v > 0 for v in data["counts"].values()
+    )
+
+
+def test_lint_shim_still_gates():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_subtree_scan_honors_the_baseline():
+    # all 15 baselined findings live under tpudes/, and baseline keys
+    # are root-relative — an explicit-path scan from the repo root must
+    # not report frozen debt as new
+    proc = _run("tpudes")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_misspelled_path_is_an_error_not_a_green_gate():
+    proc = _run("tpudes/modles")
+    assert proc.returncode == 2
+    assert "no such file" in proc.stderr
+
+
+def test_write_baseline_refuses_narrowed_runs(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    pass\nexcept:\n    pass\n")
+    before = (REPO / "tools" / "analysis_baseline.json").read_text()
+    for narrowed in ([str(bad), "--write-baseline"],
+                     ["--select", "LNT", "--write-baseline"]):
+        proc = _run(*narrowed)
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert (REPO / "tools" / "analysis_baseline.json").read_text() == before
+
+
+def test_missing_default_roots_is_an_error_not_a_green_gate(tmp_path):
+    proc = _run(cwd=tmp_path)
+    assert proc.returncode == 2
+    assert "default roots" in proc.stderr

@@ -208,3 +208,78 @@ def test_large_datagram_fragments_and_reassembles():
     assert len(frag_frames) >= 6, len(frag_frames)
     assert all(p.GetSize() <= 127 for p in frames)
     _reset()
+
+
+# --- ADVICE.md round-5 regressions ----------------------------------------
+
+def test_triple_overlap_collision_does_not_poison_next_clean_frame():
+    """ADVICE.md medium (lr_wpan collision bookkeeping): with >=3
+    overlapping receptions the old single _rx_overlaps counter kept a
+    positive residue after the pile-up drained, falsely dropping the
+    NEXT clean frame.  Per-reception corrupted flags drop exactly the
+    overlapped frames and nothing after."""
+    from tpudes.models.lr_wpan import LrWpanMacHeader
+
+    _reset()
+    nodes, devices = _pan(2, spacing=20.0)
+    rx = devices.Get(1)
+    got = []
+    nodes.Get(1).RegisterProtocolHandler(
+        lambda dev, pkt, proto, sender: got.append(pkt.GetSize()),
+        0x86DD, rx,
+    )
+    drops = []
+    rx.TraceConnectWithoutContext(
+        "PhyRxDrop", lambda pkt, reason: drops.append(reason)
+    )
+
+    def bcast(seq):
+        p = Packet(50)
+        p.AddHeader(LrWpanMacHeader(
+            LrWpanMacHeader.DATA, seq,
+            dst=rx.GetBroadcast(), src=devices.Get(0).GetAddress(),
+        ))
+        return p
+
+    # A<-B<-C pile-up at the PHY, then a clean frame well afterwards
+    for seq, t in ((1, 0.100), (2, 0.105), (3, 0.106)):
+        Simulator.Schedule(Seconds(t), rx.phy_start_rx, bcast(seq), -40.0, 0.010)
+    Simulator.Schedule(Seconds(0.2), rx.phy_start_rx, bcast(4), -40.0, 0.010)
+    Simulator.Stop(Seconds(0.5))
+    Simulator.Run()
+    assert drops.count("collision") == 3, drops
+    assert got == [50], got
+    _reset()
+
+
+def test_stranded_sixlowpan_fragment_expires_with_drop_trace():
+    """ADVICE.md low (6LoWPAN reassembly leak): a buffer whose
+    fragments never complete must expire (mirroring
+    Ipv4L3Protocol._expire_fragments), firing the Drop trace and
+    freeing the (src, tag) key before the 16-bit tag wraps."""
+    from tpudes.models.sixlowpan import SIXLOWPAN_PROT
+
+    _reset()
+    nodes, devices = _pan(2)
+    six = SixLowPanHelper().Install(devices)
+    wrap = six.Get(1)
+    drops = []
+    wrap.TraceConnectWithoutContext("Drop", lambda reason: drops.append(reason))
+    delivered = []
+    nodes.Get(1).RegisterProtocolHandler(
+        lambda dev, pkt, proto, sender: delivered.append(pkt),
+        0x86DD, wrap,
+    )
+    # first fragment of a 200-byte datagram; the rest never arrive
+    frag = Packet(40)
+    frag.AddHeader(SixLowPanFrag(size=200, tag=7, offset=0, first=True))
+    Simulator.Schedule(
+        Seconds(0.1), wrap._receive_from_inner,
+        devices.Get(1), frag, SIXLOWPAN_PROT, devices.Get(0).GetAddress(),
+    )
+    Simulator.Stop(Seconds(wrap.REASSEMBLY_EXPIRATION_S + 1.0))
+    Simulator.Run()
+    assert drops == ["reassembly-timeout"], drops
+    assert wrap._frags == {}, wrap._frags
+    assert delivered == []
+    _reset()

@@ -317,3 +317,26 @@ def test_shaping_discs_terminate_on_event_exhaustion(disc, kw):
     Simulator.Run()  # NO Stop(): must terminate on event exhaustion
     assert sapps.Get(0).received == 20
     reset_world()
+
+
+def test_pie_rejected_enqueue_on_idle_disc_arms_no_timer():
+    """ADVICE.md low (PIE Tupdate mis-arm): a packet rejected by the
+    queue-limit check on an otherwise idle disc must not start the
+    recurring probability-update chain — only an ACCEPTED packet arms
+    Tupdate."""
+    from tpudes.core.world import reset_world
+    from tpudes.models.traffic_control import PieQueueDisc
+
+    reset_world()
+    disc = PieQueueDisc(MaxSize=0)          # every enqueue rejected
+    assert not disc.Enqueue(_item())
+    assert not disc._timer_started
+    assert Simulator.IsFinished(), "rejected enqueue scheduled an event"
+
+    # the flip side: an accepted packet DOES arm the update chain
+    reset_world()
+    disc = PieQueueDisc()
+    assert disc.Enqueue(_item())
+    assert disc._timer_started
+    assert not Simulator.IsFinished()
+    reset_world()

@@ -1,98 +1,35 @@
-"""Dependency-free lint gate (no ruff/flake8 in the image — SURVEY.md
-§2.11 style/CI row).  AST-level checks scoped to the defect classes
-reviews actually flagged this round: unused/duplicate MODULE-level
-imports (function-local lazy imports are the repo's idiom and exempt),
-bare excepts, accidental tabs, syntax errors.
+"""Dependency-free lint gate — thin shim over ``tpudes.analysis``.
+
+The four original checks (unused/duplicate module-level imports, bare
+excepts, tabs, syntax errors) now live in the analyzer's style pass as
+rules LNT001–LNT005; this entry point keeps the historical command and
+its no-baseline semantics (the repo stays LNT-clean outright, no
+ratchet).  For the full simulator-aware suite run
+``python -m tpudes.analysis``.
 
 Run: python tools/lint.py  (exits nonzero on findings)
 """
 
-import ast
+import sys
 from pathlib import Path
 
-ROOTS = ("tpudes", "tests", "examples", "tools")
-#: names imported for re-export or registration side effects
-EXPORT_FILES = {"__init__.py"}
-
-
-def _module_imports(tree):
-    """Module-level imports only (the lazy function-local idiom is
-    exempt): yields (lineno, bound_name)."""
-    for node in tree.body:
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                # bound name vs dedup identity: `import importlib.util`
-                # and `import importlib.machinery` both bind `importlib`
-                # but are distinct imports
-                yield node.lineno, (a.asname or a.name).split(".")[0], (
-                    a.asname or a.name
-                )
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue
-            for a in node.names:
-                if a.name != "*":
-                    name = a.asname or a.name
-                    yield node.lineno, name, f"{node.module}.{name}"
-
-
-def _used_names(tree):
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            pass  # the Name at the base is walked separately
-    # names referenced inside docstring-free string annotations etc. are
-    # rare here; __all__ strings count as usage
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            if len(node.value) < 80 and node.value.isidentifier():
-                used.add(node.value)
-    return used
-
-
-def lint_file(path: Path) -> list[str]:
-    src = path.read_text()
-    problems = []
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-
-    if "\t" in src:
-        line = src[: src.index("\t")].count("\n") + 1
-        problems.append(f"{path}:{line}: tab character")
-
-    if path.name not in EXPORT_FILES:
-        used = _used_names(tree)
-        seen: dict[str, int] = {}
-        for lineno, name, ident in _module_imports(tree):
-            if ident in seen and lineno != seen[ident]:
-                problems.append(
-                    f"{path}:{lineno}: duplicate import '{ident}' "
-                    f"(first at line {seen[ident]})"
-                )
-            seen.setdefault(ident, lineno)
-            if name not in used:
-                problems.append(f"{path}:{lineno}: unused import '{name}'")
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            problems.append(f"{path}:{node.lineno}: bare except")
-    return problems
+REPO = Path(__file__).resolve().parent.parent
 
 
 def main() -> int:
-    repo = Path(__file__).resolve().parent.parent
-    problems = []
-    for root in ROOTS:
-        for path in sorted((repo / root).rglob("*.py")):
-            problems.extend(lint_file(path))
-    for p in problems:
-        print(p)
-    print(f"lint: {len(problems)} problem(s)")
-    return 1 if problems else 0
+    sys.path.insert(0, str(REPO))
+    from tpudes.analysis import analyze_paths
+    from tpudes.analysis.engine import DEFAULT_ROOTS
+
+    findings = analyze_paths(
+        [REPO / r for r in DEFAULT_ROOTS if (REPO / r).is_dir()],
+        root=REPO,
+        select=["LNT"],
+    )
+    for f in findings:
+        print(f.render())
+    print(f"lint: {len(findings)} problem(s)")
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,37 @@
+"""tpudes.analysis — simulator-aware static analysis.
+
+A multi-pass AST analyzer for the defect classes a generic linter
+cannot see: trace-impurity inside jit-lifted kernels, jax.random key
+reuse, event ordering fed from unordered containers, leaked scheduled
+events, and TypeId registration drift.  Run as::
+
+    python -m tpudes.analysis            # gate against the baseline
+    python -m tpudes.analysis --list-rules
+
+The ``Pass`` plugin API, inline ``# tpudes: ignore[RULE]``
+suppressions, ``--select``/``--ignore``, JSON output and the
+``tools/analysis_baseline.json`` ratchet are documented in README.md
+("Static analysis").
+"""
+
+from tpudes.analysis.base import Finding, Pass, SourceModule
+from tpudes.analysis.engine import (
+    ALL_PASSES,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    new_findings,
+    register_pass,
+)
+
+__all__ = [
+    "ALL_PASSES",
+    "Finding",
+    "Pass",
+    "SourceModule",
+    "analyze_paths",
+    "analyze_source",
+    "load_baseline",
+    "new_findings",
+    "register_pass",
+]

@@ -1,0 +1,132 @@
+"""CLI: ``python -m tpudes.analysis [paths...]``.
+
+Exit 0 when every finding is covered by the baseline; nonzero when new
+findings exist (the tier-1 gate in tests/test_analysis_gate.py).  With
+explicit paths the same rules run over just those files/dirs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tpudes.analysis.engine import (
+    ALL_PASSES,
+    DEFAULT_BASELINE,
+    DEFAULT_ROOTS,
+    analyze_paths,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+
+
+def _csv(value: str) -> list[str]:
+    return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpudes.analysis",
+        description="tpudes simulator-aware static analysis",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to analyze (default: {DEFAULT_ROOTS})")
+    ap.add_argument("--select", type=_csv, default=None, metavar="CODES",
+                    help="only rules with these code prefixes (e.g. RNG,DET001)")
+    ap.add_argument("--ignore", type=_csv, default=None, metavar="CODES",
+                    help="drop rules with these code prefixes")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help=f"baseline file (default: {DEFAULT_BASELINE} when "
+                         "analyzing the default roots)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring any baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every registered rule code and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from tpudes.analysis.engine import _ensure_builtins
+
+        _ensure_builtins()
+        for p in ALL_PASSES:
+            for code in sorted(p.codes):
+                print(f"{code}  [{p.name}]  {p.codes[code]}")
+        return 0
+
+    root = Path.cwd()
+    explicit = bool(args.paths)
+    if explicit:
+        paths = [Path(p) for p in args.paths]
+        missing = [
+            p for p in paths
+            if not (p.is_dir() or (p.suffix == ".py" and p.is_file()))
+        ]
+        if missing:
+            for p in missing:
+                print(f"analysis: no such file or directory: {p}",
+                      file=sys.stderr)
+            return 2
+    else:
+        paths = [root / r for r in DEFAULT_ROOTS if (root / r).is_dir()]
+        if not paths:
+            print(
+                f"analysis: none of the default roots {DEFAULT_ROOTS} "
+                f"exist under {root} — run from the repo root or pass "
+                "explicit paths", file=sys.stderr,
+            )
+            return 2
+
+    findings = analyze_paths(paths, root=root,
+                             select=args.select, ignore=args.ignore,
+                             project_passes=not explicit)
+
+    # the baseline keys are root-relative, so they apply to subtree
+    # scans launched from the same root too
+    baseline_path = (
+        args.baseline if args.baseline is not None
+        else root / DEFAULT_BASELINE
+    )
+    baseline = {}
+    if not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+
+    if args.write_baseline:
+        if explicit or args.select or args.ignore:
+            print(
+                "analysis: refusing --write-baseline from a narrowed run "
+                "(explicit paths / --select / --ignore would clobber the "
+                "full-repo ratchet)", file=sys.stderr,
+            )
+            return 2
+        write_baseline(baseline_path, findings)
+        print(
+            f"analysis: baselined {len(findings)} finding(s) -> "
+            f"{baseline_path}"
+        )
+        return 0
+
+    fresh = new_findings(findings, baseline)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in fresh],
+            "baselined": len(findings) - len(fresh),
+        }, indent=1))
+    else:
+        for f in fresh:
+            print(f.render())
+        suffix = (
+            f" ({len(findings) - len(fresh)} baselined)" if baseline else ""
+        )
+        print(f"analysis: {len(fresh)} new finding(s){suffix}")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

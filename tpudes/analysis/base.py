@@ -1,0 +1,161 @@
+"""Analyzer plumbing: findings, parsed modules, suppressions, the Pass
+plugin API.
+
+Design (mirrors the dependency-free AST-gate approach tools/lint.py
+proved out — SURVEY.md §2.11): every check is a ``Pass`` with a stable
+rule-code namespace; passes see ``SourceModule`` objects (source + AST
++ per-line suppressions) and emit ``Finding``s.  Baseline identity is
+``path:CODE:message`` — deliberately line-number-free so unrelated
+edits above a pre-existing finding do not re-flag it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+#: inline suppression: ``# tpudes: ignore`` silences every rule on the
+#: line, ``# tpudes: ignore[RNG001,DET002]`` the listed codes only
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpudes:\s*ignore(?:\[([A-Za-z0-9_,\s]+)\])?"
+)
+
+
+class Finding:
+    """One diagnostic: location + rule code + message."""
+
+    __slots__ = ("path", "line", "col", "code", "message")
+
+    def __init__(self, path: str, line: int, col: int, code: str, message: str):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.code = code
+        self.message = message
+
+    @property
+    def key(self) -> str:
+        """Baseline identity (line-number-free on purpose)."""
+        return f"{self.path}:{self.code}:{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "code": self.code,
+            "message": self.message,
+        }
+
+    def __repr__(self):
+        return f"Finding({self.render()!r})"
+
+
+class SourceModule:
+    """One parsed file: source, AST (None on syntax error), posix-style
+    display path, and the per-line suppression table."""
+
+    __slots__ = ("path", "source", "tree", "syntax_error", "_suppress")
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.syntax_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = e
+        self._suppress: dict[int, set[str] | None] = {}
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m is None:
+                continue
+            codes = m.group(1)
+            if codes is None:
+                self._suppress[lineno] = None  # everything on this line
+            else:
+                self._suppress[lineno] = {
+                    c.strip() for c in codes.split(",") if c.strip()
+                }
+
+    @classmethod
+    def from_file(cls, file_path: Path, display_path: str) -> "SourceModule":
+        return cls(display_path, file_path.read_text())
+
+    def suppressed(self, line: int, code: str) -> bool:
+        codes = self._suppress.get(line, False)
+        if codes is False:
+            return False
+        return codes is None or code in codes
+
+    def in_package(self, *parts: str) -> bool:
+        """True when the display path contains the adjacent directory
+        run ``parts`` (e.g. ``in_package("tpudes", "ops")``)."""
+        p = tuple(self.path.split("/"))
+        n = len(parts)
+        return any(p[i : i + n] == parts for i in range(len(p) - n + 1))
+
+
+class Pass:
+    """One analysis pass.  Subclasses declare ``name`` and ``codes``
+    (rule code -> one-line description) and implement ``check_module``
+    — or ``check_project`` for cross-file passes (set
+    ``project_wide = True``).  Register with
+    :func:`tpudes.analysis.register_pass`."""
+
+    name: str = ""
+    codes: dict[str, str] = {}
+    project_wide: bool = False
+    #: only passes that opt in see modules that failed to parse
+    handles_syntax_errors: bool = False
+
+    def applies(self, path: str) -> bool:
+        return True
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        return []
+
+    def check_project(self, mods: list[SourceModule]) -> list[Finding]:
+        out = []
+        for mod in mods:
+            if mod.tree is not None and self.applies(mod.path):
+                out.extend(self.check_module(mod))
+        return out
+
+
+def walk_in_order(node: ast.AST):
+    """Yield descendant nodes in source order (``ast.iter_child_nodes``
+    preserves it) — the linear approximation the flow-sensitive passes
+    (rng-discipline) scan."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        yield from walk_in_order(child)
+
+
+def scope_walk(scope: ast.AST):
+    """Walk a scope in source order WITHOUT descending into nested
+    function definitions (their bodies are separate scopes, scanned on
+    their own) — nested def/lambda nodes themselves are still yielded,
+    since their decorators and defaults evaluate in this scope."""
+    for child in ast.iter_child_nodes(scope):
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+            yield from scope_walk(child)
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

@@ -1,0 +1,190 @@
+"""Analysis driver: file collection, pass execution, rule selection,
+suppression filtering, baseline ratchet.
+
+The baseline (``tools/analysis_baseline.json``) is a count-per-key
+ratchet: pre-existing findings gate *new* regressions without forcing
+a repo-wide cleanup.  A finding's key is ``path:CODE:message`` (no
+line number), so edits that merely move a known finding do not fire.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from tpudes.analysis.base import Finding, Pass, SourceModule
+
+#: default roots, relative to the project root (cwd for the CLI)
+DEFAULT_ROOTS = ("tpudes", "tests", "examples", "tools")
+DEFAULT_BASELINE = "tools/analysis_baseline.json"
+
+ALL_PASSES: list[Pass] = []
+_builtins_loaded = False
+
+
+def register_pass(pass_cls: type) -> type:
+    """Add a Pass subclass to the global registry (plugin hook);
+    returns the class so it can be used as a decorator."""
+    ALL_PASSES.append(pass_cls())
+    return pass_cls
+
+
+def _ensure_builtins():
+    # flag-guarded, not emptiness-guarded: a plugin registered before
+    # the first analysis must not displace the builtin passes
+    global _builtins_loaded
+    if _builtins_loaded:
+        return
+    _builtins_loaded = True
+    from tpudes.analysis.passes import BUILTIN_PASSES
+
+    for cls in BUILTIN_PASSES:
+        register_pass(cls)
+
+
+def _selected(code: str, select, ignore) -> bool:
+    """Prefix match, ruff-style: --select RNG keeps RNG001+RNG002."""
+    if select and not any(code.startswith(s) for s in select):
+        return False
+    if ignore and any(code.startswith(s) for s in ignore):
+        return False
+    return True
+
+
+def collect_modules(paths: list[Path], root: Path) -> list[SourceModule]:
+    files: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    mods = []
+    seen: set[Path] = set()
+    for f in files:
+        resolved = f.resolve()
+        if resolved in seen:
+            continue  # overlapping path args must not double-count
+        seen.add(resolved)
+        try:
+            rel = resolved.relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        mods.append(SourceModule.from_file(f, rel))
+    return mods
+
+
+def run_passes(
+    mods: list[SourceModule],
+    passes: list[Pass] | None = None,
+    select: list[str] | None = None,
+    ignore: list[str] | None = None,
+    project_passes: bool = True,
+) -> list[Finding]:
+    _ensure_builtins()
+    passes = ALL_PASSES if passes is None else passes
+    by_path = {m.path: m for m in mods}
+    findings: list[Finding] = []
+    for p in passes:
+        if select or ignore:
+            if not any(_selected(c, select, ignore) for c in p.codes):
+                continue
+        if p.project_wide:
+            # cross-file passes are sound only over the full module
+            # set: a subtree scan cannot see references living outside
+            # it and would flag live registrations as dead
+            if not project_passes:
+                continue
+            found = p.check_project(mods)
+        else:
+            found = []
+            for mod in mods:
+                if not p.applies(mod.path):
+                    continue
+                if mod.tree is None and not p.handles_syntax_errors:
+                    continue
+                found.extend(p.check_module(mod))
+        findings.extend(found)
+    out = []
+    for f in findings:
+        if not _selected(f.code, select, ignore):
+            continue
+        mod = by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.line, f.code):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def analyze_paths(
+    paths: list[str | Path],
+    root: str | Path = ".",
+    select: list[str] | None = None,
+    ignore: list[str] | None = None,
+    project_passes: bool = True,
+) -> list[Finding]:
+    root = Path(root)
+    mods = collect_modules([Path(p) for p in paths], root)
+    return run_passes(mods, select=select, ignore=ignore,
+                      project_passes=project_passes)
+
+
+def analyze_source(
+    source: str,
+    path: str = "tpudes/snippet.py",
+    select: list[str] | None = None,
+    extra_modules: list[tuple[str, str]] | None = None,
+) -> list[Finding]:
+    """Analyze an in-memory snippet (the fixture-test entry point).
+    ``path`` participates in pass scoping (e.g. ``tpudes/ops/x.py``
+    lands in the device-path scope); ``extra_modules`` are
+    ``(path, source)`` companions for project-wide passes."""
+    mods = [SourceModule(path, source)]
+    for p, src in extra_modules or ():
+        mods.append(SourceModule(p, src))
+    return [f for f in run_passes(mods, select=select) if f.path == path]
+
+
+# --- baseline ratchet -----------------------------------------------------
+
+def load_baseline(path: str | Path) -> dict[str, int]:
+    p = Path(path)
+    if not p.exists():
+        return {}
+    data = json.loads(p.read_text())
+    return {str(k): int(v) for k, v in data.get("counts", {}).items()}
+
+
+def baseline_counts(findings: list[Finding]) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    return counts
+
+
+def write_baseline(path: str | Path, findings: list[Finding]) -> None:
+    counts = baseline_counts(findings)
+    payload = {
+        "version": 1,
+        "comment": (
+            "Known findings gated by `python -m tpudes.analysis`. Keys are "
+            "path:CODE:message (line-free). Regenerate with "
+            "--write-baseline after an intentional cleanup."
+        ),
+        "counts": {k: counts[k] for k in sorted(counts)},
+    }
+    Path(path).write_text(json.dumps(payload, indent=1) + "\n")
+
+
+def new_findings(
+    findings: list[Finding], baseline: dict[str, int]
+) -> list[Finding]:
+    """Findings beyond the baselined count for their key."""
+    remaining = dict(baseline)
+    out = []
+    for f in findings:
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+        else:
+            out.append(f)
+    return out

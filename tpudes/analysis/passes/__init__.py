@@ -1,0 +1,19 @@
+"""Built-in analysis passes.  Importing this package registers them;
+third-party passes call :func:`tpudes.analysis.register_pass` directly.
+"""
+
+from tpudes.analysis.passes.determinism import DeterminismPass
+from tpudes.analysis.passes.event_hygiene import EventHygienePass
+from tpudes.analysis.passes.jit_purity import JitPurityPass
+from tpudes.analysis.passes.registry_parity import RegistryParityPass
+from tpudes.analysis.passes.rng_discipline import RngDisciplinePass
+from tpudes.analysis.passes.style import StylePass
+
+BUILTIN_PASSES = [
+    StylePass,
+    JitPurityPass,
+    RngDisciplinePass,
+    DeterminismPass,
+    EventHygienePass,
+    RegistryParityPass,
+]

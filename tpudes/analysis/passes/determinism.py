@@ -1,0 +1,141 @@
+"""determinism pass: event order must not depend on interpreter state.
+
+The simulator's reproducibility contract is (ts, uid) total order with
+uids handed out in schedule-call order — so the *schedule-call order*
+itself must be deterministic.  Two ways repos break it:
+
+DET001 — ``Simulator.Schedule*`` (or ``.Insert`` on a scheduler)
+invoked from a loop over a ``set``/``frozenset`` (literal, call, or a
+name assigned from one in the same function): set iteration order
+varies with PYTHONHASHSEED, so uids — and therefore event tie-breaks —
+differ run to run.
+
+DET002 — ``id()`` inside a sort key (``sorted``/``.sort``/``min``/
+``max`` key callables, or elements of a tuple-building sort key):
+CPython ids are allocation addresses, unstable across runs, so any
+ordering derived from them is unreproducible.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudes.analysis.base import (
+    Finding,
+    Pass,
+    SourceModule,
+    dotted_name,
+    scope_walk,
+)
+
+_SCHEDULE_NAMES = {
+    "Schedule", "ScheduleNow", "ScheduleWithContext", "ScheduleAt",
+    "ScheduleDestroy", "Insert",
+}
+_SORTERS = {"sorted", "min", "max"}
+
+
+def _is_set_expr(node: ast.AST, set_names: set[str]) -> bool:
+    if isinstance(node, ast.Set):
+        return True
+    if isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func)
+        if dn in ("set", "frozenset"):
+            return True
+        # set-algebra results are sets too
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "union", "intersection", "difference", "symmetric_difference",
+        ):
+            return _is_set_expr(node.func.value, set_names)
+    if isinstance(node, ast.Name):
+        return node.id in set_names
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitAnd, ast.BitOr, ast.Sub, ast.BitXor)
+    ):
+        return _is_set_expr(node.left, set_names) or _is_set_expr(
+            node.right, set_names
+        )
+    return False
+
+
+def _schedule_call(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _SCHEDULE_NAMES:
+        dn = dotted_name(f)
+        return dn or f.attr
+    return None
+
+
+class DeterminismPass(Pass):
+    name = "determinism"
+    codes = {
+        "DET001": "event scheduled from set iteration (hash-order-dependent)",
+        "DET002": "id() used as a sort / tie-break key",
+    }
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        out: list[Finding] = []
+        for scope in ast.walk(mod.tree):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+                out.extend(self._check_scope(mod, scope))
+        return out
+
+    def _check_scope(self, mod, scope) -> list[Finding]:
+        out: list[Finding] = []
+        # DET001: one in-source-order pass tracking which names hold a
+        # set RIGHT NOW — `backlog = sorted(backlog)` un-marks the
+        # name, so scheduling from the sorted rebind stays clean
+        set_names: set[str] = set()
+        for node in scope_walk(scope):
+            if isinstance(node, ast.Assign):
+                is_set = _is_set_expr(node.value, set_names)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        (set_names.add if is_set
+                         else set_names.discard)(t.id)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    if _is_set_expr(node.value, set_names):
+                        set_names.add(node.target.id)
+                    else:
+                        set_names.discard(node.target.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)) and _is_set_expr(
+                node.iter, set_names
+            ):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        sched = _schedule_call(sub)
+                        if sched is not None:
+                            out.append(Finding(
+                                mod.path, sub.lineno, sub.col_offset,
+                                "DET001",
+                                f"'{sched}' called while iterating a set — "
+                                "uid order follows PYTHONHASHSEED",
+                            ))
+
+        # DET002: id() inside sort keys
+        for node in scope_walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_sorter = (
+                isinstance(f, ast.Name) and f.id in _SORTERS
+            ) or (isinstance(f, ast.Attribute) and f.attr == "sort")
+            if not is_sorter:
+                continue
+            key_exprs = [k.value for k in node.keywords if k.arg == "key"]
+            for key in key_exprs:
+                for sub in ast.walk(key):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "id"
+                    ):
+                        out.append(Finding(
+                            mod.path, sub.lineno, sub.col_offset, "DET002",
+                            "id() in a sort key — object addresses are "
+                            "not stable across runs",
+                        ))
+        return out

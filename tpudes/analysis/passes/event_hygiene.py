@@ -1,0 +1,179 @@
+"""event-hygiene pass: leaked events and swallowed callback errors.
+
+EVT401-class bugs produced two of this round's advisor findings (the
+PIE timer mis-arm and the 6LoWPAN reassembly leak — ADVICE.md), so the
+heuristics here are tuned to those shapes:
+
+EVT001 — inside a class that defines a stop/teardown method, an
+expression-statement ``Simulator.Schedule``/``ScheduleWithContext``
+whose EventId is dropped: teardown cannot Cancel what it never held, so
+the event outlives the object (the classic "simulation never drains"
+leak).  ``ScheduleNow``/``ScheduleDestroy`` are exempt (immediate /
+teardown-by-design).
+
+EVT002 — ``except Exception: pass`` (or BaseException) inside a
+function in a Simulator-importing module: an event callback that
+swallows everything turns a model bug into silent event loss.
+
+EVT003 — a keyed buffer (``self.X`` dict) whose entries are removed
+only on completion (``del self.X[k]`` / ``.pop``) in a class that never
+schedules any event: nothing expires a stranded entry, so one lost
+packet leaks the buffer forever (the pre-fix 6LoWPAN reassembly shape;
+cf. Ipv4L3Protocol._expire_fragments).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudes.analysis.base import Finding, Pass, SourceModule, dotted_name
+
+_TEARDOWN_NAMES = {
+    "StopApplication", "DoDispose", "Dispose", "Stop", "stop",
+    "teardown", "Teardown", "close", "Close",
+}
+_LEAKY_SCHEDULES = {"Schedule", "ScheduleWithContext", "ScheduleAt"}
+
+
+def _simulator_schedule(node: ast.Call) -> str | None:
+    """'Simulator.Schedule*' attr name for a call target, or None."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr.startswith("Schedule"):
+        dn = dotted_name(f)
+        if dn is not None and "Simulator" in dn.split("."):
+            return f.attr
+    return None
+
+
+class EventHygienePass(Pass):
+    name = "event-hygiene"
+    codes = {
+        "EVT001": "scheduled EventId dropped in a class with a teardown method",
+        "EVT002": "except Exception: pass swallows event-callback errors",
+        "EVT003": "keyed buffer with completion-only cleanup and no expiry event",
+    }
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        out: list[Finding] = []
+        uses_simulator = (
+            "Simulator" in mod.source or "simulator" in mod.source
+        )
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(mod, node))
+        if uses_simulator:
+            out.extend(self._check_swallows(mod))
+        return out
+
+    # --- EVT001 + EVT003 --------------------------------------------------
+    def _check_class(self, cls_mod, cls: ast.ClassDef) -> list[Finding]:
+        mod = cls_mod
+        out: list[Finding] = []
+        method_names = {
+            n.name for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        has_teardown = bool(method_names & _TEARDOWN_NAMES)
+
+        schedules_any = False
+        completion_deletes: dict[str, ast.AST] = {}  # attr -> first del site
+        keyed_buffers: set[str] = set()
+
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) and _simulator_schedule(node):
+                schedules_any = True
+            # keyed accumulation: self.X[k] = ... or self.X.setdefault
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and isinstance(t.value.value, ast.Name)
+                        and t.value.value.id == "self"
+                    ):
+                        keyed_buffers.add(t.value.attr)
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "setdefault"
+                and isinstance(node.func.value, ast.Attribute)
+                and isinstance(node.func.value.value, ast.Name)
+                and node.func.value.value.id == "self"
+            ):
+                keyed_buffers.add(node.func.value.attr)
+            # completion-only cleanup: del self.X[k] / self.X.pop(k)
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and isinstance(t.value.value, ast.Name)
+                        and t.value.value.id == "self"
+                    ):
+                        completion_deletes.setdefault(t.value.attr, t)
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "pop"
+                and isinstance(node.func.value, ast.Attribute)
+                and isinstance(node.func.value.value, ast.Name)
+                and node.func.value.value.id == "self"
+                and node.args
+            ):
+                completion_deletes.setdefault(node.func.value.attr, node)
+
+        if has_teardown:
+            for stmt in ast.walk(cls):
+                if not (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                ):
+                    continue
+                call = stmt.value
+                dn = dotted_name(call.func)
+                if (
+                    dn is not None
+                    and "Simulator" in dn.split(".")
+                    and dn.rsplit(".", 1)[-1] in _LEAKY_SCHEDULES
+                ):
+                    out.append(Finding(
+                        mod.path, stmt.lineno, stmt.col_offset, "EVT001",
+                        f"'{dn}' EventId dropped — class '{cls.name}' has a "
+                        "teardown method that can never Cancel it",
+                    ))
+
+        if not schedules_any:
+            for attr, site in completion_deletes.items():
+                if attr in keyed_buffers:
+                    out.append(Finding(
+                        mod.path, site.lineno, site.col_offset, "EVT003",
+                        f"keyed buffer 'self.{attr}' in '{cls.name}' is "
+                        "cleaned up only on completion and the class never "
+                        "schedules an expiry — stranded entries leak forever",
+                    ))
+        return out
+
+    # --- EVT002 -----------------------------------------------------------
+    def _check_swallows(self, mod: SourceModule) -> list[Finding]:
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            t = node.type
+            name = dotted_name(t) if t is not None else None
+            if name not in ("Exception", "BaseException"):
+                continue
+            body_is_noop = all(
+                isinstance(s, ast.Pass)
+                or (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                    and s.value.value is Ellipsis)
+                for s in node.body
+            )
+            if body_is_noop:
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, "EVT002",
+                    f"'except {name}: pass' silently swallows callback "
+                    "errors (event loss with no trace)",
+                ))
+        return out

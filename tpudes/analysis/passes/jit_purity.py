@@ -1,0 +1,306 @@
+"""jit-purity pass: Python side effects inside traced code.
+
+A function lifted onto the replica/topology axes (``jax.jit`` /
+``vmap`` / ``pmap`` decorators, functions handed to those transforms or
+to ``lax.scan``/``while_loop``/``cond``/``fori_loop``) executes once at
+trace time — wall-clock reads, prints, host RNG draws and mutation of
+Python state silently bake one trace's value into every replica.
+
+Traced regions are found per module: decorated defs, bare-name
+arguments to transform calls, lambdas inside ``lax.*`` control-flow
+calls, plus everything nested inside those.  The wall-clock / print /
+host-RNG rules additionally apply module-wide in ``tpudes/ops/`` and
+``tpudes/parallel/`` — every line there is on or next to the device
+path (ISSUE 1 tentpole scope).
+
+JP001 wall-clock ``time.*`` · JP002 ``print`` · JP003 host RNG
+(``np.random``/stdlib ``random``) · JP004 mutation of ``self`` /
+globals / captured containers (traced regions only).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudes.analysis.base import Finding, Pass, SourceModule, dotted_name
+
+_TRANSFORMS = {"jit", "vmap", "pmap"}
+_LAX_HOF_TAILS = {
+    "lax.scan", "lax.while_loop", "lax.cond", "lax.fori_loop",
+    "lax.map", "lax.switch", "lax.associative_scan",
+}
+_TIME_FUNCS = {
+    "time", "monotonic", "perf_counter", "process_time", "time_ns",
+    "monotonic_ns", "perf_counter_ns",
+}
+_MUTATORS = {
+    "append", "extend", "insert", "add", "discard", "update", "pop",
+    "popitem", "remove", "clear", "setdefault", "sort", "reverse",
+    "appendleft", "extendleft",
+}
+
+
+def _alias_map(tree: ast.Module) -> dict[str, set[str]]:
+    """Module-level import aliases for the modules this pass cares
+    about: ``{"time": {...}, "numpy": {...}, "random": {...},
+    "np_random": {...}, "time_funcs": {...}}``.  ``from jax import
+    random`` deliberately does NOT land in the stdlib ``random``
+    bucket."""
+    out: dict[str, set[str]] = {
+        "time": set(), "numpy": set(), "random": set(),
+        "np_random": set(), "time_funcs": set(),
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                if a.name == "time" or a.name.startswith("time."):
+                    out["time"].add(bound)
+                elif a.name == "numpy" or a.name.startswith("numpy."):
+                    out["numpy"].add(bound)
+                elif a.name == "random":
+                    out["random"].add(bound)
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                bound = a.asname or a.name
+                if node.module == "time" and a.name in _TIME_FUNCS:
+                    out["time_funcs"].add(bound)
+                elif node.module == "numpy" and a.name == "random":
+                    out["np_random"].add(bound)
+                elif node.module == "random":
+                    out["random"].add(bound)  # stdlib draw functions
+    return out
+
+
+def _is_transform_ref(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``jax.numpy...vmap`` style reference."""
+    if isinstance(node, ast.Name):
+        return node.id in _TRANSFORMS
+    dn = dotted_name(node)
+    return dn is not None and dn.rsplit(".", 1)[-1] in _TRANSFORMS
+
+
+def _decorator_is_transform(dec: ast.AST) -> bool:
+    if _is_transform_ref(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(static_argnums=...) or @partial(jax.jit, ...)
+        if _is_transform_ref(dec.func):
+            return True
+        return any(_is_transform_ref(a) for a in dec.args)
+    return False
+
+
+def _traced_regions(tree: ast.Module) -> list[ast.AST]:
+    """FunctionDef / Lambda nodes whose bodies execute under trace."""
+    traced_names: set[str] = set()
+    regions: list[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_decorator_is_transform(d) for d in node.decorator_list):
+                regions.append(node)
+        elif isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            is_transform = _is_transform_ref(node.func)
+            is_lax_hof = dn is not None and any(
+                dn == t or dn.endswith("." + t) for t in _LAX_HOF_TAILS
+            )
+            if is_transform or is_lax_hof:
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        traced_names.add(a.id)
+                    elif isinstance(a, ast.Lambda):
+                        regions.append(a)
+    if traced_names:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in traced_names
+                and node not in regions
+            ):
+                regions.append(node)
+    return regions
+
+
+def _binding_names(target: ast.AST):
+    """Names a target BINDS.  ``x = ...`` and ``x, y = ...`` bind; an
+    Attribute/Subscript target (``obj.f = ...``, ``d[k] = ...``)
+    mutates its receiver and binds nothing — the distinction JP004
+    rides on."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from _binding_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from _binding_names(target.value)
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Names bound inside the function (params, assignments, loop and
+    comprehension targets, local imports, nested defs) — receivers NOT
+    in this set are captured or global state."""
+    bound: set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn.args
+        for arg in (
+            a.posonlyargs + a.args + a.kwonlyargs
+            + ([a.vararg] if a.vararg else [])
+            + ([a.kwarg] if a.kwarg else [])
+        ):
+            bound.add(arg.arg)
+    elif isinstance(fn, ast.Lambda):
+        a = fn.args
+        for arg in a.posonlyargs + a.args + a.kwonlyargs:
+            bound.add(arg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                bound.update(_binding_names(t))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            bound.update(_binding_names(node.target))
+        elif isinstance(node, ast.comprehension):
+            bound.update(_binding_names(node.target))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node is not fn:
+                bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                bound.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+            bound.update(_binding_names(node.optional_vars))
+        elif isinstance(node, ast.NamedExpr):
+            bound.add(node.target.id)
+    return bound
+
+
+class JitPurityPass(Pass):
+    name = "jit-purity"
+    codes = {
+        "JP001": "wall-clock time.* in traced/device-path code",
+        "JP002": "print() in traced/device-path code",
+        "JP003": "host RNG (np.random / stdlib random) in traced/device-path code",
+        "JP004": "mutation of self/global/captured state in traced code",
+    }
+
+    def applies(self, path: str) -> bool:
+        return path.split("/")[0] == "tpudes" or "/tpudes/" in path
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        aliases = _alias_map(mod.tree)
+        regions = _traced_regions(mod.tree)
+        findings: dict[tuple, Finding] = {}
+
+        def put(node, code, message):
+            k = (node.lineno, node.col_offset, code)
+            if k not in findings:
+                findings[k] = Finding(
+                    mod.path, node.lineno, node.col_offset, code, message
+                )
+
+        def check_effect_call(node: ast.Call):
+            func = node.func
+            if isinstance(func, ast.Name):
+                if func.id == "print":
+                    put(node, "JP002", "print() executes at trace time only")
+                elif func.id in aliases["time_funcs"]:
+                    put(node, "JP001",
+                        f"wall-clock '{func.id}()' freezes one trace-time "
+                        "value into the compiled program")
+                elif func.id in aliases["random"] and not func.id[:1].isupper():
+                    put(node, "JP003",
+                        f"stdlib random '{func.id}()' bypasses the seeded "
+                        "stream API")
+                return
+            dn = dotted_name(func)
+            if dn is None:
+                return
+            head, _, rest = dn.partition(".")
+            if head in aliases["time"] and rest:
+                put(node, "JP001",
+                    f"wall-clock '{dn}()' freezes one trace-time value "
+                    "into the compiled program")
+            elif head in aliases["numpy"] and rest.startswith("random."):
+                put(node, "JP003",
+                    f"'{dn}()' draws from host numpy RNG (use the seeded "
+                    "stream API / jax.random)")
+            elif head in aliases["np_random"] and rest:
+                put(node, "JP003",
+                    f"'{dn}()' draws from host numpy RNG (use the seeded "
+                    "stream API / jax.random)")
+            elif head in aliases["random"] and rest:
+                put(node, "JP003",
+                    f"'{dn}()' draws from stdlib random (use the seeded "
+                    "stream API)")
+
+        # JP001/2/3: module-wide on the device path, else traced regions
+        if mod.in_package("tpudes", "ops") or mod.in_package("tpudes", "parallel"):
+            effect_scopes: list[ast.AST] = [mod.tree]
+        else:
+            effect_scopes = list(regions)
+        for scope in effect_scopes:
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Call):
+                    check_effect_call(node)
+
+        # JP004: mutation, traced regions only.  Module aliases (jnp,
+        # np, jax...) are function namespaces, not mutable receivers —
+        # jnp.sort(x) is pure
+        module_aliases: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    module_aliases.add((a.asname or a.name).split(".")[0])
+        for region in regions:
+            bound = _bound_names(region)
+
+            def is_impure_receiver(node: ast.AST) -> bool:
+                base = node
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                if isinstance(base, ast.Name):
+                    if base.id == "self":
+                        return True
+                    if base.id in module_aliases:
+                        return False
+                    # a bare free Name is captured or global state; its
+                    # attributes/items are host objects either way
+                    return base.id not in bound
+                return False
+
+            for node in ast.walk(region):
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, (ast.Attribute, ast.Subscript)) and (
+                            is_impure_receiver(t)
+                        ):
+                            put(node, "JP004",
+                                "assignment to self/captured/global state "
+                                "inside traced code")
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, (ast.Attribute, ast.Subscript)) and (
+                            is_impure_receiver(t)
+                        ):
+                            put(node, "JP004",
+                                "del on self/captured/global state inside "
+                                "traced code")
+                elif isinstance(node, ast.Global):
+                    put(node, "JP004", "global statement inside traced code")
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and f.attr in _MUTATORS
+                        and is_impure_receiver(f.value)
+                        and not isinstance(f.value, ast.Call)
+                    ):
+                        put(node, "JP004",
+                            f"'.{f.attr}()' mutates self/captured/global "
+                            "state inside traced code")
+        return sorted(findings.values(), key=lambda f: (f.line, f.col, f.code))

@@ -1,0 +1,138 @@
+"""registry-parity pass: dead TypeId registration drift.
+
+Every ``AddAttribute``/``AddTraceSource`` declaration carries an
+upstream ns-3 name and binds a Python field; this repo's idiom is to
+keep the declared surface in lockstep with what model code actually
+reads (``self.<field>``) or scripts configure/connect (the name as a
+string).  A declaration nothing references is drift: either the port
+of the upstream behavior was dropped, or the registration outlived a
+refactor.
+
+REG001 fires when neither the declared name nor its bound field is
+referenced anywhere in the analyzed project — as a whole word inside
+any string constant (``SetAttribute("DataRate", ...)``, Config paths),
+as an attribute access / bare name, or as a keyword argument
+(``DataRate="5Mbps"`` construction).  Strings inside the declaration
+calls themselves do not count (one class's declaration must not
+launder another's).
+
+This is the only project-wide pass: declarations come from ``tpudes/``
+modules, references from every analyzed file (tests pin trace names).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+# the canonical name->field rule — the analyzer must derive the exact
+# field the runtime binds, or REG001 misreads live attributes as dead
+from tpudes.core.object import _default_field
+from tpudes.analysis.base import Finding, Pass, SourceModule
+
+_DECL_METHODS = {"AddAttribute", "AddTraceSource"}
+_WORD_SPLIT = re.compile(r"[^A-Za-z0-9_]+")
+
+
+def _enclosing_typeid_name(call: ast.Call) -> str | None:
+    """Walk the fluent chain ``TypeId("x").SetParent(...).Add...``
+    down to the TypeId(...) constructor and return its name arg."""
+    node: ast.AST = call
+    while isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "TypeId":
+            if node.args and isinstance(node.args[0], ast.Constant):
+                return node.args[0].value
+            return None
+        if isinstance(f, ast.Attribute):
+            node = f.value
+        else:
+            return None
+    return None
+
+
+class RegistryParityPass(Pass):
+    name = "registry-parity"
+    codes = {
+        "REG001": "TypeId attribute/trace source declared but never referenced",
+    }
+    project_wide = True
+
+    def check_project(self, mods: list[SourceModule]) -> list[Finding]:
+        decls = []       # (mod, node, kind, name, field, tid_name)
+        decl_calls = []  # the Call nodes, to exclude from reference text
+        for mod in mods:
+            if mod.tree is None or not mod.in_package("tpudes"):
+                continue
+            for node in ast.walk(mod.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _DECL_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    continue
+                name = node.args[0].value
+                field = None
+                for kw in node.keywords:
+                    if kw.arg == "field" and isinstance(kw.value, ast.Constant):
+                        field = kw.value.value
+                if field is None and node.func.attr == "AddAttribute":
+                    if len(node.args) >= 4 and isinstance(
+                        node.args[3], ast.Constant
+                    ):
+                        field = node.args[3].value
+                if field is None:
+                    field = _default_field(name)
+                kind = (
+                    "attribute" if node.func.attr == "AddAttribute"
+                    else "trace source"
+                )
+                decls.append(
+                    (mod, node, kind, name, field,
+                     _enclosing_typeid_name(node))
+                )
+                decl_calls.append(node)
+        if not decls:
+            return []
+
+        # reference universe, with declaration-call subtrees excluded
+        excluded_consts: set[int] = set()
+        for call in decl_calls:
+            for sub in ast.walk(call):
+                if isinstance(sub, ast.Constant):
+                    excluded_consts.add(id(sub))
+        words: set[str] = set()
+        idents: set[str] = set()
+        for mod in mods:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    if id(node) not in excluded_consts and len(node.value) < 400:
+                        words.update(_WORD_SPLIT.split(node.value))
+                elif isinstance(node, ast.Attribute):
+                    idents.add(node.attr)
+                elif isinstance(node, ast.Name):
+                    idents.add(node.id)
+                elif isinstance(node, ast.keyword) and node.arg:
+                    idents.add(node.arg)
+
+        out: list[Finding] = []
+        for mod, node, kind, name, field, tid_name in decls:
+            if name in words or name in idents:
+                continue
+            if field in idents or field in words:
+                continue
+            where = f" on {tid_name}" if tid_name else ""
+            out.append(Finding(
+                mod.path, node.args[0].lineno, node.args[0].col_offset,
+                "REG001",
+                f"{kind} '{name}'{where} (field '{field}') is declared "
+                "but never set/get/connected/read anywhere",
+            ))
+        return out

@@ -1,0 +1,232 @@
+"""rng-discipline pass: jax.random key hygiene + seeded-stream bypass.
+
+RNG001 — a ``jax.random`` key consumed by two calls without an
+intervening ``split``/``fold_in`` produces *identical* draws; on the
+replica axis that correlates every replica's noise.  The scan is a
+linter-grade abstract interpretation per function: statements in
+source order, ``if``/``else`` arms forked and OR-merged, rebinding
+from a non-deriver source dropping the tracked state (loop bodies get
+one linear pass, so per-iteration reuse is under-reported).
+
+RNG002 — host RNG (``np.random`` / stdlib ``random``) anywhere in
+``tpudes/`` outside ``tpudes/core/rng.py`` bypasses the MRG32k3a /
+threefry seeded stream API, breaking the RngSeedManager reproducibility
+contract (run/substream selection never reaches it).
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+
+from tpudes.analysis.base import (
+    Finding,
+    Pass,
+    SourceModule,
+    dotted_name,
+    walk_in_order,
+)
+
+#: parameters assumed to carry a PRNG key when named like one
+_KEY_PARAMS = {"key", "subkey", "rng_key", "prng_key", "rngkey"}
+#: jax.random functions that *derive* keys rather than draw with them
+_DERIVERS = {"split", "fold_in", "PRNGKey", "key", "clone", "wrap_key_data"}
+
+
+def _jax_random_aliases(tree: ast.Module) -> set[str]:
+    """Bound names that refer to the ``jax.random`` module."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        out.add(a.asname or "random")
+    return out
+
+
+def _np_and_stdlib_random(tree: ast.Module):
+    """(numpy module aliases, stdlib random aliases, names imported
+    from stdlib random)."""
+    np_alias, rand_alias, rand_funcs = set(), set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                if a.name == "numpy" or a.name.startswith("numpy."):
+                    np_alias.add(bound)
+                elif a.name == "random":
+                    rand_alias.add(bound)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "random":
+                for a in node.names:
+                    rand_funcs.add(a.asname or a.name)
+    return np_alias, rand_alias, rand_funcs
+
+
+class RngDisciplinePass(Pass):
+    name = "rng-discipline"
+    codes = {
+        "RNG001": "jax.random key consumed twice without split/fold_in",
+        "RNG002": "RNG use that bypasses the seeded stream API",
+    }
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        out: list[Finding] = []
+        jr = _jax_random_aliases(mod.tree)
+        # jax.random is reachable as jax.random.X without an alias too
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_function(mod, node, jr))
+        out.extend(self._check_bypass(mod))
+        return out
+
+    # --- RNG001 -----------------------------------------------------------
+    def _jax_random_callee(self, func: ast.AST, jr: set[str]) -> str | None:
+        """The jax.random function name for a call target, or None."""
+        dn = dotted_name(func)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        if len(parts) >= 3 and parts[-3] == "jax" and parts[-2] == "random":
+            return parts[-1]
+        if len(parts) == 2 and parts[0] in jr:
+            return parts[1]
+        return None
+
+    def _check_function(self, mod, fn, jr) -> list[Finding]:
+        """Abstract interpretation of key consumption, one function at
+        a time.  Branches of an ``if``/``try`` fork the state and merge
+        with OR (consumed-on-either-path counts), so mutually-exclusive
+        ``split`` calls do not false-positive.  Loops and nested defs
+        get a single linear pass of their own."""
+        out: list[Finding] = []
+        keys: dict[str, bool] = {}  # name -> consumed?
+        a = fn.args
+        for arg in a.posonlyargs + a.args + a.kwonlyargs:
+            if arg.arg in _KEY_PARAMS:
+                keys[arg.arg] = False
+
+        def scan_expr(expr: ast.AST):
+            """Consume keys used by jax.random calls, in source order
+            (the expression node itself included).  Derivers
+            (split/fold_in) flag an already-consumed key but do NOT
+            consume: deriving several children from one parent key —
+            ``fold_in(key, 1)`` then ``fold_in(key, 2)`` — is the
+            idiomatic safe pattern."""
+            for node in itertools.chain([expr], walk_in_order(expr)):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self._jax_random_callee(node.func, jr)
+                if callee is None or callee == "PRNGKey":
+                    continue
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in keys:
+                        if keys[arg.id]:
+                            out.append(Finding(
+                                mod.path, arg.lineno, arg.col_offset,
+                                "RNG001",
+                                f"key '{arg.id}' already consumed — reuse "
+                                "without split/fold_in repeats the same "
+                                "draw",
+                            ))
+                        if callee not in _DERIVERS:
+                            keys[arg.id] = True
+
+        def scan_stmts(stmts: list[ast.stmt]):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # own scope, scanned separately
+                if isinstance(stmt, ast.If):
+                    scan_expr(stmt.test)
+                    before = dict(keys)
+                    scan_stmts(stmt.body)
+                    after_body = dict(keys)
+                    keys.clear()
+                    keys.update(before)
+                    scan_stmts(stmt.orelse)
+                    for name in set(after_body) | set(keys):
+                        keys[name] = after_body.get(name, False) or keys.get(
+                            name, False
+                        )
+                elif isinstance(stmt, ast.Try):
+                    scan_stmts(stmt.body)
+                    for h in stmt.handlers:
+                        scan_stmts(h.body)
+                    scan_stmts(stmt.orelse)
+                    scan_stmts(stmt.finalbody)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    scan_expr(stmt.iter)
+                    scan_stmts(stmt.body)
+                    scan_stmts(stmt.orelse)
+                elif isinstance(stmt, ast.While):
+                    scan_expr(stmt.test)
+                    scan_stmts(stmt.body)
+                    scan_stmts(stmt.orelse)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        scan_expr(item.context_expr)
+                    scan_stmts(stmt.body)
+                elif isinstance(stmt, ast.Assign):
+                    # the RHS consumes first, THEN targets rebind fresh:
+                    # `key, sub = split(key)` leaves `key` fresh
+                    scan_expr(stmt.value)
+                    callee = (
+                        self._jax_random_callee(stmt.value.func, jr)
+                        if isinstance(stmt.value, ast.Call) else None
+                    )
+                    for t in stmt.targets:
+                        for sub in ast.walk(t):
+                            if not isinstance(sub, ast.Name):
+                                continue
+                            if callee in _DERIVERS:
+                                keys[sub.id] = False
+                            else:
+                                # rebound from an unknown source: stop
+                                # tracking rather than carry a stale
+                                # consumed flag onto a fresh key
+                                keys.pop(sub.id, None)
+                else:
+                    scan_expr(stmt)
+
+        scan_stmts(fn.body)
+        return out
+
+    # --- RNG002 -----------------------------------------------------------
+    def _check_bypass(self, mod: SourceModule) -> list[Finding]:
+        if not mod.in_package("tpudes") or mod.path.endswith("core/rng.py"):
+            return []
+        np_alias, rand_alias, rand_funcs = _np_and_stdlib_random(mod.tree)
+        if not (np_alias or rand_alias or rand_funcs):
+            return []
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is not None:
+                head, _, rest = dn.partition(".")
+                if head in np_alias and rest.startswith("random."):
+                    out.append(Finding(
+                        mod.path, node.lineno, node.col_offset, "RNG002",
+                        f"'{dn}()' bypasses the seeded stream API "
+                        "(RngSeedManager run/substream never reaches it)",
+                    ))
+                elif head in rand_alias and rest:
+                    out.append(Finding(
+                        mod.path, node.lineno, node.col_offset, "RNG002",
+                        f"'{dn}()' uses stdlib random instead of the "
+                        "seeded stream API",
+                    ))
+            elif isinstance(node.func, ast.Name) and node.func.id in rand_funcs:
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, "RNG002",
+                    f"'{node.func.id}()' uses stdlib random instead of "
+                    "the seeded stream API",
+                ))
+        return out

@@ -1,0 +1,103 @@
+"""Style pass: the four generic gates ported from the original
+``tools/lint.py`` (which is now a thin shim over this pass).
+
+LNT001 syntax error · LNT002 tab character · LNT003 unused
+module-level import · LNT004 duplicate import · LNT005 bare except.
+Function-local lazy imports remain the repo's idiom and are exempt;
+``__init__.py`` re-export imports are exempt from LNT003.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudes.analysis.base import Finding, Pass, SourceModule
+
+#: names imported for re-export or registration side effects
+EXPORT_FILES = {"__init__.py"}
+
+
+def _module_imports(tree):
+    """Module-level imports only: yields (lineno, bound_name, identity).
+    Identity distinguishes ``import importlib.util`` from
+    ``import importlib.machinery`` (same bound name, distinct imports).
+    """
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                yield node.lineno, (a.asname or a.name).split(".")[0], (
+                    a.asname or a.name
+                )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name != "*":
+                    name = a.asname or a.name
+                    yield node.lineno, name, f"{node.module}.{name}"
+
+
+def _used_names(tree):
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+    # __all__ strings (and other short identifier-shaped constants)
+    # count as usage, as in the original lint
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if len(node.value) < 80 and node.value.isidentifier():
+                used.add(node.value)
+    return used
+
+
+class StylePass(Pass):
+    name = "style"
+    handles_syntax_errors = True
+    codes = {
+        "LNT001": "syntax error",
+        "LNT002": "tab character",
+        "LNT003": "unused module-level import",
+        "LNT004": "duplicate module-level import",
+        "LNT005": "bare except",
+    }
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        out: list[Finding] = []
+        if "\t" in mod.source:
+            line = mod.source[: mod.source.index("\t")].count("\n") + 1
+            out.append(Finding(mod.path, line, 0, "LNT002", "tab character"))
+        if mod.tree is None:
+            e = mod.syntax_error
+            out.append(
+                Finding(mod.path, e.lineno or 1, e.offset or 0,
+                        "LNT001", f"syntax error: {e.msg}")
+            )
+            return out
+
+        basename = mod.path.rsplit("/", 1)[-1]
+        if basename not in EXPORT_FILES:
+            used = _used_names(mod.tree)
+            seen: dict[str, int] = {}
+            for lineno, name, ident in _module_imports(mod.tree):
+                if ident in seen and lineno != seen[ident]:
+                    # no line numbers in the message: it is the
+                    # baseline key, which must survive code motion
+                    out.append(Finding(
+                        mod.path, lineno, 0, "LNT004",
+                        f"duplicate import '{ident}'",
+                    ))
+                seen.setdefault(ident, lineno)
+                if name not in used:
+                    out.append(Finding(
+                        mod.path, lineno, 0, "LNT003",
+                        f"unused import '{name}'",
+                    ))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset,
+                    "LNT005", "bare except",
+                ))
+        return out

@@ -156,9 +156,12 @@ class LrWpanNetDevice(NetDevice):
         self._be = MAC_MIN_BE
         self._retries = 0
         self._ack_timer = None
-        # rx state: overlapping receptions corrupt each other
+        # rx state: overlapping receptions corrupt each other.  Each
+        # in-flight reception carries its own corrupted flag — a single
+        # shared counter undercounts for >=3 overlapping frames and its
+        # residue would drop the NEXT clean frame as a phantom collision
         self._rx_until = 0
-        self._rx_overlaps = 0
+        self._rx_inflight: list[dict] = []
         self._dup: dict[str, int] = {}  # src -> last seq delivered
 
     # --- wiring ---
@@ -271,18 +274,22 @@ class LrWpanNetDevice(NetDevice):
         if rx_dbm < self.rx_sensitivity:
             self.phy_rx_drop(packet, "below-sensitivity")
             return
-        overlapped = now < self._rx_until
-        if overlapped:
-            self._rx_overlaps += 1   # corrupts BOTH frames
+        rx = {"corrupt": False}
+        if now < self._rx_until:
+            rx["corrupt"] = True         # corrupts BOTH frames
+            for other in self._rx_inflight:
+                other["corrupt"] = True
+        self._rx_inflight.append(rx)
         self._rx_until = max(self._rx_until, end)
         Simulator.Schedule(
-            Seconds(duration_s), self._phy_end_rx, packet, overlapped
+            Seconds(duration_s), self._phy_end_rx, packet, rx
         )
 
-    def _phy_end_rx(self, packet, was_overlapped: bool):
-        if was_overlapped or self._rx_overlaps > 0:
-            if not was_overlapped:
-                self._rx_overlaps -= 1  # the first frame of the overlap
+    def _phy_end_rx(self, packet, rx: dict):
+        # remove by identity: equal-valued dicts of concurrent
+        # receptions must not be evicted for each other
+        self._rx_inflight = [o for o in self._rx_inflight if o is not rx]
+        if rx["corrupt"]:
             self.phy_rx_drop(packet, "collision")
             return
         header = packet.RemoveHeader(LrWpanMacHeader)

@@ -25,7 +25,9 @@ from __future__ import annotations
 
 import struct
 
+from tpudes.core.nstime import Seconds
 from tpudes.core.object import TypeId
+from tpudes.core.simulator import Simulator
 from tpudes.network.address import Ipv6Address
 from tpudes.network.net_device import NetDevice
 from tpudes.network.packet import Header, Packet
@@ -111,6 +113,11 @@ class SixLowPanNetDevice(NetDevice):
         .AddTraceSource("Rx", "(packet) reassembled and delivered")
         .AddTraceSource("Drop", "(reason) adaptation drop")
     )
+
+    #: reassembly buffer lifetime (upstream FragmentExpirationTimeout;
+    #: mirrors Ipv4L3Protocol.FRAGMENT_EXPIRATION_S — a lost fragment
+    #: must not strand the buffer until the 16-bit tag wraps)
+    REASSEMBLY_EXPIRATION_S = 60.0
 
     def __init__(self, inner=None, **attributes):
         super().__init__(**attributes)
@@ -207,9 +214,14 @@ class SixLowPanNetDevice(NetDevice):
 
     def _reassemble(self, fh: SixLowPanFrag, packet, sender):
         key = (str(sender), fh.tag)
-        buf = self._frags.setdefault(
-            key, {"ranges": [], "total": fh.size, "packet": None}
-        )
+        buf = self._frags.get(key)
+        if buf is None:
+            buf = {"ranges": [], "total": fh.size, "packet": None}
+            buf["timer"] = Simulator.Schedule(
+                Seconds(self.REASSEMBLY_EXPIRATION_S),
+                self._expire_reassembly, key,
+            )
+            self._frags[key] = buf
         tag = packet.PeekPacketTag(_SixLowPanOriginal)
         if tag is not None:
             buf["packet"] = tag.packet
@@ -222,8 +234,13 @@ class SixLowPanNetDevice(NetDevice):
             covered = max(covered, e)
         if covered < buf["total"] or buf["packet"] is None:
             return None
+        buf["timer"].Cancel()
         del self._frags[key]
         return buf["packet"]
+
+    def _expire_reassembly(self, key):
+        if self._frags.pop(key, None) is not None:
+            self.drop("reassembly-timeout")
 
     def _deliver(self, packet, sender):
         from tpudes.models.internet.ipv6 import Ipv6Header

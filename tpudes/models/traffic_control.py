@@ -543,9 +543,6 @@ class PieQueueDisc(QueueDisc):
         Simulator.Schedule(self.t_update, self._update_p)
 
     def DoEnqueue(self, item) -> bool:
-        if not self._timer_started:
-            self._timer_started = True
-            Simulator.Schedule(self.t_update, self._update_p)
         if len(self._items) >= self.max_packets:
             return False
         # RFC 8033 §4.1 safeguards: never drop when the queue is tiny
@@ -557,6 +554,12 @@ class PieQueueDisc(QueueDisc):
             self.stats_early_drops += 1
             return False
         self._items.append(item)
+        # arm Tupdate only once the packet is actually queued — a
+        # rejected enqueue on an idle disc must not start the recurring
+        # probability-update chain
+        if not self._timer_started:
+            self._timer_started = True
+            Simulator.Schedule(self.t_update, self._update_p)
         return True
 
     def DoDequeue(self):
