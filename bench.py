@@ -25,6 +25,14 @@ Four scenarios:
     study = one replica's complete traffic outcome — not sim-s/wall-s;
     the host side's study is its AS_HOST_S packet-level integration.
 
+Two sweep rows ride on top of the LTE and TCP scenarios (r6):
+  - lte_sched_sweep: the SAME lowered hex grid through all NINE FF-MAC
+    schedulers.  The scheduler id is a traced operand of the compiled
+    program, so the sweep pays ONE compile; the row reports the whole-
+    family wall time and asserts the single-executable property.
+  - tcp_variant_sweep: one dumbbell with 17 flows, one per
+    TcpCongestionOps variant — the full family in one fused program.
+
 Timing protocol: the device side compiles once, then runs N_TIMED=5
 timed repetitions with distinct PRNG keys; the reported value is the
 MEDIAN with min/max spread (rounds 1-3 reported single-shot numbers,
@@ -183,6 +191,101 @@ def bench_lte():
     )
 
 
+def bench_lte_sched_sweep():
+    """All nine FF-MAC schedulers over the SAME lowered scenario: the
+    whole family rides one XLA executable (the traced scheduler-id
+    dispatch), so a 9-point scheduler study costs one compile plus nine
+    device runs — the row the r6 tentpole adds must not regress the
+    plain `lte` row above."""
+    import dataclasses
+
+    import jax
+
+    from tpudes.core.world import reset_world
+    from tpudes.parallel import lte_sm
+    from tpudes.parallel.lte_sm import SM_SCHED_IDS, lower_lte_sm, run_lte_sm
+    from tpudes.scenarios import build_lena
+
+    reset_world()
+    lte, _ = build_lena(LTE_ENBS, LTE_UES_PER_CELL)
+    prog = lower_lte_sm(lte, LTE_SIM_S)
+    reset_world()
+
+    lte_sm._SM_CACHE.clear()
+    run_lte_sm(prog, jax.random.PRNGKey(0), replicas=LTE_REPLICAS)  # compile
+    t0 = time.monotonic()
+    per_sched = {}
+    for i, sched in enumerate(SM_SCHED_IDS):
+        out = run_lte_sm(
+            dataclasses.replace(prog, scheduler=sched),
+            jax.random.PRNGKey(1 + i), replicas=LTE_REPLICAS,
+        )
+        per_sched[sched] = round(
+            float(out["rx_bits"].sum() / LTE_REPLICAS / LTE_SIM_S / 1e6), 3
+        )
+    wall = time.monotonic() - t0
+    n_compiled = len(lte_sm._SM_CACHE)
+    rate = len(SM_SCHED_IDS) * LTE_REPLICAS * LTE_SIM_S / wall
+    return dict(
+        sim_s_per_wall_s=rate,
+        wall_sweep_s=wall,
+        schedulers=len(SM_SCHED_IDS),
+        compiled_programs=n_compiled,   # must stay 1
+        agg_dl_mbps=per_sched,
+    )
+
+
+def bench_tcp_variant_sweep():
+    """The 17-variant comparison itself: one dumbbell, one flow per
+    TcpCongestionOps variant, every variant's cwnd rule evaluated as a
+    masked vector lane of the same fused step."""
+    import jax
+
+    from tpudes.core.world import reset_world
+    from tpudes.parallel.tcp_dumbbell import (
+        VARIANTS,
+        lower_dumbbell,
+        run_tcp_dumbbell,
+    )
+    from tpudes.scenarios import build_dumbbell
+
+    reset_world()
+    build_dumbbell(
+        len(VARIANTS), TCP_SIM_S, variants=list(VARIANTS),
+        bottleneck_rate="13Mbps",
+    )
+    prog = lower_dumbbell(TCP_SIM_S)
+    reset_world()
+
+    run_tcp_dumbbell(prog, jax.random.PRNGKey(0), replicas=TCP_REPLICAS)
+    walls = []
+    goodput = None
+    for i in range(N_TIMED):
+        t0 = time.monotonic()
+        out = run_tcp_dumbbell(
+            prog, jax.random.PRNGKey(1 + i), replicas=TCP_REPLICAS
+        )
+        out["delivered"].block_until_ready()
+        walls.append(time.monotonic() - t0)
+        import numpy as np
+
+        g = np.asarray(out["goodput_mbps"]).mean(0)
+        goodput = g if goodput is None else goodput + g
+    med = statistics.median(walls)
+    rate = TCP_REPLICAS * TCP_SIM_S / med
+    return dict(
+        sim_s_per_wall_s=rate,
+        wall_median_s=med,
+        wall_min_s=min(walls),
+        wall_max_s=max(walls),
+        variants=len(VARIANTS),
+        per_variant_mbps={
+            v: round(float(goodput[i] / N_TIMED), 3)
+            for i, v in enumerate(VARIANTS)
+        },
+    )
+
+
 def bench_tcp():
     import jax
 
@@ -279,7 +382,9 @@ def main():
     wifi = bench_wifi()
     wifi_ht = bench_wifi_ht()
     lte = bench_lte()
+    lte_sweep = bench_lte_sched_sweep()
     tcp = bench_tcp()
+    tcp_sweep = bench_tcp_variant_sweep()
     asn = bench_as()
     # honest-metric caveat (VERDICT r4 weak #6): the AS ratio compares a
     # host packet-level integration to a converged fluid fixed point —
@@ -304,7 +409,9 @@ def main():
         "wifi": r3(wifi),
         "wifi_ht": r3(wifi_ht),
         "lte": r3(lte),
+        "lte_sched_sweep": r3(lte_sweep),
         "tcp": r3(tcp),
+        "tcp_variant_sweep": r3(tcp_sweep),
         "as": r3(asn),
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,

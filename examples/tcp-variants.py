@@ -7,7 +7,7 @@ point-to-point-layout dumbbell.
 Run (scalar DES, one variant):
     python examples/tcp-variants.py --nFlows=4 --variant=TcpCubic --simTime=5
 
-Sweep all six variants sequentially:
+Sweep all seventeen variants sequentially:
     python examples/tcp-variants.py --nFlows=4 --variant=all --simTime=5
 
 The TPU engine is one GlobalValue flip away — 256 Monte-Carlo replicas

@@ -145,3 +145,30 @@ def test_learning_table_expires():
     Simulator.Stop(Seconds(0.1))
     Simulator.Run()
     assert bridge._lookup(mac) is None, "expired entry must age out"
+
+def test_learning_table_aging_sweep_purges_stranded_entries():
+    """Promoted EVT003 finding: an entry for a station the bridge never
+    hears about (or looks up) again must still age OUT of the table —
+    the periodic sweep, not just _lookup's lazy expiry, bounds it."""
+    from tpudes.network.address import Mac48Address
+
+    bridge = BridgeNetDevice(ExpirationTime=Seconds(0.05))
+
+    class Port:
+        def SetPromiscReceiveCallback(self, cb):
+            pass
+
+        def SetReceiveCallback(self, cb):
+            pass
+
+    p = Port()
+    bridge._ports.append(p)
+    bridge._learn_station(Mac48Address(78), p)
+    assert len(bridge._learn) == 1
+    Simulator.Stop(Seconds(0.2))
+    Simulator.Run()
+    # no _lookup ever ran: the sweep alone must have purged the entry
+    assert len(bridge._learn) == 0
+    # and the sweep chain disarms once the table is empty (no immortal
+    # self-rescheduling event keeping every simulation alive)
+    assert not bridge._age_event.IsPending()

@@ -142,23 +142,21 @@ def test_all_schedulers_run_in_the_full_lena_loop():
     reset_world()
 
 
-def test_sm_engine_refuses_to_lower_unsupported_schedulers():
-    """r5 review: every non-pf/rr algorithm used to lower silently to
-    RR on the device engine — the forbidden mis-lowering class."""
+def test_sm_engine_lowers_every_registered_scheduler():
+    """r5 review forbade silently mis-lowering non-pf/rr algorithms;
+    r6 closes the gap the right way: every registered FF-MAC scheduler
+    now lowers to the traced-id dispatch (tests/test_lte_sm.py pins the
+    per-family behavior) while a custom class still refuses loudly."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    import pytest
 
     from tests.test_lte import _build_lena
     from tpudes.core.world import reset_world
-    from tpudes.parallel.lte_sm import (
-        UnliftableLteScenarioError,
-        lower_lte_sm,
-    )
+    from tpudes.parallel.lte_sm import lower_lte_sm
 
     reset_world()
     lte, enbs, ues = _build_lena(1, 2, scheduler="tdmt")
-    with pytest.raises(UnliftableLteScenarioError, match="pf/rr only"):
-        lower_lte_sm(lte, 1.0)
+    prog = lower_lte_sm(lte, 1.0)
+    assert prog.scheduler == "tdmt"
     reset_world()

@@ -206,3 +206,31 @@ def test_echo_replies_bounded_by_requests():
     cli = np.asarray(out["cli_rx"]).sum(axis=1)
     srv = np.asarray(out["srv_rx"])
     assert (cli <= srv).all()
+
+
+class TestShortHorizonGuard:
+    """lower_bss skips association/ARP/ADDBA warm-up; a horizon within
+    ~5x of that budget must warn loudly (0.2 s), a comfortable one must
+    stay silent (1.6 s)."""
+
+    def _lower_at(self, sim_end_s):
+        _reset_world()
+        sta_devices, ap_device, clients, _ = _build_bss()
+        prog = lower_bss(
+            [sta_devices.Get(i) for i in range(N_STAS)],
+            ap_device, clients, sim_end_s,
+        )
+        _reset_world()
+        return prog
+
+    def test_short_horizon_warns(self):
+        with pytest.warns(UserWarning, match="warm-up"):
+            self._lower_at(0.2)
+
+    def test_comfortable_horizon_is_silent(self):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            prog = self._lower_at(1.6)
+        assert prog.sim_end_us == 1_600_000

@@ -202,3 +202,29 @@ def test_fin_teardown_reaches_closed():
     # LAST_ACK→CLOSED after closing in response
     assert client._state in (TcpSocketBase.TIME_WAIT, TcpSocketBase.CLOSED)
     assert srv_sock._state in (TcpSocketBase.CLOSED, TcpSocketBase.LAST_ACK)
+
+
+def test_htcp_throughput_ratio_guards_beta_adaptation():
+    """Promoted REG001 finding: ThroughputRatio now guards H-TCP's
+    adaptive backoff — beta follows RTTmin/RTTmax across stable epochs
+    and falls back to the 0.5 default when the epoch throughput swings
+    by more than the ratio (the RTT spread is stale then)."""
+    from tpudes.models.internet.tcp_congestion import TcpHtcp, TcpSocketState
+
+    ops = TcpHtcp()
+    tcb = TcpSocketState(segment_size=1000)
+    betas = []
+    # two stable epochs: identical ack pattern → throughput unchanged
+    for _ in range(2):
+        ops.PktsAcked(tcb, 100, 0.06)
+        ops.PktsAcked(tcb, 100, 0.10)
+        ops.GetSsThresh(tcb, tcb.cwnd)
+        betas.append(ops._beta)
+    # starved epoch: throughput collapses past the 20% guard
+    ops.PktsAcked(tcb, 5, 0.06)
+    ops.GetSsThresh(tcb, tcb.cwnd)
+    betas.append(ops._beta)
+
+    assert betas[0] == pytest.approx(0.6)  # RTTmin/RTTmax = 0.06/0.10
+    assert betas[1] == pytest.approx(0.6)  # stable: still adaptive
+    assert betas[2] == pytest.approx(0.5)  # unstable: default backoff

@@ -1,10 +1,11 @@
-"""Device dumbbell engine: the full 13-variant family + RED/ECN.
+"""Device dumbbell engine: the full 17-variant family + RED/ECN.
 
-VERDICT r4 weak #2: config #2 is the *variants comparison*, so the
-replica engine must sweep the whole TcpCongestionOps family (incl. BBR
-and DCTCP) with no silent host fallback, and the bottleneck AQM must
-lower too (RED marking is what makes DCTCP meaningful).  The scalar DES
-remains the oracle: per-variant goodput parity pins mirror the existing
+VERDICT r4 weak #2 / r5 #2: config #2 is the *variants comparison*, so
+the replica engine must sweep the whole TcpCongestionOps family (incl.
+BBR, DCTCP, and the r6 additions H-TCP, YeAH, LEDBAT, TCP-LP) with no
+silent host fallback, and the bottleneck AQM must lower too (RED
+marking is what makes DCTCP meaningful).  The scalar DES remains the
+oracle: per-variant goodput parity pins mirror the existing
 NewReno/Vegas ones.
 """
 
@@ -52,7 +53,7 @@ def _red_dumbbell(variant, n_flows=3, min_th=5.0, max_th=15.0,
     return db, sinks
 
 
-def test_all_thirteen_variants_lift_and_progress():
+def test_all_seventeen_variants_lift_and_progress():
     """One flow per variant — the whole family on the replica axis in a
     single program, every flow making progress (no silent fallback)."""
     _reset()
@@ -85,10 +86,15 @@ def test_red_lowering_reads_qdisc():
     assert prog.ecn.all()
 
 
-@pytest.mark.parametrize("variant", ["TcpBbr", "TcpWestwood", "TcpIllinois"])
+@pytest.mark.parametrize(
+    "variant",
+    ["TcpBbr", "TcpWestwood", "TcpIllinois",
+     "TcpHtcp", "TcpYeah", "TcpLedbat", "TcpLp"],
+)
 def test_new_variant_goodput_parity(variant):
     """Host socket stack vs slot model, ±25% aggregate goodput — the
-    same pin the original six variants carry."""
+    same pin the original six variants carry (r6 extends the sweep to
+    the last four host variants: H-TCP, YeAH, LEDBAT, TCP-LP)."""
     _reset()
     db, sinks = build_dumbbell(
         3, SIM_S, variant=variant, bottleneck_rate="3Mbps"
@@ -106,6 +112,26 @@ def test_new_variant_goodput_parity(variant):
     assert dev == pytest.approx(host, rel=0.25), (
         f"{variant}: device {dev:.2f} vs host {host:.2f} Mbps"
     )
+
+
+def test_scavenger_variants_yield_to_reno():
+    """LEDBAT and TCP-LP are scavengers: competing with a NewReno flow
+    each takes less than Reno does, while the pipe stays full — the
+    behavioral signature that distinguishes them from the loss-based
+    family (not just an aggregate-goodput pin)."""
+    _reset()
+    build_dumbbell(
+        3, SIM_S, variants=["TcpNewReno", "TcpLedbat", "TcpLp"],
+        bottleneck_rate="5Mbps",
+    )
+    prog = lower_dumbbell(SIM_S)
+    out = run_tcp_dumbbell(prog, jax.random.PRNGKey(11), replicas=8)
+    g = np.asarray(out["goodput_mbps"]).mean(0)
+    util = np.asarray(out["delivered"]).sum(1) / prog.n_slots
+    _reset()
+    assert g[1] < g[0], f"LEDBAT {g[1]:.2f} should yield to Reno {g[0]:.2f}"
+    assert g[2] < g[0], f"TCP-LP {g[2]:.2f} should yield to Reno {g[0]:.2f}"
+    assert (util > 0.85).all(), util
 
 
 def test_dctcp_over_red_parity_and_shallow_queue():

@@ -35,9 +35,13 @@ class BridgeNetDevice(NetDevice):
 
     def __init__(self, **attributes):
         super().__init__(**attributes)
+        from tpudes.core.event import EventId
+
         self._ports: list[NetDevice] = []
         #: learned station location: mac addr -> (port, expire_ticks)
         self._learn: dict[int, tuple] = {}
+        #: periodic aging sweep over the learning table (armed lazily)
+        self._age_event = EventId()
 
     # --- wiring -----------------------------------------------------------
     def AddBridgePort(self, device: NetDevice) -> None:
@@ -78,6 +82,24 @@ class BridgeNetDevice(NetDevice):
         self._learn[src.addr] = (
             port, Simulator.NowTicks() + self.expiration_time.ticks
         )
+        # aging sweep: _lookup expires lazily, but a station the bridge
+        # never hears about again would strand its entry forever — the
+        # sweep (upstream's ExpirationTime contract) bounds the table
+        if not self._age_event.IsPending():
+            self._age_event = Simulator.Schedule(
+                self.expiration_time, self._age_learned
+            )
+
+    def _age_learned(self) -> None:
+        now = Simulator.NowTicks()
+        for addr in [
+            a for a, (_p, exp) in self._learn.items() if now >= exp
+        ]:
+            del self._learn[addr]
+        if self._learn:
+            self._age_event = Simulator.Schedule(
+                self.expiration_time, self._age_learned
+            )
 
     def _lookup(self, dst):
         hit = self._learn.get(dst.addr)

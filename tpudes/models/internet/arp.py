@@ -85,6 +85,10 @@ class ArpL3Protocol(Object):
         super().__init__(**attributes)
         self._node = None
         self._caches: dict[int, dict[int, ArpCacheEntry]] = {}  # id(device) -> ip -> entry
+        # seeded jitter stream, created lazily so nodes with the default
+        # RequestJitter=0 never consume an RNG stream (stream-allocation
+        # order is part of the reproducibility contract)
+        self._jitter_rv = None
 
     def SetNode(self, node) -> None:
         self._node = node
@@ -140,7 +144,22 @@ class ArpL3Protocol(Object):
                 dest_ip=dest_ip,
             )
         )
-        device.Send(req, Mac48Address.GetBroadcast(), self.PROT_NUMBER)
+        jitter = float(self.request_jitter)
+        if jitter > 0.0:
+            # upstream ArpL3Protocol::RequestJitter: stagger broadcast
+            # requests so simultaneously-booting nodes don't emit a
+            # synchronized request burst
+            if self._jitter_rv is None:
+                from tpudes.core.rng import UniformRandomVariable
+
+                self._jitter_rv = UniformRandomVariable()
+            Simulator.Schedule(
+                Seconds(self._jitter_rv.GetValue(0.0, jitter)),
+                device.Send, req, Mac48Address.GetBroadcast(),
+                self.PROT_NUMBER,
+            )
+        else:
+            device.Send(req, Mac48Address.GetBroadcast(), self.PROT_NUMBER)
 
     def _receive(self, device, packet, protocol, sender):
         from tpudes.models.internet.ipv4 import Ipv4L3Protocol

@@ -718,6 +718,8 @@ class TcpHtcp(TcpNewReno):
         self._min_rtt = math.inf
         self._max_rtt = 0.0
         self._beta = float(self.default_backoff)
+        self._acked_bytes_epoch = 0
+        self._last_throughput = 0.0
 
     def PktsAcked(self, tcb, segments_acked, rtt_s) -> None:
         if not rtt_s or rtt_s <= 0:
@@ -727,6 +729,7 @@ class TcpHtcp(TcpNewReno):
         )
         self._min_rtt = min(self._min_rtt, rtt_s)
         self._max_rtt = max(self._max_rtt, rtt_s)
+        self._acked_bytes_epoch += segments_acked * tcb.segment_size
 
     def _alpha(self) -> float:
         delta = max(self._clock - self._last_congestion_s - self.DELTA_B, 0.0)
@@ -741,8 +744,23 @@ class TcpHtcp(TcpNewReno):
         tcb.cwnd += max(int(add), 1)
 
     def GetSsThresh(self, tcb, bytes_in_flight) -> int:
-        if self._max_rtt > 0 and self._min_rtt < math.inf:
+        # upstream UpdateBeta: adapt beta from the RTT spread only while
+        # throughput is stable across congestion epochs — a swing larger
+        # than ThroughputRatio means the path changed and the spread is
+        # stale, so back off by the default factor instead
+        epoch_s = max(self._clock - self._last_congestion_s, 1e-9)
+        throughput = self._acked_bytes_epoch / epoch_s
+        unstable = (
+            self._last_throughput > 0.0
+            and abs(throughput - self._last_throughput)
+            > float(self.throughput_ratio) * self._last_throughput
+        )
+        if unstable or self._max_rtt <= 0 or self._min_rtt == math.inf:
+            self._beta = float(self.default_backoff)
+        else:
             self._beta = min(max(self._min_rtt / self._max_rtt, 0.5), 0.8)
+        self._last_throughput = throughput
+        self._acked_bytes_epoch = 0
         self._last_congestion_s = self._clock
         return max(int(tcb.cwnd * self._beta), 2 * tcb.segment_size)
 

@@ -61,6 +61,16 @@ class LteTtiController:
         self.lifted = False   # set by parallel.lift: device engine owns the run
         self._dirty = True
         self._static_geometry = True
+        #: True once a windowed engine has driven refresh_window_cache:
+        #: the per-TTI event then trusts the window snapshot instead of
+        #: re-evaluating mobile geometry at every event
+        self._windowed = False
+        # second BatchableRegistry consumer beside YansWifiChannel: the
+        # windowed engine refreshes the per-TTI SINR evaluation tables
+        # once per window instead of once per TTI event
+        from tpudes.parallel.engine import BatchableRegistry
+
+        BatchableRegistry.register(self)
         # device-side constants (built lazily)
         self._gain_dl = None          # (E, U)
         self._gain_ul_eff = None      # (U, U): v's gain at u's serving eNB
@@ -233,6 +243,19 @@ class LteTtiController:
                 )
 
             self._jit_step = jax.jit(both)
+
+    # --- per-window batched refresh (JaxSimulatorImpl contract) -----------
+    def refresh_window_cache(self) -> None:
+        """Rebuild geometry + the batched per-TTI SINR reference tables
+        (gain matrices, reference PSDs) ONCE per conservative window.
+        Mobile graphs otherwise pay one full rebuild per TTI *event*;
+        under the windowed engine every TTI inside the window reads the
+        window-start snapshot — the same granted-time-window geometry
+        contract YansWifiChannel's pair-table cache follows."""
+        if self._dirty or not self._static_geometry:
+            if self.enbs and self.ues:
+                self._rebuild()
+        self._windowed = True
 
     def _rbgs_to_rbs(self, rbgs) -> list[int]:
         """TS 36.213 type-0: expand RBG indices to RB indices (one
@@ -430,7 +453,9 @@ class LteTtiController:
             return  # the lifted device program runs the scenario instead
         if self._dirty:
             self._rebuild()
-        elif not self._static_geometry:
+        elif not self._static_geometry and not self._windowed:
+            # per-event fallback: no windowed engine drives the registry,
+            # so mobile geometry must be re-evaluated at every TTI
             self._rebuild()
         self._evaluate_handover()
         if self._dirty:

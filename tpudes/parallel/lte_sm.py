@@ -14,6 +14,21 @@ entire RB grid every TTI, which makes the interference pattern (and
 hence SINR, CQI, MCS, per-RB MI) static for a static topology: they are
 precomputed once at lowering time.
 
+All NINE FF-MAC schedulers (models/lte/scheduler.py) lower: each is a
+per-UE metric whose per-cell argmax drives the same one-hot allocation
+algebra, so a SINGLE jitted program serves the whole family — the
+scheduler id is a traced operand selecting the metric
+(:data:`SM_SCHED_IDS`).  Full-buffer degeneracies, identical on the
+host on the same scenario, are relied on and pinned by tests:
+- TD and FD variants coincide: the greedy fill gives the first
+  (best-metric) flow every RBG its infinite buffer wants, which is the
+  whole grid — winner-takes-the-rest IS the frequency-domain cascade;
+- TTA reduces to RR: with wideband CQI the subband/wideband rate ratio
+  is identically 1 (the host class literally inherits RR);
+- CQA and PSS reduce to PF: the saturation-mode controller has no
+  HOL-delay or target-bit-rate state to feed them (SchedCandidate
+  defaults 0), so the delay group / priority set is degenerate.
+
 Timing-model deviations vs the host TTI loop (controller.py), all
 bounded fixed offsets — tests/test_lte_sm.py pins host-vs-device
 throughput parity (aggregate and per-cell) and CQI equality on an
@@ -34,7 +49,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpudes.models.lte.scheduler import HARQ_MAX_TX, HARQ_RTT_TTIS, rbg_size_for
+from tpudes.models.lte.scheduler import (
+    HARQ_MAX_TX,
+    HARQ_RTT_TTIS,
+    SCHEDULERS,
+    rbg_size_for,
+)
 from tpudes.ops.lte import (
     RB_BANDWIDTH_HZ,
     cqi_from_sinr,
@@ -51,6 +71,27 @@ class UnliftableLteScenarioError(ValueError):
     (non-SM bearers, mobile nodes, unattached UEs, …)."""
 
 
+#: scheduler short name → traced dispatch id.  Families sharing a
+#: full-buffer-degenerate metric share an id group in the step's select
+#: (see module docstring); the id itself is a RUNTIME operand of the
+#: compiled program, so all nine ride one XLA executable.
+SM_SCHED_IDS = {
+    "pf": 0, "cqa": 1, "pss": 2,
+    "rr": 3, "tta": 4,
+    "tdmt": 5, "fdmt": 6,
+    "tdbet": 7, "fdbet": 8,
+}
+
+#: host FfMacScheduler class → short name, derived from the host
+#: registry so SM_SCHED_IDS stays the single device-support list (a
+#: host class rename cannot silently demote a scheduler to "custom")
+_SCHED_CLASS_TO_NAME = {
+    cls.__name__: cls.name
+    for cls in set(SCHEDULERS.values())
+    if cls.name in SM_SCHED_IDS
+}
+
+
 @dataclass(frozen=True)
 class LteSmProgram:
     """Static description of a full-buffer LTE downlink scenario."""
@@ -61,7 +102,7 @@ class LteSmProgram:
     noise_psd: float
     n_rb: int
     n_ttis: int
-    scheduler: str            # "pf" | "rr"
+    scheduler: str            # any key of SM_SCHED_IDS
     pf_alpha: float = 0.05
 
     @property
@@ -109,16 +150,14 @@ def lower_lte_sm(helper, sim_time_s: float) -> LteSmProgram:
     if len(sched_types) > 1:
         raise UnliftableLteScenarioError(f"mixed schedulers {sched_types}")
     sched_name = sched_types.pop()
-    if sched_name == "PfFfMacScheduler":
-        sched = "pf"
-    elif sched_name == "RrFfMacScheduler":
-        sched = "rr"
-    else:
-        # never lower TDMT/BET/CQA/... to something else silently — the
-        # host controller runs them exactly (the round-2 rule)
+    sched = _SCHED_CLASS_TO_NAME.get(sched_name)
+    if sched is None:
+        # a custom user scheduler class has arbitrary host semantics —
+        # never lower it to an approximation silently (the round-2 rule)
         raise UnliftableLteScenarioError(
-            f"SM engine implements pf/rr only (got {sched_name}); "
-            "run the host controller for the other algorithms"
+            f"unrecognized custom FF-MAC scheduler class {sched_name}; "
+            "the device engine lowers the registered upstream family "
+            "only — run the host controller for custom algorithms"
         )
 
     for dev in ctrl.enbs + ctrl.ues:
@@ -149,7 +188,12 @@ def lower_lte_sm(helper, sim_time_s: float) -> LteSmProgram:
 
 def build_sm_step(prog: LteSmProgram):
     """Returns ``(consts, init_state, step_fn)`` for the per-TTI scan
-    body (single replica; vmapped by run_lte_sm)."""
+    body (single replica; vmapped by run_lte_sm).
+
+    ``step_fn(state, (t, key), sid)`` — ``sid`` is the traced scheduler
+    id (:data:`SM_SCHED_IDS`), so the compiled program is
+    scheduler-agnostic: ``prog.scheduler`` only picks the value fed in.
+    """
     E, U = prog.n_enb, prog.n_ue
     rbg_size = rbg_size_for(prog.n_rb)
     n_rbg = (prog.n_rb + rbg_size - 1) // rbg_size
@@ -203,7 +247,7 @@ def build_sm_step(prog: LteSmProgram):
             new_tbs=z_i, retx=z_i, drops=z_i, ok_cnt=z_i,
         )
 
-    def step_fn(s, xs):
+    def step_fn(s, xs, sid):
         t, key = xs
         due = s["pend"] & (s["p_due"] <= t) & eligible
         nrbg_req = jnp.where(due, s["p_nrbg"], 0)
@@ -216,13 +260,23 @@ def build_sm_step(prog: LteSmProgram):
         )                                                           # (E,)
         rem_c = n_rbg - used_c
 
-        # new-TB winner per cell (full buffer: winner takes the rest)
+        # new-TB winner per cell (full buffer: winner takes the rest).
+        # One metric per scheduler family; the per-cell argmax breaks
+        # ties at the lowest UE index = lowest rnti, the host tie-break.
         cand = eligible & ~s["pend"]
-        if prog.scheduler == "pf":
-            metric = rate0 / jnp.maximum(s["avg"], 1.0)
-        else:  # rr: next UE at/after the rotating pointer wins
-            ahead = jnp.mod(pos - s["rr_ptr"][serving_j], count_u)
-            metric = -ahead.astype(jnp.float32)
+        pf_metric = rate0 / jnp.maximum(s["avg"], 1.0)
+        # rr/tta: next UE at/after the rotating pointer wins
+        ahead = jnp.mod(pos - s["rr_ptr"][serving_j], count_u)
+        rr_metric = -ahead.astype(jnp.float32)
+        # td/fd-mt: highest achievable rate; td/fd-bet: lowest EMA
+        # throughput (argmax of 1/avg == argmax of -avg)
+        metric = jnp.select(
+            [sid <= SM_SCHED_IDS["pss"],
+             sid <= SM_SCHED_IDS["tta"],
+             sid <= SM_SCHED_IDS["fdmt"]],
+            [pf_metric, rr_metric, rate0],
+            -s["avg"],
+        )
         m_eu = jnp.where(cell_onehot & cand[None, :], metric[None, :], NEG)
         win_idx = jnp.argmax(m_eu, axis=1)                          # (E,)
         has_win = (jnp.max(m_eu, axis=1) > NEG) & (rem_c > 0)
@@ -288,10 +342,13 @@ _SM_CACHE: dict = {}
 
 
 def _sm_cache_key(prog: LteSmProgram, replicas) -> tuple:
+    # prog.scheduler is deliberately ABSENT: the scheduler id is a
+    # traced operand, so one compiled program serves all nine — a
+    # scheduler sweep pays one compile, not nine
     return (
         prog.gain.tobytes(), prog.serving.tobytes(),
         prog.tx_power_dbm.tobytes(), prog.noise_psd, prog.n_rb,
-        prog.n_ttis, prog.scheduler, prog.pf_alpha, replicas,
+        prog.n_ttis, prog.pf_alpha, replicas,
     )
 
 
@@ -309,32 +366,34 @@ def run_lte_sm(prog: LteSmProgram, key, replicas: int | None = None, mesh=None):
     if cached is None:
         consts, init_state, step_fn = build_sm_step(prog)
 
-        def run_one(k):
+        def run_one(k, sid):
             ts = jnp.arange(prog.n_ttis, dtype=jnp.int32)
             keys = jax.random.split(k, prog.n_ttis)
             final, _ = jax.lax.scan(
-                lambda s, xs: (step_fn(s, xs), None), init_state(), (ts, keys)
+                lambda s, xs: (step_fn(s, xs, sid), None),
+                init_state(), (ts, keys),
             )
             return final
 
         if replicas is None:
             fn = jax.jit(run_one)
         else:
-            fn = jax.jit(jax.vmap(run_one))
+            fn = jax.jit(jax.vmap(run_one, in_axes=(0, None)))
         _SM_CACHE[ck] = (consts, fn)
         if len(_SM_CACHE) > 32:
             _SM_CACHE.pop(next(iter(_SM_CACHE)))
     consts, fn = _SM_CACHE[ck]
 
+    sid = jnp.int32(SM_SCHED_IDS[prog.scheduler])
     if replicas is not None:
         keys = jax.random.split(key, replicas)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             keys = jax.device_put(keys, NamedSharding(mesh, P("replica")))
-        out = fn(keys)
+        out = fn(keys, sid)
     else:
-        out = fn(key)
+        out = fn(key, sid)
     out["rx_lo"].block_until_ready()
     result = {k: np.asarray(v) for k, v in jax.device_get(out).items()
               if k in ("rx_lo", "rx_hi", "new_tbs", "retx", "drops", "ok_cnt")}

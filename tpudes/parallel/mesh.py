@@ -25,6 +25,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudes.parallel.kernels import WindowParams
 
+# shard_map's public home moved across jax releases: jax.shard_map
+# (check_vma kwarg) on new jax, jax.experimental.shard_map (check_rep)
+# before that — resolve once so the window step builds on both
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
+
 
 def replica_mesh(n_devices: int | None = None, axis: str = "replica") -> Mesh:
     """1-D mesh over all (or the first n) local devices."""
@@ -53,12 +64,12 @@ def sharded_window_step(mesh: Mesh, params: WindowParams = WindowParams()):
     next_ts, lookahead) -> (ok, sinr, delivered_total, grant)``.
     """
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P("replica"), P("replica"), P("replica"), P("replica"),
                   P("replica"), P("replica"), P()),
         out_specs=(P("replica"), P("replica"), P(), P()),
-        check_vma=False,
+        **_SHARD_MAP_KW,
     )
     def step(positions, tx_active, mode_idx, frame_bytes, keys, next_ts, lookahead):
         from tpudes.parallel.kernels import replicated

@@ -4,9 +4,10 @@ Lowers a dumbbell object graph — N left leaves bulk-sending TCP through
 one bottleneck toward N right leaves (tcp-variants-comparison's shape;
 SURVEY.md §2.7/§2.9) — to a device-resident **packet-slot** program: one
 ``lax.scan`` step per bottleneck serialization time τ (= pkt_bytes·8/C),
-per-replica per-flow state in (R, F) arrays, all THIRTEEN
-TcpCongestionOps variants (the full upstream family incl. BBR and
-DCTCP) evaluated as masked vector rules in one fused step.  A RED root
+per-replica per-flow state in (R, F) arrays, all SEVENTEEN
+TcpCongestionOps variants (the full upstream family incl. BBR, DCTCP,
+H-TCP, YeAH, LEDBAT and TCP-LP) evaluated as masked vector rules in one
+fused step.  A RED root
 qdisc on the bottleneck lowers too: EWMA average queue, early
 drop/CE-mark (RFC 3168 ECE triggers the variant's loss response; DCTCP
 scales its cut by the marked fraction), gentle mode, hard-drop forced
@@ -49,10 +50,11 @@ import numpy as np
 # upstream tcp-variants-comparison family, tcp_congestion.TCP_VARIANTS)
 VARIANTS = ("TcpNewReno", "TcpCubic", "TcpScalable", "TcpHighSpeed",
             "TcpVegas", "TcpVeno", "TcpLinuxReno", "TcpBic", "TcpWestwood",
-            "TcpIllinois", "TcpHybla", "TcpBbr", "TcpDctcp")
+            "TcpIllinois", "TcpHybla", "TcpBbr", "TcpDctcp", "TcpHtcp",
+            "TcpYeah", "TcpLedbat", "TcpLp")
 (V_NEWRENO, V_CUBIC, V_SCALABLE, V_HIGHSPEED, V_VEGAS, V_VENO,
  V_LINUXRENO, V_BIC, V_WESTWOOD, V_ILLINOIS, V_HYBLA, V_BBR,
- V_DCTCP) = range(13)
+ V_DCTCP, V_HTCP, V_YEAH, V_LEDBAT, V_LP) = range(17)
 
 INIT_CWND = 10.0          # segments (tcp_congestion.TcpSocketState default)
 SSTHRESH0 = 1e9
@@ -72,6 +74,11 @@ BBR_CYCLE_GAINS = (1.25, 0.75, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0)
 BBR_STARTUP, BBR_DRAIN, BBR_PROBE_BW = range(3)
 BBR_BW_DECAY = 0.98       # per-round decaying-max ≈ the 10-round window
 DCTCP_G = 0.0625
+HTCP_DELTA_B = 1.0        # s: low-speed regime boundary
+HTCP_DEFAULT_BACKOFF = 0.5
+YEAH_ALPHA, YEAH_QMAX, YEAH_RHO = 80.0, 8.0, 0.125
+LEDBAT_TARGET_S, LEDBAT_GAIN = 0.1, 1.0
+LP_INFERENCE_FRAC = 0.15
 
 
 @dataclass(frozen=True)
@@ -482,13 +489,47 @@ def _cwnd_increase(var, cwnd, ssthresh, acked, t_s, rtt_s, st,
     rho = jnp.maximum(rtt_s / HYBLA_RRTT, 1.0)
     inc_hybla = a * rho * rho / w
 
+    # H-TCP: additive increase grows with time since the last congestion
+    # event (quadratic past the 1 s low-speed boundary), scaled by the
+    # adaptive backoff beta carried in st["htcp_beta"]
+    h_delta = jnp.maximum(t_s - st["htcp_last_cong"] - HTCP_DELTA_B, 0.0)
+    h_alpha = jnp.maximum(
+        2.0 * (1.0 - st["htcp_beta"])
+        * (1.0 + 10.0 * h_delta + 0.25 * h_delta * h_delta),
+        1.0,
+    )
+    inc_htcp = h_alpha * a / w
+
+    # YeAH: STCP fast mode while the backlog estimate (the shared
+    # Vegas-style `diff`) stays under Q_max; Reno slow mode past it with
+    # the precautionary decongestion shed spread over one cwnd of acks
+    inc_yeah = jnp.where(
+        diff < YEAH_QMAX,
+        a / jnp.minimum(w, YEAH_ALPHA),
+        (1.0 - diff * (1.0 - YEAH_RHO)) * a / w,
+    )
+
+    # LEDBAT: window tracks the 100 ms queueing-delay target; negative
+    # off-target shrinks the window (scavenger behavior)
+    qdelay = jnp.maximum(rtt_s - jnp.minimum(st["min_rtt"], rtt_s), 0.0)
+    inc_ledbat = (
+        LEDBAT_GAIN * (LEDBAT_TARGET_S - qdelay) / LEDBAT_TARGET_S * a / w
+    )
+
+    # TCP-LP: Reno growth outside the inference phase (the early-
+    # congestion collapse itself is applied after the select below)
+    in_infer = t_s < st["lp_until"]
+    inc_lp = jnp.where(in_infer, 0.0, inc_reno)
+
     inc_ca = jnp.select(
         [var == V_NEWRENO, var == V_CUBIC, var == V_SCALABLE,
          var == V_HIGHSPEED, var == V_VEGAS, var == V_VENO,
          is_lr, var == V_BIC, var == V_WESTWOOD,
-         var == V_ILLINOIS, var == V_HYBLA],
+         var == V_ILLINOIS, var == V_HYBLA, var == V_HTCP,
+         var == V_YEAH, var == V_LEDBAT, var == V_LP],
         [inc_reno, inc_cubic, inc_scal, inc_hs, inc_vegas, inc_veno,
-         inc_lr, inc_bic, inc_reno, inc_ill, inc_hybla],
+         inc_lr, inc_bic, inc_reno, inc_ill, inc_hybla, inc_htcp,
+         inc_yeah, inc_ledbat, inc_lp],
     )
     # slow start: +1 per ack (Hybla: 2^rho − 1 per ack); Vegas leaves SS
     # once the backlog passes γ
@@ -496,7 +537,15 @@ def _cwnd_increase(var, cwnd, ssthresh, acked, t_s, rtt_s, st,
     ssthresh = jnp.where(vegas_exit, jnp.maximum(w - 1.0, 2.0), ssthresh)
     inc_ss = jnp.where(var == V_HYBLA, a * (2.0**rho - 1.0), a)
     inc = jnp.where(in_ss & ~vegas_exit, inc_ss, inc_ca)
-    new_cwnd = jnp.maximum(cwnd + jnp.where(a > 0, inc, 0.0), 2.0)
+    # TCP-LP yields completely while inferring congestion: the collapsed
+    # 1-segment window must not slow-start straight back up, or the
+    # scavenger stops yielding (the host's ack-clocked hold is slower
+    # than this slot model's, so the gate covers slow start too)
+    inc = jnp.where((var == V_LP) & in_infer, 0.0, inc)
+    # TCP-LP's inference collapse holds at ONE segment (host behavior);
+    # every other variant keeps the usual 2-segment floor
+    floor = jnp.where((var == V_LP) & in_infer, 1.0, 2.0)
+    new_cwnd = jnp.maximum(cwnd + jnp.where(a > 0, inc, 0.0), floor)
 
     # BBR replaces loss-driven AIMD entirely: cwnd tracks gain × BDP
     gain = jnp.select(
@@ -519,7 +568,24 @@ def _cwnd_increase(var, cwnd, ssthresh, acked, t_s, rtt_s, st,
         var == V_BBR, jnp.where(a > 0, cwnd_bbr, cwnd), new_cwnd
     )
 
+    # TCP-LP early-congestion inference: one-way delay past 15% of the
+    # observed delay range collapses the window to one segment and holds
+    # the inference phase for one RTT (host PktsAcked hook)
+    lp_trigger = (
+        (var == V_LP) & sampled & (ill_max > min_rtt)
+        & (rtt_s > min_rtt + LP_INFERENCE_FRAC * (ill_max - min_rtt))
+        & ~in_infer
+    )
+    new_cwnd = jnp.where(lp_trigger, 1.0, new_cwnd)
+    ssthresh = jnp.where(
+        lp_trigger, jnp.maximum(ssthresh / 2.0, 2.0), ssthresh
+    )
+    lp_until = jnp.where(
+        lp_trigger, t_s + rtt_s, st["lp_until"]
+    )
+
     st = dict(st, epoch_t=epoch_t, k=k, origin=origin, w_est=w_est,
+              lp_until=lp_until,
               last_diff=jnp.where(a > 0, diff, st["last_diff"]),
               min_rtt=min_rtt, ww_acc=ww_acc, bwe=bwe,
               ill_max_rtt=ill_max, ill_alpha=ill_alpha, ill_beta=ill_beta,
@@ -529,8 +595,11 @@ def _cwnd_increase(var, cwnd, ssthresh, acked, t_s, rtt_s, st,
     return new_cwnd, ssthresh, st
 
 
-def _loss_response(var, cwnd, st):
-    """Vectorized GetSsThresh on a detected loss (segments)."""
+def _loss_response(var, cwnd, st, t_s):
+    """Vectorized GetSsThresh on a detected loss (segments).
+
+    ``t_s`` stamps H-TCP's last-congestion clock (its additive increase
+    grows with the time elapsed since this moment)."""
     w = jnp.maximum(cwnd, 1.0)
     ss_reno = w / 2.0
     # cubic fast convergence: remember a reduced w_max when still climbing
@@ -567,14 +636,29 @@ def _loss_response(var, cwnd, st):
     ), 4.0)
     # DCTCP: reduction fraction follows the marked-byte EWMA
     ss_dctcp = w * (1.0 - st["dctcp_alpha"] / 2.0)
+    # H-TCP adaptive backoff: beta = RTTmin/RTTmax clamped to [0.5, 0.8]
+    # once an RTT spread exists, default 0.5 before
+    h_valid = (st["ill_max_rtt"] > 0.0) & jnp.isfinite(st["min_rtt"])
+    h_beta = jnp.where(
+        h_valid,
+        jnp.clip(
+            st["min_rtt"] / jnp.maximum(st["ill_max_rtt"], 1e-9), 0.5, 0.8
+        ),
+        HTCP_DEFAULT_BACKOFF,
+    )
+    ss_htcp = w * h_beta
+    # YeAH: shed the larger of the measured backlog and cwnd/8
+    ss_yeah = w - jnp.maximum(st["last_diff"], w / 8.0)
     ssthresh = jnp.select(
         [var == V_NEWRENO, var == V_CUBIC, var == V_SCALABLE,
          var == V_HIGHSPEED, var == V_VEGAS, var == V_VENO,
          var == V_LINUXRENO, var == V_BIC, var == V_WESTWOOD,
          var == V_ILLINOIS, var == V_HYBLA, var == V_BBR,
-         var == V_DCTCP],
+         var == V_DCTCP, var == V_HTCP, var == V_YEAH,
+         var == V_LEDBAT, var == V_LP],
         [ss_reno, ss_cubic, ss_scal, ss_hs, ss_reno, ss_veno,
-         ss_reno, ss_bic, ss_west, ss_ill, ss_reno, ss_bbr, ss_dctcp],
+         ss_reno, ss_bic, ss_west, ss_ill, ss_reno, ss_bbr, ss_dctcp,
+         ss_htcp, ss_yeah, ss_reno, ss_reno],
     )
     ssthresh = jnp.maximum(ssthresh, 2.0)
     st = dict(
@@ -585,6 +669,10 @@ def _loss_response(var, cwnd, st):
             st["w_max"],
         ),
         epoch_t=jnp.full_like(st["epoch_t"], -1.0),
+        htcp_beta=jnp.where(var == V_HTCP, h_beta, st["htcp_beta"]),
+        htcp_last_cong=jnp.where(
+            var == V_HTCP, t_s, st["htcp_last_cong"]
+        ),
     )
     return ssthresh, st
 
@@ -643,6 +731,9 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
                 bbr_cycle=z(R, F, dt=jnp.int32),
                 cwnd_cnt=z(R, F),
                 dctcp_alpha=jnp.ones((R, F)),
+                htcp_beta=jnp.full((R, F), HTCP_DEFAULT_BACKOFF),
+                htcp_last_cong=z(R, F),
+                lp_until=z(R, F),
             ),
         )
 
@@ -686,7 +777,9 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
         # (RFC 3168: an ECE ack triggers the variant's loss response;
         # DCTCP's response is the alpha-scaled cut via ss_dctcp)
         reduce = ((losses > 0) | ((marks > 0) & ecn_cap[None, :])) & ~in_recovery
-        ss_loss, side_loss = _loss_response(var[None, :], cwnd, side)
+        ss_loss, side_loss = _loss_response(
+            var[None, :], cwnd, side, t * slot_s
+        )
         ssthresh = jnp.where(reduce, ss_loss, ssthresh)
         cwnd = jnp.where(reduce, ssthresh, cwnd)
         side = {
