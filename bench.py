@@ -180,6 +180,7 @@ def bench_lte():
         bits += int(out["rx_bits"].sum())
     med = statistics.median(walls)
     rate = LTE_REPLICAS * LTE_SIM_S / med
+    ues = LTE_REPLICAS * LTE_ENBS * LTE_UES_PER_CELL
     return dict(
         sim_s_per_wall_s=rate,
         vs_scalar=rate / host_rate,
@@ -188,6 +189,10 @@ def bench_lte():
         wall_max_s=max(walls),
         scalar_sim_s_per_wall_s=host_rate,
         agg_dl_mbps=bits / N_TIMED / LTE_REPLICAS / LTE_SIM_S / 1e6,
+        # tpudes.obs device accumulators (last timed run, per-UE means)
+        obs_grants_per_ue=float(out["new_tbs"].sum()) / ues,
+        obs_harq_retx_per_ue=float(out["retx"].sum()) / ues,
+        obs_harq_drops_per_ue=float(out["drops"].sum()) / ues,
     )
 
 
@@ -211,7 +216,10 @@ def bench_lte_sched_sweep():
     prog = lower_lte_sm(lte, LTE_SIM_S)
     reset_world()
 
+    from tpudes.obs.device import CompileTelemetry
+
     lte_sm._SM_CACHE.clear()
+    compiles_before = CompileTelemetry.compiles("lte_sm")
     run_lte_sm(prog, jax.random.PRNGKey(0), replicas=LTE_REPLICAS)  # compile
     t0 = time.monotonic()
     per_sched = {}
@@ -231,6 +239,8 @@ def bench_lte_sched_sweep():
         wall_sweep_s=wall,
         schedulers=len(SM_SCHED_IDS),
         compiled_programs=n_compiled,   # must stay 1
+        # same single-executable property from the obs telemetry side
+        obs_compiles=CompileTelemetry.compiles("lte_sm") - compiles_before,
         agg_dl_mbps=per_sched,
     )
 
@@ -320,6 +330,8 @@ def bench_tcp():
         mbps += float(out["goodput_mbps"].sum(1).mean())
     med = statistics.median(walls)
     rate = TCP_REPLICAS * TCP_SIM_S / med
+    import numpy as np
+
     return dict(
         sim_s_per_wall_s=rate,
         vs_scalar=rate / host_rate,
@@ -329,6 +341,11 @@ def bench_tcp():
         scalar_sim_s_per_wall_s=host_rate,
         scalar_goodput_mbps=host_rx * 8 / TCP_HOST_S / 1e6,
         agg_goodput_mbps=mbps / N_TIMED,
+        # tpudes.obs device accumulators (last timed run, per-replica)
+        obs_drops_per_replica=float(
+            np.asarray(out["drops"]).sum(axis=1).mean()
+        ),
+        obs_mean_queue_pkts=float(np.asarray(out["mean_queue"]).mean()),
     )
 
 
@@ -397,6 +414,8 @@ def main():
     r3 = lambda d: {  # noqa: E731
         k: (round(v, 3) if isinstance(v, float) else v) for k, v in d.items()
     }
+    from tpudes.obs.device import CompileTelemetry
+
     out = {
         "metric": (
             "scenario sim-seconds per wall-second, replica engine "
@@ -413,6 +432,10 @@ def main():
         "tcp": r3(tcp),
         "tcp_variant_sweep": r3(tcp_sweep),
         "as": r3(asn),
+        # tpudes.obs compile telemetry: per-engine XLA compile count +
+        # wall time over the whole bench process (sweeps must not add
+        # compiles — the single-executable property as a metric)
+        "obs_compile": CompileTelemetry.snapshot(),
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
     }

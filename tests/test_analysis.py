@@ -537,3 +537,100 @@ def test_det_same_name_sorted_rebind_is_clean():
             Simulator.Schedule(1, dev.poll)
     """
     assert _codes(src, select=["DET"]) == []
+
+
+# --- trace-arity (TRC001, the ROADMAP open item) ---------------------------
+
+_TRC_SOURCE = '''
+from tpudes.core.object import Object, TypeId
+
+
+class Mac(Object):
+    tid = (
+        TypeId("tpudes::Mac")
+        .AddTraceSource("MacTx", "(packet, power)")
+    )
+
+    def send(self, packet, power):
+        self.mac_tx(packet, power)
+'''
+
+
+def test_trc_sink_too_narrow_for_fired_arity():
+    sink = """
+    def wire(mac):
+        mac.TraceConnectWithoutContext("MacTx", lambda p: p.GetSize())
+    """
+    assert _codes(
+        sink, select=["TRC"],
+        extra=[("tpudes/models/mac_fixture.py", _TRC_SOURCE)],
+    ) == ["TRC001"]
+
+
+def test_trc_matching_sink_and_vararg_sink_are_clean():
+    sink = """
+    def wire(mac):
+        mac.TraceConnectWithoutContext("MacTx", lambda p, power: p)
+        mac.TraceConnectWithoutContext("MacTx", lambda *args: None)
+    """
+    assert _codes(
+        sink, select=["TRC"],
+        extra=[("tpudes/models/mac_fixture.py", _TRC_SOURCE)],
+    ) == []
+
+
+def test_trc_context_connect_shifts_the_window():
+    # TraceConnect prepends the context string: a 2-param sink is now
+    # too narrow for a 2-arg fire, a 3-param sink fits
+    sink = """
+    def wire(mac):
+        mac.TraceConnect("MacTx", "/path", lambda p, power: p)
+        mac.TraceConnect("MacTx", "/path", lambda ctx, p, power: p)
+    """
+    assert _codes(
+        sink, select=["TRC"],
+        extra=[("tpudes/models/mac_fixture.py", _TRC_SOURCE)],
+    ) == ["TRC001"]
+
+
+def test_trc_defaults_widen_the_window_and_suppression_works():
+    clean = """
+    def wire(mac):
+        mac.TraceConnectWithoutContext("MacTx", lambda p, power=None, extra=0: p)
+    """
+    assert _codes(
+        clean, select=["TRC"],
+        extra=[("tpudes/models/mac_fixture.py", _TRC_SOURCE)],
+    ) == []
+    suppressed = """
+    def wire(mac):
+        mac.TraceConnectWithoutContext("MacTx", lambda p: p)  # tpudes: ignore[TRC001]
+    """
+    assert _codes(
+        suppressed, select=["TRC"],
+        extra=[("tpudes/models/mac_fixture.py", _TRC_SOURCE)],
+    ) == []
+
+
+def test_trc_unfired_trace_name_is_not_guessed_at():
+    # TracedValue-style sources never fire via self.<field>(...): with
+    # no observed fire site the pass stays silent rather than guessing
+    sink = """
+    def wire(sock):
+        sock.TraceConnectWithoutContext("CongestionWindow", lambda old: old)
+    """
+    assert _codes(sink, select=["TRC"]) == []
+
+
+def test_trc_module_level_def_sink_is_resolved():
+    sink = """
+    def on_tx(packet):
+        return packet
+
+    def wire(mac):
+        mac.TraceConnectWithoutContext("MacTx", on_tx)
+    """
+    assert _codes(
+        sink, select=["TRC"],
+        extra=[("tpudes/models/mac_fixture.py", _TRC_SOURCE)],
+    ) == ["TRC001"]

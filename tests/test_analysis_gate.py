@@ -60,7 +60,8 @@ def test_json_output_is_machine_readable(tmp_path):
 def test_list_rules_covers_every_pass():
     proc = _run("--list-rules")
     assert proc.returncode == 0
-    for code in ("JP001", "RNG001", "DET001", "EVT001", "REG001", "LNT001"):
+    for code in ("JP001", "RNG001", "DET001", "EVT001", "REG001", "LNT001",
+                 "TRC001"):
         assert code in proc.stdout
 
 

@@ -221,3 +221,42 @@ def test_broadcast_reaches_all_but_sender():
     Simulator.Schedule(Seconds(1), devs[0].Send, Packet(5), Mac48Address.GetBroadcast(), 0)
     Simulator.Run()
     assert sorted(got) == [1, 2, 3]
+
+
+def test_disposed_application_never_starts_or_stops():
+    """Upstream Application::DoDispose cancels the pending start/stop
+    events (the promoted EVT001 baseline finding): a disposed app must
+    not fire either callback when the simulation runs on."""
+    from tpudes.network.application import Application
+
+    calls = []
+
+    class Probe(Application):
+        tid = Application.tid
+
+        def StartApplication(self):
+            calls.append("start")
+
+        def StopApplication(self):
+            calls.append("stop")
+
+    node = Node()
+    app = Probe()
+    app.SetStartTime(Seconds(1.0))
+    app.SetStopTime(Seconds(2.0))
+    node.AddApplication(app)
+    Simulator.Schedule(Seconds(0.5), app.Dispose)
+    Simulator.Stop(Seconds(3.0))
+    Simulator.Run()
+    assert calls == []
+
+    # un-disposed control: both fire
+    Simulator.Destroy()
+    node2 = Node()
+    app2 = Probe()
+    app2.SetStartTime(Seconds(0.1))
+    app2.SetStopTime(Seconds(0.2))
+    node2.AddApplication(app2)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    assert calls == ["start", "stop"]

@@ -1,0 +1,462 @@
+"""tpudes.obs tests: host profiler, flight recorder, Chrome-trace
+export, on-device metric accumulators, compile telemetry, and the two
+acceptance gates — host/device metric parity on a deterministic
+dumbbell, and the TpudesObs=0 zero-cost contract.
+"""
+
+import io
+import json
+import subprocess
+import sys
+from pathlib import Path
+from time import perf_counter
+
+import jax
+import numpy as np
+import pytest
+
+from tpudes.core import Seconds, Simulator
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.simulator import DefaultSimulatorImpl
+from tpudes.core.world import reset_world
+from tpudes.obs import (
+    CompileTelemetry,
+    FlightRecorder,
+    validate_chrome_trace,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _echo_pair(packets=3):
+    from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+    from tpudes.helper.containers import NodeContainer
+    from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+    from tpudes.helper.point_to_point import PointToPointHelper
+
+    nodes = NodeContainer()
+    nodes.Create(2)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "5Mbps")
+    p2p.SetChannelAttribute("Delay", "2ms")
+    devices = p2p.Install(nodes)
+    InternetStackHelper().Install(nodes)
+    ifc = Ipv4AddressHelper("10.1.1.0", "255.255.255.0").Assign(devices)
+    UdpEchoServerHelper(9).Install(nodes.Get(1)).Start(Seconds(0.0))
+    client = UdpEchoClientHelper(ifc.GetAddress(1), 9)
+    client.SetAttribute("MaxPackets", packets)
+    client.SetAttribute("Interval", Seconds(0.1))
+    client.SetAttribute("PacketSize", 400)
+    client.Install(nodes.Get(0)).Start(Seconds(0.1))
+    return nodes, devices
+
+
+# --- host profiler ---------------------------------------------------------
+
+def test_disabled_is_structurally_zero_cost():
+    """TpudesObs=0 must leave the engine byte-identical to pre-obs code:
+    no profiler, no scheduler wrapper, the class ``_invoke`` un-shadowed."""
+    impl = Simulator.GetImpl()
+    assert impl._obs is None
+    assert "_invoke" not in impl.__dict__  # no instance-attr swap
+    from tpudes.obs.profiler import InstrumentedScheduler
+
+    assert not isinstance(impl._events, InstrumentedScheduler)
+
+
+def test_profiler_counts_types_and_queue_depth():
+    GlobalValue.Bind("TpudesObs", 1)
+    _echo_pair(packets=3)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    obs = Simulator.GetImpl()._obs
+    assert obs is not None
+    assert obs.event_count == Simulator.GetEventCount() > 0
+    summary = obs.summary()
+    assert sum(t["count"] for t in summary["event_types"].values()) == obs.event_count
+    assert all(t["wall_s"] >= 0.0 for t in summary["event_types"].values())
+    # the echo exchange schedules receives while others are pending
+    assert summary["queue"]["depth_max"] >= 2
+    assert summary["queue"]["inserts"] >= obs.event_count
+    # event-type labels are callback qualnames
+    assert any("Receive" in name for name in summary["event_types"])
+
+
+def test_window_stats_on_jax_engine():
+    GlobalValue.Bind("TpudesObs", 1)
+    GlobalValue.Bind(
+        "SimulatorImplementationType", "tpudes::JaxSimulatorImpl"
+    )
+    _echo_pair(packets=5)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    impl = Simulator.GetImpl()
+    obs = impl._obs
+    w = obs.summary()["windows"]
+    assert w["count"] == impl.windows_run > 0
+    assert w["events"] == obs.event_count
+    assert w["events_per_window"] == pytest.approx(
+        w["events"] / w["count"]
+    )
+
+
+def test_show_progress_reads_profiler_stats():
+    GlobalValue.Bind("TpudesObs", 1)
+    from tpudes.core.show_progress import ShowProgress
+
+    _echo_pair(packets=8)
+    buf = io.StringIO()
+    sp = ShowProgress(Seconds(0.25), stream=buf)
+    # one meter: ShowProgress samples the engine profiler's RunStats
+    assert sp._stats is Simulator.GetImpl()._obs.run_stats
+    Simulator.Stop(Seconds(1.2))
+    Simulator.Run()
+    lines = [
+        ln for ln in buf.getvalue().splitlines()
+        if ln.startswith("ShowProgress:")
+    ]
+    assert len(lines) >= 2
+    assert "ev/s" in lines[0] and "sim-s/wall-s" in lines[0]
+    assert "q=" in lines[0]  # live queue depth column, profiler-only
+
+
+def test_show_progress_still_works_without_obs():
+    from tpudes.core.show_progress import ShowProgress
+
+    _echo_pair(packets=8)
+    buf = io.StringIO()
+    ShowProgress(Seconds(0.25), stream=buf)
+    Simulator.Stop(Seconds(1.2))
+    Simulator.Run()
+    lines = buf.getvalue().splitlines()
+    assert lines and all("q=" not in ln for ln in lines)
+
+
+# --- flight recorder -------------------------------------------------------
+
+def test_flight_recorder_ring_is_bounded_and_keeps_the_tail():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.note(i, 0, i, f"ev{i}")
+    assert len(rec) == 4
+    assert [e[3] for e in rec.entries()] == ["ev6", "ev7", "ev8", "ev9"]
+
+
+def test_flight_recorder_dumps_on_event_exception(capsys):
+    GlobalValue.Bind("TpudesObs", 1)
+    GlobalValue.Bind("TpudesObsRing", 8)
+
+    def noop():
+        pass
+
+    def boom():
+        raise ValueError("kaput")
+
+    for i in range(20):
+        Simulator.Schedule(Seconds(0.01 * i), noop)
+    Simulator.Schedule(Seconds(0.5), boom)
+    with pytest.raises(ValueError, match="kaput"):
+        Simulator.Run()
+    err = capsys.readouterr().err
+    assert "flight recorder" in err and "kaput" in err
+    # capacity knob honored: 8 entries + 2 frame lines
+    body = [ln for ln in err.splitlines() if ln.startswith("  ts=")]
+    assert len(body) == 8
+    assert "boom" in body[-1]  # newest last == the fatal event
+
+
+# --- Chrome-trace export ---------------------------------------------------
+
+def test_chrome_trace_export_schema_and_cli(tmp_path):
+    trace = tmp_path / "trace.json"
+    GlobalValue.Bind("TpudesObs", 1)
+    GlobalValue.Bind("TpudesObsTrace", str(trace))
+    _echo_pair(packets=3)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    Simulator.Destroy()  # writes the export
+    doc = json.loads(trace.read_text())
+    assert validate_chrome_trace(doc) == []
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert spans and all("sim_ts" in s["args"] for s in spans)
+    assert doc["otherData"]["events"] > 0
+    # the CLI validator gates the same file (the CI smoke step)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpudes.obs", str(trace)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "valid Chrome trace" in proc.stdout
+
+
+def test_chrome_trace_validator_rejects_malformed():
+    assert validate_chrome_trace([]) == ["top level is not an object"]
+    assert validate_chrome_trace({}) == ["'traceEvents' missing or not an array"]
+    bad_ph = {"traceEvents": [{"ph": "Z", "name": "x", "ts": 0}]}
+    assert any("bad phase" in p for p in validate_chrome_trace(bad_ph))
+    no_dur = {"traceEvents": [
+        {"ph": "X", "name": "x", "ts": 1, "pid": 0, "tid": 0}
+    ]}
+    assert any("dur" in p for p in validate_chrome_trace(no_dur))
+    neg_ts = {"traceEvents": [
+        {"ph": "i", "name": "x", "ts": -5, "pid": 0, "tid": 0}
+    ]}
+    assert any("ts" in p for p in validate_chrome_trace(neg_ts))
+
+
+# --- device metric accumulators -------------------------------------------
+
+def _deterministic_dumbbell(sim_s=2.0, max_bytes_per_flow=20_000):
+    """Two budget-limited flows through an uncongested bottleneck: the
+    per-flow delivered/drop/retransmit totals are independent of the
+    departure interleaving (every packet is eventually served, none is
+    ever dropped), so both engines must finish the budgets with zero
+    drops and zero retransmissions — deterministically."""
+    from tpudes.scenarios import build_dumbbell
+
+    db, sinks = build_dumbbell(
+        2, sim_s, variant="TcpNewReno", queue="200p", seg_bytes=1000
+    )
+    from tpudes.models.applications import BulkSendApplication
+    from tpudes.network.node import NodeList
+
+    bulks = [
+        app
+        for i in range(NodeList.GetNNodes())
+        for a in range(NodeList.GetNode(i).GetNApplications())
+        if isinstance(
+            app := NodeList.GetNode(i).GetApplication(a), BulkSendApplication
+        )
+    ]
+    for bulk in bulks:
+        bulk.SetAttribute("MaxBytes", max_bytes_per_flow)
+    return db, sinks, bulks
+
+
+def test_dumbbell_device_metrics_match_host_traced_counts():
+    """Acceptance gate: device-accumulated drop/retransmit counters ==
+    the host engine's TracedCallback-derived counts on a deterministic
+    dumbbell (and the delivered byte count agrees exactly)."""
+    from tpudes.parallel.tcp_dumbbell import lower_dumbbell, run_tcp_dumbbell
+
+    sim_s, budget = 2.0, 20_000
+    db, sinks, bulks = _deterministic_dumbbell(sim_s, budget)
+    prog = lower_dumbbell(sim_s)
+
+    # --- host side: counters derived purely from TracedCallbacks -------
+    host_drops, host_retx = [], []
+    from tpudes.network.node import NodeList
+
+    for i in range(NodeList.GetNNodes()):
+        node = NodeList.GetNode(i)
+        for d in range(node.GetNDevices()):
+            q = getattr(node.GetDevice(d), "GetQueue", lambda: None)()
+            if q is not None:
+                q.TraceConnectWithoutContext(
+                    "Drop", lambda p: host_drops.append(p)
+                )
+
+    def hook_retransmit():
+        for bulk in bulks:
+            bulk._socket.TraceConnectWithoutContext(
+                "Retransmit", lambda seq: host_retx.append(seq)
+            )
+
+    Simulator.Schedule(Seconds(0.15), hook_retransmit)  # after app starts
+    Simulator.Stop(Seconds(sim_s))
+    Simulator.Run()
+    host_rx = [s.GetTotalRx() for s in sinks]
+    assert host_rx == [budget, budget]  # both budgets completed
+    reset_world()
+
+    # --- device side: obs accumulators fetched once at run end ---------
+    GlobalValue.Bind("TpudesObs", 1)
+    out = run_tcp_dumbbell(prog, jax.random.PRNGKey(0), replicas=3)
+    delivered = np.asarray(out["delivered"])
+    dev_drops = np.asarray(out["drops"])
+    dev_retx = np.asarray(out["retx"])
+    dev_cuts = np.asarray(out["cwnd_cuts"])
+    # deterministic: every replica identical
+    assert (delivered == delivered[0]).all()
+    # parity with the host TracedCallback counts, per flow
+    assert (delivered[0] * prog.seg_bytes).tolist() == host_rx
+    assert dev_drops.sum() == len(host_drops) == 0
+    assert dev_retx.sum() == len(host_retx) == 0
+    assert dev_cuts.sum() == 0  # no loss -> no cwnd reduction anywhere
+
+
+def test_dumbbell_obs_accumulators_consistent_under_loss():
+    from tpudes.parallel.tcp_dumbbell import (
+        OBS_QHIST_BINS,
+        lower_dumbbell,
+        run_tcp_dumbbell,
+    )
+    from tpudes.scenarios import build_dumbbell
+
+    sim_s = 3.0
+    build_dumbbell(4, sim_s, variant="TcpNewReno", queue="10p")
+    prog = lower_dumbbell(sim_s)
+    reset_world()
+    GlobalValue.Bind("TpudesObs", 1)
+    out = run_tcp_dumbbell(prog, jax.random.PRNGKey(1), replicas=4)
+    drops = np.asarray(out["drops"])
+    retx = np.asarray(out["retx"])
+    cuts = np.asarray(out["cwnd_cuts"])
+    hist = np.asarray(out["queue_hist"])
+    assert hist.shape == (4, OBS_QHIST_BINS)
+    # one histogram increment per slot per replica
+    assert (hist.sum(axis=1) == prog.n_slots).all()
+    assert drops.sum() > 0  # the 10p queue overflows
+    assert cuts.sum() > 0  # losses triggered window reductions
+    # every retransmission is a detected loss; detection trails the
+    # drop by ack_lag so the consumed count never exceeds the drops
+    assert 0 < retx.sum() <= drops.sum()
+
+
+def test_dumbbell_obs_off_omits_metric_keys_and_matches():
+    from tpudes.parallel.tcp_dumbbell import lower_dumbbell, run_tcp_dumbbell
+    from tpudes.scenarios import build_dumbbell
+
+    build_dumbbell(2, 1.0, variant="TcpNewReno")
+    prog = lower_dumbbell(1.0)
+    reset_world()
+    out_off = run_tcp_dumbbell(prog, jax.random.PRNGKey(2), replicas=2)
+    assert "retx" not in out_off and "queue_hist" not in out_off
+    GlobalValue.Bind("TpudesObs", 1)
+    out_on = run_tcp_dumbbell(prog, jax.random.PRNGKey(2), replicas=2)
+    # the accumulators ride along without disturbing the outcome
+    np.testing.assert_array_equal(
+        np.asarray(out_off["delivered"]), np.asarray(out_on["delivered"])
+    )
+
+
+def test_lte_sweep_compile_telemetry_pins_single_executable():
+    """PR 2's 'one executable serves the family' claim, as a metric: a
+    scheduler sweep over the same lowered program records ONE compile."""
+    import dataclasses
+
+    from tpudes.parallel import lte_sm as lte_sm_mod
+    from tpudes.parallel.lte_sm import run_lte_sm
+
+    sys.path.insert(0, str(REPO / "tests"))
+    from test_lte_sm import _toy_prog
+
+    prog = _toy_prog(n_enb=2, n_ue=4, n_ttis=40)
+    lte_sm_mod._SM_CACHE.clear()
+    CompileTelemetry.reset()
+    for sched in ("pf", "rr", "fdmt"):
+        run_lte_sm(
+            dataclasses.replace(prog, scheduler=sched),
+            jax.random.PRNGKey(0), replicas=2,
+        )
+    snap = CompileTelemetry.snapshot()
+    assert snap["lte_sm"]["compiles"] == 1
+    assert snap["lte_sm"]["wall_s"] > 0
+
+
+def test_bss_retx_metric_rides_the_carry():
+    sys.path.insert(0, str(REPO / "tests"))
+    from test_replicated import _lowered_program
+
+    prog = _lowered_program()
+    GlobalValue.Bind("TpudesObs", 1)
+    from tpudes.parallel.replicated import run_replicated_bss
+
+    out = run_replicated_bss(prog, 8, jax.random.PRNGKey(3))
+    assert out["all_done"]
+    retx = np.asarray(out["retx"])
+    assert retx.shape == (8,)
+    # retransmissions are attempts that are not first tries
+    assert (retx >= 0).all()
+    assert (retx <= np.asarray(out["tx_data"])).all()
+
+
+# --- zero-cost contract ----------------------------------------------------
+
+def _storm(impl, n):
+    def noop():
+        pass
+
+    for i in range(n):
+        impl.Schedule(i, noop, ())
+
+
+def _pristine_run(impl):
+    """The pre-obs DefaultSimulatorImpl loop, verbatim — the no-obs
+    baseline the acceptance criterion compares against."""
+    impl._stop = False
+    events = impl._events
+    while not impl._stop:
+        impl._process_events_with_context()
+        if events.IsEmpty():
+            break
+        ev = events.RemoveNext()
+        impl.current_ts = ev.ts
+        impl.current_context = ev.context
+        impl.current_uid = ev.uid
+        impl._event_count += 1
+        ev.invoke()
+
+
+def test_obs_disabled_overhead_within_3_percent():
+    """TpudesObs=0 runtime pinned within 3% of a no-obs run on the host
+    event loop (the denominator loop of every bench.py row).  The
+    Python scheduler is forced on both sides so the identical dispatch
+    path is measured."""
+    GlobalValue.Bind("SchedulerType", "tpudes::PyHeapScheduler")
+    N = 50_000
+
+    def once(run_fn):
+        impl = DefaultSimulatorImpl()
+        assert impl._obs is None
+        _storm(impl, N)
+        t0 = perf_counter()
+        run_fn(impl)
+        dt = perf_counter() - t0
+        assert impl._event_count == N
+        return dt
+
+    for attempt in range(3):
+        knob0 = min(once(DefaultSimulatorImpl.Run) for _ in range(5))
+        pristine = min(once(_pristine_run) for _ in range(5))
+        if knob0 <= pristine * 1.03:
+            return
+    pytest.fail(
+        f"TpudesObs=0 run {knob0:.4f}s vs no-obs {pristine:.4f}s "
+        f"({knob0 / pristine:.3f}x > 1.03x)"
+    )
+
+
+def test_queue_depth_resyncs_after_cancellations():
+    """Cancelled events are purged inside the wrapped scheduler without
+    a visible pop; the profiler's periodic resync must snap the depth
+    back to the exact live count instead of drifting upward forever."""
+    GlobalValue.Bind("TpudesObs", 1)
+
+    def noop():
+        pass
+
+    ids = [Simulator.Schedule(Seconds(5.0 + 0.001 * i), noop) for i in range(500)]
+    for eid in ids:
+        eid.Cancel()
+    Simulator.Schedule(Seconds(0.1), noop)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    obs = Simulator.GetImpl()._obs
+    # without the resync the 500 phantom entries would linger
+    assert obs.resync_depth() == 0
+    assert obs.summary()["queue"]["depth"] == 0
+
+
+def test_window_totals_are_exact_beyond_the_span_cap():
+    from tpudes.obs import HostProfiler
+
+    obs = HostProfiler(ring_capacity=8)
+    obs.MAX_SPANS = 10
+    for i in range(25):
+        obs.on_window(obs.run_stats.wall_start, 0.001, 2, 1)
+    w = obs.summary()["windows"]
+    assert len(obs.windows) == 10          # export list stays bounded
+    assert w["count"] == 25                # totals stay exact
+    assert w["events"] == 50
+    assert w["events_per_window"] == pytest.approx(2.0)

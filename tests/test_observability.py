@@ -204,3 +204,82 @@ def test_show_progress_emits_rate_lines():
     lines = [ln for ln in out.splitlines() if ln.startswith("ShowProgress:")]
     assert len(lines) >= 2
     assert "ev/s" in lines[0] and "sim-s/wall-s" in lines[0]
+
+
+def test_pcap_all_and_ascii_all_cover_every_device(tmp_path):
+    """EnablePcapAll/EnableAsciiAll round trip: one pcap per device plus
+    the single shared ascii stream, all non-empty and parseable."""
+    nodes, devices, p2p = _echo_pair()
+    p2p.EnablePcapAll(str(tmp_path / "all"))
+    p2p.EnableAsciiAll(str(tmp_path / "all.tr"))
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    Simulator.Destroy()
+    for name in ("all-0-0.pcap", "all-1-0.pcap"):
+        hdr, recs = _parse_pcap(tmp_path / name)
+        assert hdr["magic"] == PCAP_MAGIC and hdr["dlt"] == DLT_PPP
+        assert len(recs) == 6  # both devices see both directions
+    lines = (tmp_path / "all.tr").read_text().splitlines()
+    assert lines
+    paths = {ln.split()[2] for ln in lines}
+    assert any(p.startswith("/NodeList/0/") for p in paths)
+    assert any(p.startswith("/NodeList/1/") for p in paths)
+    for ln in lines:
+        code, ts, path = ln.split()[:3]
+        assert code in "+-dr"
+        float(ts)
+
+
+def test_ascii_same_filename_appends_to_one_stream(tmp_path):
+    """Two EnableAscii calls naming the same file must share ONE handle
+    (the upstream single-stream contract) — the second must not
+    truncate the first's lines."""
+    nodes, devices, p2p = _echo_pair()
+    path = str(tmp_path / "shared.tr")
+    p2p.EnableAscii(path, devices.Get(0))
+    p2p.EnableAscii(path, devices.Get(1))
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    Simulator.Destroy()
+    lines = open(path).read().splitlines()
+    paths = {ln.split()[2] for ln in lines}
+    assert any(p.startswith("/NodeList/0/") for p in paths)
+    assert any(p.startswith("/NodeList/1/") for p in paths)
+
+
+def test_ascii_drop_letter_on_queue_overflow(tmp_path):
+    """The 'd' event letter: a 1-packet tx queue under a burst of
+    back-to-back sends must record drops in the ascii stream."""
+    nodes, devices, p2p = _echo_pair()
+    # re-build with a tiny queue and a flooding client
+    Simulator.Destroy()
+    from tpudes.core.world import reset_world
+
+    reset_world()
+    from tpudes.helper.containers import NodeContainer
+    from tpudes.helper.internet import InternetStackHelper, Ipv4AddressHelper
+    from tpudes.helper.point_to_point import PointToPointHelper
+    from tpudes.helper.applications import UdpEchoClientHelper, UdpEchoServerHelper
+
+    nodes = NodeContainer()
+    nodes.Create(2)
+    p2p = PointToPointHelper()
+    p2p.SetDeviceAttribute("DataRate", "5Mbps")
+    p2p.SetChannelAttribute("Delay", "2ms")
+    p2p.SetQueue("tpudes::DropTailQueue", MaxSize="1p")
+    devices = p2p.Install(nodes)
+    InternetStackHelper().Install(nodes)
+    ifc = Ipv4AddressHelper("10.1.1.0", "255.255.255.0").Assign(devices)
+    UdpEchoServerHelper(9).Install(nodes.Get(1)).Start(Seconds(0.0))
+    client = UdpEchoClientHelper(ifc.GetAddress(1), 9)
+    client.SetAttribute("MaxPackets", 10)
+    client.SetAttribute("Interval", Seconds(0.0001))  # << 1.6 ms serialization
+    client.SetAttribute("PacketSize", 1000)
+    client.Install(nodes.Get(0)).Start(Seconds(0.1))
+    p2p.EnableAscii(str(tmp_path / "drop.tr"), devices)
+    Simulator.Stop(Seconds(1.0))
+    Simulator.Run()
+    Simulator.Destroy()
+    lines = (tmp_path / "drop.tr").read_text().splitlines()
+    dropped = [ln for ln in lines if ln[0] == "d"]
+    assert dropped and all("/TxQueue/Drop" in ln for ln in dropped)

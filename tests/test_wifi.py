@@ -284,3 +284,69 @@ def test_wifi_udp_echo_end_to_end():
     Simulator.Run()
     assert server_rx == [256, 256]
     assert client_rx == [256, 256]
+
+
+def test_sta_disassociate_fires_deassoc_and_rejoins():
+    """StaWifiMac.Disassociate (the promoted DeAssoc REG001 finding):
+    the trace fires with the AP address, the STA drops out of the BSS,
+    and a later beacon re-associates it."""
+
+    def setup(i, mac):
+        mac.SetType("tpudes::ApWifiMac" if i == 0 else "tpudes::StaWifiMac")
+
+    nodes, devices = _wifi_nodes(2, [(0, 0, 0), (5, 0, 0)], setup)
+    ap_mac = devices[0].GetMac()
+    sta = devices[1].GetMac()
+    gone = []
+    sta.TraceConnectWithoutContext("DeAssoc", lambda ap: gone.append(str(ap)))
+
+    def kick():
+        assert sta.IsAssociated()
+        sta.Disassociate()
+        assert not sta.IsAssociated()
+        assert sta.GetBssid() is None
+
+    Simulator.Schedule(Seconds(0.5), kick)
+    Simulator.Stop(Seconds(1.5))
+    Simulator.Run()
+    assert gone == [str(ap_mac.GetAddress())]
+    # the next beacons re-ran the scan -> assoc handshake
+    assert sta.IsAssociated()
+
+
+def test_stale_assoc_resp_after_disassociate_is_ignored():
+    """A stale DCF-retransmitted ASSOC_RESP arriving after
+    Disassociate() cleared the state must NOT silently re-associate the
+    STA (there is no outstanding request) — the pre-fix handler would
+    flip `_associated` with `_ap=None`, flushing data frames addressed
+    to no AP.  A later beacon re-runs the scan→request→response
+    handshake and rejoins cleanly."""
+    def setup(i, mac):
+        mac.SetType("tpudes::ApWifiMac" if i == 0 else "tpudes::StaWifiMac")
+
+    nodes, devices = _wifi_nodes(2, [(0, 0, 0), (5, 0, 0)], setup)
+    ap_mac = devices[0].GetMac()
+    sta = devices[1].GetMac()
+
+    def race():
+        from tpudes.models.wifi.mac import WifiMacHeader, WifiMacType
+
+        sta.Disassociate()
+        assert sta.GetBssid() is None
+        stale = WifiMacHeader(
+            WifiMacType.ASSOC_RESP,
+            addr1=sta.GetAddress(),
+            addr2=ap_mac.GetAddress(),
+            addr3=ap_mac.GetAddress(),
+            seq=99,
+        )
+        sta.Receive(None, stale)
+        assert not sta.IsAssociated()
+        assert sta.GetBssid() is None
+
+    Simulator.Schedule(Seconds(0.5), race)
+    Simulator.Stop(Seconds(1.5))
+    Simulator.Run()
+    # the next beacons re-ran the genuine handshake
+    assert sta.IsAssociated()
+    assert str(sta.GetBssid()) == str(ap_mac.GetAddress())

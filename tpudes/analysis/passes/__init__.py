@@ -8,6 +8,7 @@ from tpudes.analysis.passes.jit_purity import JitPurityPass
 from tpudes.analysis.passes.registry_parity import RegistryParityPass
 from tpudes.analysis.passes.rng_discipline import RngDisciplinePass
 from tpudes.analysis.passes.style import StylePass
+from tpudes.analysis.passes.trace_arity import TraceArityPass
 
 BUILTIN_PASSES = [
     StylePass,
@@ -16,4 +17,5 @@ BUILTIN_PASSES = [
     DeterminismPass,
     EventHygienePass,
     RegistryParityPass,
+    TraceArityPass,
 ]

@@ -1,0 +1,168 @@
+"""trace-arity pass: TracedCallback fire arity vs connected-sink
+signature (the ROADMAP open item).
+
+A ``TracedCallback`` is fired as ``self.<field>(a, b, ...)`` inside the
+class whose TypeId declared it (``AddTraceSource("Name", ...)`` binds
+``field`` via the same name→field rule the runtime uses), and consumed
+by sinks connected with ``TraceConnectWithoutContext("Name", sink)`` /
+``TraceConnect("Name", context, sink)``.  Nothing checks the two ends
+against each other at runtime until the trace actually fires — a sink
+whose signature cannot accept the fired arity is a latent ``TypeError``
+that only detonates on the (often rare) traced path.
+
+TRC001 fires at a connect site when the sink's positional-parameter
+window ``[required, max]`` (defaults widen it; ``*args`` disables the
+check) cannot accept ANY observed fire arity for that trace name —
+``TraceConnect`` sinks receive the context string prepended, so their
+window shifts by one.  Fire arities are collected project-wide per
+trace NAME (not per class): two classes sharing a name union their
+arities, so the pass under-reports rather than cross-flags.  Sinks it
+cannot resolve statically (method references, ``MakeCallback`` results,
+bound names) are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudes.core.object import _default_field
+from tpudes.analysis.base import Finding, Pass, SourceModule
+
+_CONNECT_METHODS = {"TraceConnectWithoutContext": 0, "TraceConnect": 1}
+
+
+def _class_trace_fields(cls: ast.ClassDef) -> dict[str, str]:
+    """``field -> trace name`` for every AddTraceSource in the class
+    body's TypeId declaration chain."""
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "AddTraceSource"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        name = node.args[0].value
+        field = None
+        for kw in node.keywords:
+            if kw.arg == "field" and isinstance(kw.value, ast.Constant):
+                field = kw.value.value
+        if field is None and len(node.args) >= 3 and isinstance(
+            node.args[2], ast.Constant
+        ):
+            field = node.args[2].value
+        if field is None:
+            field = _default_field(name)
+        out[field] = name
+    return out
+
+
+def _sink_window(sink: ast.AST, mod: SourceModule) -> tuple[int, int] | None:
+    """``(required, max)`` positional-parameter window for a sink
+    expression, or None when it cannot be resolved statically (or
+    accepts anything via ``*args``)."""
+    fn = None
+    if isinstance(sink, ast.Lambda):
+        fn = sink
+    elif isinstance(sink, ast.Name):
+        # module-level def of the same name
+        for node in mod.tree.body:
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == sink.id
+            ):
+                fn = node
+                break
+    if fn is None:
+        return None
+    a = fn.args
+    if a.vararg is not None:
+        return None  # accepts anything
+    params = list(a.posonlyargs) + list(a.args)
+    if params and params[0].arg == "self":
+        # a def referenced by bare name inside a class body — treat the
+        # remaining params as the callable surface
+        params = params[1:]
+    n_max = len(params)
+    n_req = n_max - len(a.defaults)
+    return (n_req, n_max)
+
+
+class TraceArityPass(Pass):
+    name = "trace-arity"
+    codes = {
+        "TRC001": "TracedCallback fire arity vs connected-sink signature mismatch",
+    }
+    project_wide = True
+
+    def check_project(self, mods: list[SourceModule]) -> list[Finding]:
+        # 1. fire arities per trace name, from self.<field>(...) calls
+        #    inside the declaring class (tpudes/ modules only)
+        fires: dict[str, set[int]] = {}
+        for mod in mods:
+            if mod.tree is None or not mod.in_package("tpudes"):
+                continue
+            for cls in ast.walk(mod.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                fields = _class_trace_fields(cls)
+                if not fields:
+                    continue
+                for node in ast.walk(cls):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in fields
+                    ):
+                        continue
+                    if node.keywords or any(
+                        isinstance(arg, ast.Starred) for arg in node.args
+                    ):
+                        continue  # dynamic arity: unknowable statically
+                    fires.setdefault(fields[node.func.attr], set()).add(
+                        len(node.args)
+                    )
+
+        # 2. connect sites anywhere in the analyzed set
+        out: list[Finding] = []
+        for mod in mods:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CONNECT_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    continue
+                name = node.args[0].value
+                arities = fires.get(name)
+                if not arities:
+                    continue  # no observed fire site (e.g. TracedValue)
+                shift = _CONNECT_METHODS[node.func.attr]
+                sink_idx = 1 + shift  # TraceConnect(name, context, sink)
+                if len(node.args) <= sink_idx:
+                    continue
+                window = _sink_window(node.args[sink_idx], mod)
+                if window is None:
+                    continue
+                n_req, n_max = window
+                if any(n_req <= a + shift <= n_max for a in arities):
+                    continue
+                fired = ", ".join(str(a) for a in sorted(arities))
+                ctx_note = " (+1 context arg)" if shift else ""
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, "TRC001",
+                    f"sink connected to trace '{name}' accepts "
+                    f"{n_req}..{n_max} positional args but the source "
+                    f"fires {fired}{ctx_note}",
+                ))
+        return out

@@ -131,4 +131,26 @@ JaxReplicas = GlobalValue(
     0,
 )
 
+# Observability knobs (tpudes/obs).  Registered here, like the engine
+# knobs, so CommandLine / NS_GLOBAL_VALUE can bind them before any
+# engine or device program is constructed.
+TpudesObs = GlobalValue(
+    "TpudesObs",
+    "enable the unified observability layer: host event profiler, "
+    "flight recorder, on-device metric accumulators (0 = zero-cost off)",
+    0,
+)
+TpudesObsTrace = GlobalValue(
+    "TpudesObsTrace",
+    "path to write a Chrome-trace/Perfetto JSON export of the run at "
+    "Simulator.Destroy ('' = no export; needs TpudesObs=1)",
+    "",
+)
+TpudesObsRing = GlobalValue(
+    "TpudesObsRing",
+    "flight-recorder capacity: the last N executed events dumped on an "
+    "exception or invariant trip",
+    512,
+)
+
 GlobalValue.ApplyEnvironment()

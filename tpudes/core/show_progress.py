@@ -6,46 +6,57 @@ mount empty at survey — SURVEY.md §0, §5.5).  Construct one before
 elapsed wall clock, the event execution rate since the last report, and
 the speedup (sim seconds per wall second) — upstream's exact trio — and
 adapts the reporting interval toward one line per ~second of wall time.
+
+Rate bookkeeping lives in the observability layer
+(:class:`tpudes.obs.profiler.RunStats`), not here: with ``TpudesObs=1``
+ShowProgress shares the engine profiler's meter (one source of truth
+for the trace export and the progress line, plus a live queue-depth
+column); with the knob off it owns a standalone ``RunStats``.
 """
 
 from __future__ import annotations
 
 import sys
-import time
 
 from tpudes.core.nstime import Seconds, Time
 from tpudes.core.simulator import Simulator
+from tpudes.obs.profiler import RunStats
 
 
 class ShowProgress:
     def __init__(self, interval=None, stream=None):
         self._interval = Time(interval) if interval is not None else Seconds(1.0)
         self._stream = stream if stream is not None else sys.stderr
-        self._wall_start = time.monotonic()
-        self._last_wall = self._wall_start
-        self._last_events = 0
-        self._last_sim_s = 0.0
+        impl = Simulator.GetImpl()
+        self._obs = impl._obs
+        self._stats = (
+            self._obs.run_stats if self._obs is not None else RunStats()
+        )
+        # the engine profiler's meter dates from engine construction;
+        # prime it here so the first reported interval (and the wall
+        # column) measures from ShowProgress creation, as upstream does
+        snap0 = self._stats.sample(
+            Simulator.GetEventCount(), Simulator.Now().GetSeconds()
+        )
+        self._wall0 = snap0["wall_s"]
         Simulator.Schedule(self._interval, self._report)
 
     def _report(self):
-        now_wall = time.monotonic()
-        dt_wall = max(now_wall - self._last_wall, 1e-9)
-        events = Simulator.GetEventCount()
-        d_events = events - self._last_events
-        sim_s = Simulator.Now().GetSeconds()
-        d_sim = sim_s - self._last_sim_s
-        self._stream.write(
-            f"ShowProgress: sim {sim_s:.3f}s wall "
-            f"{now_wall - self._wall_start:.1f}s "
-            f"[{d_events / dt_wall:,.0f} ev/s, "
-            f"{d_sim / dt_wall:.3g} sim-s/wall-s]\n"
+        snap = self._stats.sample(
+            Simulator.GetEventCount(), Simulator.Now().GetSeconds()
         )
-        self._last_wall = now_wall
-        self._last_events = events
-        self._last_sim_s = sim_s
+        extra = ""
+        if self._obs is not None:
+            extra = f" q={self._obs.resync_depth()}"
+        self._stream.write(
+            f"ShowProgress: sim {snap['sim_s']:.3f}s wall "
+            f"{snap['wall_s'] - self._wall0:.1f}s "
+            f"[{snap['ev_per_s']:,.0f} ev/s, "
+            f"{snap['sim_per_wall']:.3g} sim-s/wall-s]{extra}\n"
+        )
         # adapt toward ~1 line per wall second (upstream's behavior)
-        if dt_wall < 0.5:
+        if snap["dt_wall"] < 0.5:
             self._interval = Time(self._interval.ticks * 2)
-        elif dt_wall > 2.0 and self._interval.ticks > 1:
+        elif snap["dt_wall"] > 2.0 and self._interval.ticks > 1:
             self._interval = Time(max(self._interval.ticks // 2, 1))
         Simulator.Schedule(self._interval, self._report)

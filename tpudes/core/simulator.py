@@ -42,6 +42,17 @@ class SimulatorImpl:
         self._events = create_scheduler(scheduler_type)
         self._event_count = 0  # total executed, for ShowProgress/bench
         self._scheduled_stop_ts: int | None = None  # last Stop(delay) target
+        # observability (tpudes/obs): with TpudesObs=0 the hot loop runs
+        # the pre-obs byte code — no per-event check is added; enabling
+        # swaps in the instrumented _invoke and wraps the scheduler (the
+        # wrapper hides run_native so every event reaches _invoke_obs)
+        self._obs = None
+        if GlobalValue.GetValueFailSafe("TpudesObs", 0):
+            from tpudes.obs.profiler import HostProfiler, InstrumentedScheduler
+
+            self._obs = HostProfiler()
+            self._events = InstrumentedScheduler(self._events, self._obs)
+            self._invoke = self._invoke_obs
 
     # --- scheduling ---
     def Schedule(self, delay_ticks: int, fn, args) -> Event:
@@ -128,6 +139,32 @@ class SimulatorImpl:
         self.current_uid = ev.uid
         self._event_count += 1
         ev.invoke()
+
+    def _invoke_obs(self, ev: Event) -> None:
+        """Instrumented twin of ``_invoke`` (installed as an instance
+        attribute when TpudesObs=1): per-type count + wall time, flight
+        recorder, crash dump, and the time-monotonicity invariant."""
+        obs = self._obs
+        if ev.ts < self.current_ts:
+            obs.trip(
+                f"event uid={ev.uid} at ts={ev.ts} behind now="
+                f"{self.current_ts} (queue ordering violated)"
+            )
+        self.current_ts = ev.ts
+        self.current_context = ev.context
+        self.current_uid = ev.uid
+        self._event_count += 1
+        obs.event_count += 1
+        fn = ev.fn
+        label = getattr(fn, "__qualname__", None) or type(fn).__name__
+        obs.recorder.note(ev.ts, ev.context, ev.uid, label)
+        t0 = _wallclock.monotonic()
+        try:
+            ev.invoke()
+        except BaseException as e:
+            obs.dump_crash(e)
+            raise
+        obs.record(label, t0, _wallclock.monotonic() - t0, ev)
 
 
 class DefaultSimulatorImpl(SimulatorImpl):
@@ -322,6 +359,14 @@ class Simulator:
         several simulations back-to-back (each pytest test does)."""
         if cls._impl is not None:
             cls._impl.Destroy()
+            obs = cls._impl._obs
+            if obs is not None:
+                # TpudesObsTrace names a Chrome-trace output path; the
+                # GlobalValue is still bound here (reset_world resets
+                # globals only after Destroy returns)
+                from tpudes.obs.export import export_on_destroy
+
+                export_on_destroy(obs)
         cls._impl = None
 
     # --- time / context ---

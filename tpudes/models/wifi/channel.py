@@ -95,6 +95,10 @@ class YansWifiChannel(Object):
                 # takes the exact per-pair path
                 cache = None
         impl = Simulator.GetImpl()
+        obs = impl._obs
+        if obs is not None:
+            # profiler hit rate: did this send ride the window/pair cache?
+            obs.prop_cache(cache is not None)
         if cache is not None:
             # fully-cached fast loop: precomputed power/delay-ticks/
             # context — no mobility, loss-chain, or Time churn per rx

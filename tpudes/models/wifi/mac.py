@@ -1085,6 +1085,19 @@ class StaWifiMac(WifiMac):
     def IsAssociated(self) -> bool:
         return self._associated
 
+    def Disassociate(self) -> None:
+        """Leave the BSS (upstream sta-wifi-mac beacon-loss /
+        Disassociate path): clear the association, fire the DeAssoc
+        trace, and rescan from the next beacon.  Data enqueued while
+        disassociated buffers in ``_pending_data`` until a
+        re-association flushes it, as on first join."""
+        if not self._associated:
+            return
+        self._associated = False
+        ap, self._ap = self._ap, None
+        self._assoc_req_ts = None
+        self.de_assoc(ap)
+
     def GetBssid(self):
         return self._ap
 
@@ -1126,7 +1139,15 @@ class StaWifiMac(WifiMac):
                 if elapsed > self.ASSOC_REQUEST_TIMEOUT_S:
                     self._send_assoc_req()
         elif header.frame_type == WifiMacType.ASSOC_RESP:
-            if not self._associated:
+            # accept only while OUR request to THIS AP is outstanding: a
+            # stale DCF-retransmitted resp (e.g. arriving after
+            # Disassociate() cleared the state, or from a previous AP
+            # mid-rescan) must not silently re-associate the STA
+            if (
+                not self._associated
+                and self._assoc_req_ts is not None
+                and header.addr2 == self._ap
+            ):
                 self._associated = True
                 self.assoc(header.addr2)
                 pending, self._pending_data = self._pending_data, []

@@ -5,6 +5,7 @@ Reference parity: src/network/model/application.{h,cc} (SURVEY.md 2.2).
 
 from __future__ import annotations
 
+from tpudes.core.event import EventId
 from tpudes.core.nstime import Time
 from tpudes.core.object import Object, TypeId
 from tpudes.core.simulator import Simulator
@@ -21,6 +22,8 @@ class Application(Object):
         super().__init__(**attributes)
         self._node = None
         self._started = False
+        self._start_event = EventId()
+        self._stop_event = EventId()
 
     def SetNode(self, node) -> None:
         self._node = node
@@ -35,12 +38,23 @@ class Application(Object):
         self.stop_time = Time(stop)
 
     def DoInitialize(self) -> None:
-        # Applications self-schedule their Start/Stop at Initialize (t=0)
+        # Applications self-schedule their Start/Stop at Initialize (t=0);
+        # the EventIds are held so DoDispose can Cancel them (upstream
+        # Application::DoDispose cancels m_startEvent/m_stopEvent — a
+        # disposed app must never start)
         delay = self.start_time - Simulator.Now()
-        Simulator.Schedule(Time(max(0, delay.ticks)), self._start)
+        self._start_event = Simulator.Schedule(Time(max(0, delay.ticks)), self._start)
         if self.stop_time.ticks > 0:
             delay = self.stop_time - Simulator.Now()
-            Simulator.Schedule(Time(max(0, delay.ticks)), self._stop)
+            self._stop_event = Simulator.Schedule(Time(max(0, delay.ticks)), self._stop)
+
+    def DoDispose(self) -> None:
+        # upstream Application::DoDispose: cancel the pending start/stop
+        # (a disposed app must never start); StopApplication is NOT
+        # called here, matching ns-3
+        self._start_event.Cancel()
+        self._stop_event.Cancel()
+        super().DoDispose()
 
     def _start(self):
         self._started = True

@@ -1,0 +1,54 @@
+"""tpudes.obs — unified observability across all three execution layers.
+
+One GlobalValue knob, ``TpudesObs`` (bound like every engine knob:
+``GlobalValue.Bind``, ``--TpudesObs=1`` on any CommandLine script, or
+``NS_GLOBAL_VALUE``), turns on:
+
+- the **host event-loop profiler** (:mod:`tpudes.obs.profiler`):
+  per-event-type counts and wall time, queue depth, per-window stats
+  and the propagation-cache hit rate on the windowed engine;
+- the **flight recorder** (:mod:`tpudes.obs.flight_recorder`): the last
+  ``TpudesObsRing`` events, dumped on an exception or invariant trip;
+- **on-device metric accumulators** in the parallel engines, fetched
+  once at run end (no host sync in the scan), plus process-wide XLA
+  compile telemetry (:mod:`tpudes.obs.device` — always on, it costs one
+  dict update per compile);
+- the **Chrome-trace export** (:mod:`tpudes.obs.export`): set
+  ``TpudesObsTrace=/path/trace.json`` and ``Simulator.Destroy`` writes
+  a chrome://tracing / Perfetto loadable timeline.  Validate with
+  ``python -m tpudes.obs trace.json``.
+
+With the knob at 0 the engines run their pre-obs code paths unchanged
+(pinned by the overhead test in tests/test_obs.py).
+"""
+
+from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+from tpudes.obs.export import (
+    assert_valid_chrome_trace,
+    chrome_trace,
+    export_chrome_trace,
+    export_on_destroy,
+    validate_chrome_trace,
+)
+from tpudes.obs.flight_recorder import FlightRecorder
+from tpudes.obs.profiler import (
+    HostProfiler,
+    InstrumentedScheduler,
+    RunStats,
+    enabled,
+)
+
+__all__ = [
+    "CompileTelemetry",
+    "FlightRecorder",
+    "HostProfiler",
+    "InstrumentedScheduler",
+    "RunStats",
+    "assert_valid_chrome_trace",
+    "chrome_trace",
+    "device_metrics_enabled",
+    "enabled",
+    "export_chrome_trace",
+    "export_on_destroy",
+    "validate_chrome_trace",
+]

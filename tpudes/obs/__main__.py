@@ -1,0 +1,45 @@
+"""Validate a Chrome-trace export against the Trace Event format.
+
+Usage::
+
+    python -m tpudes.obs <trace.json> [more.json ...]
+
+Exit 0 when every file is a valid trace, 1 on violations, 2 on usage /
+unreadable input.  This is the schema gate the CI smoke step runs over
+the trace exported by an example under ``TpudesObs=1``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from tpudes.obs.export import validate_chrome_trace
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or any(a in ("-h", "--help") for a in argv):
+        print(__doc__, file=sys.stderr)
+        return 2
+    rc = 0
+    for path in argv:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{path}: unreadable ({e})", file=sys.stderr)
+            return 2
+        problems = validate_chrome_trace(doc)
+        if problems:
+            rc = 1
+            for p in problems:
+                print(f"{path}: {p}")
+        else:
+            n = len(doc["traceEvents"])
+            print(f"{path}: valid Chrome trace ({n} records)")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,77 @@
+"""Device-side observability: XLA compile telemetry + metric plumbing.
+
+The device engines (tpudes/parallel) accumulate their metrics *inside*
+the scan carry — drops, retransmits, scheduler grants, cwnd-cut events,
+queue histograms — and fetch them once at run end with the outcome
+arrays, so the hot loop never syncs with the host.  What lives here is
+the part that must be process-global:
+
+- :class:`CompileTelemetry` — every engine records one entry per
+  jit-cache miss (compile count + wall time of the compiling call).
+  This pins the "one executable serves the family" property as a
+  *metric*: a 9-scheduler LTE sweep must show ``compiles == 1``.
+  Recording is always on (a dict update per compile is free); the
+  registry deliberately survives ``reset_world`` because XLA's compile
+  caches do too.
+- :func:`device_metrics_enabled` — the engines consult this at
+  lowering/build time; the extra carry buffers exist only when the
+  ``TpudesObs`` knob is up, so a disabled run compiles the exact
+  pre-obs program.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+def device_metrics_enabled() -> bool:
+    """The engines consult this when building a device program: the
+    same ``TpudesObs`` knob that arms the host profiler."""
+    from tpudes.obs.profiler import enabled
+
+    return enabled()
+
+
+class CompileTelemetry:
+    """Process-wide per-engine compile counters."""
+
+    _entries: dict[str, dict] = {}
+
+    @classmethod
+    def record(cls, engine: str, wall_s: float) -> None:
+        entry = cls._entries.setdefault(
+            engine, {"compiles": 0, "wall_s": 0.0}
+        )
+        entry["compiles"] += 1
+        entry["wall_s"] += float(wall_s)
+
+    @classmethod
+    def snapshot(cls) -> dict[str, dict]:
+        return {
+            engine: {"compiles": e["compiles"], "wall_s": round(e["wall_s"], 3)}
+            for engine, e in sorted(cls._entries.items())
+        }
+
+    @classmethod
+    def compiles(cls, engine: str) -> int:
+        return cls._entries.get(engine, {}).get("compiles", 0)
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._entries.clear()
+
+    @classmethod
+    @contextmanager
+    def timed(cls, engine: str, compiling: bool):
+        """Record one compile entry for the wrapped block when
+        ``compiling`` (a jit-cache miss) — the single plumbing shape
+        every parallel engine uses.  The caller must block on the
+        result inside the block (``jax.block_until_ready``) or the
+        recorded wall time under-counts the async compile."""
+        if not compiling:
+            yield
+            return
+        t0 = time.monotonic()
+        yield
+        cls.record(engine, time.monotonic() - t0)

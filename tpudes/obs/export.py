@@ -1,0 +1,139 @@
+"""Chrome-trace-event (Perfetto-loadable) export of a profiled run.
+
+The output is the JSON Object Format of the Trace Event spec: a top
+object with a ``traceEvents`` array, loadable in ``chrome://tracing``
+and https://ui.perfetto.dev unchanged.  Spans are wall-clock (ts/dur in
+microseconds since run start) because the question the exporter answers
+is "where did the *wall time* go"; each span carries the simulated
+timestamp and context in ``args`` so the two clocks can be correlated.
+
+Layout: tid 0 carries host event spans, tid 1 the engine windows, plus
+a queue-depth counter track and one metadata record per track.  The
+validator is dependency-free (no jsonschema in the image) and is what
+the CI smoke step runs over a real exported trace.
+"""
+
+from __future__ import annotations
+
+import json
+
+#: event phases the validator accepts (Trace Event Format, table 1)
+_KNOWN_PHASES = set("BEXiICnbesftTPNODMVvRcG()")
+
+_PID = 1
+_TID_EVENTS = 0
+_TID_WINDOWS = 1
+
+
+def chrome_trace(profiler) -> dict:
+    """Build the trace document from a ``HostProfiler``."""
+    events: list[dict] = [
+        {"ph": "M", "pid": _PID, "tid": _TID_EVENTS, "name": "process_name",
+         "args": {"name": "tpudes"}},
+        {"ph": "M", "pid": _PID, "tid": _TID_EVENTS, "name": "thread_name",
+         "args": {"name": "host events"}},
+        {"ph": "M", "pid": _PID, "tid": _TID_WINDOWS, "name": "thread_name",
+         "args": {"name": "engine windows"}},
+    ]
+    depth = 0
+    for label, t0, dur_s, sim_ts, context in profiler.spans:
+        events.append({
+            "ph": "X", "pid": _PID, "tid": _TID_EVENTS,
+            "name": label, "cat": "event",
+            "ts": round(t0 * 1e6, 3), "dur": round(dur_s * 1e6, 3),
+            "args": {"sim_ts": sim_ts, "context": context},
+        })
+    for i, (t0, dur_s, n_events, refreshes) in enumerate(profiler.windows):
+        events.append({
+            "ph": "X", "pid": _PID, "tid": _TID_WINDOWS,
+            "name": "window", "cat": "window",
+            "ts": round(t0 * 1e6, 3), "dur": round(dur_s * 1e6, 3),
+            "args": {"index": i, "events": n_events, "refreshes": refreshes},
+        })
+        depth += n_events
+        events.append({
+            "ph": "C", "pid": _PID, "tid": _TID_WINDOWS,
+            "name": "events_cum", "ts": round(t0 * 1e6, 3),
+            "args": {"events": depth},
+        })
+    events.append({
+        "ph": "C", "pid": _PID, "tid": _TID_EVENTS, "name": "queue_depth",
+        "ts": 0,
+        "args": {"depth_max": profiler.queue_depth_max,
+                 "depth_final": profiler.resync_depth()},
+    })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": profiler.summary(),
+    }
+
+
+def export_chrome_trace(profiler, path: str) -> dict:
+    doc = chrome_trace(profiler)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def export_on_destroy(profiler) -> None:
+    """Engine hook: write the trace if ``TpudesObsTrace`` names a path
+    (called from ``Simulator.Destroy`` while GlobalValues are live)."""
+    from tpudes.core.global_value import GlobalValue
+
+    path = GlobalValue.GetValueFailSafe("TpudesObsTrace", "")
+    if path:
+        export_chrome_trace(profiler, str(path))
+
+
+# --- schema validation (dependency-free) -----------------------------------
+
+def validate_chrome_trace(doc) -> list[str]:
+    """Return every way ``doc`` violates the Trace Event JSON Object
+    Format (empty list = valid).  Checks structure, required per-phase
+    fields, and value types — the contract chrome://tracing actually
+    relies on."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["top level is not an object"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["'traceEvents' missing or not an array"]
+    if not events:
+        problems.append("'traceEvents' is empty")
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or len(ph) != 1 or ph not in _KNOWN_PHASES:
+            problems.append(f"{where}: bad phase {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            problems.append(f"{where}: missing/empty 'name'")
+        for field in ("pid", "tid"):
+            if field in ev and not isinstance(ev[field], int):
+                problems.append(f"{where}: '{field}' is not an integer")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            problems.append(f"{where}: 'args' is not an object")
+        if ph in "XBEiIC":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                problems.append(f"{where}: '{ph}' needs numeric ts >= 0")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: 'X' needs numeric dur >= 0")
+        if ph == "M" and "args" not in ev:
+            problems.append(f"{where}: metadata record without 'args'")
+    return problems
+
+
+def assert_valid_chrome_trace(doc) -> None:
+    problems = validate_chrome_trace(doc)
+    if problems:
+        raise ValueError(
+            "invalid Chrome trace: " + "; ".join(problems[:10])
+            + (f" (+{len(problems) - 10} more)" if len(problems) > 10 else "")
+        )

@@ -1,0 +1,54 @@
+"""Flight recorder: a bounded ring buffer of the last N executed events.
+
+Upstream ns-3 has no analog — when a compiled engine or a long scalar
+run dies, the only forensics are whatever the user happened to log.
+The recorder keeps the tail of the event stream at O(1) cost per event
+and dumps it exactly once, on the first exception that escapes an event
+callback or on an engine invariant trip (time moving backwards).
+
+Capacity comes from the ``TpudesObsRing`` GlobalValue; the recorder
+only exists at all when ``TpudesObs=1`` (see tpudes/obs/profiler.py).
+"""
+
+from __future__ import annotations
+
+import sys
+from collections import deque
+
+
+class FlightRecorder:
+    """Ring of ``(sim_ts, context, uid, label)`` tuples, newest last."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self.dumped = False  # dump-once guard (exceptions propagate)
+
+    def note(self, ts: int, context: int, uid: int, label: str) -> None:
+        self._ring.append((ts, context, uid, label))
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def entries(self) -> list:
+        return list(self._ring)
+
+    def to_dicts(self) -> list[dict]:
+        return [
+            {"ts": ts, "context": ctx, "uid": uid, "event": label}
+            for ts, ctx, uid, label in self._ring
+        ]
+
+    def dump(self, reason: str = "", stream=None) -> None:
+        """Write the ring to ``stream`` (default stderr), once."""
+        if self.dumped:
+            return
+        self.dumped = True
+        stream = stream if stream is not None else sys.stderr
+        stream.write(
+            f"=== tpudes flight recorder: last {len(self._ring)} events"
+            f"{' — ' + reason if reason else ''} ===\n"
+        )
+        for ts, ctx, uid, label in self._ring:
+            stream.write(f"  ts={ts} ctx={ctx} uid={uid} {label}\n")
+        stream.write("=== end flight recorder ===\n")

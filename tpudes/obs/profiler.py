@@ -1,0 +1,243 @@
+"""Host event-loop profiler — the engine-side half of tpudes.obs.
+
+One ``HostProfiler`` is attached to a ``SimulatorImpl`` at construction
+when the ``TpudesObs`` GlobalValue is 1.  The engine then routes every
+executed event through an instrumented ``_invoke`` which feeds:
+
+- per-event-type counts and cumulative wall time (type = the callback's
+  ``__qualname__``),
+- a bounded span list for the Chrome-trace export,
+- the flight-recorder ring (dumped on exception / invariant trip),
+- queue-depth tracking via :class:`InstrumentedScheduler`,
+- window stats from ``JaxSimulatorImpl`` (events/window, batch-refresh
+  count) and the propagation-cache hit rate reported by batched
+  channels.
+
+When the knob is 0 the engine's hot loop runs the exact pre-obs byte
+code: no profiler is constructed, the scheduler stays un-wrapped, and
+the ``_invoke`` swap (an instance attribute) happens in
+``SimulatorImpl.__init__`` only when enabled — that structural
+zero-cost contract is pinned by tests/test_obs.py.  (The module itself
+may still be imported with the knob off — ``ShowProgress`` reuses
+:class:`RunStats` — which costs nothing per event.)
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpudes.core.global_value import GlobalValue
+from tpudes.obs.flight_recorder import FlightRecorder
+
+
+def enabled() -> bool:
+    """The one observability knob (bound via CommandLine / Bind /
+    NS_GLOBAL_VALUE like every engine knob)."""
+    return bool(GlobalValue.GetValueFailSafe("TpudesObs", 0))
+
+
+class RunStats:
+    """Events/s and simulated-vs-wall rate meter between samples.
+
+    Owns the bookkeeping ShowProgress used to carry privately; the
+    engine profiler holds one (``HostProfiler.run_stats``) so progress
+    reporting and the trace export read the same numbers.
+    """
+
+    def __init__(self):
+        self.wall_start = time.monotonic()
+        self._last = (self.wall_start, 0, 0.0)
+
+    def sample(self, events: int, sim_s: float) -> dict:
+        now = time.monotonic()
+        last_wall, last_events, last_sim = self._last
+        dt = max(now - last_wall, 1e-9)
+        snap = dict(
+            events=events,
+            sim_s=sim_s,
+            wall_s=now - self.wall_start,
+            dt_wall=dt,
+            ev_per_s=(events - last_events) / dt,
+            sim_per_wall=(sim_s - last_sim) / dt,
+        )
+        self._last = (now, events, sim_s)
+        return snap
+
+
+class InstrumentedScheduler:
+    """Transparent scheduler wrapper counting inserts/pops so the
+    profiler can track queue depth without an O(n) ``len`` scan per
+    event.
+
+    Deliberately does NOT forward ``run_native``: with obs enabled the
+    engine must take the Python dispatch loop so the instrumented
+    ``_invoke`` sees every event.  The insert/pop delta over-counts
+    cancelled events (the inner schedulers purge them internally,
+    invisibly to this wrapper), so the profiler is handed a live-depth
+    probe and periodically resynchronizes against it — see
+    ``HostProfiler.on_pop``.
+    """
+
+    __slots__ = ("_inner", "_obs")
+
+    def __init__(self, inner, obs: "HostProfiler"):
+        self._inner = inner
+        self._obs = obs
+        obs.depth_probe = inner.__len__  # exact live (non-cancelled) count
+
+    def Insert(self, ev) -> None:
+        self._obs.on_insert()
+        self._inner.Insert(ev)
+
+    def IsEmpty(self) -> bool:
+        return self._inner.IsEmpty()
+
+    def PeekNext(self):
+        return self._inner.PeekNext()
+
+    def RemoveNext(self):
+        ev = self._inner.RemoveNext()
+        self._obs.on_pop()
+        return ev
+
+    def Remove(self, ev) -> None:
+        self._inner.Remove(ev)
+
+    def __len__(self):
+        return len(self._inner)
+
+
+class HostProfiler:
+    """Per-run host-side metrics sink (see module docstring)."""
+
+    MAX_SPANS = 20_000
+
+    def __init__(self, ring_capacity: int | None = None):
+        if ring_capacity is None:
+            ring_capacity = int(GlobalValue.GetValueFailSafe("TpudesObsRing", 512))
+        self.run_stats = RunStats()
+        self.recorder = FlightRecorder(ring_capacity)
+        self.event_count = 0
+        self.counts: dict[str, int] = {}
+        self.wall: dict[str, float] = {}
+        # queue depth: insert/pop delta, resynced every RESYNC_EVERY
+        # pops against the exact live count (the delta over-counts
+        # events the inner scheduler lazily purged after a Cancel)
+        self.queue_depth = 0
+        self.queue_depth_max = 0
+        self.inserts = 0
+        self.depth_probe = None  # set by InstrumentedScheduler
+        self._pops_since_sync = 0
+        # bounded Chrome-trace spans: (label, t0_s, dur_s, sim_ts, context)
+        self.spans: list[tuple] = []
+        self.spans_dropped = 0
+        # windowed-engine stats (span list bounded, totals exact)
+        self.windows: list[tuple] = []  # (t0_s, dur_s, events, refreshes)
+        self.windows_total = 0
+        self.window_events = 0
+        self.window_refreshes = 0
+        # batched-channel propagation cache
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    #: pops between exact-depth resyncs: bounds cancel-drift at O(1)
+    #: amortized probe cost (the probe is an O(n) live-count scan)
+    RESYNC_EVERY = 4096
+
+    # --- scheduler hooks ---------------------------------------------------
+    def on_insert(self) -> None:
+        self.inserts += 1
+        self.queue_depth += 1
+        if self.queue_depth > self.queue_depth_max:
+            self.queue_depth_max = self.queue_depth
+
+    def on_pop(self) -> None:
+        self.queue_depth -= 1
+        self._pops_since_sync += 1
+        if self._pops_since_sync >= self.RESYNC_EVERY:
+            self.resync_depth()
+
+    def resync_depth(self) -> int:
+        """Snap ``queue_depth`` to the exact live count (drops the
+        phantom depth accumulated from cancelled-then-purged events)."""
+        self._pops_since_sync = 0
+        if self.depth_probe is not None:
+            self.queue_depth = self.depth_probe()
+        return self.queue_depth
+
+    # --- engine hooks ------------------------------------------------------
+    def record(self, label: str, t0: float, dur_s: float, ev) -> None:
+        """``t0`` is absolute ``time.monotonic()``; spans store seconds
+        since run start so the export timeline begins at ~0."""
+        self.counts[label] = self.counts.get(label, 0) + 1
+        self.wall[label] = self.wall.get(label, 0.0) + dur_s
+        if len(self.spans) < self.MAX_SPANS:
+            self.spans.append(
+                (label, t0 - self.run_stats.wall_start, dur_s, ev.ts, ev.context)
+            )
+        else:
+            self.spans_dropped += 1
+
+    def on_window(self, t0: float, dur_s: float, events: int, refreshes: int) -> None:
+        self.windows_total += 1
+        self.window_events += events
+        self.window_refreshes += refreshes
+        if len(self.windows) < self.MAX_SPANS:
+            self.windows.append(
+                (t0 - self.run_stats.wall_start, dur_s, events, refreshes)
+            )
+
+    def prop_cache(self, hit: bool) -> None:
+        if hit:
+            self.cache_hits += 1
+        else:
+            self.cache_misses += 1
+
+    # --- failure paths -----------------------------------------------------
+    def trip(self, message: str) -> None:
+        """Engine invariant violated: dump the tail, then fail loudly."""
+        self.recorder.dump(reason=f"invariant trip: {message}")
+        raise RuntimeError(f"tpudes.obs invariant trip: {message}")
+
+    def dump_crash(self, exc: BaseException) -> None:
+        self.recorder.dump(reason=f"{type(exc).__name__}: {exc}")
+
+    # --- summary -----------------------------------------------------------
+    def cache_hit_rate(self) -> float | None:
+        total = self.cache_hits + self.cache_misses
+        return self.cache_hits / total if total else None
+
+    def summary(self) -> dict:
+        """Everything the exporter / bench integration reads, as one
+        plain dict."""
+        n_windows = self.windows_total
+        return {
+            "events": self.event_count,
+            "event_types": {
+                label: {
+                    "count": self.counts[label],
+                    "wall_s": self.wall.get(label, 0.0),
+                }
+                for label in sorted(self.counts)
+            },
+            "queue": {
+                "inserts": self.inserts,
+                "depth": self.resync_depth(),
+                "depth_max": self.queue_depth_max,
+            },
+            "windows": {
+                "count": n_windows,
+                "events": self.window_events,
+                "events_per_window": (
+                    self.window_events / n_windows if n_windows else 0.0
+                ),
+                "batch_refreshes": self.window_refreshes,
+            },
+            "prop_cache": {
+                "hits": self.cache_hits,
+                "misses": self.cache_misses,
+                "hit_rate": self.cache_hit_rate(),
+            },
+            "spans_dropped": self.spans_dropped,
+            "wall_s": time.monotonic() - self.run_stats.wall_start,
+        }

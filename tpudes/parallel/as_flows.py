@@ -257,6 +257,7 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
         replicas, mesh,
     )
     run = _RUNNER_CACHE.get(ck)
+    compiling = run is None
     if run is None:
         E = prog.edges.shape[0]
         E2 = 2 * E
@@ -353,6 +354,9 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         z = jax.device_put(z, NamedSharding(mesh, P("replica", None)))
-    out = run(z)
-    out["goodput_bps"].block_until_ready()
+    from tpudes.obs.device import CompileTelemetry
+
+    with CompileTelemetry.timed("as_flows", compiling):
+        out = run(z)
+        out["goodput_bps"].block_until_ready()
     return out

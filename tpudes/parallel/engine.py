@@ -17,6 +17,8 @@ its event traces exactly (the step-3 oracle contract).
 
 from __future__ import annotations
 
+from time import monotonic
+
 from tpudes.core.global_value import GlobalValue
 from tpudes.core.simulator import DefaultSimulatorImpl, register_simulator_impl
 
@@ -130,20 +132,30 @@ class JaxSimulatorImpl(DefaultSimulatorImpl):
             return
         self._stop = False
         events = self._events
+        obs = self._obs
         while not self._stop:
             self._process_events_with_context()
             if events.IsEmpty():
                 break
             # conservative window: [next event, next event + W)
             window_end = events.PeekNext().ts + self.window_ticks
-            for member in BatchableRegistry.members():
+            members = BatchableRegistry.members()
+            for member in members:
                 member.refresh_window_cache()
             self.windows_run += 1
+            if obs is not None:
+                # host window loop, never traced
+                w0, e0 = monotonic(), self._event_count  # tpudes: ignore[JP001]
             while not self._stop:
                 self._process_events_with_context()
                 if events.IsEmpty() or events.PeekNext().ts > window_end:
                     break
                 self._invoke(events.RemoveNext())
+            if obs is not None:
+                obs.on_window(
+                    w0, monotonic() - w0,  # tpudes: ignore[JP001]
+                    self._event_count - e0, len(members),
+                )
 
 
 register_simulator_impl("tpudes::JaxSimulatorImpl", JaxSimulatorImpl)

@@ -338,10 +338,14 @@ def _estimate_max_steps(prog: BssProgram) -> int:
     return int(total_arrivals * (3 + RETRY_LIMIT) * 1.5) + 64
 
 
-def build_bss_step(prog: BssProgram, replicas: int):
+def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
     """Return ``(init_state, cond_fn, step_fn, finalize)`` for the
     vectorized event loop — exposed separately so the driver dryrun and
-    benchmarks can jit/shard the pieces themselves."""
+    benchmarks can jit/shard the pieces themselves.
+
+    ``obs=True`` (the ``TpudesObs`` knob) adds a cumulative per-replica
+    retransmission counter to the carry; a disabled run compiles the
+    exact pre-obs program."""
     n = prog.n
     R = replicas
     from tpudes.ops.wifi_error import ALL_MODES
@@ -383,7 +387,9 @@ def build_bss_step(prog: BssProgram, replicas: int):
     is_ap = jnp.arange(n) == 0
 
     def init_state():
+        extra = {"retx": jnp.zeros((R,), jnp.int32)} if obs else {}
         return dict(
+            **extra,
             t=jnp.zeros((R,), jnp.int32),
             next_arr=jnp.broadcast_to(start0, (R, n)).astype(jnp.int32),
             queue=jnp.zeros((R, n), jnp.int32),      # STA→AP requests waiting
@@ -620,7 +626,13 @@ def build_bss_step(prog: BssProgram, replicas: int):
             jnp.where(winners, next_t[:, None] + occ, s["hold"]),
         )
 
+        extra = (
+            {"retx": s["retx"] + jnp.sum(fail, axis=1).astype(jnp.int32)}
+            if obs
+            else {}
+        )
         return dict(
+            **extra,
             t=jnp.maximum(next_t, s["t"]),
             next_arr=new_next_arr,
             queue=jnp.maximum(new_queue, 0),
@@ -658,20 +670,25 @@ def _prog_cache_key(prog: BssProgram) -> tuple:
 _RUNNER_CACHE: dict = {}
 
 
-def _compiled_bss_runner(prog_key, prog, replicas, max_steps, mesh):
+def _compiled_bss_runner(prog_key, prog, replicas, max_steps, mesh, obs=False):
     """Jitted runner cache keyed on (program, replicas, max_steps) so a
     warm-up call actually warms subsequent timed calls (ADVICE r2 medium:
     a fresh jax.jit wrapper per call re-traces every time).  The runner
     itself is mesh-independent — sharding flows from the input arrays and
     jax.jit specializes per input sharding internally — so mesh is not
-    part of the key."""
+    part of the key.
+
+    Returns ``(init_state, pending, run, compiled_new)`` —
+    ``compiled_new`` tells the caller this call populated the cache (the
+    compile-telemetry trigger), so the cache key is derived in exactly
+    one place."""
     del mesh
-    key = (prog_key, replicas, max_steps)
+    key = (prog_key, replicas, max_steps, obs)
     hit = _RUNNER_CACHE.get(key)
     if hit is not None:
-        return hit
+        return (*hit, False)
 
-    init_state, pending, step_fn = build_bss_step(prog, replicas)
+    init_state, pending, step_fn = build_bss_step(prog, replicas, obs=obs)
 
     @jax.jit
     def run(s, k):
@@ -687,7 +704,7 @@ def _compiled_bss_runner(prog_key, prog, replicas, max_steps, mesh):
     _RUNNER_CACHE[key] = (init_state, pending, run)
     if len(_RUNNER_CACHE) > 32:  # bound compile-cache growth in sweeps
         _RUNNER_CACHE.pop(next(iter(_RUNNER_CACHE)))
-    return _RUNNER_CACHE[key]
+    return (*_RUNNER_CACHE[key], True)
 
 
 def run_replicated_bss(
@@ -712,10 +729,13 @@ def run_replicated_bss(
     the only cross-device traffic is the loop's any-replica-pending
     reduction (the LBTS-grant analog) and the final stats gather.
     """
+    from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+
     if max_steps is None:
         max_steps = _estimate_max_steps(prog)
-    init_state, pending, run = _compiled_bss_runner(
-        _prog_cache_key(prog), prog, replicas, max_steps, mesh
+    obs = device_metrics_enabled()
+    init_state, pending, run, compiling = _compiled_bss_runner(
+        _prog_cache_key(prog), prog, replicas, max_steps, mesh, obs=obs
     )
 
     s0 = init_state()
@@ -730,11 +750,11 @@ def run_replicated_bss(
 
         s0 = {k: shard(v) for k, v in s0.items()}
 
-    out, still_pending = run(s0, key)
-    # one batched device→host transfer for every result (steps/all_done
-    # ride along instead of costing their own round trips)
-    host = jax.device_get(
-        dict(
+    with CompileTelemetry.timed("bss", compiling):
+        out, still_pending = run(s0, key)
+        # one batched device→host transfer for every result (steps/
+        # all_done ride along instead of costing their own round trips)
+        fetch = dict(
             srv_rx=out["srv_rx"],
             cli_rx=out["cli_rx"],
             tx_data=out["tx_data"],
@@ -742,8 +762,10 @@ def run_replicated_bss(
             step=out["step"],
             pending=still_pending,
         )
-    )
-    return dict(
+        if obs:
+            fetch["retx"] = out["retx"]
+        host = jax.device_get(fetch)
+    result = dict(
         srv_rx=host["srv_rx"],
         cli_rx=host["cli_rx"],
         tx_data=host["tx_data"],
@@ -751,3 +773,6 @@ def run_replicated_bss(
         steps=int(host["step"]),
         all_done=not bool(host["pending"]),
     )
+    if obs:
+        result["retx"] = host["retx"]
+    return result

@@ -677,8 +677,19 @@ def _loss_response(var, cwnd, st, t_s):
     return ssthresh, st
 
 
-def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
-    """Return (init_state, step_fn) for the slot-stepped scan."""
+#: queue-occupancy histogram bins for the on-device obs accumulators
+OBS_QHIST_BINS = 16
+
+
+def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False):
+    """Return (init_state, step_fn) for the slot-stepped scan.
+
+    ``obs=True`` (the ``TpudesObs`` knob at run time) threads three
+    extra accumulators through the carry — per-lane cwnd-cut events,
+    retransmissions (losses consumed by the dupack-timed detector), and
+    a bottleneck-occupancy histogram — fetched once at run end.  A
+    disabled run compiles the exact pre-obs program.
+    """
     R, F, L = replicas, prog.n_flows, prog.buf_len
     var = jnp.asarray(prog.variant_idx)
     start = jnp.asarray(prog.start_slot)
@@ -698,7 +709,17 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
 
     def init_state():
         z = lambda *sh, dt=jnp.float32: jnp.zeros(sh, dt)  # noqa: E731
+        extra = (
+            dict(
+                cwnd_cuts=z(R, F, dt=jnp.int32),
+                retx_cnt=z(R, F, dt=jnp.int32),
+                q_hist=z(R, OBS_QHIST_BINS, dt=jnp.int32),
+            )
+            if obs
+            else {}
+        )
         return dict(
+            **extra,
             cwnd=jnp.full((R, F), INIT_CWND, jnp.float32),
             ssthresh=jnp.full((R, F), SSTHRESH0, jnp.float32),
             inflight=z(R, F, dt=jnp.int32),
@@ -910,7 +931,21 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int):
         lidx = (t + prog.ack_lag) % L  # dupack-timed detection
         loss_buf = loss_buf.at[:, lidx, :].add(rej + red_drops)
 
+        extra = {}
+        if obs:
+            # per-lane metric accumulators (no host sync: they ride the
+            # carry and are fetched with the outcome arrays at run end)
+            bucket = jnp.clip(
+                qtot * OBS_QHIST_BINS // max(Q + 1, 1), 0, OBS_QHIST_BINS - 1
+            )
+            extra = dict(
+                cwnd_cuts=s["cwnd_cuts"] + reduce.astype(jnp.int32),
+                retx_cnt=s["retx_cnt"] + losses,
+                q_hist=s["q_hist"]
+                + jax.nn.one_hot(bucket, OBS_QHIST_BINS, dtype=jnp.int32),
+            )
         return dict(
+            **extra,
             cwnd=cwnd, ssthresh=ssthresh, inflight=inflight, q=q,
             q_marked=q_marked,
             delivered=delivered, drops=drops, recover_until=recover_until,
@@ -931,14 +966,20 @@ _RUNNER_CACHE: dict = {}
 def run_tcp_dumbbell(prog: DumbbellProgram, key, replicas: int, mesh=None):
     """Execute R replicas of the dumbbell program; returns per-replica
     outcome arrays: goodput_mbps (R,F), delivered (R,F), drops (R,F),
-    mean_queue (R,), cwnd_final (R,F)."""
+    mean_queue (R,), cwnd_final (R,F) — plus, under ``TpudesObs=1``,
+    the on-device metric accumulators ``cwnd_cuts`` (R,F), ``retx``
+    (R,F) and ``queue_hist`` (R, OBS_QHIST_BINS)."""
+    from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+
+    obs = device_metrics_enabled()
     ck = tuple(
         v.tobytes() if isinstance(v, np.ndarray) else v
         for v in prog.__dict__.values()
-    ) + (replicas,)
+    ) + (replicas, obs)
     hit = _RUNNER_CACHE.get(ck)
+    compiling = hit is None
     if hit is None:
-        init_state, step_fn = build_dumbbell_step(prog, replicas)
+        init_state, step_fn = build_dumbbell_step(prog, replicas, obs=obs)
 
         @jax.jit
         def run(s0, key):
@@ -964,16 +1005,26 @@ def run_tcp_dumbbell(prog: DumbbellProgram, key, replicas: int, mesh=None):
             return v
 
         s0 = jax.tree_util.tree_map(shard, s0)
-    out = run(s0, key)
+    with CompileTelemetry.timed("dumbbell", compiling):
+        out = run(s0, key)
+        if compiling:
+            jax.block_until_ready(out)
     sim_s = prog.n_slots * prog.slot_s
     goodput = (
         out["delivered"].astype(jnp.float32) * prog.seg_bytes * 8.0
         / sim_s / 1e6
     )
-    return dict(
+    result = dict(
         goodput_mbps=goodput,
         delivered=out["delivered"],
         drops=out["drops"],
         mean_queue=out["qsum"] / prog.n_slots,
         cwnd_final=out["cwnd"],
     )
+    if obs:
+        result.update(
+            cwnd_cuts=out["cwnd_cuts"],
+            retx=out["retx_cnt"],
+            queue_hist=out["q_hist"],
+        )
+    return result

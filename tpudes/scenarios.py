@@ -212,9 +212,8 @@ def build_as_network(
     Flow endpoints are drawn from ``seed`` (the RngRun axis); returns
     ``(helper, servers)`` where servers[i] counts flow i's deliveries.
     """
-    import random as _random
-
     from tpudes.core import Seconds
+    from tpudes.core.rng import RngStream
     from tpudes.helper.applications import UdpClientHelper, UdpServerHelper
     from tpudes.helper.internet import InternetStackHelper
     from tpudes.helper.topology import BriteTopologyHelper
@@ -227,14 +226,17 @@ def build_as_network(
     nodes = topo.BuildTopology(stack)
     Ipv4GlobalRoutingHelper.PopulateRoutingTables()
 
-    rng = _random.Random(seed)
+    # endpoint draws on the seeded stream API (MRG32k3a), not stdlib
+    # random: `seed` keys the stream so the flow set stays a pure
+    # function of the builder arguments
+    rng = RngStream(seed, 0, 0)
     interval_s = pkt_bytes * 8.0 / (flow_kbps * 1e3)
     servers = []
     for f in range(n_flows):
-        src = rng.randrange(n_nodes)
-        dst = rng.randrange(n_nodes)
+        src = rng.RandInt(0, n_nodes - 1)
+        dst = rng.RandInt(0, n_nodes - 1)
         while dst == src:
-            dst = rng.randrange(n_nodes)
+            dst = rng.RandInt(0, n_nodes - 1)
         dst_addr = (
             nodes.Get(dst)
             .GetObject(Ipv4L3Protocol)
@@ -272,8 +274,7 @@ def build_lena(
 
     Returns ``(lte_helper, ue_devices)``.
     """
-    import random
-
+    from tpudes.core.rng import RngStream
     from tpudes.helper.containers import NodeContainer
     from tpudes.models.lte import LteHelper
     from tpudes.models.mobility import (
@@ -303,13 +304,15 @@ def build_lena(
     me.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
     me.Install(enb_nodes)
 
-    rng = random.Random(drop_seed)
+    # UE drop on the seeded stream API (MRG32k3a keyed by drop_seed),
+    # not stdlib random
+    rng = RngStream(drop_seed, 0, 0)
     ua = ListPositionAllocator()
     for c in range(n_enbs):
         cx, cy = sites[c]
         for _ in range(ues_per_cell):
-            r = inter_site * drop_radius_factor * math.sqrt(rng.random())
-            a = 2 * math.pi * rng.random()
+            r = inter_site * drop_radius_factor * math.sqrt(rng.RandU01())
+            a = 2 * math.pi * rng.RandU01()
             ua.Add(Vector(cx + r * math.cos(a), cy + r * math.sin(a), 1.5))
     mu = MobilityHelper()
     mu.SetPositionAllocator(ua)
