@@ -41,6 +41,13 @@ spread now makes the noise visible).  The host side runs once (its
 wall time is deterministic within a few percent) after a warm-up
 segment so JIT compilation of the TTI kernel is excluded on both sides.
 
+Strong-scaling rows (PR 4): ``bench_mesh()`` runs each engine's SAME
+program on a 1-device mesh vs the full mesh and reports rate, wall
+medians, speedup and per-configuration compile counts.  The section
+rides the default output whenever more than one device is visible, and
+``--mesh [--smoke]`` emits it standalone (the CI virtual-device job and
+the MULTICHIP harness both use that path).
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
@@ -207,8 +214,8 @@ def bench_lte_sched_sweep():
     import jax
 
     from tpudes.core.world import reset_world
-    from tpudes.parallel import lte_sm
     from tpudes.parallel.lte_sm import SM_SCHED_IDS, lower_lte_sm, run_lte_sm
+    from tpudes.parallel.runtime import RUNTIME
     from tpudes.scenarios import build_lena
 
     reset_world()
@@ -218,7 +225,7 @@ def bench_lte_sched_sweep():
 
     from tpudes.obs.device import CompileTelemetry
 
-    lte_sm._SM_CACHE.clear()
+    RUNTIME.clear("lte_sm")
     compiles_before = CompileTelemetry.compiles("lte_sm")
     run_lte_sm(prog, jax.random.PRNGKey(0), replicas=LTE_REPLICAS)  # compile
     t0 = time.monotonic()
@@ -232,7 +239,7 @@ def bench_lte_sched_sweep():
             float(out["rx_bits"].sum() / LTE_REPLICAS / LTE_SIM_S / 1e6), 3
         )
     wall = time.monotonic() - t0
-    n_compiled = len(lte_sm._SM_CACHE)
+    n_compiled = RUNTIME.size("lte_sm")
     rate = len(SM_SCHED_IDS) * LTE_REPLICAS * LTE_SIM_S / wall
     return dict(
         sim_s_per_wall_s=rate,
@@ -393,6 +400,115 @@ def bench_as():
     )
 
 
+# --- per-engine mesh strong scaling (the MULTICHIP rows) ----------------
+
+MESH_TIMED = 3
+
+
+def _mesh_programs(smoke: bool):
+    """Per-engine device programs for the strong-scaling rows — the
+    shared synthetic builders (tpudes/parallel/programs.py, also the
+    test_runtime fixtures), no host object graph, so the multichip
+    driver can emit the rows cheaply on any backend.  ``smoke`` shrinks
+    every shape for the CI virtual-device job."""
+    from tpudes.parallel.programs import (
+        toy_as_program,
+        toy_bss_program,
+        toy_dumbbell_program,
+        toy_lte_program,
+    )
+
+    bss = toy_bss_program(
+        n_sta=8 if smoke else 32,
+        sim_end_us=100_000 if smoke else 1_000_000,
+    )
+    lte = toy_lte_program(
+        *((2, 8) if smoke else (7, 70)),
+        n_ttis=200 if smoke else 2000,
+    )
+    tcp = toy_dumbbell_program(
+        n_flows=4 if smoke else 8, n_slots=400 if smoke else 10_000
+    )
+    asp = toy_as_program(
+        n_nodes=128 if smoke else 2000,
+        n_flows=8 if smoke else 64,
+        spf_rounds=16 if smoke else 32,
+    )
+    return bss, lte, tcp, asp
+
+
+def bench_mesh(smoke: bool = False, n_devices: int | None = None):
+    """Per-engine strong scaling: the SAME device program at the same
+    replica count on a 1-device mesh vs the full mesh.  Emits, per
+    engine, sim-s/wall-s (studies/s for the AS flow engine) on both
+    configurations, the speedup, and the XLA compile count each
+    configuration paid (CompileTelemetry delta) — the rows the
+    MULTICHIP harness records."""
+    import jax
+
+    from tpudes.obs.device import CompileTelemetry
+    from tpudes.parallel.as_flows import run_as_flows
+    from tpudes.parallel.lte_sm import run_lte_sm
+    from tpudes.parallel.mesh import replica_mesh
+    from tpudes.parallel.replicated import run_replicated_bss
+    from tpudes.parallel.runtime import RUNTIME
+    from tpudes.parallel.tcp_dumbbell import run_tcp_dumbbell
+
+    n_dev = len(jax.devices()) if n_devices is None else n_devices
+    bss, lte, tcp, asp = _mesh_programs(smoke)
+    r_scale = 2 * n_dev if smoke else None  # full: the BENCH replica counts
+
+    engines = [
+        (
+            "bss",
+            lambda key, mesh, r: run_replicated_bss(bss, r, key, mesh=mesh),
+            r_scale or WIFI_REPLICAS,
+            (bss.sim_end_us / 1e6, "sim-s/wall-s"),
+        ),
+        (
+            "lte_sm",
+            lambda key, mesh, r: run_lte_sm(lte, key, replicas=r, mesh=mesh),
+            r_scale or LTE_REPLICAS,
+            (lte.n_ttis / 1000.0, "sim-s/wall-s"),
+        ),
+        (
+            "dumbbell",
+            lambda key, mesh, r: run_tcp_dumbbell(tcp, key, replicas=r, mesh=mesh),
+            r_scale or TCP_REPLICAS,
+            (tcp.n_slots * tcp.slot_s, "sim-s/wall-s"),
+        ),
+        (
+            "as_flows",
+            lambda key, mesh, r: run_as_flows(asp, key, replicas=r, mesh=mesh),
+            r_scale or AS_REPLICAS,
+            (1.0, "studies/s"),  # one study = one replica outcome
+        ),
+    ]
+
+    rows = {}
+    for name, runner, replicas, (per_replica, unit) in engines:
+        row = {"replicas": replicas, "unit": unit}
+        for label, mesh in (("1dev", replica_mesh(1)), ("ndev", replica_mesh(n_dev))):
+            # each mesh configuration pays (and records) its own
+            # compiles: jit re-specializes per input sharding even on a
+            # runner-cache hit, so the honest count needs a cold cache
+            RUNTIME.clear(name)
+            c0 = CompileTelemetry.compiles(name)
+            runner(jax.random.PRNGKey(0), mesh, replicas)  # compile + warm
+            walls = []
+            for i in range(MESH_TIMED):
+                t0 = time.monotonic()
+                runner(jax.random.PRNGKey(1 + i), mesh, replicas)
+                walls.append(time.monotonic() - t0)
+            med = statistics.median(walls)
+            row[f"wall_median_s_{label}"] = round(med, 4)
+            row[f"rate_{label}"] = round(replicas * per_replica / med, 3)
+            row[f"compiles_{label}"] = CompileTelemetry.compiles(name) - c0
+        row["speedup"] = round(row["rate_ndev"] / row["rate_1dev"], 3)
+        rows[name] = row
+    return {"n_devices": n_dev, "smoke": smoke, "rows": rows}
+
+
 def main():
     import jax
 
@@ -439,8 +555,30 @@ def main():
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
     }
+    # strong-scaling rows whenever more than one device is visible (the
+    # single-device rows above are measured first, so this section
+    # cannot perturb them)
+    if len(jax.devices()) > 1:
+        out["mesh_scaling"] = bench_mesh()
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--mesh",
+        action="store_true",
+        help="emit ONLY the per-engine 1-vs-N-device strong-scaling rows",
+    )
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="tiny shapes for the CI virtual-device job (with --mesh)",
+    )
+    args = ap.parse_args()
+    if args.mesh:
+        print(json.dumps({"mesh_scaling": bench_mesh(smoke=args.smoke)}))
+    else:
+        main()

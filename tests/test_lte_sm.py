@@ -289,14 +289,15 @@ class TestSchedulerFamily:
         import jax
 
         from tpudes.parallel import lte_sm as mod
+        from tpudes.parallel.runtime import RUNTIME
 
-        mod._SM_CACHE.clear()
+        RUNTIME.clear("lte_sm")
         base = _toy_prog(n_ttis=120)
         outs = {}
         for sched in mod.SM_SCHED_IDS:
             prog = dataclasses.replace(base, scheduler=sched)
             outs[sched] = run_lte_sm(prog, jax.random.PRNGKey(2))
-        assert len(mod._SM_CACHE) == 1
+        assert RUNTIME.size("lte_sm") == 1
         # and the dispatch actually differentiates the families
         assert (
             outs["tdmt"]["new_tbs"] != outs["pf"]["new_tbs"]

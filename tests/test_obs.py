@@ -335,14 +335,15 @@ def test_lte_sweep_compile_telemetry_pins_single_executable():
     scheduler sweep over the same lowered program records ONE compile."""
     import dataclasses
 
-    from tpudes.parallel import lte_sm as lte_sm_mod
     from tpudes.parallel.lte_sm import run_lte_sm
 
     sys.path.insert(0, str(REPO / "tests"))
     from test_lte_sm import _toy_prog
 
     prog = _toy_prog(n_enb=2, n_ue=4, n_ttis=40)
-    lte_sm_mod._SM_CACHE.clear()
+    from tpudes.parallel.runtime import RUNTIME
+
+    RUNTIME.clear("lte_sm")
     CompileTelemetry.reset()
     for sched in ("pf", "rr", "fdmt"):
         run_lte_sm(

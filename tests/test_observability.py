@@ -172,6 +172,54 @@ def test_in_flight_packets_are_not_losses():
     assert sum(s.tx_packets for s in stats.values()) == 1
 
 
+def test_stranded_entries_expire_without_explicit_check():
+    """A packet dropped in transit without firing the monitored Drop
+    trace used to strand its tracked entry forever (the baselined
+    EVT003 finding): only an explicit CheckForLostPackets call ever
+    reclaimed it.  The periodic expiry sweep (upstream's
+    PeriodicCheckForLostPackets) must now fold it into loss on its
+    own and leave the tracking buffer empty."""
+    from tpudes.network.error_model import ReceiveListErrorModel
+
+    nodes, devices, p2p = _echo_pair(packets=3)
+    em = ReceiveListErrorModel()
+    em.SetList([0])  # the first request vanishes mid-hop
+    devices.Get(1).SetReceiveErrorModel(em)
+    fmh = FlowMonitorHelper()
+    monitor = fmh.InstallAll()
+    # run past MaxPerHopDelay (10 s) plus one sweep period; note there
+    # is deliberately NO CheckForLostPackets call here
+    Simulator.Stop(Seconds(12.0))
+    Simulator.Run()
+    stats = monitor.GetFlowStats()
+    assert sum(s.lost_packets for s in stats.values()) == 1
+    assert monitor._tracked == {}
+    # idle monitor: the sweep stopped re-arming once nothing was flying
+    assert monitor._check_event is None
+
+
+def test_stop_sticks_while_traffic_continues():
+    """Stop() freezes loss accounting for good: later sends must not
+    quietly re-arm the expiry sweep the user just cancelled."""
+    from tpudes.network.error_model import ReceiveListErrorModel
+
+    nodes, devices, p2p = _echo_pair(packets=3)
+    em = ReceiveListErrorModel()
+    em.SetList([0])  # the first request vanishes mid-hop
+    devices.Get(1).SetReceiveErrorModel(em)
+    fmh = FlowMonitorHelper()
+    monitor = fmh.InstallAll()
+    # stop mid-traffic (sends at 0.1/0.2/0.3 s continue afterwards)
+    Simulator.Schedule(Seconds(0.15), monitor.Stop)
+    Simulator.Stop(Seconds(12.0))
+    Simulator.Run()
+    assert monitor._check_event is None
+    # no sweep ran: the stranded entry froze in place, nothing was
+    # folded into loss after monitoring stopped
+    assert sum(s.lost_packets for s in monitor.GetFlowStats().values()) == 0
+    assert len(monitor._tracked) == 1
+
+
 def test_flow_monitor_xml_round_trip(tmp_path):
     import xml.etree.ElementTree as ET
 

@@ -103,15 +103,52 @@ class Ipv4FlowClassifier:
 class FlowMonitor:
     """The collector; one per FlowMonitorHelper."""
 
+    #: flow-monitor.cc PERIODIC_CHECK_INTERVAL: cadence of the lost-
+    #: packet expiry sweep while packets are in flight
+    PERIODIC_CHECK_INTERVAL_S = 1.0
+    #: flow-monitor.h MaxPerHopDelay default: in-flight longer than
+    #: this counts as lost (and the tracked entry is reclaimed)
+    MAX_PER_HOP_DELAY_S = 10.0
+
     def __init__(self):
         self.classifier = Ipv4FlowClassifier()
         self.stats: dict[int, FlowStats] = {}
         #: packet uid -> (flow id, tx sim seconds) for in-flight packets
         self._tracked: dict[int, tuple[int, float]] = {}
+        #: held so Stop can Cancel it; re-armed only while entries are
+        #: in flight (the expiry that keeps a lost packet from leaking
+        #: its tracked entry forever — upstream's periodic check)
+        self._check_event = None
+        self._stopped = False
 
     # --- probe callbacks --------------------------------------------------
     def _now_s(self) -> float:
         return Time(Simulator.NowTicks()).GetSeconds()
+
+    def _arm_periodic_check(self) -> None:
+        from tpudes.core.nstime import Seconds
+
+        self._check_event = Simulator.Schedule(
+            Seconds(self.PERIODIC_CHECK_INTERVAL_S), self._periodic_check
+        )
+
+    def _periodic_check(self) -> None:
+        """flow-monitor.cc PeriodicCheckForLostPackets: expire overdue
+        entries into loss, then re-arm while anything is still flying."""
+        self.CheckForLostPackets(self.MAX_PER_HOP_DELAY_S)
+        if self._tracked:
+            self._arm_periodic_check()
+        else:
+            self._check_event = None
+
+    def Stop(self) -> None:
+        """Cancel the pending expiry sweep and keep it cancelled even
+        if traffic continues (flow-monitor.cc StopRightNow analog) —
+        reporting APIs keep working."""
+        self._stopped = True
+        if self._check_event is not None:
+            self._check_event.Cancel()
+            self._check_event = None
 
     def _on_send(self, header, packet, if_index) -> None:
         fid, _ = self.classifier.Classify(header, packet)
@@ -122,6 +159,10 @@ class FlowMonitor:
         if st.time_first_tx_s is None:
             st.time_first_tx_s = now
         self._tracked[packet.GetUid()] = (fid, now)
+        if not self._stopped and (
+            self._check_event is None or self._check_event.IsExpired()
+        ):
+            self._arm_periodic_check()
 
     def _on_deliver(self, header, packet, if_index) -> None:
         hit = self._tracked.pop(packet.GetUid(), None)

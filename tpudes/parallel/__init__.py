@@ -1,6 +1,8 @@
 """Parallel/TPU execution layer: the windowed engine, fused window
-kernels, replica axis, device-mesh collectives, and the host-side
-distributed (MPI-analog) engine.
+kernels, replica axis, device-mesh collectives, the shared engine
+runtime (runner cache / shape bucketing / donation —
+tpudes.parallel.runtime), and the host-side distributed (MPI-analog)
+engine.
 
 SURVEY.md §2.3, §5.8, §7 steps 4/7 — the reference's MPI machinery maps
 here to XLA collectives over the device mesh; the Monte-Carlo RngRun
@@ -28,6 +30,8 @@ _LAZY = {
     # submodule (first import wins, making resolution order-dependent);
     # import it from tpudes.parallel.kernels directly
     "wifi_phy_window": ("tpudes.parallel.kernels", "wifi_phy_window"),
+    "RUNTIME": ("tpudes.parallel.runtime", "RUNTIME"),
+    "EngineRuntime": ("tpudes.parallel.runtime", "EngineRuntime"),
     "lbts_grant": ("tpudes.parallel.mesh", "lbts_grant"),
     "make_replica_batch": ("tpudes.parallel.mesh", "make_replica_batch"),
     "replica_mesh": ("tpudes.parallel.mesh", "replica_mesh"),

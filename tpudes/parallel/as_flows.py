@@ -241,24 +241,33 @@ def _walk_paths(prog: AsFlowsProgram, ddst, nh_edge, nh_node):
     return path, hops, arrived
 
 
-_RUNNER_CACHE: dict = {}
-
-
 def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
     """Execute R replicas; returns per-replica outcome arrays:
     ``goodput_bps`` (R,F), ``delay_s`` (R,F) fluid end-to-end delay,
     ``delivered_frac`` (R,F), ``max_util`` (R,), ``hops`` (F,),
-    ``unreachable`` (F,) bool."""
+    ``unreachable`` (F,) bool.  The replica axis is runtime-bucketed
+    (padded to a power of two, results sliced back)."""
+    import functools
+
+    from tpudes.parallel.runtime import (
+        RUNTIME,
+        bucket_replicas,
+        donate_argnums,
+        replica_keys,
+    )
+
+    r_pad = bucket_replicas(replicas, mesh)
+    # prog.sim_s is deliberately ABSENT: the fluid fixed point has no
+    # time horizon (its cost does not scale with simulated seconds)
     ck = (
         prog.edges.tobytes(), prog.delay_s.tobytes(),
         prog.rate_bps.tobytes(), prog.src.tobytes(), prog.dst.tobytes(),
-        prog.flow_bps.tobytes(), prog.pkt_bytes, prog.sim_s,
+        prog.flow_bps.tobytes(), prog.pkt_bytes,
         prog.max_hops, prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
-        replicas, mesh,
+        r_pad, mesh,
     )
-    run = _RUNNER_CACHE.get(ck)
-    compiling = run is None
-    if run is None:
+
+    def build():
         E = prog.edges.shape[0]
         E2 = 2 * E
         cap = jnp.concatenate(
@@ -268,9 +277,9 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
             [jnp.asarray(prog.delay_s), jnp.asarray(prog.delay_s)]
         ).astype(jnp.float32)
         fbps = jnp.asarray(prog.flow_bps, jnp.float32)
-        R, F, H = replicas, len(prog.src), prog.max_hops
+        R, F, H = r_pad, len(prog.src), prog.max_hops
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=donate_argnums(0))
         def _run(z):
             ddst, dist, nh_edge, nh_node = device_spf(prog, mesh)
             path, hops, arrived = _walk_paths(prog, ddst, nh_edge, nh_node)
@@ -344,12 +353,15 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
                 unreachable=~reached,
             )
 
-        _RUNNER_CACHE[ck] = _run
-        if len(_RUNNER_CACHE) > 16:
-            _RUNNER_CACHE.pop(next(iter(_RUNNER_CACHE)))
-        run = _run
+        return _run
 
-    z = jax.random.normal(key, (replicas, len(prog.src)))
+    run, compiling = RUNTIME.runner("as_flows", ck, build)
+
+    # per-replica jitter draws keyed by fold_in(key, r): replica r's
+    # z-row is independent of the padded axis size, so bucketing is exact
+    z = jax.vmap(
+        lambda kk: jax.random.normal(kk, (len(prog.src),))
+    )(replica_keys(key, r_pad))
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -359,4 +371,9 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
     with CompileTelemetry.timed("as_flows", compiling):
         out = run(z)
         out["goodput_bps"].block_until_ready()
+    if r_pad != replicas:
+        r_lead = ("goodput_bps", "delay_s", "delivered_frac", "max_util")
+        out = {
+            k: (v[:replicas] if k in r_lead else v) for k, v in out.items()
+        }
     return out

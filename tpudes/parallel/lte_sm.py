@@ -338,17 +338,15 @@ def build_sm_step(prog: LteSmProgram):
     return consts, init_state, step_fn
 
 
-_SM_CACHE: dict = {}
-
-
 def _sm_cache_key(prog: LteSmProgram, replicas) -> tuple:
-    # prog.scheduler is deliberately ABSENT: the scheduler id is a
-    # traced operand, so one compiled program serves all nine — a
-    # scheduler sweep pays one compile, not nine
+    # prog.scheduler AND prog.n_ttis are deliberately ABSENT: the
+    # scheduler id and the TTI horizon are both traced operands, so one
+    # compiled program serves all nine schedulers at every horizon — a
+    # scheduler×horizon sweep pays one compile, not one per point
     return (
         prog.gain.tobytes(), prog.serving.tobytes(),
         prog.tx_power_dbm.tobytes(), prog.noise_psd, prog.n_rb,
-        prog.n_ttis, prog.pf_alpha, replicas,
+        prog.pf_alpha, replicas,
     )
 
 
@@ -357,52 +355,67 @@ def run_lte_sm(prog: LteSmProgram, key, replicas: int | None = None, mesh=None):
 
     Without ``replicas``: one run, returns per-UE arrays
     ``{rx_bits, new_tbs, retx, drops, ok, cqi, mcs, sinr}``.
-    With ``replicas=R``: vmaps R Monte-Carlo replicas over split keys,
-    leading axis R on the outcome arrays; with ``mesh`` (1-axis
-    "replica") the replica axis is sharded over the mesh devices.
+    With ``replicas=R``: vmaps R Monte-Carlo replicas over per-replica
+    keys, leading axis R on the outcome arrays; with ``mesh`` (1-axis
+    "replica") the replica axis is sharded over the mesh devices.  The
+    replica axis is runtime-bucketed (padded to a power of two, results
+    sliced back) so replica sweeps reuse one executable per bucket.
     """
-    ck = _sm_cache_key(prog, replicas)
-    cached = _SM_CACHE.get(ck)
-    compiling = cached is None
-    if cached is None:
+    from tpudes.parallel.runtime import RUNTIME, bucket_replicas, replica_keys
+
+    r_pad = bucket_replicas(replicas, mesh)
+
+    def build():
         consts, init_state, step_fn = build_sm_step(prog)
 
-        def run_one(k, sid):
-            ts = jnp.arange(prog.n_ttis, dtype=jnp.int32)
-            keys = jax.random.split(k, prog.n_ttis)
-            final, _ = jax.lax.scan(
-                lambda s, xs: (step_fn(s, xs, sid), None),
-                init_state(), (ts, keys),
+        def run_one(k, sid, horizon):
+            # per-TTI key = fold_in(k, t): a pure function of (k, t),
+            # so the traced horizon needs no key-array shape at all —
+            # one executable serves every n_ttis (split(k, n_ttis)
+            # would bake the horizon into the program)
+            def body(carry):
+                t, s = carry
+                kt = jax.random.fold_in(k, t)
+                return t + 1, step_fn(s, (t, kt), sid)
+
+            _, final = jax.lax.while_loop(
+                lambda c: c[0] < horizon,
+                body,
+                (jnp.int32(0), init_state()),
             )
             return final
 
-        if replicas is None:
+        if r_pad is None:
             fn = jax.jit(run_one)
         else:
-            fn = jax.jit(jax.vmap(run_one, in_axes=(0, None)))
-        _SM_CACHE[ck] = (consts, fn)
-        if len(_SM_CACHE) > 32:
-            _SM_CACHE.pop(next(iter(_SM_CACHE)))
-    consts, fn = _SM_CACHE[ck]
+            fn = jax.jit(jax.vmap(run_one, in_axes=(0, None, None)))
+        return consts, fn
+
+    (consts, fn), compiling = RUNTIME.runner(
+        "lte_sm", _sm_cache_key(prog, r_pad), build
+    )
 
     from tpudes.obs.device import CompileTelemetry
 
     sid = jnp.int32(SM_SCHED_IDS[prog.scheduler])
-    # the scheduler id is traced, so a 9-scheduler sweep must keep the
-    # recorded compile count at ONE — bench reports the metric
+    horizon = jnp.int32(prog.n_ttis)
+    # scheduler id and horizon are traced, so a 9-scheduler sweep must
+    # keep the recorded compile count at ONE — bench reports the metric
     with CompileTelemetry.timed("lte_sm", compiling):
-        if replicas is not None:
-            keys = jax.random.split(key, replicas)
+        if r_pad is not None:
+            keys = replica_keys(key, r_pad)
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 keys = jax.device_put(keys, NamedSharding(mesh, P("replica")))
-            out = fn(keys, sid)
+            out = fn(keys, sid, horizon)
         else:
-            out = fn(key, sid)
+            out = fn(key, sid, horizon)
         out["rx_lo"].block_until_ready()
     result = {k: np.asarray(v) for k, v in jax.device_get(out).items()
               if k in ("rx_lo", "rx_hi", "new_tbs", "retx", "drops", "ok_cnt")}
+    if r_pad is not None and r_pad != replicas:
+        result = {k: v[:replicas] for k, v in result.items()}
     result["rx_bits"] = (
         result.pop("rx_hi").astype(np.int64) << 20
     ) + result.pop("rx_lo").astype(np.int64)

@@ -1,0 +1,112 @@
+"""Synthetic device-program builders, parameterized by size.
+
+One recipe per engine, shared by ``bench.bench_mesh`` (the MULTICHIP
+strong-scaling rows), and ``tests/test_runtime.py`` (the recompile/
+bucketing gates) — so a Program-dataclass field change is edited in one
+place and the bench and the tests cannot silently drift apart.  All
+builders are deterministic pure-numpy constructions (no host RNG): the
+programs exist to exercise the runtime, not to model anything.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def toy_bss_program(n_sta: int = 4, sim_end_us: int = 60_000):
+    """AP + ``n_sta`` STAs on a 25 m circle (well inside mutual sensing
+    range), UDP echo arrivals every 20 ms, AP beaconing."""
+    from tpudes.ops.wifi_error import MODES_BY_NAME
+    from tpudes.parallel.replicated import BssProgram
+
+    pos = [(0.0, 0.0, 0.0)] + [
+        (
+            25.0 * math.cos(2 * math.pi * i / n_sta),
+            25.0 * math.sin(2 * math.pi * i / n_sta),
+            0.0,
+        )
+        for i in range(n_sta)
+    ]
+    n = n_sta + 1
+    start = np.full(n, 10_000, dtype=np.int32)
+    start[0] = 0
+    interval = np.full(n, 20_000, dtype=np.int32)
+    interval[0] = 102_400  # AP beacon period
+    return BssProgram(
+        positions=np.asarray(pos, np.float32),
+        data_mode_idx=MODES_BY_NAME["OfdmRate54Mbps"].index,
+        ack_mode_idx=MODES_BY_NAME["OfdmRate24Mbps"].index,
+        data_bytes=1084,
+        beacon_bytes=78,
+        start_us=start,
+        interval_us=interval,
+        stop_us=np.full(n, 2**30, np.int32),
+        sim_end_us=int(sim_end_us),
+    )
+
+
+def toy_lte_program(
+    n_enb: int = 2, n_ue: int = 4, n_ttis: int = 60, scheduler: str = "pf"
+):
+    """Full-buffer grid with a 30 dB serving-cell dominance (every UE
+    lands at a usable CQI)."""
+    from tpudes.parallel.lte_sm import LteSmProgram
+
+    serving = (np.arange(n_ue) % n_enb).astype(np.int32)
+    gain = np.full((n_enb, n_ue), 1e-12)
+    gain[serving, np.arange(n_ue)] = 1e-9
+    return LteSmProgram(
+        gain=gain,
+        serving=serving,
+        tx_power_dbm=np.full((n_enb,), 30.0),
+        noise_psd=10.0**0.9 * 1.380649e-23 * 290.0,
+        n_rb=25,
+        n_ttis=int(n_ttis),
+        scheduler=scheduler,
+    )
+
+
+def toy_dumbbell_program(n_flows: int = 3, n_slots: int = 250):
+    """Saturated dumbbell, one TcpCongestionOps lane per flow (round-
+    robin over the 17-variant table)."""
+    from tpudes.parallel.tcp_dumbbell import DumbbellProgram
+
+    return DumbbellProgram(
+        n_flows=n_flows,
+        variant_idx=(np.arange(n_flows) % 17).astype(np.int32),
+        start_slot=np.zeros(n_flows, np.int32),
+        stop_slot=np.full(n_flows, 2**30, np.int32),
+        max_pkts=np.full(n_flows, 2**31 - 1, np.int32),
+        slot_s=1e-3,
+        n_slots=int(n_slots),
+        ack_lag=10,
+        queue_cap=25,
+        burst_cap=4,
+        base_rtt_s=0.011,
+        seg_bytes=1000,
+    )
+
+
+def toy_as_program(
+    n_nodes: int = 64, n_flows: int = 3, spf_rounds: int = 16, seed: int = 1
+):
+    """BRITE BA graph with ``n_flows`` low-to-high-id CBR flows."""
+    from tpudes.helper.topology import BriteTopologyHelper
+    from tpudes.parallel.as_flows import AsFlowsProgram
+
+    g = BriteTopologyHelper(model="BA", n=n_nodes, m=2, seed=seed).Generate()
+    return AsFlowsProgram(
+        n=g.n,
+        edges=g.edges,
+        delay_s=g.delay_s,
+        rate_bps=g.rate_bps,
+        src=np.arange(1, 1 + n_flows, dtype=np.int32),
+        dst=np.arange(g.n - n_flows, g.n, dtype=np.int32),
+        flow_bps=np.full(n_flows, 1e5),
+        pkt_bytes=512,
+        sim_s=1.0,
+        max_hops=16,
+        spf_rounds=int(spf_rounds),
+    )

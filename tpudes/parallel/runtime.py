@@ -1,0 +1,205 @@
+"""Shared engine runtime: runner cache, shape bucketing, donation,
+and persistent-compile-cache wiring for the device engines.
+
+Every device engine (replicated BSS, LTE SM, TCP dumbbell, AS flows)
+used to carry its own module-level runner dict with ad-hoc eviction,
+its own idea of what belongs in the cache key, and its own launch
+conventions.  This module is the one runtime they all route through:
+
+- :class:`EngineRuntime` / :data:`RUNTIME` — one process-wide runner
+  registry with **true LRU eviction** (a cache hit moves the entry to
+  the back of the eviction order; the old per-engine dicts popped the
+  *insertion*-oldest entry, so a hot runner could be evicted while a
+  stale one survived).  Misses call the engine's ``build`` thunk and
+  report ``compiled_new`` so :class:`~tpudes.obs.device.CompileTelemetry`
+  is triggered from exactly one place per engine.
+
+- **Shape bucketing** (:func:`bucket_replicas`): the replica axis is
+  padded up to the next power of two (and to a multiple of the mesh
+  device count when sharding), so a replica-count sweep compiles one
+  program per *bucket* instead of one per point; callers slice results
+  back to the requested count.  Horizons (``max_steps`` / TTIs / slots)
+  need no bucket at all: the engines take the horizon as a **traced
+  operand** of a ``lax.while_loop`` bound, so one executable serves
+  every horizon with zero masked-iteration cost.
+
+  Bucketing is *exact*, not statistical: padding must not change any
+  real replica's outcome, which is why the engines derive per-replica
+  randomness via :func:`replica_keys` / per-step ``fold_in`` — replica
+  ``r``'s stream is a pure function of ``(key, r)`` and step ``t``'s of
+  ``(key, t)``, independent of the padded axis sizes.  (A joint
+  ``jax.random.uniform(key, (R, n))`` draw or ``split(key, R)`` does
+  NOT have this property: threefry lays counters out per-shape, so
+  growing R would silently reshuffle every replica's draws.)
+  ``TPUDES_BUCKETING=0`` disables padding for A/B debugging.
+
+- :func:`donate_argnums` — the state carry crossing the jit boundary is
+  donated on accelerators (the (R, …) carry is rebuilt fresh per call,
+  so XLA may alias it into the loop buffers instead of copying);
+  XLA:CPU does not implement donation and warns per call, so the CPU
+  backend gets an empty donate list.
+
+- :func:`configure_persistent_cache` — ``TPUDES_CACHE_DIR`` opts into
+  jax's persistent compilation cache, so a *second process* running the
+  same engines skips the XLA compiles entirely (the in-memory runner
+  cache only ever amortized within one process).  Wired lazily on the
+  first runner build; harmless no-op when the env var is unset.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+__all__ = [
+    "RUNTIME",
+    "EngineRuntime",
+    "bucket_replicas",
+    "bucketing_enabled",
+    "configure_persistent_cache",
+    "donate_argnums",
+    "pow2_bucket",
+    "replica_keys",
+]
+
+
+def bucketing_enabled() -> bool:
+    """Shape bucketing is on unless ``TPUDES_BUCKETING`` says otherwise
+    (read per call so tests can A/B without re-importing)."""
+    raw = os.environ.get("TPUDES_BUCKETING")
+    if raw is None:
+        return True
+    return raw.strip().lower() not in {"0", "false", "no", "off"}
+
+
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_replicas(replicas: int | None, mesh=None) -> int | None:
+    """Padded replica-axis size: next power of two, then rounded up to a
+    multiple of the mesh device count so the sharded axis always divides
+    evenly.  ``None`` (no replica axis) passes through."""
+    if replicas is None:
+        return None
+    r = int(replicas)
+    if bucketing_enabled():
+        r = pow2_bucket(r)
+    if mesh is not None:
+        n_dev = len(mesh.devices.flat)
+        r = ((r + n_dev - 1) // n_dev) * n_dev
+    return r
+
+
+def replica_keys(key, n: int):
+    """(n, …) batch of per-replica PRNG keys; row ``i`` is
+    ``fold_in(key, i)`` — a pure function of ``(key, i)`` independent of
+    ``n``, so padding the replica axis to a bucket leaves every real
+    replica's stream untouched.  ``jax.random.split(key, n)`` must NOT
+    be used for this: its rows depend on n."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(n))
+
+
+def donate_argnums(*argnums: int) -> tuple[int, ...]:
+    """``argnums`` on accelerators, ``()`` on CPU (XLA:CPU does not
+    implement buffer donation and logs a warning per donated call)."""
+    import jax
+
+    return argnums if jax.default_backend() != "cpu" else ()
+
+
+def configure_persistent_cache() -> str | None:
+    """Wire ``TPUDES_CACHE_DIR`` into jax's persistent compilation
+    cache so a fresh process reuses the previous process's XLA
+    compiles.  Returns the directory when armed, None otherwise (unset
+    env, or a jax too old to know the knobs — gated, never fatal)."""
+    path = os.environ.get("TPUDES_CACHE_DIR")
+    if not path:
+        return None
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every engine program: the default thresholds skip
+        # fast-compiling entries, which is exactly the sweep traffic
+        # the engines generate on CPU test backends
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except (AttributeError, ValueError):  # pragma: no cover - old jax
+        return None
+    return path
+
+
+class EngineRuntime:
+    """Process-wide runner registry shared by all device engines.
+
+    Entries are keyed ``(engine, *engine_key)`` and evicted true-LRU:
+    a hit refreshes the entry's position, so sweep working sets stay
+    resident while one-shot programs age out.
+    """
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = int(capacity)
+        self._runners: OrderedDict[tuple, object] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self._cache_wired = False
+
+    def runner(self, engine: str, key: tuple, build):
+        """Return ``(value, compiled_new)``: the cached runner for
+        ``(engine, *key)``, building (and recording a miss) when absent.
+        ``compiled_new`` is the engines' CompileTelemetry trigger."""
+        if not self._cache_wired:
+            configure_persistent_cache()
+            self._cache_wired = True
+        full = (engine, *key)
+        hit = self._runners.get(full)
+        if hit is not None:
+            self._runners.move_to_end(full)  # true LRU: hot entries survive
+            self.hits += 1
+            return hit, False
+        self.misses += 1
+        value = build()
+        self._runners[full] = value
+        while len(self._runners) > self.capacity:
+            self._runners.popitem(last=False)
+        return value, True
+
+    def size(self, engine: str | None = None) -> int:
+        """Resident runner count, optionally for one engine."""
+        if engine is None:
+            return len(self._runners)
+        return sum(1 for k in self._runners if k[0] == engine)
+
+    def clear(self, engine: str | None = None) -> None:
+        """Drop cached runners (all, or one engine's)."""
+        if engine is None:
+            self._runners.clear()
+            return
+        for k in [k for k in self._runners if k[0] == engine]:
+            # not a sim-time buffer: entries age out via the capacity
+            # LRU in runner(), so no expiry event is ever needed
+            del self._runners[k]  # tpudes: ignore[EVT003]
+
+    def stats(self) -> dict:
+        """Hit/miss counters plus per-engine residency — bench fodder."""
+        per_engine: dict[str, int] = {}
+        for k in self._runners:
+            per_engine[k[0]] = per_engine.get(k[0], 0) + 1
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "resident": len(self._runners),
+            "per_engine": per_engine,
+        }
+
+
+#: the one shared registry every engine routes through
+RUNTIME = EngineRuntime()

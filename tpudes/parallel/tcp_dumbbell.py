@@ -762,6 +762,25 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
         t, key = inp
         idx = t % L
 
+        # per-replica keying: replica r's draws at slot t are a pure
+        # function of (key, t, r) — independent of R — so runtime
+        # replica-bucketing (padding R to a power of two) leaves every
+        # real replica's stream bit-identical
+        rkeys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(R))
+        if RED:
+
+            def draw(kk):
+                k_dep, k_red, k_mark = jax.random.split(kk, 3)
+                return (
+                    jax.random.uniform(k_dep, ()),
+                    jax.random.uniform(k_red, (F,)),
+                    jax.random.uniform(k_mark, ()),
+                )
+
+            u_dep, u_red, u_mark = jax.vmap(draw)(rkeys)
+        else:
+            u_dep = jax.vmap(lambda kk: jax.random.uniform(kk, ()))(rkeys)
+
         # 1. consume this slot's ack / loss / ECN-echo arrivals
         acks = s["ack_buf"][:, idx, :]
         losses = s["loss_buf"][:, idx, :]
@@ -811,14 +830,11 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
         )
 
         # 3. departure: serve one packet, flow ∝ queue occupancy
-        if RED:
-            key, key_red, key_mark = jax.random.split(key, 3)
         q = s["q"]
         qtot = q.sum(axis=1)
         backlogged = qtot > 0
-        u = jax.random.uniform(key, (R,))
         cum = jnp.cumsum(q, axis=1)
-        thresh = (u * qtot.astype(jnp.float32)).astype(jnp.int32)
+        thresh = (u_dep * qtot.astype(jnp.float32)).astype(jnp.int32)
         dep = jnp.argmax(cum > thresh[:, None], axis=1)  # (R,)
         dep_oh = jax.nn.one_hot(dep, F, dtype=jnp.int32) * backlogged[
             :, None
@@ -828,7 +844,6 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
         # residue would keep the `marks > 0` loss response firing for
         # hundreds of RTTs after a marking episode)
         if RED:
-            u_mark = jax.random.uniform(key_mark, (R,))
             dep_marked = dep_oh.astype(jnp.float32) * (
                 u_mark[:, None]
                 < s["q_marked"] / jnp.maximum(q, 1).astype(jnp.float32)
@@ -890,7 +905,6 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
             p = jnp.clip(jnp.where(forced, 1.0, p), 0.0, 1.0)
             # ECT packets are marked unless the forced region hard-drops
             ect = ecn_cap[None, :] & prog.red_use_ecn
-            u_red = jax.random.uniform(key_red, (R, F))
             n_act = jnp.minimum(
                 want,
                 jnp.floor(
@@ -960,53 +974,67 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
     return init_state, step_fn
 
 
-_RUNNER_CACHE: dict = {}
-
-
 def run_tcp_dumbbell(prog: DumbbellProgram, key, replicas: int, mesh=None):
     """Execute R replicas of the dumbbell program; returns per-replica
     outcome arrays: goodput_mbps (R,F), delivered (R,F), drops (R,F),
     mean_queue (R,), cwnd_final (R,F) — plus, under ``TpudesObs=1``,
     the on-device metric accumulators ``cwnd_cuts`` (R,F), ``retx``
-    (R,F) and ``queue_hist`` (R, OBS_QHIST_BINS)."""
+    (R,F) and ``queue_hist`` (R, OBS_QHIST_BINS).  The slot horizon is
+    a traced operand and the replica axis is runtime-bucketed, so
+    horizon/replica sweeps reuse one executable per replica bucket."""
+    import functools
+
     from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+    from tpudes.parallel.runtime import (
+        RUNTIME,
+        bucket_replicas,
+        donate_argnums,
+    )
 
     obs = device_metrics_enabled()
+    r_pad = bucket_replicas(replicas, mesh)
+    # n_slots is deliberately ABSENT from the key: the horizon is a
+    # traced while_loop bound, so one executable serves every n_slots
     ck = tuple(
         v.tobytes() if isinstance(v, np.ndarray) else v
-        for v in prog.__dict__.values()
-    ) + (replicas, obs)
-    hit = _RUNNER_CACHE.get(ck)
-    compiling = hit is None
-    if hit is None:
-        init_state, step_fn = build_dumbbell_step(prog, replicas, obs=obs)
+        for k, v in prog.__dict__.items()
+        if k != "n_slots"
+    ) + (r_pad, obs)
 
-        @jax.jit
-        def run(s0, key):
-            keys = jax.random.split(key, prog.n_slots)
-            ts = jnp.arange(prog.n_slots, dtype=jnp.int32)
-            out, _ = jax.lax.scan(step_fn, s0, (ts, keys))
+    def build():
+        init_state, step_fn = build_dumbbell_step(prog, r_pad, obs=obs)
+
+        @functools.partial(jax.jit, donate_argnums=donate_argnums(0))
+        def run(s0, key, horizon):
+            # per-slot key = fold_in(key, t): pure in (key, t), so the
+            # traced horizon needs no split-keys array shape
+            def body(carry):
+                t, s = carry
+                s, _ = step_fn(s, (t, jax.random.fold_in(key, t)))
+                return t + 1, s
+
+            _, out = jax.lax.while_loop(
+                lambda c: c[0] < horizon, body, (jnp.int32(0), s0)
+            )
             return out
 
-        _RUNNER_CACHE[ck] = (init_state, run)
-        if len(_RUNNER_CACHE) > 32:
-            _RUNNER_CACHE.pop(next(iter(_RUNNER_CACHE)))
-        hit = _RUNNER_CACHE[ck]
-    init_state, run = hit
+        return init_state, run
+
+    (init_state, run), compiling = RUNTIME.runner("dumbbell", ck, build)
 
     s0 = init_state()
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         def shard(v):
-            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == replicas:
+            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == r_pad:
                 spec = P("replica", *([None] * (v.ndim - 1)))
                 return jax.device_put(v, NamedSharding(mesh, spec))
             return v
 
         s0 = jax.tree_util.tree_map(shard, s0)
     with CompileTelemetry.timed("dumbbell", compiling):
-        out = run(s0, key)
+        out = run(s0, key, jnp.int32(prog.n_slots))
         if compiling:
             jax.block_until_ready(out)
     sim_s = prog.n_slots * prog.slot_s
@@ -1014,17 +1042,18 @@ def run_tcp_dumbbell(prog: DumbbellProgram, key, replicas: int, mesh=None):
         out["delivered"].astype(jnp.float32) * prog.seg_bytes * 8.0
         / sim_s / 1e6
     )
+    R = replicas
     result = dict(
-        goodput_mbps=goodput,
-        delivered=out["delivered"],
-        drops=out["drops"],
-        mean_queue=out["qsum"] / prog.n_slots,
-        cwnd_final=out["cwnd"],
+        goodput_mbps=goodput[:R],
+        delivered=out["delivered"][:R],
+        drops=out["drops"][:R],
+        mean_queue=out["qsum"][:R] / prog.n_slots,
+        cwnd_final=out["cwnd"][:R],
     )
     if obs:
         result.update(
-            cwnd_cuts=out["cwnd_cuts"],
-            retx=out["retx_cnt"],
-            queue_hist=out["q_hist"],
+            cwnd_cuts=out["cwnd_cuts"][:R],
+            retx=out["retx_cnt"][:R],
+            queue_hist=out["q_hist"][:R],
         )
     return result
