@@ -108,6 +108,71 @@ def test_jp_suppressed_and_clean():
     assert _codes(clean, select=["JP"]) == []
 
 
+def test_jp005_host_sync_in_step_and_cond_bodies():
+    """block_until_ready / .item() / np.asarray inside functions handed
+    to lax control flow or jit — per-iteration device fences (the
+    serialization ISSUE 5's async runtime removes)."""
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def run(s0, horizon):
+        def cond(c):
+            return c[0].item() < horizon
+
+        def body(c):
+            t, s = c
+            s.block_until_ready()
+            return t + 1, jnp.asarray(np.asarray(s) + 1)
+
+        return jax.lax.while_loop(cond, body, s0)
+    """
+    assert _codes(
+        src, path="tpudes/parallel/fixture.py", select=["JP005"]
+    ) == ["JP005", "JP005", "JP005"]
+
+
+def test_jp005_host_side_sync_is_clean():
+    """The same calls in a HOST driver function (not traced) are the
+    legitimate run-end fetch — module-wide scoping would flag every
+    run_* entry point in tpudes/parallel."""
+    src = """
+    import jax
+    import numpy as np
+
+    def run_engine(fn, s0):
+        out = fn(s0)
+        jax.block_until_ready(out)
+        host = np.asarray(out)
+        return int(host.sum()), out.item() if out.ndim == 0 else None
+    """
+    assert _codes(
+        src, path="tpudes/parallel/fixture.py", select=["JP005"]
+    ) == []
+
+
+def test_jp005_from_import_and_suppression():
+    flagged = """
+    import jax
+    from numpy import asarray
+
+    @jax.jit
+    def step(x):
+        return asarray(x) + 1
+    """
+    assert _codes(flagged, select=["JP005"]) == ["JP005"]
+    suppressed = """
+    import jax
+    from numpy import asarray
+
+    @jax.jit
+    def step(x):
+        return asarray(x) + 1  # tpudes: ignore[JP005]
+    """
+    assert _codes(suppressed, select=["JP005"]) == []
+
+
 # --- rng-discipline (RNG) --------------------------------------------------
 
 def test_rng_key_reuse_without_split():

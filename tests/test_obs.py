@@ -418,8 +418,15 @@ def test_obs_disabled_overhead_within_3_percent():
         return dt
 
     for attempt in range(3):
-        knob0 = min(once(DefaultSimulatorImpl.Run) for _ in range(5))
-        pristine = min(once(_pristine_run) for _ in range(5))
+        # interleave the two sides: measuring all knob0 samples and
+        # THEN all pristine samples lets a monotonic load change (CI
+        # neighbors spinning up/down) bias the ratio — alternating
+        # keeps min-vs-min comparing the same load regime
+        k_samples, p_samples = [], []
+        for _ in range(5):
+            k_samples.append(once(DefaultSimulatorImpl.Run))
+            p_samples.append(once(_pristine_run))
+        knob0, pristine = min(k_samples), min(p_samples)
         if knob0 <= pristine * 1.03:
             return
     pytest.fail(

@@ -204,6 +204,54 @@ def test_fin_teardown_reaches_closed():
     assert srv_sock._state in (TcpSocketBase.CLOSED, TcpSocketBase.LAST_ACK)
 
 
+def test_time_wait_timer_held_and_cancelled_on_teardown():
+    """Promoted EVT001 finding: _enter_time_wait dropped its 2*MSL
+    EventId, so a socket torn down mid-TIME_WAIT could not cancel the
+    timer — 240 s later _time_wait_done fired on the dead socket and
+    re-notified its close callbacks.  The EventId is now held and
+    _cleanup cancels it."""
+    from tpudes.models.internet.tcp import MSL_S
+
+    nodes, devices, interfaces = _p2p_pair()
+    tcp1 = nodes.Get(1).GetObject(TcpL4Protocol)
+    server = tcp1.CreateSocket()
+    server.Bind(InetSocketAddress(Ipv4Address.GetAny(), 8080))
+    server.Listen()
+    server.SetAcceptCallback(lambda s, a: True, lambda s, a: None)
+    server.SetCloseCallbacks(lambda s: s.Close(), lambda s: None)
+
+    tcp0 = nodes.Get(0).GetObject(TcpL4Protocol)
+    client = tcp0.CreateSocket()
+    closes = []
+    client.SetCloseCallbacks(lambda s: closes.append(Simulator.Now()), lambda s: None)
+
+    def go():
+        client.Connect(InetSocketAddress(interfaces.GetAddress(1), 8080))
+        client.Send(Packet(500))
+        Simulator.Schedule(Seconds(1.0), client.Close)
+
+    probe = {}
+
+    def teardown_mid_time_wait():
+        probe["state"] = client._state
+        probe["held"] = client._time_wait_event is not None
+        client._cleanup()  # app/protocol teardown before 2*MSL elapses
+        probe["cancelled"] = client._time_wait_event is None
+
+    Simulator.Schedule(Seconds(0.1), go)
+    Simulator.Schedule(Seconds(5.0), teardown_mid_time_wait)
+    Simulator.Stop(Seconds(2 * MSL_S + 10.0))
+    Simulator.Run()
+    assert probe["state"] == TcpSocketBase.TIME_WAIT
+    assert probe["held"], "the 2*MSL EventId must be HELD, not dropped"
+    assert probe["cancelled"]
+    # the cancelled timer must NOT have fired on the torn-down socket:
+    # no post-teardown close notification, state untouched by
+    # _time_wait_done
+    assert not closes, f"TIME_WAIT timer fired after teardown: {closes}"
+    assert client._state == TcpSocketBase.TIME_WAIT
+
+
 def test_htcp_throughput_ratio_guards_beta_adaptation():
     """Promoted REG001 finding: ThroughputRatio now guards H-TCP's
     adaptive backoff — beta follows RTTmin/RTTmax across stable epochs

@@ -15,7 +15,12 @@ path (ISSUE 1 tentpole scope).
 
 JP001 wall-clock ``time.*`` · JP002 ``print`` · JP003 host RNG
 (``np.random``/stdlib ``random``) · JP004 mutation of ``self`` /
-globals / captured containers (traced regions only).
+globals / captured containers (traced regions only) · JP005 host-sync
+calls (``block_until_ready`` / ``.item()`` / ``np.asarray``-family) in
+traced regions — inside an engine step/cond function these force a
+device→host round trip per loop iteration (or simply fail to trace),
+exactly the serialization the async runtime exists to avoid; the hot
+loop must accumulate on-device and fetch once at run end.
 """
 
 from __future__ import annotations
@@ -33,6 +38,10 @@ _TIME_FUNCS = {
     "time", "monotonic", "perf_counter", "process_time", "time_ns",
     "monotonic_ns", "perf_counter_ns",
 }
+#: numpy calls that force a traced value onto the host (JP005); jnp's
+#: spellings are fine — they stay on device
+_NP_HOST_FUNCS = {"asarray", "array", "ascontiguousarray"}
+
 _MUTATORS = {
     "append", "extend", "insert", "add", "discard", "update", "pop",
     "popitem", "remove", "clear", "setdefault", "sort", "reverse",
@@ -48,7 +57,7 @@ def _alias_map(tree: ast.Module) -> dict[str, set[str]]:
     bucket."""
     out: dict[str, set[str]] = {
         "time": set(), "numpy": set(), "random": set(),
-        "np_random": set(), "time_funcs": set(),
+        "np_random": set(), "time_funcs": set(), "np_funcs": set(),
     }
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -67,6 +76,8 @@ def _alias_map(tree: ast.Module) -> dict[str, set[str]]:
                     out["time_funcs"].add(bound)
                 elif node.module == "numpy" and a.name == "random":
                     out["np_random"].add(bound)
+                elif node.module == "numpy" and a.name in _NP_HOST_FUNCS:
+                    out["np_funcs"].add(bound)
                 elif node.module == "random":
                     out["random"].add(bound)  # stdlib draw functions
     return out
@@ -182,6 +193,7 @@ class JitPurityPass(Pass):
         "JP002": "print() in traced/device-path code",
         "JP003": "host RNG (np.random / stdlib random) in traced/device-path code",
         "JP004": "mutation of self/global/captured state in traced code",
+        "JP005": "host-sync call (block_until_ready/.item()/np.asarray) in traced code",
     }
 
     def applies(self, path: str) -> bool:
@@ -243,6 +255,39 @@ class JitPurityPass(Pass):
             for node in ast.walk(scope):
                 if isinstance(node, ast.Call):
                     check_effect_call(node)
+
+        # JP005: host-sync calls, traced regions ONLY — the host-side
+        # run_* drivers in tpudes/parallel legitimately block/fetch at
+        # run end; the rule targets step/cond bodies, where a sync is a
+        # per-iteration device fence (or a trace-time failure)
+        for region in regions:
+            for node in ast.walk(region):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    if func.attr == "block_until_ready":
+                        put(node, "JP005",
+                            "'.block_until_ready()' fences the device "
+                            "inside traced code — accumulate on-device "
+                            "and sync once at run end")
+                        continue
+                    if func.attr == "item" and not node.args and not node.keywords:
+                        put(node, "JP005",
+                            "'.item()' forces a device->host transfer "
+                            "of a traced value (it cannot even trace "
+                            "under jit)")
+                        continue
+                dn = dotted_name(func)
+                if dn is not None:
+                    head, _, rest = dn.partition(".")
+                    if (head in aliases["numpy"] and rest in _NP_HOST_FUNCS) or (
+                        not rest and head in aliases["np_funcs"]
+                    ):
+                        put(node, "JP005",
+                            f"'{dn}()' materializes a traced value on "
+                            "the host (use jnp, or fetch after the "
+                            "loop)")
 
         # JP004: mutation, traced regions only.  Module aliases (jnp,
         # np, jax...) are function namespaces, not mutable receivers —

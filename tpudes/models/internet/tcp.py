@@ -204,6 +204,7 @@ class TcpSocketBase(Socket):
         self._dupack_count = 0
         self._recover = 0
         self._rto_event = None
+        self._time_wait_event = None
         self._rto_s = self.initial_rto_s
         self._srtt = None
         self._rttvar = None
@@ -812,15 +813,24 @@ class TcpSocketBase(Socket):
     def _enter_time_wait(self):
         self._set_state(self.TIME_WAIT)
         self._cancel_rto()
-        Simulator.Schedule(Seconds(2 * MSL_S), self._time_wait_done)
+        # hold the 2*MSL EventId: a socket torn down mid-TIME_WAIT
+        # (app Close/teardown) must cancel it, or the timer fires on a
+        # dead socket 240 s later and re-notifies its callbacks
+        self._time_wait_event = Simulator.Schedule(
+            Seconds(2 * MSL_S), self._time_wait_done
+        )
 
     def _time_wait_done(self):
+        self._time_wait_event = None
         self._set_state(self.CLOSED)
         self._cleanup()
         self.NotifyNormalClose()
 
     def _cleanup(self):
         self._cancel_rto()
+        if self._time_wait_event is not None:
+            self._time_wait_event.Cancel()
+            self._time_wait_event = None
         if self._endpoint is not None:
             self._tcp._demux.DeAllocate(self._endpoint)
             self._endpoint = None

@@ -22,7 +22,11 @@ With the knob at 0 the engines run their pre-obs code paths unchanged
 (pinned by the overhead test in tests/test_obs.py).
 """
 
-from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+from tpudes.obs.device import (
+    ChunkStream,
+    CompileTelemetry,
+    device_metrics_enabled,
+)
 from tpudes.obs.export import (
     assert_valid_chrome_trace,
     chrome_trace,
@@ -39,6 +43,7 @@ from tpudes.obs.profiler import (
 )
 
 __all__ = [
+    "ChunkStream",
     "CompileTelemetry",
     "FlightRecorder",
     "HostProfiler",

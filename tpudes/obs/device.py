@@ -17,6 +17,11 @@ the part that must be process-global:
   lowering/build time; the extra carry buffers exist only when the
   ``TpudesObs`` knob is up, so a disabled run compiles the exact
   pre-obs program.
+- :class:`ChunkStream` — the landing strip for chunked-horizon runs:
+  each fixed-size while_loop segment returns a small device metrics
+  tree alongside the carry, and the engine records it here *after
+  dispatching the next segment*, so the D2H fetch overlaps the next
+  chunk's compute instead of serializing the pipeline.
 """
 
 from __future__ import annotations
@@ -75,3 +80,37 @@ class CompileTelemetry:
         t0 = time.monotonic()
         yield
         cls.record(engine, time.monotonic() - t0)
+
+
+class ChunkStream:
+    """Per-chunk metrics streamed by chunked-horizon engine runs.
+
+    Bounded (oldest entries drop past :data:`CAP`) because a long
+    streaming run would otherwise grow host memory without limit; the
+    stream is a progress feed, not an archive."""
+
+    CAP = 4096
+    _entries: list[dict] = []
+    _dropped = 0
+
+    @classmethod
+    def record(cls, engine: str, t_end: int, metrics: dict) -> None:
+        cls._entries.append(
+            {"engine": engine, "t_end": int(t_end), "metrics": metrics}
+        )
+        if len(cls._entries) > cls.CAP:
+            del cls._entries[: len(cls._entries) - cls.CAP]
+            cls._dropped += 1
+
+    @classmethod
+    def entries(cls, engine: str | None = None) -> list[dict]:
+        if engine is None:
+            return list(cls._entries)
+        return [e for e in cls._entries if e["engine"] == engine]
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._entries.clear()
+        cls._dropped = 0
+
+

@@ -31,6 +31,7 @@ _LAZY = {
     # import it from tpudes.parallel.kernels directly
     "wifi_phy_window": ("tpudes.parallel.kernels", "wifi_phy_window"),
     "RUNTIME": ("tpudes.parallel.runtime", "RUNTIME"),
+    "EngineFuture": ("tpudes.parallel.runtime", "EngineFuture"),
     "EngineRuntime": ("tpudes.parallel.runtime", "EngineRuntime"),
     "lbts_grant": ("tpudes.parallel.mesh", "lbts_grant"),
     "make_replica_batch": ("tpudes.parallel.mesh", "make_replica_batch"),

@@ -241,30 +241,82 @@ def _walk_paths(prog: AsFlowsProgram, ddst, nh_edge, nh_node):
     return path, hops, arrived
 
 
-def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
+#: fluid fixed-point relaxation rounds (feed-forward paths settle the
+#: ≤k-th-hop links exactly in round k)
+FP_ROUNDS = 4
+
+#: result keys carrying a leading replica axis (sliced back after
+#: bucket padding); hops/unreachable are per-flow statics
+_AS_R_LEAD = ("goodput_bps", "delay_s", "delivered_frac", "max_util")
+
+
+def _as_unpack(host: dict, replicas: int) -> dict:
+    return {
+        k: (np.asarray(v)[:replicas] if k in _AS_R_LEAD else np.asarray(v))
+        for k, v in host.items()
+    }
+
+
+def run_as_flows(
+    prog: AsFlowsProgram,
+    key,
+    replicas: int,
+    mesh=None,
+    *,
+    rate_scale=None,
+    chunk_rounds: int | None = None,
+    block: bool = True,
+):
     """Execute R replicas; returns per-replica outcome arrays:
     ``goodput_bps`` (R,F), ``delay_s`` (R,F) fluid end-to-end delay,
     ``delivered_frac`` (R,F), ``max_util`` (R,), ``hops`` (F,),
     ``unreachable`` (F,) bool.  The replica axis is runtime-bucketed
-    (padded to a power of two, results sliced back)."""
-    import functools
+    (padded to a power of two, results sliced back).
 
+    ``rate_scale=[...]`` runs a **config-axis offered-load sweep**: the
+    scale is a traced multiplier on every flow's nominal rate, vmapped
+    over a leading config axis — a C-point load study is ONE launch in
+    which the SPF/path tables are computed once and only the fluid
+    fixed point fans out; returns a list of per-point result dicts.
+
+    ``chunk_rounds=N`` splits the fixed-point relaxation into N-round
+    while_loop segments with a donated carry handoff (bit-identical to
+    the single-shot :data:`FP_ROUNDS` relaxation).  Chunking here is a
+    streaming/debugging aid, not a throughput mode: the runner is one
+    executable, so every segment re-runs the config-independent SPF +
+    path walk and the output assembly — with :data:`FP_ROUNDS` = 4
+    that is at most 4 repeats, but don't chunk a large-topology run
+    you aren't inspecting.  ``block=False`` returns an
+    :class:`~tpudes.parallel.runtime.EngineFuture`.
+    """
+    from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
     from tpudes.parallel.runtime import (
         RUNTIME,
+        EngineFuture,
         bucket_replicas,
+        chunk_bounds,
         donate_argnums,
+        drive_chunks,
+        finalize_with_flush,
         replica_keys,
+        shard_replica_axis,
+        stack_axis,
+        unstack_points,
     )
 
     r_pad = bucket_replicas(replicas, mesh)
+    n_cfg = None if rate_scale is None else len(rate_scale)
+    obs = device_metrics_enabled()
     # prog.sim_s is deliberately ABSENT: the fluid fixed point has no
-    # time horizon (its cost does not scale with simulated seconds)
+    # time horizon (its cost does not scale with simulated seconds).
+    # mesh IS present: device_spf shards its tables via the mesh
+    # closure, unlike the engines whose sharding flows from inputs
     ck = (
         prog.edges.tobytes(), prog.delay_s.tobytes(),
         prog.rate_bps.tobytes(), prog.src.tobytes(), prog.dst.tobytes(),
         prog.flow_bps.tobytes(), prog.pkt_bytes,
         prog.max_hops, prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
-        r_pad, mesh,
+        r_pad, mesh, n_cfg, obs,
     )
 
     def build():
@@ -278,37 +330,37 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
         ).astype(jnp.float32)
         fbps = jnp.asarray(prog.flow_bps, jnp.float32)
         R, F, H = r_pad, len(prog.src), prog.max_hops
+        pad = lambda x: jnp.concatenate(  # noqa: E731
+            [x, jnp.zeros((R, 1), x.dtype)], axis=1
+        )
+        hs = jnp.arange(H, dtype=jnp.int32)
 
-        @functools.partial(jax.jit, donate_argnums=donate_argnums(0))
-        def _run(z):
+        def topo():
             ddst, dist, nh_edge, nh_node = device_spf(prog, mesh)
             path, hops, arrived = _walk_paths(prog, ddst, nh_edge, nh_node)
             reached = (
                 dist[ddst, jnp.asarray(prog.src)] < INF
             ) & arrived
+            return path, hops, reached
 
-            # per-replica offered rates: lognormal jitter around nominal
-            # (z enters sharded over the mesh's replica axis — every
-            # (R, ...) array downstream inherits that sharding)
-            rate = fbps[None, :] * jnp.exp(
+        def relax(carry, z, scale, rounds_end, path, reached):
+            # per-replica offered rates: lognormal jitter around the
+            # scale-multiplied nominal (z enters sharded over the
+            # mesh's replica axis — every (R, ...) array downstream
+            # inherits that sharding)
+            rate = fbps[None, :] * scale * jnp.exp(
                 prog.rate_jitter * z - 0.5 * prog.rate_jitter**2
             )
             rate = jnp.where(reached[None, :], rate, 0.0)
 
             # fluid fixed point: a link's load is the SURVIVING rate of
             # each transiting flow at that hop (loss upstream attenuates
-            # load downstream); K rounds converge fast on feed-forward
-            # paths (round k settles every ≤k-th-hop link exactly)
-            pad = lambda x: jnp.concatenate(  # noqa: E731
-                [x, jnp.zeros((R, 1), x.dtype)], axis=1
-            )
-            hs = jnp.arange(H, dtype=jnp.int32)
-
-            def fixed_point(lfrac_link, _):
+            # load downstream)
+            def one_round(lfrac_link):
                 # walk: per-flow surviving rate entering each hop, and
                 # accumulate this round's per-link loads
-                def walk(carry, h):
-                    lg, load = carry
+                def walk(c, h):
+                    lg, load = c
                     e_h = path[:, h]                       # (F,)
                     load = load.at[:, e_h].add(rate * jnp.exp(lg))
                     lg = lg + lfrac_link[:, e_h]
@@ -323,13 +375,16 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
                 new_lfrac = pad(
                     jnp.log(jnp.minimum(1.0, 1.0 / jnp.maximum(util, 1e-9)))
                 )
-                return new_lfrac, (lg, util)
+                return new_lfrac, lg, util
 
-            lfrac0 = jnp.zeros((R, E2 + 1), jnp.float32)
-            _, (lgs, utils) = jax.lax.scan(
-                fixed_point, lfrac0, None, length=4
+            def body(c):
+                i, lf, _, _ = c
+                lf2, lg2, util2 = one_round(lf)
+                return i + 1, lf2, lg2, util2
+
+            i, lfrac, lg, util = jax.lax.while_loop(
+                lambda c: c[0] < rounds_end, body, carry
             )
-            lg, util = lgs[-1], utils[-1]
 
             # M/M/1 queue delay along each path from the settled utils
             rho = jnp.minimum(util, 0.99)
@@ -344,16 +399,34 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
 
             dl, _ = jax.lax.scan(acc_hop, jnp.zeros((R, F)), hs)
             frac = jnp.where(reached[None, :], jnp.exp(lg), 0.0)
-            return dict(
+            outputs = dict(
                 goodput_bps=rate * frac,
                 delay_s=jnp.where(reached[None, :], dl, jnp.inf),
                 delivered_frac=frac,
                 max_util=util.max(axis=1),
-                hops=hops,
-                unreachable=~reached,
             )
+            # chunk summary only under TpudesObs (obs is in the cache
+            # key): a disabled run compiles the pre-obs program
+            metrics = dict(max_util=jnp.max(util)) if obs else {}
+            return (i, lfrac, lg, util), outputs, metrics
 
-        return _run
+        def run(carry, z, scale, rounds_end):
+            path, hops, reached = topo()
+            if n_cfg is None:
+                carry, outputs, metrics = relax(
+                    carry, z, scale, rounds_end, path, reached
+                )
+            else:
+                # SPF + path walk are config-independent: computed once,
+                # closed over by the vmapped fixed point
+                carry, outputs, metrics = jax.vmap(
+                    lambda c, s: relax(c, z, s, rounds_end, path, reached)
+                )(carry, scale)
+            outputs["hops"] = hops
+            outputs["unreachable"] = ~reached
+            return carry, outputs, metrics
+
+        return jax.jit(run, donate_argnums=donate_argnums(0))
 
     run, compiling = RUNTIME.runner("as_flows", ck, build)
 
@@ -362,18 +435,47 @@ def run_as_flows(prog: AsFlowsProgram, key, replicas: int, mesh=None):
     z = jax.vmap(
         lambda kk: jax.random.normal(kk, (len(prog.src),))
     )(replica_keys(key, r_pad))
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        z = jax.device_put(z, NamedSharding(mesh, P("replica", None)))
-    from tpudes.obs.device import CompileTelemetry
+    z = shard_replica_axis(z, mesh, r_pad, 0)
+    scale = (
+        jnp.float32(1.0) if n_cfg is None
+        else jnp.asarray([float(v) for v in rate_scale], jnp.float32)
+    )
+    E2 = 2 * prog.edges.shape[0]
+    F = len(prog.src)
+    carry = (
+        jnp.int32(0),
+        jnp.zeros((r_pad, E2 + 1), jnp.float32),
+        jnp.zeros((r_pad, F), jnp.float32),
+        jnp.zeros((r_pad, E2), jnp.float32),
+    )
+    carry = stack_axis(carry, n_cfg)
+    carry = shard_replica_axis(carry, mesh, r_pad, 0 if n_cfg is None else 1)
 
     with CompileTelemetry.timed("as_flows", compiling):
-        out = run(z)
-        out["goodput_bps"].block_until_ready()
-    if r_pad != replicas:
-        r_lead = ("goodput_bps", "delay_s", "delivered_frac", "max_util")
-        out = {
-            k: (v[:replicas] if k in r_lead else v) for k, v in out.items()
-        }
-    return out
+        def launch(c, bound):
+            carry, out, metrics = run(c[0], z, scale, jnp.int32(bound))
+            return (carry, out), metrics
+
+        (_, out), flush = drive_chunks(
+            "as_flows",
+            chunk_bounds(FP_ROUNDS, chunk_rounds or FP_ROUNDS),
+            (carry, None),
+            launch,
+            obs,
+        )
+        if compiling:
+            jax.block_until_ready(out)
+
+    fut = EngineFuture(
+        "as_flows",
+        out,
+        finalize_with_flush(
+            flush,
+            unstack_points(
+                n_cfg,
+                lambda host: _as_unpack(host, replicas),
+                shared=("hops", "unreachable"),
+            ),
+        ),
+    )
+    return fut.result() if block else fut

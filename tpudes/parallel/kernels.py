@@ -147,11 +147,19 @@ def lte_tti_sinr(
 ):
     """Per-RB SINR for each UE in one TTI: serving signal over sum of
     other-cell interference + noise (LteInterference chunk processing,
-    dense over the RB grid)."""
-    # power seen by UE u from eNB e on each RB: (E, U, RB)
-    seen = tx_psd_w[:, None, :] * gain[:, :, None]
-    total = jnp.sum(seen, axis=0)                          # (U, RB)
-    sig = jnp.take_along_axis(
-        seen, serving[None, :, None], axis=0
-    )[0]                                                   # (U, RB)
+    dense over the RB grid).
+
+    Peak memory is O(U·RB): the serving-signal term is a gather on
+    ``(gain, tx_psd_w)`` and the all-cells total one einsum contraction
+    over E — the old form materialized the full (E, U, RB) ``seen``
+    tensor (7 eNB × 210 UE × 100 RB × f32 per *replica*) because the
+    take_along_axis gather was a second consumer of it.  The gather
+    term is BIT-exact vs the old form; the einsum total is within a
+    couple of f32 ULP (XLA fuses the old multiply into its reduce with
+    FMA, so no O(U·RB) reformulation can reproduce those exact bits)
+    and no further from the float64 ground truth
+    (tests/test_ops_lte_kernels.py pins all three properties)."""
+    u = jnp.arange(gain.shape[1])
+    sig = tx_psd_w[serving] * gain[serving, u][:, None]    # (U, RB)
+    total = jnp.einsum("eu,er->ur", gain, tx_psd_w)        # (U, RB)
     return sig / (total - sig + noise_psd_w)

@@ -338,7 +338,7 @@ def build_sm_step(prog: LteSmProgram):
     return consts, init_state, step_fn
 
 
-def _sm_cache_key(prog: LteSmProgram, replicas) -> tuple:
+def _sm_cache_key(prog: LteSmProgram, replicas, n_cfg, obs) -> tuple:
     # prog.scheduler AND prog.n_ttis are deliberately ABSENT: the
     # scheduler id and the TTI horizon are both traced operands, so one
     # compiled program serves all nine schedulers at every horizon — a
@@ -346,11 +346,39 @@ def _sm_cache_key(prog: LteSmProgram, replicas) -> tuple:
     return (
         prog.gain.tobytes(), prog.serving.tobytes(),
         prog.tx_power_dbm.tobytes(), prog.noise_psd, prog.n_rb,
-        prog.pf_alpha, replicas,
+        prog.pf_alpha, replicas, n_cfg, obs,
     )
 
 
-def run_lte_sm(prog: LteSmProgram, key, replicas: int | None = None, mesh=None):
+#: the state-dict keys fetched back to the host at run end
+_SM_FETCH = ("rx_lo", "rx_hi", "new_tbs", "retx", "drops", "ok_cnt")
+
+
+def _sm_unpack(host: dict, consts_np: dict, replicas) -> dict:
+    """Host-side result assembly for ONE config point (already
+    device_get; slices the replica padding, rebuilds the 52-bit rx
+    counter)."""
+    result = {k: np.asarray(v) for k, v in host.items()}
+    if replicas is not None and result["rx_lo"].shape[0] != replicas:
+        result = {k: v[:replicas] for k, v in result.items()}
+    result["rx_bits"] = (
+        result.pop("rx_hi").astype(np.int64) << 20
+    ) + result.pop("rx_lo").astype(np.int64)
+    result["ok"] = result.pop("ok_cnt")
+    result.update(consts_np)
+    return result
+
+
+def run_lte_sm(
+    prog: LteSmProgram,
+    key,
+    replicas: int | None = None,
+    mesh=None,
+    *,
+    schedulers=None,
+    chunk_ttis: int | None = None,
+    block: bool = True,
+):
     """Run the full-buffer downlink simulation on-device.
 
     Without ``replicas``: one run, returns per-UE arrays
@@ -360,67 +388,132 @@ def run_lte_sm(prog: LteSmProgram, key, replicas: int | None = None, mesh=None):
     "replica") the replica axis is sharded over the mesh devices.  The
     replica axis is runtime-bucketed (padded to a power of two, results
     sliced back) so replica sweeps reuse one executable per bucket.
+
+    ``schedulers=[...]`` (names from :data:`SM_SCHED_IDS`) turns the
+    call into a **config-axis sweep**: the scheduler id gains a leading
+    vmapped axis alongside the replica axis, so a C-point scheduler
+    study is ONE device launch of a (C, R, …) program; the return value
+    is a list of per-point result dicts, each exactly what the
+    per-point launch (same key) would have produced.
+
+    ``chunk_ttis=N`` splits the horizon into N-TTI while_loop segments
+    with the carry handed (donated) from segment to segment — results
+    are bit-identical to a single-shot run (per-TTI keys are
+    ``fold_in(key, t)``, indifferent to segment boundaries) while each
+    segment's summary metrics stream to ``tpudes.obs`` as the next
+    segment runs.
+
+    ``block=False`` returns an :class:`~tpudes.parallel.runtime.EngineFuture`
+    (the launch is dispatched; D2H + unpack happen at ``result()``) —
+    the :meth:`RUNTIME.submit` payload.
     """
-    from tpudes.parallel.runtime import RUNTIME, bucket_replicas, replica_keys
+    from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+    from tpudes.parallel.runtime import (
+        RUNTIME,
+        EngineFuture,
+        bucket_replicas,
+        chunk_bounds,
+        donate_argnums,
+        drive_chunks,
+        finalize_with_flush,
+        replica_keys,
+        shard_replica_axis,
+        stack_axis,
+        unstack_points,
+    )
 
     r_pad = bucket_replicas(replicas, mesh)
+    n_cfg = None if schedulers is None else len(schedulers)
+    obs = device_metrics_enabled()
 
     def build():
         consts, init_state, step_fn = build_sm_step(prog)
 
-        def run_one(k, sid, horizon):
+        def advance(carry, k, sid, t_end):
             # per-TTI key = fold_in(k, t): a pure function of (k, t),
             # so the traced horizon needs no key-array shape at all —
             # one executable serves every n_ttis (split(k, n_ttis)
-            # would bake the horizon into the program)
-            def body(carry):
-                t, s = carry
+            # would bake the horizon into the program), and a chunked
+            # run re-entering at t>0 draws the same per-TTI streams
+            def body(c):
+                t, s = c
                 kt = jax.random.fold_in(k, t)
                 return t + 1, step_fn(s, (t, kt), sid)
 
-            _, final = jax.lax.while_loop(
-                lambda c: c[0] < horizon,
-                body,
-                (jnp.int32(0), init_state()),
+            t, s = jax.lax.while_loop(
+                lambda c: c[0] < t_end, body, carry
             )
-            return final
+            # small per-chunk summaries (fresh buffers, NOT aliased to
+            # the carry — the next chunk donates the carry away); only
+            # under TpudesObs, so a disabled run compiles the exact
+            # pre-obs program
+            metrics = (
+                dict(
+                    ok=jnp.sum(s["ok_cnt"]), drops=jnp.sum(s["drops"]),
+                    retx=jnp.sum(s["retx"]),
+                )
+                if obs
+                else {}
+            )
+            return (t, s), metrics
 
-        if r_pad is None:
-            fn = jax.jit(run_one)
-        else:
-            fn = jax.jit(jax.vmap(run_one, in_axes=(0, None, None)))
-        return consts, fn
+        fn = advance
+        if r_pad is not None:
+            fn = jax.vmap(fn, in_axes=(0, 0, None, None))
+        if n_cfg is not None:
+            fn = jax.vmap(fn, in_axes=(0, None, 0, None))
+        fn = jax.jit(fn, donate_argnums=donate_argnums(0))
+        return consts, init_state, fn
 
-    (consts, fn), compiling = RUNTIME.runner(
-        "lte_sm", _sm_cache_key(prog, r_pad), build
+    (consts, init_state, fn), compiling = RUNTIME.runner(
+        "lte_sm", _sm_cache_key(prog, r_pad, n_cfg, obs), build
     )
 
-    from tpudes.obs.device import CompileTelemetry
+    sched_names = [prog.scheduler] if schedulers is None else list(schedulers)
+    sids = [SM_SCHED_IDS[s] for s in sched_names]
+    sid = (
+        jnp.int32(sids[0]) if n_cfg is None
+        else jnp.asarray(sids, jnp.int32)
+    )
+    if r_pad is None:
+        keys = key
+    else:
+        keys = shard_replica_axis(replica_keys(key, r_pad), mesh, r_pad, 0)
+    carry = (jnp.int32(0), init_state())
+    carry = stack_axis(carry, r_pad)
+    carry = stack_axis(carry, n_cfg)
+    carry = shard_replica_axis(
+        carry, mesh, r_pad, 0 if n_cfg is None else 1
+    )
 
-    sid = jnp.int32(SM_SCHED_IDS[prog.scheduler])
-    horizon = jnp.int32(prog.n_ttis)
     # scheduler id and horizon are traced, so a 9-scheduler sweep must
     # keep the recorded compile count at ONE — bench reports the metric
     with CompileTelemetry.timed("lte_sm", compiling):
-        if r_pad is not None:
-            keys = replica_keys(key, r_pad)
-            if mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
+        carry, flush = drive_chunks(
+            "lte_sm",
+            chunk_bounds(prog.n_ttis, chunk_ttis or prog.n_ttis),
+            carry,
+            lambda c, t_end: fn(c, keys, sid, jnp.int32(t_end)),
+            obs,
+        )
+        if compiling:
+            jax.block_until_ready(carry)
 
-                keys = jax.device_put(keys, NamedSharding(mesh, P("replica")))
-            out = fn(keys, sid, horizon)
-        else:
-            out = fn(key, sid, horizon)
-        out["rx_lo"].block_until_ready()
-    result = {k: np.asarray(v) for k, v in jax.device_get(out).items()
-              if k in ("rx_lo", "rx_hi", "new_tbs", "retx", "drops", "ok_cnt")}
-    if r_pad is not None and r_pad != replicas:
-        result = {k: v[:replicas] for k, v in result.items()}
-    result["rx_bits"] = (
-        result.pop("rx_hi").astype(np.int64) << 20
-    ) + result.pop("rx_lo").astype(np.int64)
-    result["ok"] = result.pop("ok_cnt")
-    result["cqi"] = np.asarray(consts["cqi"])
-    result["mcs"] = np.asarray(consts["mcs"])
-    result["sinr"] = np.asarray(consts["sinr"])
-    return result
+    fetch = {k: carry[1][k] for k in _SM_FETCH}
+    consts_np = {
+        "cqi": np.asarray(consts["cqi"]),
+        "mcs": np.asarray(consts["mcs"]),
+        "sinr": np.asarray(consts["sinr"]),
+    }
+    want = replicas if r_pad is not None else None
+    fut = EngineFuture(
+        "lte_sm",
+        fetch,
+        finalize_with_flush(
+            flush,
+            unstack_points(
+                n_cfg, lambda host: _sm_unpack(host, consts_np, want)
+            ),
+        ),
+    )
+    return fut.result() if block else fut

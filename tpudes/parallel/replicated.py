@@ -339,9 +339,14 @@ def _estimate_max_steps(prog: BssProgram) -> int:
 
 
 def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
-    """Return ``(init_state, cond_fn, step_fn, finalize)`` for the
-    vectorized event loop — exposed separately so the driver dryrun and
+    """Return ``(init_state, pending, step_fn)`` for the vectorized
+    event loop — exposed separately so the driver dryrun and
     benchmarks can jit/shard the pieces themselves.
+
+    ``step_fn(s, key, sim_end)`` / ``pending(s, sim_end)`` — the
+    simulation horizon ``sim_end`` (µs) is a RUNTIME operand, so one
+    compiled program serves every horizon and the config-axis sweep
+    vmaps a batch of horizons alongside the replica axis.
 
     ``obs=True`` (the ``TpudesObs`` knob) adds a cumulative per-replica
     retransmission counter to the carry; a disabled run compiles the
@@ -383,7 +388,6 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
     start0 = jnp.asarray(prog.start_us, dtype=jnp.int32)
     interval = jnp.asarray(prog.interval_us, dtype=jnp.int32)
     stop = jnp.asarray(prog.stop_us, dtype=jnp.int32)
-    sim_end = jnp.int32(prog.sim_end_us)
     is_ap = jnp.arange(n) == 0
 
     def init_state():
@@ -425,7 +429,7 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
         tx = jnp.maximum(tx, s["t"][:, None])  # never in the past
         return jnp.where(frame, tx, INF)
 
-    def step_fn(s, key):
+    def step_fn(s, key, sim_end):
         # per-replica keying: replica r's draws at step t are a pure
         # function of (key, t, r) — independent of R — so runtime
         # replica-bucketing (padding R to a power of two) leaves every
@@ -669,7 +673,7 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
             step=s["step"] + 1,
         )
 
-    def pending(s):
+    def pending(s, sim_end):
         tx_t = jnp.min(tx_times(s), axis=1)
         ta = jnp.min(s["next_arr"], axis=1)
         return (s["t"] < sim_end) & (jnp.minimum(ta, tx_t) < sim_end)
@@ -678,30 +682,33 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
 
 
 def _prog_cache_key(prog: BssProgram) -> tuple:
-    """Hashable identity of a BssProgram (ndarray fields → bytes)."""
+    """Hashable identity of a BssProgram (ndarray fields → bytes).
+    ``sim_end_us`` is deliberately ABSENT: the horizon is a traced
+    operand, so one executable serves every sim_end."""
     return tuple(
         v.tobytes() if isinstance(v, np.ndarray) else v
-        for v in prog.__dict__.values()
+        for k, v in prog.__dict__.items()
+        if k != "sim_end_us"
     )
 
 
-def _compiled_bss_runner(prog_key, prog, replicas, mesh, obs=False):
+def _compiled_bss_runner(prog_key, prog, replicas, mesh, obs=False, n_cfg=None):
     """Jitted runner via the shared :data:`~tpudes.parallel.runtime.RUNTIME`
     cache, keyed on (program, padded replicas) so a warm-up call
     actually warms subsequent timed calls (ADVICE r2 medium: a fresh
-    jax.jit wrapper per call re-traces every time).  ``max_steps`` is a
-    traced operand of the while_loop bound — a horizon sweep reuses ONE
-    executable — and the state carry is donated on accelerators.  The
-    runner itself is mesh-independent — sharding flows from the input
-    arrays and jax.jit specializes per input sharding internally — so
-    mesh is not part of the key.
+    jax.jit wrapper per call re-traces every time).  ``max_steps`` AND
+    ``sim_end`` are traced operands — a horizon sweep reuses ONE
+    executable — and the state carry is donated on accelerators.  With
+    ``n_cfg`` the runner is additionally vmapped over a leading
+    config axis of (state, sim_end) — a C-point horizon sweep is one
+    launch.  The runner itself is mesh-independent — sharding flows
+    from the input arrays and jax.jit specializes per input sharding
+    internally — so mesh is not part of the key.
 
     Returns ``(init_state, pending, run, compiled_new)`` —
     ``compiled_new`` tells the caller this call populated the cache (the
     compile-telemetry trigger), so the cache key is derived in exactly
     one place."""
-    import functools
-
     from tpudes.parallel.runtime import RUNTIME, donate_argnums
 
     del mesh
@@ -709,26 +716,59 @@ def _compiled_bss_runner(prog_key, prog, replicas, mesh, obs=False):
     def build():
         init_state, pending, step_fn = build_bss_step(prog, replicas, obs=obs)
 
-        @functools.partial(jax.jit, donate_argnums=donate_argnums(0))
-        def run(s, k, max_steps):
+        def advance(s, k, max_steps, sim_end):
             def cond(s):
                 return jnp.logical_and(
-                    s["step"] < max_steps, jnp.any(pending(s))
+                    s["step"] < max_steps, jnp.any(pending(s, sim_end))
                 )
 
-            out = jax.lax.while_loop(cond, lambda st: step_fn(st, k), s)
+            out = jax.lax.while_loop(
+                cond, lambda st: step_fn(st, k, sim_end), s
+            )
             # per-replica completion flags computed on-device so the
             # caller needs no second compiled program (each extra host
             # round trip costs ~90 ms over a tunneled TPU); a vector so
-            # padded replicas can be sliced off before the any()
-            return out, pending(out)
+            # padded replicas can be sliced off before the any().
+            # chunk metrics only under TpudesObs (obs is in the runner
+            # key) and as FRESH reductions only (drive_chunks's
+            # invariant: a carry leaf here would be deleted when the
+            # next chunk donates the carry)
+            metrics = (
+                dict(
+                    srv_rx=jnp.sum(out["srv_rx"]),
+                    drops=jnp.sum(out["drops"]),
+                )
+                if obs
+                else {}
+            )
+            return out, pending(out, sim_end), metrics
 
+        fn = advance
+        if n_cfg is not None:
+            fn = jax.vmap(fn, in_axes=(0, None, None, 0))
+        run = jax.jit(fn, donate_argnums=donate_argnums(0))
         return init_state, pending, run
 
     (init_state, pending, run), compiled_new = RUNTIME.runner(
-        "bss", (prog_key, replicas, obs), build
+        "bss", (prog_key, replicas, obs, n_cfg), build
     )
     return init_state, pending, run, compiled_new
+
+
+def _bss_unpack(host: dict, replicas: int, obs: bool) -> dict:
+    """Host-side result assembly for ONE config point."""
+    R = replicas
+    result = dict(
+        srv_rx=host["srv_rx"][:R],
+        cli_rx=host["cli_rx"][:R],
+        tx_data=host["tx_data"][:R],
+        drops=host["drops"][:R],
+        steps=int(host["step"]),
+        all_done=not bool(host["pending"][:R].any()),
+    )
+    if obs:
+        result["retx"] = host["retx"][:R]
+    return result
 
 
 def run_replicated_bss(
@@ -737,6 +777,10 @@ def run_replicated_bss(
     key: jax.Array,
     max_steps: int | None = None,
     mesh=None,
+    *,
+    sim_end_us=None,
+    chunk_steps: int | None = None,
+    block: bool = True,
 ):
     """Execute ``replicas`` Monte-Carlo replicas of the scenario.
 
@@ -752,12 +796,46 @@ def run_replicated_bss(
     replica axis of every state array is sharded over the mesh devices;
     the only cross-device traffic is the loop's any-replica-pending
     reduction (the LBTS-grant analog) and the final stats gather.
-    """
-    from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
-    from tpudes.parallel.runtime import bucket_replicas
 
+    ``sim_end_us=[...]`` runs a **config-axis horizon sweep**: the
+    sim-end bound gains a leading vmapped axis, so a C-point horizon
+    study is ONE launch of a (C, R, …) program; returns a list of
+    per-point result dicts whose OUTCOME fields equal the per-point
+    launch with ``dataclasses.replace(prog, sim_end_us=v)`` and the
+    same key.  (``steps`` is the exception: the sweep shares one step
+    budget and runs every point to the slowest point's bound — a
+    finished replica is a fixed point of step_fn, so the extra
+    iterations change nothing but the counter.)
+
+    ``chunk_steps=N`` splits the event loop into N-iteration segments
+    with a donated carry handoff (bit-identical: the loop condition
+    depends only on the carry).  ``block=False`` returns an
+    :class:`~tpudes.parallel.runtime.EngineFuture`.
+    """
+    import dataclasses
+
+    from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+    from tpudes.parallel.runtime import (
+        EngineFuture,
+        bucket_replicas,
+        chunk_bounds,
+        drive_chunks,
+        finalize_with_flush,
+        shard_replica_axis,
+        stack_axis,
+        unstack_points,
+    )
+
+    n_cfg = None if sim_end_us is None else len(sim_end_us)
+    ends = (
+        [prog.sim_end_us] if sim_end_us is None
+        else [int(v) for v in sim_end_us]
+    )
     if max_steps is None:
-        max_steps = _estimate_max_steps(prog)
+        max_steps = max(
+            _estimate_max_steps(dataclasses.replace(prog, sim_end_us=v))
+            for v in ends
+        )
     obs = device_metrics_enabled()
     # replica bucketing: pad R to the power-of-two bucket so a replica
     # sweep reuses one compiled program per bucket; padded replicas are
@@ -767,23 +845,33 @@ def run_replicated_bss(
     # iterations the padding may cause cannot corrupt real replicas)
     r_pad = bucket_replicas(replicas, mesh)
     init_state, pending, run, compiling = _compiled_bss_runner(
-        _prog_cache_key(prog), prog, r_pad, mesh, obs=obs
+        _prog_cache_key(prog), prog, r_pad, mesh, obs=obs, n_cfg=n_cfg
     )
 
-    s0 = init_state()
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        def shard(v):
-            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == r_pad:
-                spec = P("replica", *([None] * (v.ndim - 1)))
-                return jax.device_put(v, NamedSharding(mesh, spec))
-            return v
-
-        s0 = {k: shard(v) for k, v in s0.items()}
+    sim_end = (
+        jnp.int32(ends[0]) if n_cfg is None
+        else jnp.asarray(ends, jnp.int32)
+    )
+    s0 = stack_axis(init_state(), n_cfg)
+    s0 = shard_replica_axis(s0, mesh, r_pad, 0 if n_cfg is None else 1)
 
     with CompileTelemetry.timed("bss", compiling):
-        out, still_pending = run(s0, key, jnp.int32(max_steps))
+        def launch(carry, bound):
+            # chunking reuses the SAME executable: each segment raises
+            # the step bound; finished replicas are a fixed point of
+            # step_fn, so later segments cost one cond evaluation
+            state, still_pending, metrics = run(
+                carry[0], key, jnp.int32(bound), sim_end
+            )
+            return (state, still_pending), metrics
+
+        (out, still_pending), flush = drive_chunks(
+            "bss",
+            chunk_bounds(max_steps, chunk_steps or max_steps),
+            (s0, None),
+            launch,
+            obs,
+        )
         # one batched device→host transfer for every result (steps/
         # all_done ride along instead of costing their own round trips)
         fetch = dict(
@@ -796,16 +884,17 @@ def run_replicated_bss(
         )
         if obs:
             fetch["retx"] = out["retx"]
-        host = jax.device_get(fetch)
-    R = replicas
-    result = dict(
-        srv_rx=host["srv_rx"][:R],
-        cli_rx=host["cli_rx"][:R],
-        tx_data=host["tx_data"][:R],
-        drops=host["drops"][:R],
-        steps=int(host["step"]),
-        all_done=not bool(host["pending"][:R].any()),
+        if compiling:
+            jax.block_until_ready(fetch)
+
+    fut = EngineFuture(
+        "bss",
+        fetch,
+        finalize_with_flush(
+            flush,
+            unstack_points(
+                n_cfg, lambda host: _bss_unpack(host, replicas, obs)
+            ),
+        ),
     )
-    if obs:
-        result["retx"] = host["retx"][:R]
-    return result
+    return fut.result() if block else fut

@@ -44,6 +44,30 @@ conventions.  This module is the one runtime they all route through:
   same engines skips the XLA compiles entirely (the in-memory runner
   cache only ever amortized within one process).  Wired lazily on the
   first runner build; harmless no-op when the env var is unset.
+
+- **Async submission** (:meth:`EngineRuntime.submit` /
+  :class:`EngineFuture`): every ``run_*`` entry point takes
+  ``block=False`` and returns an :class:`EngineFuture` instead of
+  blocking — the device work is dispatched (jax's async dispatch) but
+  the D2H fetch and host-side unpack are deferred to ``result()``.
+  ``RUNTIME.submit(run_fn, *args, **kw)`` adds a **bounded in-flight
+  window** on top (``TPUDES_INFLIGHT``, default 4): submitting past the
+  window retires the oldest future first, so a heterogeneous sweep
+  (different buckets → different executables) keeps the device busy
+  while the host builds/unpacks other points instead of serializing on
+  a ``block_until_ready`` per point.  Telemetry (``submitted``,
+  ``retired``, ``max_in_flight``, per-engine ``launches``) rides
+  :meth:`EngineRuntime.stats` so pipelining is pinned by tests, not
+  assumed.
+
+- **Chunked horizons** (:func:`chunk_bounds`): a long horizon splits
+  into fixed-size ``while_loop`` segments; the engines hand the carry
+  from segment to segment (donated, so the state never copies) and
+  return a small per-chunk metrics tree that streams to
+  :class:`tpudes.obs.device.ChunkStream` while the *next* chunk runs.
+  Results are bit-identical to a single-shot run because every step's
+  randomness is ``fold_in(key, t)`` — pure in t, indifferent to where
+  the segment boundaries fall.
 """
 
 from __future__ import annotations
@@ -53,13 +77,21 @@ from collections import OrderedDict
 
 __all__ = [
     "RUNTIME",
+    "EngineFuture",
     "EngineRuntime",
     "bucket_replicas",
     "bucketing_enabled",
+    "chunk_bounds",
     "configure_persistent_cache",
     "donate_argnums",
+    "drive_chunks",
+    "finalize_with_flush",
+    "inflight_window",
     "pow2_bucket",
     "replica_keys",
+    "shard_replica_axis",
+    "stack_axis",
+    "unstack_points",
 ]
 
 
@@ -107,6 +139,135 @@ def replica_keys(key, n: int):
     return jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(n))
 
 
+def inflight_window() -> int:
+    """Bound on concurrently in-flight submitted runs
+    (``TPUDES_INFLIGHT``, default 4, floor 1; read per call so tests
+    can resize without re-importing)."""
+    raw = os.environ.get("TPUDES_INFLIGHT")
+    if not raw:
+        return 4
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return 4
+
+
+def chunk_bounds(total: int, chunk: int) -> list[int]:
+    """Segment end-bounds covering ``[0, total)`` in ``chunk``-sized
+    pieces: ``chunk_bounds(10, 4) == [4, 8, 10]``.  A non-positive or
+    oversized chunk degenerates to one segment."""
+    total = int(total)
+    chunk = int(chunk)
+    if chunk <= 0 or chunk >= total:
+        return [total]
+    return list(range(chunk, total, chunk)) + [total]
+
+
+def drive_chunks(engine: str, bounds, carry, launch, obs: bool):
+    """The one chunk-dispatch protocol every engine runs: one device
+    launch per bound; when observability is up AND the run is actually
+    chunked (>1 bound — a single-shot run has no chunk stream), chunk
+    k's metrics are fetched only after chunk k+1 is dispatched, so the
+    D2H overlaps the next segment's compute.  Returns ``(carry,
+    flush)``: the final carry plus a deferred thunk (or None) that
+    records the LAST chunk's metrics — the engines run it inside their
+    EngineFuture finalize, so a ``block=False`` caller's dispatch never
+    blocks on a metrics fetch.
+
+    ``launch(carry, bound) -> (carry', metrics)`` — INVARIANT: every
+    leaf of ``metrics`` must be a FRESH device value (a reduction or
+    other computed output), never a leaf of the returned carry: the
+    next launch donates the carry on accelerators, and a metrics tree
+    aliasing it would be deleted before the deferred fetch reads it.
+    """
+    import jax
+
+    from tpudes.obs.device import ChunkStream
+
+    stream = obs and len(bounds) > 1
+    prev = None
+    for bound in bounds:
+        carry, metrics = launch(carry, bound)
+        RUNTIME.record_launch(engine)
+        if stream:
+            if prev is not None:
+                ChunkStream.record(engine, prev[0], jax.device_get(prev[1]))
+            prev = (bound, metrics)
+    if not (stream and prev is not None):
+        return carry, None
+
+    def flush(last=prev):
+        ChunkStream.record(engine, last[0], jax.device_get(last[1]))
+
+    return carry, flush
+
+
+def finalize_with_flush(flush, finalize):
+    """Chain the deferred last-chunk metrics flush in front of an
+    EngineFuture finalize (identity when there is nothing to flush)."""
+    if flush is None:
+        return finalize
+
+    def wrapped(host):
+        flush()
+        return finalize(host)
+
+    return wrapped
+
+
+def unstack_points(n_cfg: int | None, unpack_one, shared=()):
+    """Build the EngineFuture ``finalize``: without a config axis the
+    fetched host tree unpacks directly; with one, each point's slice of
+    the leading axis unpacks separately (``shared`` names keys with no
+    config axis — per-flow statics identical across points)."""
+
+    def finalize(host):
+        if n_cfg is None:
+            return unpack_one(host)
+        return [
+            unpack_one(
+                {k: (v if k in shared else v[i]) for k, v in host.items()}
+            )
+            for i in range(n_cfg)
+        ]
+
+    return finalize
+
+
+def stack_axis(tree, n: int | None):
+    """Broadcast every leaf of ``tree`` to a new leading axis of size
+    ``n`` (None passes through) — how the engines stack the initial
+    carry over the replica and config axes."""
+    if n is None:
+        return tree
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (int(n),) + jnp.shape(x)), tree
+    )
+
+
+def shard_replica_axis(tree, mesh, r_pad: int | None, axis: int):
+    """device_put every leaf whose ``axis`` dimension equals ``r_pad``
+    with that dimension sharded over the mesh's "replica" axis (other
+    leaves pass through).  ``axis`` is 0 for plain runs, 1 when a
+    config axis leads."""
+    if mesh is None or r_pad is None:
+        return tree
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(v):
+        if getattr(v, "ndim", 0) > axis and v.shape[axis] == r_pad:
+            spec = P(*([None] * axis), "replica",
+                     *([None] * (v.ndim - axis - 1)))
+            return jax.device_put(v, NamedSharding(mesh, spec))
+        return v
+
+    return jax.tree_util.tree_map(put, tree)
+
+
 def donate_argnums(*argnums: int) -> tuple[int, ...]:
     """``argnums`` on accelerators, ``()`` on CPU (XLA:CPU does not
     implement buffer donation and logs a warning per donated call)."""
@@ -137,6 +298,66 @@ def configure_persistent_cache() -> str | None:
     return path
 
 
+class EngineFuture:
+    """Handle to one dispatched engine run (``run_* (..., block=False)``).
+
+    Holds the on-device output tree plus the engine's host-side
+    ``finalize`` (slice padded replicas, unstack config points, rebuild
+    wide counters).  The device work is already in flight when the
+    future is created; ``result()`` performs the deferred D2H transfer
+    and unpack exactly once."""
+
+    __slots__ = ("engine", "_device_out", "_finalize", "_result", "_done",
+                 "_runtime")
+
+    def __init__(self, engine: str, device_out, finalize):
+        self.engine = engine
+        self._device_out = device_out
+        self._finalize = finalize
+        self._result = None
+        self._done = False
+        self._runtime: "EngineRuntime | None" = None
+
+    def done(self) -> bool:
+        """True once the device work has finished (never blocks)."""
+        if self._done:
+            return True
+        import jax
+
+        return all(
+            leaf.is_ready()
+            for leaf in jax.tree_util.tree_leaves(self._device_out)
+            if hasattr(leaf, "is_ready")
+        )
+
+    def block(self) -> "EngineFuture":
+        """Wait for the device work without fetching/unpacking."""
+        if not self._done:
+            import jax
+
+            jax.block_until_ready(self._device_out)
+        return self
+
+    def result(self):
+        """Fetch (one batched D2H) + unpack; memoized.  Retires from
+        the runtime's in-flight window even when the fetch/unpack
+        raises — a poisoned future must not jam every later submit's
+        window-eviction loop (the caller may retry result(); the
+        device buffers are still held)."""
+        if not self._done:
+            import jax
+
+            try:
+                host = jax.device_get(self._device_out)
+                self._result = self._finalize(host)
+            finally:
+                if self._runtime is not None:
+                    self._runtime._retire(self)
+            self._device_out = None  # release the device buffers
+            self._done = True
+        return self._result
+
+
 class EngineRuntime:
     """Process-wide runner registry shared by all device engines.
 
@@ -151,6 +372,11 @@ class EngineRuntime:
         self.hits = 0
         self.misses = 0
         self._cache_wired = False
+        self._inflight: list[EngineFuture] = []
+        self.submitted = 0
+        self.retired = 0
+        self.max_in_flight = 0
+        self._launches: dict[str, int] = {}
 
     def runner(self, engine: str, key: tuple, build):
         """Return ``(value, compiled_new)``: the cached runner for
@@ -179,14 +405,67 @@ class EngineRuntime:
         return sum(1 for k in self._runners if k[0] == engine)
 
     def clear(self, engine: str | None = None) -> None:
-        """Drop cached runners (all, or one engine's)."""
+        """Drop cached runners (all, or one engine's).  A full clear
+        also zeroes the submit/launch telemetry — the test-isolation
+        reset (in-flight futures stay valid; they hold their own
+        buffers)."""
         if engine is None:
             self._runners.clear()
+            self.submitted = self.retired = self.max_in_flight = 0
+            self._inflight = []
+            self._launches = {}
             return
         for k in [k for k in self._runners if k[0] == engine]:
             # not a sim-time buffer: entries age out via the capacity
             # LRU in runner(), so no expiry event is ever needed
             del self._runners[k]  # tpudes: ignore[EVT003]
+
+    # --- async submission -------------------------------------------------
+
+    def submit(self, run_fn, *args, **kwargs) -> EngineFuture:
+        """Dispatch ``run_fn(*args, block=False, **kwargs)`` and track it
+        in the bounded in-flight window: at the window, the OLDEST
+        future is retired (D2H + unpack) BEFORE the new run is
+        dispatched — the window's other runs keep the device busy
+        through that wait, and an eviction error surfaces before this
+        submit has dispatched anything, so it can never orphan a
+        just-launched run's future.  Returns the new run's
+        :class:`EngineFuture`."""
+        window = inflight_window()
+        while len(self._inflight) >= window:
+            self._inflight[0].result()  # retires itself via _retire
+        fut = run_fn(*args, block=False, **kwargs)
+        if not isinstance(fut, EngineFuture):
+            raise TypeError(
+                f"{getattr(run_fn, '__name__', run_fn)!r} did not return "
+                "an EngineFuture under block=False — only the device "
+                "engines' run_* entry points are submittable"
+            )
+        fut._runtime = self
+        self._inflight.append(fut)
+        self.submitted += 1
+        self.max_in_flight = max(self.max_in_flight, len(self._inflight))
+        return fut
+
+    def _retire(self, fut: EngineFuture) -> None:
+        try:
+            self._inflight.remove(fut)
+        except ValueError:
+            return  # already retired (result() is memoized)
+        self.retired += 1
+
+    def drain(self) -> None:
+        """Retire every outstanding future (in submission order)."""
+        while self._inflight:
+            self._inflight[0].result()
+
+    def record_launch(self, engine: str, n: int = 1) -> None:
+        """Count one device dispatch — the sweep tests pin that an
+        8-point config-axis sweep is exactly ONE of these."""
+        self._launches[engine] = self._launches.get(engine, 0) + int(n)
+
+    def launches(self, engine: str) -> int:
+        return self._launches.get(engine, 0)
 
     def stats(self) -> dict:
         """Hit/miss counters plus per-engine residency — bench fodder."""
@@ -198,6 +477,11 @@ class EngineRuntime:
             "misses": self.misses,
             "resident": len(self._runners),
             "per_engine": per_engine,
+            "submitted": self.submitted,
+            "retired": self.retired,
+            "in_flight": len(self._inflight),
+            "max_in_flight": self.max_in_flight,
+            "launches": dict(self._launches),
         }
 
 

@@ -684,6 +684,12 @@ OBS_QHIST_BINS = 16
 def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False):
     """Return (init_state, step_fn) for the slot-stepped scan.
 
+    ``step_fn(s, (t, key), var, ecn_cap)`` — the per-flow variant ids
+    ``var`` (F,) and ECN-capability flags ``ecn_cap`` (F,) are RUNTIME
+    operands, not trace-time constants: every variant assignment rides
+    one compiled executable, and the config-axis sweep vmaps them
+    alongside the replica axis.
+
     ``obs=True`` (the ``TpudesObs`` knob at run time) threads three
     extra accumulators through the carry — per-lane cwnd-cut events,
     retransmissions (losses consumed by the dupack-timed detector), and
@@ -691,7 +697,6 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
     disabled run compiles the exact pre-obs program.
     """
     R, F, L = replicas, prog.n_flows, prog.buf_len
-    var = jnp.asarray(prog.variant_idx)
     start = jnp.asarray(prog.start_slot)
     stop = jnp.asarray(prog.stop_slot)
     max_pkts = jnp.asarray(prog.max_pkts)
@@ -701,11 +706,6 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
     Q = prog.queue_cap
     burst = prog.burst_cap
     RED = prog.qdisc == "red"
-    ecn_cap = jnp.asarray(
-        prog.ecn
-        if prog.ecn is not None
-        else np.zeros(prog.n_flows, bool)
-    )
 
     def init_state():
         z = lambda *sh, dt=jnp.float32: jnp.zeros(sh, dt)  # noqa: E731
@@ -758,7 +758,7 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
             ),
         )
 
-    def step_fn(s, inp):
+    def step_fn(s, inp, var, ecn_cap):
         t, key = inp
         idx = t % L
 
@@ -974,86 +974,198 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
     return init_state, step_fn
 
 
-def run_tcp_dumbbell(prog: DumbbellProgram, key, replicas: int, mesh=None):
+def _variant_point(entry) -> np.ndarray:
+    """One sweep point → (F,) int32 variant ids (names or ids in)."""
+    return np.asarray(
+        [VARIANTS.index(v) if isinstance(v, str) else int(v) for v in entry],
+        np.int32,
+    )
+
+
+def _variant_ecn(variant_idx: np.ndarray) -> np.ndarray:
+    """(F,) ECN capability implied by the variant alone (the
+    ``REQUIRES_ECN`` class flag, e.g. DCTCP) — what a sweep point that
+    reassigns variants can know without a live socket's UseEcn
+    attribute."""
+    from tpudes.models.internet.tcp_congestion import TCP_VARIANTS
+
+    return np.asarray(
+        [
+            bool(getattr(TCP_VARIANTS[VARIANTS[int(i)]], "REQUIRES_ECN", False))
+            for i in variant_idx
+        ],
+        bool,
+    )
+
+
+#: state keys fetched to the host at run end (plus the obs extras)
+_TCP_FETCH = ("delivered", "drops", "qsum", "cwnd")
+_TCP_FETCH_OBS = ("cwnd_cuts", "retx_cnt", "q_hist")
+
+
+def _tcp_unpack(host: dict, prog: DumbbellProgram, replicas: int,
+                obs: bool) -> dict:
+    """Host-side result assembly for ONE config point."""
+    sim_s = prog.n_slots * prog.slot_s
+    R = replicas
+    delivered = np.asarray(host["delivered"])[:R]
+    result = dict(
+        goodput_mbps=delivered.astype(np.float32) * prog.seg_bytes * 8.0
+        / sim_s / 1e6,
+        delivered=delivered,
+        drops=np.asarray(host["drops"])[:R],
+        mean_queue=np.asarray(host["qsum"])[:R] / prog.n_slots,
+        cwnd_final=np.asarray(host["cwnd"])[:R],
+    )
+    if obs:
+        result.update(
+            cwnd_cuts=np.asarray(host["cwnd_cuts"])[:R],
+            retx=np.asarray(host["retx_cnt"])[:R],
+            queue_hist=np.asarray(host["q_hist"])[:R],
+        )
+    return result
+
+
+def run_tcp_dumbbell(
+    prog: DumbbellProgram,
+    key,
+    replicas: int,
+    mesh=None,
+    *,
+    variants=None,
+    chunk_slots: int | None = None,
+    block: bool = True,
+):
     """Execute R replicas of the dumbbell program; returns per-replica
     outcome arrays: goodput_mbps (R,F), delivered (R,F), drops (R,F),
     mean_queue (R,), cwnd_final (R,F) — plus, under ``TpudesObs=1``,
     the on-device metric accumulators ``cwnd_cuts`` (R,F), ``retx``
-    (R,F) and ``queue_hist`` (R, OBS_QHIST_BINS).  The slot horizon is
-    a traced operand and the replica axis is runtime-bucketed, so
-    horizon/replica sweeps reuse one executable per replica bucket."""
-    import functools
+    (R,F) and ``queue_hist`` (R, OBS_QHIST_BINS).  The slot horizon AND
+    the per-flow variant/ECN assignments are traced operands and the
+    replica axis is runtime-bucketed, so horizon/variant/replica sweeps
+    all reuse one executable per replica bucket.
 
+    ``variants=[point, ...]`` (each point an (F,)-sequence of variant
+    names or ids) runs a **config-axis sweep**: one launch of a
+    (C, R, F) program, returning a list of per-point result dicts equal
+    to what ``dataclasses.replace(prog, variant_idx=point,
+    ecn=REQUIRES_ECN(point))`` per-point launches (same key) produce.
+
+    ``chunk_slots=N`` splits the horizon into N-slot segments with a
+    donated carry handoff (bit-identical to single-shot; per-chunk
+    metrics stream to ``tpudes.obs``).  ``block=False`` returns an
+    :class:`~tpudes.parallel.runtime.EngineFuture`.
+    """
     from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
     from tpudes.parallel.runtime import (
         RUNTIME,
+        EngineFuture,
         bucket_replicas,
+        chunk_bounds,
         donate_argnums,
+        drive_chunks,
+        finalize_with_flush,
+        shard_replica_axis,
+        stack_axis,
+        unstack_points,
     )
 
     obs = device_metrics_enabled()
     r_pad = bucket_replicas(replicas, mesh)
-    # n_slots is deliberately ABSENT from the key: the horizon is a
-    # traced while_loop bound, so one executable serves every n_slots
+    n_cfg = None if variants is None else len(variants)
+    # n_slots, variant_idx and ecn are deliberately ABSENT from the
+    # key: the horizon is a traced while_loop bound and the variant/ECN
+    # assignment a traced operand, so one executable serves every
+    # horizon AND every variant assignment
     ck = tuple(
         v.tobytes() if isinstance(v, np.ndarray) else v
         for k, v in prog.__dict__.items()
-        if k != "n_slots"
-    ) + (r_pad, obs)
+        if k not in ("n_slots", "variant_idx", "ecn")
+    ) + (r_pad, obs, n_cfg)
 
     def build():
         init_state, step_fn = build_dumbbell_step(prog, r_pad, obs=obs)
 
-        @functools.partial(jax.jit, donate_argnums=donate_argnums(0))
-        def run(s0, key, horizon):
+        def advance(carry, key, var, ecn, t_end):
             # per-slot key = fold_in(key, t): pure in (key, t), so the
-            # traced horizon needs no split-keys array shape
-            def body(carry):
-                t, s = carry
-                s, _ = step_fn(s, (t, jax.random.fold_in(key, t)))
+            # traced horizon needs no split-keys array shape and a
+            # chunked run re-enters at t>0 on the same slot streams
+            def body(c):
+                t, s = c
+                s, _ = step_fn(
+                    s, (t, jax.random.fold_in(key, t)), var, ecn
+                )
                 return t + 1, s
 
-            _, out = jax.lax.while_loop(
-                lambda c: c[0] < horizon, body, (jnp.int32(0), s0)
+            t, s = jax.lax.while_loop(
+                lambda c: c[0] < t_end, body, carry
             )
-            return out
+            # chunk summaries only under TpudesObs (obs is in the
+            # cache key): a disabled run compiles the pre-obs program
+            metrics = (
+                dict(
+                    delivered=jnp.sum(s["delivered"], axis=-1),
+                    drops=jnp.sum(s["drops"], axis=-1),
+                )
+                if obs
+                else {}
+            )
+            return (t, s), metrics
 
-        return init_state, run
+        fn = advance
+        if n_cfg is not None:
+            fn = jax.vmap(fn, in_axes=(0, None, 0, 0, None))
+        fn = jax.jit(fn, donate_argnums=donate_argnums(0))
+        return init_state, fn
 
-    (init_state, run), compiling = RUNTIME.runner("dumbbell", ck, build)
+    (init_state, fn), compiling = RUNTIME.runner("dumbbell", ck, build)
 
-    s0 = init_state()
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    if variants is None:
+        points = [np.asarray(prog.variant_idx, np.int32)]
+        ecns = [
+            np.asarray(prog.ecn, bool)
+            if prog.ecn is not None
+            else np.zeros(prog.n_flows, bool)
+        ]
+    else:
+        points = [_variant_point(p) for p in variants]
+        ecns = [_variant_ecn(p) for p in points]
+        for p in points:
+            if p.shape != (prog.n_flows,):
+                raise ValueError(
+                    f"each sweep point assigns all {prog.n_flows} flows "
+                    f"(got shape {p.shape})"
+                )
+    var = jnp.asarray(points[0] if n_cfg is None else np.stack(points))
+    ecn = jnp.asarray(ecns[0] if n_cfg is None else np.stack(ecns))
 
-        def shard(v):
-            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == r_pad:
-                spec = P("replica", *([None] * (v.ndim - 1)))
-                return jax.device_put(v, NamedSharding(mesh, spec))
-            return v
+    carry = (jnp.int32(0), init_state())
+    carry = stack_axis(carry, n_cfg)
+    carry = shard_replica_axis(
+        carry, mesh, r_pad, 0 if n_cfg is None else 1
+    )
 
-        s0 = jax.tree_util.tree_map(shard, s0)
     with CompileTelemetry.timed("dumbbell", compiling):
-        out = run(s0, key, jnp.int32(prog.n_slots))
-        if compiling:
-            jax.block_until_ready(out)
-    sim_s = prog.n_slots * prog.slot_s
-    goodput = (
-        out["delivered"].astype(jnp.float32) * prog.seg_bytes * 8.0
-        / sim_s / 1e6
-    )
-    R = replicas
-    result = dict(
-        goodput_mbps=goodput[:R],
-        delivered=out["delivered"][:R],
-        drops=out["drops"][:R],
-        mean_queue=out["qsum"][:R] / prog.n_slots,
-        cwnd_final=out["cwnd"][:R],
-    )
-    if obs:
-        result.update(
-            cwnd_cuts=out["cwnd_cuts"][:R],
-            retx=out["retx_cnt"][:R],
-            queue_hist=out["q_hist"][:R],
+        carry, flush = drive_chunks(
+            "dumbbell",
+            chunk_bounds(prog.n_slots, chunk_slots or prog.n_slots),
+            carry,
+            lambda c, t_end: fn(c, key, var, ecn, jnp.int32(t_end)),
+            obs,
         )
-    return result
+        if compiling:
+            jax.block_until_ready(carry)
+
+    keys = _TCP_FETCH + (_TCP_FETCH_OBS if obs else ())
+    fetch = {k: carry[1][k] for k in keys}
+    fut = EngineFuture(
+        "dumbbell",
+        fetch,
+        finalize_with_flush(
+            flush,
+            unstack_points(
+                n_cfg, lambda host: _tcp_unpack(host, prog, replicas, obs)
+            ),
+        ),
+    )
+    return fut.result() if block else fut
