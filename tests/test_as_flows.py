@@ -59,6 +59,27 @@ def test_generator_is_seed_deterministic():
     assert not np.array_equal(a.edges, c.edges)
 
 
+def test_generator_rides_the_seeded_stream_api():
+    """Promoted RNG002 regression: the topology draws are keyed by the
+    global (RngSeed, RngRun) pair — selecting a different RngRun
+    re-randomizes the graph (a bare np.random.default_rng(seed) could
+    never see it), while the same (seed, run) reproduces it exactly."""
+    from tpudes.core.rng import RngSeedManager
+
+    run0 = RngSeedManager.GetRun()
+    try:
+        a = BriteTopologyHelper(model="BA", n=200, m=2, seed=5).Generate()
+        RngSeedManager.SetRun(run0 + 7)
+        b = BriteTopologyHelper(model="BA", n=200, m=2, seed=5).Generate()
+        RngSeedManager.SetRun(run0)
+        c = BriteTopologyHelper(model="BA", n=200, m=2, seed=5).Generate()
+    finally:
+        RngSeedManager.SetRun(run0)
+    assert not np.array_equal(a.edges, b.edges)
+    np.testing.assert_array_equal(a.edges, c.edges)
+    np.testing.assert_array_equal(a.pos, c.pos)
+
+
 # ---------------------------------------------------------------- device SPF
 def _dijkstra(n, edges, w, dst):
     """float64 host oracle (hop metric when w=1)."""

@@ -274,6 +274,47 @@ def test_hysteresis_blocks_marginal_neighbors():
     assert lte.controller.stats["handovers"] == 0
 
 
+def test_a3_pending_entries_expire_when_measurements_stop():
+    """Promoted EVT003 regression: a (ue, target) entry whose UE stops
+    being measured (detach / controller teardown) must be swept by the
+    algorithm's scheduled expiry instead of leaking forever.  A live A3
+    condition is re-confirmed every measurement period, so only
+    abandoned entries can age past the lapse window."""
+    from tpudes.models.lte.handover import A3RsrpHandoverAlgorithm
+
+    algo = A3RsrpHandoverAlgorithm(TimeToTrigger=256)
+    # enter the pending dict at t=0: neighbour 5 dB above serving
+    assert algo.evaluate(0, 0, 0, [10.0, 15.0]) is None
+    assert (0, 1) in algo._entered
+    # the UE vanishes (no further evaluate calls) — run past the lapse
+    Simulator.Stop(MilliSeconds(4 * (256 + 80)))
+    Simulator.Run()
+    assert algo._entered == {}
+
+
+def test_a3_sweep_keeps_live_entries():
+    """The expiry sweep must NOT touch an entry that keeps being
+    re-confirmed every measurement period (the sweep fires mid-run,
+    between confirmations, and must leave the live entry alone)."""
+    from tpudes.models.lte.handover import (
+        MEASUREMENT_PERIOD_TTIS,
+        A3RsrpHandoverAlgorithm,
+    )
+
+    algo = A3RsrpHandoverAlgorithm(TimeToTrigger=1000)
+    row = [10.0, 15.0]
+    for t in range(0, 2001, MEASUREMENT_PERIOD_TTIS):
+        Simulator.Schedule(
+            MilliSeconds(t), lambda t=t: algo.evaluate(t, 0, 0, row)
+        )
+    # the sweep (lapse = 2 periods + TTT = 1080 ms) fires at least once
+    # inside this horizon while confirmations keep arriving
+    Simulator.Stop(MilliSeconds(2001))
+    Simulator.Run()
+    assert (0, 1) in algo._entered
+    assert algo._entered[(0, 1)][1] == 2000
+
+
 # --- EPC with a true remote host -------------------------------------------
 def test_remote_host_traffic_through_backhaul_and_pgw():
     """lena-simple-epc shape: remote host → p2p backhaul → PGW → DL

@@ -1,0 +1,288 @@
+"""ISSUE-6 gates: the fused Pallas LTE TTI kernel chain.
+
+- **One math core, two lowerings**: ``TPUDES_PALLAS=1`` (the Pallas
+  kernel, interpret-mode on CPU — the exact body Mosaic compiles on
+  TPU) and ``=0`` (the plain XLA lowering) are BIT-identical for every
+  scheduler id, under bucketing on and off, and across the 8-point
+  config-axis scheduler sweep.
+- **Flags are cache-key components**: flipping the kill switch or the
+  precision mode compiles a distinct runner — never reuses a stale
+  executable for different arithmetic.
+- **Mixed precision**: the bf16 mode sweeps with ≤1 compile and one
+  launch (the CI multi-device smoke rides this), stays within the
+  engine-level throughput budget of the f32 mode, and holds the same
+  HARQ conservation laws.
+- **Per-stage profile harness**: profile_sm_stages times every stage
+  of the chain and records to obs.KernelProfile.
+- **lower_lte_sm horizon warning**: the compile-amortization boundary
+  (COMPILE_AMORTIZE_TTIS) warns below the line, not at it.
+"""
+
+import dataclasses
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from tpudes.obs.device import CompileTelemetry, KernelProfile
+from tpudes.parallel.kernels_pallas import (
+    build_sm_consts,
+    build_sm_step_fn,
+    pallas_enabled,
+    sm_init_state,
+)
+from tpudes.parallel.lte_sm import SM_SCHED_IDS, run_lte_sm
+from tpudes.parallel.programs import toy_lte_program
+from tpudes.parallel.runtime import RUNTIME
+
+KEY = jax.random.PRNGKey(11)
+
+OUT_KEYS = ("rx_bits", "ok", "new_tbs", "retx", "drops")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_runtime():
+    RUNTIME.clear()
+    yield
+    RUNTIME.clear()
+
+
+def _prog(**kw):
+    kw.setdefault("n_enb", 2)
+    kw.setdefault("n_ue", 6)
+    kw.setdefault("n_ttis", 150)
+    return toy_lte_program(**kw)
+
+
+def _assert_same(a, b, msg=""):
+    for k in OUT_KEYS:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=f"{msg}: {k}")
+
+
+def test_pallas_knob_default_on_and_kill_switch(monkeypatch):
+    monkeypatch.delenv("TPUDES_PALLAS", raising=False)
+    assert pallas_enabled()
+    for off in ("0", "false", "no", "OFF"):
+        monkeypatch.setenv("TPUDES_PALLAS", off)
+        assert not pallas_enabled()
+    monkeypatch.setenv("TPUDES_PALLAS", "1")
+    assert pallas_enabled()
+
+
+@pytest.mark.parametrize("sched", list(SM_SCHED_IDS))
+def test_interpret_mode_bit_parity_every_scheduler(monkeypatch, sched):
+    """The Pallas kernel (interpret on CPU) and the XLA fallback run the
+    SAME math core: bit equality per scheduler id."""
+    prog = _prog(scheduler=sched)
+    monkeypatch.setenv("TPUDES_PALLAS", "1")
+    on = run_lte_sm(prog, KEY)
+    monkeypatch.setenv("TPUDES_PALLAS", "0")
+    off = run_lte_sm(prog, KEY)
+    _assert_same(on, off, sched)
+
+
+def test_step_fn_bit_parity_at_kernel_level():
+    """Below the engine: one fused step, both lowerings, same state in,
+    bit-identical state out (including the f32 accumulators)."""
+    prog = _prog()
+    consts = build_sm_consts(prog)
+    s = sm_init_state(prog.n_enb, prog.n_ue)
+    coin = jax.random.uniform(KEY, (prog.n_ue,))[None, :]
+    sid = jax.numpy.int32(0)
+    for t in range(3):
+        t_j = jax.numpy.int32(t)
+        s_p = build_sm_step_fn(consts, True)(s, coin, t_j, sid)
+        s_x = build_sm_step_fn(consts, False)(s, coin, t_j, sid)
+        for k in s_p:
+            np.testing.assert_array_equal(
+                np.asarray(s_p[k]), np.asarray(s_x[k]), err_msg=k
+            )
+        s = s_p
+
+
+@pytest.mark.parametrize("bucketing", ["1", "0"])
+def test_ab_equality_under_bucketing(monkeypatch, bucketing):
+    """TPUDES_PALLAS=0 A/B equality composed with the replica-axis
+    bucketing knob: 3 replicas pad to 4 (or not at all) identically in
+    both kernel modes."""
+    monkeypatch.setenv("TPUDES_BUCKETING", bucketing)
+    prog = _prog()
+    monkeypatch.setenv("TPUDES_PALLAS", "1")
+    on = run_lte_sm(prog, KEY, replicas=3)
+    monkeypatch.setenv("TPUDES_PALLAS", "0")
+    off = run_lte_sm(prog, KEY, replicas=3)
+    assert on["rx_bits"].shape == (3, prog.n_ue)
+    _assert_same(on, off, f"bucketing={bucketing}")
+
+
+def test_ab_equality_8_point_scheduler_sweep(monkeypatch):
+    """The config-axis megabatch sweeps identically through both
+    lowerings — point by point, bit for bit."""
+    prog = _prog()
+    scheds = list(SM_SCHED_IDS)[:8]
+    monkeypatch.setenv("TPUDES_PALLAS", "1")
+    on = run_lte_sm(prog, KEY, replicas=2, schedulers=scheds)
+    monkeypatch.setenv("TPUDES_PALLAS", "0")
+    off = run_lte_sm(prog, KEY, replicas=2, schedulers=scheds)
+    assert len(on) == len(off) == 8
+    for s, a, b in zip(scheds, on, off):
+        _assert_same(a, b, s)
+
+
+def test_pallas_flag_is_a_cache_key_component(monkeypatch):
+    """Flipping the kill switch compiles a SECOND runner instead of
+    reusing the other mode's executable (stale-arithmetic hazard)."""
+    prog = _prog(n_ttis=40)
+    monkeypatch.setenv("TPUDES_PALLAS", "1")
+    run_lte_sm(prog, KEY)
+    assert RUNTIME.size("lte_sm") == 1
+    monkeypatch.setenv("TPUDES_PALLAS", "0")
+    run_lte_sm(prog, KEY)
+    assert RUNTIME.size("lte_sm") == 2
+    # and back: a cache HIT, not a third entry
+    monkeypatch.setenv("TPUDES_PALLAS", "1")
+    run_lte_sm(prog, KEY)
+    assert RUNTIME.size("lte_sm") == 2
+
+
+def test_precision_is_a_cache_key_component():
+    prog = _prog(n_ttis=40)
+    run_lte_sm(prog, KEY)
+    run_lte_sm(dataclasses.replace(prog, precision="bf16"), KEY)
+    assert RUNTIME.size("lte_sm") == 2
+
+
+def test_invalid_precision_refused():
+    with pytest.raises(ValueError, match="precision"):
+        run_lte_sm(dataclasses.replace(_prog(), precision="f16"), KEY)
+
+
+# --- mixed precision ---------------------------------------------------
+
+
+def test_bf16_sweep_one_launch_one_compile():
+    """The CI mixed-precision smoke as a test: an 8-point scheduler
+    sweep at bf16 is ONE launch paying at most ONE fresh compile."""
+    prog = dataclasses.replace(_prog(), precision="bf16")
+    c0 = CompileTelemetry.compiles("lte_sm")
+    results = run_lte_sm(
+        prog, KEY, replicas=2, schedulers=list(SM_SCHED_IDS)[:8]
+    )
+    assert RUNTIME.launches("lte_sm") == 1
+    assert CompileTelemetry.compiles("lte_sm") - c0 <= 1
+    assert len(results) == 8
+
+
+def test_bf16_engine_outcome_within_budget():
+    """Engine-level budget: bf16 rounds the SINR/metric/BLER chain but
+    the aggregate served traffic stays within a few percent of f32, and
+    the HARQ conservation law holds unchanged."""
+    prog = _prog(n_ue=8, n_ttis=400)
+    f32 = run_lte_sm(prog, KEY, replicas=4)
+    bf16 = run_lte_sm(
+        dataclasses.replace(prog, precision="bf16"), KEY, replicas=4
+    )
+    a = float(f32["rx_bits"].sum())
+    b = float(bf16["rx_bits"].sum())
+    assert b == pytest.approx(a, rel=0.10), (a, b)
+    # conservation: decoded + dropped never exceeds transmissions
+    assert (
+        bf16["ok"] + bf16["drops"] <= bf16["new_tbs"] + bf16["retx"]
+    ).all()
+
+
+def test_bf16_and_f32_share_no_executable(monkeypatch):
+    """bf16 arithmetic must be a different program in BOTH kernel
+    modes (precision × pallas = 4 distinct runners)."""
+    prog = _prog(n_ttis=40)
+    for pallas in ("1", "0"):
+        monkeypatch.setenv("TPUDES_PALLAS", pallas)
+        for precision in ("f32", "bf16"):
+            run_lte_sm(
+                dataclasses.replace(prog, precision=precision), KEY
+            )
+    assert RUNTIME.size("lte_sm") == 4
+
+
+# --- per-stage profile harness ----------------------------------------
+
+
+def test_profile_sm_stages_records_every_stage():
+    from tpudes.parallel.kernels_pallas import profile_sm_stages
+
+    KernelProfile.reset()
+    out = profile_sm_stages(_prog(), replicas=2, iters=2, warm_ttis=4)
+    expect = {
+        "coin_prng", "admit_retx", "sched_dispatch", "sinr_cqi_harq",
+        "harq_update", "fused_step",
+    }
+    assert expect <= set(out)
+    # measured programs are strictly positive; the marginal deltas are
+    # clamped at 0 (separately compiled prefixes can fuse differently)
+    assert out["coin_prng"] > 0 and out["admit_retx"] > 0
+    assert out["fused_step"] > 0
+    assert all(out[k] >= 0.0 for k in expect)
+    assert out["pallas"] == pallas_enabled()
+    recorded = KernelProfile.stages("lte_sm")
+    assert expect <= set(recorded)
+    snap = KernelProfile.snapshot()["lte_sm"]
+    assert snap["fused_step"]["batch"] == 2
+
+
+# --- the lower_lte_sm compile-amortization warning ---------------------
+
+
+def _helper_scenario():
+    import math as _math
+
+    from tpudes.helper.containers import NodeContainer
+    from tpudes.models.lte import LteHelper
+    from tpudes.models.mobility import (
+        ListPositionAllocator,
+        MobilityHelper,
+        Vector,
+    )
+
+    lte = LteHelper()
+    enbs = NodeContainer()
+    enbs.Create(1)
+    ues = NodeContainer()
+    ues.Create(2)
+    ea = ListPositionAllocator()
+    ea.Add(Vector(0.0, 0.0, 30.0))
+    me = MobilityHelper()
+    me.SetPositionAllocator(ea)
+    me.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    me.Install(enbs)
+    ua = ListPositionAllocator()
+    for i in range(2):
+        ua.Add(Vector(50.0 * _math.cos(i), 50.0 * _math.sin(i), 1.5))
+    mu = MobilityHelper()
+    mu.SetPositionAllocator(ua)
+    mu.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    mu.Install(ues)
+    lte.InstallEnbDevice(enbs)
+    devs = lte.InstallUeDevice(ues)
+    ue_list = [devs.Get(i) for i in range(devs.GetN())]
+    lte.Attach(ue_list)
+    lte.ActivateDataRadioBearer(ue_list)
+    return lte
+
+
+def test_lower_warns_below_compile_amortization_horizon():
+    from tpudes.parallel.lte_sm import COMPILE_AMORTIZE_TTIS, lower_lte_sm
+
+    lte = _helper_scenario()
+    with pytest.warns(UserWarning, match="one-time XLA compile"):
+        lower_lte_sm(lte, (COMPILE_AMORTIZE_TTIS - 1) / 1000.0)
+
+
+def test_lower_silent_at_the_boundary():
+    from tpudes.parallel.lte_sm import COMPILE_AMORTIZE_TTIS, lower_lte_sm
+
+    lte = _helper_scenario()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        prog = lower_lte_sm(lte, COMPILE_AMORTIZE_TTIS / 1000.0)
+    assert prog.n_ttis == COMPILE_AMORTIZE_TTIS

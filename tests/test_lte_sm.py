@@ -449,6 +449,35 @@ class TestHostDeviceParity:
             err_msg=f"{sched}: shares {dev_share} vs host {host_share}",
         )
 
+    def test_sm_engine_matches_host_under_bf16(self):
+        """ISSUE-6 budget pin: the bf16 mixed-precision mode must hold
+        the SAME host-parity tolerances as f32 — the precision knob
+        buys speed, not a different simulator."""
+        import jax
+
+        from tpudes.core.nstime import Seconds
+        from tpudes.core.simulator import Simulator
+
+        sim_time = 0.4
+        lte, _ = _build_helper_scenario(n_enbs=2, ues_per_cell=3)
+        prog = lower_lte_sm(lte, sim_time, precision="bf16")
+        assert prog.precision == "bf16"
+
+        Simulator.Stop(Seconds(sim_time))
+        Simulator.Run()
+        host_bits = sum(
+            s["dl_rx_bytes"] for s in lte.GetRlcStats()
+        ) * 8
+
+        out = run_lte_sm(prog, jax.random.PRNGKey(11))
+        assert int(out["rx_bits"].sum()) == pytest.approx(
+            host_bits, rel=0.15
+        )
+        # the bf16-rounded CQI still matches the host's f32 steady
+        # state away from efficiency boundaries: allow ±1 index
+        host_cqi = np.asarray(lte.controller._cqi_dl)
+        assert np.abs(out["cqi"].astype(int) - host_cqi).max() <= 1
+
     def test_sm_engine_cqi_matches_host(self):
         """Static full-buffer geometry: the device engine's precomputed
         CQI equals the host controller's steady-state applied CQI."""

@@ -1,7 +1,10 @@
-"""lte_tti_sinr memory-shape regression: the dense (E, U, RB)
-intermediate was materialized because the serving-signal
-``take_along_axis`` was a SECOND consumer of it — the fix gathers the
-serving term directly and contracts the total over E with one einsum.
+"""lte_tti_sinr memory-shape regression + the ISSUE-6 mixed-precision
+error budget.
+
+Memory shape: the dense (E, U, RB) intermediate was materialized
+because the serving-signal ``take_along_axis`` was a SECOND consumer of
+it — the fix gathers the serving term directly and contracts the total
+over E with one einsum.
 
 Exactness contract (why not plain ``assert_array_equal`` on the whole
 kernel): XLA fuses the old form's broadcast-multiply into its reduce
@@ -122,4 +125,116 @@ def test_peak_memory_has_no_dense_intermediate():
     assert analysis.temp_size_in_bytes < dense_bytes, (
         f"temp allocation {analysis.temp_size_in_bytes} B suggests the "
         f"(E,U,RB) intermediate ({dense_bytes} B) is back"
+    )
+
+
+# --- ISSUE-6: the bf16/f32 mixed-precision error budget -----------------
+#
+# Policy (tpudes/parallel/kernels_pallas.py): PRODUCTS and ratios at
+# bf16, every REDUCTION/accumulator and transcendental at f32.  bf16
+# keeps f32's 8-bit exponent (the 1e-18 W/Hz PSDs and 1e-12 gains stay
+# representable — f16 would flush them to zero) and pays 8 mantissa
+# bits, so the budget below is a handful of 2^-8 relative steps.
+
+BF16_EPS = 2.0 ** -8  # half-ulp at 1.0
+
+
+def test_lte_tti_sinr_bf16_relative_budget():
+    """The mixed-precision SINR stays within a few bf16 ulps of the f32
+    kernel — products rounded, einsum still f32-accumulating."""
+    for seed, shape in ((0, (7, 210, 100)), (3, (3, 24, 25))):
+        tx_psd, gain, serving, noise = _scenario(*shape, seed=seed)
+        f32 = np.asarray(
+            jax.jit(lte_tti_sinr, static_argnums=3)(
+                tx_psd, gain, serving, noise
+            )
+        )
+        bf16 = np.asarray(
+            jax.jit(
+                lambda a, b, c: lte_tti_sinr(
+                    a, b, c, noise, dtype=jnp.bfloat16
+                )
+            )(tx_psd, gain, serving)
+        )
+        rel = np.abs(bf16 - f32) / np.maximum(np.abs(f32), 1e-30)
+        assert rel.max() <= 8 * BF16_EPS, (
+            f"seed {seed}: bf16 SINR drifted {rel.max():.2e} rel — "
+            "beyond the 8-ulp product-rounding budget"
+        )
+
+
+def test_cqi_bf16_within_one_index():
+    """bf16 SINR rounding can flip a CQI only AT an efficiency
+    boundary, and only by one index."""
+    from tpudes.ops.lte import cqi_from_sinr
+
+    sinr = jnp.asarray(
+        np.logspace(-2, 3, 4001, dtype=np.float32)
+    )
+    f32 = np.asarray(cqi_from_sinr(sinr))
+    bf16 = np.asarray(cqi_from_sinr(sinr, dtype=jnp.bfloat16))
+    assert np.abs(bf16.astype(int) - f32.astype(int)).max() <= 1
+    # and only a small fraction of the sweep sits on a boundary
+    assert (bf16 != f32).mean() < 0.05
+
+
+def test_mi_bf16_budget_and_f32_reduction():
+    """Per-RB MI at bf16: |Δmi| bounded by the bf16 half-ulp scaled
+    through the log2 slope (the normalized MI lives in [0, 1])."""
+    from tpudes.ops.lte import mi_per_rb
+
+    sinr = jnp.asarray(np.logspace(-2, 3, 2001, dtype=np.float32))
+    qm = jnp.full_like(sinr, 6.0)
+    f32 = np.asarray(mi_per_rb(sinr, qm))
+    bf16 = np.asarray(mi_per_rb(sinr, qm, dtype=jnp.bfloat16))
+    assert bf16.dtype == np.float32  # the f32-reduction half of the policy
+    # d(mi)/d(s) = 1/(qm ln2 (Γ+s)) ≤ ~0.6/Γ per unit s; a relative
+    # bf16 step δ·s moves mi by at most δ/(qm ln2) ≈ δ/4.16 — budget 2δ
+    assert np.abs(bf16 - f32).max() <= 2 * BF16_EPS
+
+
+def test_tb_bler_ecr_bf16_budget():
+    """BLER at bf16: the waterfall argument z moves by at most the MI
+    budget over sigma; pin the resulting BLER band around the 10 %
+    design point and exactness far from the cliff."""
+    from tpudes.ops.lte import tb_bler_ecr
+
+    ecr = jnp.full((101,), 0.5, jnp.float32)
+    tb = jnp.full((101,), 5000.0, jnp.float32)
+    mi = jnp.asarray(np.linspace(0.3, 0.7, 101, dtype=np.float32))
+    f32 = np.asarray(tb_bler_ecr(mi, ecr, tb))
+    bf16 = np.asarray(tb_bler_ecr(mi, ecr, tb, dtype=jnp.bfloat16))
+    sigma = 1.4 / np.sqrt(5000.0)
+    # max slope of the Gaussian CDF is 1/(sigma*sqrt(2pi))
+    budget = 2 * BF16_EPS * 0.7 / (sigma * np.sqrt(2 * np.pi))
+    assert np.abs(bf16 - f32).max() <= budget
+    # far from the waterfall both saturate (BLER≈1 at MI far below the
+    # code rate, ≈0 far above — well past any bf16 perturbation)
+    np.testing.assert_allclose(f32[:10], 1.0, atol=1e-12)
+    np.testing.assert_allclose(bf16[:10], 1.0, atol=1e-12)
+    assert f32[-10:].max() < 1e-12 and bf16[-10:].max() < 1e-12
+
+
+def test_dtype_none_and_f32_identical():
+    """dtype=jnp.float32 must be the EXACT legacy arithmetic — the
+    casts are no-ops, not a third rounding mode."""
+    from tpudes.ops.lte import cqi_from_sinr, mi_per_rb
+
+    tx_psd, gain, serving, noise = _scenario(3, 24, 25, seed=5)
+    np.testing.assert_array_equal(
+        np.asarray(lte_tti_sinr(tx_psd, gain, serving, noise)),
+        np.asarray(
+            lte_tti_sinr(tx_psd, gain, serving, noise, dtype=jnp.float32)
+        ),
+    )
+    sinr = jnp.asarray(np.logspace(-2, 2, 501, dtype=np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(cqi_from_sinr(sinr)),
+        np.asarray(cqi_from_sinr(sinr, dtype=jnp.float32)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(mi_per_rb(sinr, jnp.full_like(sinr, 4.0))),
+        np.asarray(
+            mi_per_rb(sinr, jnp.full_like(sinr, 4.0), dtype=jnp.float32)
+        ),
     )

@@ -181,7 +181,14 @@ class BriteTopologyHelper:
 
     # --- generation (pure arrays) ----------------------------------------
     def Generate(self) -> BriteGraph:
-        rng = np.random.default_rng(self.seed)
+        from tpudes.core.rng import seeded_bulk_generator
+
+        # bulk array draws on the seeded-stream contract: the generator
+        # is keyed by (RngSeed, RngRun, self.seed), so RngSeedManager
+        # run selection re-randomizes the topology like every other
+        # stream consumer (was: a bare default_rng(seed) that RngRun
+        # could never reach — promoted RNG002 baseline finding)
+        rng = seeded_bulk_generator(self.seed)
         if self.model.upper() == "BA":
             edges = barabasi_albert(self.n, self.m_links, rng)
             pos = rng.uniform(0.0, self.plane, size=(self.n, 2))

@@ -51,8 +51,10 @@ class A3RsrpHandoverAlgorithm(LteHandoverAlgorithm):
 
     def __init__(self, **attributes):
         super().__init__(**attributes)
-        #: (ue_index, target) -> tti when the A3 condition first held
-        self._entered: dict[tuple[int, int], int] = {}
+        #: (ue_index, target) -> (tti the A3 condition first held,
+        #:                        tti it was last confirmed)
+        self._entered: dict[tuple[int, int], tuple[int, int]] = {}
+        self._sweep_ev = None
 
     def evaluate(self, tti: int, ue_index: int, serving: int, rsrp_dbm_row):
         import numpy as np
@@ -71,11 +73,47 @@ class A3RsrpHandoverAlgorithm(LteHandoverAlgorithm):
             self._entered.pop((ue_index, best), None)
             return None
         key = (ue_index, best)
-        start = self._entered.setdefault(key, tti)
+        start, _ = self._entered.get(key, (tti, tti))
+        self._entered[key] = (start, tti)
+        self._arm_sweep()
         if tti - start >= self.time_to_trigger_ms:  # 1 TTI = 1 ms
             del self._entered[key]
             return best
         return None
+
+    # --- stranded-entry expiry -------------------------------------------
+    # evaluate() prunes a UE's entries only when it is called FOR that
+    # UE; a UE that detaches (or a controller that stops measuring it)
+    # would otherwise strand its pending (ue, target) entries forever.
+    # A periodic sweep drops any entry not re-confirmed within a lapse
+    # window — a live A3 condition is confirmed every measurement
+    # period, so only genuinely abandoned entries can age past it.
+
+    def _lapse_ttis(self) -> int:
+        return 2 * MEASUREMENT_PERIOD_TTIS + int(self.time_to_trigger_ms)
+
+    def _arm_sweep(self) -> None:
+        from tpudes.core.nstime import MilliSeconds
+        from tpudes.core.simulator import Simulator
+
+        if self._sweep_ev is not None and not self._sweep_ev.IsExpired():
+            return
+        self._sweep_ev = Simulator.Schedule(
+            MilliSeconds(self._lapse_ttis()), self._sweep_stranded
+        )
+
+    def _sweep_stranded(self) -> None:
+        from tpudes.core.simulator import Simulator
+
+        now = int(Simulator.Now().GetMilliSeconds())
+        lapse = self._lapse_ttis()
+        for key in [
+            k for k, (_, seen) in self._entered.items()
+            if now - seen >= lapse
+        ]:
+            del self._entered[key]
+        if self._entered:
+            self._arm_sweep()
 
 
 HANDOVER_ALGORITHMS = {

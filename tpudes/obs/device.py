@@ -82,6 +82,48 @@ class CompileTelemetry:
         cls.record(engine, time.monotonic() - t0)
 
 
+class KernelProfile:
+    """Per-stage device-kernel timings (the ISSUE-6 measurement seam).
+
+    The engines' profiling harnesses (e.g.
+    :func:`tpudes.parallel.kernels_pallas.profile_sm_stages`) record
+    the median wall time of each stage of a fused kernel chain here, so
+    "the win is measured, not asserted": bench's ``lte_kernel_profile``
+    row and any interactive session read the same registry.  Like
+    :class:`CompileTelemetry`, the registry survives ``reset_world``
+    (it describes executables, not simulation state)."""
+
+    _entries: dict[str, dict[str, dict]] = {}
+
+    @classmethod
+    def record(
+        cls, engine: str, stage: str, wall_s: float, batch: int
+    ) -> None:
+        cls._entries.setdefault(engine, {})[stage] = {
+            "wall_s": float(wall_s),
+            "batch": int(batch),
+        }
+
+    @classmethod
+    def stages(cls, engine: str) -> dict[str, dict]:
+        return dict(cls._entries.get(engine, {}))
+
+    @classmethod
+    def snapshot(cls) -> dict[str, dict]:
+        return {
+            engine: {
+                stage: {"wall_us": round(e["wall_s"] * 1e6, 1),
+                        "batch": e["batch"]}
+                for stage, e in stages.items()
+            }
+            for engine, stages in sorted(cls._entries.items())
+        }
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._entries.clear()
+
+
 class ChunkStream:
     """Per-chunk metrics streamed by chunked-horizon engine runs.
 

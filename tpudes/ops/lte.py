@@ -145,11 +145,19 @@ def tti_sinr_py(tx_psd_w, gain, serving, noise_psd):
 # --- CQI -------------------------------------------------------------------
 
 
-def cqi_from_sinr(sinr: jax.Array) -> jax.Array:
+def cqi_from_sinr(sinr: jax.Array, dtype=None) -> jax.Array:
     """Wideband CQI from mean per-RB SINR: spectral efficiency
     log2(1 + SINR/Γ) mapped to the highest CQI the efficiency supports
-    (lte-amc CreateCqiFeedbacks, PiroEW2010 mapping)."""
-    se = jnp.log2(1.0 + sinr / SNR_GAP)
+    (lte-amc CreateCqiFeedbacks, PiroEW2010 mapping).
+
+    ``dtype`` (e.g. ``jnp.bfloat16``) selects the mixed-precision mode:
+    the gapped SINR ratio is computed at that precision while the log2
+    transcendental and the table comparison stay f32 — the engine's
+    compute-in-low/accumulate-in-f32 policy.  The CQI error budget this
+    buys is at most ±1 index at efficiency-boundary SINRs
+    (tests/test_ops_lte_kernels.py pins it)."""
+    x = sinr if dtype is None else sinr.astype(dtype)
+    se = jnp.log2((1.0 + x / SNR_GAP).astype(jnp.float32))
     # highest cqi with efficiency <= se
     eff = jnp.asarray(_CQI_EFF)                            # (16,)
     return jnp.sum((eff[None, :] <= se[..., None]) & (eff[None, :] > 0.0), axis=-1)
@@ -175,12 +183,41 @@ def mcs_from_cqi_py(cqi: int) -> int:
 # --- MI-based error model --------------------------------------------------
 
 
-def mi_per_rb(sinr: jax.Array, qm: jax.Array) -> jax.Array:
+def mi_per_rb(sinr: jax.Array, qm: jax.Array, dtype=None) -> jax.Array:
     """Normalized per-RB mutual information in [0, 1]: gapped Shannon
     capacity capped at the modulation order (the MIESM structure of
-    LteMiErrorModel with an analytic MI curve — see module docstring)."""
-    cap = jnp.log2(1.0 + sinr / SNR_GAP)
+    LteMiErrorModel with an analytic MI curve — see module docstring).
+
+    ``dtype`` selects the mixed-precision mode (same policy as
+    :func:`cqi_from_sinr`: ratio at ``dtype``, log2 and the final
+    normalization in f32)."""
+    x = sinr if dtype is None else sinr.astype(dtype)
+    cap = jnp.log2((1.0 + x / SNR_GAP).astype(jnp.float32))
     return jnp.minimum(cap, qm) / qm
+
+
+def tb_bler_ecr(
+    mi_eff: jax.Array, ecr: jax.Array, tb_bits_: jax.Array, dtype=None
+) -> jax.Array:
+    """:func:`tb_bler` on a pre-gathered effective code rate — the form
+    the fused device kernel uses (its per-UE MCS is static, so the
+    table gather happens once at build time instead of per TTI).
+
+    ``dtype`` selects the mixed-precision mode: the waterfall argument
+    ``z`` is computed at that precision while the dispersion sqrt and
+    the erfc tail stay f32.  The BLER budget this buys is |Δmi| ≤ the
+    dtype's half-ulp at 1.0 propagated through the waterfall slope
+    (tests/test_ops_lte_kernels.py pins it)."""
+    sigma = BLER_DISPERSION / jnp.sqrt(jnp.maximum(tb_bits_, 24.0))
+    margin = BLER_TARGET_Q * sigma
+    if dtype is None:
+        z = (mi_eff - (ecr - margin)) / sigma
+    else:
+        z = (
+            (mi_eff.astype(dtype) - (ecr - margin).astype(dtype))
+            / sigma.astype(dtype)
+        ).astype(jnp.float32)
+    return jnp.clip(0.5 * erfc(z / math.sqrt(2.0)), 0.0, 1.0)
 
 
 def tb_bler(mi_eff: jax.Array, mcs: jax.Array, tb_bits_: jax.Array) -> jax.Array:
@@ -188,11 +225,7 @@ def tb_bler(mi_eff: jax.Array, mcs: jax.Array, tb_bits_: jax.Array) -> jax.Array
     the code rate with finite-blocklength dispersion, margin calibrated
     to 10 % BLER when MI exactly matches the code rate
     (GetTbDecodificationStats analog)."""
-    ecr = jnp.asarray(_MCS_ECR)[mcs]
-    sigma = BLER_DISPERSION / jnp.sqrt(jnp.maximum(tb_bits_, 24.0))
-    margin = BLER_TARGET_Q * sigma
-    z = (mi_eff - (ecr - margin)) / sigma
-    return jnp.clip(0.5 * erfc(z / math.sqrt(2.0)), 0.0, 1.0)
+    return tb_bler_ecr(mi_eff, jnp.asarray(_MCS_ECR)[mcs], tb_bits_)
 
 
 def tb_bler_py(mi_eff: float, mcs: int, tb_bits_: float) -> float:

@@ -144,6 +144,7 @@ def lte_tti_sinr(
     gain: jax.Array,         # (E, U) linear path gain eNB→UE
     serving: jax.Array,      # (U,) int32 serving eNB per UE
     noise_psd_w: float,
+    dtype=None,              # e.g. jnp.bfloat16: mixed-precision mode
 ):
     """Per-RB SINR for each UE in one TTI: serving signal over sum of
     other-cell interference + noise (LteInterference chunk processing,
@@ -158,8 +159,26 @@ def lte_tti_sinr(
     couple of f32 ULP (XLA fuses the old multiply into its reduce with
     FMA, so no O(U·RB) reformulation can reproduce those exact bits)
     and no further from the float64 ground truth
-    (tests/test_ops_lte_kernels.py pins all three properties)."""
+    (tests/test_ops_lte_kernels.py pins all three properties).
+
+    ``dtype`` (e.g. ``jnp.bfloat16``) turns on the mixed-precision
+    mode: the gain/PSD PRODUCTS are taken at that precision while the
+    interference einsum ACCUMULATES in f32 (``preferred_element_type``)
+    and the final SINR division stays f32 — the engine-wide
+    compute-in-low/accumulate-in-f32 policy.  The relative-error
+    budget vs the f32 path is a few bf16 ulps
+    (tests/test_ops_lte_kernels.py pins it)."""
     u = jnp.arange(gain.shape[1])
-    sig = tx_psd_w[serving] * gain[serving, u][:, None]    # (U, RB)
-    total = jnp.einsum("eu,er->ur", gain, tx_psd_w)        # (U, RB)
+    if dtype is None:
+        sig = tx_psd_w[serving] * gain[serving, u][:, None]     # (U, RB)
+        total = jnp.einsum("eu,er->ur", gain, tx_psd_w)         # (U, RB)
+    else:
+        psd_lo, gain_lo = tx_psd_w.astype(dtype), gain.astype(dtype)
+        sig = (
+            psd_lo[serving] * gain_lo[serving, u][:, None]
+        ).astype(jnp.float32)
+        total = jnp.einsum(
+            "eu,er->ur", gain_lo, psd_lo,
+            preferred_element_type=jnp.float32,
+        )
     return sig / (total - sig + noise_psd_w)

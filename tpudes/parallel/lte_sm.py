@@ -14,6 +14,14 @@ entire RB grid every TTI, which makes the interference pattern (and
 hence SINR, CQI, MCS, per-RB MI) static for a static topology: they are
 precomputed once at lowering time.
 
+The per-TTI math itself lives in
+:mod:`tpudes.parallel.kernels_pallas`: one fused kernel chain (retx
+admission → scheduler dispatch → MI/BLER decode → HARQ update) with a
+hand-written Pallas lowering on TPU, an interpret-mode path everywhere
+else, a ``TPUDES_PALLAS=0`` plain-XLA kill switch, and an optional
+bf16/f32 mixed-precision mode (``LteSmProgram.precision``) — both
+flags are cache-key components, never traced operands.
+
 All NINE FF-MAC schedulers (models/lte/scheduler.py) lower: each is a
 per-UE metric whose per-cell argmax drives the same one-hot allocation
 algebra, so a SINGLE jitted program serves the whole family — the
@@ -49,20 +57,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpudes.models.lte.scheduler import (
-    HARQ_MAX_TX,
-    HARQ_RTT_TTIS,
-    SCHEDULERS,
-    rbg_size_for,
-)
-from tpudes.ops.lte import (
-    RB_BANDWIDTH_HZ,
-    cqi_from_sinr,
-    mcs_from_cqi,
-    mi_per_rb,
-    tb_bler,
-    tbs_bits,
-    _MCS_QM,
+from tpudes.models.lte.scheduler import SCHEDULERS
+from tpudes.parallel.kernels_pallas import (
+    SM_PRECISIONS,
+    SM_SCHED_IDS,
+    build_sm_consts,
+    build_sm_step_fn,
+    pallas_enabled,
+    sm_init_state,
 )
 
 
@@ -71,16 +73,10 @@ class UnliftableLteScenarioError(ValueError):
     (non-SM bearers, mobile nodes, unattached UEs, …)."""
 
 
-#: scheduler short name → traced dispatch id.  Families sharing a
-#: full-buffer-degenerate metric share an id group in the step's select
-#: (see module docstring); the id itself is a RUNTIME operand of the
-#: compiled program, so all nine ride one XLA executable.
-SM_SCHED_IDS = {
-    "pf": 0, "cqa": 1, "pss": 2,
-    "rr": 3, "tta": 4,
-    "tdmt": 5, "fdmt": 6,
-    "tdbet": 7, "fdbet": 8,
-}
+#: SM_SCHED_IDS (scheduler short name → traced dispatch id) is defined
+#: next to the kernel whose family boundaries derive from it
+#: (tpudes/parallel/kernels_pallas.py) and re-exported here, the
+#: engine's public surface.
 
 #: host FfMacScheduler class → short name, derived from the host
 #: registry so SM_SCHED_IDS stays the single device-support list (a
@@ -104,6 +100,12 @@ class LteSmProgram:
     n_ttis: int
     scheduler: str            # any key of SM_SCHED_IDS
     pf_alpha: float = 0.05
+    #: arithmetic mode of the SINR/CQI/metric/BLER chain — "f32"
+    #: (exact legacy math) or "bf16" (mixed precision with f32
+    #: accumulators; see tpudes/parallel/kernels_pallas.py).  A cache-
+    #: key component, never a traced operand: flipping it compiles a
+    #: distinct executable.
+    precision: str = "f32"
 
     @property
     def n_enb(self) -> int:
@@ -114,11 +116,28 @@ class LteSmProgram:
         return int(self.gain.shape[1])
 
 
-def lower_lte_sm(helper, sim_time_s: float) -> LteSmProgram:
+#: below this horizon the fused TTI scan's one-time XLA compile
+#: (seconds), not the per-TTI math (tens of µs), dominates a cold run's
+#: wall time — the LTE analog of lower_bss's MODELED_WARMUP_S boundary
+COMPILE_AMORTIZE_TTIS = 250
+
+
+def lower_lte_sm(
+    helper, sim_time_s: float, precision: str = "f32"
+) -> LteSmProgram:
     """Lower a constructed LteHelper object graph (controller state) to
     a device program; raises UnliftableLteScenarioError for anything the
-    full-buffer engine cannot faithfully represent."""
+    full-buffer engine cannot faithfully represent.
+
+    ``precision`` selects the arithmetic mode of the SINR/CQI/BLER
+    chain ("f32" exact, "bf16" mixed precision — see
+    :class:`LteSmProgram`)."""
     from tpudes.models.mobility import MobilityModel
+
+    if precision not in SM_PRECISIONS:
+        raise ValueError(
+            f"precision {precision!r} not in {SM_PRECISIONS}"
+        )
 
     ctrl = helper.controller
     if not ctrl.enbs or not ctrl.ues:
@@ -169,6 +188,19 @@ def lower_lte_sm(helper, sim_time_s: float) -> LteSmProgram:
     ctrl._rebuild()
     if (ctrl._serving < 0).any():
         raise UnliftableLteScenarioError("unattached UEs present")
+    n_ttis = int(round(sim_time_s * 1000.0))
+    if n_ttis < COMPILE_AMORTIZE_TTIS:
+        import warnings
+
+        warnings.warn(
+            f"sim_time_s={sim_time_s} s ({n_ttis} TTIs) is below the "
+            f"~{COMPILE_AMORTIZE_TTIS}-TTI horizon at which the fused "
+            "TTI scan's one-time XLA compile stops dominating wall "
+            "time; a cold run this short measures the compiler, not "
+            "the engine — extend the horizon, sweep replicas/"
+            "schedulers to amortize, or pre-warm via TPUDES_CACHE_DIR",
+            stacklevel=2,
+        )
     alphas = {
         getattr(enb.scheduler, "alpha", None) for enb in ctrl.enbs
     } - {None}
@@ -180,173 +212,59 @@ def lower_lte_sm(helper, sim_time_s: float) -> LteSmProgram:
         ),
         noise_psd=float(ctrl._noise_dl),
         n_rb=ctrl.n_rb,
-        n_ttis=int(round(sim_time_s * 1000.0)),
+        n_ttis=n_ttis,
         scheduler=sched,
         pf_alpha=float(alphas.pop()) if alphas else 0.05,
+        precision=precision,
     )
 
 
-def build_sm_step(prog: LteSmProgram):
+def build_sm_step(prog: LteSmProgram, use_pallas: bool | None = None):
     """Returns ``(consts, init_state, step_fn)`` for the per-TTI scan
     body (single replica; vmapped by run_lte_sm).
+
+    The TTI math itself lives in :mod:`tpudes.parallel.kernels_pallas`
+    (one math core, two lowerings — the fused Pallas kernel and the
+    plain-XLA fallback); this builder only owns the scan plumbing: the
+    per-TTI ``fold_in`` coin draw and the carry layout.
 
     ``step_fn(state, (t, key), sid)`` — ``sid`` is the traced scheduler
     id (:data:`SM_SCHED_IDS`), so the compiled program is
     scheduler-agnostic: ``prog.scheduler`` only picks the value fed in.
     """
+    if use_pallas is None:
+        use_pallas = pallas_enabled()
+    consts_np = build_sm_consts(prog)
+    fused = build_sm_step_fn(consts_np, use_pallas)
     E, U = prog.n_enb, prog.n_ue
-    rbg_size = rbg_size_for(prog.n_rb)
-    n_rbg = (prog.n_rb + rbg_size - 1) // rbg_size
-
-    # --- static physics: full-buffer ⇒ full grid ⇒ flat per-RB SINR ----
-    psd = 10.0 ** ((prog.tx_power_dbm - 30.0) / 10.0) / (
-        prog.n_rb * RB_BANDWIDTH_HZ
-    )  # (E,) W/Hz
-    seen = psd[:, None] * prog.gain                       # (E, U)
-    total = seen.sum(axis=0)                              # (U,)
-    sig = seen[prog.serving, np.arange(U)]
-    sinr_np = sig / (total - sig + prog.noise_psd)        # (U,) flat over RBs
-
-    sinr = jnp.asarray(sinr_np, dtype=jnp.float32)
-    cqi = cqi_from_sinr(sinr)                             # (U,)
-    mcs0 = mcs_from_cqi(cqi)                              # (U,)
-    qm0 = jnp.asarray(_MCS_QM)[mcs0]
-    mi0 = mi_per_rb(sinr, qm0)                            # (U,)
-    eligible = cqi >= 1
-    rate0 = tbs_bits(mcs0, rbg_size) * 1000.0             # bits/s if served
-
-    cell_onehot = jnp.asarray(
-        prog.serving[None, :] == np.arange(E)[:, None]
-    )                                                     # (E, U)
-    # RR rotation bookkeeping: position of each UE within its cell
-    pos_np = np.zeros((U,), dtype=np.int32)
-    count_np = np.zeros((E,), dtype=np.int32)
-    for u in range(U):
-        c = int(prog.serving[u])
-        pos_np[u] = count_np[c]
-        count_np[c] += 1
-    pos = jnp.asarray(pos_np)
-    count_u = jnp.asarray(np.maximum(count_np, 1))[jnp.asarray(prog.serving)]
-    count_c = jnp.asarray(np.maximum(count_np, 1))
-    serving_j = jnp.asarray(prog.serving)
-    NEG = jnp.float32(-1e30)
 
     def init_state():
-        z_i = jnp.zeros((U,), jnp.int32)
-        z_f = jnp.zeros((U,), jnp.float32)
-        return dict(
-            avg=jnp.ones((U,), jnp.float32),
-            pend=jnp.zeros((U,), bool),
-            p_mi=z_f, p_tbb=z_f,
-            p_mcs=z_i, p_nrbg=z_i, p_txc=z_i, p_due=z_i,
-            rr_ptr=jnp.zeros((E,), jnp.int32),
-            # exact bit accounting without int32 overflow on long runs:
-            # rx_lo rolls over into rx_hi at 2^20 (≤1e5 bits/TTI, so
-            # rx_lo never exceeds 2^21 before the carry)
-            rx_lo=z_i, rx_hi=z_i,
-            new_tbs=z_i, retx=z_i, drops=z_i, ok_cnt=z_i,
-        )
+        return sm_init_state(E, U)
 
     def step_fn(s, xs, sid):
         t, key = xs
-        due = s["pend"] & (s["p_due"] <= t) & eligible
-        nrbg_req = jnp.where(due, s["p_nrbg"], 0)
-        # per-cell capped retx admission (UE-index order)
-        cum = jnp.cumsum(cell_onehot * nrbg_req[None, :], axis=1)   # (E, U)
-        cum_u = jnp.sum(jnp.where(cell_onehot, cum, 0), axis=0)     # (U,)
-        retx_fit = due & (cum_u <= n_rbg)
-        used_c = jnp.sum(
-            cell_onehot * jnp.where(retx_fit, nrbg_req, 0)[None, :], axis=1
-        )                                                           # (E,)
-        rem_c = n_rbg - used_c
+        coin = jax.random.uniform(key, (U,))[None, :]
+        return fused(s, coin, t, sid)
 
-        # new-TB winner per cell (full buffer: winner takes the rest).
-        # One metric per scheduler family; the per-cell argmax breaks
-        # ties at the lowest UE index = lowest rnti, the host tie-break.
-        cand = eligible & ~s["pend"]
-        pf_metric = rate0 / jnp.maximum(s["avg"], 1.0)
-        # rr/tta: next UE at/after the rotating pointer wins
-        ahead = jnp.mod(pos - s["rr_ptr"][serving_j], count_u)
-        rr_metric = -ahead.astype(jnp.float32)
-        # td/fd-mt: highest achievable rate; td/fd-bet: lowest EMA
-        # throughput (argmax of 1/avg == argmax of -avg)
-        metric = jnp.select(
-            [sid <= SM_SCHED_IDS["pss"],
-             sid <= SM_SCHED_IDS["tta"],
-             sid <= SM_SCHED_IDS["fdmt"]],
-            [pf_metric, rr_metric, rate0],
-            -s["avg"],
-        )
-        m_eu = jnp.where(cell_onehot & cand[None, :], metric[None, :], NEG)
-        win_idx = jnp.argmax(m_eu, axis=1)                          # (E,)
-        has_win = (jnp.max(m_eu, axis=1) > NEG) & (rem_c > 0)
-        winner_oh = (
-            (jnp.arange(U)[None, :] == win_idx[:, None]) & has_win[:, None]
-        )                                                           # (E, U)
-        is_winner = jnp.any(winner_oh, axis=0)
-        new_nrbg = jnp.sum(winner_oh * rem_c[:, None], axis=0)
-        new_nrb = jnp.minimum(new_nrbg * rbg_size, prog.n_rb)
-        tb_new = tbs_bits(mcs0, new_nrb.astype(jnp.float32))
-
-        tx = retx_fit | is_winner
-        mcs_tx = jnp.where(retx_fit, s["p_mcs"], mcs0)
-        tbb_tx = jnp.where(retx_fit, s["p_tbb"], tb_new.astype(jnp.float32))
-        mi_tx = jnp.where(
-            retx_fit, jnp.minimum(s["p_mi"] + mi0, 1.0), mi0
-        )
-        bler = tb_bler(mi_tx, mcs_tx, tbb_tx)
-        coin = jax.random.uniform(key, (U,))
-        ok = tx & (coin >= bler)
-        fail = tx & ~ok
-
-        txc_after = jnp.where(retx_fit, s["p_txc"] + 1, 1)
-        dropped = fail & (txc_after >= HARQ_MAX_TX)
-        repend = fail & ~dropped
-        # a due TB that didn't fit the RBG budget stays pending (its
-        # p_due is already ≤ t, so it retries next TTI) — clearing on
-        # `due` alone would silently erase it
-        keep = s["pend"] & ~retx_fit
-
-        served_bits = jnp.where(ok, tbb_tx, 0.0)
-        ptr_winner = jnp.sum(winner_oh * pos[None, :], axis=1)
-        new_ptr = jnp.where(
-            has_win, jnp.mod(ptr_winner + 1, count_c), s["rr_ptr"]
-        )
-        lo = s["rx_lo"] + served_bits.astype(jnp.int32)
-        return dict(
-            avg=(1.0 - prog.pf_alpha) * s["avg"]
-            + prog.pf_alpha * served_bits * 1000.0,
-            pend=keep | repend,
-            p_mi=jnp.where(repend, mi_tx, s["p_mi"]),
-            p_tbb=jnp.where(repend, tbb_tx, s["p_tbb"]),
-            p_mcs=jnp.where(repend, mcs_tx, s["p_mcs"]),
-            p_nrbg=jnp.where(
-                repend, jnp.where(retx_fit, s["p_nrbg"], new_nrbg), s["p_nrbg"]
-            ),
-            p_txc=jnp.where(repend, txc_after, s["p_txc"]),
-            p_due=jnp.where(repend, t + HARQ_RTT_TTIS, s["p_due"]),
-            rr_ptr=new_ptr,
-            rx_lo=lo & 0xFFFFF,
-            rx_hi=s["rx_hi"] + (lo >> 20),
-            new_tbs=s["new_tbs"] + is_winner.astype(jnp.int32),
-            retx=s["retx"] + retx_fit.astype(jnp.int32),
-            drops=s["drops"] + dropped.astype(jnp.int32),
-            ok_cnt=s["ok_cnt"] + ok.astype(jnp.int32),
-        )
-
-    consts = dict(sinr=sinr, cqi=cqi, mcs=mcs0)
+    consts = dict(
+        sinr=consts_np["sinr"][0], cqi=consts_np["cqi"][0],
+        mcs=consts_np["mcs"][0],
+    )
     return consts, init_state, step_fn
 
 
-def _sm_cache_key(prog: LteSmProgram, replicas, n_cfg, obs) -> tuple:
+def _sm_cache_key(prog: LteSmProgram, replicas, n_cfg, obs, use_pallas) -> tuple:
     # prog.scheduler AND prog.n_ttis are deliberately ABSENT: the
     # scheduler id and the TTI horizon are both traced operands, so one
     # compiled program serves all nine schedulers at every horizon — a
-    # scheduler×horizon sweep pays one compile, not one per point
+    # scheduler×horizon sweep pays one compile, not one per point.
+    # prog.precision and the pallas flag ARE present: they select
+    # different arithmetic, i.e. different executables — flipping
+    # TPUDES_PALLAS mid-process must not hit a stale runner.
     return (
         prog.gain.tobytes(), prog.serving.tobytes(),
         prog.tx_power_dbm.tobytes(), prog.noise_psd, prog.n_rb,
-        prog.pf_alpha, replicas, n_cfg, obs,
+        prog.pf_alpha, prog.precision, use_pallas, replicas, n_cfg, obs,
     )
 
 
@@ -356,9 +274,12 @@ _SM_FETCH = ("rx_lo", "rx_hi", "new_tbs", "retx", "drops", "ok_cnt")
 
 def _sm_unpack(host: dict, consts_np: dict, replicas) -> dict:
     """Host-side result assembly for ONE config point (already
-    device_get; slices the replica padding, rebuilds the 52-bit rx
-    counter)."""
-    result = {k: np.asarray(v) for k, v in host.items()}
+    device_get; drops the kernel's (1, U) row axis, slices the replica
+    padding, rebuilds the 52-bit rx counter)."""
+    result = {
+        k: np.asarray(v).reshape(np.shape(v)[:-2] + np.shape(v)[-1:])
+        for k, v in host.items()
+    }
     if replicas is not None and result["rx_lo"].shape[0] != replicas:
         result = {k: v[:replicas] for k, v in result.items()}
     result["rx_bits"] = (
@@ -425,9 +346,19 @@ def run_lte_sm(
     r_pad = bucket_replicas(replicas, mesh)
     n_cfg = None if schedulers is None else len(schedulers)
     obs = device_metrics_enabled()
+    # interpret-mode pallas (every non-TPU backend) executes the kernel
+    # interpreter PER SHARD under a sharded mesh — measured ~100x slower
+    # than the XLA lowering at runtime, with zero coverage gain (the
+    # unsharded tests already run the exact kernel body, and the two
+    # lowerings are pinned bit-identical).  Mesh runs on non-TPU
+    # backends therefore take the XLA lowering; TPU keeps the compiled
+    # Mosaic kernel everywhere.
+    use_pallas = pallas_enabled() and (
+        mesh is None or jax.default_backend() == "tpu"
+    )
 
     def build():
-        consts, init_state, step_fn = build_sm_step(prog)
+        consts, init_state, step_fn = build_sm_step(prog, use_pallas)
 
         def advance(carry, k, sid, t_end):
             # per-TTI key = fold_in(k, t): a pure function of (k, t),
@@ -466,7 +397,7 @@ def run_lte_sm(
         return consts, init_state, fn
 
     (consts, init_state, fn), compiling = RUNTIME.runner(
-        "lte_sm", _sm_cache_key(prog, r_pad, n_cfg, obs), build
+        "lte_sm", _sm_cache_key(prog, r_pad, n_cfg, obs, use_pallas), build
     )
 
     sched_names = [prog.scheduler] if schedulers is None else list(schedulers)
