@@ -69,6 +69,14 @@ ISSUE-5 rows:
   - mesh_config_sweep (with --mesh): a 2-point scheduler sweep on the
     full mesh — megabatching composed with replica sharding.
 
+ISSUE-7 row:
+  - serving_closed_loop: a closed-loop multi-tenant client pool driving
+    the StudyServer (tpudes/serving) vs serialized RUNTIME.submit of
+    the same study stream — requests/s at bounded p99 study latency,
+    the first metric that models many concurrent users rather than one
+    batch job.  Coalesced serving must be >= 2x serialized throughput
+    at equal (bit-pinned) results.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
@@ -519,6 +527,122 @@ def bench_pipeline_overlap():
     )
 
 
+SERVING_CLIENTS = 16
+SERVING_STUDIES_PER_CLIENT = 6
+SERVING_SLOTS = 50
+SERVING_REPLICAS = 1
+SERVING_MAX_WAIT_S = 0.004
+SERVING_MAX_BATCH = 8
+
+
+def bench_serving_closed_loop(smoke: bool = False):
+    """ISSUE-7 tentpole row: simulation-as-a-service under closed-loop
+    multi-tenant load.  A pool of client threads drives a StudyServer —
+    each client submits a study (one dumbbell program per TCP variant,
+    same static program / key / replica count, so every study is
+    coalescible), waits for its demuxed result, and submits the next.
+    The baseline is the SAME study stream through serialized
+    ``RUNTIME.submit`` — the best a caller could do before the serving
+    layer (pipelined async dispatch, but one device launch per study).
+
+    The row reports requests/s on both paths and the serving p50/p99
+    study latency: the acceptance bar is coalesced >= 2x serialized at
+    equal results (equality is pinned by tests/test_serving.py, which
+    compares coalesced results bit-for-bit against solo launches).
+    The study shape is deliberately SMALL: this row measures the
+    serving layer's per-launch amortization, not engine compute — on
+    accelerators the fixed launch+transfer overhead it amortizes is
+    larger still."""
+    import dataclasses
+    import threading
+
+    import jax
+
+    from tpudes.obs.serving import ServingTelemetry
+    from tpudes.parallel.programs import toy_dumbbell_program
+    from tpudes.parallel.runtime import RUNTIME
+    from tpudes.parallel.tcp_dumbbell import (
+        VARIANTS,
+        _variant_ecn,
+        _variant_point,
+        run_tcp_dumbbell,
+    )
+    from tpudes.serving import StudyServer
+
+    n_clients = 8 if smoke else SERVING_CLIENTS
+    per_client = 4 if smoke else SERVING_STUDIES_PER_CLIENT
+    prog = toy_dumbbell_program(n_flows=3, n_slots=SERVING_SLOTS)
+    key = jax.random.PRNGKey(0)
+
+    def study_prog(i):
+        ids = _variant_point([VARIANTS[i % len(VARIANTS)]] * prog.n_flows)
+        return dataclasses.replace(
+            prog, variant_idx=ids, ecn=_variant_ecn(ids)
+        )
+
+    total = n_clients * per_client
+    stream = [study_prog(i) for i in range(total)]
+    RUNTIME.clear("dumbbell")
+    run_tcp_dumbbell(stream[0], key, replicas=SERVING_REPLICAS)  # warm
+
+    # --- baseline: serialized (but async-pipelined) submission -----------
+    t0 = time.monotonic()
+    futs = [
+        RUNTIME.submit(run_tcp_dumbbell, p, key, SERVING_REPLICAS)
+        for p in stream
+    ]
+    for f in futs:
+        f.result()
+    wall_serial = time.monotonic() - t0
+
+    # --- coalesced serving: closed-loop client pool ----------------------
+    ServingTelemetry.reset()
+    server = StudyServer(
+        max_wait_s=SERVING_MAX_WAIT_S,
+        max_batch=SERVING_MAX_BATCH,
+        warm=[dict(engine="dumbbell", prog=stream[0], key=key,
+                   replicas=SERVING_REPLICAS)],
+    )
+
+    def client(c):
+        for j in range(per_client):
+            h = server.submit_study(
+                "dumbbell", stream[c * per_client + j], key,
+                SERVING_REPLICAS, tenant=f"tenant{c}",
+            )
+            h.result(timeout=300)
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=client, args=(c,))
+        for c in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_served = time.monotonic() - t0
+    metrics = server.metrics()
+    server.close()
+
+    eng = metrics["engines"]["dumbbell"]
+    return dict(
+        requests=total,
+        clients=n_clients,
+        smoke=smoke,
+        rps_serialized=round(total / wall_serial, 1),
+        rps_coalesced=round(total / wall_served, 1),
+        coalesced_speedup=round(wall_serial / wall_served, 3),  # >= 2 target
+        launches=eng["launches"],
+        coalesced_launches=eng["coalesced_launches"],
+        coalesce_rate=metrics["coalesce_rate"],
+        batch_occupancy=eng["batch_occupancy"],
+        latency_p50_ms=round(eng["study_latency_s"]["p50"] * 1e3, 2),
+        latency_p99_ms=round(eng["study_latency_s"]["p99"] * 1e3, 2),
+        launch_p99_ms=round(eng["launch_wall_s"]["p99"] * 1e3, 2),
+    )
+
+
 def bench_tcp():
     import jax
 
@@ -791,6 +915,7 @@ def main():
     asn = bench_as()
     sweep_vec = bench_sweep_vectorized()
     pipeline = bench_pipeline_overlap()
+    serving = bench_serving_closed_loop()
     # honest-metric caveat (VERDICT r4 weak #6): the AS ratio compares a
     # host packet-level integration to a converged fluid fixed point —
     # different study definitions; the comparable number is studies/s
@@ -829,6 +954,10 @@ def main():
         # sweep (one-launch must be >= per-point on every platform)
         "sweep_vectorized": sweep_vec,
         "pipeline_overlap": pipeline,
+        # ISSUE-7 row: closed-loop multi-tenant serving — requests/s at
+        # bounded p99, coalesced StudyServer vs serialized submission
+        # of the same study stream (>= 2x is the acceptance bar)
+        "serving_closed_loop": serving,
         # tpudes.obs compile telemetry: per-engine XLA compile count +
         # wall time over the whole bench process (sweeps must not add
         # compiles — the single-executable property as a metric)
@@ -863,6 +992,11 @@ if __name__ == "__main__":
         print(json.dumps({
             "mesh_scaling": bench_mesh(smoke=args.smoke),
             "mesh_config_sweep": bench_mesh_sweep(smoke=args.smoke),
+            # the serving row rides the CI artifact too, so the
+            # closed-loop metric is asserted present on every run
+            "serving_closed_loop": bench_serving_closed_loop(
+                smoke=args.smoke
+            ),
         }))
     else:
         main()

@@ -395,3 +395,129 @@ def test_remote_host_traffic_through_backhaul_and_pgw():
     Simulator.Run()
     assert dl_rx[0] == 8, "all DL packets must reach the UE app"
     assert ul_apps.Get(0).received == 6, "all UL packets must reach the remote host"
+
+
+# --- eNB RRC stranded-context sweep ----------------------------------------
+
+
+def test_stranded_context_reclaimed_after_reattach_elsewhere():
+    """Promoted EVT003 regression (LteEnbRrc.ues): a UE that re-attaches
+    to another cell OUTSIDE the handover remove_ue path must have its
+    old eNB-side UeContext reclaimed by the scheduled stranded-context
+    sweep instead of leaking forever."""
+    from tpudes.models.lte.device import (
+        LteEnbNetDevice,
+        LteEnbRrc,
+        LteUeNetDevice,
+    )
+
+    src, dst = LteEnbNetDevice(), LteEnbNetDevice()
+    ue = LteUeNetDevice()
+    ctx = src.rrc.add_ue(ue)
+    ue.rrc.connect(src, ctx.rnti)
+    # raw re-attach: no remove_ue on the old cell
+    ctx2 = dst.rrc.add_ue(ue)
+    ue.rrc.connect(dst, ctx2.rnti)
+    assert len(src.rrc.ues) == 1, "stranded until the sweep fires"
+    Simulator.Stop(MilliSeconds(2 * LteEnbRrc.STRANDED_UE_LAPSE_MS))
+    Simulator.Run()
+    assert src.rrc.ues == {}
+    assert list(dst.rrc.ues) == [ctx2.rnti]
+
+
+def test_disconnect_releases_enb_context_after_lapse():
+    """LteUeRrc.disconnect (RRC release) leaves the eNB context to the
+    lapse sweep — reclaimed, but only after the grace window."""
+    from tpudes.models.lte.device import (
+        LteEnbNetDevice,
+        LteEnbRrc,
+        LteUeNetDevice,
+        LteUeRrc,
+    )
+
+    enb = LteEnbNetDevice()
+    ue = LteUeNetDevice()
+    ctx = enb.rrc.add_ue(ue)
+    ue.rrc.connect(enb, ctx.rnti)
+    ue.rrc.disconnect()
+    assert ue.rrc.state == LteUeRrc.IDLE
+    assert len(enb.rrc.ues) == 1, "grace window: not reclaimed inline"
+    Simulator.Stop(MilliSeconds(2 * LteEnbRrc.STRANDED_UE_LAPSE_MS))
+    Simulator.Run()
+    assert enb.rrc.ues == {}
+
+
+def test_sweep_keeps_claimed_contexts():
+    """The sweep armed by one UE's departure must not touch a context
+    its UE still claims."""
+    from tpudes.models.lte.device import (
+        LteEnbNetDevice,
+        LteEnbRrc,
+        LteUeNetDevice,
+    )
+
+    enb = LteEnbNetDevice()
+    stay, leave = LteUeNetDevice(), LteUeNetDevice()
+    ctx_stay = enb.rrc.add_ue(stay)
+    stay.rrc.connect(enb, ctx_stay.rnti)
+    ctx_leave = enb.rrc.add_ue(leave)
+    leave.rrc.connect(enb, ctx_leave.rnti)
+    leave.rrc.disconnect()
+    Simulator.Stop(MilliSeconds(2 * LteEnbRrc.STRANDED_UE_LAPSE_MS))
+    Simulator.Run()
+    assert list(enb.rrc.ues) == [ctx_stay.rnti]
+
+
+def test_same_cell_reattach_reclaims_old_context():
+    """Review fix: a UE re-establishing on the SAME cell under a fresh
+    RNTI abandons its old context just like a re-attach elsewhere — the
+    sweep must reclaim it (connect() notes the detach for any previous
+    serving cell, not only a different one)."""
+    from tpudes.models.lte.device import (
+        LteEnbNetDevice,
+        LteEnbRrc,
+        LteUeNetDevice,
+    )
+
+    enb = LteEnbNetDevice()
+    ue = LteUeNetDevice()
+    ctx = enb.rrc.add_ue(ue)
+    ue.rrc.connect(enb, ctx.rnti)
+    ctx2 = enb.rrc.add_ue(ue)  # RRC re-establishment: fresh RNTI
+    ue.rrc.connect(enb, ctx2.rnti)
+    assert len(enb.rrc.ues) == 2, "old context stranded until the sweep"
+    Simulator.Stop(MilliSeconds(2 * LteEnbRrc.STRANDED_UE_LAPSE_MS))
+    Simulator.Run()
+    assert list(enb.rrc.ues) == [ctx2.rnti]
+
+
+def test_detach_during_pending_sweep_keeps_full_grace():
+    """Review fix: a detach landing while a sweep is already pending
+    keeps its OWN full lapse window (per-context timestamps) — a
+    re-attach inside that window survives the earlier-armed sweep."""
+    from tpudes.models.lte.device import (
+        LteEnbNetDevice,
+        LteEnbRrc,
+        LteUeNetDevice,
+    )
+
+    lapse = LteEnbRrc.STRANDED_UE_LAPSE_MS
+    enb = LteEnbNetDevice()
+    ue1, ue2 = LteUeNetDevice(), LteUeNetDevice()
+    ctx1 = enb.rrc.add_ue(ue1)
+    ue1.rrc.connect(enb, ctx1.rnti)
+    ctx2 = enb.rrc.add_ue(ue2)
+    ue2.rrc.connect(enb, ctx2.rnti)
+    ue1.rrc.disconnect()  # t=0: arms the sweep for t=lapse
+    # t=lapse-1: ue2 detaches; t=lapse+1: it re-attaches (same RNTI) —
+    # well inside ITS grace window even though the pending sweep fires
+    # at t=lapse, 1 ms after its detach
+    Simulator.Schedule(MilliSeconds(lapse - 1), ue2.rrc.disconnect)
+    Simulator.Schedule(
+        MilliSeconds(lapse + 1), lambda: ue2.rrc.connect(enb, ctx2.rnti)
+    )
+    Simulator.Stop(MilliSeconds(3 * lapse))
+    Simulator.Run()
+    assert ctx1.rnti not in enb.rrc.ues, "lapsed context reclaimed"
+    assert ctx2.rnti in enb.rrc.ues, "re-attach inside its grace survives"
+    assert enb.rrc._unclaimed_since == {}, "re-claimed context unmarked"

@@ -287,3 +287,69 @@ def test_csv_reader_types_comments_quotes():
     assert r.row_number == 2
     with pytest.raises(IndexError):
         r.GetValue(0)
+
+def test_building_floor_attributes_set_and_read_like_upstream():
+    """Promoted REG001 regression: the NFloors and Type attributes must
+    bind to live fields — settable at construction and via
+    SetAttribute, readable via the upstream getter surface."""
+    b = Building(x_min=0, x_max=10, y_min=0, y_max=10, z_min=0, z_max=12,
+                 NFloors=4, Type=Building.OFFICE)
+    assert b.GetNFloors() == 4
+    assert b.GetBuildingType() == Building.OFFICE
+    assert b.IsOffice() and not b.IsResidential()
+    assert b.GetAttribute("NFloors") == 4
+    assert b.GetAttribute("Type") == Building.OFFICE
+    b.SetAttribute("NFloors", 3)
+    b.SetAttribute("Type", Building.COMMERCIAL)
+    assert b.GetNFloors() == 3 and b.IsCommercial()
+    # floor classification: 12 m / 3 floors = 4 m per floor
+    assert b.floor_height_m() == pytest.approx(4.0)
+    assert b.floor_at(1.5) == 0
+    assert b.floor_at(5.0) == 1
+    assert b.floor_at(11.9) == 2
+    assert b.floor_at(12.0) == 2  # clamped at the roof
+
+
+def test_same_building_floor_penetration_by_type():
+    """ITU-R P.1238 floor factors (upstream itu-r-1238 model): the
+    loss model must charge Lf for endpoints sharing a multi-floor
+    building, by building type, and nothing for same-floor pairs."""
+    from tpudes.models.buildings import batch_floor_penetration
+
+    b = Building(x_min=0, x_max=20, y_min=0, y_max=20, z_min=0, z_max=9,
+                 NFloors=3, Type=Building.RESIDENTIAL)
+    ground = np.array([[5.0, 5.0, 1.5]])
+    same = np.array([[15.0, 15.0, 1.5]])     # same floor
+    one_up = np.array([[5.0, 5.0, 4.5]])     # floor 1
+    two_up = np.array([[5.0, 5.0, 7.5]])     # floor 2
+    outside = np.array([[50.0, 50.0, 1.5]])
+    assert batch_floor_penetration(ground, same)[0, 0] == 0.0
+    assert batch_floor_penetration(ground, one_up)[0, 0] == pytest.approx(4.0)
+    assert batch_floor_penetration(ground, two_up)[0, 0] == pytest.approx(8.0)
+    assert batch_floor_penetration(ground, outside)[0, 0] == 0.0
+
+    b.SetBuildingType(Building.OFFICE)       # 15 + 4(n-1)
+    assert batch_floor_penetration(ground, one_up)[0, 0] == pytest.approx(15.0)
+    assert batch_floor_penetration(ground, two_up)[0, 0] == pytest.approx(19.0)
+    b.SetBuildingType(Building.COMMERCIAL)   # 6 + 3(n-1)
+    assert batch_floor_penetration(ground, two_up)[0, 0] == pytest.approx(9.0)
+
+
+def test_loss_model_charges_floors_in_calc_rx_power():
+    """The scalar CalcRxPower path must see the floor term too (the
+    model routes through the batched kernel)."""
+    Building(x_min=0, x_max=20, y_min=0, y_max=20, z_min=0, z_max=9,
+             NFloors=3, Type=Building.RESIDENTIAL)
+
+    class M:
+        def __init__(self, x, y, z):
+            self._p = type("V", (), {"x": x, "y": y, "z": z})()
+
+        def GetPosition(self):
+            return self._p
+
+    model = BuildingsPropagationLossModel()
+    same = model.CalcRxPower(0.0, M(5, 5, 1.5), M(15, 15, 1.5))
+    up2 = model.CalcRxPower(0.0, M(5, 5, 1.5), M(5, 5, 7.5))
+    assert same == pytest.approx(0.0)     # indoor same floor: no walls
+    assert up2 == pytest.approx(-8.0)     # two floors at 4 dB each

@@ -6,12 +6,14 @@ hybrid-buildings-propagation-loss-model}.{h,cc} (upstream paths; mount
 empty at survey — SURVEY.md §0, §2.4 buildings row).
 
 A Building is an axis-aligned box with a type (residential/office/
-commercial) and external-wall material setting the per-wall penetration
-loss.  :class:`BuildingsPropagationLossModel` chains on any outdoor
-model and adds the penetration loss of every external wall the straight
-tx→rx segment crosses (indoor endpoints add their own wall) — the
-essential effect of upstream's hybrid model without its COST231/Okumura
-zoo (chain those separately if needed).
+commercial), a floor count, and an external-wall material setting the
+per-wall penetration loss.  :class:`BuildingsPropagationLossModel`
+chains on any outdoor model and adds the penetration loss of every
+external wall the straight tx→rx segment crosses (indoor endpoints add
+their own wall) plus, for endpoints sharing a multi-floor building,
+the ITU-R P.1238 floor-penetration factor by building type — the
+essential effects of upstream's hybrid model without its
+COST231/Okumura zoo (chain those separately if needed).
 
 TPU-first: the wall-crossing count is a vectorized slab test —
 ``batch_wall_crossings`` answers every (tx, rx) pair against every
@@ -88,8 +90,62 @@ class Building(Object):
             x0 <= pos.x <= x1 and y0 <= pos.y <= y1 and z0 <= pos.z <= z1
         )
 
+    # --- upstream Building surface (building.cc) -------------------------
+    def GetNFloors(self) -> int:
+        return int(self.n_floors)
+
+    def SetNFloors(self, n: int) -> None:
+        self.n_floors = int(n)
+
+    def GetBuildingType(self) -> int:
+        return int(self.building_type)
+
+    def SetBuildingType(self, t: int) -> None:
+        self.building_type = int(t)
+
+    def IsResidential(self) -> bool:
+        return self.building_type == self.RESIDENTIAL
+
+    def IsOffice(self) -> bool:
+        return self.building_type == self.OFFICE
+
+    def IsCommercial(self) -> bool:
+        return self.building_type == self.COMMERCIAL
+
+    def floor_height_m(self) -> float:
+        """Per-floor height: the box's z extent split evenly over the
+        declared floors (upstream MobilityBuildingInfo does the same
+        uniform split when classifying a position's floor)."""
+        x0, x1, y0, y1, z0, z1 = self.bounds
+        return (z1 - z0) / max(1, int(self.n_floors))
+
+    def floor_at(self, z: float) -> int:
+        """Floor index (0-based) of a height inside the building,
+        clamped to the declared floor count (upstream
+        mobility-building-info.cc MakeConsistent)."""
+        x0, x1, y0, y1, z0, z1 = self.bounds
+        h = self.floor_height_m()
+        return int(
+            np.clip((np.asarray(z, float) - z0) // h, 0, self.n_floors - 1)
+        )
+
     def wall_loss_db(self) -> float:
         return self.WALL_LOSS_DB[self.walls_type]
+
+    def floor_penetration_db(self, n_between):
+        """ITU-R P.1238 floor-penetration factor Lf for ``n_between``
+        floors separating tx and rx, by building type (upstream
+        itu-r-1238-propagation-loss-model.cc): residential 4n dB,
+        office 15+4(n-1) dB, commercial 6+3(n-1) dB; 0 on the same
+        floor.  Accepts scalars or arrays."""
+        n = np.asarray(n_between, float)
+        if self.building_type == self.RESIDENTIAL:
+            lf = 4.0 * n
+        elif self.building_type == self.OFFICE:
+            lf = 15.0 + 4.0 * (n - 1.0)
+        else:
+            lf = 6.0 + 3.0 * (n - 1.0)
+        return np.where(n > 0, lf, 0.0)
 
 
 def batch_wall_crossings(p_tx: np.ndarray, p_rx: np.ndarray) -> np.ndarray:
@@ -134,6 +190,36 @@ def batch_wall_crossings(p_tx: np.ndarray, p_rx: np.ndarray) -> np.ndarray:
     return loss
 
 
+def batch_floor_penetration(p_tx: np.ndarray, p_rx: np.ndarray) -> np.ndarray:
+    """(T, R) indoor floor-penetration loss (dB): for every tx/rx pair
+    BOTH inside the same multi-floor building, the ITU-R P.1238 Lf of
+    the floors separating them (:meth:`Building.floor_penetration_db`).
+    Pairs not sharing a building (or in single-floor boxes) add 0 —
+    their attenuation is the wall-crossing term."""
+    T, R = len(p_tx), len(p_rx)
+    loss = np.zeros((T, R))
+    for b in BuildingList.All():
+        if b.GetNFloors() <= 1:
+            continue
+        x0, x1, y0, y1, z0, z1 = b.bounds
+        lo = np.array([x0, y0, z0])
+        hi = np.array([x1, y1, z1])
+        in_tx = ((p_tx >= lo) & (p_tx <= hi)).all(axis=1)
+        in_rx = ((p_rx >= lo) & (p_rx <= hi)).all(axis=1)
+        if not (in_tx.any() and in_rx.any()):
+            continue
+        h = b.floor_height_m()
+        f_tx = np.clip((p_tx[:, 2] - z0) // h, 0, b.n_floors - 1)
+        f_rx = np.clip((p_rx[:, 2] - z0) // h, 0, b.n_floors - 1)
+        between = np.abs(f_tx[:, None] - f_rx[None, :])
+        loss += np.where(
+            in_tx[:, None] & in_rx[None, :],
+            b.floor_penetration_db(between),
+            0.0,
+        )
+    return loss
+
+
 class BuildingsPropagationLossModel(Object):
     """Chainable wall-penetration loss on top of any outdoor model
     (the HybridBuildings essence)."""
@@ -157,9 +243,13 @@ class BuildingsPropagationLossModel(Object):
         )
         if p_tx is None or p_rx is None:
             return base
-        return base - batch_wall_crossings(
-            np.asarray(p_tx, float), np.asarray(p_rx, float)
-        )
+        a = np.asarray(p_tx, float)
+        b = np.asarray(p_rx, float)
+        # wall crossings for pairs the segment takes through walls;
+        # floor penetration for pairs sharing a multi-floor building
+        # (disjoint cases: a same-building segment crosses no external
+        # wall, so the two terms never double-count)
+        return base - batch_wall_crossings(a, b) - batch_floor_penetration(a, b)
 
     def CalcRxPower(self, tx_power_dbm, mob_a, mob_b) -> float:
         import math

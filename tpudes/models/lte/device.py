@@ -63,12 +63,33 @@ class UeContext:
 
 
 class LteEnbRrc:
-    """eNB-side ideal RRC: RNTI allocation + bearer setup."""
+    """eNB-side ideal RRC: RNTI allocation + bearer setup.
+
+    A UE that detaches WITHOUT the explicit :meth:`remove_ue` path
+    (reconnects to another cell — or the SAME cell under a fresh
+    RNTI — or releases via :meth:`LteUeRrc.disconnect`) would strand
+    its :class:`UeContext` here forever — upstream reclaims these
+    through the RRC connection-release/inactivity machinery.  The
+    analog here is the PR-6 A3-handover lapse-sweep pattern: the
+    departing UE's RRC pings :meth:`note_detach`, which timestamps
+    every context its UE no longer claims and arms a sweep that drops
+    the ones STILL unclaimed a full lapse window later (per-context
+    timestamps, so a detach landing while a sweep is already pending
+    keeps its own full grace window; a re-claimed context is simply
+    unmarked)."""
+
+    #: grace (ms) between a noted detach and the stranded-context
+    #: sweep reclaiming unclaimed contexts — the ideal-RRC analog of
+    #: upstream's connection-release timeout
+    STRANDED_UE_LAPSE_MS = 100
 
     def __init__(self, enb_device: "LteEnbNetDevice"):
         self.device = enb_device
         self.ues: dict[int, UeContext] = {}
         self._next_rnti = 1
+        self._sweep_ev = None
+        #: rnti -> ms timestamp the context was first seen unclaimed
+        self._unclaimed_since: dict[int, int] = {}
 
     def add_ue(self, ue_device: "LteUeNetDevice") -> UeContext:
         rnti = self._next_rnti
@@ -80,7 +101,65 @@ class LteEnbRrc:
     def remove_ue(self, rnti: int) -> "UeContext | None":
         """Handover departure: drop the context (the caller carries the
         bearers to the target cell)."""
+        self._unclaimed_since.pop(rnti, None)
         return self.ues.pop(rnti, None)
+
+    # --- stranded-context expiry -----------------------------------------
+
+    def _claimed(self, ctx: UeContext) -> bool:
+        """Does the UE still claim this context as its serving cell?"""
+        rrc = ctx.ue_device.rrc
+        return (
+            rrc.serving_enb is self.device
+            and rrc.rnti == ctx.rnti
+            and rrc.state == LteUeRrc.CONNECTED
+        )
+
+    def note_detach(self, ue_device=None) -> None:
+        """A UE left this cell outside :meth:`remove_ue` (re-attach
+        elsewhere or to this same cell under a new RNTI, RRC release):
+        timestamp every now-unclaimed context and arm the sweep.  The
+        sweep, not this note, does the reclaiming — each context gets
+        its own full lapse window from the moment it was first seen
+        unclaimed, so an in-flight re-attach has time to land even
+        when a sweep armed by an earlier detach is already pending."""
+        del ue_device  # the scan below re-checks every context anyway
+        from tpudes.core.simulator import Simulator
+
+        now = int(Simulator.Now().GetMilliSeconds())
+        for rnti, ctx in self.ues.items():
+            if not self._claimed(ctx):
+                self._unclaimed_since.setdefault(rnti, now)
+        if self._unclaimed_since:
+            self._arm_sweep()
+
+    def _arm_sweep(self) -> None:
+        from tpudes.core.nstime import MilliSeconds
+        from tpudes.core.simulator import Simulator
+
+        if self._sweep_ev is not None and not self._sweep_ev.IsExpired():
+            return
+        self._sweep_ev = Simulator.Schedule(
+            MilliSeconds(self.STRANDED_UE_LAPSE_MS), self._sweep_stranded
+        )
+
+    def _sweep_stranded(self) -> None:
+        """Drop every marked context still unclaimed a full lapse after
+        it was first seen unclaimed; unmark contexts that were
+        re-claimed (or already removed) meanwhile, and re-arm while any
+        marked context has lapse time left to serve."""
+        from tpudes.core.simulator import Simulator
+
+        now = int(Simulator.Now().GetMilliSeconds())
+        for rnti in list(self._unclaimed_since):
+            ctx = self.ues.get(rnti)
+            if ctx is None or self._claimed(ctx):
+                del self._unclaimed_since[rnti]
+            elif now - self._unclaimed_since[rnti] >= self.STRANDED_UE_LAPSE_MS:
+                del self.ues[rnti]
+                del self._unclaimed_since[rnti]
+        if self._unclaimed_since:
+            self._arm_sweep()
 
     def setup_bearer(self, ctx: UeContext, mode: str) -> RadioBearer:
         lcid = 3 + len(ctx.bearers)  # LCID 1-2 reserved for SRBs
@@ -108,9 +187,28 @@ class LteUeRrc:
         self.bearers: dict[int, RadioBearer] = {}
 
     def connect(self, enb_device: "LteEnbNetDevice", rnti: int) -> None:
+        prev = self.serving_enb
         self.serving_enb = enb_device
         self.rnti = rnti
         self.state = self.CONNECTED
+        # re-attach without the explicit remove_ue path — to another
+        # cell OR to this same cell under a fresh RNTI: let the
+        # previous serving cell's RRC reclaim any context this UE no
+        # longer claims (the stranded-entry sweep; a same-rnti
+        # reconnect stays claimed, so noting it is harmless)
+        if prev is not None:
+            prev.rrc.note_detach(self.device)
+
+    def disconnect(self) -> None:
+        """RRC connection release (UE-initiated / out-of-coverage):
+        the eNB-side context is reclaimed by its stranded-context
+        sweep after the lapse window."""
+        prev = self.serving_enb
+        self.state = self.IDLE
+        self.serving_enb = None
+        self.rnti = 0
+        if prev is not None:
+            prev.rrc.note_detach(self.device)
 
 
 class LteEnbNetDevice(NetDevice):

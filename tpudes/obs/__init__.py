@@ -41,6 +41,7 @@ from tpudes.obs.profiler import (
     RunStats,
     enabled,
 )
+from tpudes.obs.serving import ServingTelemetry, validate_serving_metrics
 
 __all__ = [
     "ChunkStream",
@@ -49,6 +50,7 @@ __all__ = [
     "HostProfiler",
     "InstrumentedScheduler",
     "RunStats",
+    "ServingTelemetry",
     "assert_valid_chrome_trace",
     "chrome_trace",
     "device_metrics_enabled",
@@ -56,4 +58,5 @@ __all__ = [
     "export_chrome_trace",
     "export_on_destroy",
     "validate_chrome_trace",
+    "validate_serving_metrics",
 ]

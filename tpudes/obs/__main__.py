@@ -1,12 +1,16 @@
-"""Validate a Chrome-trace export against the Trace Event format.
+"""Validate tpudes.obs export files against their schemas.
 
 Usage::
 
     python -m tpudes.obs <trace.json> [more.json ...]
+    python -m tpudes.obs --serving <metrics.json> [more.json ...]
 
-Exit 0 when every file is a valid trace, 1 on violations, 2 on usage /
-unreadable input.  This is the schema gate the CI smoke step runs over
-the trace exported by an example under ``TpudesObs=1``.
+Default mode checks Chrome-trace exports against the Trace Event
+format; ``--serving`` checks :class:`tpudes.obs.serving.ServingTelemetry`
+snapshot dumps against the serving-metrics schema.  Exit 0 when every
+file is valid, 1 on violations, 2 on usage / unreadable input.  These
+are the schema gates the CI smoke steps run over the artifacts an
+example (``TpudesObs=1``) and the serving smoke produce.
 """
 
 from __future__ import annotations
@@ -15,13 +19,18 @@ import json
 import sys
 
 from tpudes.obs.export import validate_chrome_trace
+from tpudes.obs.serving import validate_serving_metrics
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    serving = "--serving" in argv
+    argv = [a for a in argv if a != "--serving"]
     if not argv or any(a in ("-h", "--help") for a in argv):
         print(__doc__, file=sys.stderr)
         return 2
+    validate = validate_serving_metrics if serving else validate_chrome_trace
+    kind = "serving metrics" if serving else "Chrome trace"
     rc = 0
     for path in argv:
         try:
@@ -30,14 +39,17 @@ def main(argv: list[str] | None = None) -> int:
         except (OSError, json.JSONDecodeError) as e:
             print(f"{path}: unreadable ({e})", file=sys.stderr)
             return 2
-        problems = validate_chrome_trace(doc)
+        problems = validate(doc)
         if problems:
             rc = 1
             for p in problems:
                 print(f"{path}: {p}")
         else:
-            n = len(doc["traceEvents"])
-            print(f"{path}: valid Chrome trace ({n} records)")
+            n = (
+                len(doc["engines"]) if serving
+                else len(doc["traceEvents"])
+            )
+            print(f"{path}: valid {kind} ({n} records)")
     return rc
 
 

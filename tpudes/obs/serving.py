@@ -1,0 +1,240 @@
+"""Serving-layer observability: the StudyServer's metrics surface.
+
+:class:`ServingTelemetry` is the process-global registry
+:class:`tpudes.serving.StudyServer` records into — queue depth,
+coalesce rate, batch occupancy, per-engine launch latency and
+end-to-end study latency — and :func:`validate_serving_metrics` is the
+schema gate the CI serving smoke runs over a dumped snapshot
+(``python -m tpudes.obs --serving metrics.json``).
+
+The registry follows the :class:`tpudes.obs.device.CompileTelemetry`
+shape: recording is a dict update (always cheap, no knob), snapshots
+are computed on demand, and the latency samples are bounded rings
+(:data:`ServingTelemetry.CAP`) so a long-lived server cannot grow host
+memory without limit — percentiles describe the recent window, which
+is what operating dashboards want anyway.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ServingTelemetry", "validate_serving_metrics"]
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 1]) of a non-empty list."""
+    xs = sorted(samples)
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[idx]
+
+
+class ServingTelemetry:
+    """Process-wide serving metrics registry.
+
+    Counters are cumulative since the last :meth:`reset`; the latency
+    rings keep the most recent :data:`CAP` samples per engine.  A
+    *coalesced* launch is one that carried more than one real study;
+    *pad_points* counts the duplicated tail points a pow2 config-bucket
+    pad added (device work spent on no study — the occupancy cost of
+    executable reuse).
+    """
+
+    #: bound on retained latency samples per engine (recent window)
+    CAP = 4096
+
+    _counters: dict[str, int] = {}
+    _queue_depth = 0
+    _queue_depth_max = 0
+    _engines: dict[str, dict] = {}
+
+    # --- recording hooks (called by tpudes.serving) ----------------------
+
+    @classmethod
+    def _bump(cls, name: str, n: int = 1) -> None:
+        cls._counters[name] = cls._counters.get(name, 0) + int(n)
+
+    @classmethod
+    def _engine(cls, engine: str) -> dict:
+        return cls._engines.setdefault(
+            engine,
+            {
+                "launches": 0,
+                "studies": 0,
+                "coalesced_launches": 0,
+                "real_points": 0,
+                "padded_points": 0,
+                "launch_wall_s": [],
+                "study_latency_s": [],
+            },
+        )
+
+    @classmethod
+    def record_submit(cls, engine: str, queue_depth: int) -> None:
+        cls._bump("submitted")
+        cls._queue_depth = int(queue_depth)
+        cls._queue_depth_max = max(cls._queue_depth_max, int(queue_depth))
+
+    @classmethod
+    def record_reject(cls, tenant: str) -> None:
+        del tenant  # per-tenant breakdown is the server's, not global
+        cls._bump("rejected")
+
+    @classmethod
+    def record_dispatch(cls, engine: str, n_real: int, n_padded: int,
+                        queue_depth: int) -> None:
+        cls._queue_depth = int(queue_depth)
+        e = cls._engine(engine)
+        e["launches"] += 1
+        e["real_points"] += int(n_real)
+        e["padded_points"] += int(n_padded)
+        cls._bump("launches")
+        if n_real > 1:
+            e["coalesced_launches"] += 1
+            cls._bump("coalesced_launches")
+            cls._bump("coalesced_studies", n_real)
+        cls._bump("pad_points", int(n_padded) - int(n_real))
+
+    @classmethod
+    def record_launch_done(cls, engine: str, wall_s: float) -> None:
+        ring = cls._engine(engine)["launch_wall_s"]
+        ring.append(float(wall_s))
+        del ring[: max(0, len(ring) - cls.CAP)]
+
+    @classmethod
+    def record_study_done(cls, engine: str, latency_s: float) -> None:
+        e = cls._engine(engine)
+        e["studies"] += 1
+        cls._bump("completed")
+        ring = e["study_latency_s"]
+        ring.append(float(latency_s))
+        del ring[: max(0, len(ring) - cls.CAP)]
+
+    @classmethod
+    def record_queue_depth(cls, depth: int) -> None:
+        cls._queue_depth = int(depth)
+        cls._queue_depth_max = max(cls._queue_depth_max, int(depth))
+
+    @classmethod
+    def record_warm(cls, engine: str, n_programs: int, wall_s: float) -> None:
+        del engine
+        cls._bump("warm_programs", n_programs)
+        cls._warm_wall = getattr(cls, "_warm_wall", 0.0) + float(wall_s)
+
+    # --- reading ----------------------------------------------------------
+
+    @classmethod
+    def snapshot(cls) -> dict:
+        """The exported metrics document (see
+        :func:`validate_serving_metrics` for the schema)."""
+
+        def dist(ring: list[float]) -> dict:
+            if not ring:
+                return {"p50": 0.0, "p99": 0.0, "n": 0}
+            return {
+                "p50": round(_percentile(ring, 0.50), 6),
+                "p99": round(_percentile(ring, 0.99), 6),
+                "n": len(ring),
+            }
+
+        counters = {
+            k: cls._counters.get(k, 0)
+            for k in (
+                "submitted", "completed", "rejected", "launches",
+                "coalesced_launches", "coalesced_studies", "pad_points",
+                "warm_programs",
+            )
+        }
+        done = counters["completed"]
+        engines = {}
+        for name, e in sorted(cls._engines.items()):
+            occupancy = (
+                e["real_points"] / e["padded_points"]
+                if e["padded_points"]
+                else 0.0
+            )
+            engines[name] = {
+                "launches": e["launches"],
+                "studies": e["studies"],
+                "coalesced_launches": e["coalesced_launches"],
+                "batch_occupancy": round(occupancy, 4),
+                "launch_wall_s": dist(e["launch_wall_s"]),
+                "study_latency_s": dist(e["study_latency_s"]),
+            }
+        return {
+            "version": 1,
+            "counters": counters,
+            "coalesce_rate": round(
+                counters["coalesced_studies"] / done, 4
+            ) if done else 0.0,
+            "warm_wall_s": round(getattr(cls, "_warm_wall", 0.0), 3),
+            "queue": {
+                "depth": cls._queue_depth,
+                "depth_max": cls._queue_depth_max,
+            },
+            "engines": engines,
+        }
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._counters = {}
+        cls._engines = {}
+        cls._queue_depth = 0
+        cls._queue_depth_max = 0
+        cls._warm_wall = 0.0
+
+
+def validate_serving_metrics(doc) -> list[str]:
+    """Schema check for a :meth:`ServingTelemetry.snapshot` document
+    (dependency-free, mirroring ``validate_chrome_trace``).  Returns a
+    list of human-readable problems; empty means valid."""
+    problems: list[str] = []
+
+    def need(obj, key, types, where):
+        if not isinstance(obj, dict):
+            problems.append(f"{where}: not an object")
+            return None
+        if key not in obj:
+            problems.append(f"{where}: missing key {key!r}")
+            return None
+        if not isinstance(obj[key], types):
+            problems.append(
+                f"{where}.{key}: expected {types}, got "
+                f"{type(obj[key]).__name__}"
+            )
+            return None
+        return obj[key]
+
+    if not isinstance(doc, dict):
+        return ["top level: not a JSON object"]
+    if doc.get("version") != 1:
+        problems.append("version: expected 1")
+    counters = need(doc, "counters", dict, "top level")
+    if counters is not None:
+        for k in (
+            "submitted", "completed", "rejected", "launches",
+            "coalesced_launches", "coalesced_studies", "pad_points",
+        ):
+            v = need(counters, k, int, "counters")
+            if isinstance(v, int) and v < 0:
+                problems.append(f"counters.{k}: negative")
+    need(doc, "coalesce_rate", (int, float), "top level")
+    queue = need(doc, "queue", dict, "top level")
+    if queue is not None:
+        need(queue, "depth", int, "queue")
+        need(queue, "depth_max", int, "queue")
+    engines = need(doc, "engines", dict, "top level")
+    if engines is not None:
+        for name, e in engines.items():
+            where = f"engines.{name}"
+            need(e, "launches", int, where)
+            need(e, "studies", int, where)
+            need(e, "coalesced_launches", int, where)
+            occ = need(e, "batch_occupancy", (int, float), where)
+            if occ is not None and not (0.0 <= occ <= 1.0):
+                problems.append(f"{where}.batch_occupancy: not in [0, 1]")
+            for dk in ("launch_wall_s", "study_latency_s"):
+                d = need(e, dk, dict, where)
+                if d is not None:
+                    need(d, "p50", (int, float), f"{where}.{dk}")
+                    need(d, "p99", (int, float), f"{where}.{dk}")
+                    need(d, "n", int, f"{where}.{dk}")
+    return problems

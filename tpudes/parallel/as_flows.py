@@ -257,6 +257,42 @@ def _as_unpack(host: dict, replicas: int) -> dict:
     }
 
 
+def as_study(prog: AsFlowsProgram, key, replicas, mesh=None,
+             rate_scale: float = 1.0):
+    """Serving-layer study descriptor (see :mod:`tpudes.serving`): the
+    offered-load multiplier is the traced sweep operand, so two AS
+    load studies coalesce onto one launch whenever their topology,
+    flows, key, replica count and mesh all match.  A lone study still
+    launches through ``rate_scale=[x]`` (the fluid engine has no plain
+    scalar-scale entry), which the sweep equality tests pin equal to
+    the unswept run at scale 1."""
+    from tpudes.serving.descriptor import StudyDescriptor, mesh_fingerprint
+
+    ck = (
+        prog.edges.tobytes(), prog.delay_s.tobytes(),
+        prog.rate_bps.tobytes(), prog.src.tobytes(), prog.dst.tobytes(),
+        prog.flow_bps.tobytes(), prog.pkt_bytes, prog.max_hops,
+        prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
+        np.asarray(key).tobytes(), int(replicas), mesh_fingerprint(mesh),
+    )
+
+    def launch(points, block=False):
+        return run_as_flows(
+            prog, key, replicas=replicas, mesh=mesh,
+            rate_scale=[float(v) for v in points], block=block,
+        )
+
+    def warm(n_points):
+        # no horizon to shrink: the fixed point's cost is topology-
+        # bound, so warming runs the real relaxation once per bucket
+        run_as_flows(
+            prog, key, replicas=replicas, mesh=mesh,
+            rate_scale=[1.0] * n_points,
+        )
+
+    return StudyDescriptor("as_flows", ck, float(rate_scale), launch, warm)
+
+
 def run_as_flows(
     prog: AsFlowsProgram,
     key,

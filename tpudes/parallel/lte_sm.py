@@ -290,6 +290,51 @@ def _sm_unpack(host: dict, consts_np: dict, replicas) -> dict:
     return result
 
 
+def lte_sm_study(prog: LteSmProgram, key, replicas=None, mesh=None):
+    """Serving-layer study descriptor (see :mod:`tpudes.serving`): the
+    scheduler is the traced sweep operand, so two full-buffer studies
+    coalesce onto one (C, R, …) launch whenever their static program
+    fields, horizon, key, replica count and mesh all match — only the
+    FF-MAC scheduler may differ."""
+    import dataclasses
+
+    from tpudes.serving.descriptor import StudyDescriptor, mesh_fingerprint
+
+    ck = (
+        prog.gain.tobytes(), prog.serving.tobytes(),
+        prog.tx_power_dbm.tobytes(), prog.noise_psd, prog.n_rb,
+        prog.pf_alpha, prog.precision, prog.n_ttis,
+        np.asarray(key).tobytes(), replicas, mesh_fingerprint(mesh),
+    )
+
+    def launch(points, block=False):
+        # a single point rides the PLAIN entry so it shares the common
+        # non-sweep executable with every non-serving caller
+        if len(points) == 1:
+            return run_lte_sm(
+                dataclasses.replace(prog, scheduler=points[0]), key,
+                replicas=replicas, mesh=mesh, block=block,
+            )
+        return run_lte_sm(
+            prog, key, replicas=replicas, mesh=mesh,
+            schedulers=list(points), block=block,
+        )
+
+    def warm(n_points):
+        # the horizon is a traced operand: a 1-TTI run compiles the
+        # exact executable every real horizon reuses
+        tiny = dataclasses.replace(prog, n_ttis=1)
+        if n_points == 1:
+            run_lte_sm(tiny, key, replicas=replicas, mesh=mesh)
+        else:
+            run_lte_sm(
+                tiny, key, replicas=replicas, mesh=mesh,
+                schedulers=[prog.scheduler] * n_points,
+            )
+
+    return StudyDescriptor("lte_sm", ck, prog.scheduler, launch, warm)
+
+
 def run_lte_sm(
     prog: LteSmProgram,
     key,

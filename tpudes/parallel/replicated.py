@@ -771,6 +771,48 @@ def _bss_unpack(host: dict, replicas: int, obs: bool) -> dict:
     return result
 
 
+def bss_study(prog: BssProgram, key, replicas, mesh=None):
+    """Serving-layer study descriptor (see :mod:`tpudes.serving`): the
+    sim-end horizon is the traced sweep operand, so two BSS studies
+    coalesce onto one (C, R, …) launch whenever their static program
+    fields, key, replica count and mesh all match — only ``sim_end_us``
+    may differ (the sweep shares one step budget; finished replicas are
+    fixed points of the step, so outcomes stay bit-equal)."""
+    import dataclasses
+
+    from tpudes.serving.descriptor import StudyDescriptor, mesh_fingerprint
+
+    ck = (
+        _prog_cache_key(prog), np.asarray(key).tobytes(), int(replicas),
+        mesh_fingerprint(mesh),
+    )
+
+    def launch(points, block=False):
+        if len(points) == 1:
+            return run_replicated_bss(
+                dataclasses.replace(prog, sim_end_us=int(points[0])),
+                replicas, key, mesh=mesh, block=block,
+            )
+        return run_replicated_bss(
+            prog, replicas, key, mesh=mesh,
+            sim_end_us=[int(v) for v in points], block=block,
+        )
+
+    def warm(n_points):
+        # sim_end and max_steps are traced: a ~1 ms horizon compiles
+        # the exact executable every real horizon reuses
+        tiny = dataclasses.replace(prog, sim_end_us=1000)
+        if n_points == 1:
+            run_replicated_bss(tiny, replicas, key, mesh=mesh)
+        else:
+            run_replicated_bss(
+                tiny, replicas, key, mesh=mesh,
+                sim_end_us=[tiny.sim_end_us] * n_points,
+            )
+
+    return StudyDescriptor("bss", ck, int(prog.sim_end_us), launch, warm)
+
+
 def run_replicated_bss(
     prog: BssProgram,
     replicas: int,

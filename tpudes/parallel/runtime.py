@@ -459,6 +459,20 @@ class EngineRuntime:
         while self._inflight:
             self._inflight[0].result()
 
+    def poll(self) -> int:
+        """Retire (fetch + unpack) every in-flight future whose device
+        work has already FINISHED — never blocks.  The serving layer's
+        window sweep: between dispatches the StudyServer polls so
+        completed launches leave the in-flight window (and free their
+        device buffers) without a blocking ``result()`` serializing the
+        scheduler on still-running work.  Returns the number retired."""
+        n = 0
+        for fut in list(self._inflight):
+            if fut.done():
+                fut.result()
+                n += 1
+        return n
+
     def record_launch(self, engine: str, n: int = 1) -> None:
         """Count one device dispatch — the sweep tests pin that an
         8-point config-axis sweep is exactly ONE of these."""

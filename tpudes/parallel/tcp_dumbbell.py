@@ -1026,6 +1026,66 @@ def _tcp_unpack(host: dict, prog: DumbbellProgram, replicas: int,
     return result
 
 
+def tcp_study(prog: DumbbellProgram, key, replicas, mesh=None):
+    """Serving-layer study descriptor (see :mod:`tpudes.serving`): the
+    per-flow variant/ECN assignment is the traced sweep operand, so two
+    dumbbell studies coalesce onto one (C, R, F) launch whenever their
+    static fields, slot horizon, key, replica count and mesh all match.
+
+    A program whose declared ``ecn`` disagrees with the variants'
+    ``REQUIRES_ECN`` flags is marked ``solo``: sweep points derive ECN
+    from the variant (the PR-5 equality contract), so such a study can
+    only be served bit-faithfully by its own plain launch."""
+    import dataclasses
+
+    from tpudes.serving.descriptor import StudyDescriptor, mesh_fingerprint
+
+    ids = np.asarray(prog.variant_idx, np.int32)
+    declared = (
+        np.asarray(prog.ecn, bool) if prog.ecn is not None
+        else np.zeros(prog.n_flows, bool)
+    )
+    solo = not np.array_equal(declared, _variant_ecn(ids))
+    statics = tuple(
+        v.tobytes() if isinstance(v, np.ndarray) else v
+        for k, v in prog.__dict__.items()
+        if k not in ("variant_idx", "ecn")
+    )  # n_slots stays IN: the batch shares one traced slot bound
+    ck = (
+        statics, np.asarray(key).tobytes(), int(replicas),
+        mesh_fingerprint(mesh),
+    )
+    point = tuple(int(i) for i in ids)
+
+    def launch(points, block=False):
+        if solo or len(points) == 1:
+            pt = _variant_point(list(points[0]))
+            p1 = prog if solo else dataclasses.replace(
+                prog, variant_idx=pt, ecn=_variant_ecn(pt)
+            )
+            return run_tcp_dumbbell(
+                p1, key, replicas=replicas, mesh=mesh, block=block
+            )
+        return run_tcp_dumbbell(
+            prog, key, replicas=replicas, mesh=mesh,
+            variants=[list(p) for p in points], block=block,
+        )
+
+    def warm(n_points):
+        # the slot horizon is a traced operand: a 1-slot run compiles
+        # the exact executable every real horizon reuses
+        tiny = dataclasses.replace(prog, n_slots=1)
+        if n_points == 1:
+            run_tcp_dumbbell(tiny, key, replicas=replicas, mesh=mesh)
+        else:
+            run_tcp_dumbbell(
+                tiny, key, replicas=replicas, mesh=mesh,
+                variants=[list(point)] * n_points,
+            )
+
+    return StudyDescriptor("dumbbell", ck, point, launch, warm, solo=solo)
+
+
 def run_tcp_dumbbell(
     prog: DumbbellProgram,
     key,

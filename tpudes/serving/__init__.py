@@ -1,0 +1,36 @@
+"""tpudes.serving — simulation-as-a-service on the engine runtime.
+
+A long-lived :class:`StudyServer` accepts independently arriving
+*studies* (one lowered device program + key + replicas each) and
+coalesces compatible ones onto shared megabatched config-axis device
+launches — continuous batching for simulation studies, built on the
+PR-5 sweep arguments whose per-point results are pinned bit-equal to
+solo launches.  See :mod:`tpudes.serving.server` for the scheduling
+story (batching deadline, admission control, pow2 batch buckets, warm
+pool) and :mod:`tpudes.obs.serving` for the metrics surface.
+
+Quick start::
+
+    from tpudes.serving import StudyServer
+
+    server = StudyServer(max_wait_s=0.005, max_batch=8)
+    handles = [
+        server.submit_study("lte_sm", prog, key, replicas=64,
+                            tenant=f"user{i}")
+        for i, prog in enumerate(programs)      # e.g. 9 schedulers
+    ]
+    results = [h.result() for h in handles]     # demuxed per study
+    print(server.metrics()["coalesce_rate"])
+    server.close()
+"""
+
+from tpudes.serving.descriptor import StudyDescriptor, mesh_fingerprint
+from tpudes.serving.server import AdmissionError, StudyHandle, StudyServer
+
+__all__ = [
+    "AdmissionError",
+    "StudyDescriptor",
+    "StudyHandle",
+    "StudyServer",
+    "mesh_fingerprint",
+]

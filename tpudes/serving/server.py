@@ -1,0 +1,436 @@
+"""StudyServer: continuous batching of independently arriving studies.
+
+The long-lived serving layer on top of :data:`tpudes.parallel.runtime.RUNTIME`
+(ROADMAP item 1): clients call :meth:`StudyServer.submit_study` and get a
+:class:`StudyHandle` back immediately; a coalescing scheduler drains the
+request queue and merges **compatible** studies — same engine, same
+static cache key; differences only in traced operands (scheduler id,
+TCP variant assignment, BSS horizon, AS load scale) — into ONE
+megabatched config-axis device launch, demultiplexing per-study results
+back through each handle.  This is the simulator analog of continuous
+batching in LLM serving: the hardware sees dense (C, R, …) launches
+even when every study arrives alone.
+
+Correctness is inherited, not approximated: the PR-5 sweep arguments
+are pinned bit-equal to per-point solo launches (tests/test_sweep.py),
+and the server only ever merges studies whose coalesce keys match —
+everything the executable or the PRNG streams depend on is in the key,
+so a coalesced result IS the solo result (tests/test_serving.py pins
+this end to end for all four engines).
+
+Operating behavior:
+
+- **Batching deadline** (``max_wait_s``): the head-of-queue study waits
+  at most this long for batchmates; a lone study is dispatched alone at
+  the deadline, never starved.
+- **Admission control**: per-tenant cap on queued+in-flight studies
+  (:class:`AdmissionError` on overflow) in front of the device-side
+  bounded in-flight window (``TPUDES_INFLIGHT``) that
+  :meth:`EngineRuntime.submit` enforces at dispatch.
+- **pow2 batch buckets**: a coalesced batch pads its config axis to the
+  next power of two by duplicating the tail point (results discarded),
+  so the server compiles one executable per bucket, not per batch size;
+  single studies ride the engines' plain entry points and share the
+  common non-sweep executables.
+- **Warm pool** (:meth:`warm`): pre-compiles the hot engine/bucket set
+  at server start — with ``TPUDES_CACHE_DIR`` armed these become
+  persistent-cache disk hits instead of fresh XLA compiles.
+- **Metrics**: every decision is recorded in
+  :class:`tpudes.obs.serving.ServingTelemetry` (queue depth, coalesce
+  rate, batch occupancy, launch latency p50/p99); :meth:`metrics`
+  snapshots it and ``python -m tpudes.obs --serving dump.json``
+  validates a dump.
+
+Threading model: ALL device work (launch, D2H, unpack) happens on the
+single scheduler thread (or the caller's thread via :meth:`pump` when
+constructed with ``start=False`` — the deterministic mode tests use).
+Client threads only build descriptors, enqueue, and wait on events.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from tpudes.obs.serving import ServingTelemetry
+from tpudes.serving.descriptor import StudyDescriptor
+
+__all__ = ["AdmissionError", "StudyHandle", "StudyServer"]
+
+
+class AdmissionError(RuntimeError):
+    """The tenant's queued+in-flight study cap is exhausted; retry
+    after some of its studies complete."""
+
+
+#: engine name -> (module, study-descriptor extraction function); the
+#: lazy import keeps tpudes.serving importable without pulling every
+#: engine (and jax) in at module import
+_ENGINE_STUDY = {
+    "bss": ("tpudes.parallel.replicated", "bss_study"),
+    "lte_sm": ("tpudes.parallel.lte_sm", "lte_sm_study"),
+    "dumbbell": ("tpudes.parallel.tcp_dumbbell", "tcp_study"),
+    "as_flows": ("tpudes.parallel.as_flows", "as_study"),
+}
+
+
+class StudyHandle:
+    """Client-side future for one submitted study."""
+
+    def __init__(self, engine: str, tenant: str):
+        self.engine = engine
+        self.tenant = tenant
+        #: how many real studies shared this study's launch (set at
+        #: completion; 1 means it was dispatched alone)
+        self.batch_size: int | None = None
+        self._ev = threading.Event()
+        self._result = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: float | None = None):
+        """Block until the study completes; raises the launch error if
+        its batch failed, TimeoutError past ``timeout``."""
+        if not self._ev.wait(timeout):
+            raise TimeoutError(
+                f"study ({self.engine}, tenant={self.tenant!r}) not "
+                f"complete within {timeout} s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _complete(self, result=None, error=None, batch_size=None) -> None:
+        self._result = result
+        self._error = error
+        self.batch_size = batch_size
+        self._ev.set()
+
+
+@dataclass
+class _Request:
+    desc: StudyDescriptor
+    tenant: str
+    handle: StudyHandle
+    t_submit: float
+
+
+def _pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class StudyServer:
+    """The coalescing scheduler + its request queue (module docstring
+    has the big picture)."""
+
+    def __init__(
+        self,
+        *,
+        max_wait_s: float = 0.01,
+        max_batch: int = 8,
+        tenant_cap: int = 64,
+        warm: list | None = None,
+        start: bool = True,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_wait_s = float(max_wait_s)
+        self.max_batch = int(max_batch)
+        self.tenant_cap = int(tenant_cap)
+        self._cond = threading.Condition()
+        self._queue: deque[_Request] = deque()
+        #: dispatched launches not yet demuxed: (future, batch, t0)
+        self._pending: deque[tuple] = deque()
+        self._tenant_load: dict[str, int] = {}
+        self._running = False
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        if warm:
+            self.warm(warm)
+        if start:
+            self.start()
+
+    # --- client surface ---------------------------------------------------
+
+    def submit_study(
+        self,
+        engine: str,
+        prog,
+        key,
+        replicas=None,
+        *,
+        mesh=None,
+        tenant: str = "default",
+        **engine_kwargs,
+    ) -> StudyHandle:
+        """Queue one study; returns immediately with its handle.
+
+        ``engine`` is one of ``bss`` / ``lte_sm`` / ``dumbbell`` /
+        ``as_flows``; ``prog`` the engine's lowered Program dataclass;
+        ``key``/``replicas``/``mesh`` exactly what the engine's
+        ``run_*`` entry takes.  Extra ``engine_kwargs`` flow to the
+        engine's study extractor (e.g. ``rate_scale=`` for the AS
+        engine).  Raises :class:`AdmissionError` when ``tenant``
+        already has ``tenant_cap`` studies queued or in flight."""
+        mod_name, fn_name = _ENGINE_STUDY[engine]
+        extract = getattr(importlib.import_module(mod_name), fn_name)
+        desc = extract(prog, key, replicas, mesh=mesh, **engine_kwargs)
+        return self.submit(desc, tenant=tenant)
+
+    def submit(self, desc: StudyDescriptor, tenant: str = "default"
+               ) -> StudyHandle:
+        """Queue a pre-extracted :class:`StudyDescriptor`."""
+        handle = StudyHandle(desc.engine, tenant)
+        with self._cond:
+            if self._closed:
+                # a closed server never strands a handle — including
+                # one a racing submit would otherwise enqueue after
+                # the drain
+                raise RuntimeError("StudyServer is closed")
+            if self._tenant_load.get(tenant, 0) >= self.tenant_cap:
+                ServingTelemetry.record_reject(tenant)
+                raise AdmissionError(
+                    f"tenant {tenant!r} has {self.tenant_cap} studies "
+                    "queued/in flight (tenant_cap)"
+                )
+            self._tenant_load[tenant] = self._tenant_load.get(tenant, 0) + 1
+            self._queue.append(
+                _Request(desc, tenant, handle, time.monotonic())
+            )
+            ServingTelemetry.record_submit(desc.engine, len(self._queue))
+            self._cond.notify_all()
+        return handle
+
+    def metrics(self) -> dict:
+        """Snapshot of the process-global serving telemetry (see
+        :func:`tpudes.obs.serving.validate_serving_metrics`)."""
+        return ServingTelemetry.snapshot()
+
+    # --- warm pool --------------------------------------------------------
+
+    def warm(self, studies: list, buckets: tuple | None = None) -> int:
+        """Pre-compile the executables the given example studies will
+        need: for each distinct coalesce key, the plain single-study
+        program plus each pow2 config-axis bucket up to the one
+        ``max_batch`` pads into (the default ``buckets``) — so no batch
+        size the server can ever dispatch pays a fresh compile on the
+        serving path.  ``studies`` holds :class:`StudyDescriptor`
+        objects or dicts of :meth:`submit_study` keyword arguments.
+        Returns the number of warm launches performed (each a
+        minimal-horizon run — a persistent-cache disk hit when
+        ``TPUDES_CACHE_DIR`` is set)."""
+        top = _pow2(max(1, self.max_batch))
+        if buckets is None:
+            buckets = tuple(1 << i for i in range(top.bit_length()))
+        n = 0
+        seen: set = set()
+        t0 = time.monotonic()
+        for study in studies:
+            desc = study
+            if isinstance(study, dict):
+                kw = dict(study)
+                mod_name, fn_name = _ENGINE_STUDY[kw.pop("engine")]
+                extract = getattr(
+                    importlib.import_module(mod_name), fn_name
+                )
+                desc = extract(
+                    kw.pop("prog"), kw.pop("key"),
+                    kw.pop("replicas", None), **kw,
+                )
+            if desc.warm is None or desc.coalesce_key in seen:
+                continue
+            seen.add(desc.coalesce_key)
+            for b in buckets if not desc.solo else (1,):
+                if b > top:
+                    continue
+                desc.warm(int(b))
+                n += 1
+        if n:
+            ServingTelemetry.record_warm(
+                "all", n, time.monotonic() - t0
+            )
+        return n
+
+    # --- scheduler --------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the background scheduler thread (idempotent)."""
+        with self._cond:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="tpudes-study-server", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop the scheduler, force-dispatching and completing every
+        queued/in-flight study first (a closed server never strands a
+        handle)."""
+        thread = self._thread
+        with self._cond:
+            self._running = False
+            self._closed = True
+            self._cond.notify_all()
+        if thread is not None:
+            thread.join()
+            self._thread = None
+        else:
+            self.pump(force=True)  # start=False server: drain inline
+
+    def pump(self, force: bool = True) -> int:
+        """Synchronously dispatch what is due (everything queued when
+        ``force``) and demux every completed launch — the deterministic
+        single-thread mode (``start=False``); returns the number of
+        studies completed.  Must not be called while the background
+        thread runs."""
+        done = 0
+        while True:
+            with self._cond:
+                batch = self._take_batch(force=force)
+            if batch is None:
+                break
+            self._dispatch(batch)
+        while self._pending:
+            done += self._demux_oldest()
+        return done
+
+    def _loop(self) -> None:
+        from tpudes.parallel.runtime import RUNTIME
+
+        while True:
+            batch = None
+            with self._cond:
+                if (
+                    not self._running
+                    and not self._queue
+                    and not self._pending
+                ):
+                    return
+                batch = self._take_batch(force=not self._running)
+                if batch is None and self._queue and self._running:
+                    # head not due: sleep until its deadline or a new
+                    # arrival, whichever first
+                    head_age = time.monotonic() - self._queue[0].t_submit
+                    self._cond.wait(
+                        timeout=max(0.001, self.max_wait_s - head_age)
+                    )
+                    batch = self._take_batch(force=not self._running)
+                elif batch is None and not self._pending and self._running:
+                    self._cond.wait(timeout=0.05)
+            if batch is not None:
+                self._dispatch(batch)
+                RUNTIME.poll()  # sweep the window, never blocks
+            # demux finished launches; a blocking result() would pin
+            # the scheduler to one launch wall while a fresh arrival
+            # could be dispatching into the window, so while live we
+            # only nap (woken early by any submit) and retire done work
+            while self._pending and self._pending[0][0].done():
+                self._demux_oldest()
+            if batch is None and self._pending and not self._queue:
+                if self._running:
+                    with self._cond:
+                        if self._running and not self._queue:
+                            self._cond.wait(timeout=0.002)
+                else:
+                    self._demux_oldest()  # shutdown drain: block
+
+    def _take_batch(self, force: bool) -> list | None:
+        """Pop the head study's batch when it is due (caller holds the
+        lock): due = solo study, batch full, deadline reached, or
+        ``force``.  Batchmates are every queued request sharing the
+        head's coalesce key, in arrival order, up to ``max_batch``."""
+        if not self._queue:
+            return None
+        head = self._queue[0]
+        if head.desc.solo:
+            mates = [head]
+        else:
+            mates = [
+                r for r in self._queue
+                if r.desc.compatible(head.desc)
+            ][: self.max_batch]
+        due = (
+            force
+            or head.desc.solo
+            or len(mates) >= self.max_batch
+            or (time.monotonic() - head.t_submit) >= self.max_wait_s
+        )
+        if not due:
+            return None
+        for r in mates:
+            self._queue.remove(r)
+        ServingTelemetry.record_queue_depth(len(self._queue))
+        return mates
+
+    def _dispatch(self, batch: list) -> None:
+        """Launch one (possibly coalesced) batch through the runtime's
+        bounded in-flight window.  Never raises: a failed launch
+        poisons the batch's handles instead of killing the scheduler."""
+        from tpudes.parallel.runtime import RUNTIME
+
+        points = [r.desc.sweep_point for r in batch]
+        n_real = len(points)
+        if n_real > 1:
+            # pad the config axis to the pow2 bucket by duplicating the
+            # tail point: one executable per bucket, not per batch size
+            points = points + [points[-1]] * (_pow2(n_real) - n_real)
+        t0 = time.monotonic()
+        try:
+            fut = RUNTIME.submit(batch[0].desc.launch, points)
+        except Exception as e:  # noqa: BLE001 - poison, don't crash
+            self._finish_batch(batch, error=e, n_real=n_real)
+            return
+        with self._cond:
+            queue_depth = len(self._queue)
+        ServingTelemetry.record_dispatch(
+            batch[0].desc.engine, n_real, len(points), queue_depth
+        )
+        self._pending.append((fut, batch, t0))
+
+    def _demux_oldest(self) -> int:
+        """Retire the oldest pending launch and complete its handles."""
+        fut, batch, t0 = self._pending.popleft()
+        engine = batch[0].desc.engine
+        try:
+            res = fut.result()
+        except Exception as e:  # noqa: BLE001 - poison, don't crash
+            self._finish_batch(batch, error=e, n_real=len(batch))
+            return len(batch)
+        ServingTelemetry.record_launch_done(
+            engine, time.monotonic() - t0
+        )
+        results = res if isinstance(res, list) else [res]
+        now = time.monotonic()
+        for r, out in zip(batch, results):  # pad tail dropped by zip
+            r.handle._complete(result=out, batch_size=len(batch))
+            ServingTelemetry.record_study_done(engine, now - r.t_submit)
+            self._release(r.tenant)
+        return len(batch)
+
+    def _finish_batch(self, batch, error, n_real) -> None:
+        del n_real
+        for r in batch:
+            r.handle._complete(error=error, batch_size=len(batch))
+            self._release(r.tenant)
+
+    def _release(self, tenant: str) -> None:
+        with self._cond:
+            # decrement-only (never popped): the map is bounded by the
+            # distinct-tenant count, and a zero entry is a valid gauge
+            self._tenant_load[tenant] = self._tenant_load.get(tenant, 1) - 1
+            self._cond.notify_all()
+
+    # --- context manager ---------------------------------------------------
+
+    def __enter__(self) -> "StudyServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
