@@ -699,3 +699,121 @@ def test_trc_module_level_def_sink_is_resolved():
         sink, select=["TRC"],
         extra=[("tpudes/models/mac_fixture.py", _TRC_SOURCE)],
     ) == ["TRC001"]
+
+
+# --- cross-replica shape (SHP) --------------------------------------------
+
+def test_shp_trailing_replica_axis_flagged():
+    # per-replica state with the replica operand smuggled into a
+    # trailing position: traces fine, silently breaks sharding (axis
+    # match) and bucket slice-back (axis 0 slice)
+    src = """
+    import jax.numpy as jnp
+
+    def run_engine(prog, replicas):
+        state = jnp.zeros((prog.n, replicas))
+        return state
+    """
+    assert _codes(
+        src, path="tpudes/parallel/fixture.py", select=["SHP"]
+    ) == ["SHP001"]
+
+
+def test_shp_leading_replica_axis_and_outside_parallel_clean():
+    leading = """
+    import jax.numpy as jnp
+
+    def run_engine(prog, replicas):
+        r_pad = 1 << (replicas - 1).bit_length()
+        state = jnp.zeros((r_pad, prog.n))
+        hist = jnp.zeros((replicas, prog.n, 4))
+        return state, hist
+    """
+    assert _codes(
+        leading, path="tpudes/parallel/fixture.py", select=["SHP"]
+    ) == []
+    # the same trailing shape outside tpudes/parallel/ is host-side
+    # code with no sharding/bucketing contract — not flagged
+    trailing = """
+    import numpy as np
+
+    def summarize(n, replicas):
+        return np.zeros((n, replicas))
+    """
+    assert _codes(trailing, select=["SHP"]) == []
+
+
+def test_shp_inherited_binding_kwarg_shape_and_suppression():
+    # the engines' build() closures: `replicas` bound in the enclosing
+    # scope, constructor uses shape= keyword, broadcast_to's shape is
+    # its second positional
+    src = """
+    import jax.numpy as jnp
+
+    def lower(prog, replicas):
+        def body(carry):
+            q = jnp.full(shape=(prog.n, replicas), fill_value=0)
+            b = jnp.broadcast_to(carry, (prog.n, replicas))
+            return q, b
+        return body
+    """
+    assert _codes(
+        src, path="tpudes/parallel/fixture.py", select=["SHP"]
+    ) == ["SHP001", "SHP001"]
+    suppressed = """
+    import jax.numpy as jnp
+
+    def run_engine(prog, replicas):
+        return jnp.zeros((prog.n, replicas))  # tpudes: ignore[SHP001]
+    """
+    assert _codes(
+        suppressed, path="tpudes/parallel/fixture.py", select=["SHP"]
+    ) == []
+
+
+# --- time units (TIM) ------------------------------------------------------
+
+def test_tim_bare_number_delay_flagged():
+    src = """
+    from tpudes.core import Simulator
+
+    def arm(cb):
+        Simulator.Schedule(5, cb)
+        Simulator.Stop(2.5)
+    """
+    assert _codes(src, select=["TIM"]) == ["TIM001", "TIM001"]
+
+
+def test_tim_mixed_time_plus_literal_and_now_arithmetic():
+    src = """
+    from tpudes.core import Seconds, Simulator
+
+    def arm(cb):
+        Simulator.Schedule(Seconds(1) + 5, cb)
+        deadline = Simulator.Now() + 100
+        if Simulator.Now() > 100:
+            return deadline
+    """
+    assert _codes(src, select=["TIM"]) == ["TIM001", "TIM001", "TIM001"]
+
+
+def test_tim_unit_safe_zero_and_impl_layer_clean():
+    clean = """
+    from tpudes.core import MilliSeconds, Seconds, Simulator
+
+    def arm(cb, impl):
+        Simulator.Schedule(Seconds(1) + MilliSeconds(5), cb)
+        Simulator.Schedule(0, cb)
+        Simulator.Stop(Seconds(2))
+        impl.Schedule(500, cb)  # SimulatorImpl speaks ticks by design
+        if Simulator.Now() > Seconds(1):
+            return Simulator.NowTicks() + 100
+    """
+    assert _codes(clean, select=["TIM"]) == []
+    suppressed = """
+    from tpudes.core import Simulator
+
+    def arm(cb):
+        Simulator.Schedule(5, cb)  # tpudes: ignore[TIM001]
+    """
+    assert _codes(suppressed, select=["TIM"]) == []

@@ -2,12 +2,14 @@
 third-party passes call :func:`tpudes.analysis.register_pass` directly.
 """
 
+from tpudes.analysis.passes.cross_replica import CrossReplicaShapePass
 from tpudes.analysis.passes.determinism import DeterminismPass
 from tpudes.analysis.passes.event_hygiene import EventHygienePass
 from tpudes.analysis.passes.jit_purity import JitPurityPass
 from tpudes.analysis.passes.registry_parity import RegistryParityPass
 from tpudes.analysis.passes.rng_discipline import RngDisciplinePass
 from tpudes.analysis.passes.style import StylePass
+from tpudes.analysis.passes.time_units import TimeUnitsPass
 from tpudes.analysis.passes.trace_arity import TraceArityPass
 
 BUILTIN_PASSES = [
@@ -18,4 +20,6 @@ BUILTIN_PASSES = [
     EventHygienePass,
     RegistryParityPass,
     TraceArityPass,
+    CrossReplicaShapePass,
+    TimeUnitsPass,
 ]

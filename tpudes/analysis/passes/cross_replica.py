@@ -1,0 +1,147 @@
+"""cross-replica shape pass: per-replica state arrays in lowered
+programs must carry the replica axis LEADING, built from the replica
+operand (the still-unbuilt rule from the PR 1 plan).
+
+Every device engine lays per-replica state out as ``(R, …)`` arrays:
+the replica axis is the vmap/shard axis, ``shard_replica_axis`` only
+shards a leading-or-config-adjacent axis whose size equals the padded
+replica count, and the bucketing contract (pad + slice-back) slices
+``[:R]`` on axis 0.  An array that smuggles the replica count into a
+*trailing* position type-checks, traces, and runs — and then silently
+breaks sharding (the axis never matches, so the array replicates per
+device) and bucketing slice-back (the wrong axis is sliced).  That is
+exactly the class of bug a shape-polymorphic tracer cannot catch.
+
+SHP001 fires inside ``tpudes/parallel/`` scopes that bind a replica
+operand (a parameter or assignment named ``replicas`` / ``R`` /
+``r_pad`` / ``n_replicas``, including bindings inherited from an
+enclosing function — the engines' ``build()`` closures) when an array
+constructor (``jnp.zeros/ones/empty/full/broadcast_to`` and np
+equivalents) takes a literal shape tuple with the replica operand at
+any position other than 0.  Leading-position use, replica-free shapes,
+and computed (non-literal) shapes are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudes.analysis.base import Finding, Pass, SourceModule, scope_walk
+
+#: names a scope may bind the replica operand to (the engines' idiom)
+_REPLICA_NAMES = {"replicas", "R", "r_pad", "n_replicas"}
+
+#: constructor attr -> index of its shape argument
+_SHAPE_ARG = {
+    "zeros": 0,
+    "ones": 0,
+    "empty": 0,
+    "full": 0,
+    "broadcast_to": 1,
+}
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Replica-operand names bound directly in ``fn``'s scope (params
+    and simple/tuple assignment targets; nested scopes collect their
+    own bindings when the walker recurses into them)."""
+    out: set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        for p in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            + ([a.vararg] if a.vararg else [])
+            + ([a.kwarg] if a.kwarg else [])
+        ):
+            if p.arg in _REPLICA_NAMES:
+                out.add(p.arg)
+    for node in scope_walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            for el in t.elts if isinstance(t, ast.Tuple) else [t]:
+                if isinstance(el, ast.Name) and el.id in _REPLICA_NAMES:
+                    out.add(el.id)
+    return out
+
+
+def _shape_tuple(call: ast.Call) -> ast.Tuple | None:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _SHAPE_ARG:
+        return None
+    idx = _SHAPE_ARG[fn.attr]
+    shape = None
+    if len(call.args) > idx and not any(
+        isinstance(a, ast.Starred) for a in call.args[: idx + 1]
+    ):
+        shape = call.args[idx]
+    for kw in call.keywords:
+        if kw.arg == "shape":
+            shape = kw.value
+    return shape if isinstance(shape, ast.Tuple) else None
+
+
+class CrossReplicaShapePass(Pass):
+    name = "cross-replica-shape"
+    codes = {
+        "SHP001": "per-replica state array's replica axis is not the "
+                  "leading axis built from the replica operand",
+    }
+
+    def applies(self, path: str) -> bool:
+        return "tpudes/parallel/" in path or path.startswith(
+            "tpudes/parallel"
+        )
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        out: list[Finding] = []
+
+        def visit(scope: ast.AST, inherited: set[str]) -> None:
+            bound = inherited | _bound_names(scope)
+            for node in scope_walk(scope):
+                if isinstance(node, ast.Call) and bound:
+                    shape = _shape_tuple(node)
+                    if shape is not None:
+                        for i, el in enumerate(shape.elts[1:], start=1):
+                            if (
+                                isinstance(el, ast.Name)
+                                and el.id in bound
+                            ):
+                                out.append(Finding(
+                                    mod.path, node.lineno,
+                                    node.col_offset, "SHP001",
+                                    f"replica operand '{el.id}' at shape "
+                                    f"position {i}; per-replica state "
+                                    "must lead with the replica axis "
+                                    "(sharding and bucket slice-back "
+                                    "operate on axis 0)",
+                                ))
+                                break
+            # recurse into nested scopes with the bindings visible there
+            for child in _direct_nested(scope):
+                visit(child, bound)
+
+        for top in _direct_nested(mod.tree):
+            visit(top, set())
+        return out
+
+
+def _direct_nested(scope: ast.AST):
+    """Function/lambda scopes whose nearest enclosing scope is
+    ``scope`` (not deeper)."""
+    found = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                found.append(child)
+            else:
+                walk(child)
+
+    walk(scope)
+    return found

@@ -1,0 +1,176 @@
+"""time-units pass: Time-vs-ticks unit confusion at the ``Simulator``
+boundary (the other still-unbuilt rule from the PR 1 plan).
+
+``Time`` arithmetic coerces bare numbers through ``Time(other)`` —
+which interprets them as raw TICKS (nanoseconds at the default
+resolution).  So ``Simulator.Schedule(Seconds(1) + 5, cb)`` schedules
+at 1 s + 5 *nanoseconds*, and ``Simulator.Now() > 100`` compares
+against 100 ns — both type-check, trace, and run, silently off by up
+to nine orders of magnitude from the author's likely intent.  Upstream
+ns-3 has the same footgun (``Time::Time(int64_t)`` is tick-valued);
+the unit-safe spelling is always an explicit constructor
+(``Seconds``/``MilliSeconds``/…) or ``Simulator.NowTicks()`` when raw
+ticks are genuinely meant.
+
+TIM001 fires when raw numeric literals cross the PUBLIC ``Simulator``
+facade boundary:
+
+- the delay argument of ``Simulator.Schedule`` /
+  ``Simulator.ScheduleWithContext`` / ``Simulator.Stop`` is a bare
+  numeric literal, or an additive expression mixing a Time-constructor
+  call with a bare numeric literal;
+- ``Simulator.Now()`` is combined with a bare numeric literal via
+  ``+``/``-`` or compared against one.
+
+The internal ``SimulatorImpl`` layer deliberately speaks ticks
+(``delay_ticks`` parameters) and is not matched: only the dotted
+``Simulator.*`` facade is the unit boundary.  A literal ``0`` delay is
+exempt — zero is the same instant in every unit, and schedule-at-0 is
+an established idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudes.analysis.base import Finding, Pass, SourceModule, dotted_name
+
+#: unit-safe Time constructors (core/nstime.py)
+_TIME_CTORS = {
+    "Seconds", "MilliSeconds", "MicroSeconds", "NanoSeconds",
+    "PicoSeconds", "FemtoSeconds", "Minutes", "Hours", "Days", "Time",
+}
+
+#: Simulator facade method -> index of its Time delay argument
+_DELAY_ARG = {"Schedule": 0, "ScheduleWithContext": 1, "Stop": 0}
+
+
+def _is_number(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) and not isinstance(
+            node.value, bool
+        )
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        return _is_number(node.operand)
+    return False
+
+
+def _is_zero(node: ast.AST) -> bool:
+    if isinstance(node, ast.UnaryOp):
+        return _is_zero(node.operand)
+    return isinstance(node, ast.Constant) and node.value == 0
+
+
+def _is_time_expr(node: ast.AST) -> bool:
+    """A call of a unit-safe constructor or of ``Simulator.Now``."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in _TIME_CTORS or _is_now(node)
+
+
+def _is_now(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and dotted_name(node.func) is not None
+        and dotted_name(node.func).endswith("Simulator.Now")
+    )
+
+
+def _mixed_additive(node: ast.AST) -> bool:
+    """An ``a + b`` / ``a - b`` mixing a Time expression with a bare
+    numeric literal (either side; one level of nesting on the Time
+    side so ``Seconds(1) + Seconds(2) - 5`` is caught)."""
+    if not (
+        isinstance(node, ast.BinOp)
+        and isinstance(node.op, (ast.Add, ast.Sub))
+    ):
+        return False
+    left, right = node.left, node.right
+
+    def timeish(n):
+        return _is_time_expr(n) or _mixed_additive(n) or (
+            isinstance(n, ast.BinOp)
+            and isinstance(n.op, (ast.Add, ast.Sub))
+            and (timeish(n.left) or timeish(n.right))
+        )
+
+    return (timeish(left) and _is_number(right)) or (
+        _is_number(left) and timeish(right)
+    )
+
+
+class TimeUnitsPass(Pass):
+    name = "time-units"
+    codes = {
+        "TIM001": "raw-int arithmetic mixed with Time values crossing "
+                  "the Simulator Schedule/Now boundary",
+    }
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        out: list[Finding] = []
+
+        def flag(node, msg):
+            out.append(Finding(
+                mod.path, node.lineno, node.col_offset, "TIM001", msg
+            ))
+
+        for node in ast.walk(mod.tree):
+            # --- Schedule/Stop delay argument --------------------------
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is not None and "." in name:
+                    base, _, method = name.rpartition(".")
+                    if (
+                        base.rsplit(".", 1)[-1] == "Simulator"
+                        and method in _DELAY_ARG
+                        and len(node.args) > _DELAY_ARG[method]
+                    ):
+                        delay = node.args[_DELAY_ARG[method]]
+                        # literal 0 is unit-independent ("now" in every
+                        # resolution) — the established schedule-at-0
+                        # idiom carries no tick confusion
+                        if _is_number(delay) and not _is_zero(delay):
+                            flag(
+                                node,
+                                f"bare number as the Simulator.{method} "
+                                "delay is interpreted as raw TICKS — "
+                                "wrap it in Seconds()/MilliSeconds()/…",
+                            )
+                        elif _mixed_additive(delay):
+                            flag(
+                                node,
+                                f"Simulator.{method} delay adds a bare "
+                                "number to a Time — the number is raw "
+                                "TICKS; wrap it in a Time constructor",
+                            )
+            # --- Now() arithmetic / comparisons ------------------------
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Add, ast.Sub)
+            ):
+                if (_is_now(node.left) and _is_number(node.right)) or (
+                    _is_number(node.left) and _is_now(node.right)
+                ):
+                    flag(
+                        node,
+                        "Simulator.Now() +/- a bare number treats it as "
+                        "raw TICKS — wrap it in a Time constructor (or "
+                        "use Simulator.NowTicks() for tick math)",
+                    )
+            if isinstance(node, ast.Compare):
+                operands = [node.left] + list(node.comparators)
+                if any(_is_now(o) for o in operands) and any(
+                    _is_number(o) for o in operands
+                ):
+                    flag(
+                        node,
+                        "comparing Simulator.Now() against a bare number "
+                        "compares raw TICKS — compare against a Time "
+                        "constructor (or use Simulator.NowTicks())",
+                    )
+        return out

@@ -1,0 +1,59 @@
+"""tpudes.fuzz — property-based differential fuzzing with
+auto-shrinking oracles across every execution mode.
+
+The correctness harness of ROADMAP item 5: the host DES (the ns-3
+lineage's semantic ground truth) and the runtime's documented
+bit-equality contracts (chunking, sweeping, bucketing, mesh sharding,
+serving coalescing, the LTE Pallas/precision modes) are *oracles*; a
+seeded generator turns integers into in-envelope scenarios for all
+four device engines and checks every pair.  On divergence the scenario
+auto-shrinks while the failure reproduces and a self-contained repro
+artifact lands under ``fuzz_artifacts/``.  Run as::
+
+    python -m tpudes.fuzz --budget 40            # fixed scenario budget
+    python -m tpudes.fuzz --engine lte_sm --seconds 60
+    python -m tpudes.fuzz --replay fuzz_artifacts/dumbbell-…-seed17.json
+
+Every engine front-end declares its documented-faithful region as a
+``FUZZ_ENVELOPE`` (:class:`FuzzEnvelope`) next to its lowering guards;
+``tests/fuzz_corpus/`` pins regression seeds replayed by tier-1.
+
+This module stays import-light (the engine front-ends import
+:class:`FuzzEnvelope` from :mod:`tpudes.fuzz.envelope` at module
+scope); the harness surface loads lazily on first touch.
+"""
+
+from tpudes.fuzz.envelope import FUZZ_ROOT_SEED, FuzzEnvelope, ScenarioGen
+
+__all__ = [
+    "FUZZ_ROOT_SEED",
+    "CampaignResult",
+    "Divergence",
+    "ENGINE_FUZZERS",
+    "FuzzEnvelope",
+    "ScenarioGen",
+    "first_diff",
+    "replay",
+    "run_campaign",
+    "run_scenario",
+    "scenario_config",
+    "shrink_divergence",
+]
+
+_HARNESS = {
+    "CampaignResult", "replay", "run_campaign", "run_scenario",
+    "scenario_config", "shrink_divergence",
+}
+_ENGINES = {"Divergence", "ENGINE_FUZZERS", "first_diff"}
+
+
+def __getattr__(name: str):
+    if name in _HARNESS:
+        from tpudes.fuzz import harness
+
+        return getattr(harness, name)
+    if name in _ENGINES:
+        from tpudes.fuzz import engines
+
+        return getattr(engines, name)
+    raise AttributeError(f"module 'tpudes.fuzz' has no attribute {name!r}")

@@ -1,0 +1,158 @@
+"""Differential fuzzing CLI.
+
+Usage::
+
+    python -m tpudes.fuzz [--engine E ...] [--budget N | --seconds S]
+                          [--seed BASE] [--host-every K]
+                          [--artifacts DIR] [--metrics PATH]
+                          [--mesh-devices N] [--no-shrink] [--quiet]
+    python -m tpudes.fuzz --replay <artifact.json | SEED> [--engine E]
+
+Exit codes: 0 = every oracle pair agreed (or the replayed repro
+artifact reproduced, which is that mode's success); 1 = a fresh
+divergence was found (artifacts written) or a repro artifact did NOT
+reproduce; 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpudes.fuzz",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--engine", action="append", default=None,
+        help="restrict to one or more engines "
+             "(bss / lte_sm / dumbbell / as_flows; repeatable)",
+    )
+    ap.add_argument("--budget", type=int, default=None,
+                    help="number of scenarios to run (default 12)")
+    ap.add_argument("--seconds", type=float, default=None,
+                    help="run scenarios until this much wall time elapsed")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base scenario seed (scenario i uses seed+i)")
+    ap.add_argument("--host-every", type=int, default=3,
+                    help="host-DES oracle stride per engine (0 disables)")
+    ap.add_argument("--artifacts", default="fuzz_artifacts",
+                    help="divergence artifact directory")
+    ap.add_argument("--metrics", default=None,
+                    help="write the FuzzTelemetry snapshot JSON here")
+    ap.add_argument("--mesh-devices", type=int, default=2,
+                    help="devices for the mesh oracle pair (skipped when "
+                         "fewer are visible)")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="emit artifacts without auto-shrinking")
+    ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("--replay", default=None, metavar="ARTIFACT|SEED",
+                    help="replay one artifact (or corpus entry, or bare "
+                         "seed with --engine) instead of fuzzing")
+    args = ap.parse_args(argv)
+
+    if args.budget is not None and args.seconds is not None:
+        ap.print_usage(sys.stderr)
+        print("--budget and --seconds are exclusive", file=sys.stderr)
+        return 2
+
+    log = (lambda *a: None) if args.quiet else print
+
+    if args.replay is not None:
+        return _replay(args, log)
+
+    from tpudes.fuzz.harness import run_campaign
+    from tpudes.obs.fuzz import FuzzTelemetry
+
+    try:
+        result = run_campaign(
+            args.engine,
+            budget=args.budget,
+            seconds=args.seconds,
+            base_seed=args.seed,
+            host_every=args.host_every,
+            artifacts_dir=args.artifacts,
+            mesh_devices=args.mesh_devices,
+            shrink=not args.no_shrink,
+            log=log,
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    snap = FuzzTelemetry.snapshot()
+    if args.metrics:
+        with open(args.metrics, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+    c = snap["counters"]
+    log(
+        f"fuzz: {c['scenarios']} scenarios, {c['pair_runs']} oracle-pair "
+        f"runs, {c['divergences']} divergences in {result.wall_s:.1f}s"
+    )
+    if result.divergences:
+        for p in result.artifact_paths:
+            print(f"divergence artifact: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _replay(args, log) -> int:
+    from tpudes.fuzz.artifact import ARTIFACT_KIND_REPRO, load_artifact
+    from tpudes.fuzz.harness import replay
+
+    src = args.replay
+    doc = None
+    if not str(src).isdigit():
+        try:
+            doc = load_artifact(src)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"{src}: unreadable artifact ({e})", file=sys.stderr)
+            return 2
+    engine = (args.engine or [None])[0]
+    try:
+        divs = replay(
+            doc if doc is not None else int(src),
+            engine=engine,
+            mesh_devices=args.mesh_devices,
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    expects_repro = bool(doc and doc.get("kind") == ARTIFACT_KIND_REPRO
+                         and doc.get("pair"))
+    if expects_repro:
+        if not divs:
+            print("repro artifact did NOT reproduce", file=sys.stderr)
+            return 1
+        from tpudes.fuzz.artifact import _jsonable
+
+        # compare through the artifact's own JSON normalization: the
+        # fresh diff may hold tuples/np scalars/NaN where the loaded
+        # one has lists/floats ("NaN" == "NaN" serialized, while
+        # nan != nan under dict equality)
+        norm = lambda d: json.dumps(_jsonable(d), sort_keys=True)  # noqa: E731
+        fresh = divs[0].diff
+        recorded = doc.get("first_diff")
+        if norm(fresh) == norm(recorded):
+            log(f"reproduced bit-identically: {divs[0].render()}")
+            return 0
+        print(
+            "diverged, but not bit-identically to the artifact:\n"
+            f"  recorded: {recorded}\n  fresh:    {fresh}",
+            file=sys.stderr,
+        )
+        return 1
+    if divs:
+        for d in divs:
+            print(d.render(), file=sys.stderr)
+        return 1
+    log("replay clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,103 @@
+"""Self-contained repro artifacts for fuzz divergences.
+
+An artifact is one JSON document that reproduces a divergence from
+nothing but the repo: the integer seed, the (shrunk) scenario config,
+the failing oracle-pair name, the first-divergence field diff, the env
+knobs that were live at detection (the planted-bug flag, Pallas /
+bucketing overrides), and — when ``TpudesObs`` was on — the host
+flight-recorder tail.  ``python -m tpudes.fuzz --replay <artifact>``
+re-runs exactly the recorded pair under the recorded knobs and checks
+the diff reproduces bit-identically.
+
+Corpus entries (``tests/fuzz_corpus/``) use the same format with
+``kind == "tpudes-fuzz-corpus"`` and no divergence fields: replaying
+one runs the full cross-mode pair set and expects it clean.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+__all__ = [
+    "ARTIFACT_KIND_CORPUS",
+    "ARTIFACT_KIND_REPRO",
+    "artifact_doc",
+    "load_artifact",
+    "write_artifact",
+]
+
+ARTIFACT_KIND_REPRO = "tpudes-fuzz-repro"
+ARTIFACT_KIND_CORPUS = "tpudes-fuzz-corpus"
+
+#: env knobs that change what a replay executes — captured at detection
+#: time so a later ``--replay`` reconstructs the same modes without the
+#: caller having to remember to export them
+_CAPTURED_ENV = (
+    "TPUDES_FUZZ_PLANTED_BUG",
+    "TPUDES_PALLAS",
+    "TPUDES_BUCKETING",
+)
+
+
+def _jsonable(v):
+    if hasattr(v, "item"):
+        return v.item()
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return v
+
+
+def artifact_doc(
+    engine: str,
+    seed: int,
+    pair: str,
+    config: dict,
+    first_diff: dict,
+    original_config: dict | None = None,
+    shrink_iterations: int = 0,
+    flight_recorder=None,
+) -> dict:
+    import jax
+
+    doc = {
+        "version": 1,
+        "kind": ARTIFACT_KIND_REPRO,
+        "engine": engine,
+        "seed": int(seed),
+        "pair": pair,
+        "config": _jsonable(config),
+        "first_diff": _jsonable(first_diff),
+        "shrink_iterations": int(shrink_iterations),
+        "env": {
+            k: os.environ[k] for k in _CAPTURED_ENV if k in os.environ
+        },
+        "meta": {
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+        },
+    }
+    if original_config is not None and original_config != config:
+        doc["original_config"] = _jsonable(original_config)
+    if flight_recorder:
+        doc["flight_recorder"] = _jsonable(flight_recorder)
+    return doc
+
+
+def write_artifact(dirpath: str | Path, doc: dict) -> str:
+    d = Path(dirpath)
+    d.mkdir(parents=True, exist_ok=True)
+    name = f"{doc['engine']}-{doc.get('pair', 'scenario')}-seed{doc['seed']}.json"
+    path = d / name
+    path.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    return str(path)
+
+
+def load_artifact(path: str | Path) -> dict:
+    doc = json.loads(Path(path).read_text())
+    if not isinstance(doc, dict) or "engine" not in doc:
+        raise ValueError(f"{path}: not a tpudes fuzz artifact")
+    return doc

@@ -1,0 +1,143 @@
+"""Fuzz envelopes and the seeded scenario generator.
+
+The generative half of :mod:`tpudes.fuzz` (ROADMAP item 5): every
+device engine front-end declares a :class:`FuzzEnvelope` — the
+parameter region inside which its lowering is *documented* to be
+faithful (topology/geometry bounds, traffic shapes, scheduler/variant
+ids, horizons, replica counts) — and :class:`ScenarioGen` turns ONE
+integer seed into an in-envelope configuration dict by deriving every
+draw from a ``fold_in``-keyed PRNG stream (the QuickCheck "corpus entry
+is a seed" property: a scenario is reproduced from its integer alone,
+no state files).
+
+This module is deliberately standalone (no engine imports): the engine
+front-ends import :class:`FuzzEnvelope` from here to declare their
+``FUZZ_ENVELOPE``, and the rest of :mod:`tpudes.fuzz` imports the
+engines — keeping the dependency arrow one-directional.
+
+Axis kinds:
+
+- ``("int", lo, hi)``      — inclusive integer range
+- ``("float", lo, hi)``    — half-open float range
+- ``("choice", (a, b, …))`` — finite set (ids, categorical knobs)
+
+``floors`` names the shrink floors of the axes the auto-shrinker may
+reduce (replicas, horizon, population sizes); an axis absent from
+``floors`` is never shrunk below its envelope minimum.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+__all__ = ["FuzzEnvelope", "ScenarioGen", "FUZZ_ROOT_SEED"]
+
+#: root of every fuzz PRNG stream: scenario ``seed`` is folded into
+#: PRNGKey(FUZZ_ROOT_SEED), and each subsequent draw folds in a draw
+#: counter — so a corpus entry is the single integer ``seed``
+FUZZ_ROOT_SEED = 0x7D0DE5
+
+
+class ScenarioGen:
+    """Deterministic draw stream for one scenario seed.
+
+    Draw ``i`` is a pure function of ``(FUZZ_ROOT_SEED, seed, i)`` via
+    two ``fold_in`` hops — the same keying discipline the engines use
+    for replica/step randomness, so the generator inherits their
+    reproducibility story (and RNG001's single-use-key rule: every draw
+    consumes a fresh fold)."""
+
+    def __init__(self, seed: int):
+        import jax
+
+        self.seed = int(seed)
+        self._key = jax.random.fold_in(
+            jax.random.PRNGKey(FUZZ_ROOT_SEED), self.seed
+        )
+        self._draws = 0
+
+    def _next_key(self):
+        import jax
+
+        k = jax.random.fold_in(self._key, self._draws)
+        self._draws += 1
+        return k
+
+    def randint(self, lo: int, hi: int) -> int:
+        """Uniform integer in [lo, hi] (inclusive both ends)."""
+        import jax
+
+        return int(
+            jax.random.randint(self._next_key(), (), int(lo), int(hi) + 1)
+        )
+
+    def uniform(self, lo: float = 0.0, hi: float = 1.0) -> float:
+        import jax
+
+        return float(
+            jax.random.uniform(
+                self._next_key(), (), minval=float(lo), maxval=float(hi)
+            )
+        )
+
+    def choice(self, seq):
+        seq = tuple(seq)
+        return seq[self.randint(0, len(seq) - 1)]
+
+
+@dataclass(frozen=True)
+class FuzzEnvelope:
+    """One engine's documented-faithful parameter region.
+
+    ``axes`` maps configuration keys to axis specs (module docstring);
+    :meth:`draw` samples a config dict from a :class:`ScenarioGen`,
+    :meth:`contains` checks a (possibly shrunk or hand-edited) config
+    against the region — shrunk configs may fall below envelope minima
+    down to ``floors``, which :meth:`contains` honors."""
+
+    engine: str
+    axes: Mapping[str, tuple]
+    floors: Mapping[str, int] = field(default_factory=dict)
+    doc: str = ""
+
+    def draw(self, gen: ScenarioGen) -> dict:
+        """Sample every axis in declaration order (order is part of the
+        seed→config contract: reordering axes changes every corpus
+        entry, so axes are append-only within a corpus generation)."""
+        cfg: dict = {}
+        for name, spec in self.axes.items():
+            kind = spec[0]
+            if kind == "int":
+                cfg[name] = gen.randint(spec[1], spec[2])
+            elif kind == "float":
+                cfg[name] = round(gen.uniform(spec[1], spec[2]), 6)
+            elif kind == "choice":
+                cfg[name] = gen.choice(spec[1])
+            else:  # pragma: no cover - envelope author error
+                raise ValueError(f"unknown axis kind {kind!r} for {name!r}")
+        return cfg
+
+    def contains(self, cfg: Mapping) -> list[str]:
+        """Axis names at which ``cfg`` leaves the (floor-extended)
+        envelope; empty means in-envelope."""
+        out: list[str] = []
+        for name, spec in self.axes.items():
+            if name not in cfg:
+                out.append(name)
+                continue
+            v = cfg[name]
+            kind = spec[0]
+            if kind == "int":
+                lo = min(spec[1], self.floors.get(name, spec[1]))
+                if not (isinstance(v, int) and lo <= v <= spec[2]):
+                    out.append(name)
+            elif kind == "float":
+                lo = min(spec[1], self.floors.get(name, spec[1]))
+                if not (
+                    isinstance(v, (int, float)) and lo <= v <= spec[2]
+                ):
+                    out.append(name)
+            elif kind == "choice" and v not in spec[1]:
+                out.append(name)
+        return out

@@ -1,0 +1,401 @@
+"""The fuzz campaign driver: pair orchestration, auto-shrinking, replay.
+
+One *scenario* is an in-envelope config drawn from its engine's
+``FUZZ_ENVELOPE`` by the seed alone; :func:`run_scenario` lowers it
+once, runs the canonical scalar launch, and checks every oracle pair
+against that canonical result:
+
+===================  =========================================  ========
+pair                 contract                                   strength
+===================  =========================================  ========
+chunked_vs_single    donated-carry segment handoff              exact
+swept_vs_point       config-axis megabatch point 0              exact
+bucketing_off        pow2 replica padding                       exact
+mesh_vs_single       virtual-mesh replica sharding              exact¹
+serving_vs_solo      StudyServer coalescing demux               exact
+pallas_vs_xla        LTE fused-kernel lowerings (LTE only)      exact
+bf16_budget          LTE mixed-precision budget (LTE only)      budget
+host_vs_device       host DES vs device engine                  fuzz band
+===================  =========================================  ========
+
+¹ the AS fluid float chain uses the documented GSPMD ULP tolerance.
+
+On divergence the scenario is greedily shrunk (fewer replicas / UEs /
+flows / nodes, shorter horizon, simpler topology) while the SAME pair
+still reproduces, then a self-contained repro artifact lands under
+``fuzz_artifacts/`` (see :mod:`tpudes.fuzz.artifact`).  All effort is
+recorded in :class:`tpudes.obs.fuzz.FuzzTelemetry`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tpudes.fuzz.artifact import (
+    _CAPTURED_ENV,
+    artifact_doc,
+    load_artifact,
+    write_artifact,
+)
+from tpudes.fuzz.engines import (
+    ENGINE_FUZZERS,
+    Divergence,
+    EngineFuzzer,
+    _env,
+    _mesh_or_none,
+    first_diff,
+)
+from tpudes.fuzz.envelope import ScenarioGen
+from tpudes.obs.fuzz import FuzzTelemetry
+
+__all__ = [
+    "CROSS_MODE_PAIRS",
+    "CampaignResult",
+    "PAIR_HOST",
+    "replay",
+    "run_campaign",
+    "run_scenario",
+    "scenario_config",
+    "shrink_divergence",
+]
+
+PAIR_HOST = "host_vs_device"
+#: the exact device-side pairs every scenario runs (plus the engine's
+#: extra_pairs); the host pair rides the ``host_every`` stride
+CROSS_MODE_PAIRS = (
+    "chunked_vs_single",
+    "swept_vs_point",
+    "bucketing_off",
+    "mesh_vs_single",
+    "serving_vs_solo",
+)
+
+#: sentinel for a pair that could not run in this environment (e.g.
+#: the mesh pair on a single-device host) — not counted as coverage
+_SKIPPED = object()
+
+
+def scenario_config(engine: str, seed: int) -> dict:
+    """The seed→config map: one integer reproduces the whole scenario."""
+    return ENGINE_FUZZERS[engine].envelope.draw(ScenarioGen(seed))
+
+
+def _serving_pair(fz: EngineFuzzer, prog, cfg, canonical):
+    from tpudes.fuzz.engines import scenario_key
+    from tpudes.serving import StudyServer
+
+    engine, studies = fz.serving_studies(prog, cfg)
+    # start=False: the deterministic single-thread mode — submit both
+    # studies, then pump once so the scheduler sees them together and
+    # coalesces onto one megabatched launch
+    server = StudyServer(start=False, max_wait_s=0.0, max_batch=2)
+    try:
+        key = scenario_key(cfg)
+        handles = [
+            server.submit_study(engine, p, key, int(cfg["replicas"]), **kw)
+            for p, kw in studies
+        ]
+        server.pump(force=True)
+        res0 = handles[0].result(timeout=600)
+    finally:
+        server.close()
+    return first_diff(canonical, res0, fields=fz.outcome_fields)
+
+
+def _run_named_pair(fz: EngineFuzzer, name: str, prog, cfg, canonical,
+                    mesh_devices: int = 2):
+    """One oracle pair against the canonical scalar result; returns a
+    first_diff dict, None (agreement), or ``_SKIPPED``."""
+    if name == "chunked_vs_single":
+        return first_diff(canonical, fz.run_chunked(prog, cfg, canonical))
+    if name == "swept_vs_point":
+        return first_diff(
+            canonical, fz.run_sweep0(prog, cfg), fields=fz.outcome_fields
+        )
+    if name == "bucketing_off":
+        with _env("TPUDES_BUCKETING", "0"):
+            res = fz.run_scalar(prog, cfg)
+        # outcome fields only: the padded replicas are real independent
+        # sims, so the unpadded run's shared loop counter (BSS "steps")
+        # may legitimately stop earlier — same caveat as the sweep's
+        # shared step budget
+        return first_diff(canonical, res, fields=fz.outcome_fields)
+    if name == "mesh_vs_single":
+        mesh = _mesh_or_none(mesh_devices)
+        if mesh is None:
+            return _SKIPPED
+        res = fz.run_scalar(prog, cfg, mesh=mesh)
+        return first_diff(
+            canonical, res, fields=fz.outcome_fields,
+            rtol=getattr(fz, "mesh_rtol", 0.0),
+        )
+    if name == "serving_vs_solo":
+        return _serving_pair(fz, prog, cfg, canonical)
+    if name == PAIR_HOST:
+        host = fz.host_run(cfg)
+        diff = fz.host_compare(host, canonical, cfg)
+        if diff is not None and host.get("_flight_recorder"):
+            diff = dict(diff, flight_recorder=host["_flight_recorder"])
+        return diff
+    for extra_name, fn in fz.extra_pairs():
+        if extra_name == name:
+            return fn(prog, cfg, canonical)
+    raise ValueError(f"unknown oracle pair {name!r}")
+
+
+def _pair_names(fz: EngineFuzzer, host: bool) -> list[str]:
+    names = list(CROSS_MODE_PAIRS)
+    names += [n for n, _ in fz.extra_pairs()]
+    if host:
+        names.append(PAIR_HOST)
+    return names
+
+
+def run_scenario(
+    engine: str | EngineFuzzer,
+    cfg: dict,
+    *,
+    host: bool = False,
+    mesh_devices: int = 2,
+    pairs=None,
+    record: bool = True,
+) -> list[Divergence]:
+    """Build + lower the scenario once, then run every oracle pair;
+    returns the divergences (empty list = clean scenario)."""
+    fz = ENGINE_FUZZERS[engine] if isinstance(engine, str) else engine
+    names = list(pairs) if pairs is not None else _pair_names(fz, host)
+    prog = fz.build(cfg)
+    canonical = fz.run_scalar(prog, cfg)
+    out: list[Divergence] = []
+    for name in names:
+        diff = _run_named_pair(fz, name, prog, cfg, canonical,
+                               mesh_devices=mesh_devices)
+        if diff is _SKIPPED:
+            continue
+        if record:
+            FuzzTelemetry.record_pair(fz.name, name, diff is not None)
+        if diff is not None:
+            out.append(Divergence(fz.name, name, diff, config=dict(cfg)))
+    return out
+
+
+def _replay_pair(fz: EngineFuzzer, pair: str, cfg: dict,
+                 mesh_devices: int = 2):
+    """Re-run exactly one pair on a (possibly shrunk/edited) config;
+    returns a first_diff dict, None (agreement), or ``_SKIPPED`` when
+    the pair cannot run in this environment."""
+    prog = fz.build(cfg)
+    canonical = fz.run_scalar(prog, cfg)
+    return _run_named_pair(fz, pair, prog, cfg, canonical,
+                           mesh_devices=mesh_devices)
+
+
+def shrink_divergence(
+    fz: EngineFuzzer,
+    div: Divergence,
+    *,
+    max_iters: int = 48,
+    mesh_devices: int = 2,
+):
+    """Greedy auto-shrink: try each of the engine's shrink moves in
+    order; keep any strictly-smaller config on which the SAME oracle
+    pair still diverges, restart the scan from it, stop when no move
+    reproduces (or the iteration budget runs out).  Returns
+    ``(shrunk_config, shrunk_diff, iterations)``."""
+    cfg, diff = dict(div.config), div.diff
+    iters = 0
+    progressed = True
+    while progressed and iters < max_iters:
+        progressed = False
+        for _label, cand in fz.shrink_moves(cfg):
+            if iters >= max_iters:
+                break
+            iters += 1
+            try:
+                d = _replay_pair(fz, div.pair, cand,
+                                 mesh_devices=mesh_devices)
+            except Exception:
+                # a shrink that breaks the build/lowering is not a
+                # smaller reproduction — discard the candidate
+                d = None
+            if d is _SKIPPED:  # pair ran at detection, so can't occur
+                d = None       # mid-shrink — but never misread a skip
+            if d is not None:
+                cfg, diff = dict(cand), d
+                progressed = True
+                break
+    return cfg, diff, iters
+
+
+@dataclass
+class CampaignResult:
+    """What one :func:`run_campaign` did."""
+
+    scenarios: int = 0
+    divergences: list = field(default_factory=list)   # artifact docs
+    artifact_paths: list = field(default_factory=list)
+    wall_s: float = 0.0
+
+    @property
+    def clean(self) -> bool:
+        return not self.divergences
+
+
+def run_campaign(
+    engines=None,
+    *,
+    budget: int | None = None,
+    seconds: float | None = None,
+    base_seed: int = 0,
+    host_every: int = 3,
+    artifacts_dir: str | Path = "fuzz_artifacts",
+    mesh_devices: int = 2,
+    shrink: bool = True,
+    log=None,
+) -> CampaignResult:
+    """Round-robin the engines over seeds ``base_seed, base_seed+1, …``
+    until ``budget`` scenarios ran (or ``seconds`` elapsed).  Every
+    scenario runs the full cross-mode pair set; the host-DES pair runs
+    on every ``host_every``-th scenario of each engine (0 disables it).
+    Divergences are shrunk and written as artifacts; telemetry is reset
+    at entry so :meth:`FuzzTelemetry.snapshot` describes this campaign.
+    """
+    FuzzTelemetry.reset()
+    names = list(engines) if engines else list(ENGINE_FUZZERS)
+    for n in names:
+        if n not in ENGINE_FUZZERS:
+            raise ValueError(
+                f"unknown engine {n!r} (have {sorted(ENGINE_FUZZERS)})"
+            )
+    if budget is None and seconds is None:
+        budget = 12
+    result = CampaignResult()
+    t0 = time.monotonic()
+    per_engine: dict[str, int] = {}
+    i = 0
+    while True:
+        if budget is not None and i >= budget:
+            break
+        if seconds is not None and time.monotonic() - t0 >= seconds:
+            break
+        fz = ENGINE_FUZZERS[names[i % len(names)]]
+        seed = base_seed + i
+        cfg = fz.envelope.draw(ScenarioGen(seed))
+        k = per_engine.get(fz.name, 0)
+        per_engine[fz.name] = k + 1
+        host = host_every > 0 and (k % host_every == 0)
+        t1 = time.monotonic()
+        divs = run_scenario(fz, cfg, host=host, mesh_devices=mesh_devices)
+        FuzzTelemetry.record_scenario(fz.name, time.monotonic() - t1)
+        for div in divs:
+            if shrink:
+                scfg, sdiff, iters = shrink_divergence(
+                    fz, div, mesh_devices=mesh_devices
+                )
+                FuzzTelemetry.record_shrink(fz.name, iters)
+            else:
+                scfg, sdiff, iters = dict(div.config), div.diff, 0
+            flight = None
+            if isinstance(sdiff, dict) and "flight_recorder" in sdiff:
+                sdiff = dict(sdiff)
+                flight = sdiff.pop("flight_recorder")
+            doc = artifact_doc(
+                fz.name, seed, div.pair, scfg, sdiff,
+                original_config=dict(cfg), shrink_iterations=iters,
+                flight_recorder=flight,
+            )
+            path = write_artifact(artifacts_dir, doc)
+            result.divergences.append(doc)
+            result.artifact_paths.append(path)
+            if log:
+                log(f"DIVERGENCE {fz.name}/{div.pair} seed={seed} -> {path}")
+        if log:
+            log(
+                f"[{i + 1}] {fz.name} seed={seed} "
+                f"pairs={'clean' if not divs else len(divs)} "
+                f"({time.monotonic() - t1:.1f}s)"
+            )
+        result.scenarios += 1
+        i += 1
+    result.wall_s = time.monotonic() - t0
+    return result
+
+
+@contextlib.contextmanager
+def _envs(env: dict):
+    with contextlib.ExitStack() as stack:
+        for k, v in env.items():
+            stack.enter_context(_env(k, v))
+        yield
+
+
+def replay(
+    source,
+    engine: str | None = None,
+    *,
+    mesh_devices: int = 2,
+    host: bool = False,
+) -> list[Divergence]:
+    """Replay an artifact (path or loaded dict) or a bare integer seed.
+
+    - **repro artifact** (has ``pair``): re-run exactly the recorded
+      pair on the recorded config under the recorded env knobs; the
+      returned divergence (if any) carries the fresh first_diff so the
+      caller can check bit-identical reproduction against the artifact.
+    - **corpus entry / seed**: run the full cross-mode pair set (plus
+      the host pair when ``host``) and expect it clean; a corpus entry
+      may restrict itself to the pairs its seed was chosen to exercise
+      via a ``pairs`` list.
+
+    Returns the divergences found (empty = clean / not reproduced).
+    """
+    if isinstance(source, (str, Path)) and not str(source).isdigit():
+        doc = load_artifact(source)
+    elif isinstance(source, dict):
+        doc = source
+    else:
+        if engine is None:
+            raise ValueError("--replay <seed> needs an engine")
+        doc = {"engine": engine, "seed": int(source)}
+    if doc["engine"] not in ENGINE_FUZZERS:
+        raise ValueError(
+            f"unknown engine {doc['engine']!r} "
+            f"(have {sorted(ENGINE_FUZZERS)})"
+        )
+    fz = ENGINE_FUZZERS[doc["engine"]]
+    cfg = doc.get("config")
+    if cfg is None:
+        cfg = fz.envelope.draw(ScenarioGen(int(doc["seed"])))
+    bad = fz.envelope.contains(cfg)
+    if bad:
+        raise ValueError(
+            f"artifact config leaves the {fz.name} envelope at {bad}"
+        )
+    # apply the artifact's env knobs AND unset every captured knob the
+    # artifact does NOT record — an ambient TPUDES_PALLAS=0 (or a
+    # leftover planted-bug export) must not corrupt the "bit-identical
+    # reproduction" verdict of an artifact found without it
+    env: dict = {k: None for k in _CAPTURED_ENV}
+    env.update(doc.get("env", {}))
+    with _envs(env):
+        if doc.get("pair"):
+            diff = _replay_pair(fz, doc["pair"], cfg,
+                                mesh_devices=mesh_devices)
+            if diff is _SKIPPED:
+                raise ValueError(
+                    f"oracle pair {doc['pair']!r} cannot run in this "
+                    f"environment (the mesh pair needs >= {mesh_devices} "
+                    "visible devices) — replay where the artifact was "
+                    "recorded"
+                )
+            if diff is None:
+                return []
+            return [Divergence(fz.name, doc["pair"], diff, config=cfg)]
+        return run_scenario(
+            fz, cfg, host=host or bool(doc.get("host")),
+            mesh_devices=mesh_devices, record=False,
+            pairs=doc.get("pairs"),
+        )

@@ -35,6 +35,7 @@ from tpudes.obs.export import (
     validate_chrome_trace,
 )
 from tpudes.obs.flight_recorder import FlightRecorder
+from tpudes.obs.fuzz import FuzzTelemetry, validate_fuzz_metrics
 from tpudes.obs.profiler import (
     HostProfiler,
     InstrumentedScheduler,
@@ -47,6 +48,7 @@ __all__ = [
     "ChunkStream",
     "CompileTelemetry",
     "FlightRecorder",
+    "FuzzTelemetry",
     "HostProfiler",
     "InstrumentedScheduler",
     "RunStats",
@@ -58,5 +60,6 @@ __all__ = [
     "export_chrome_trace",
     "export_on_destroy",
     "validate_chrome_trace",
+    "validate_fuzz_metrics",
     "validate_serving_metrics",
 ]

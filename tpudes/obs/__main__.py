@@ -4,13 +4,16 @@ Usage::
 
     python -m tpudes.obs <trace.json> [more.json ...]
     python -m tpudes.obs --serving <metrics.json> [more.json ...]
+    python -m tpudes.obs --fuzz <metrics.json> [more.json ...]
 
 Default mode checks Chrome-trace exports against the Trace Event
 format; ``--serving`` checks :class:`tpudes.obs.serving.ServingTelemetry`
-snapshot dumps against the serving-metrics schema.  Exit 0 when every
-file is valid, 1 on violations, 2 on usage / unreadable input.  These
-are the schema gates the CI smoke steps run over the artifacts an
-example (``TpudesObs=1``) and the serving smoke produce.
+snapshot dumps against the serving-metrics schema; ``--fuzz`` checks
+:class:`tpudes.obs.fuzz.FuzzTelemetry` snapshot dumps against the
+fuzz-metrics schema.  Exit 0 when every file is valid, 1 on
+violations, 2 on usage / unreadable input.  These are the schema gates
+the CI smoke steps run over the artifacts an example (``TpudesObs=1``),
+the serving smoke, and the fuzz smoke produce.
 """
 
 from __future__ import annotations
@@ -19,18 +22,28 @@ import json
 import sys
 
 from tpudes.obs.export import validate_chrome_trace
+from tpudes.obs.fuzz import validate_fuzz_metrics
 from tpudes.obs.serving import validate_serving_metrics
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     serving = "--serving" in argv
-    argv = [a for a in argv if a != "--serving"]
-    if not argv or any(a in ("-h", "--help") for a in argv):
+    fuzz = "--fuzz" in argv
+    argv = [a for a in argv if a not in ("--serving", "--fuzz")]
+    if (
+        not argv
+        or (serving and fuzz)
+        or any(a in ("-h", "--help") for a in argv)
+    ):
         print(__doc__, file=sys.stderr)
         return 2
-    validate = validate_serving_metrics if serving else validate_chrome_trace
-    kind = "serving metrics" if serving else "Chrome trace"
+    if serving:
+        validate, kind = validate_serving_metrics, "serving metrics"
+    elif fuzz:
+        validate, kind = validate_fuzz_metrics, "fuzz metrics"
+    else:
+        validate, kind = validate_chrome_trace, "Chrome trace"
     rc = 0
     for path in argv:
         try:
@@ -45,10 +58,12 @@ def main(argv: list[str] | None = None) -> int:
             for p in problems:
                 print(f"{path}: {p}")
         else:
-            n = (
-                len(doc["engines"]) if serving
-                else len(doc["traceEvents"])
-            )
+            if serving:
+                n = len(doc["engines"])
+            elif fuzz:
+                n = doc["counters"]["scenarios"]
+            else:
+                n = len(doc["traceEvents"])
             print(f"{path}: valid {kind} ({n} records)")
     return rc
 

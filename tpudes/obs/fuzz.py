@@ -1,0 +1,137 @@
+"""Fuzz-harness observability: the differential fuzzer's metrics surface.
+
+:class:`FuzzTelemetry` is the process-global registry
+:mod:`tpudes.fuzz` records into — scenario throughput per engine,
+oracle-pair coverage counts (how many times each pair actually ran,
+and how many diverged), and shrink-loop effort — and
+:func:`validate_fuzz_metrics` is the schema gate the CI fuzz smoke
+runs over a dumped snapshot (``python -m tpudes.obs --fuzz
+metrics.json``).
+
+Follows the :class:`tpudes.obs.serving.ServingTelemetry` shape:
+recording is a dict update, snapshots are computed on demand, reset is
+explicit (the harness resets at campaign start so a snapshot describes
+exactly one campaign).
+"""
+
+from __future__ import annotations
+
+__all__ = ["FuzzTelemetry", "validate_fuzz_metrics"]
+
+
+class FuzzTelemetry:
+    """Process-wide fuzz metrics registry (cumulative since reset)."""
+
+    _counters: dict[str, int] = {}
+    _engines: dict[str, dict] = {}
+
+    # --- recording hooks (called by tpudes.fuzz.harness) -----------------
+
+    @classmethod
+    def _bump(cls, name: str, n: int = 1) -> None:
+        cls._counters[name] = cls._counters.get(name, 0) + int(n)
+
+    @classmethod
+    def _engine(cls, engine: str) -> dict:
+        return cls._engines.setdefault(
+            engine, {"scenarios": 0, "wall_s": 0.0, "pairs": {}}
+        )
+
+    @classmethod
+    def record_scenario(cls, engine: str, wall_s: float) -> None:
+        cls._bump("scenarios")
+        e = cls._engine(engine)
+        e["scenarios"] += 1
+        e["wall_s"] += float(wall_s)
+
+    @classmethod
+    def record_pair(cls, engine: str, pair: str, diverged: bool) -> None:
+        cls._bump("pair_runs")
+        p = cls._engine(engine)["pairs"].setdefault(
+            pair, {"runs": 0, "divergences": 0}
+        )
+        p["runs"] += 1
+        if diverged:
+            p["divergences"] += 1
+            cls._bump("divergences")
+
+    @classmethod
+    def record_shrink(cls, engine: str, iterations: int) -> None:
+        del engine
+        cls._bump("shrinks")
+        cls._bump("shrink_iterations", iterations)
+
+    # --- reading ----------------------------------------------------------
+
+    @classmethod
+    def snapshot(cls) -> dict:
+        counters = {
+            k: cls._counters.get(k, 0)
+            for k in (
+                "scenarios", "pair_runs", "divergences", "shrinks",
+                "shrink_iterations",
+            )
+        }
+        engines = {}
+        for name, e in sorted(cls._engines.items()):
+            wall = e["wall_s"]
+            engines[name] = {
+                "scenarios": e["scenarios"],
+                "wall_s": round(wall, 3),
+                "scenarios_per_s": (
+                    round(e["scenarios"] / wall, 4) if wall > 0 else 0.0
+                ),
+                "pairs": {
+                    k: dict(v) for k, v in sorted(e["pairs"].items())
+                },
+            }
+        return {"version": 1, "counters": counters, "engines": engines}
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._counters = {}
+        cls._engines = {}
+
+
+def validate_fuzz_metrics(doc) -> list[str]:
+    """Schema check for a :meth:`FuzzTelemetry.snapshot` document
+    (dependency-free, mirroring ``validate_serving_metrics``).  Returns
+    human-readable problems; empty means valid."""
+    from tpudes.obs.schema import make_need
+
+    problems: list[str] = []
+    need = make_need(problems)
+
+    if not isinstance(doc, dict):
+        return ["top level: not a JSON object"]
+    if doc.get("version") != 1:
+        problems.append("version: expected 1")
+    counters = need(doc, "counters", dict, "top level")
+    if counters is not None:
+        for k in (
+            "scenarios", "pair_runs", "divergences", "shrinks",
+            "shrink_iterations",
+        ):
+            v = need(counters, k, int, "counters")
+            if isinstance(v, int) and v < 0:
+                problems.append(f"counters.{k}: negative")
+    engines = need(doc, "engines", dict, "top level")
+    if engines is not None:
+        for name, e in engines.items():
+            where = f"engines.{name}"
+            need(e, "scenarios", int, where)
+            need(e, "wall_s", (int, float), where)
+            need(e, "scenarios_per_s", (int, float), where)
+            pairs = need(e, "pairs", dict, where)
+            if pairs is not None:
+                for pname, p in pairs.items():
+                    pw = f"{where}.pairs.{pname}"
+                    runs = need(p, "runs", int, pw)
+                    div = need(p, "divergences", int, pw)
+                    if (
+                        isinstance(runs, int)
+                        and isinstance(div, int)
+                        and div > runs
+                    ):
+                        problems.append(f"{pw}: divergences > runs")
+    return problems

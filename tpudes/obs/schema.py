@@ -1,0 +1,31 @@
+"""Shared primitive for the dependency-free obs schema validators
+(``validate_serving_metrics``, ``validate_fuzz_metrics``): one
+``need()`` closure per problems list, so every validator reports
+type/presence violations with identical wording.
+"""
+
+from __future__ import annotations
+
+__all__ = ["make_need"]
+
+
+def make_need(problems: list[str]):
+    """A ``need(obj, key, types, where)`` closure that appends a
+    human-readable problem on failure and returns the value (or None)."""
+
+    def need(obj, key, types, where):
+        if not isinstance(obj, dict):
+            problems.append(f"{where}: not an object")
+            return None
+        if key not in obj:
+            problems.append(f"{where}: missing key {key!r}")
+            return None
+        if not isinstance(obj[key], types):
+            problems.append(
+                f"{where}.{key}: expected {types}, got "
+                f"{type(obj[key]).__name__}"
+            )
+            return None
+        return obj[key]
+
+    return need

@@ -186,22 +186,10 @@ def validate_serving_metrics(doc) -> list[str]:
     """Schema check for a :meth:`ServingTelemetry.snapshot` document
     (dependency-free, mirroring ``validate_chrome_trace``).  Returns a
     list of human-readable problems; empty means valid."""
-    problems: list[str] = []
+    from tpudes.obs.schema import make_need
 
-    def need(obj, key, types, where):
-        if not isinstance(obj, dict):
-            problems.append(f"{where}: not an object")
-            return None
-        if key not in obj:
-            problems.append(f"{where}: missing key {key!r}")
-            return None
-        if not isinstance(obj[key], types):
-            problems.append(
-                f"{where}.{key}: expected {types}, got "
-                f"{type(obj[key]).__name__}"
-            )
-            return None
-        return obj[key]
+    problems: list[str] = []
+    need = make_need(problems)
 
     if not isinstance(doc, dict):
         return ["top level: not a JSON object"]

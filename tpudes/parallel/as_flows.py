@@ -32,7 +32,30 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpudes.fuzz.envelope import FuzzEnvelope
+
 INF = jnp.float32(1e30)
+
+#: the documented-faithful fuzz region (see :mod:`tpudes.fuzz`): BA
+#: graphs are connected by construction (every new node attaches to m
+#: existing ones), CBR loads stay in the sparse regime where the fluid
+#: outcome model is documented to coincide with the packet oracle
+FUZZ_ENVELOPE = FuzzEnvelope(
+    engine="as_flows",
+    axes={
+        "n_nodes": ("int", 24, 72),
+        "n_flows": ("int", 2, 6),
+        "flow_kbps": ("choice", (200.0, 400.0, 800.0)),
+        "pkt_bytes": ("choice", (256, 512)),
+        "topo_seed": ("int", 1, 999),
+        "sim_ms": ("int", 1000, 2500),
+        "replicas": ("int", 2, 9),
+        "chunk_divisor": ("choice", (2,)),
+        "key_seed": ("int", 0, 2**16),
+    },
+    floors={"replicas": 1, "n_nodes": 8, "n_flows": 1},
+    doc="BRITE BA AS topology, sparse CBR flows, fluid outcome model",
+)
 
 
 @dataclass(frozen=True)

@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpudes.fuzz.envelope import FuzzEnvelope
 from tpudes.models.lte.scheduler import SCHEDULERS
 from tpudes.parallel.kernels_pallas import (
     SM_PRECISIONS,
@@ -86,6 +87,30 @@ _SCHED_CLASS_TO_NAME = {
     for cls in set(SCHEDULERS.values())
     if cls.name in SM_SCHED_IDS
 }
+
+
+#: the documented-faithful fuzz region (see :mod:`tpudes.fuzz`): lena
+#: macro drops the host controller also runs (static ConstantPosition
+#: UEs, strongest-cell attach, RLC-SM full buffer), every registered
+#: FF-MAC scheduler, horizons short enough for the host TTI loop to be
+#: an affordable oracle — all inside the lower_lte_sm guards
+FUZZ_ENVELOPE = FuzzEnvelope(
+    engine="lte_sm",
+    axes={
+        "n_enbs": ("int", 1, 3),
+        "ues_per_cell": ("int", 2, 4),
+        "scheduler": ("choice", tuple(SM_SCHED_IDS)),
+        "inter_site": ("choice", (400.0, 500.0, 800.0)),
+        "layout": ("choice", ("hex", "line")),
+        "drop_seed": ("int", 1, 999),
+        "sim_ms": ("int", 80, 320),
+        "replicas": ("int", 1, 6),
+        "chunk_divisor": ("choice", (2, 3)),
+        "key_seed": ("int", 0, 2**16),
+    },
+    floors={"replicas": 1, "n_enbs": 1, "ues_per_cell": 1, "sim_ms": 16},
+    doc="lena macro grid, full-buffer RLC-SM downlink, all 9 schedulers",
+)
 
 
 @dataclass(frozen=True)

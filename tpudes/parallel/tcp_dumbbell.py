@@ -40,11 +40,14 @@ goodput, not per-packet equality.
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from tpudes.fuzz.envelope import FuzzEnvelope
 
 # variant ids (order is the vector-rule dispatch table; the full
 # upstream tcp-variants-comparison family, tcp_congestion.TCP_VARIANTS)
@@ -79,6 +82,33 @@ HTCP_DEFAULT_BACKOFF = 0.5
 YEAH_ALPHA, YEAH_QMAX, YEAH_RHO = 80.0, 8.0, 0.125
 LEDBAT_TARGET_S, LEDBAT_GAIN = 0.1, 1.0
 LP_INFERENCE_FRAC = 0.15
+
+
+#: the documented-faithful fuzz region (see :mod:`tpudes.fuzz`): the
+#: tcp-variants-comparison dumbbell shape lower_dumbbell accepts —
+#: access faster than the bottleneck, packet-mode droptail queue, one
+#: SendSize, all flows left→right — across the full 17-variant family
+#: ("mixed" assigns variants round-robin from the drawn one)
+FUZZ_ENVELOPE = FuzzEnvelope(
+    engine="dumbbell",
+    axes={
+        "n_flows": ("int", 2, 4),
+        "variant": ("choice", VARIANTS),
+        "variant_mix": ("choice", ("homogeneous", "mixed")),
+        "bottleneck_mbps": ("choice", (3, 5, 10)),
+        "bottleneck_delay_ms": ("choice", (5, 10, 20)),
+        "queue_pkts": ("choice", (25, 50, 100)),
+        "seg_bytes": ("choice", (500, 1000)),
+        "sim_ms": ("int", 900, 2500),
+        "replicas": ("int", 2, 9),
+        "chunk_divisor": ("choice", (2, 3)),
+        "key_seed": ("int", 0, 2**16),
+    },
+    # sim_ms floor 8: even at the fastest slot (500 B @ 10 Mbps,
+    # 0.432 ms) the shrunk horizon lands under 32 slots
+    floors={"replicas": 1, "n_flows": 1, "sim_ms": 8},
+    doc="single-bottleneck dumbbell, bulk TCP left→right, 17 variants",
+)
 
 
 @dataclass(frozen=True)
@@ -1003,6 +1033,25 @@ _TCP_FETCH = ("delivered", "drops", "qsum", "cwnd")
 _TCP_FETCH_OBS = ("cwnd_cuts", "retx_cnt", "q_hist")
 
 
+def _planted_divergence(finalize):
+    """``TPUDES_FUZZ_PLANTED_BUG=1``: deliberately corrupt CHUNKED-run
+    results (replica 0, flow 0: ``delivered`` += 1) so the fuzz
+    harness's planted-bug self-test (tests/test_fuzz.py + the CI step)
+    can prove the scalar-vs-chunked oracle detects, shrinks and replays
+    a real divergence end to end.  Never on outside that self-test —
+    the flag is read per call and gates nothing else."""
+
+    def wrapped(host):
+        out = finalize(host)
+        for point in out if isinstance(out, list) else [out]:
+            d = np.array(point["delivered"], copy=True)
+            d[0, 0] += 1
+            point["delivered"] = d
+        return out
+
+    return wrapped
+
+
 def _tcp_unpack(host: dict, prog: DumbbellProgram, replicas: int,
                 obs: bool) -> dict:
     """Host-side result assembly for ONE config point."""
@@ -1218,14 +1267,16 @@ def run_tcp_dumbbell(
 
     keys = _TCP_FETCH + (_TCP_FETCH_OBS if obs else ())
     fetch = {k: carry[1][k] for k in keys}
-    fut = EngineFuture(
-        "dumbbell",
-        fetch,
-        finalize_with_flush(
-            flush,
-            unstack_points(
-                n_cfg, lambda host: _tcp_unpack(host, prog, replicas, obs)
-            ),
+    finalize = finalize_with_flush(
+        flush,
+        unstack_points(
+            n_cfg, lambda host: _tcp_unpack(host, prog, replicas, obs)
         ),
     )
+    if (
+        chunk_slots is not None
+        and os.environ.get("TPUDES_FUZZ_PLANTED_BUG") == "1"
+    ):
+        finalize = _planted_divergence(finalize)
+    fut = EngineFuture("dumbbell", fetch, finalize)
     return fut.result() if block else fut
