@@ -657,8 +657,10 @@ def bench_fuzz_throughput(smoke: bool = False):
 
     per_engine = 1 if smoke else 2
     host_every = 0 if smoke else 2
+    from tpudes.fuzz.engines import ENGINE_FUZZERS
+
     result = run_campaign(
-        budget=4 * per_engine,
+        budget=len(ENGINE_FUZZERS) * per_engine,
         host_every=host_every,
         artifacts_dir="fuzz_artifacts",
     )
@@ -673,6 +675,171 @@ def bench_fuzz_throughput(smoke: bool = False):
         },
         pair_runs=snap["counters"]["pair_runs"],
         divergences=snap["counters"]["divergences"],
+    )
+
+
+def bench_hybrid_weak_scaling(max_ranks: int = 2, smoke: bool = False):
+    """ISSUE-9 row: hybrid PDES weak scaling — fixed work per rank.
+
+    Every rank count runs the SAME engine (the space-lane hybrid,
+    ``transport="batched"``) on a structurally identical per-rank block
+    (:func:`tpudes.parallel.wired.wired_weak_chain`) under the SAME
+    bounded window cadence (``window_slots`` = the boundary lookahead),
+    so the rows isolate what adding rank lanes costs from what the
+    window protocol costs.  Aggregate throughput = ranks x horizon
+    sim-s / wall-s; the acceptance bar is the 2-rank aggregate >= 1.6x
+    the 1-rank row on the CPU reference shape (lanes amortize the
+    per-window dispatch + D2H + demux that dominate at sparse shapes).
+
+    Measurement is PAIRED: the rank counts are interleaved round-robin
+    and each pair contributes one ratio, so hypervisor throttling
+    phases hit all rows alike; the row reports the MEDIAN ratio with
+    min/max spread (this box's unpaired walls drift ±40%)."""
+    import statistics
+
+    import jax
+
+    from tpudes.obs.distributed import DistributedTelemetry
+    from tpudes.parallel.hybrid import run_hybrid
+    from tpudes.parallel.wired import wired_weak_chain
+
+    n_slots = 18_000 if smoke else 108_000
+    period = 601 if smoke else 3573
+    # the window cadence (= the boundary lookahead) picks the regime:
+    # finer windows raise the K-shared protocol share of the wall, so
+    # rank lanes amortize more — the reference shape runs 180 windows
+    boundary = 1200 if smoke else 600
+    cross = 1481 if smoke else 8793
+    pairs = 3 if smoke else 9
+    rank_counts = [k for k in (1, 2, 4) if k <= max(2, int(max_ranks))]
+    key = jax.random.key(7)
+
+    progs = {
+        k: wired_weak_chain(
+            k, links_per_rank=2, period=period, n_slots=n_slots,
+            boundary_delay=boundary, cross_period=cross,
+        )
+        for k in rank_counts
+    }
+
+    def once(k):
+        t0 = time.monotonic()
+        out = run_hybrid(
+            progs[k], key, replicas=1, transport="batched",
+            window_slots=boundary,
+        )
+        return time.monotonic() - t0, out
+
+    DistributedTelemetry.reset()
+    windows = {k: once(k)[1]["windows"] for k in rank_counts}  # warm
+    walls: dict[int, list] = {k: [] for k in rank_counts}
+    for _ in range(pairs):
+        for k in rank_counts:
+            walls[k].append(once(k)[0])
+
+    med = {k: statistics.median(walls[k]) for k in rank_counts}
+    rows = {}
+    for k in rank_counts:
+        ratios = [
+            (k * w1) / wk for w1, wk in zip(walls[1], walls[k])
+        ]
+        rows[str(k)] = dict(
+            wall_med_s=round(med[k], 4),
+            windows=windows[k],
+            agg_sim_s_per_wall_s=round(
+                k * n_slots * progs[k].slot_s / med[k], 1
+            ),
+            ratio_vs_1rank=round(statistics.median(ratios), 3),
+            ratio_min=round(min(ratios), 3),
+            ratio_max=round(max(ratios), 3),
+        )
+    return dict(
+        transport="batched",
+        window_slots=boundary,
+        lookahead_slots=boundary,
+        per_rank=dict(
+            links=2, flows=3, n_slots=n_slots, period=period,
+        ),
+        pairs=pairs,
+        smoke=smoke,
+        telemetry=DistributedTelemetry.snapshot()["counters"],
+        ranks=rows,
+    )
+
+
+def _distributed_mesh_worker(pmesh, n_replicas, n_slots):
+    """One member process of the ``distributed_mesh`` row: run this
+    process's contiguous replica block with the GLOBAL offset — the
+    ``fold_in(key, r)`` purity contract makes the block bit-identical
+    to the same rows of one big launch (module-level so the spawn
+    start method can pickle it by reference)."""
+    import jax
+
+    from tpudes.parallel.wired import run_wired, wired_chain
+
+    lo, hi = pmesh.slice_bounds(n_replicas)
+    prog = wired_chain(n_links=4, n_flows=2, n_slots=n_slots,
+                       jitter_slots=3)
+    key = jax.random.key(11)
+    run_wired(prog, key, replicas=hi - lo, replica_offset=lo)  # warm
+    t0 = time.monotonic()
+    out = run_wired(prog, key, replicas=hi - lo, replica_offset=lo)
+    wall = time.monotonic() - t0
+    return dict(
+        lo=lo,
+        hi=hi,
+        wall_s=wall,
+        global_devices=jax.device_count(),
+        local_devices=jax.local_device_count(),
+        deliver=out["deliver_slot"],
+    )
+
+
+def bench_distributed_mesh(n_procs: int = 2, smoke: bool = False):
+    """ISSUE-9 row: the replica axis over N ``jax.distributed``
+    processes (the multi-process mesh path of
+    :mod:`tpudes.parallel.procmesh`).  CPU CI exercises the
+    process-sliced contract — each member runs its contiguous replica
+    block at the global offset and the stitched result must be
+    BIT-equal to the single-process launch (asserted here, not just
+    reported); on TPU/GPU the same worker takes the global-mesh path.
+    The row reports per-process walls, the stitched aggregate
+    replicas/s, and the global/local device counts the procmesh smoke
+    pins (global = members x local)."""
+    import jax
+    import numpy as np
+
+    from tpudes.parallel.procmesh import launch_process_mesh
+    from tpudes.parallel.wired import run_wired, wired_chain
+
+    n_replicas = 4 if smoke else 8
+    n_slots = 300 if smoke else 1200
+    outs = launch_process_mesh(
+        _distributed_mesh_worker, n_procs, args=(n_replicas, n_slots),
+        timeout_s=300.0,
+    )
+    stitched = np.concatenate([o["deliver"] for o in outs], axis=0)
+    prog = wired_chain(n_links=4, n_flows=2, n_slots=n_slots,
+                       jitter_slots=3)
+    ref = run_wired(prog, jax.random.key(11), replicas=n_replicas)
+    bit_equal = bool((stitched == ref["deliver_slot"]).all())
+    if not bit_equal:
+        raise AssertionError(
+            "distributed_mesh: stitched member blocks diverged from the "
+            "single-process launch — the replica_offset purity contract "
+            "is broken"
+        )
+    wall = max(o["wall_s"] for o in outs)
+    return dict(
+        processes=n_procs,
+        replicas=n_replicas,
+        slices=[[o["lo"], o["hi"]] for o in outs],
+        global_devices=outs[0]["global_devices"],
+        local_devices=outs[0]["local_devices"],
+        wall_max_s=round(wall, 4),
+        replicas_per_s=round(n_replicas / wall, 2),
+        bit_equal=bit_equal,
+        smoke=smoke,
     )
 
 
@@ -995,6 +1162,11 @@ def main():
         # ISSUE-8 row: scenarios/s per engine through the differential
         # fuzz harness (every oracle pair) — the cost of the safety net
         "fuzz_throughput": fuzz,
+        # ISSUE-9 rows: hybrid space-parallel weak scaling (fixed work
+        # per PDES rank, paired measurement) and the replica axis over
+        # N jax.distributed processes (bit-equal process slicing)
+        "hybrid_weak_scaling": bench_hybrid_weak_scaling(max_ranks=4),
+        "distributed_mesh": bench_distributed_mesh(),
         # tpudes.obs compile telemetry: per-engine XLA compile count +
         # wall time over the whole bench process (sweeps must not add
         # compiles — the single-executable property as a metric)
@@ -1024,8 +1196,26 @@ if __name__ == "__main__":
         action="store_true",
         help="tiny shapes for the CI virtual-device job (with --mesh)",
     )
+    ap.add_argument(
+        "--ranks",
+        type=int,
+        default=0,
+        help=(
+            "emit ONLY the hybrid weak-scaling row up to N PDES ranks "
+            "plus the N-process distributed mesh row (ISSUE-9)"
+        ),
+    )
     args = ap.parse_args()
-    if args.mesh:
+    if args.ranks:
+        print(json.dumps({
+            "hybrid_weak_scaling": bench_hybrid_weak_scaling(
+                max_ranks=args.ranks, smoke=args.smoke
+            ),
+            "distributed_mesh": bench_distributed_mesh(
+                n_procs=max(2, min(args.ranks, 4)), smoke=args.smoke
+            ),
+        }))
+    elif args.mesh:
         print(json.dumps({
             "mesh_scaling": bench_mesh(smoke=args.smoke),
             "mesh_config_sweep": bench_mesh_sweep(smoke=args.smoke),
@@ -1038,6 +1228,11 @@ if __name__ == "__main__":
             # divergence found by even this tiny budget fails loudly
             # in the asserted row)
             "fuzz_throughput": bench_fuzz_throughput(smoke=args.smoke),
+            # ISSUE-9: the hybrid weak-scaling row rides the CI mesh
+            # artifact so rank-lane scaling is asserted on every run
+            "hybrid_weak_scaling": bench_hybrid_weak_scaling(
+                max_ranks=2, smoke=args.smoke
+            ),
         }))
     else:
         main()

@@ -286,3 +286,83 @@ def run_chain_three_ranks(rank: int, size: int):
     Simulator.Run()
     Simulator.Destroy()
     return dict(server_rx=server_rx)
+
+
+# --- ISSUE-9: multi-process mesh workers (launch_process_mesh targets) ----
+
+
+def procmesh_devices(pmesh):
+    """Pin the jax.distributed invariant: the global device count sums
+    every member's local devices while local stays local."""
+    import jax
+
+    return dict(
+        process_id=pmesh.process_id,
+        num_processes=pmesh.num_processes,
+        global_devices=jax.device_count(),
+        local_devices=jax.local_device_count(),
+        backend=jax.default_backend(),
+    )
+
+
+def procmesh_replica_slice(pmesh, n_replicas: int):
+    """Run this process's contiguous replica block of a jittered wired
+    program at the GLOBAL offset (the fold_in purity contract)."""
+    import jax
+
+    from tpudes.parallel.wired import run_wired, wired_chain
+
+    lo, hi = pmesh.slice_bounds(n_replicas)
+    prog = wired_chain(n_links=4, n_flows=2, n_slots=300, jitter_slots=3)
+    out = run_wired(prog, jax.random.key(11), replicas=hi - lo,
+                    replica_offset=lo)
+    return dict(lo=lo, hi=hi, deliver=out["deliver_slot"])
+
+
+def procmesh_serving_router(pmesh, n_studies: int):
+    """Rank 0 runs a StudyServer with a ProcessRouter over the member
+    pipes; members run serve_studies.  Returns rank 0's routed results
+    + solo references (computed in the SAME process so compile caches
+    are warm), members' served counts."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from tpudes.parallel.mpi import MpiInterface
+    from tpudes.parallel.programs import toy_bss_program
+    from tpudes.serving import ProcessRouter, StudyServer, serve_studies
+
+    if pmesh.process_id != 0:
+        return dict(served=serve_studies(MpiInterface._conns[0]))
+
+    from tpudes.parallel.replicated import run_replicated_bss
+
+    prog = toy_bss_program(n_sta=4, sim_end_us=40_000)
+    key = jax.random.PRNGKey(3)
+    horizons = [40_000 + 2_000 * i for i in range(n_studies)]
+    router = ProcessRouter(MpiInterface._conns)
+    server = StudyServer(max_batch=8, router=router, start=False)
+    handles = [
+        server.submit_study(
+            "bss", dataclasses.replace(prog, sim_end_us=h), key, 2,
+            tenant=f"t{i}",
+        )
+        for i, h in enumerate(horizons)
+    ]
+    server.pump(force=True)
+    results = [h.result(timeout=240) for h in handles]
+    server.close()
+    equal = True
+    for h, res in zip(horizons, results):
+        solo = run_replicated_bss(
+            dataclasses.replace(prog, sim_end_us=h), 2, key
+        )
+        for k in solo:
+            if not np.array_equal(np.asarray(res[k]), np.asarray(solo[k])):
+                equal = False
+    return dict(
+        routed_batches=router.routed_batches,
+        routed_points=router.routed_points,
+        equal=equal,
+    )

@@ -88,3 +88,112 @@ def test_lookahead_registry_tracks_minimum():
     finally:
         MpiInterface._enabled = False
         MpiInterface._lookahead_ts = INF_TS
+
+
+# --- ISSUE-9 satellites: lookahead validation + framed wire format --------
+
+
+def test_zero_delay_error_names_the_offending_channel():
+    """Satellite: the Enable-time validation must name the channel so
+    a degenerate grant is debuggable from the message alone."""
+    MpiInterface._enabled = True
+    try:
+        with pytest.raises(ValueError, match="myChannel.*degenerates"):
+            MpiInterface.RegisterLookahead(0, source="myChannel")
+        with pytest.raises(ValueError, match="-3 ticks"):
+            MpiInterface.RegisterLookahead(-3, source="neg")
+    finally:
+        MpiInterface._enabled = False
+
+
+def test_remote_channel_registration_carries_source():
+    """PointToPointRemoteChannel registers its delay with a named
+    source, so a zero Delay attribute fails with the channel named."""
+    from tpudes.core import Seconds
+    from tpudes.models.p2p import PointToPointRemoteChannel
+
+    MpiInterface._enabled = True
+    MpiInterface._size = 2
+    try:
+        with pytest.raises(
+            ValueError, match="PointToPointRemoteChannel.*degenerates"
+        ):
+            PointToPointRemoteChannel(delay=Seconds(0))
+    finally:
+        MpiInterface._enabled = False
+        MpiInterface._size = 1
+        MpiInterface._lookahead_ts = INF_TS
+
+
+def test_run_requires_a_registered_lookahead():
+    """Satellite regression: a >1-rank engine with NOTHING registered
+    must fail loudly at Run start, not spin a degenerate grant."""
+    from tpudes.parallel.distributed import DistributedSimulatorImpl
+
+    MpiInterface._enabled = True
+    MpiInterface._size = 2
+    MpiInterface._rank = 0
+    MpiInterface._lookahead_ts = INF_TS
+    try:
+        impl = DistributedSimulatorImpl()
+        with pytest.raises(RuntimeError, match="no remote channel"):
+            impl._require_lookahead()
+    finally:
+        MpiInterface._enabled = False
+        MpiInterface._size = 1
+        MpiInterface._rank = 0
+        MpiInterface._lookahead_ts = INF_TS
+
+
+def test_wire_frame_roundtrip():
+    from tpudes.parallel.mpi import pack_frame, unpack_frame
+
+    msg = ("pkt", 123456, 7, 0, {"payload": list(range(10))})
+    assert unpack_frame(pack_frame(msg)) == msg
+
+
+def test_wire_frame_truncation_raises_before_unpickling():
+    from tpudes.parallel.mpi import (
+        WireFormatError,
+        pack_frame,
+        unpack_frame,
+    )
+
+    frame = pack_frame(("lbts", 42))
+    # a partial pipe read (any strict prefix) must raise, never
+    # reach the unpickler with garbage
+    for cut in (0, 1, 4, len(frame) - 1):
+        with pytest.raises(WireFormatError, match="truncated|mismatch"):
+            unpack_frame(frame[:cut])
+    # trailing garbage = length mismatch
+    with pytest.raises(WireFormatError, match="mismatch"):
+        unpack_frame(frame + b"\x00")
+
+
+def test_wire_frame_version_mismatch_raises():
+    from tpudes.parallel.mpi import (
+        WIRE_VERSION,
+        WireFormatError,
+        pack_frame,
+        unpack_frame,
+    )
+
+    frame = pack_frame(("lbts", 42))
+    foreign = bytes((WIRE_VERSION + 1,)) + frame[1:]
+    with pytest.raises(WireFormatError, match="version"):
+        unpack_frame(foreign)
+
+
+def test_corrupted_frame_raises_not_silently_diverges():
+    """Satellite regression: flipping bytes in the length field (the
+    partial-read shape) raises rather than desyncing the protocol."""
+    from tpudes.parallel.mpi import (
+        WireFormatError,
+        pack_frame,
+        unpack_frame,
+    )
+
+    frame = bytearray(pack_frame(("pkt", 99, 1, 0, b"x" * 64)))
+    frame[2] ^= 0xFF  # corrupt the declared length
+    with pytest.raises(WireFormatError):
+        unpack_frame(bytes(frame))

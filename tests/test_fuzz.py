@@ -148,7 +148,7 @@ def test_corpus_has_three_seeds_per_engine():
         assert doc["kind"] == "tpudes-fuzz-corpus", path
         by_engine[doc["engine"]] = by_engine.get(doc["engine"], 0) + 1
     assert by_engine == {
-        "bss": 3, "lte_sm": 3, "dumbbell": 3, "as_flows": 3,
+        "bss": 3, "lte_sm": 3, "dumbbell": 3, "as_flows": 3, "wired": 3,
     }
 
 

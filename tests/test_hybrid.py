@@ -1,0 +1,229 @@
+"""Hybrid space×replica PDES: device-engine ranks (ISSUE-9 tentpole).
+
+The pinned contracts of ROADMAP item 4(b):
+
+- a 1-rank hybrid run is BIT-identical to the plain device engine;
+- an N-rank run is timestamp-EXACT against the sequential host DES on
+  a deterministic cross-partition scenario (mirroring
+  tests/test_distributed.py's yardstick for the host engines);
+- the three transports (in-process lockstep, space-lane batched, one
+  OS process per rank) all produce identical results, because they
+  issue the identical advance/operand sequence.
+"""
+
+import pytest
+
+import jax
+
+from tpudes.obs.distributed import (
+    DistributedTelemetry,
+    validate_distributed_metrics,
+)
+from tpudes.parallel.hybrid import run_hybrid
+from tpudes.parallel.wired import (
+    UnliftableWiredError,
+    run_wired,
+    run_wired_host,
+    wired_chain,
+    wired_weak_chain,
+)
+
+KEY = jax.random.key(7)
+FIELDS = ("deliver_slot", "delivered", "served")
+
+
+def _cross_partition_prog(**kw):
+    """Deterministic 2-partition chain where every flow crosses the
+    boundary (each flow runs to the chain tail on the far rank)."""
+    kw.setdefault("n_slots", 400)
+    return wired_chain(n_links=6, n_flows=3, ranks=2, **kw)
+
+
+# --- the acceptance-criteria pins ------------------------------------------
+
+
+def test_one_rank_hybrid_bit_identical_to_plain_engine():
+    prog = wired_chain(n_links=6, n_flows=3, n_slots=400, ranks=1)
+    plain = run_wired(prog, KEY, replicas=2)
+    hybrid = run_hybrid(prog, KEY, replicas=2, ranks=1, transport="local")
+    for k in FIELDS:
+        assert (plain[k] == hybrid[k]).all(), k
+    # no boundary => infinite lookahead => a single granted window
+    assert hybrid["windows"] == 1
+
+
+def test_two_rank_hybrid_timestamp_exact_vs_host_des():
+    prog = _cross_partition_prog()
+    host = run_wired_host(prog)
+    hybrid = run_hybrid(prog, KEY, replicas=2, ranks=2, transport="local")
+    assert (hybrid["deliver_slot"][0] == host["deliver_slot"]).all()
+    assert (hybrid["deliver_slot"][1] == host["deliver_slot"]).all()
+    assert (hybrid["served"][0] == host["served"]).all()
+    # the window protocol actually ran granted windows
+    assert hybrid["windows"] > 1
+    # and traffic really crossed the partition boundary
+    assert hybrid["delivered"].sum() > 0
+
+
+def test_transports_identical():
+    prog = _cross_partition_prog()
+    plain = run_wired(prog, KEY, replicas=2)
+    local = run_hybrid(prog, KEY, replicas=2, transport="local")
+    batched = run_hybrid(prog, KEY, replicas=2, transport="batched")
+    for k in FIELDS:
+        assert (plain[k] == local[k]).all(), k
+        assert (plain[k] == batched[k]).all(), k
+    assert local["windows"] == batched["windows"]
+
+
+@pytest.mark.slow
+def test_mpi_transport_identical():
+    """One spawned OS process per rank, boundary traffic over the
+    framed MpiInterface pipes — results equal the in-process run."""
+    prog = _cross_partition_prog()
+    plain = run_wired(prog, KEY, replicas=2)
+    out = run_hybrid(prog, KEY, replicas=2, transport="mpi",
+                     timeout_s=240.0)
+    for k in FIELDS:
+        assert (plain[k] == out[k]).all(), k
+    assert out["windows"] > 1
+    assert out["loop_wall_s"] > 0
+
+
+def test_jitter_replicas_cross_partition():
+    """Per-replica phase jitter derives from GLOBAL (replica, flow)
+    ids, so every rank draws identical phases for shared flows."""
+    prog = _cross_partition_prog(jitter_slots=5)
+    plain = run_wired(prog, KEY, replicas=3)
+    hybrid = run_hybrid(prog, KEY, replicas=3, transport="local")
+    batched = run_hybrid(prog, KEY, replicas=3, transport="batched")
+    for k in FIELDS:
+        assert (plain[k] == hybrid[k]).all(), k
+        assert (plain[k] == batched[k]).all(), k
+
+
+# --- bounded windows (the weak-scaling cadence knob) -----------------------
+
+
+def test_bounded_grants_change_schedule_not_results():
+    prog = _cross_partition_prog()
+    free = run_hybrid(prog, KEY, replicas=1, transport="batched")
+    bounded = run_hybrid(prog, KEY, replicas=1, transport="batched",
+                         window_slots=11)
+    for k in FIELDS:
+        assert (free[k] == bounded[k]).all(), k
+    assert bounded["windows"] >= free["windows"]
+
+
+def test_bounded_grants_window_one_rank():
+    """With a bound, even a boundary-free 1-rank run pays the window
+    cadence — the fixed-discipline baseline of the weak-scaling row."""
+    prog = wired_chain(n_links=6, n_flows=3, n_slots=400, ranks=1)
+    plain = run_wired(prog, KEY, replicas=1)
+    bounded = run_hybrid(prog, KEY, replicas=1, ranks=1,
+                         transport="local", window_slots=50)
+    for k in FIELDS:
+        assert (plain[k] == bounded[k]).all(), k
+    assert bounded["windows"] == 8  # ceil(400 / 50)
+
+
+# --- weak-scaling scenario -------------------------------------------------
+
+
+def test_weak_chain_hybrid_exact_all_rank_counts():
+    for ranks in (1, 2, 4):
+        wp = wired_weak_chain(ranks, links_per_rank=2, n_slots=1500)
+        host = run_wired_host(wp)
+        out = run_hybrid(wp, KEY, replicas=1, transport="batched",
+                         window_slots=240)
+        assert (out["deliver_slot"][0] == host["deliver_slot"]).all(), ranks
+
+
+def test_batched_rejects_ragged_partitions():
+    """Non-uniform per-rank resident sets cannot stack as lanes — the
+    error names the counts and points at the ragged-capable transports."""
+    prog = wired_chain(n_links=6, n_flows=4, n_slots=300, ranks=2)
+    from tpudes.parallel.wired import build_wired_space_advance
+
+    with pytest.raises(UnliftableWiredError, match="uniform"):
+        build_wired_space_advance(prog, 1)
+
+
+def test_batched_rank_count_must_match_partitioning():
+    prog = _cross_partition_prog()
+    with pytest.raises(ValueError, match="ranks"):
+        run_hybrid(prog, KEY, replicas=1, ranks=3, transport="batched")
+
+
+# --- telemetry -------------------------------------------------------------
+
+
+def test_distributed_telemetry_schema_after_run():
+    DistributedTelemetry.reset()
+    prog = _cross_partition_prog()
+    run_hybrid(prog, KEY, replicas=1, transport="local")
+    snap = DistributedTelemetry.snapshot()
+    assert validate_distributed_metrics(snap) == []
+    assert set(snap["ranks"]) == {"0", "1"}
+    assert snap["counters"]["windows"] > 0
+    # chain topology: rank 0 sends downstream, rank 1 receives
+    assert snap["ranks"]["0"]["tx_pkts"] > 0
+    assert snap["ranks"]["1"]["rx_pkts"] == snap["ranks"]["0"]["tx_pkts"]
+    DistributedTelemetry.reset()
+
+
+def test_distributed_telemetry_absorb_merges_rank_snapshots():
+    DistributedTelemetry.reset()
+    DistributedTelemetry.record_window(
+        0, grant_slots=10, tx_pkts=2, rx_pkts=0, poll_wall_s=0.1,
+        flush_wall_s=0.2, grant_wall_s=0.3, advance_wall_s=0.4,
+    )
+    child = DistributedTelemetry.snapshot()
+    DistributedTelemetry.reset()
+    DistributedTelemetry.absorb(child)
+    DistributedTelemetry.absorb(child)
+    snap = DistributedTelemetry.snapshot()
+    assert validate_distributed_metrics(snap) == []
+    assert snap["ranks"]["0"]["windows"] == 2
+    assert snap["ranks"]["0"]["tx_pkts"] == 4
+    assert snap["counters"]["windows"] == 2
+    DistributedTelemetry.reset()
+
+
+def test_distributed_schema_rejects_malformed():
+    assert validate_distributed_metrics([]) != []
+    assert validate_distributed_metrics({"version": 1}) != []
+    ok = {
+        "version": 1,
+        "counters": {"windows": 1, "boundary_tx": 0, "boundary_rx": 0},
+        "ranks": {"0": {
+            "windows": 1, "wall_s": 0.1, "windows_per_s": 10.0,
+            "grant_slots_sum": 5, "grant_slots_mean": 5.0,
+            "grant_slots_max": 5,
+            "tx_pkts": 0, "rx_pkts": 0, "transport_tx": 0,
+            "transport_rx": 0, "poll_wall_s": 0.0, "flush_wall_s": 0.0,
+            "grant_wall_s": 0.1, "advance_wall_s": 0.0,
+        }},
+    }
+    assert validate_distributed_metrics(ok) == []
+    bad = {**ok, "ranks": {"x": ok["ranks"]["0"]}}
+    assert validate_distributed_metrics(bad) != []
+    bad2 = {**ok, "counters": {"windows": -1, "boundary_tx": 0,
+                               "boundary_rx": 0}}
+    assert validate_distributed_metrics(bad2) != []
+
+
+def test_obs_cli_distributed_gate(tmp_path, capsys):
+    import json
+
+    from tpudes.obs.__main__ import main as obs_main
+
+    DistributedTelemetry.reset()
+    prog = _cross_partition_prog()
+    run_hybrid(prog, KEY, replicas=1, transport="local")
+    p = tmp_path / "distributed.json"
+    p.write_text(json.dumps(DistributedTelemetry.snapshot()))
+    assert obs_main(["--distributed", str(p)]) == 0
+    p.write_text(json.dumps({"version": 2}))
+    assert obs_main(["--distributed", str(p)]) == 1
+    DistributedTelemetry.reset()

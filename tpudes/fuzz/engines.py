@@ -207,10 +207,13 @@ class EngineFuzzer:
     """Template for one engine's fuzz surface; subclasses fill in the
     build/run/host hooks.  ``outcome_fields`` is the sweep/serving
     comparison set (fields documented identical across launch modes);
-    ``None`` means "every field"."""
+    ``None`` means "every field".  ``cross_mode_pairs`` restricts the
+    default exact-pair set for engines that do not implement every
+    execution mode (None = the full ``harness.CROSS_MODE_PAIRS``)."""
 
     name: str = ""
     outcome_fields: tuple | None = None
+    cross_mode_pairs: tuple | None = None
 
     @property
     def envelope(self):
@@ -833,8 +836,133 @@ class AsFlowsFuzzer(EngineFuzzer):
         return out
 
 
+# ---------------------------------------------------------------------------
+# Wired graph (per-link queues — the hybrid-PDES partition unit)
+# ---------------------------------------------------------------------------
+
+
+class WiredFuzzer(EngineFuzzer):
+    """The hybrid-capable wired engine: deterministic CBR over
+    per-link queues, so every oracle pair here is EXACT (bit-equal
+    timestamps) — including the ``hybrid_vs_host`` pair, which runs the
+    full 2-rank granted-time-window protocol (in-process fabric: the
+    identical advance/operand sequence the spawned-rank transport
+    issues) against both the single-engine device run and the
+    sequential host DES."""
+
+    name = "wired"
+    outcome_fields = ("deliver_slot", "delivered", "served")
+    # no config-sweep axis on the wired engine (yet), so the swept /
+    # serving pairs cannot run; chunking, bucketing and mesh sharding
+    # all apply
+    cross_mode_pairs = ("chunked_vs_single", "bucketing_off",
+                        "mesh_vs_single")
+
+    @property
+    def envelope(self):
+        from tpudes.parallel.wired import FUZZ_ENVELOPE
+
+        return FUZZ_ENVELOPE
+
+    def build(self, cfg):
+        from tpudes.parallel.wired import wired_chain
+
+        L = int(cfg["n_links"])
+        return wired_chain(
+            n_links=L,
+            n_flows=int(cfg["n_flows"]),
+            service=[1 + (i % int(cfg["max_service"])) for i in range(L)],
+            period=int(cfg["period"]),
+            n_slots=int(cfg["n_slots"]),
+            ranks=2,
+            boundary_delay=int(cfg["boundary_delay"]),
+            jitter_slots=int(cfg["jitter"]),
+        )
+
+    def run_scalar(self, prog, cfg, mesh=None):
+        from tpudes.parallel.wired import run_wired
+
+        return run_wired(
+            prog, scenario_key(cfg), int(cfg["replicas"]), mesh=mesh
+        )
+
+    def run_chunked(self, prog, cfg, canonical):
+        from tpudes.parallel.wired import run_wired
+
+        # an off-boundary window size, mimicking a mid-stream grant cut
+        window = max(1, int(cfg["n_slots"]) // 3 - 1)
+        return run_wired(
+            prog, scenario_key(cfg), int(cfg["replicas"]),
+            window_slots=window,
+        )
+
+    def _jitter_rows(self, prog, cfg):
+        from tpudes.parallel.wired import _replica_jitter
+
+        return np.asarray(_replica_jitter(
+            prog, scenario_key(cfg), int(cfg["replicas"])
+        ))
+
+    def host_run(self, cfg):
+        from tpudes.parallel.wired import run_wired_host
+
+        prog = self.build(cfg)
+        jit = self._jitter_rows(prog, cfg)
+        # the host DES is cheap: run EVERY replica's jitter trajectory
+        rows = [
+            run_wired_host(prog, jitter=jit[r])
+            for r in range(int(cfg["replicas"]))
+        ]
+        return dict(
+            deliver_slot=np.stack([r["deliver_slot"] for r in rows]),
+            served=np.stack([r["served"] for r in rows]),
+        )
+
+    def host_compare(self, host, dev, cfg):
+        # deterministic model: the host DES and the device engine must
+        # agree on every timestamp — exact, not a fuzz band
+        return first_diff(
+            {k: host[k] for k in ("deliver_slot", "served")},
+            {k: np.asarray(dev[k]) for k in ("deliver_slot", "served")},
+        )
+
+    def extra_pairs(self):
+        def hybrid_vs_host(prog, cfg, canonical):
+            from tpudes.parallel.hybrid import run_hybrid
+
+            hybrid = run_hybrid(
+                prog, scenario_key(cfg), int(cfg["replicas"]),
+                ranks=2, transport="local",
+            )
+            diff = first_diff(
+                canonical, hybrid, fields=self.outcome_fields
+            )
+            if diff is not None:
+                return diff
+            host = self.host_run(cfg)
+            return first_diff(
+                {k: host[k] for k in ("deliver_slot", "served")},
+                {k: np.asarray(hybrid[k]) for k in ("deliver_slot", "served")},
+            )
+
+        return [("hybrid_vs_host", hybrid_vs_host)]
+
+    def shrink_moves(self, cfg):
+        out = super().shrink_moves(cfg)
+        floors = self.envelope.floors
+        for name in ("n_slots", "n_flows", "n_links"):
+            c = _shrink_int(cfg, name, floors.get(name, 1))
+            if c:
+                out.append((f"halve {name}", c))
+        c = _shrink_choice(cfg, "jitter", 0)
+        if c:
+            out.append(("no jitter", c))
+        return out
+
+
 #: engine name -> fuzzer (the registry the harness and CLI iterate)
 ENGINE_FUZZERS: dict[str, EngineFuzzer] = {
     f.name: f
-    for f in (BssFuzzer(), LteSmFuzzer(), DumbbellFuzzer(), AsFlowsFuzzer())
+    for f in (BssFuzzer(), LteSmFuzzer(), DumbbellFuzzer(), AsFlowsFuzzer(),
+              WiredFuzzer())
 }

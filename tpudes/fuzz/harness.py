@@ -147,7 +147,14 @@ def _run_named_pair(fz: EngineFuzzer, name: str, prog, cfg, canonical,
 
 
 def _pair_names(fz: EngineFuzzer, host: bool) -> list[str]:
-    names = list(CROSS_MODE_PAIRS)
+    # an engine that does not implement every execution mode restricts
+    # its exact-pair set (e.g. the wired engine has no config-sweep
+    # axis, so swept/serving pairs cannot run there)
+    names = list(
+        fz.cross_mode_pairs
+        if fz.cross_mode_pairs is not None
+        else CROSS_MODE_PAIRS
+    )
     names += [n for n, _ in fz.extra_pairs()]
     if host:
         names.append(PAIR_HOST)

@@ -98,7 +98,13 @@ class PointToPointRemoteChannel(PointToPointChannel):
         from tpudes.parallel.mpi import MpiInterface
 
         if MpiInterface.IsEnabled():
-            MpiInterface.RegisterLookahead(self.delay.GetTimeStep())
+            MpiInterface.RegisterLookahead(
+                self.delay.GetTimeStep(),
+                source=(
+                    "tpudes::PointToPointRemoteChannel"
+                    f"(Delay={self.delay.GetTimeStep()} ticks)"
+                ),
+            )
 
     def Attach(self, device) -> None:
         super().Attach(device)
@@ -112,7 +118,13 @@ class PointToPointRemoteChannel(PointToPointChannel):
                 sid = dev.GetNode().GetSystemId()
                 if sid != me:
                     MpiInterface.RegisterLookahead(
-                        self.delay.GetTimeStep(), peer_rank=sid
+                        self.delay.GetTimeStep(),
+                        peer_rank=sid,
+                        source=(
+                            "tpudes::PointToPointRemoteChannel"
+                            f"(Delay={self.delay.GetTimeStep()} ticks, "
+                            f"peer rank {sid})"
+                        ),
                     )
 
     def TransmitStart(self, packet, src_device, tx_time: Time) -> bool:

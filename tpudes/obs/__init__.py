@@ -27,6 +27,10 @@ from tpudes.obs.device import (
     CompileTelemetry,
     device_metrics_enabled,
 )
+from tpudes.obs.distributed import (
+    DistributedTelemetry,
+    validate_distributed_metrics,
+)
 from tpudes.obs.export import (
     assert_valid_chrome_trace,
     chrome_trace,
@@ -47,6 +51,7 @@ from tpudes.obs.serving import ServingTelemetry, validate_serving_metrics
 __all__ = [
     "ChunkStream",
     "CompileTelemetry",
+    "DistributedTelemetry",
     "FlightRecorder",
     "FuzzTelemetry",
     "HostProfiler",
@@ -60,6 +65,7 @@ __all__ = [
     "export_chrome_trace",
     "export_on_destroy",
     "validate_chrome_trace",
+    "validate_distributed_metrics",
     "validate_fuzz_metrics",
     "validate_serving_metrics",
 ]

@@ -5,12 +5,16 @@ Usage::
     python -m tpudes.obs <trace.json> [more.json ...]
     python -m tpudes.obs --serving <metrics.json> [more.json ...]
     python -m tpudes.obs --fuzz <metrics.json> [more.json ...]
+    python -m tpudes.obs --distributed <metrics.json> [more.json ...]
 
 Default mode checks Chrome-trace exports against the Trace Event
 format; ``--serving`` checks :class:`tpudes.obs.serving.ServingTelemetry`
 snapshot dumps against the serving-metrics schema; ``--fuzz`` checks
 :class:`tpudes.obs.fuzz.FuzzTelemetry` snapshot dumps against the
-fuzz-metrics schema.  Exit 0 when every file is valid, 1 on
+fuzz-metrics schema; ``--distributed`` checks
+:class:`tpudes.obs.distributed.DistributedTelemetry` snapshot dumps
+against the hybrid-PDES window-protocol schema.  Exit 0 when every
+file is valid, 1 on
 violations, 2 on usage / unreadable input.  These are the schema gates
 the CI smoke steps run over the artifacts an example (``TpudesObs=1``),
 the serving smoke, and the fuzz smoke produce.
@@ -21,6 +25,7 @@ from __future__ import annotations
 import json
 import sys
 
+from tpudes.obs.distributed import validate_distributed_metrics
 from tpudes.obs.export import validate_chrome_trace
 from tpudes.obs.fuzz import validate_fuzz_metrics
 from tpudes.obs.serving import validate_serving_metrics
@@ -30,10 +35,14 @@ def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     serving = "--serving" in argv
     fuzz = "--fuzz" in argv
-    argv = [a for a in argv if a not in ("--serving", "--fuzz")]
+    distributed = "--distributed" in argv
+    argv = [
+        a for a in argv
+        if a not in ("--serving", "--fuzz", "--distributed")
+    ]
     if (
         not argv
-        or (serving and fuzz)
+        or serving + fuzz + distributed > 1
         or any(a in ("-h", "--help") for a in argv)
     ):
         print(__doc__, file=sys.stderr)
@@ -42,6 +51,8 @@ def main(argv: list[str] | None = None) -> int:
         validate, kind = validate_serving_metrics, "serving metrics"
     elif fuzz:
         validate, kind = validate_fuzz_metrics, "fuzz metrics"
+    elif distributed:
+        validate, kind = validate_distributed_metrics, "distributed metrics"
     else:
         validate, kind = validate_chrome_trace, "Chrome trace"
     rc = 0
@@ -62,6 +73,8 @@ def main(argv: list[str] | None = None) -> int:
                 n = len(doc["engines"])
             elif fuzz:
                 n = doc["counters"]["scenarios"]
+            elif distributed:
+                n = doc["counters"]["windows"]
             else:
                 n = len(doc["traceEvents"])
             print(f"{path}: valid {kind} ({n} records)")

@@ -313,7 +313,13 @@ def as_study(prog: AsFlowsProgram, key, replicas, mesh=None,
             rate_scale=[1.0] * n_points,
         )
 
-    return StudyDescriptor("as_flows", ck, float(rate_scale), launch, warm)
+    spec = None if mesh is not None else dict(
+        engine="as_flows", prog=prog, key=np.asarray(key),
+        replicas=replicas,
+    )
+    return StudyDescriptor(
+        "as_flows", ck, float(rate_scale), launch, warm, spec=spec
+    )
 
 
 def run_as_flows(

@@ -37,6 +37,26 @@ class DistributedSimulatorImpl(DefaultSimulatorImpl):
             )
         self.windows_run = 0
 
+    def _require_lookahead(self) -> int:
+        """The conservative engines cannot run without a finite,
+        positive lookahead: with no remote channel registered the
+        grant is ``min(next_event + INF)`` — every rank would either
+        terminate instantly believing the world idle or (null-message)
+        never bound a peer.  Zero/negative delays are rejected at
+        registration time (:meth:`MpiInterface.RegisterLookahead`
+        names the offending channel); this catches the
+        nothing-registered shape at Run start."""
+        lookahead = MpiInterface.MinLookahead()
+        if MpiInterface.GetSize() > 1 and lookahead >= INF_TS:
+            raise RuntimeError(
+                f"rank {MpiInterface.GetSystemId()}: no remote channel "
+                "registered a lookahead (PointToPointRemoteChannel "
+                "registers its delay at construction) — with infinite "
+                "lookahead the granted-time window degenerates and the "
+                "partitions cannot exchange traffic"
+            )
+        return lookahead
+
     def _deliver(self, rx_ts, node_id, if_index, packet):
         from tpudes.network.node import NodeList
 
@@ -51,7 +71,7 @@ class DistributedSimulatorImpl(DefaultSimulatorImpl):
     def Run(self) -> None:
         self._stop = False
         events = self._events
-        lookahead = MpiInterface.MinLookahead()
+        lookahead = self._require_lookahead()
         while True:
             self._process_events_with_context()
             # phase 1: land ALL in-flight traffic, then bound future sends
@@ -109,6 +129,7 @@ class NullMessageSimulatorImpl(DistributedSimulatorImpl):
 
     def Run(self) -> None:
         self._stop = False
+        self._require_lookahead()
         events = self._events
         peers = list(MpiInterface._conns)
         guarantee_in = {p: MpiInterface.PeerLookahead(p) for p in peers}

@@ -357,7 +357,12 @@ def lte_sm_study(prog: LteSmProgram, key, replicas=None, mesh=None):
                 schedulers=[prog.scheduler] * n_points,
             )
 
-    return StudyDescriptor("lte_sm", ck, prog.scheduler, launch, warm)
+    spec = None if mesh is not None else dict(
+        engine="lte_sm", prog=prog, key=np.asarray(key), replicas=replicas,
+    )
+    return StudyDescriptor(
+        "lte_sm", ck, prog.scheduler, launch, warm, spec=spec
+    )
 
 
 def run_lte_sm(

@@ -22,9 +22,12 @@ by tests/test_distributed.py):
 3. **grant phase**: each rank computes candidate = next-event-time +
    lookahead over its now-complete queue and all-reduces the minimum.
 
-Packet wire format: pickle of the structured Packet (headers are plain
-objects); upstream uses its Buffer serialization — the pickle is this
-build's local-process equivalent.
+Packet wire format: a framed pickle of the structured Packet (headers
+are plain objects); upstream uses its Buffer serialization — the pickle
+is this build's local-process equivalent.  Every frame is prefixed with
+a protocol-version byte plus a 4-byte big-endian payload length, so a
+truncated or corrupted read raises :class:`WireFormatError` loudly
+instead of unpickling garbage and silently diverging the simulation.
 """
 
 from __future__ import annotations
@@ -32,6 +35,47 @@ from __future__ import annotations
 import pickle
 
 INF_TS = 1 << 62
+
+#: bump when the frame layout or message tuples change shape — a
+#: version mismatch between rank binaries must fail loudly at the
+#: first frame, not corrupt the window protocol mid-run
+WIRE_VERSION = 1
+
+_HEADER_LEN = 5  # 1 version byte + 4 length bytes
+
+
+class WireFormatError(RuntimeError):
+    """A cross-rank frame failed validation (truncated pipe read,
+    length mismatch, or a peer speaking another protocol version)."""
+
+
+def pack_frame(obj) -> bytes:
+    """version byte + 4-byte big-endian body length + pickle body."""
+    body = pickle.dumps(obj)
+    return bytes((WIRE_VERSION,)) + len(body).to_bytes(4, "big") + body
+
+
+def unpack_frame(buf: bytes):
+    """Validate and unpickle one frame; raises :class:`WireFormatError`
+    before any byte reaches the unpickler when the frame is short, the
+    declared length disagrees with the payload, or the version byte is
+    foreign."""
+    if len(buf) < _HEADER_LEN:
+        raise WireFormatError(
+            f"truncated frame: {len(buf)} bytes < {_HEADER_LEN}-byte header"
+        )
+    if buf[0] != WIRE_VERSION:
+        raise WireFormatError(
+            f"wire protocol version {buf[0]} != {WIRE_VERSION} "
+            "(mixed-build ranks?)"
+        )
+    declared = int.from_bytes(buf[1:_HEADER_LEN], "big")
+    if len(buf) - _HEADER_LEN != declared:
+        raise WireFormatError(
+            f"frame length mismatch: header declares {declared} payload "
+            f"bytes, got {len(buf) - _HEADER_LEN} (partial pipe read?)"
+        )
+    return pickle.loads(buf[_HEADER_LEN:])
 
 
 class MpiInterface:
@@ -95,11 +139,19 @@ class MpiInterface:
 
     # --- lookahead registry (remote channels report their delay) ---------
     @classmethod
-    def RegisterLookahead(cls, delay_ticks: int, peer_rank: int | None = None) -> None:
+    def RegisterLookahead(
+        cls,
+        delay_ticks: int,
+        peer_rank: int | None = None,
+        source: str | None = None,
+    ) -> None:
         if delay_ticks <= 0:
             raise ValueError(
-                "remote channels need a positive delay (zero lookahead "
-                "deadlocks the conservative grant)"
+                f"remote channel {source or '<unnamed>'} has delay "
+                f"{delay_ticks} ticks: remote channels need a positive "
+                "delay — a zero/negative lookahead degenerates the "
+                "conservative grant to no progress (the window never "
+                "advances)"
             )
         cls._lookahead_ts = min(cls._lookahead_ts, delay_ticks)
         if peer_rank is not None:
@@ -126,7 +178,7 @@ class MpiInterface:
         write happens in the next Flush so a large window can never
         block mid-event on a full pipe)."""
         cls._spool.setdefault(dst_rank, []).append(
-            pickle.dumps(("pkt", rx_ts, node_id, if_index, packet))
+            pack_frame(("pkt", rx_ts, node_id, if_index, packet))
         )
         cls._tx_count += 1
 
@@ -140,7 +192,7 @@ class MpiInterface:
         import threading
 
         spool, cls._spool = cls._spool, {}
-        marker = pickle.dumps(("flush",))
+        marker = pack_frame(("flush",))
 
         def write_all():
             for rank, c in cls._conns.items():
@@ -152,7 +204,7 @@ class MpiInterface:
         writer.start()
         for c in cls._conns.values():
             while True:
-                msg = pickle.loads(c.recv_bytes())
+                msg = unpack_frame(c.recv_bytes())
                 if msg[0] == "flush":
                     break
                 _, rx_ts, node_id, if_index, packet = msg
@@ -198,7 +250,7 @@ class MpiInterface:
         wedge the event loop (the MPI_Isend analog for null-message
         traffic, where no flush barrier exists to pair writers/readers)."""
         cls._ensure_sender()
-        cls._send_q.put((dst_rank, pickle.dumps(msg)))
+        cls._send_q.put((dst_rank, pack_frame(msg)))
         cls._tx_count += 1
 
     @classmethod
@@ -226,7 +278,7 @@ class MpiInterface:
         for c in ready:
             rank = by_conn[id(c)]
             try:
-                out.append((rank, pickle.loads(c.recv_bytes())))
+                out.append((rank, unpack_frame(c.recv_bytes())))
                 cls._rx_count += 1
             except (EOFError, OSError):
                 out.append((rank, ("eof",)))
@@ -245,10 +297,10 @@ class MpiInterface:
         """Phase 2: global minimum of the per-rank grant candidates.
         Call only with no traffic in flight (right after Flush)."""
         for c in cls._conns.values():
-            c.send_bytes(pickle.dumps(("lbts", candidate_ts)))
+            c.send_bytes(pack_frame(("lbts", candidate_ts)))
         grant = candidate_ts
         for c in cls._conns.values():
-            msg = pickle.loads(c.recv_bytes())
+            msg = unpack_frame(c.recv_bytes())
             assert msg[0] == "lbts", f"protocol desync: {msg[0]!r}"
             grant = min(grant, msg[1])
         return grant

@@ -1,0 +1,151 @@
+"""Multi-process device meshes: ``jax.distributed``-backed scale-out.
+
+ROADMAP item 4(a): the mesh rows used to stop at one host's visible
+devices.  This module joins N **processes** (CPU processes in CI; the
+identical code path is the multi-host TPU path) into one jax
+distributed runtime so the replica and config axes can shard across
+them:
+
+- :func:`init_process_mesh` — ``jax.distributed.initialize`` against a
+  local coordinator; afterwards ``jax.devices()`` enumerates EVERY
+  process's devices (the global view a multi-host TPU slice gives).
+- :func:`global_replica_mesh` — a 1-D mesh over the global device set.
+  On TPU/GPU backends the engines take it straight through their
+  ``mesh=`` argument (``shard_replica_axis`` → GSPMD does the rest —
+  the same code that shards single-host meshes today).  XLA:CPU does
+  **not** implement cross-process computations, so
+  :func:`supports_global_computation` gates that path and CI instead
+  exercises the **process-sliced** contract below.
+- **Process-sliced axes** (:func:`process_slice`): replica/config axes
+  split into contiguous per-process blocks.  The engines' randomness is
+  pure in the *global* replica index (``fold_in(key, r)`` — the PR-4
+  bucketing contract), so a process running its block with the global
+  offset (e.g. ``run_wired(..., replica_offset=lo)``) computes
+  bit-identical rows to the corresponding slice of one big launch; the
+  config axis needs no offset at all (points are explicit operands, and
+  the PR-5 sweep contract makes any split bit-equal).  The serving
+  layer routes coalesced batches across member processes exactly this
+  way (:mod:`tpudes.serving.distributed`).
+- :func:`launch_process_mesh` — spawn N local processes wired with the
+  :mod:`tpudes.parallel.mpi` control fabric AND a shared
+  ``jax.distributed`` coordinator; each runs
+  ``worker(pmesh, *args)`` and results gather like
+  :func:`LaunchDistributed`.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+
+__all__ = [
+    "ProcessMesh",
+    "global_replica_mesh",
+    "init_process_mesh",
+    "launch_process_mesh",
+    "process_slice",
+    "supports_global_computation",
+]
+
+
+@dataclass(frozen=True)
+class ProcessMesh:
+    """One process's view of the N-process device runtime."""
+
+    process_id: int
+    num_processes: int
+    coordinator_address: str
+
+    def slice_bounds(self, n: int) -> tuple[int, int]:
+        """This process's contiguous block of an ``n``-long axis."""
+        return process_slice(n, self.num_processes, self.process_id)
+
+
+def process_slice(n: int, num_processes: int, process_id: int
+                  ) -> tuple[int, int]:
+    """Balanced contiguous split of an ``n``-long axis: the first
+    ``n % num_processes`` blocks carry one extra element."""
+    n, k, p = int(n), int(num_processes), int(process_id)
+    base, extra = divmod(n, k)
+    lo = p * base + min(p, extra)
+    return lo, lo + base + (1 if p < extra else 0)
+
+
+def supports_global_computation() -> bool:
+    """True when the active backend can run ONE computation over a
+    multi-process mesh (TPU/GPU).  XLA:CPU raises ``Multiprocess
+    computations aren't implemented`` — CI uses the process-sliced
+    contract there instead."""
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def init_process_mesh(coordinator_address: str, num_processes: int,
+                      process_id: int) -> ProcessMesh:
+    """Join this process into the distributed jax runtime (idempotent
+    per process).  After this call ``jax.device_count()`` counts every
+    member process's devices while ``jax.local_device_count()`` stays
+    local — the invariant the procmesh smoke test pins."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes),
+        process_id=int(process_id),
+    )
+    # force backend construction NOW: the global topology exchange
+    # blocks every member's first jax op until ALL members registered
+    # their local devices — a member that defers its first jax touch
+    # (e.g. straight into a blocking serve loop) would deadlock the
+    # whole mesh for the key-value timeout
+    jax.devices()
+    return ProcessMesh(int(process_id), int(num_processes),
+                       coordinator_address)
+
+
+def global_replica_mesh(axis: str = "replica"):
+    """1-D mesh over the GLOBAL device set (every member process).  On
+    accelerator backends this drops into the engines' ``mesh=``
+    argument unchanged; on CPU it still constructs (device enumeration
+    works) but executing a computation over it raises — gate with
+    :func:`supports_global_computation`."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (axis,))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _procmesh_main(rank: int, size: int, port: int, env: dict, worker,
+                   args: tuple):
+    # the spawned child may inherit a parent's virtual-device XLA flag
+    # overrides; apply the launcher's env pins before jax initializes
+    for k, v in env.items():
+        os.environ[k] = v
+    pmesh = init_process_mesh(f"127.0.0.1:{port}", size, rank)
+    return worker(pmesh, *args)
+
+
+def launch_process_mesh(worker, num_processes: int, args: tuple = (),
+                        timeout_s: float = 300.0, env: dict | None = None):
+    """Run ``worker(pmesh, *args)`` in ``num_processes`` spawned local
+    processes sharing one ``jax.distributed`` coordinator plus the
+    all-to-all :class:`~tpudes.parallel.mpi.MpiInterface` control
+    pipes; returns the per-process results in rank order."""
+    from tpudes.parallel.mpi import LaunchDistributed
+
+    port = _free_port()
+    return LaunchDistributed(
+        _procmesh_main,
+        num_processes,
+        args=(port, dict(env or {}), worker, args),
+        timeout_s=timeout_s,
+    )

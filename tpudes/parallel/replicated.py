@@ -834,7 +834,12 @@ def bss_study(prog: BssProgram, key, replicas, mesh=None):
                 sim_end_us=[tiny.sim_end_us] * n_points,
             )
 
-    return StudyDescriptor("bss", ck, int(prog.sim_end_us), launch, warm)
+    spec = None if mesh is not None else dict(
+        engine="bss", prog=prog, key=np.asarray(key), replicas=replicas,
+    )
+    return StudyDescriptor(
+        "bss", ck, int(prog.sim_end_us), launch, warm, spec=spec
+    )
 
 
 def run_replicated_bss(

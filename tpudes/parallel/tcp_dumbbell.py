@@ -1132,7 +1132,13 @@ def tcp_study(prog: DumbbellProgram, key, replicas, mesh=None):
                 variants=[list(point)] * n_points,
             )
 
-    return StudyDescriptor("dumbbell", ck, point, launch, warm, solo=solo)
+    spec = None if (mesh is not None or solo) else dict(
+        engine="dumbbell", prog=prog, key=np.asarray(key),
+        replicas=replicas,
+    )
+    return StudyDescriptor(
+        "dumbbell", ck, point, launch, warm, solo=solo, spec=spec
+    )
 
 
 def run_tcp_dumbbell(

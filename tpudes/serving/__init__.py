@@ -25,12 +25,15 @@ Quick start::
 """
 
 from tpudes.serving.descriptor import StudyDescriptor, mesh_fingerprint
+from tpudes.serving.distributed import ProcessRouter, serve_studies
 from tpudes.serving.server import AdmissionError, StudyHandle, StudyServer
 
 __all__ = [
     "AdmissionError",
+    "ProcessRouter",
     "StudyDescriptor",
     "StudyHandle",
     "StudyServer",
     "mesh_fingerprint",
+    "serve_studies",
 ]

@@ -34,6 +34,13 @@ engine knows which of its fields are traced) returning a
   cover (e.g. a dumbbell program whose ``ecn`` disagrees with the
   variants' ``REQUIRES_ECN`` flags — sweep points derive ECN from the
   variant); the server never batches it with anything.
+- ``spec`` — a **picklable** launch description
+  (``{"engine", "prog", "key", "replicas"}``) for studies that can be
+  routed to a member process of a multi-process mesh
+  (:mod:`tpudes.serving.distributed`): the member rebuilds the
+  descriptor from the spec through the same ``*_study`` extractor and
+  launches its slice of the batch's points.  ``None`` (e.g. a study
+  pinned to a live device mesh) keeps the study host-local.
 """
 
 from __future__ import annotations
@@ -65,6 +72,8 @@ class StudyDescriptor:
     launch: Callable  # (points, block=False) -> result | EngineFuture
     warm: Callable = None  # (n_points) -> None, blocking mini-compile
     solo: bool = field(default=False)
+    #: picklable launch spec for cross-process routing (None = local)
+    spec: dict | None = field(default=None, compare=False)
 
     def compatible(self, other: "StudyDescriptor") -> bool:
         """True when ``self`` and ``other`` may share one launch."""

@@ -1,0 +1,206 @@
+"""Cross-process study routing: the StudyServer on a process mesh.
+
+ROADMAP item 4: "the serving layer routes studies to member
+processes".  A :class:`ProcessRouter` plugs into
+``StudyServer(router=...)``: when a coalesced batch's studies carry a
+picklable ``spec`` (see :class:`~tpudes.serving.descriptor.
+StudyDescriptor`), the router splits the batch's config points into
+contiguous per-process blocks (:func:`~tpudes.parallel.procmesh.
+process_slice`), keeps block 0 on the serving process (through the
+descriptor's own launch, inside ``RUNTIME``'s in-flight window) and
+ships the other blocks to member processes over the
+:class:`~tpudes.parallel.mpi.MpiInterface` control pipes (framed wire
+format).  Each member rebuilds the descriptor from the spec through the
+SAME ``*_study`` extractor and launches its block — so every split
+result is covered by the PR-5 sweep bit-equality contract, and the
+reassembled batch is bit-equal to the unrouted launch
+(tests/test_procmesh.py pins it).
+
+Members run :func:`serve_studies` — a blocking loop on the pipe to the
+serving rank — until the router closes.  On multi-host TPU the same
+topology applies with one serving process per pod slice; the CPU CI
+exercises the full round trip on two local processes.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+
+__all__ = ["ProcessRouter", "serve_studies"]
+
+
+class _RoutedFuture:
+    """Future over one routed batch: the local block's EngineFuture
+    plus the member replies still in flight.  Duck-types the
+    ``done()/result()`` surface StudyServer's demux loop uses."""
+
+    def __init__(self, local_fut, local_n, remote, local_error=None):
+        self._local_fut = local_fut
+        self._local_n = local_n
+        self._remote = remote          # [(conn, n_points), ...] rank order
+        self._local_error = local_error
+        self._result = None
+        self._done = False
+
+    def done(self) -> bool:
+        if self._done:
+            return True
+        if self._local_fut is not None and not self._local_fut.done():
+            return False
+        return all(conn.poll() for conn, _ in self._remote)
+
+    def result(self):
+        from tpudes.parallel.mpi import unpack_frame
+
+        if self._done:
+            if isinstance(self._result, Exception):
+                raise self._result
+            return self._result
+        # drain EVERY member reply FIRST, even when something already
+        # failed: a frame left on a shared pipe would be read by the
+        # NEXT routed batch's future, silently desyncing every routed
+        # launch after one poisoned batch
+        replies = [
+            (n, unpack_frame(conn.recv_bytes())) for conn, n in self._remote
+        ]
+        self._done = True
+        try:
+            out: list = []
+            if self._local_error is not None:
+                raise self._local_error
+            if self._local_fut is not None:
+                res = self._local_fut.result()
+                local = res if isinstance(res, list) else [res]
+                if len(local) != self._local_n:
+                    raise RuntimeError(
+                        f"local block returned {len(local)} results for "
+                        f"{self._local_n} points"
+                    )
+                out.extend(local)
+            for n, (kind, payload) in replies:
+                if kind == "error":
+                    raise RuntimeError(
+                        f"routed member launch failed:\n{payload}"
+                    )
+                if len(payload) != n:
+                    raise RuntimeError(
+                        f"routed member returned {len(payload)} results "
+                        f"for {n} points"
+                    )
+                out.extend(payload)
+        except Exception as e:
+            self._result = e
+            raise
+        self._result = out
+        return out
+
+
+class ProcessRouter:
+    """Splits coalesced batches across the member processes reachable
+    over ``conns`` (peer rank -> Connection, e.g.
+    ``MpiInterface._conns`` inside a :func:`launch_process_mesh`
+    worker)."""
+
+    def __init__(self, conns: dict):
+        self._conns = [c for _, c in sorted(conns.items())]
+        self.routed_batches = 0
+        self.routed_points = 0
+        self._closed = False
+
+    def launch(self, batch, points):
+        """Dispatch one batch, split across processes; returns a
+        :class:`_RoutedFuture`, or None when the batch cannot be routed
+        (single point, no members, or a spec-less study) — the caller
+        falls back to the plain local launch."""
+        from tpudes.parallel.mpi import pack_frame
+        from tpudes.parallel.procmesh import process_slice
+        from tpudes.parallel.runtime import RUNTIME
+
+        n_procs = len(self._conns) + 1
+        if self._closed or n_procs < 2 or len(points) < 2:
+            return None
+        if any(r.desc.spec is None for r in batch):
+            return None
+        spec = batch[0].desc.spec
+        bounds = [
+            process_slice(len(points), n_procs, p) for p in range(n_procs)
+        ]
+        remote = []
+        for p, conn in enumerate(self._conns, start=1):
+            lo, hi = bounds[p]
+            if hi <= lo:
+                continue
+            conn.send_bytes(pack_frame((
+                "study",
+                dict(
+                    engine=spec["engine"],
+                    prog=spec["prog"],
+                    key=np.asarray(spec["key"]),
+                    replicas=spec["replicas"],
+                    points=list(points[lo:hi]),
+                ),
+            )))
+            remote.append((conn, hi - lo))
+        lo, hi = bounds[0]
+        local_fut = local_error = None
+        if hi > lo:
+            try:
+                local_fut = RUNTIME.submit(
+                    batch[0].desc.launch, list(points[lo:hi])
+                )
+            except Exception as e:  # noqa: BLE001 - member frames are
+                # already in flight; the future must still drain their
+                # replies before surfacing this, or the pipes desync
+                local_error = e
+        self.routed_batches += 1
+        self.routed_points += sum(n for _, n in remote)
+        return _RoutedFuture(local_fut, hi - lo, remote, local_error)
+
+    def close(self) -> None:
+        """Tell every member's :func:`serve_studies` loop to exit."""
+        from tpudes.parallel.mpi import pack_frame
+
+        if self._closed:
+            return
+        self._closed = True
+        for conn in self._conns:
+            try:
+                conn.send_bytes(pack_frame(("close", None)))
+            except (OSError, ValueError):
+                pass
+
+
+def serve_studies(conn) -> int:
+    """Member-process loop: execute routed launch specs arriving on
+    ``conn`` (the pipe to the serving rank) until a close frame;
+    returns the number of launches served.  The spec rebuilds the
+    study through the engine's own ``*_study`` extractor, so a member
+    launch takes exactly the code path a local launch takes."""
+    import traceback
+
+    from tpudes.parallel.mpi import pack_frame, unpack_frame
+    from tpudes.serving.server import _ENGINE_STUDY
+
+    served = 0
+    while True:
+        kind, payload = unpack_frame(conn.recv_bytes())
+        if kind == "close":
+            return served
+        if kind != "study":
+            raise RuntimeError(f"unexpected routed frame kind {kind!r}")
+        try:
+            mod_name, fn_name = _ENGINE_STUDY[payload["engine"]]
+            extract = getattr(importlib.import_module(mod_name), fn_name)
+            desc = extract(
+                payload["prog"], payload["key"], payload["replicas"]
+            )
+            res = desc.launch(payload["points"])
+            if hasattr(res, "result"):  # EngineFuture: resolve to host
+                res = res.result()      # numpy before the wire
+            results = res if isinstance(res, list) else [res]
+            conn.send_bytes(pack_frame(("result", results)))
+            served += 1
+        except Exception:  # noqa: BLE001 - poison the batch, not the loop
+            conn.send_bytes(pack_frame(("error", traceback.format_exc())))

@@ -136,12 +136,18 @@ class StudyServer:
         tenant_cap: int = 64,
         warm: list | None = None,
         start: bool = True,
+        router=None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.max_wait_s = float(max_wait_s)
         self.max_batch = int(max_batch)
         self.tenant_cap = int(tenant_cap)
+        #: optional cross-process dispatcher
+        #: (:class:`tpudes.serving.distributed.ProcessRouter`): coalesced
+        #: batches whose studies carry a picklable spec split across the
+        #: mesh's member processes; everything else stays host-local
+        self.router = router
         self._cond = threading.Condition()
         self._queue: deque[_Request] = deque()
         #: dispatched launches not yet demuxed: (future, batch, t0)
@@ -283,6 +289,8 @@ class StudyServer:
             self._thread = None
         else:
             self.pump(force=True)  # start=False server: drain inline
+        if self.router is not None:
+            self.router.close()  # release the member serve loops
 
     def pump(self, force: bool = True) -> int:
         """Synchronously dispatch what is due (everything queued when
@@ -383,7 +391,13 @@ class StudyServer:
             points = points + [points[-1]] * (_pow2(n_real) - n_real)
         t0 = time.monotonic()
         try:
-            fut = RUNTIME.submit(batch[0].desc.launch, points)
+            fut = None
+            if self.router is not None:
+                # routed dispatch: the batch's point blocks fan out to
+                # member processes (None = not routable, fall through)
+                fut = self.router.launch(batch, points)
+            if fut is None:
+                fut = RUNTIME.submit(batch[0].desc.launch, points)
         except Exception as e:  # noqa: BLE001 - poison, don't crash
             self._finish_batch(batch, error=e, n_real=n_real)
             return
