@@ -238,6 +238,162 @@ def bench_lte():
     )
 
 
+def bench_mobile_bss(smoke: bool = False):
+    """ISSUE-10 row: a MOVING BSS topology on the device engine.
+
+    Three measurements on the same scenario shape:
+    - ``host``: the scalar host DES on the mobile graph — the rate any
+      mobile topology ran at while the engines refused mobility (the
+      host-geometry-refresh baseline);
+    - ``static``: the device engine on the frozen (t=0) geometry — the
+      ceiling the mobile engine is compared against;
+    - ``mobile``: the device engine with the geometry stage in the scan
+      carry at ``geom_stride``.
+
+    Acceptance: mobile >= 5x the host baseline at <= 1.5x the static
+    wall (CPU reference shape); the row carries the geometry-refresh
+    counters so the artifact PROVES which regime ran."""
+    import jax
+
+    from tpudes.core import Seconds, Simulator
+    from tpudes.core.world import reset_world
+    from tpudes.obs.geometry import GeomTelemetry
+    from tpudes.parallel.replicated import lower_bss, run_replicated_bss
+    from tpudes.scenarios import build_bss
+
+    n_stas = 8 if smoke else N_STAS
+    sim_s = 1.4 if smoke else WIFI_SIM_S
+    replicas = 32 if smoke else WIFI_REPLICAS
+    stride = 8
+    speed = 1.0
+
+    def _lowered(mobility):
+        reset_world()
+        stas, ap, clients, _ = build_bss(
+            n_stas, sim_s, mobility=mobility, speed=speed
+        )
+        prog = lower_bss(
+            [stas.Get(i) for i in range(n_stas)], ap, clients, sim_s,
+            geom_stride=stride,
+        )
+        return prog
+
+    # --- host baseline: the mobile graph on the scalar DES ---------------
+    prog_m = _lowered("const_velocity")
+    t0 = time.monotonic()
+    Simulator.Stop(Seconds(sim_s))
+    Simulator.Run()
+    host_rate = sim_s / (time.monotonic() - t0)
+    prog_s = _lowered("static")
+    reset_world()
+
+    def _timed(prog):
+        run_replicated_bss(prog, replicas, jax.random.PRNGKey(0))  # compile
+        walls = []
+        for i in range(N_TIMED):
+            t0 = time.monotonic()
+            out = run_replicated_bss(prog, replicas, jax.random.PRNGKey(1 + i))
+            walls.append(time.monotonic() - t0)
+            assert out["all_done"]
+        return statistics.median(walls), out
+
+    GeomTelemetry.reset()
+    static_wall, _ = _timed(prog_s)
+    mobile_wall, mout = _timed(prog_m)
+    mobile_rate = replicas * sim_s / mobile_wall
+    return dict(
+        sim_s_per_wall_s=mobile_rate,
+        static_sim_s_per_wall_s=replicas * sim_s / static_wall,
+        host_sim_s_per_wall_s=host_rate,
+        # the two acceptance ratios
+        vs_host_refresh=mobile_rate / host_rate,
+        wall_vs_static=mobile_wall / static_wall,
+        wall_median_s=mobile_wall,
+        geom_stride=stride,
+        mob_model=prog_m.mobility.model,
+        speed_mps=speed,
+        # per-run geometry accounting (last timed mobile run) + the
+        # process-cumulative telemetry the obs schema gate validates
+        geom_refreshes=mout["geom_refreshes"],
+        steps=mout["steps"],
+        geom_telemetry=GeomTelemetry.engine("bss"),
+        replicas=replicas,
+        n_stas=n_stas,
+    )
+
+
+def bench_lte_mobility(smoke: bool = False):
+    """ISSUE-10 row, LTE side: moving UEs through the SM engine's
+    device geometry stage vs (a) the host TTI controller on the same
+    mobile graph — whose every TTI pays the host geometry refresh that
+    used to be the ONLY way to run mobile LTE — and (b) the device
+    engine on the frozen drop (the static-geometry ceiling)."""
+    import jax
+
+    from tpudes.core import Seconds, Simulator
+    from tpudes.core.world import reset_world
+    from tpudes.obs.geometry import GeomTelemetry
+    from tpudes.parallel.lte_sm import lower_lte_sm, run_lte_sm
+    from tpudes.scenarios import build_lena
+
+    n_enbs, upc = (2, 4) if smoke else (LTE_ENBS, LTE_UES_PER_CELL)
+    sim_s = 0.3 if smoke else LTE_SIM_S
+    replicas = 8 if smoke else LTE_REPLICAS
+    stride = 8
+    speed = 10.0
+
+    reset_world()
+    lte, _ = build_lena(
+        n_enbs, upc, mobility="const_velocity", speed=speed
+    )
+    prog_m = lower_lte_sm(lte, sim_s, geom_stride=stride)
+    # host baseline: the controller's TTI loop on the SAME mobile graph
+    # (per-TTI host geometry refresh); warm segment excludes the jit
+    Simulator.Stop(Seconds(LTE_HOST_WARM_S))
+    Simulator.Run()
+    t0 = time.monotonic()
+    Simulator.Stop(Seconds(LTE_HOST_WARM_S + LTE_HOST_MEAS_S))
+    Simulator.Run()
+    host_rate = LTE_HOST_MEAS_S / (time.monotonic() - t0)
+    reset_world()
+    lte, _ = build_lena(n_enbs, upc)  # same drop, frozen
+    prog_s = lower_lte_sm(lte, sim_s)
+    reset_world()
+
+    def _timed(prog):
+        run_lte_sm(prog, jax.random.PRNGKey(0), replicas=replicas)
+        walls = []
+        for i in range(N_TIMED):
+            t0 = time.monotonic()
+            out = run_lte_sm(
+                prog, jax.random.PRNGKey(1 + i), replicas=replicas
+            )
+            walls.append(time.monotonic() - t0)
+        return statistics.median(walls), out
+
+    GeomTelemetry.reset()
+    static_wall, _ = _timed(prog_s)
+    mobile_wall, mout = _timed(prog_m)
+    mobile_rate = replicas * sim_s / mobile_wall
+    return dict(
+        sim_s_per_wall_s=mobile_rate,
+        static_sim_s_per_wall_s=replicas * sim_s / static_wall,
+        host_sim_s_per_wall_s=host_rate,
+        vs_host_refresh=mobile_rate / host_rate,
+        wall_vs_static=mobile_wall / static_wall,
+        wall_median_s=mobile_wall,
+        ttis_per_wall_s=replicas * prog_m.n_ttis / mobile_wall,
+        geom_stride=stride,
+        mob_model=prog_m.mobility.model,
+        speed_mps=speed,
+        geom_refreshes=mout["geom_refreshes"],
+        geom_telemetry=GeomTelemetry.engine("lte_sm"),
+        replicas=replicas,
+        n_enbs=n_enbs,
+        ues_per_cell=upc,
+    )
+
+
 def bench_lte_kernel_profile():
     """ISSUE-6 tentpole row: per-stage device timing of the fused LTE
     TTI kernel chain at the bench scenario's scale, so the dominating
@@ -1107,7 +1263,9 @@ def main():
 
     wifi = bench_wifi()
     wifi_ht = bench_wifi_ht()
+    mobile_bss = bench_mobile_bss()
     lte = bench_lte()
+    lte_mobility = bench_lte_mobility()
     lte_profile = bench_lte_kernel_profile()
     lte_sweep = bench_lte_sched_sweep()
     tcp = bench_tcp()
@@ -1142,6 +1300,12 @@ def main():
         "wifi": r3(wifi),
         "wifi_ht": r3(wifi_ht),
         "lte": r3(lte),
+        # ISSUE-10 rows: moving topologies on the device engines —
+        # mobile rate vs the host-geometry-refresh baseline (>= 5x)
+        # and vs the static-geometry wall (<= 1.5x), with the
+        # geometry-refresh counters that prove which regime ran
+        "mobile_bss": r3(mobile_bss),
+        "lte_mobility": r3(lte_mobility),
         # ISSUE-6: per-stage timing of the fused TTI kernel chain — the
         # row that says WHERE the LTE budget goes (dominating stage,
         # fusion ratio, per-launch TTI ceiling)
@@ -1228,11 +1392,15 @@ if __name__ == "__main__":
             # divergence found by even this tiny budget fails loudly
             # in the asserted row)
             "fuzz_throughput": bench_fuzz_throughput(smoke=args.smoke),
-            # ISSUE-9: the hybrid weak-scaling row rides the CI mesh
+            # ISSUE-9: the hybrid weak-scaling row rides the mesh
             # artifact so rank-lane scaling is asserted on every run
             "hybrid_weak_scaling": bench_hybrid_weak_scaling(
                 max_ranks=2, smoke=args.smoke
             ),
+            # ISSUE-10: the mobile-BSS row (with geometry counters)
+            # rides the CI artifact so device-resident mobility is
+            # asserted on every run
+            "mobile_bss": bench_mobile_bss(smoke=args.smoke),
         }))
     else:
         main()

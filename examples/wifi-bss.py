@@ -17,6 +17,13 @@ JaxSimulatorImpl then lowers the SAME constructed object graph onto the
 replica axis (tpudes/parallel/lift.py) and runs all replicas on the
 accelerator at once; graphs the lowering cannot faithfully represent
 fall back to the windowed scalar engine with a warning.
+
+Moving stations lift too (the ISSUE-10 device geometry pipeline):
+
+    python examples/wifi-bss.py --nStas=8 --simTime=2 \
+        --mobility=const_velocity --speed=1.0 --JaxGeomStride=8 \
+        --SimulatorImplementationType=tpudes::JaxSimulatorImpl \
+        --JaxReplicas=64
 """
 
 import os
@@ -46,6 +53,11 @@ def main(argv=None):
     cmd.AddValue("interval", "client send interval (s)", 0.1)
     cmd.AddValue("standard", "80211a (legacy) or 80211n (HT: QoS + A-MPDU)", "80211a")
     cmd.AddValue("dataMode", "ConstantRate data mode ('' = per-standard default)", "")
+    cmd.AddValue(
+        "mobility", "STA motion: static | const_velocity | random_walk",
+        "static",
+    )
+    cmd.AddValue("speed", "STA speed (m/s) when mobility != static", 1.0)
     cmd.Parse(argv)
     n_stas = int(cmd.nStas)
     sim_time = float(cmd.simTime)
@@ -59,12 +71,48 @@ def main(argv=None):
     nodes = NodeContainer()
     nodes.Create(n_stas + 1)  # node 0 = AP
 
+    # AP pinned at the disc center; STA motion selected by --mobility
+    # (moving graphs lift through the device geometry pipeline —
+    # tpudes/ops/mobility.py — instead of refusing)
+    mob_kind = str(cmd.mobility)
+    speed = float(cmd.speed)
+    from tpudes.models.mobility import Vector
+
+    ap_mob = MobilityHelper()
+    ap_mob.SetPositionAllocator("tpudes::ListPositionAllocator").Add(
+        Vector(0.0, 0.0, 0.0)
+    )
+    ap_mob.Install(nodes.Get(0))
+    sta_nodes = [nodes.Get(i) for i in range(1, n_stas + 1)]
     mobility = MobilityHelper()
     mobility.SetPositionAllocator(
         "tpudes::RandomDiscPositionAllocator", X=0.0, Y=0.0, Rho=25.0
     )
-    mobility.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
-    mobility.Install(nodes)
+    if mob_kind == "random_walk":
+        mobility.SetMobilityModel(
+            "tpudes::RandomWalk2dMobilityModel",
+            Bounds=(-30.0, 30.0, -30.0, 30.0),
+            MinSpeed=speed / 2.0, MaxSpeed=speed,
+        )
+        mobility.Install(sta_nodes)
+    elif mob_kind == "const_velocity":
+        import math as _math
+
+        from tpudes.models.mobility import ConstantVelocityMobilityModel
+
+        mobility.SetMobilityModel("tpudes::ConstantVelocityMobilityModel")
+        mobility.Install(sta_nodes)
+        for node in sta_nodes:
+            m = node.GetObject(ConstantVelocityMobilityModel)
+            p = m.GetPosition()
+            a = _math.atan2(p.y, p.x)
+            # tangential drift keeps STAs near their radius
+            m.SetVelocity(
+                Vector(-speed * _math.sin(a), speed * _math.cos(a), 0.0)
+            )
+    else:
+        mobility.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+        mobility.Install(sta_nodes)
 
     channel = YansWifiChannelHelper.Default().Create()
     phy = YansWifiPhyHelper()

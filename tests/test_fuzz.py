@@ -147,8 +147,10 @@ def test_corpus_has_three_seeds_per_engine():
         doc = json.loads(path.read_text())
         assert doc["kind"] == "tpudes-fuzz-corpus", path
         by_engine[doc["engine"]] = by_engine.get(doc["engine"], 0) + 1
+    # ISSUE-10 added 2 mobile stride-boundary seeds each for the two
+    # radio engines (mobility + geom_stride draws)
     assert by_engine == {
-        "bss": 3, "lte_sm": 3, "dumbbell": 3, "as_flows": 3, "wired": 3,
+        "bss": 5, "lte_sm": 5, "dumbbell": 3, "as_flows": 3, "wired": 3,
     }
 
 

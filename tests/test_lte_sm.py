@@ -123,7 +123,7 @@ class TestLowering:
         with pytest.raises(UnliftableLteScenarioError):
             lower_lte_sm(lte, 0.1)
 
-    def test_rejects_mobile_geometry(self):
+    def _with_walker(self):
         from tpudes.helper.containers import NodeContainer
         from tpudes.models.mobility import MobilityHelper
 
@@ -141,8 +141,45 @@ class TestLowering:
         dev = lte.InstallUeDevice(walker)
         lte.Attach([dev.Get(0)])
         lte.ActivateDataRadioBearer([dev.Get(0)])
+        return lte
+
+    def test_mobile_geometry_lifts_by_default(self):
+        # the ISSUE-10 flip: moving UEs ride the device geometry
+        # pipeline instead of being refused
+        lte = self._with_walker()
+        prog = lower_lte_sm(lte, 0.3)
+        assert prog.mobility is not None
+        assert prog.mobility.model == "random_walk"
+        assert prog.pathloss is not None and prog.enb_pos is not None
+
+    def test_mobile_geometry_refused_under_kill_switch(self, monkeypatch):
+        # TPUDES_DEVICE_GEOM=0 restores the loud refusal (the host
+        # controller's per-window refresh is the fallback path)
+        lte = self._with_walker()
+        monkeypatch.setenv("TPUDES_DEVICE_GEOM", "0")
         with pytest.raises(UnliftableLteScenarioError):
-            lower_lte_sm(lte, 0.1)
+            lower_lte_sm(lte, 0.3)
+
+    def test_mobile_enb_still_refused(self):
+        from tpudes.models.mobility import (
+            ConstantVelocityMobilityModel,
+            MobilityModel,
+            Vector,
+        )
+
+        lte, _ = _build_helper_scenario()
+        enb_node = lte.controller.enbs[0].GetNode()
+        old = enb_node.GetObject(MobilityModel)
+        cv = ConstantVelocityMobilityModel()
+        cv.SetPosition(old.GetPosition())
+        cv.SetVelocity(Vector(1.0, 0.0, 0.0))
+        # replace the model in the aggregation ring (GetObject returns
+        # the first match, so appending would not take effect)
+        ring = enb_node._aggregates
+        ring[ring.index(old)] = cv
+        cv._aggregates = ring
+        with pytest.raises(UnliftableLteScenarioError):
+            lower_lte_sm(lte, 0.3)
 
 
 class TestSmEngine:

@@ -241,14 +241,23 @@ def test_lte_window_cache_beats_per_event_dispatch():
         new[0].SetPosition(Vector(pos.x, pos.y, pos.z))
 
         c = lte.controller
+        # ISSUE-10: the mobile refresh is now the geometry-only slice
+        # of _rebuild (bit-equal, cheaper) — the per-window-vs-per-event
+        # contract is about GEOMETRY REFRESHES, so count both kinds
         rebuilds = [0]
         orig = c._rebuild
+        orig_geom = c._refresh_geometry
 
         def counting():
             rebuilds[0] += 1
             orig()
 
+        def counting_geom():
+            rebuilds[0] += 1
+            orig_geom()
+
         c._rebuild = counting
+        c._refresh_geometry = counting_geom
         members = BatchableRegistry.members()
         assert any(isinstance(m, LteTtiController) for m in members)
 

@@ -130,6 +130,13 @@ JaxReplicas = GlobalValue(
     "(0 = windowed scalar engine)",
     0,
 )
+JaxGeomStride = GlobalValue(
+    "JaxGeomStride",
+    "geometry refresh stride of the lifted mobile path: recompute the "
+    "in-kernel loss tables every K steps/TTIs (1 = every step, "
+    "bit-identical to per-step recompute)",
+    1,
+)
 
 # Observability knobs (tpudes/obs).  Registered here, like the engine
 # knobs, so CommandLine / NS_GLOBAL_VALUE can bind them before any

@@ -38,6 +38,7 @@ _CAPTURED_ENV = (
     "TPUDES_FUZZ_PLANTED_BUG",
     "TPUDES_PALLAS",
     "TPUDES_BUCKETING",
+    "TPUDES_DEVICE_GEOM",
 )
 
 

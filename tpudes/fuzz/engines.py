@@ -303,6 +303,8 @@ class BssFuzzer(EngineFuzzer):
             radii=(float(cfg["radius"]),),
             interval_s=cfg["interval_ms"] / 1e3,
             packet_bytes=int(cfg["packet_bytes"]),
+            mobility=str(cfg.get("mob_model", "static")),
+            speed=float(cfg.get("mob_speed", 1.0)),
         )
 
     def build(self, cfg):
@@ -315,6 +317,7 @@ class BssFuzzer(EngineFuzzer):
                 return lower_bss(
                     [stas.Get(i) for i in range(int(cfg["n_stas"]))],
                     ap, clients, cfg["sim_ms"] / 1e3,
+                    geom_stride=int(cfg.get("geom_stride", 1)),
                 )
         finally:
             _reset_world()
@@ -439,6 +442,8 @@ class LteSmFuzzer(EngineFuzzer):
             inter_site=float(cfg["inter_site"]),
             layout=str(cfg["layout"]),
             drop_seed=int(cfg["drop_seed"]),
+            mobility=str(cfg.get("mob_model", "static")),
+            speed=float(cfg.get("mob_speed", 5.0)),
         )
 
     def build(self, cfg):
@@ -448,7 +453,10 @@ class LteSmFuzzer(EngineFuzzer):
         try:
             lte, _ = self._graph(cfg)
             with _quiet_lowering():
-                return lower_lte_sm(lte, cfg["sim_ms"] / 1e3)
+                return lower_lte_sm(
+                    lte, cfg["sim_ms"] / 1e3,
+                    geom_stride=int(cfg.get("geom_stride", 1)),
+                )
         finally:
             _reset_world()
 
@@ -490,7 +498,19 @@ class LteSmFuzzer(EngineFuzzer):
         return [
             ("pallas_vs_xla", self._pallas_pair),
             ("bf16_budget", self._bf16_pair),
+            ("device_geom_off", self._device_geom_pair),
         ]
+
+    def _device_geom_pair(self, prog, cfg, canonical):
+        # ISSUE-10: the TPUDES_DEVICE_GEOM=0 fallback runs the mobile
+        # scan against HOST-precomputed refresh positions (the
+        # per-window fresh-operands shape of the legacy controller
+        # path) — pinned bit-equal to the carried geometry.  A static
+        # draw has no geometry stage; the pair degenerates to a rerun
+        # and still must agree bit for bit.
+        with _env("TPUDES_DEVICE_GEOM", "0"):
+            off = self.run_scalar(prog, cfg)
+        return first_diff(canonical, off)
 
     def _pallas_pair(self, prog, cfg, canonical):
         # the two lowerings of the fused TTI chain are pinned

@@ -15,6 +15,7 @@ mesh_vs_single       virtual-mesh replica sharding              exact¹
 serving_vs_solo      StudyServer coalescing demux               exact
 pallas_vs_xla        LTE fused-kernel lowerings (LTE only)      exact
 bf16_budget          LTE mixed-precision budget (LTE only)      budget
+device_geom_off      carried vs precomputed geometry (LTE)      exact
 host_vs_device       host DES vs device engine                  fuzz band
 ===================  =========================================  ========
 

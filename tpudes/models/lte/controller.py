@@ -138,29 +138,12 @@ class LteTtiController:
         return pos
 
     def _rebuild(self) -> None:
-        import jax.numpy as jnp
-
         self._dirty = False
         e, u = len(self.enbs), len(self.ues)
         if e == 0 or u == 0:
             return
         self._static_geometry = True
-        pos_e = self._positions(self.enbs)
-        pos_u = self._positions(self.ues)
-        d = np.sqrt(
-            ((pos_e[:, None, :] - pos_u[None, :, :]) ** 2).sum(-1)
-        )  # (E, U)
-        # loss chain evaluated as one batched kernel call: gain below
-        # unity, reciprocal between directions
-        loss_db = -np.asarray(
-            self.pathloss.batch_rx_power(jnp.zeros(()), jnp.asarray(d))
-        )
-        # buildings (wall penetration) + antennas (directional gain),
-        # one shared implementation with the REM helper
-        from tpudes.models.lte.scene import scene_loss_db
-
-        loss_db = loss_db + scene_loss_db(self.enbs, pos_e, pos_u)
-        self._gain_dl = 10.0 ** (-loss_db / 10.0)               # (E, U)
+        self._compute_gain_dl()
         serving = np.full((u,), -1, dtype=np.int64)
         enb_index = {id(dev): i for i, dev in enumerate(self.enbs)}
         for i, ue in enumerate(self.ues):
@@ -169,9 +152,6 @@ class LteTtiController:
                 serving[i] = enb_index[id(s)]
         self._serving = serving
         self._ue_index = {id(dev): i for i, dev in enumerate(self.ues)}
-        # v transmitting → power at u's serving eNB: (U, U)
-        safe = np.maximum(serving, 0)
-        self._gain_ul_eff = self._gain_dl.T[:, safe].astype(np.float64)
         # UL CQI is measured SRS-style: intra-cell sounding is orthogonal,
         # so co-served transmitters must NOT appear as interferers in the
         # reference scenario (only inter-cell UEs + noise do).  Without
@@ -182,14 +162,12 @@ class LteTtiController:
         same_cell = (serving[:, None] == serving[None, :]) & (
             serving[:, None] >= 0
         )                                                   # (v, u)
-        srs_mask = np.where(
+        # kept for the geometry-only refresh (attachment topology: only
+        # a handover/attach — which sets _dirty — can change it)
+        self._srs_mask = np.where(
             same_cell & ~np.eye(u, dtype=bool), 0.0, 1.0
         )
-        # static across TTIs → device-resident once, not re-shipped per
-        # dispatch (each host↔device payload byte costs on the tunnel)
-        self._gain_ul_ref = jnp.asarray(self._gain_ul_eff * srs_mask)
-        self._gain_dl_dev = jnp.asarray(self._gain_dl)
-        self._gain_ul_dev = jnp.asarray(self._gain_ul_eff)
+        self._publish_gain_residents()
         if self._cqi_dl is None or len(self._cqi_dl) != u:
             self._cqi_dl = np.zeros((u,), dtype=np.int64)
             self._cqi_ul = np.zeros((u,), dtype=np.int64)
@@ -251,11 +229,76 @@ class LteTtiController:
         Mobile graphs otherwise pay one full rebuild per TTI *event*;
         under the windowed engine every TTI inside the window reads the
         window-start snapshot — the same granted-time-window geometry
-        contract YansWifiChannel's pair-table cache follows."""
-        if self._dirty or not self._static_geometry:
+        contract YansWifiChannel's pair-table cache follows.
+
+        This whole path is the FALLBACK behind device-resident mobility
+        (``tpudes.parallel.lte_sm`` lifts moving UEs into the scan):
+        when it does run, ``TPUDES_DEVICE_GEOM`` selects between the
+        geometry-only refresh (recompute exactly the position-dependent
+        arrays; the attachment-topology tables were built once) and the
+        legacy full rebuild — bit-equal by construction, since the
+        geometry-only path runs the same math on the same inputs."""
+        from tpudes.obs.geometry import GeomTelemetry
+        from tpudes.ops.mobility import device_geom_enabled
+
+        if self._dirty:
             if self.enbs and self.ues:
                 self._rebuild()
+        elif not self._static_geometry and self.enbs and self.ues:
+            if device_geom_enabled():
+                self._refresh_geometry()
+            else:
+                self._rebuild()
+            GeomTelemetry.record_host("lte_ctrl")
         self._windowed = True
+
+    def _refresh_geometry(self) -> None:
+        """The position-dependent slice of :meth:`_rebuild` — gain
+        matrices (+ scene loss) and their device residents, nothing
+        else.  Bit-equal to a full rebuild BY CONSTRUCTION: both paths
+        call the same two helpers below; this one just skips
+        re-deriving the attachment topology (serving maps, SRS mask,
+        reference PSDs, noise figures, the jitted step) that only a
+        ``_dirty``-setting event can change."""
+        self._compute_gain_dl()
+        self._publish_gain_residents()
+
+    def _compute_gain_dl(self) -> None:
+        """positions → distance → loss chain (+ scene effects) →
+        ``_gain_dl`` — the geometry half shared by :meth:`_rebuild`
+        and :meth:`_refresh_geometry`."""
+        import jax.numpy as jnp
+
+        from tpudes.models.lte.scene import scene_loss_db
+
+        pos_e = self._positions(self.enbs)
+        pos_u = self._positions(self.ues)
+        d = np.sqrt(
+            ((pos_e[:, None, :] - pos_u[None, :, :]) ** 2).sum(-1)
+        )  # (E, U)
+        # loss chain evaluated as one batched kernel call: gain below
+        # unity, reciprocal between directions; buildings (wall
+        # penetration) + antennas (directional gain) ride the shared
+        # scene implementation (one copy with the REM helper)
+        loss_db = -np.asarray(
+            self.pathloss.batch_rx_power(jnp.zeros(()), jnp.asarray(d))
+        )
+        loss_db = loss_db + scene_loss_db(self.enbs, pos_e, pos_u)
+        self._gain_dl = 10.0 ** (-loss_db / 10.0)               # (E, U)
+
+    def _publish_gain_residents(self) -> None:
+        """``_gain_dl`` + the (attachment-topology) serving map / SRS
+        mask → the UL effective gains and the device-resident arrays
+        the TTI step consumes — static across TTIs, so device-resident
+        once instead of re-shipped per dispatch."""
+        import jax.numpy as jnp
+
+        # v transmitting → power at u's serving eNB: (U, U)
+        safe = np.maximum(self._serving, 0)
+        self._gain_ul_eff = self._gain_dl.T[:, safe].astype(np.float64)
+        self._gain_ul_ref = jnp.asarray(self._gain_ul_eff * self._srs_mask)
+        self._gain_dl_dev = jnp.asarray(self._gain_dl)
+        self._gain_ul_dev = jnp.asarray(self._gain_ul_eff)
 
     def _rbgs_to_rbs(self, rbgs) -> list[int]:
         """TS 36.213 type-0: expand RBG indices to RB indices (one
@@ -455,8 +498,17 @@ class LteTtiController:
             self._rebuild()
         elif not self._static_geometry and not self._windowed:
             # per-event fallback: no windowed engine drives the registry,
-            # so mobile geometry must be re-evaluated at every TTI
-            self._rebuild()
+            # so mobile geometry must be re-evaluated at every TTI —
+            # geometry-only unless the kill switch wants the legacy
+            # full rebuild (bit-equal either way; see _refresh_geometry)
+            from tpudes.obs.geometry import GeomTelemetry
+            from tpudes.ops.mobility import device_geom_enabled
+
+            if device_geom_enabled():
+                self._refresh_geometry()
+            else:
+                self._rebuild()
+            GeomTelemetry.record_host("lte_ctrl")
         self._evaluate_handover()
         if self._dirty:
             self._rebuild()  # a handover just moved serving cells

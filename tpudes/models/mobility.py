@@ -101,6 +101,17 @@ class MobilityModel(Object):
     def DoGetVelocity(self) -> Vector:
         return Vector()
 
+    # --- device extraction (tpudes.ops.mobility) --------------------------
+    def as_device_program(self):
+        """``(model_name, params)`` for the device mobility pipeline —
+        mirroring the position read :func:`positions_array` does for
+        static graphs, but for the whole trajectory.  ``params`` is a
+        dict the batch assembler :func:`device_mobility_program`
+        merges; models without a closed-form device representation
+        (Gauss-Markov's AR(1), ConstantAcceleration) return ``None``
+        and the engine lowerings refuse the graph loudly."""
+        return None
+
 
 class ConstantPositionMobilityModel(MobilityModel):
     is_static = True
@@ -121,6 +132,9 @@ class ConstantPositionMobilityModel(MobilityModel):
     def DoSetPosition(self, position: Vector) -> None:
         self._position = position
         self.NotifyCourseChange()
+
+    def as_device_program(self):
+        return "static", {"base": self._position.tuple()}
 
 
 class ConstantVelocityMobilityModel(MobilityModel):
@@ -158,6 +172,15 @@ class ConstantVelocityMobilityModel(MobilityModel):
 
     def DoGetVelocity(self) -> Vector:
         return self._velocity
+
+    def as_device_program(self):
+        # rebase to t = 0 so the device closed form p0 + v·t reproduces
+        # this model's p(t) regardless of when SetVelocity ran
+        t0_s = Time(self._base_time).GetSeconds()
+        base = self._base_position - self._velocity * t0_s
+        return "const_velocity", {
+            "base": base.tuple(), "velocity": self._velocity.tuple(),
+        }
 
 
 class ConstantAccelerationMobilityModel(MobilityModel):
@@ -297,6 +320,16 @@ class RandomWalk2dMobilityModel(MobilityModel):
         self._position = pos
         self._velocity = Vector(vx, vy, 0.0)
         self._walk()
+
+    def as_device_program(self):
+        if self.mode == self.MODE_DISTANCE and self.segment_m > 0:
+            return None  # distance-mode segments have no fixed cadence
+        return "random_walk", {
+            "base": self._position.tuple(),
+            "bounds": tuple(self.bounds),
+            "speed": (float(self.min_speed), float(self.max_speed)),
+            "seg_s": float(self.segment_s),
+        }
 
     def DoGetPosition(self) -> Vector:
         return self._now_position() if self._started else self._position
@@ -527,6 +560,19 @@ class WaypointMobilityModel(MobilityModel):
                 return (p1 - p0) * (1.0 / dt) if dt > 0 else Vector()
         return Vector()
 
+    def as_device_program(self):
+        if not self._waypoints:
+            return None
+        # resolution-aware ticks → µs (the engine clock): raw // 1000
+        # would silently assume nanosecond resolution (TIM001's defect
+        # class) — go through Time like the const-velocity extractor
+        return "waypoint", {
+            "wp": [
+                (int(round(Time(t).GetSeconds() * 1e6)), p.tuple())
+                for t, p in self._waypoints
+            ]
+        }
+
 
 # --- position allocators ---------------------------------------------------
 
@@ -725,3 +771,115 @@ def positions_array(nodes):
         if m is not None:
             out[i] = m.GetPosition().tuple()
     return out
+
+
+class UnliftableMobilityError(ValueError):
+    """The node batch's motion cannot ride one device mobility program
+    (unsupported model, mixed moving families, inconsistent walk
+    parameters) — the engine lowerings wrap this into their
+    ``Unliftable*Error`` so callers fall back loudly."""
+
+
+def device_mobility_program(nodes, horizon_us: int, mob_seed: int = 0):
+    """Assemble one node batch's motion into a
+    :class:`tpudes.ops.mobility.MobilityProgram` — the trajectory
+    analog of :func:`positions_array` (``as_device_program`` per node,
+    merged).  Returns ``None`` when every node is static (the caller
+    keeps its precomputed-table fast path).  Static nodes ride any
+    moving family as degenerate members (zero velocity / zero speed
+    band / single waypoint); TWO moving families in one batch cannot
+    share the single traced model id and raise."""
+    import numpy as np
+
+    from tpudes.ops.mobility import MobilityProgram
+
+    extracted = []
+    for i, node in enumerate(nodes):
+        m = node.GetObject(MobilityModel)
+        if m is None:
+            raise UnliftableMobilityError(f"node {i} has no mobility model")
+        prog = m.as_device_program()
+        if prog is None:
+            raise UnliftableMobilityError(
+                f"node {i}'s {type(m).__name__} has no closed-form "
+                "device representation — run the host DES"
+            )
+        extracted.append(prog)
+
+    moving = sorted({name for name, _ in extracted if name != "static"})
+    if not moving:
+        return None
+    if len(moving) > 1:
+        raise UnliftableMobilityError(
+            f"mixed moving mobility families {moving} cannot share one "
+            "traced model id — split the study or run the host DES"
+        )
+    family = moving[0]
+
+    def _normalize(prog):
+        """Align the walk segment grid across the family: the model id
+        is a traced operand, so const-velocity / waypoint programs get
+        the same (unused) segment-grid shape a default-cadence walk
+        would — a sweep across models then reuses ONE executable."""
+        import dataclasses
+
+        n_seg = int(horizon_us) // prog.seg_us + 1
+        return dataclasses.replace(prog, n_seg=max(prog.n_seg, n_seg))
+    n = len(extracted)
+    base = np.array(
+        [p["base"] if "base" in p else p["wp"][0][1] for _, p in extracted],
+        dtype=np.float32,
+    )
+
+    if family == "const_velocity":
+        vel = np.array(
+            [p.get("velocity", (0.0, 0.0, 0.0)) for _, p in extracted],
+            dtype=np.float32,
+        )
+        return _normalize(MobilityProgram.constant_velocity(base, vel))
+
+    if family == "random_walk":
+        walkers = [p for name, p in extracted if name == "random_walk"]
+        bounds = {tuple(p["bounds"]) for p in walkers}
+        segs = {float(p["seg_s"]) for p in walkers}
+        if len(bounds) > 1 or len(segs) > 1:
+            raise UnliftableMobilityError(
+                f"walkers disagree on bounds/segment "
+                f"({sorted(bounds)}, {sorted(segs)}) — one rectangle "
+                "and one cadence per batch"
+            )
+        speed = np.array(
+            [
+                p.get("speed", (0.0, 0.0)) if name == "random_walk"
+                else (0.0, 0.0)
+                for name, p in extracted
+            ],
+            dtype=np.float32,
+        )
+        return MobilityProgram.random_walk(
+            base, np.asarray(bounds.pop(), np.float32), speed,
+            seg_s=segs.pop(), horizon_us=int(horizon_us),
+            mob_seed=int(mob_seed),
+        )
+
+    # waypoint: pad every node's table to the widest row; static nodes
+    # become a two-entry pause at their position
+    tables = []
+    for (name, p), b in zip(extracted, base):
+        if name == "waypoint":
+            tables.append([(int(t), tuple(xyz)) for t, xyz in p["wp"]])
+        else:
+            tables.append([(0, tuple(b)), (1, tuple(b))])
+    W = max(2, max(len(t) for t in tables))
+    wp_t = np.zeros((n, W), dtype=np.int64)
+    wp_p = np.zeros((n, W, 3), dtype=np.float32)
+    for i, tab in enumerate(tables):
+        # pad by repeating the final waypoint at strictly later times
+        # (the pause-at-final clamp makes the padding inert)
+        last_t, last_p = tab[-1]
+        tab = tab + [
+            (last_t + 1 + k, last_p) for k in range(W - len(tab))
+        ]
+        wp_t[i] = [t for t, _ in tab]
+        wp_p[i] = [p for _, p in tab]
+    return _normalize(MobilityProgram.waypoints(wp_t, wp_p))

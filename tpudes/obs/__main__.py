@@ -6,6 +6,7 @@ Usage::
     python -m tpudes.obs --serving <metrics.json> [more.json ...]
     python -m tpudes.obs --fuzz <metrics.json> [more.json ...]
     python -m tpudes.obs --distributed <metrics.json> [more.json ...]
+    python -m tpudes.obs --geometry <metrics.json> [more.json ...]
 
 Default mode checks Chrome-trace exports against the Trace Event
 format; ``--serving`` checks :class:`tpudes.obs.serving.ServingTelemetry`
@@ -13,7 +14,10 @@ snapshot dumps against the serving-metrics schema; ``--fuzz`` checks
 :class:`tpudes.obs.fuzz.FuzzTelemetry` snapshot dumps against the
 fuzz-metrics schema; ``--distributed`` checks
 :class:`tpudes.obs.distributed.DistributedTelemetry` snapshot dumps
-against the hybrid-PDES window-protocol schema.  Exit 0 when every
+against the hybrid-PDES window-protocol schema; ``--geometry`` checks
+:class:`tpudes.obs.geometry.GeomTelemetry` snapshot dumps against the
+geometry-refresh schema (device recomputes vs host refreshes, stride
+hit rate).  Exit 0 when every
 file is valid, 1 on
 violations, 2 on usage / unreadable input.  These are the schema gates
 the CI smoke steps run over the artifacts an example (``TpudesObs=1``),
@@ -28,6 +32,7 @@ import sys
 from tpudes.obs.distributed import validate_distributed_metrics
 from tpudes.obs.export import validate_chrome_trace
 from tpudes.obs.fuzz import validate_fuzz_metrics
+from tpudes.obs.geometry import validate_geometry_metrics
 from tpudes.obs.serving import validate_serving_metrics
 
 
@@ -36,13 +41,14 @@ def main(argv: list[str] | None = None) -> int:
     serving = "--serving" in argv
     fuzz = "--fuzz" in argv
     distributed = "--distributed" in argv
+    geometry = "--geometry" in argv
     argv = [
         a for a in argv
-        if a not in ("--serving", "--fuzz", "--distributed")
+        if a not in ("--serving", "--fuzz", "--distributed", "--geometry")
     ]
     if (
         not argv
-        or serving + fuzz + distributed > 1
+        or serving + fuzz + distributed + geometry > 1
         or any(a in ("-h", "--help") for a in argv)
     ):
         print(__doc__, file=sys.stderr)
@@ -53,6 +59,8 @@ def main(argv: list[str] | None = None) -> int:
         validate, kind = validate_fuzz_metrics, "fuzz metrics"
     elif distributed:
         validate, kind = validate_distributed_metrics, "distributed metrics"
+    elif geometry:
+        validate, kind = validate_geometry_metrics, "geometry metrics"
     else:
         validate, kind = validate_chrome_trace, "Chrome trace"
     rc = 0
@@ -75,6 +83,8 @@ def main(argv: list[str] | None = None) -> int:
                 n = doc["counters"]["scenarios"]
             elif distributed:
                 n = doc["counters"]["windows"]
+            elif geometry:
+                n = len(doc["engines"])
             else:
                 n = len(doc["traceEvents"])
             print(f"{path}: valid {kind} ({n} records)")

@@ -393,8 +393,8 @@ def _as_jnp_consts(consts: dict) -> dict:
     }
 
 
-def build_sm_step_fn(consts: dict, use_pallas: bool):
-    """Returns ``step(state_dict, coin, t, sid) -> state_dict``.
+def build_sm_step_fn(consts: dict, use_pallas: bool, dynamic: tuple = ()):
+    """Returns ``step(state_dict, coin, t, sid[, dyn]) -> state_dict``.
 
     ``use_pallas=True`` lowers the math core through ONE
     ``pl.pallas_call`` — compiled by Mosaic on TPU (VMEM-resident
@@ -402,16 +402,25 @@ def build_sm_step_fn(consts: dict, use_pallas: bool):
     ops at trace time) everywhere else so the CPU tier-1 suite runs the
     very same kernel body.  ``False`` is the plain XLA lowering of the
     same core — the ``TPUDES_PALLAS=0`` kill-switch path.
+
+    ``dynamic`` names const entries that arrive PER CALL as the ``dyn``
+    dict instead of closing over the build-time tables — the
+    device-resident mobility seam: a geometry stage recomputes the
+    SINR-derived per-UE rows (mi0/rate0/eff0/ecr0/eligible) every
+    ``geom_stride`` TTIs and feeds them through here, with the kernel
+    body (and the Pallas lowering's input list) unchanged.
     """
     import jax
     import jax.numpy as jnp
 
     cj = _as_jnp_consts(consts)
     keys = [k for k, _, _ in SM_STATE]
+    dynamic = tuple(dynamic)
 
     if not use_pallas:
-        def step(s, coin, t, sid):
-            return sm_step_math(cj, s, coin, t, sid)
+        def step(s, coin, t, sid, dyn=None):
+            ck = cj if not dynamic else {**cj, **dyn}
+            return sm_step_math(ck, s, coin, t, sid)
 
         return step
 
@@ -465,10 +474,13 @@ def build_sm_step_fn(consts: dict, use_pallas: bool):
         kernel, out_shape=out_shape, interpret=interpret, **kwargs
     )
 
-    def step(s, coin, t, sid):
+    def step(s, coin, t, sid, dyn=None):
         out = call(
             jnp.reshape(t, (1, 1)), jnp.reshape(sid, (1, 1)), coin,
-            *[cj[k] for k in const_names],
+            *[
+                (dyn[k] if k in dynamic else cj[k])
+                for k in const_names
+            ],
             *[s[k] for k in keys],
         )
         return dict(zip(keys, out))

@@ -73,7 +73,13 @@ def _discover_bss(sim_end_s: float):
             f"{stray_clients} echo client(s) live on non-BSS nodes; the "
             "replica axis models only the BSS traffic"
         )
-    return "bss", lower_bss(stas, aps[0], clients, sim_end_s), lambda: None
+    from tpudes.core.global_value import GlobalValue
+
+    prog = lower_bss(
+        stas, aps[0], clients, sim_end_s,
+        geom_stride=int(GlobalValue.GetValue("JaxGeomStride")),
+    )
+    return "bss", prog, lambda: None
 
 
 def _discover_lte_sm(sim_end_s: float):
@@ -99,7 +105,12 @@ def _discover_lte_sm(sim_end_s: float):
     if controller is None:
         raise UnliftableScenarioError("no LTE eNB devices in the graph")
     try:
-        prog = lower_lte_sm(SimpleNamespace(controller=controller), sim_end_s)
+        from tpudes.core.global_value import GlobalValue
+
+        prog = lower_lte_sm(
+            SimpleNamespace(controller=controller), sim_end_s,
+            geom_stride=int(GlobalValue.GetValue("JaxGeomStride")),
+        )
     except UnliftableLteScenarioError as e:
         raise UnliftableScenarioError(str(e)) from e
 

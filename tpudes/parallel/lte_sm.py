@@ -90,10 +90,11 @@ _SCHED_CLASS_TO_NAME = {
 
 
 #: the documented-faithful fuzz region (see :mod:`tpudes.fuzz`): lena
-#: macro drops the host controller also runs (static ConstantPosition
-#: UEs, strongest-cell attach, RLC-SM full buffer), every registered
-#: FF-MAC scheduler, horizons short enough for the host TTI loop to be
-#: an affordable oracle — all inside the lower_lte_sm guards
+#: macro drops the host controller also runs (strongest-cell attach,
+#: RLC-SM full buffer; static, drifting, or walking UEs over the
+#: device geometry pipeline), every registered FF-MAC scheduler,
+#: horizons short enough for the host TTI loop to be an affordable
+#: oracle — all inside the lower_lte_sm guards
 FUZZ_ENVELOPE = FuzzEnvelope(
     engine="lte_sm",
     axes={
@@ -107,6 +108,12 @@ FUZZ_ENVELOPE = FuzzEnvelope(
         "replicas": ("int", 1, 6),
         "chunk_divisor": ("choice", (2, 3)),
         "key_seed": ("int", 0, 2**16),
+        # ISSUE-10 mobility draws (appended — axis order is part of
+        # the seed→config contract); pedestrian..vehicular UE speeds
+        "mob_model": ("choice", ("static", "const_velocity",
+                                 "random_walk")),
+        "mob_speed": ("float", 1.0, 30.0),
+        "geom_stride": ("choice", (1, 2, 8, 32)),
     },
     floors={"replicas": 1, "n_enbs": 1, "ues_per_cell": 1, "sim_ms": 16},
     doc="lena macro grid, full-buffer RLC-SM downlink, all 9 schedulers",
@@ -131,6 +138,26 @@ class LteSmProgram:
     #: key component, never a traced operand: flipping it compiles a
     #: distinct executable.
     precision: str = "f32"
+    #: UE motion (tpudes.ops.mobility.MobilityProgram): None = static
+    #: geometry (the build-time SINR constants).  Model id + params are
+    #: traced operands — only ``mobility.shape_key()`` enters the
+    #: runner cache key, so a sweep across the model family reuses one
+    #: executable.  With mobility the per-TTI kernel consumes DYNAMIC
+    #: SINR-derived rows recomputed on device every ``geom_stride``
+    #: TTIs (f32 geometry, vs the static path's f64 build-time chain —
+    #: the documented precision of the moving regime).
+    mobility: object = None
+    #: geometry refresh stride in TTIs (traced — NOT a cache-key
+    #: component); stride=1 is bit-identical to per-TTI recompute and
+    #: the closed-form trajectory makes a strided run sample the SAME
+    #: motion, just less often
+    geom_stride: int = 1
+    #: static eNB sites (E, 3) f32 — mobile programs only
+    enb_pos: np.ndarray = None
+    #: pure-kernel pathloss descriptor for the device geometry stage:
+    #: ("friis", frequency_hz, system_loss, min_loss_db) or
+    #: ("log_distance", exponent, reference_distance, reference_loss_db)
+    pathloss: tuple = None
 
     @property
     def n_enb(self) -> int:
@@ -148,7 +175,8 @@ COMPILE_AMORTIZE_TTIS = 250
 
 
 def lower_lte_sm(
-    helper, sim_time_s: float, precision: str = "f32"
+    helper, sim_time_s: float, precision: str = "f32",
+    geom_stride: int = 1,
 ) -> LteSmProgram:
     """Lower a constructed LteHelper object graph (controller state) to
     a device program; raises UnliftableLteScenarioError for anything the
@@ -156,7 +184,15 @@ def lower_lte_sm(
 
     ``precision`` selects the arithmetic mode of the SINR/CQI/BLER
     chain ("f32" exact, "bf16" mixed precision — see
-    :class:`LteSmProgram`)."""
+    :class:`LteSmProgram`).
+
+    Mobile UEs lift too (``tpudes.ops.mobility``): their motion rides
+    the scan as traced operands and the SINR→CQI→MCS→MI chain is
+    recomputed ON DEVICE every ``geom_stride`` TTIs.  Requires a
+    pure-kernel pathloss model (Friis / LogDistance), no buildings or
+    directional antennas, static eNBs, and ``TPUDES_DEVICE_GEOM`` on —
+    anything else keeps the loud refusal (the host controller's
+    per-window refresh is the fallback path)."""
     from tpudes.models.mobility import MobilityModel
 
     if precision not in SM_PRECISIONS:
@@ -204,16 +240,27 @@ def lower_lte_sm(
             "only — run the host controller for custom algorithms"
         )
 
-    for dev in ctrl.enbs + ctrl.ues:
+    for dev in ctrl.enbs:
         mob = dev.GetNode().GetObject(MobilityModel)
-        if mob is None or "ConstantPosition" not in type(mob).__name__:
+        if mob is None or not mob.is_static:
             raise UnliftableLteScenarioError(
-                "SM engine needs static ConstantPosition geometry"
+                "SM engine needs static eNB sites (mobile eNBs have no "
+                "device representation)"
             )
+    ue_static = all(
+        (m := dev.GetNode().GetObject(MobilityModel)) is not None
+        and m.is_static
+        for dev in ctrl.ues
+    )
+    n_ttis = int(round(sim_time_s * 1000.0))
+    mobility, pathloss_desc = None, None
+    if not ue_static:
+        mobility, pathloss_desc = _lift_lte_mobility(
+            ctrl, n_ttis, geom_stride
+        )
     ctrl._rebuild()
     if (ctrl._serving < 0).any():
         raise UnliftableLteScenarioError("unattached UEs present")
-    n_ttis = int(round(sim_time_s * 1000.0))
     if n_ttis < COMPILE_AMORTIZE_TTIS:
         import warnings
 
@@ -241,7 +288,79 @@ def lower_lte_sm(
         scheduler=sched,
         pf_alpha=float(alphas.pop()) if alphas else 0.05,
         precision=precision,
+        mobility=mobility,
+        geom_stride=int(geom_stride),
+        enb_pos=(
+            None if mobility is None
+            else ctrl._positions(ctrl.enbs).astype(np.float32)
+        ),
+        pathloss=pathloss_desc,
     )
+
+
+def _lift_lte_mobility(ctrl, n_ttis: int, geom_stride: int):
+    """The mobile half of :func:`lower_lte_sm`: guards + extraction.
+    Returns ``(MobilityProgram, pathloss_descriptor)`` or raises."""
+    import sys
+
+    from tpudes.models.mobility import (
+        UnliftableMobilityError,
+        device_mobility_program,
+    )
+    from tpudes.models.propagation import (
+        FriisPropagationLossModel,
+        LogDistancePropagationLossModel,
+    )
+    from tpudes.ops.mobility import device_geom_enabled, warn_geom_stride
+
+    if not device_geom_enabled():
+        raise UnliftableLteScenarioError(
+            "UEs are mobile and device-resident geometry is disabled "
+            "(TPUDES_DEVICE_GEOM=0) — the host controller's per-window "
+            "refresh is the fallback path"
+        )
+    loss = ctrl.pathloss
+    if isinstance(loss, FriisPropagationLossModel):
+        pathloss_desc = (
+            "friis", float(loss.frequency), float(loss.system_loss),
+            float(loss.min_loss),
+        )
+    elif isinstance(loss, LogDistancePropagationLossModel):
+        pathloss_desc = (
+            "log_distance", float(loss.exponent),
+            float(loss.reference_distance), float(loss.reference_loss),
+        )
+    else:
+        raise UnliftableLteScenarioError(
+            f"mobile geometry needs a pure-kernel pathloss model "
+            f"(Friis/LogDistance), not {type(loss).__name__}"
+        )
+    if getattr(loss, "GetNext", lambda: None)() is not None:
+        raise UnliftableLteScenarioError(
+            "chained pathloss models cannot ride the device geometry "
+            "stage"
+        )
+    bmod = sys.modules.get("tpudes.models.buildings")
+    if bmod is not None and bmod.BuildingList.GetNBuildings():
+        raise UnliftableLteScenarioError(
+            "buildings make the scene loss position-dependent in a way "
+            "the device geometry stage does not model — run the host "
+            "controller"
+        )
+    if any(e.phy.antenna is not None for e in ctrl.enbs):
+        raise UnliftableLteScenarioError(
+            "directional eNB antennas are not modeled by the device "
+            "geometry stage — run the host controller"
+        )
+    try:
+        mobility = device_mobility_program(
+            [d.GetNode() for d in ctrl.ues], horizon_us=n_ttis * 1000
+        )
+    except UnliftableMobilityError as e:
+        raise UnliftableLteScenarioError(str(e)) from e
+    # the TTI clock is exactly 1 ms — the stride advisory is exact here
+    warn_geom_stride("lower_lte_sm", mobility, int(geom_stride), 1e-3)
+    return mobility, pathloss_desc
 
 
 def build_sm_step(prog: LteSmProgram, use_pallas: bool | None = None):
@@ -278,11 +397,112 @@ def build_sm_step(prog: LteSmProgram, use_pallas: bool | None = None):
     return consts, init_state, step_fn
 
 
+#: the const rows the geometry stage recomputes per refresh (the
+#: SINR-derived per-UE tables; everything else — cell structure, RR
+#: bookkeeping, the prefix operator — is attachment topology, which
+#: the fixed serving map keeps static)
+SM_DYNAMIC_ROWS = ("mi0", "rate0", "eff0", "ecr0", "eligible")
+
+
+def _build_geom_fn(prog: LteSmProgram, consts: dict):
+    """Device geometry stage for a mobile program: returns
+    ``(pos_at(mob_ops, t_tti) -> (U, 3),
+    rows_from_pos(pos_u) -> dict)`` — positions split out so the
+    ``TPUDES_DEVICE_GEOM=0`` fallback can gather HOST-precomputed
+    positions while running the identical rows math (the bit-equality
+    contract of the per-window fallback path).
+
+    The rows mirror :func:`~tpudes.parallel.kernels_pallas.build_sm_consts`
+    (same CQI/MCS/MI chain, same bf16 storage-rounding policy) but in
+    f32 device arithmetic — the documented precision of the moving
+    regime."""
+    import jax.numpy as jnp
+
+    from tpudes.ops import propagation as P
+    from tpudes.ops.lte import RB_BANDWIDTH_HZ, RE_PER_RB_DATA
+    from tpudes.ops.lte import (
+        _MCS_ECR,
+        _MCS_EFF,
+        _MCS_QM,
+        cqi_from_sinr,
+        mcs_from_cqi,
+        mi_per_rb,
+    )
+    from tpudes.ops.mobility import build_position_fn
+    from tpudes.parallel.kernels_pallas import _compute_dtype
+
+    U = prog.n_ue
+    dtype = _compute_dtype(prog.precision)
+    enb_pos = jnp.asarray(prog.enb_pos, jnp.float32)        # (E, 3)
+    cell_onehot = jnp.asarray(consts["cell_onehot"])        # (E, U)
+    psd = jnp.asarray(
+        10.0 ** ((prog.tx_power_dbm - 30.0) / 10.0)
+        / (prog.n_rb * RB_BANDWIDTH_HZ),
+        jnp.float32,
+    )                                                       # (E,)
+    kind, *params = prog.pathloss
+    rbg_size = consts["rbg_size"]
+    pos_fn = build_position_fn(prog.mobility)
+
+    def pos_at(mob_ops, t_tti):
+        return pos_fn(mob_ops, t_tti * 1000)                # TTI → µs
+
+    def rows_from_pos(pos_u):
+        d = jnp.sqrt(
+            jnp.sum((enb_pos[:, None, :] - pos_u[None, :, :]) ** 2, -1)
+        )                                                   # (E, U)
+        if kind == "friis":
+            rx_dbm = P.friis(jnp.float32(0.0), d, params[0], params[1],
+                             params[2])
+        else:
+            rx_dbm = P.log_distance(
+                jnp.float32(0.0), d, exponent=params[0],
+                reference_distance=params[1], reference_loss_db=params[2],
+            )
+        gain = P.db_to_ratio(rx_dbm)                        # (E, U)
+        seen = psd[:, None] * gain
+        total = jnp.sum(seen, axis=0)                       # (U,)
+        sig = jnp.sum(cell_onehot * seen, axis=0)           # (U,)
+        sinr = sig / (total - sig + jnp.float32(prog.noise_psd))
+        # storage rounding: same policy as build_sm_consts
+        sinr = sinr.astype(dtype).astype(jnp.float32)
+        cqi = cqi_from_sinr(sinr, dtype=dtype)
+        mcs = mcs_from_cqi(cqi)
+        qm = jnp.asarray(_MCS_QM)[mcs]
+        mi0 = mi_per_rb(sinr, qm, dtype=dtype)
+        eff0 = jnp.asarray(_MCS_EFF)[mcs]
+        ecr0 = jnp.asarray(_MCS_ECR)[mcs]
+        rate0 = jnp.floor(eff0 * rbg_size * RE_PER_RB_DATA) * 1000.0
+        row = lambda a: jnp.reshape(a, (1, U))  # noqa: E731
+        return dict(
+            mi0=row(mi0.astype(jnp.float32)),
+            rate0=row(rate0.astype(jnp.float32)),
+            eff0=row(eff0.astype(jnp.float32)),
+            ecr0=row(ecr0.astype(jnp.float32)),
+            eligible=row((cqi >= 1).astype(jnp.int32)),
+            sinr=row(sinr), cqi=row(cqi.astype(jnp.int32)),
+            mcs=row(mcs.astype(jnp.int32)),
+        )
+
+    def init_rows():
+        z = lambda dt: jnp.zeros((1, U), dt)  # noqa: E731
+        return dict(
+            mi0=z(jnp.float32), rate0=z(jnp.float32), eff0=z(jnp.float32),
+            ecr0=z(jnp.float32), eligible=z(jnp.int32), sinr=z(jnp.float32),
+            cqi=z(jnp.int32), mcs=z(jnp.int32),
+            refreshes=jnp.int32(0),
+        )
+
+    return pos_at, rows_from_pos, init_rows
+
+
 def _sm_cache_key(prog: LteSmProgram, replicas, n_cfg, obs, use_pallas) -> tuple:
     # prog.scheduler AND prog.n_ttis are deliberately ABSENT: the
     # scheduler id and the TTI horizon are both traced operands, so one
     # compiled program serves all nine schedulers at every horizon — a
     # scheduler×horizon sweep pays one compile, not one per point.
+    # Likewise prog.geom_stride and every mobility PARAMETER (only the
+    # mobility shape key + the pathloss branch are trace-time).
     # prog.precision and the pallas flag ARE present: they select
     # different arithmetic, i.e. different executables — flipping
     # TPUDES_PALLAS mid-process must not hit a stale runner.
@@ -290,6 +510,9 @@ def _sm_cache_key(prog: LteSmProgram, replicas, n_cfg, obs, use_pallas) -> tuple
         prog.gain.tobytes(), prog.serving.tobytes(),
         prog.tx_power_dbm.tobytes(), prog.noise_psd, prog.n_rb,
         prog.pf_alpha, prog.precision, use_pallas, replicas, n_cfg, obs,
+        None if prog.mobility is None else prog.mobility.shape_key(),
+        None if prog.enb_pos is None else prog.enb_pos.tobytes(),
+        prog.pathloss,
     )
 
 
@@ -330,6 +553,10 @@ def lte_sm_study(prog: LteSmProgram, key, replicas=None, mesh=None):
         prog.tx_power_dbm.tobytes(), prog.noise_psd, prog.n_rb,
         prog.pf_alpha, prog.precision, prog.n_ttis,
         np.asarray(key).tobytes(), replicas, mesh_fingerprint(mesh),
+        # mobility params + stride are traced but must still separate
+        # coalesce groups (only the scheduler id may differ per point)
+        None if prog.mobility is None else prog.mobility.param_key(),
+        int(prog.geom_stride),
     )
 
     def launch(points, block=False):
@@ -363,6 +590,218 @@ def lte_sm_study(prog: LteSmProgram, key, replicas=None, mesh=None):
     return StudyDescriptor(
         "lte_sm", ck, prog.scheduler, launch, warm, spec=spec
     )
+
+
+def _run_lte_sm_mobile(
+    prog: LteSmProgram,
+    key,
+    replicas: int | None = None,
+    mesh=None,
+    *,
+    schedulers=None,
+    chunk_ttis: int | None = None,
+    block: bool = True,
+):
+    """The mobile-geometry form of :func:`run_lte_sm` (same contract,
+    same result fields + ``geom_refreshes``/``geom_stride``).
+
+    Structure: the TTI ``while_loop`` runs UNBATCHED (scalar clock +
+    the geometry row dict in the carry) and only the fused TTI kernel
+    is vmapped over the replica / config axes inside the body — the
+    trajectory is shared by every replica and config point, so the
+    geometry ``lax.cond`` keeps a SCALAR predicate and the refresh
+    really is skipped on non-stride TTIs (a batched predicate would
+    degrade to select-both-branches under vmap and the stride would
+    save nothing).
+
+    ``TPUDES_DEVICE_GEOM=0`` takes the per-window fallback: refresh
+    POSITIONS are precomputed on the host (one tiny device call per
+    refresh time through the same closed-form kernel) and shipped as a
+    ``(K_ref, U, 3)`` operand the loop gathers — the per-window
+    fresh-operands shape of the host controller path — while the rows
+    math stays the identical in-step code, so the two modes are pinned
+    bit-equal."""
+    import jax.numpy as jnp
+
+    from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+    from tpudes.obs.geometry import GeomTelemetry
+    from tpudes.ops.mobility import device_geom_enabled
+    from tpudes.parallel.runtime import (
+        RUNTIME,
+        EngineFuture,
+        bucket_replicas,
+        chunk_bounds,
+        donate_argnums,
+        drive_chunks,
+        finalize_with_flush,
+        replica_keys,
+        shard_replica_axis,
+        stack_axis,
+        unstack_points,
+    )
+
+    r_pad = bucket_replicas(replicas, mesh)
+    n_cfg = None if schedulers is None else len(schedulers)
+    obs = device_metrics_enabled()
+    use_pallas = pallas_enabled() and (
+        mesh is None or jax.default_backend() == "tpu"
+    )
+    stride = max(1, int(prog.geom_stride))
+    dg_on = device_geom_enabled()
+    # fallback mode: the refresh-time grid is a SHAPE (K_ref rows)
+    k_ref = None if dg_on else -(-prog.n_ttis // stride)
+
+    def build():
+        consts_np = build_sm_consts(prog)
+        fused = build_sm_step_fn(
+            consts_np, use_pallas, dynamic=SM_DYNAMIC_ROWS
+        )
+        pos_at, rows_from_pos, init_rows = _build_geom_fn(prog, consts_np)
+        E, U = prog.n_enb, prog.n_ue
+
+        def advance(carry, keys, sid, t_end, mob_ops, stride_, pos_table):
+            def body(c):
+                t, g, s = c
+
+                def refresh(_):
+                    pos = (
+                        pos_at(mob_ops, t) if pos_table is None
+                        else pos_table[t // stride_]
+                    )
+                    return dict(
+                        rows_from_pos(pos),
+                        refreshes=g["refreshes"] + 1,
+                    )
+
+                g2 = jax.lax.cond(
+                    t % stride_ == 0, refresh, lambda _: g, None
+                )
+                dyn = {k: g2[k] for k in SM_DYNAMIC_ROWS}
+
+                def one(s_r, k_r, sid_s):
+                    coin = jax.random.uniform(
+                        jax.random.fold_in(k_r, t), (U,)
+                    )[None, :]
+                    return fused(s_r, coin, t, sid_s, dyn)
+
+                if r_pad is None:
+                    step = one
+                else:
+                    step = jax.vmap(one, in_axes=(0, 0, None))
+                if n_cfg is None:
+                    s2 = step(s, keys, sid)
+                else:
+                    s2 = jax.vmap(step, in_axes=(0, None, 0))(s, keys, sid)
+                return t + 1, g2, s2
+
+            t, g, s = jax.lax.while_loop(
+                lambda c: c[0] < t_end, body, carry
+            )
+            metrics = (
+                dict(
+                    ok=jnp.sum(s["ok_cnt"]), drops=jnp.sum(s["drops"]),
+                    retx=jnp.sum(s["retx"]),
+                )
+                if obs
+                else {}
+            )
+            return (t, g, s), metrics
+
+        fn = jax.jit(advance, donate_argnums=donate_argnums(0))
+
+        def init_carry():
+            return (jnp.int32(0), init_rows(), sm_init_state(E, U))
+
+        return init_carry, fn
+
+    (init_carry, fn), compiling = RUNTIME.runner(
+        "lte_sm",
+        _sm_cache_key(prog, r_pad, n_cfg, obs, use_pallas)
+        + ("mobile", dg_on, k_ref),
+        build,
+    )
+
+    sched_names = [prog.scheduler] if schedulers is None else list(schedulers)
+    sids = [SM_SCHED_IDS[s] for s in sched_names]
+    sid = (
+        jnp.int32(sids[0]) if n_cfg is None
+        else jnp.asarray(sids, jnp.int32)
+    )
+    keys = key if r_pad is None else shard_replica_axis(
+        replica_keys(key, r_pad), mesh, r_pad, 0
+    )
+    mob_ops = prog.mobility.operands()
+    pos_table = None
+    if k_ref is not None:
+        # host-materialized refresh schedule (the per-window fresh
+        # operands of the legacy path) through the SAME position kernel
+        from tpudes.ops.mobility import trajectory_positions
+
+        pos_table = jnp.asarray(
+            trajectory_positions(
+                prog.mobility,
+                [t * 1000 for t in range(0, prog.n_ttis, stride)],
+            ),
+            jnp.float32,
+        )
+
+    t0, g0, s0 = init_carry()
+    s0 = stack_axis(stack_axis(s0, r_pad), n_cfg)
+    s0 = shard_replica_axis(s0, mesh, r_pad, 0 if n_cfg is None else 1)
+    carry = (t0, g0, s0)
+
+    with CompileTelemetry.timed("lte_sm", compiling):
+        carry, flush = drive_chunks(
+            "lte_sm",
+            chunk_bounds(prog.n_ttis, chunk_ttis or prog.n_ttis),
+            carry,
+            lambda c, t_end: fn(
+                c, keys, sid, jnp.int32(t_end), mob_ops,
+                jnp.int32(stride), pos_table,
+            ),
+            obs,
+        )
+        if compiling:
+            jax.block_until_ready(carry)
+
+    _, g_fin, s_fin = carry
+    fetch = {k: s_fin[k] for k in _SM_FETCH}
+    fetch["_geom_sinr"] = g_fin["sinr"]
+    fetch["_geom_cqi"] = g_fin["cqi"]
+    fetch["_geom_mcs"] = g_fin["mcs"]
+    fetch["_geom_refreshes"] = g_fin["refreshes"]
+    want = replicas if r_pad is not None else None
+    shared = ("_geom_sinr", "_geom_cqi", "_geom_mcs", "_geom_refreshes")
+
+    def unpack_one(host):
+        host = dict(host)
+        consts_np = {
+            "sinr": np.asarray(host.pop("_geom_sinr"))[0],
+            "cqi": np.asarray(host.pop("_geom_cqi"))[0],
+            "mcs": np.asarray(host.pop("_geom_mcs"))[0],
+        }
+        refreshes = int(host.pop("_geom_refreshes"))
+        out = _sm_unpack(host, consts_np, want)
+        out["geom_refreshes"] = refreshes
+        out["geom_stride"] = stride
+        return out
+
+    unstack = unstack_points(n_cfg, unpack_one, shared=shared)
+
+    def finalize(host):
+        # telemetry once per LAUNCH: the geometry loop is shared by
+        # every config point (the rows ride `shared`), so recording
+        # inside the per-point unpack would inflate the counters
+        # n_cfg-fold
+        GeomTelemetry.record_device(
+            "lte_sm", int(host["_geom_refreshes"]), prog.n_ttis
+        )
+        return unstack(host)
+
+    fut = EngineFuture(
+        "lte_sm", fetch, finalize_with_flush(flush, finalize),
+    )
+    return fut.result() if block else fut
 
 
 def run_lte_sm(
@@ -402,7 +841,16 @@ def run_lte_sm(
     ``block=False`` returns an :class:`~tpudes.parallel.runtime.EngineFuture`
     (the launch is dispatched; D2H + unpack happen at ``result()``) —
     the :meth:`RUNTIME.submit` payload.
+
+    A program with ``prog.mobility`` routes to the mobile-geometry
+    runner (same contract; results gain ``geom_refreshes``/
+    ``geom_stride``) — see :func:`_run_lte_sm_mobile`.
     """
+    if prog.mobility is not None:
+        return _run_lte_sm_mobile(
+            prog, key, replicas=replicas, mesh=mesh,
+            schedulers=schedulers, chunk_ttis=chunk_ttis, block=block,
+        )
     from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
     from tpudes.parallel.runtime import (
         RUNTIME,

@@ -56,11 +56,21 @@ Timing model vs the scalar DES (all deviations are sub-slot or rare):
   node senses every transmission, so no hidden-node regime is
   representable (use the scalar DES or RTS/CTS studies for spread
   topologies; ``lower_bss`` rejects topologies wider than the mutual
-  sensing range for this reason).
+  sensing range for this reason — for a MOBILE program the guard is
+  held over the whole trajectory).
+
+Mobility (ISSUE-10): non-static node motion rides the scan as traced
+operands (``tpudes.ops.mobility`` — closed-form const-velocity /
+random-walk / waypoint trajectories, model id dispatched like the LTE
+scheduler id) and the (R, N, N) loss/detectability tables live in the
+carry, recomputed at each replica's own event time every
+``geom_stride`` steps; the static path keeps its f64 host-precomputed
+tables bit-for-bit.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from dataclasses import dataclass
 
@@ -70,6 +80,7 @@ import numpy as np
 
 from tpudes.fuzz.envelope import FuzzEnvelope
 from tpudes.ops.interference import thermal_noise_w
+from tpudes.ops.propagation import dbm_to_w, log_distance
 from tpudes.ops.wifi_error import MODES_BY_NAME, mode_chunk_success_rate
 
 # µs timing constants (models/wifi/mac.py; 802.11a OFDM 20 MHz)
@@ -106,6 +117,13 @@ FUZZ_ENVELOPE = FuzzEnvelope(
         "chunk_divisor": ("choice", (2, 3)),
         "rng_run": ("int", 1, 8),
         "key_seed": ("int", 0, 2**16),
+        # ISSUE-10 mobility draws (appended — axis order is part of
+        # the seed→config contract): slow drifts keep the trajectory
+        # inside the mutual-sensing guard at every in-envelope radius
+        "mob_model": ("choice", ("static", "const_velocity",
+                                 "random_walk")),
+        "mob_speed": ("float", 0.3, 1.5),
+        "geom_stride": ("choice", (1, 2, 4, 16)),
     },
     floors={"replicas": 1, "n_stas": 1, "sim_ms": 1300},
     doc="AP + n STAs on one circle, UDP echo upstream, beacons on",
@@ -142,6 +160,21 @@ class BssProgram:
     #: on-air bytes of one A-MPDU subframe (delimiter + MPDU + FCS,
     #: padded to 4) — used instead of data_bytes when max_mpdus > 1
     subframe_bytes: int = 0
+    #: device-resident motion (tpudes.ops.mobility.MobilityProgram):
+    #: None = static geometry (the precomputed f64 pair tables).  The
+    #: mobility PARAMS and model id are traced operands — only
+    #: ``mobility.shape_key()`` enters the runner cache key, so a sweep
+    #: across the model family reuses one executable.  Mobile geometry
+    #: is computed in f32 on device (vs the static path's f64 host
+    #: tables) — the documented precision of the moving regime.
+    mobility: object = None
+    #: recompute the pairwise loss matrix inside the kernel only every
+    #: K event-loop steps (a traced operand — NOT a cache-key
+    #: component).  stride=1 is bit-identical to per-step recompute;
+    #: the trajectory itself is closed-form in time, so a strided run
+    #: samples the same motion, just less often (the stride contract,
+    #: pinned like TPUDES_BUCKETING's).
+    geom_stride: int = 1
 
     @property
     def n(self) -> int:
@@ -168,7 +201,10 @@ class UnliftableScenarioError(ValueError):
     lowering can't represent rather than mis-lower)."""
 
 
-def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProgram:
+def lower_bss(
+    sta_devices, ap_device, echo_clients, sim_end_s: float,
+    geom_stride: int = 1,
+) -> BssProgram:
     """Lower a constructed BSS object graph to a replicated program.
 
     Reads positions from each node's mobility model, PHY attributes
@@ -177,11 +213,22 @@ def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProg
     the data mode from the devices' station manager (ConstantRate), and
     traffic from the UdpEchoClient apps.  Anything the BssProgram cannot
     faithfully represent raises :class:`UnliftableScenarioError`.
+
+    Non-static mobility models lift too (``tpudes.ops.mobility``):
+    node motion becomes traced operands of the scan and the pairwise
+    loss matrix is recomputed inside the kernel every ``geom_stride``
+    event-loop steps.  ``TPUDES_DEVICE_GEOM=0`` restores the loud
+    refusal (host-DES fallback for moving graphs).
     """
-    from tpudes.models.mobility import MobilityModel
+    from tpudes.models.mobility import (
+        MobilityModel,
+        UnliftableMobilityError,
+        device_mobility_program,
+    )
     from tpudes.models.propagation import LogDistancePropagationLossModel
     from tpudes.models.wifi.mac import FCS_SIZE, MAC_HEADER_SIZE, control_answer_mode
     from tpudes.models.wifi.rate_control import ConstantRateWifiManager
+    from tpudes.ops.mobility import device_geom_enabled
 
     if sim_end_s < 5.0 * MODELED_WARMUP_S:
         import warnings
@@ -204,6 +251,21 @@ def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProg
         ],
         dtype=np.float32,
     )
+    sim_end_us = int(sim_end_s * 1e6)
+    mobile = any(
+        not n.GetObject(MobilityModel).is_static for n in nodes
+    )
+    mobility = None
+    if mobile:
+        if not device_geom_enabled():
+            raise UnliftableScenarioError(
+                "topology is mobile and device-resident geometry is "
+                "disabled (TPUDES_DEVICE_GEOM=0) — run the host DES"
+            )
+        try:
+            mobility = device_mobility_program(nodes, sim_end_us)
+        except UnliftableMobilityError as e:
+            raise UnliftableScenarioError(str(e)) from e
 
     phy = ap_device.GetPhy()
     mac = ap_device.GetMac()
@@ -308,7 +370,9 @@ def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProg
         start_us=np.minimum(start, INF).astype(np.int32),
         interval_us=np.minimum(interval, INF).astype(np.int32),
         stop_us=np.minimum(stop, INF).astype(np.int32),
-        sim_end_us=int(sim_end_s * 1e6),
+        sim_end_us=sim_end_us,
+        mobility=mobility,
+        geom_stride=int(geom_stride),
         tx_power_dbm=tx_power_dbm,
         path_loss_exponent=float(loss.exponent),
         reference_loss_db=float(loss.reference_loss),
@@ -325,14 +389,102 @@ def lower_bss(sta_devices, ap_device, echo_clients, sim_end_s: float) -> BssProg
     # --- mutual-sensing guard (documented carrier-sense deviation): the
     # vector model has one busy_until per replica, so every node must be
     # able to sense every other; a spread topology with hidden pairs
-    # would silently diverge from the scalar DES
-    if not bool((_pairwise_rx_dbm(prog) >= prog.rx_sensitivity_dbm).all()):
+    # would silently diverge from the scalar DES.  A mobile topology
+    # must satisfy the guard over its WHOLE trajectory, sampled on a
+    # dense grid through the same closed-form kernel the scan traces.
+    if mobility is not None:
+        from tpudes.ops.mobility import (
+            max_speed_mps,
+            trajectory_positions,
+            warn_geom_stride,
+        )
+
+        # sample density derived from the max speed so no excursion
+        # can slip between samples by more than ~0.5 m of relative
+        # displacement (bounded by 1025 samples); walks additionally
+        # get the EXACT worst case below, since their reachable set is
+        # the whole bounds rectangle regardless of sampled positions
+        n_samp = int(
+            np.clip(
+                math.ceil(2.0 * max_speed_mps(mobility) * sim_end_s),
+                65, 1025,
+            )
+        )
+        grid = np.linspace(0, sim_end_us, n_samp).astype(np.int64)
+        hidden = UnliftableScenarioError(
+            "trajectory leaves mutual sensing range (hidden-node "
+            "regime at some point of the run); the single-medium "
+            "carrier-sense model cannot represent it — shrink "
+            "the motion bounds or run the scalar DES"
+        )
+        for pos_t in trajectory_positions(mobility, grid):
+            if not bool(
+                (
+                    _pairwise_rx_dbm(
+                        dataclasses.replace(
+                            prog, positions=pos_t.astype(np.float32)
+                        )
+                    )
+                    >= prog.rx_sensitivity_dbm
+                ).all()
+            ):
+                raise hidden
+        if mobility.model == "random_walk" and not _walk_worst_case_ok(
+            prog, mobility
+        ):
+            raise hidden
+        warn_geom_stride(
+            "lower_bss", mobility, int(geom_stride),
+            _bss_nominal_step_s(prog),
+        )
+    elif not bool((_pairwise_rx_dbm(prog) >= prog.rx_sensitivity_dbm).all()):
         raise UnliftableScenarioError(
             "topology has node pairs below rx sensitivity (hidden-node "
             "regime); the single-medium carrier-sense model cannot "
             "represent it — run the scalar DES"
         )
     return prog
+
+
+def _walk_worst_case_ok(prog: BssProgram, mobility) -> bool:
+    """EXACT mutual-sensing bound for random walks: a walker's
+    reachable set is its whole bounds rectangle, so the worst pair
+    separation is the rectangle diagonal (walker-walker) or the
+    farthest corner from each pinned node (walker-static) — no sampled
+    trajectory can prove these unreachable."""
+    xmin, xmax, ymin, ymax = (float(v) for v in mobility.bounds)
+    corners = np.array(
+        [(xmin, ymin), (xmin, ymax), (xmax, ymin), (xmax, ymax)]
+    )
+    moving = mobility.speed[:, 1] > 0.0
+    zs = mobility.base_pos[:, 2].astype(np.float64)
+    dz_mm = (
+        float(np.abs(zs[moving][:, None] - zs[moving][None, :]).max())
+        if moving.sum() >= 2 else 0.0
+    )
+    worst = 0.0
+    if moving.sum() >= 2:
+        diag = math.hypot(xmax - xmin, ymax - ymin)
+        worst = math.hypot(diag, dz_mm)
+    for pos in mobility.base_pos[~moving].astype(np.float64):
+        for z_m in zs[moving]:
+            d_xy = np.sqrt(((corners - pos[None, :2]) ** 2).sum(-1)).max()
+            worst = max(worst, math.hypot(float(d_xy), float(pos[2] - z_m)))
+    loss = prog.reference_loss_db + 10.0 * prog.path_loss_exponent * (
+        math.log10(max(worst, 1.0))
+    )
+    return prog.tx_power_dbm - loss >= prog.rx_sensitivity_dbm
+
+
+def _bss_nominal_step_s(prog: BssProgram) -> float:
+    """The nominal inter-step wall of the event loop — total offered
+    events over the horizon — used ONLY to express ``geom_stride`` in
+    seconds for the coherence advisory (arrival + tx + ack per frame,
+    the same accounting _estimate_max_steps uses without its retry
+    slack)."""
+    return prog.sim_end_us * 1e-6 / max(
+        3 * _total_offered_arrivals(prog), 1
+    )
 
 
 def _pairwise_rx_dbm(prog: BssProgram) -> np.ndarray:
@@ -349,28 +501,55 @@ def _pairwise_rx_dbm(prog: BssProgram) -> np.ndarray:
     return prog.tx_power_dbm - loss
 
 
-def _estimate_max_steps(prog: BssProgram) -> int:
-    total_arrivals = 0
+def _total_offered_arrivals(prog: BssProgram) -> int:
+    """App arrivals offered over the horizon — shared by the step-bound
+    estimate and the geom_stride coherence advisory so the arrival
+    accounting cannot desynchronize between them."""
+    total = 0
     for s1, iv, s2 in zip(prog.start_us, prog.interval_us, prog.stop_us):
         if s1 >= INF or iv >= INF:
             continue
         horizon = min(int(s2), prog.sim_end_us)
         if horizon > int(s1):
-            total_arrivals += (horizon - int(s1) + int(iv) - 1) // int(iv)
+            total += (horizon - int(s1) + int(iv) - 1) // int(iv)
+    return total
+
+
+def _estimate_max_steps(prog: BssProgram) -> int:
     # one arrival event + up to 1+RETRY_LIMIT tx events per frame, plus
     # same-instant arrival/tx splits; generous slack
-    return int(total_arrivals * (3 + RETRY_LIMIT) * 1.5) + 64
+    return int(_total_offered_arrivals(prog) * (3 + RETRY_LIMIT) * 1.5) + 64
 
 
-def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
+def build_bss_step(
+    prog: BssProgram, replicas: int, obs: bool = False,
+    geom_per_step: bool = False,
+):
     """Return ``(init_state, pending, step_fn)`` for the vectorized
     event loop — exposed separately so the driver dryrun and
     benchmarks can jit/shard the pieces themselves.
 
-    ``step_fn(s, key, sim_end)`` / ``pending(s, sim_end)`` — the
-    simulation horizon ``sim_end`` (µs) is a RUNTIME operand, so one
-    compiled program serves every horizon and the config-axis sweep
-    vmaps a batch of horizons alongside the replica axis.
+    ``step_fn(s, key, sim_end[, geom])`` / ``pending(s, sim_end)`` —
+    the simulation horizon ``sim_end`` (µs) is a RUNTIME operand, so
+    one compiled program serves every horizon and the config-axis
+    sweep vmaps a batch of horizons alongside the replica axis.
+
+    With ``prog.mobility`` the step gains a geometry stage: ``geom``
+    (the mobility operands + the traced ``stride``) drives a
+    closed-form position read at each replica's own event time and the
+    (R, N, N) loss/detectability tables ride the carry, recomputed
+    under a ``lax.cond`` every ``stride`` steps.  ``geom_per_step=True``
+    compiles the UNCONDITIONAL per-step recompute — the reference
+    program the stride=1 bit-identity contract is pinned against.
+
+    Known limitation (results unaffected): under a config-axis sweep
+    the whole advance is vmapped, which batches the cond predicate and
+    degrades it to compute-both-branches — a swept mobile BSS run pays
+    the per-step geometry cost regardless of stride.  The LTE mobile
+    runner keeps its geometry cond outside the vmaps (its trajectory
+    is replica/config-shared); the BSS tables are per-replica-time by
+    design, so hoisting would change the model.  Solo mobile launches
+    (the bench path) stride for real.
 
     ``obs=True`` (the ``TpudesObs`` knob) adds a cumulative per-replica
     retransmission counter to the carry; a disabled run compiles the
@@ -400,7 +579,8 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
     )
     nbits_data = float(data_mode.data_rate_bps * data_airtime_s)
 
-    # --- static per-pair physics (positions are constant in this scenario)
+    # --- static per-pair physics (f64 host tables; a mobile program
+    # overrides them with the carried f32 device tables below)
     rx_dbm_np = _pairwise_rx_dbm(prog)
     rx_w_np = 10.0 ** ((rx_dbm_np - 30.0) / 10.0)
     np.fill_diagonal(rx_w_np, 0.0)
@@ -414,8 +594,43 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
     stop = jnp.asarray(prog.stop_us, dtype=jnp.int32)
     is_ap = jnp.arange(n) == 0
 
+    # --- device-resident geometry (tpudes.ops.mobility) -------------------
+    MOBILE = prog.mobility is not None
+    if MOBILE:
+        from tpudes.ops.mobility import build_position_fn
+
+        pos_fn = build_position_fn(prog.mobility)
+        eye_b = jnp.eye(n, dtype=bool)
+
+        def geom_tables(mob_ops, t_vec):
+            """(R,) per-replica event times → ((R, N, N) rx power W,
+            (R, N, N) detectability) under the program's log-distance
+            physics — the f32 device form of :func:`_pairwise_rx_dbm`
+            (the static path keeps its f64 host tables; the moving
+            regime is documented f32)."""
+            pos = jax.vmap(lambda t: pos_fn(mob_ops, t))(t_vec)  # (R,N,3)
+            diff = pos[:, :, None, :] - pos[:, None, :, :]
+            d = jnp.sqrt(jnp.sum(diff * diff, axis=-1))          # (R,N,N)
+            rx_dbm_m = log_distance(
+                jnp.float32(prog.tx_power_dbm), d,
+                exponent=prog.path_loss_exponent,
+                reference_loss_db=prog.reference_loss_db,
+            )
+            rx_w_m = jnp.where(eye_b[None], 0.0, dbm_to_w(rx_dbm_m))
+            return (
+                rx_w_m.astype(jnp.float32),
+                rx_dbm_m >= prog.rx_sensitivity_dbm,
+            )
+
     def init_state():
         extra = {"retx": jnp.zeros((R,), jnp.int32)} if obs else {}
+        if MOBILE:
+            # placeholders only: step 0 refreshes (0 % stride == 0), so
+            # no outcome ever reads these zeros
+            extra.update(
+                geom_rx_w=jnp.zeros((R, n, n), jnp.float32),
+                geom_det=jnp.zeros((R, n, n), bool),
+            )
         return dict(
             **extra,
             t=jnp.zeros((R,), jnp.int32),
@@ -453,7 +668,7 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
         tx = jnp.maximum(tx, s["t"][:, None])  # never in the past
         return jnp.where(frame, tx, INF)
 
-    def step_fn(s, key, sim_end):
+    def step_fn(s, key, sim_end, geom=None):
         # per-replica keying: replica r's draws at step t are a pure
         # function of (key, t, r) — independent of R — so runtime
         # replica-bucketing (padding R to a power of two) leaves every
@@ -550,12 +765,46 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
         # STA destinations are all the AP (column 0); only the AP's
         # destination varies (echo_dst).
         w = winners.astype(jnp.float32)                  # (R, N)
-        total_at = w @ rx_w                              # (R, N): power at rx j
-        sig = jnp.where(
-            is_ap[None, :],
-            (ed_f @ rx_w[0])[:, None],                   # AP → echo_dst
-            rx_w[:, 0][None, :],                         # STA i → AP
-        )
+        if MOBILE:
+            # geometry stage: recompute the carried (R, N, N) tables at
+            # each replica's OWN event time every `stride` steps; the
+            # cond predicate is the scalar shared step counter, so only
+            # the refreshing steps pay the position/loss math
+            def _recompute(_):
+                return geom_tables(geom, next_t)
+
+            if geom_per_step:
+                rx_w_c, det_c = _recompute(None)
+            else:
+                rx_w_c, det_c = jax.lax.cond(
+                    s["step"] % geom["stride"] == 0,
+                    _recompute,
+                    lambda _: (s["geom_rx_w"], s["geom_det"]),
+                    None,
+                )
+            total_at = jnp.einsum("rn,rnm->rm", w, rx_w_c)
+            sig = jnp.where(
+                is_ap[None, :],
+                jnp.sum(ed_f * rx_w_c[:, 0, :], axis=1)[:, None],
+                rx_w_c[:, :, 0],
+            )
+            det = jnp.where(
+                is_ap[None, :],
+                (ed_1h & det_c[:, 0, :]).any(axis=1)[:, None],
+                det_c[:, :, 0],
+            )
+        else:
+            total_at = w @ rx_w                          # (R, N): power at rx j
+            sig = jnp.where(
+                is_ap[None, :],
+                (ed_f @ rx_w[0])[:, None],               # AP → echo_dst
+                rx_w[:, 0][None, :],                     # STA i → AP
+            )
+            det = jnp.where(
+                is_ap[None, :],
+                (ed_1h & detectable[0][None, :]).any(axis=1)[:, None],
+                detectable[:, 0][None, :],
+            )
         interf_at_dst = jnp.where(
             is_ap[None, :],
             jnp.sum(ed_f * total_at, axis=1)[:, None],
@@ -563,11 +812,6 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
         )
         interf = interf_at_dst - sig
         sinr = sig / (noise_w + interf)
-        det = jnp.where(
-            is_ap[None, :],
-            (ed_1h & detectable[0][None, :]).any(axis=1)[:, None],
-            detectable[:, 0][None, :],
-        )
         dst_idle = ~jnp.where(                           # half-duplex
             is_ap[None, :],
             (ed_1h & winners).any(axis=1)[:, None],
@@ -677,6 +921,8 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
             if obs
             else {}
         )
+        if MOBILE:
+            extra.update(geom_rx_w=rx_w_c, geom_det=det_c)
         return dict(
             **extra,
             t=jnp.maximum(next_t, s["t"]),
@@ -707,16 +953,28 @@ def build_bss_step(prog: BssProgram, replicas: int, obs: bool = False):
 
 def _prog_cache_key(prog: BssProgram) -> tuple:
     """Hashable identity of a BssProgram (ndarray fields → bytes).
-    ``sim_end_us`` is deliberately ABSENT: the horizon is a traced
-    operand, so one executable serves every sim_end."""
-    return tuple(
-        v.tobytes() if isinstance(v, np.ndarray) else v
-        for k, v in prog.__dict__.items()
-        if k != "sim_end_us"
-    )
+    ``sim_end_us`` AND ``geom_stride`` are deliberately ABSENT (both
+    are traced operands — one executable serves every horizon and
+    every stride), and ``mobility`` contributes only its SHAPE key:
+    the model id and every mobility parameter are traced too, so a
+    sweep across the whole model family reuses one executable."""
+    out = []
+    for k, v in prog.__dict__.items():
+        if k in ("sim_end_us", "geom_stride"):
+            continue
+        if k == "mobility":
+            out.append(None if v is None else v.shape_key())
+        elif isinstance(v, np.ndarray):
+            out.append(v.tobytes())
+        else:
+            out.append(v)
+    return tuple(out)
 
 
-def _compiled_bss_runner(prog_key, prog, replicas, mesh, obs=False, n_cfg=None):
+def _compiled_bss_runner(
+    prog_key, prog, replicas, mesh, obs=False, n_cfg=None,
+    geom_per_step=False,
+):
     """Jitted runner via the shared :data:`~tpudes.parallel.runtime.RUNTIME`
     cache, keyed on (program, padded replicas) so a warm-up call
     actually warms subsequent timed calls (ADVICE r2 medium: a fresh
@@ -737,17 +995,21 @@ def _compiled_bss_runner(prog_key, prog, replicas, mesh, obs=False, n_cfg=None):
 
     del mesh
 
-    def build():
-        init_state, pending, step_fn = build_bss_step(prog, replicas, obs=obs)
+    mobile = prog.mobility is not None
 
-        def advance(s, k, max_steps, sim_end):
+    def build():
+        init_state, pending, step_fn = build_bss_step(
+            prog, replicas, obs=obs, geom_per_step=geom_per_step
+        )
+
+        def advance(s, k, max_steps, sim_end, geom=None):
             def cond(s):
                 return jnp.logical_and(
                     s["step"] < max_steps, jnp.any(pending(s, sim_end))
                 )
 
             out = jax.lax.while_loop(
-                cond, lambda st: step_fn(st, k, sim_end), s
+                cond, lambda st: step_fn(st, k, sim_end, geom), s
             )
             # per-replica completion flags computed on-device so the
             # caller needs no second compiled program (each extra host
@@ -769,17 +1031,18 @@ def _compiled_bss_runner(prog_key, prog, replicas, mesh, obs=False, n_cfg=None):
 
         fn = advance
         if n_cfg is not None:
-            fn = jax.vmap(fn, in_axes=(0, None, None, 0))
+            fn = jax.vmap(fn, in_axes=(0, None, None, 0, None))
         run = jax.jit(fn, donate_argnums=donate_argnums(0))
         return init_state, pending, run
 
     (init_state, pending, run), compiled_new = RUNTIME.runner(
-        "bss", (prog_key, replicas, obs, n_cfg), build
+        "bss", (prog_key, replicas, obs, n_cfg, mobile, geom_per_step),
+        build,
     )
     return init_state, pending, run, compiled_new
 
 
-def _bss_unpack(host: dict, replicas: int, obs: bool) -> dict:
+def _bss_unpack(host: dict, replicas: int, obs: bool, prog=None) -> dict:
     """Host-side result assembly for ONE config point."""
     R = replicas
     result = dict(
@@ -792,6 +1055,16 @@ def _bss_unpack(host: dict, replicas: int, obs: bool) -> dict:
     )
     if obs:
         result["retx"] = host["retx"][:R]
+    if prog is not None and prog.mobility is not None:
+        # geometry-refresh accounting: the cond fires on steps where
+        # step % stride == 0, i.e. ceil(steps / stride) times.
+        # (Telemetry is recorded once per LAUNCH by the caller — a
+        # config sweep shares one loop, so per-point recording here
+        # would inflate the counters.)
+        stride = max(1, int(prog.geom_stride))
+        steps = int(host["step"])
+        result["geom_refreshes"] = -(-steps // stride)
+        result["geom_stride"] = stride
     return result
 
 
@@ -806,9 +1079,14 @@ def bss_study(prog: BssProgram, key, replicas, mesh=None):
 
     from tpudes.serving.descriptor import StudyDescriptor, mesh_fingerprint
 
+    # coalesce key: mobility params + stride are traced operands (not
+    # in the runner cache key) but two studies with different
+    # trajectories must NOT coalesce — the sweep operand is sim_end only
     ck = (
         _prog_cache_key(prog), np.asarray(key).tobytes(), int(replicas),
         mesh_fingerprint(mesh),
+        None if prog.mobility is None else prog.mobility.param_key(),
+        int(prog.geom_stride),
     )
 
     def launch(points, block=False):
@@ -852,6 +1130,7 @@ def run_replicated_bss(
     sim_end_us=None,
     chunk_steps: int | None = None,
     block: bool = True,
+    geom_per_step: bool = False,
 ):
     """Execute ``replicas`` Monte-Carlo replicas of the scenario.
 
@@ -916,9 +1195,19 @@ def run_replicated_bss(
     # iterations the padding may cause cannot corrupt real replicas)
     r_pad = bucket_replicas(replicas, mesh)
     init_state, pending, run, compiling = _compiled_bss_runner(
-        _prog_cache_key(prog), prog, r_pad, mesh, obs=obs, n_cfg=n_cfg
+        _prog_cache_key(prog), prog, r_pad, mesh, obs=obs, n_cfg=n_cfg,
+        geom_per_step=geom_per_step,
     )
 
+    # mobility params + stride ride as TRACED operands (None for the
+    # static tables path); the cache key above carries only shapes
+    geom = (
+        None if prog.mobility is None
+        else dict(
+            stride=jnp.int32(max(1, int(prog.geom_stride))),
+            **prog.mobility.operands(),
+        )
+    )
     sim_end = (
         jnp.int32(ends[0]) if n_cfg is None
         else jnp.asarray(ends, jnp.int32)
@@ -932,7 +1221,7 @@ def run_replicated_bss(
             # the step bound; finished replicas are a fixed point of
             # step_fn, so later segments cost one cond evaluation
             state, still_pending, metrics = run(
-                carry[0], key, jnp.int32(bound), sim_end
+                carry[0], key, jnp.int32(bound), sim_end, geom
             )
             return (state, still_pending), metrics
 
@@ -958,14 +1247,21 @@ def run_replicated_bss(
         if compiling:
             jax.block_until_ready(fetch)
 
-    fut = EngineFuture(
-        "bss",
-        fetch,
-        finalize_with_flush(
-            flush,
-            unstack_points(
-                n_cfg, lambda host: _bss_unpack(host, replicas, obs)
-            ),
-        ),
+    unstack = unstack_points(
+        n_cfg, lambda host: _bss_unpack(host, replicas, obs, prog)
     )
+
+    def finalize(host):
+        if prog.mobility is not None:
+            # once per LAUNCH (a sweep's vmapped while_loop advances
+            # every point's step counter in lockstep, so the lanes
+            # agree on the shared loop's step count)
+            from tpudes.obs.geometry import GeomTelemetry
+
+            stride = max(1, int(prog.geom_stride))
+            steps = int(np.max(host["step"]))
+            GeomTelemetry.record_device("bss", -(-steps // stride), steps)
+        return unstack(host)
+
+    fut = EngineFuture("bss", fetch, finalize_with_flush(flush, finalize))
     return fut.result() if block else fut

@@ -40,9 +40,16 @@ def build_bss(
     packet_bytes: int = 512,
     data_mode: str = "OfdmRate54Mbps",
     standard: str = "80211a",
+    mobility: str = "static",
+    speed: float = 1.0,
 ):
     """BASELINE config #3: one AP at the origin, ``n_stas`` stations on
     circles of ``radii`` (cycled), UDP echo upstream traffic.
+
+    ``mobility`` moves the stations (the AP stays put): ``"static"``
+    (default), ``"const_velocity"`` (tangential drift at ``speed``
+    m/s), or ``"random_walk"`` (RandomWalk2d in a box around the
+    circles, speed band ``[speed/2, speed]``).
 
     Returns ``(sta_devices, ap_device, clients, server_rx)`` where
     ``server_rx`` is a one-element list counting server deliveries on
@@ -67,18 +74,56 @@ def build_bss(
         YansWifiPhyHelper,
     )
 
+    from tpudes.models.mobility import ConstantVelocityMobilityModel
+
     nodes = NodeContainer()
     nodes.Create(n_stas + 1)
-    alloc = ListPositionAllocator()
-    alloc.Add(Vector(0.0, 0.0, 0.0))
+    sta_pos = []
     for i in range(n_stas):
         a = 2 * math.pi * i / n_stas
         r = radii[i % len(radii)]
-        alloc.Add(Vector(r * math.cos(a), r * math.sin(a), 0.0))
-    mob = MobilityHelper()
-    mob.SetPositionAllocator(alloc)
-    mob.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
-    mob.Install(nodes)
+        sta_pos.append((r * math.cos(a), r * math.sin(a), a))
+    # AP: always pinned at the origin
+    ap_alloc = ListPositionAllocator()
+    ap_alloc.Add(Vector(0.0, 0.0, 0.0))
+    ap_mob = MobilityHelper()
+    ap_mob.SetPositionAllocator(ap_alloc)
+    ap_mob.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+    ap_mob.Install(nodes.Get(0))
+    stas_only = [nodes.Get(1 + i) for i in range(n_stas)]
+    if mobility == "const_velocity":
+        # tangential drift: the slow circling keeps every STA near its
+        # ring over multi-second horizons
+        for node, (x, y, a) in zip(stas_only, sta_pos):
+            cv = ConstantVelocityMobilityModel()
+            node.AggregateObject(cv)
+            cv.SetPosition(Vector(x, y, 0.0))
+            cv.SetVelocity(
+                Vector(-speed * math.sin(a), speed * math.cos(a), 0.0)
+            )
+    else:
+        mob = MobilityHelper()
+        alloc = ListPositionAllocator()
+        for x, y, _ in sta_pos:
+            alloc.Add(Vector(x, y, 0.0))
+        mob.SetPositionAllocator(alloc)
+        if mobility == "random_walk":
+            r_max = max(
+                radii[i % len(radii)] for i in range(max(n_stas, 1))
+            )
+            mob.SetMobilityModel(
+                "tpudes::RandomWalk2dMobilityModel",
+                Bounds=(
+                    -r_max - 5.0, r_max + 5.0, -r_max - 5.0, r_max + 5.0
+                ),
+                MinSpeed=speed / 2.0,
+                MaxSpeed=speed,
+            )
+        elif mobility == "static":
+            mob.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+        else:
+            raise ValueError(f"unknown mobility {mobility!r}")
+        mob.Install(stas_only)
 
     channel = YansWifiChannelHelper.Default().Create()
     phy = YansWifiPhyHelper()
@@ -267,10 +312,18 @@ def build_lena(
     layout: str = "hex",
     drop_seed: int = 7,
     drop_radius_factor: float = 0.45,
+    mobility: str = "static",
+    speed: float = 5.0,
 ):
     """BASELINE config #4: lena macro-cell grid with ``ues_per_cell``
     UEs dropped uniformly in a disc around each site, strongest-cell
     attach, one default bearer per UE.
+
+    ``mobility`` moves the UEs (eNB sites stay put): ``"static"``
+    (default), ``"const_velocity"`` (heading drawn from the same
+    seeded stream as the drop, magnitude ``speed`` m/s), or
+    ``"random_walk"`` (RandomWalk2d at speed band ``[speed/2, speed]``
+    inside the deployment's bounding box).
 
     Returns ``(lte_helper, ue_devices)``.
     """
@@ -307,17 +360,51 @@ def build_lena(
     # UE drop on the seeded stream API (MRG32k3a keyed by drop_seed),
     # not stdlib random
     rng = RngStream(drop_seed, 0, 0)
-    ua = ListPositionAllocator()
+    drops = []
     for c in range(n_enbs):
         cx, cy = sites[c]
         for _ in range(ues_per_cell):
             r = inter_site * drop_radius_factor * math.sqrt(rng.RandU01())
             a = 2 * math.pi * rng.RandU01()
-            ua.Add(Vector(cx + r * math.cos(a), cy + r * math.sin(a), 1.5))
-    mu = MobilityHelper()
-    mu.SetPositionAllocator(ua)
-    mu.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
-    mu.Install(ue_nodes)
+            drops.append(
+                (cx + r * math.cos(a), cy + r * math.sin(a), 1.5)
+            )
+    ue_list_nodes = [ue_nodes.Get(i) for i in range(len(drops))]
+    if mobility == "const_velocity":
+        from tpudes.models.mobility import ConstantVelocityMobilityModel
+
+        for node, (x, y, z) in zip(ue_list_nodes, drops):
+            heading = 2 * math.pi * rng.RandU01()  # same seeded stream
+            cv = ConstantVelocityMobilityModel()
+            node.AggregateObject(cv)
+            cv.SetPosition(Vector(x, y, z))
+            cv.SetVelocity(
+                Vector(speed * math.cos(heading), speed * math.sin(heading), 0.0)
+            )
+    else:
+        ua = ListPositionAllocator()
+        for x, y, z in drops:
+            ua.Add(Vector(x, y, z))
+        mu = MobilityHelper()
+        mu.SetPositionAllocator(ua)
+        if mobility == "random_walk":
+            pad = inter_site * drop_radius_factor + 50.0
+            xs = [x for x, _ in sites]
+            ys = [y for _, y in sites]
+            mu.SetMobilityModel(
+                "tpudes::RandomWalk2dMobilityModel",
+                Bounds=(
+                    min(xs) - pad, max(xs) + pad, min(ys) - pad,
+                    max(ys) + pad,
+                ),
+                MinSpeed=speed / 2.0,
+                MaxSpeed=speed,
+            )
+        elif mobility == "static":
+            mu.SetMobilityModel("tpudes::ConstantPositionMobilityModel")
+        else:
+            raise ValueError(f"unknown mobility {mobility!r}")
+        mu.Install(ue_nodes)
 
     lte.InstallEnbDevice(enb_nodes)
     ue_devs = lte.InstallUeDevice(ue_nodes)
