@@ -817,3 +817,134 @@ def test_tim_unit_safe_zero_and_impl_layer_clean():
         Simulator.Schedule(5, cb)  # tpudes: ignore[TIM001]
     """
     assert _codes(suppressed, select=["TIM"]) == []
+
+
+# --- key-discipline (KEY) --------------------------------------------------
+
+def test_key_shape_derived_split_flagged():
+    src = """
+    import jax
+
+    def per_window_keys(key, n_windows):
+        return jax.random.split(key, n_windows)
+    """
+    assert _codes(
+        src, path="tpudes/parallel/fixture.py", select=["KEY"]
+    ) == ["KEY001"]
+
+
+def test_key_fixed_arity_split_clean():
+    # a fixed-arity split of an already-folded key is pure in its
+    # inputs — the discipline only forbids shape-derived counts
+    src = """
+    import jax
+
+    def draw(kk):
+        k_a, k_b = jax.random.split(kk)
+        k_c, k_d, k_e = jax.random.split(kk, 3)
+        return (
+            jax.random.uniform(k_a, (4,)),
+            jax.random.uniform(k_b, (4,)),
+        )
+    """
+    assert _codes(
+        src, path="tpudes/parallel/fixture.py", select=["KEY"]
+    ) == []
+
+
+def test_key_raw_key_reuse_flagged_and_rebinding_clean():
+    src = """
+    import jax
+
+    def correlated(key, n):
+        u = jax.random.uniform(key, (n,))
+        v = jax.random.normal(key, (n,))
+        return u + v
+    """
+    assert _codes(
+        src, path="tpudes/ops/fixture.py", select=["KEY"]
+    ) == ["KEY001"]
+
+    clean = """
+    import jax
+
+    def independent(key, n):
+        u = jax.random.uniform(jax.random.fold_in(key, 0), (n,))
+        v = jax.random.normal(jax.random.fold_in(key, 1), (n,))
+        key = jax.random.fold_in(key, 2)
+        w = jax.random.uniform(key, (n,))
+        return u + v + w
+    """
+    assert _codes(
+        clean, path="tpudes/ops/fixture.py", select=["KEY"]
+    ) == []
+
+
+def test_key_scope_is_device_packages_only_and_suppressible():
+    src = """
+    import jax
+
+    def correlated(key, n):
+        u = jax.random.uniform(key, (n,))
+        return u + jax.random.normal(key, (n,))
+    """
+    # host-side model code draws from the seeded stream API instead —
+    # out of scope for the fold_in discipline
+    assert _codes(
+        src, path="tpudes/models/fixture.py", select=["KEY"]
+    ) == []
+
+    suppressed = """
+    import jax
+
+    def correlated(key, n):
+        u = jax.random.uniform(key, (n,))
+        return u + jax.random.normal(key, (n,))  # tpudes: ignore[KEY001]
+    """
+    assert _codes(
+        suppressed, path="tpudes/parallel/fixture.py", select=["KEY"]
+    ) == []
+
+
+def test_key_per_function_scopes_do_not_cross_contaminate():
+    # the same key NAME drawn once in each of two functions is not reuse
+    src = """
+    import jax
+
+    def a(key):
+        return jax.random.uniform(key, (3,))
+
+    def b(key):
+        return jax.random.normal(key, (3,))
+    """
+    assert _codes(
+        src, path="tpudes/parallel/fixture.py", select=["KEY"]
+    ) == []
+
+
+def test_key_split_num_keyword_also_flagged():
+    # the keyword spelling must not slip past the gate
+    src = """
+    import jax
+
+    def per_replica_keys(key, r_pad):
+        return jax.random.split(key, num=r_pad)
+    """
+    assert _codes(
+        src, path="tpudes/parallel/fixture.py", select=["KEY"]
+    ) == ["KEY001"]
+
+
+def test_key_stdlib_random_is_not_a_key_draw():
+    # stdlib random has no key argument — must not read as key reuse
+    src = """
+    import random
+
+    def host_jitter(lo, hi):
+        a = random.uniform(lo, hi)
+        b = random.uniform(lo, hi)
+        return a + b
+    """
+    assert _codes(
+        src, path="tpudes/parallel/fixture.py", select=["KEY"]
+    ) == []

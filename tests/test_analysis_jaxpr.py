@@ -1,0 +1,488 @@
+"""Trace-aware analysis (tpudes.analysis.jaxpr): planted-defect
+fixtures for JXL001–JXL005 in both directions, the wired no-gather
+acceptance pair, cache-key hygiene on the real engines, and the
+dead-key fix regressions.
+
+Fixture manifests run through the exact production rule code
+(lint_manifest), so a rule that stops firing on its planted defect
+fails here before it silently stops gating the engines.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpudes.analysis.jaxpr import (  # noqa: E402
+    FlipSpec,
+    TraceEntry,
+    TraceManifest,
+    TraceVariant,
+    lint_manifest,
+)
+
+SYNTH = "tpudes/parallel/synthetic.py"
+
+
+def _manifest(entries_fn, flips=None, **kw):
+    return TraceManifest(
+        engine="synth",
+        path=SYNTH,
+        variants=lambda: [TraceVariant("base", entries_fn)],
+        flips=flips,
+        **kw,
+    )
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# --- JXL001 forbidden primitives -------------------------------------------
+
+
+def test_jxl001_gather_fires_only_under_no_gather_contract():
+    x = jnp.arange(8, dtype=jnp.float32)
+    idx = jnp.asarray([3, 1], jnp.int32)
+
+    def kernel(v):
+        return jnp.take(v, idx)
+
+    entries = lambda: [TraceEntry("step", kernel, (x,))]  # noqa: E731
+    armed = lint_manifest(_manifest(entries, no_gather=True))
+    assert any(
+        f.code == "JXL001" and "gather" in f.message for f in armed
+    ), armed
+    # same trace without the contract: no finding
+    assert "JXL001" not in _codes(lint_manifest(_manifest(entries)))
+
+
+def test_jxl001_gather_ban_spares_init_entries():
+    x = jnp.arange(8, dtype=jnp.float32)
+    idx = jnp.asarray([3, 1], jnp.int32)
+    entries = lambda: [  # noqa: E731
+        TraceEntry("init", lambda: jnp.take(x, idx), (), kernel=False)
+    ]
+    assert "JXL001" not in _codes(
+        lint_manifest(_manifest(entries, no_gather=True))
+    )
+
+
+def test_jxl001_callback_forbidden_everywhere():
+    def kernel(v):
+        return jax.pure_callback(
+            lambda a: np.asarray(a), jax.ShapeDtypeStruct((3,), np.float32), v
+        )
+
+    entries = lambda: [  # noqa: E731
+        TraceEntry("step", kernel, (jnp.zeros(3, jnp.float32),))
+    ]
+    found = lint_manifest(_manifest(entries))
+    assert any(
+        f.code == "JXL001" and "callback" in f.message for f in found
+    ), found
+
+
+def test_planted_gather_in_wired_step_fires_and_real_kernel_is_clean():
+    """ISSUE acceptance: a jnp.take smuggled into the wired step body
+    must produce the JXL001 finding, and today's kernels must not."""
+    from tpudes.parallel import wired
+
+    prog = wired._trace_prog()
+    init_state, advance = wired.build_wired_advance(prog, wired._TRACE_R)
+    carry = init_state(jax.random.PRNGKey(0))
+    P = int(carry["hop"].shape[1])
+    no_ing = jnp.full((wired._TRACE_R, P), -1, jnp.int32)
+    cols = jnp.arange(P, dtype=jnp.int32)
+
+    def bad_advance(c, ih, ir, t_grant):
+        c, metrics = advance(c, ih, ir, t_grant)
+        # the smuggled dynamic lookup: per-packet delivery slots read
+        # back through a gather instead of the one-hot algebra
+        c = dict(c, deliver=jnp.take(c["deliver"], cols, axis=1))
+        return c, metrics
+
+    planted = _manifest(
+        lambda: [
+            TraceEntry(
+                "advance", bad_advance,
+                (carry, no_ing, no_ing, jnp.int32(8)),
+            )
+        ],
+        no_gather=True,
+    )
+    found = lint_manifest(planted)
+    assert any(
+        f.code == "JXL001" and "gather" in f.message for f in found
+    ), found
+
+    # the real manifest stays gather-free (its only expected findings
+    # are the baselined JXL005 egress-buffer entries)
+    real = [
+        f for f in lint_manifest(wired.trace_manifest())
+        if f.code == "JXL001"
+    ]
+    assert real == []
+
+
+# --- JXL002 dtype discipline ------------------------------------------------
+
+
+def test_jxl002_unpinned_f64_fires_and_pinned_is_clean():
+    def leaky(x):
+        return jnp.zeros(3) + x  # unpinned: f64 under ambient x64
+
+    def pinned(x):
+        return jnp.zeros(3, jnp.float32) + x
+
+    x = jnp.ones(3, jnp.float32)
+    found = lint_manifest(
+        _manifest(lambda: [TraceEntry("step", leaky, (x,))])
+    )
+    assert any(
+        f.code == "JXL002" and "float64" in f.message for f in found
+    ), found
+    assert "JXL002" not in _codes(
+        lint_manifest(_manifest(lambda: [TraceEntry("step", pinned, (x,))]))
+    )
+
+
+def test_jxl002_bf16_accumulator_fires_and_f32_accumulator_is_clean():
+    x = jnp.ones((4, 4), jnp.float32)
+
+    def bad(v):
+        lo = v.astype(jnp.bfloat16)
+        return lo @ lo  # dot_general accumulating at bf16
+
+    def good(v):
+        lo = v.astype(jnp.bfloat16)
+        return jnp.einsum(
+            "ij,jk->ik", lo, lo, preferred_element_type=jnp.float32
+        )
+
+    def run(fn, bf16):
+        man = TraceManifest(
+            engine="synth", path=SYNTH,
+            variants=lambda: [
+                TraceVariant(
+                    "bf16", lambda: [TraceEntry("step", fn, (x,))],
+                    bf16=bf16,
+                )
+            ],
+        )
+        return lint_manifest(man)
+
+    found = run(bad, True)
+    assert any(
+        f.code == "JXL002" and "bfloat16" in f.message for f in found
+    ), found
+    assert "JXL002" not in _codes(run(good, True))
+    # the accumulator check only arms on bf16-tagged variants
+    assert "JXL002" not in _codes(run(bad, False))
+
+
+def test_jxl002_x64_trace_failure_is_a_finding():
+    def fragile(x):
+        def body(c):
+            # the loop carry widens: i32 in, sum-promoted i64 out
+            return (c * jnp.ones((2,), jnp.int32)).sum()
+
+        return jax.lax.while_loop(lambda c: c < x, body, jnp.int32(0))
+
+    found = lint_manifest(
+        _manifest(
+            lambda: [TraceEntry("step", fragile, (jnp.int32(5),))]
+        )
+    )
+    assert any(
+        f.code == "JXL002" and "fails under ambient x64" in f.message
+        for f in found
+    ), found
+
+
+# --- JXL003 baked-in constants ----------------------------------------------
+
+
+def test_jxl003_large_const_fires_and_operand_form_is_clean():
+    big = jnp.asarray(np.arange(4096, dtype=np.float32))  # 16 KiB
+
+    def baked(x):
+        return x + big
+
+    def operand(x, table):
+        return x + table
+
+    x = jnp.ones(4096, jnp.float32)
+    found = lint_manifest(
+        _manifest(lambda: [TraceEntry("step", baked, (x,))])
+    )
+    assert any(
+        f.code == "JXL003" and "baked constant" in f.message
+        for f in found
+    ), found
+    assert "JXL003" not in _codes(
+        lint_manifest(
+            _manifest(lambda: [TraceEntry("step", operand, (x, big))])
+        )
+    )
+    # raising the budget silences it (per-manifest knob)
+    assert "JXL003" not in _codes(
+        lint_manifest(
+            _manifest(
+                lambda: [TraceEntry("step", baked, (x,))],
+                const_budget=1 << 20,
+            )
+        )
+    )
+
+
+# --- JXL004 cache-key hygiene ----------------------------------------------
+
+
+def _affine(scale_val: float):
+    scale = jnp.float32(scale_val)
+
+    def fn(x):
+        return x * scale
+
+    return fn
+
+
+def test_jxl004_dead_key_component_fires():
+    x = jnp.ones(3, jnp.float32)
+    entries = lambda v=1.0: [  # noqa: E731
+        TraceEntry("step", _affine(v), (x,))
+    ]
+    man = _manifest(
+        lambda: entries(),
+        flips=lambda: {
+            # key separates the flip, but the trace is identical
+            "dead_field": FlipSpec(build=lambda: entries(), key_differs=True),
+        },
+    )
+    found = lint_manifest(man)
+    assert any(
+        f.code == "JXL004" and "dead" in f.message for f in found
+    ), found
+
+
+def test_jxl004_live_component_and_honest_exclusion_are_clean():
+    x = jnp.ones(3, jnp.float32)
+    entries = lambda v: [TraceEntry("step", _affine(v), (x,))]  # noqa: E731
+    man = _manifest(
+        lambda: entries(1.0),
+        flips=lambda: {
+            "live_field": FlipSpec(
+                build=lambda: entries(2.0), key_differs=True
+            ),
+            "excluded_field": FlipSpec(
+                build=lambda: entries(1.0), key_differs=False
+            ),
+        },
+    )
+    assert "JXL004" not in _codes(lint_manifest(man))
+
+
+def test_jxl004_missing_key_component_fires():
+    x = jnp.ones(3, jnp.float32)
+    entries = lambda v: [TraceEntry("step", _affine(v), (x,))]  # noqa: E731
+    man = _manifest(
+        lambda: entries(1.0),
+        flips=lambda: {
+            # flip changes the program but the key does not separate it
+            "forgotten": FlipSpec(
+                build=lambda: entries(2.0), key_differs=False
+            ),
+        },
+    )
+    found = lint_manifest(man)
+    assert any(
+        f.code == "JXL004" and "NOT a cache-key component" in f.message
+        for f in found
+    ), found
+
+
+def test_jxl004_constant_burned_traced_operand_fires():
+    x = jnp.ones(3, jnp.float32)
+    burned_scale = jnp.float32(2.0)
+
+    def burned(x, scale):
+        return x * burned_scale  # ignores the declared operand
+
+    def honest(x, scale):
+        return x * scale
+
+    def run(fn):
+        return lint_manifest(
+            _manifest(
+                lambda: [
+                    TraceEntry(
+                        "step", fn, (x, jnp.float32(2.0)),
+                        traced={"scale": 1},
+                    )
+                ]
+            )
+        )
+
+    found = run(burned)
+    assert any(
+        f.code == "JXL004" and "'scale'" in f.message for f in found
+    ), found
+    assert "JXL004" not in _codes(run(honest))
+
+
+# --- JXL005 donation audit ---------------------------------------------------
+
+
+def test_jxl005_unused_donated_leaf_fires():
+    def fn(carry, x):
+        return dict(a=carry["a"] + x, b=jnp.zeros(3, jnp.float32))
+
+    carry = dict(
+        a=jnp.zeros(3, jnp.float32), b=jnp.ones(3, jnp.float32)
+    )
+    found = lint_manifest(
+        _manifest(
+            lambda: [
+                TraceEntry(
+                    "advance", fn, (carry, jnp.float32(1.0)),
+                    donate=(0,), carry=(0,),
+                )
+            ]
+        )
+    )
+    assert any(
+        f.code == "JXL005" and "never consumed" in f.message
+        for f in found
+    ), found
+
+
+def test_jxl005_undonated_carry_and_unaliasable_leaf_fire():
+    def fn(carry, x):
+        return carry + x
+
+    args = (jnp.zeros(3, jnp.float32), jnp.float32(1.0))
+    found = lint_manifest(
+        _manifest(
+            lambda: [TraceEntry("advance", fn, args, carry=(0,))]
+        )
+    )
+    assert any(
+        f.code == "JXL005" and "never donated" in f.message
+        for f in found
+    ), found
+
+    def shrink(carry):
+        return carry[:2]  # donated buffer has no same-shape output
+
+    found = lint_manifest(
+        _manifest(
+            lambda: [
+                TraceEntry(
+                    "advance", shrink, (jnp.zeros(3, jnp.float32),),
+                    donate=(0,),
+                )
+            ]
+        )
+    )
+    assert any(
+        f.code == "JXL005" and "cannot alias" in f.message
+        for f in found
+    ), found
+
+
+def test_jxl005_proper_donated_carry_is_clean():
+    def fn(carry, x):
+        return carry + x
+
+    assert "JXL005" not in _codes(
+        lint_manifest(
+            _manifest(
+                lambda: [
+                    TraceEntry(
+                        "advance", fn,
+                        (jnp.zeros(3, jnp.float32), jnp.float32(1.0)),
+                        donate=(0,), carry=(0,),
+                    )
+                ]
+            )
+        )
+    )
+
+
+# --- real-surface checks -----------------------------------------------------
+
+
+#: the four baselined-by-design findings (egress buffers are protocol-
+#: overwritten at every window start; dropping them from the input
+#: carry would break the carry-in == carry-out chunk-handoff shape)
+_EXPECTED_REAL = {"JXL005"}
+
+
+@pytest.mark.parametrize(
+    "module",
+    ["replicated", "lte_sm", "tcp_dumbbell", "as_flows", "wired",
+     "hybrid"],
+)
+def test_real_manifest_lints_clean_modulo_baseline(module):
+    import importlib
+
+    mod = importlib.import_module(f"tpudes.parallel.{module}")
+    found = lint_manifest(mod.trace_manifest())
+    unexpected = [f for f in found if f.code not in _EXPECTED_REAL]
+    assert unexpected == [], unexpected
+    for f in found:
+        assert "eg_" in f.message, f  # only the known egress entries
+
+
+def test_wired_dead_key_fix_shares_one_runner():
+    """Regression for the JXL004-found dead components: programs
+    differing only in slot_s / link_owner must hit the SAME cached
+    wired runner (they compile identical kernels)."""
+    from tpudes.parallel.runtime import RUNTIME
+    from tpudes.parallel.wired import run_wired, wired_chain
+
+    prog = wired_chain(n_links=3, n_flows=2, n_slots=40)
+    key = jax.random.PRNGKey(7)
+    RUNTIME.clear("wired")
+    base = run_wired(prog, key)
+    misses = RUNTIME.misses
+    twin = dataclasses.replace(
+        prog, slot_s=0.5,
+        link_owner=np.asarray([0, 1, 1], np.int32),
+    )
+    out = run_wired(twin, key)
+    assert RUNTIME.misses == misses  # cache hit: no new runner
+    np.testing.assert_array_equal(
+        out["deliver_slot"], base["deliver_slot"]
+    )
+
+
+def test_dumbbell_red_knobs_out_of_fifo_key():
+    """Regression: in fifo mode the RED parameters never reach the
+    program — flipping them must reuse the cached runner."""
+    from tpudes.parallel.runtime import RUNTIME
+    from tpudes.parallel.tcp_dumbbell import (
+        dumbbell_prog_key,
+        run_tcp_dumbbell,
+    )
+    from tpudes.parallel.programs import toy_dumbbell_program
+
+    prog = toy_dumbbell_program(n_flows=2, n_slots=30)
+    twin = dataclasses.replace(prog, red_qw=0.5, red_max_p=0.9)
+    assert dumbbell_prog_key(prog) == dumbbell_prog_key(twin)
+    # ...while a RED-mode program still keys on them
+    red = dataclasses.replace(prog, qdisc="red")
+    red2 = dataclasses.replace(red, red_qw=0.5)
+    assert dumbbell_prog_key(red) != dumbbell_prog_key(red2)
+
+    key = jax.random.PRNGKey(3)
+    RUNTIME.clear("dumbbell")
+    base = run_tcp_dumbbell(prog, key, replicas=2)
+    misses = RUNTIME.misses
+    out = run_tcp_dumbbell(twin, key, replicas=2)
+    assert RUNTIME.misses == misses
+    np.testing.assert_array_equal(out["delivered"], base["delivered"])

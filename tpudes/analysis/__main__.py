@@ -3,6 +3,13 @@
 Exit 0 when every finding is covered by the baseline; nonzero when new
 findings exist (the tier-1 gate in tests/test_analysis_gate.py).  With
 explicit paths the same rules run over just those files/dirs.
+
+``--jaxpr`` additionally traces every registered device-engine
+manifest and runs the JXL contract passes over the jaxprs (CPU-safe —
+``jax.make_jaxpr`` only, no compile; run it under
+``JAX_PLATFORMS=cpu`` in CI).  ``--format sarif`` emits SARIF 2.1.0
+for GitHub code scanning.  AST findings are cached per file content
+hash (``tools/.analysis_cache.json``); ``--no-cache`` disables.
 """
 
 from __future__ import annotations
@@ -10,6 +17,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 
 from tpudes.analysis.engine import (
@@ -21,6 +29,8 @@ from tpudes.analysis.engine import (
     new_findings,
     write_baseline,
 )
+
+DEFAULT_CACHE = "tools/.analysis_cache.json"
 
 
 def _csv(value: str) -> list[str]:
@@ -38,24 +48,44 @@ def main(argv: list[str] | None = None) -> int:
                     help="only rules with these code prefixes (e.g. RNG,DET001)")
     ap.add_argument("--ignore", type=_csv, default=None, metavar="CODES",
                     help="drop rules with these code prefixes")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="also trace every registered engine manifest and "
+                         "run the JXL001-JXL005 jaxpr contract passes")
+    ap.add_argument("--format", dest="fmt", default="text",
+                    choices=("text", "json", "sarif"),
+                    help="output format (sarif = GitHub code scanning)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable findings on stdout")
+                    help="alias for --format json")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the per-file AST findings cache")
+    ap.add_argument("--cache", default=None, metavar="PATH",
+                    help=f"cache file (default: {DEFAULT_CACHE} for "
+                         "default-root runs)")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help=f"baseline file (default: {DEFAULT_BASELINE} when "
                          "analyzing the default roots)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignoring any baseline")
     ap.add_argument("--write-baseline", action="store_true",
-                    help="rewrite the baseline from the current findings")
+                    help="rewrite the baseline from the current findings "
+                         "(combine with --jaxpr to cover the JXL rules)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every registered rule code and exit")
     args = ap.parse_args(argv)
+    if args.as_json and args.fmt == "text":
+        args.fmt = "json"  # alias only; an explicit --format wins
 
     if args.list_rules:
         from tpudes.analysis.engine import _ensure_builtins
 
         _ensure_builtins()
-        for p in ALL_PASSES:
+        passes = list(ALL_PASSES)
+        # the jaxpr family is listed unconditionally (discovery must
+        # not require the jax import that --jaxpr execution pays)
+        from tpudes.analysis.jaxpr.passes import JaxprContractPass
+
+        passes.append(JaxprContractPass())
+        for p in passes:
             for code in sorted(p.codes):
                 print(f"{code}  [{p.name}]  {p.codes[code]}")
         return 0
@@ -83,9 +113,32 @@ def main(argv: list[str] | None = None) -> int:
             )
             return 2
 
+    # the cache is keyed by root-relative display paths, so it only
+    # arms for default-root runs (explicit scans of arbitrary paths —
+    # the fixture-test shape — must not grow or read it)
+    cache = None
+    if not args.no_cache and not explicit:
+        from tpudes.analysis.cache import AnalysisCache
+
+        cache = AnalysisCache(
+            args.cache if args.cache is not None
+            else root / DEFAULT_CACHE
+        )
+    elif args.cache is not None and explicit:
+        print(
+            "analysis: --cache is ignored for explicit-path scans "
+            "(the cache is keyed by root-relative default-root paths)",
+            file=sys.stderr,
+        )
+
+    t0 = time.perf_counter()
     findings = analyze_paths(paths, root=root,
                              select=args.select, ignore=args.ignore,
-                             project_passes=not explicit)
+                             project_passes=not explicit,
+                             jaxpr=args.jaxpr, cache=cache)
+    elapsed = time.perf_counter() - t0
+    if cache is not None:
+        cache.save()
 
     # the baseline keys are root-relative, so they apply to subtree
     # scans launched from the same root too
@@ -105,6 +158,18 @@ def main(argv: list[str] | None = None) -> int:
                 "full-repo ratchet)", file=sys.stderr,
             )
             return 2
+        if not args.jaxpr and any(
+            k.split(":", 2)[1].startswith("JXL")
+            for k in load_baseline(baseline_path)
+            if k.count(":") >= 2
+        ):
+            print(
+                "analysis: the baseline holds JXL trace findings this "
+                "run did not compute — rerun with --jaxpr "
+                "--write-baseline so they are preserved, not silently "
+                "dropped", file=sys.stderr,
+            )
+            return 2
         write_baseline(baseline_path, findings)
         print(
             f"analysis: baselined {len(findings)} finding(s) -> "
@@ -113,11 +178,23 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     fresh = new_findings(findings, baseline)
-    if args.as_json:
-        print(json.dumps({
+    if args.fmt == "json":
+        payload = {
             "findings": [f.to_json() for f in fresh],
             "baselined": len(findings) - len(fresh),
-        }, indent=1))
+            "elapsed_s": elapsed,
+        }
+        if cache is not None:
+            payload["cache"] = {
+                "hits": cache.hits, "misses": cache.misses,
+            }
+        print(json.dumps(payload, indent=1))
+    elif args.fmt == "sarif":
+        from tpudes.analysis.sarif import all_rule_descriptions, to_sarif
+
+        print(json.dumps(
+            to_sarif(fresh, all_rule_descriptions(jaxpr=True)), indent=1
+        ))
     else:
         for f in fresh:
             print(f.render())

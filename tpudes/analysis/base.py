@@ -56,32 +56,74 @@ class Finding:
 
 
 class SourceModule:
-    """One parsed file: source, AST (None on syntax error), posix-style
-    display path, and the per-line suppression table."""
+    """One source file: source text, LAZILY parsed AST (None on syntax
+    error), posix-style display path, per-line suppression table, and a
+    content hash.
 
-    __slots__ = ("path", "source", "tree", "syntax_error", "_suppress")
+    Parsing is deferred to first ``tree``/``syntax_error`` access so a
+    run served entirely from the per-file findings cache
+    (:mod:`tpudes.analysis.cache`) never pays ``ast.parse`` at all —
+    that is most of a warm run's cost across ~200 files."""
+
+    __slots__ = ("path", "source", "_tree", "_syntax_error", "_parsed",
+                 "_suppress_tbl", "_sha")
 
     def __init__(self, path: str, source: str):
         self.path = path
         self.source = source
-        self.syntax_error: SyntaxError | None = None
+        self._parsed = False
+        self._tree = None
+        self._syntax_error: SyntaxError | None = None
+        self._suppress_tbl: dict | None = None
+        self._sha: str | None = None
+
+    def _parse(self) -> None:
+        if self._parsed:
+            return
+        self._parsed = True
         try:
-            self.tree = ast.parse(source, filename=path)
+            self._tree = ast.parse(self.source, filename=self.path)
         except SyntaxError as e:
-            self.tree = None
-            self.syntax_error = e
-        self._suppress: dict[int, set[str] | None] = {}
-        for lineno, line in enumerate(source.splitlines(), start=1):
-            m = _SUPPRESS_RE.search(line)
-            if m is None:
-                continue
-            codes = m.group(1)
-            if codes is None:
-                self._suppress[lineno] = None  # everything on this line
-            else:
-                self._suppress[lineno] = {
-                    c.strip() for c in codes.split(",") if c.strip()
-                }
+            self._tree = None
+            self._syntax_error = e
+
+    @property
+    def tree(self):
+        self._parse()
+        return self._tree
+
+    @property
+    def syntax_error(self) -> SyntaxError | None:
+        self._parse()
+        return self._syntax_error
+
+    @property
+    def sha(self) -> str:
+        if self._sha is None:
+            import hashlib
+
+            self._sha = hashlib.sha256(self.source.encode()).hexdigest()
+        return self._sha
+
+    @property
+    def _suppress(self) -> dict:
+        if self._suppress_tbl is None:
+            tbl: dict[int, set[str] | None] = {}
+            for lineno, line in enumerate(
+                self.source.splitlines(), start=1
+            ):
+                m = _SUPPRESS_RE.search(line)
+                if m is None:
+                    continue
+                codes = m.group(1)
+                if codes is None:
+                    tbl[lineno] = None  # everything on this line
+                else:
+                    tbl[lineno] = {
+                        c.strip() for c in codes.split(",") if c.strip()
+                    }
+            self._suppress_tbl = tbl
+        return self._suppress_tbl
 
     @classmethod
     def from_file(cls, file_path: Path, display_path: str) -> "SourceModule":

@@ -73,45 +73,121 @@ def collect_modules(paths: list[Path], root: Path) -> list[SourceModule]:
     return mods
 
 
+def _pass_selected(p: Pass, select, ignore) -> bool:
+    if not (select or ignore):
+        return True
+    return any(_selected(c, select, ignore) for c in p.codes)
+
+
+def _suppress_filter(findings, by_path):
+    out = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.line, f.code):
+            continue
+        out.append(f)
+    return out
+
+
 def run_passes(
     mods: list[SourceModule],
     passes: list[Pass] | None = None,
     select: list[str] | None = None,
     ignore: list[str] | None = None,
     project_passes: bool = True,
+    jaxpr: bool = False,
+    cache=None,
 ) -> list[Finding]:
+    """Run the pass pipeline.  ``cache`` (an
+    :class:`~tpudes.analysis.cache.AnalysisCache`) serves per-file and
+    whole-set findings by content hash; the cache is only WRITTEN by
+    un-narrowed runs (no select/ignore, default pass set), so narrowed
+    runs can read it but never poison it.  ``jaxpr=True`` appends the
+    trace-aware JXL pass family (never cached — its findings depend on
+    the engines' runtime tracing, not file bytes)."""
     _ensure_builtins()
+    default_set = passes is None
     passes = ALL_PASSES if passes is None else passes
     by_path = {m.path: m for m in mods}
+    if not default_set:
+        cache = None  # a custom pass set must not read full-run results
+    if cache is not None and any(
+        not type(p).__module__.startswith("tpudes.analysis")
+        for p in passes
+    ):
+        # third-party register_pass plugins live outside the analyzer
+        # tree, so the rules fingerprint cannot see their edits — a
+        # cache here could serve stale plugin findings
+        cache = None
+    cache_writable = (
+        cache is not None and not select and not ignore
+    )
     findings: list[Finding] = []
-    for p in passes:
-        if select or ignore:
-            if not any(_selected(c, select, ignore) for c in p.codes):
+
+    module_passes = [
+        p for p in passes
+        if not p.project_wide and _pass_selected(p, select, ignore)
+    ]
+    any_module_pass = any(not p.project_wide for p in passes)
+    for mod in mods:
+        if cache is not None and any_module_pass:
+            cached = cache.get_file(mod.path, mod.sha)
+            if cached is not None:
+                findings.extend(cached)
                 continue
-        if p.project_wide:
-            # cross-file passes are sound only over the full module
-            # set: a subtree scan cannot see references living outside
-            # it and would flag live registrations as dead
-            if not project_passes:
+        found: list[Finding] = []
+        for p in module_passes:
+            if not p.applies(mod.path):
                 continue
-            found = p.check_project(mods)
+            if mod.tree is None and not p.handles_syntax_errors:
+                continue
+            found.extend(p.check_module(mod))
+        found = _suppress_filter(found, by_path)
+        if cache_writable:
+            cache.put_file(mod.path, mod.sha, found)
+        findings.extend(found)
+    if cache_writable:
+        cache.prune(by_path)  # renamed/deleted files must not linger
+
+    # cross-file passes are sound only over the full module set: a
+    # subtree scan cannot see references living outside it and would
+    # flag live registrations as dead
+    proj_passes = [
+        p for p in passes
+        if p.project_wide and _pass_selected(p, select, ignore)
+    ]
+    if project_passes and any(p.project_wide for p in passes):
+        psha = None
+        cached = None
+        if cache is not None:
+            from tpudes.analysis.cache import AnalysisCache
+
+            psha = AnalysisCache.project_sha(mods)
+            cached = cache.get_project(psha)
+        if cached is not None:
+            findings.extend(cached)
         else:
             found = []
-            for mod in mods:
-                if not p.applies(mod.path):
-                    continue
-                if mod.tree is None and not p.handles_syntax_errors:
-                    continue
-                found.extend(p.check_module(mod))
-        findings.extend(found)
-    out = []
-    for f in findings:
-        if not _selected(f.code, select, ignore):
-            continue
-        mod = by_path.get(f.path)
-        if mod is not None and mod.suppressed(f.line, f.code):
-            continue
-        out.append(f)
+            for p in proj_passes:
+                found.extend(p.check_project(mods))
+            found = _suppress_filter(found, by_path)
+            if cache_writable and psha is not None:
+                cache.put_project(psha, found)
+            findings.extend(found)
+
+    if jaxpr:
+        # the trace-aware family runs regardless of project_passes: it
+        # lints the engine manifests, not the scanned module set
+        from tpudes.analysis.jaxpr import JAXPR_PASSES
+
+        for cls in JAXPR_PASSES:
+            p = cls()
+            if _pass_selected(p, select, ignore):
+                findings.extend(
+                    _suppress_filter(p.check_project(mods), by_path)
+                )
+
+    out = [f for f in findings if _selected(f.code, select, ignore)]
     out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return out
 
@@ -122,11 +198,14 @@ def analyze_paths(
     select: list[str] | None = None,
     ignore: list[str] | None = None,
     project_passes: bool = True,
+    jaxpr: bool = False,
+    cache=None,
 ) -> list[Finding]:
     root = Path(root)
     mods = collect_modules([Path(p) for p in paths], root)
     return run_passes(mods, select=select, ignore=ignore,
-                      project_passes=project_passes)
+                      project_passes=project_passes, jaxpr=jaxpr,
+                      cache=cache)
 
 
 def analyze_source(
@@ -169,7 +248,9 @@ def write_baseline(path: str | Path, findings: list[Finding]) -> None:
         "comment": (
             "Known findings gated by `python -m tpudes.analysis`. Keys are "
             "path:CODE:message (line-free). Regenerate with "
-            "--write-baseline after an intentional cleanup."
+            "`python -m tpudes.analysis --jaxpr --write-baseline` after an "
+            "intentional cleanup (--jaxpr so the JXL trace rules stay "
+            "covered)."
         ),
         "counts": {k: counts[k] for k in sorted(counts)},
     }

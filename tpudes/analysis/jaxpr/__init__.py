@@ -1,0 +1,43 @@
+"""tpudes.analysis.jaxpr — trace-aware lint over the device-engine
+surface.
+
+Every registered engine front-end exports a canonical tiny-shape
+**trace manifest** (:mod:`tpudes.analysis.jaxpr.spec`); the JXL pass
+family (:mod:`tpudes.analysis.jaxpr.passes`) abstractly traces each
+manifest with ``jax.make_jaxpr`` — no compile, CPU-safe — and lints
+the jaxprs for the structural contracts the paper's thesis rests on:
+
+- JXL001  forbidden primitives (no-gather wired kernels, no host
+          callbacks/infeed anywhere)
+- JXL002  dtype discipline (no silent f64 promotion; bf16 reductions
+          accumulate f32)
+- JXL003  baked-in large constants that should be runtime operands
+- JXL004  cache-key hygiene (dead/missing key components; declared-
+          traced operands burned to constants)
+- JXL005  donation audit (dead donated carry leaves, unaliasable
+          donations, undonated carries)
+
+Enable with ``python -m tpudes.analysis --jaxpr``.
+"""
+
+from tpudes.analysis.jaxpr.passes import (
+    JAXPR_PASSES,
+    JaxprContractPass,
+    lint_manifest,
+)
+from tpudes.analysis.jaxpr.spec import (
+    FlipSpec,
+    TraceEntry,
+    TraceManifest,
+    TraceVariant,
+)
+
+__all__ = [
+    "JAXPR_PASSES",
+    "JaxprContractPass",
+    "FlipSpec",
+    "TraceEntry",
+    "TraceManifest",
+    "TraceVariant",
+    "lint_manifest",
+]

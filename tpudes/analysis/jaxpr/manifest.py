@@ -1,0 +1,42 @@
+"""Registry of the per-engine trace manifests.
+
+Each device-engine front-end exports a module-level
+``trace_manifest()`` returning a
+:class:`~tpudes.analysis.jaxpr.spec.TraceManifest`.  This module just
+knows where they live and imports them lazily (the AST-only analysis
+path never pays a jax import).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+#: (module, attribute) of every engine manifest the ``--jaxpr`` pass
+#: family lints — the five device engines plus the hybrid
+#: space-lanes window kernel.  A new engine front-end joins the gate by
+#: exporting ``trace_manifest()`` and adding one row here (see README
+#: "Static analysis" for the howto).
+ENGINE_MANIFESTS = (
+    ("tpudes.parallel.replicated", "trace_manifest"),
+    ("tpudes.parallel.lte_sm", "trace_manifest"),
+    ("tpudes.parallel.tcp_dumbbell", "trace_manifest"),
+    ("tpudes.parallel.as_flows", "trace_manifest"),
+    ("tpudes.parallel.wired", "trace_manifest"),
+    ("tpudes.parallel.hybrid", "trace_manifest"),
+)
+
+
+def load_manifests():
+    """Import every registered front-end and collect
+    ``(manifest, anchor_line)`` pairs — the anchor is the engine's
+    ``trace_manifest`` definition line, so findings land on (and inline
+    suppressions apply at) the manifest export itself."""
+    out = []
+    for mod_name, attr in ENGINE_MANIFESTS:
+        mod = importlib.import_module(mod_name)
+        fn = getattr(mod, attr)
+        line = getattr(
+            getattr(fn, "__code__", None), "co_firstlineno", 1
+        )
+        out.append((fn(), line))
+    return out

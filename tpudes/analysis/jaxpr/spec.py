@@ -1,0 +1,108 @@
+"""Trace-manifest spec types — the contract each device-engine
+front-end exports so the jaxpr passes can lint it.
+
+This module is deliberately dependency-free (no jax import at module
+scope): the engine front-ends in ``tpudes/parallel/`` import it to
+declare their manifests, and everything that actually traces lives in
+:mod:`tpudes.analysis.jaxpr.trace`.
+
+A manifest names, for one engine:
+
+- how to build the **canonical tiny-shape trace entries** (the exact
+  functions the engine's ``run_*`` entry point would hand to
+  ``jax.jit`` — unjitted — plus concrete example operands small enough
+  that ``jax.make_jaxpr`` traces them in well under a second, CPU-safe,
+  no compile);
+- which structural contracts apply (the wired no-gather rule, the bf16
+  accumulator policy);
+- a set of **flips**: single-field program variations, each tagged with
+  whether the engine's REAL runner-cache key distinguishes it
+  (``key_differs`` is computed by the engine from its own cache-key
+  helper, so the manifest cannot drift from the code it describes).
+  JXL004 then checks both directions: a key-distinguished flip whose
+  traces are identical is a dead key component (spurious recompiles); a
+  key-identical flip whose traces differ is a missing component (stale
+  executables).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class TraceEntry:
+    """One traceable function of a cached runner value.
+
+    ``fn`` is the UNJITTED callable exactly as the engine jits it;
+    ``args`` are concrete example operands (pytrees).  ``donate`` names
+    the argnums the engine donates on accelerators
+    (``donate_argnums(...)`` intent — the CPU backend strips them at
+    jit time, so the lint checks the declared intent, not the
+    backend-dependent call).  ``carry`` names argnums that are
+    state carries handed call-to-call (donatable by shape); ``traced``
+    maps operand names to argnums that the engine documents as traced
+    runtime operands (each must surface as a *live* jaxpr input — an
+    operand the builder accidentally closed over traces as a constant
+    and its invars go unused).  ``kernel`` marks hot-loop entries: the
+    per-manifest forbidden-primitive contracts (no-gather) apply only
+    to these, not to one-time init tracing.
+    """
+
+    name: str
+    fn: object
+    args: tuple
+    donate: tuple = ()
+    carry: tuple = ()
+    traced: dict = field(default_factory=dict)
+    kernel: bool = True
+
+
+@dataclass(frozen=True)
+class TraceVariant:
+    """One named build of the engine's entries (e.g. ``base``,
+    ``bf16``).  ``build`` is a zero-arg thunk returning the entry list
+    — thunked so the dtype pass can rebuild the SAME variant inside an
+    ``enable_x64`` context and catch unpinned build-time dtypes, not
+    just unpinned traced ops.  ``bf16`` opts the variant into the
+    mixed-precision accumulator check (reductions must accumulate in
+    f32, per the PR 6 precision policy)."""
+
+    name: str
+    build: object
+    bf16: bool = False
+
+
+@dataclass(frozen=True)
+class FlipSpec:
+    """One single-field program variation for cache-key hygiene.
+
+    ``build`` is a zero-arg thunk returning the flipped entry list (to
+    compare against the ``base`` variant); ``key_differs`` is whether
+    the engine's real runner-cache key separates the flipped program
+    from the base one — computed by the engine from its own cache-key
+    helper at manifest build time."""
+
+    build: object
+    key_differs: bool
+
+
+@dataclass(frozen=True)
+class TraceManifest:
+    """The per-engine export: ``trace_manifest()`` in each front-end
+    module returns one of these.  ``path`` is the repo-relative display
+    path findings anchor to; ``variants`` is a zero-arg thunk returning
+    the :class:`TraceVariant` list (first entry is the base variant);
+    ``flips`` a zero-arg thunk returning ``{field_name: FlipSpec}``.
+    ``no_gather`` arms the JXL001 gather/scatter ban on kernel entries
+    (the wired-engine contract: XLA:CPU serializes gathers and Mosaic
+    tiles hate them — the step body must stay one-hot/masked-reduction
+    only).  ``const_budget`` is the JXL003 per-constant byte threshold
+    at the manifest's tiny shapes."""
+
+    engine: str
+    path: str
+    variants: object
+    flips: object = None
+    no_gather: bool = False
+    const_budget: int = 4096

@@ -6,6 +6,7 @@ from tpudes.analysis.passes.cross_replica import CrossReplicaShapePass
 from tpudes.analysis.passes.determinism import DeterminismPass
 from tpudes.analysis.passes.event_hygiene import EventHygienePass
 from tpudes.analysis.passes.jit_purity import JitPurityPass
+from tpudes.analysis.passes.key_discipline import KeyDisciplinePass
 from tpudes.analysis.passes.registry_parity import RegistryParityPass
 from tpudes.analysis.passes.rng_discipline import RngDisciplinePass
 from tpudes.analysis.passes.style import StylePass
@@ -22,4 +23,5 @@ BUILTIN_PASSES = [
     TraceArityPass,
     CrossReplicaShapePass,
     TimeUnitsPass,
+    KeyDisciplinePass,
 ]

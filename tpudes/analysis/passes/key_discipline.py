@@ -1,0 +1,160 @@
+"""KEY001: ``fold_in`` key discipline in the device-engine packages.
+
+The runtime's bucketing/chunking bit-exactness contract
+(tpudes/parallel/runtime.py) requires every PRNG stream to be a pure
+function of stable indices — ``fold_in(key, replica)``,
+``fold_in(key, t)``.  Two AST shapes break it:
+
+- ``jax.random.split(key, n)`` with a NON-LITERAL count: threefry lays
+  counters out per-shape, so the rows depend on ``n`` — growing the
+  replica axis (bucket padding) or the window count silently reshuffles
+  every stream.  A fixed-arity split (``split(k)`` / ``split(k, 3)``)
+  of an already-folded key stays pure in its inputs and is allowed.
+- **raw-key reuse**: the same key name fed to two draw calls without an
+  intervening rebinding — both draws see identical bits, so "independent"
+  coins are correlated 1.0.
+
+Scope: ``tpudes/parallel/`` and ``tpudes/ops/`` (the device-engine
+surface); host-side model code draws from the seeded MRG32k3a stream
+API instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudes.analysis.base import (
+    Finding,
+    Pass,
+    SourceModule,
+    dotted_name,
+    scope_walk,
+)
+
+#: jax.random sampling functions that CONSUME a key (fold_in/split
+#: derive new keys and are not draws; key_data etc. are conversions)
+_DRAW_FNS = frozenset(
+    {"uniform", "normal", "randint", "bernoulli", "choice", "bits",
+     "exponential", "gamma", "beta", "poisson", "categorical",
+     "truncated_normal", "permutation", "laplace", "gumbel",
+     "rademacher", "cauchy", "dirichlet", "loggamma", "multivariate_normal"}
+)
+
+#: module spellings of jax.random in this codebase.  Bare ``random``
+#: is deliberately absent: stdlib ``random.uniform(lo, hi)`` has no
+#: key argument and would read as raw-key reuse; ``np.random`` is the
+#: rng-discipline pass's territory (RNG002).
+_RANDOM_MODULES = frozenset({"jax.random", "jrandom", "jr"})
+
+
+def _random_member(node: ast.AST) -> str | None:
+    """``'split'``/``'uniform'``/… when ``node`` is a call target of the
+    form ``<jax.random spelling>.<member>``, else None."""
+    name = dotted_name(node)
+    if name is None or "." not in name:
+        return None
+    mod, member = name.rsplit(".", 1)
+    # "_jax.random.split" etc.: any dotted prefix ending in the
+    # canonical jax.random spelling counts
+    if mod in _RANDOM_MODULES or mod.endswith("jax.random"):
+        return member
+    return None
+
+
+class KeyDisciplinePass(Pass):
+    name = "key-discipline"
+    codes = {
+        "KEY001": "fold_in discipline: shape-dependent random.split or "
+                  "raw-key reuse in device-engine code",
+    }
+
+    def applies(self, path: str) -> bool:
+        return True  # scoping is per-module via in_package
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        if not (
+            mod.in_package("tpudes", "parallel")
+            or mod.in_package("tpudes", "ops")
+        ):
+            return []
+        out: list[Finding] = []
+        scopes = [mod.tree] + [
+            n for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda))
+        ]
+        for scope in scopes:
+            out.extend(self._check_scope(mod, scope))
+        return out
+
+    def _check_scope(self, mod: SourceModule, scope: ast.AST):
+        out = []
+        #: key-name -> the draw call node that last consumed it since
+        #: its binding (linear source-order approximation, same model
+        #: as the rng-discipline pass; scope_walk never descends into
+        #: nested function scopes — they are scanned on their own)
+        drawn: dict[str, ast.Call] = {}
+        for node in scope_walk(scope):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign, ast.NamedExpr)):
+                for tgt in self._targets(node):
+                    drawn.pop(tgt, None)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            member = _random_member(node.func)
+            if member == "split":
+                count = (
+                    node.args[1] if len(node.args) > 1
+                    else next(
+                        (kw.value for kw in node.keywords
+                         if kw.arg == "num"),
+                        None,
+                    )
+                )
+                if count is not None and not isinstance(
+                    count, ast.Constant
+                ):
+                    out.append(Finding(
+                        mod.path, node.lineno, node.col_offset,
+                        "KEY001",
+                        "jax.random.split with a shape-derived "
+                        "count makes streams depend on the axis "
+                        "size — bucketing/chunking would reshuffle "
+                        "them; derive per-index keys via fold_in "
+                        "(runtime.replica_keys)",
+                    ))
+                continue
+            if member in _DRAW_FNS and node.args:
+                key_arg = node.args[0]
+                key_name = (
+                    key_arg.id if isinstance(key_arg, ast.Name)
+                    else None
+                )
+                if key_name is None:
+                    continue
+                if key_name in drawn:
+                    out.append(Finding(
+                        mod.path, node.lineno, node.col_offset,
+                        "KEY001",
+                        f"raw key {key_name!r} consumed by a "
+                        "second draw without rebinding — the "
+                        "draws are bit-correlated; fold_in a "
+                        "fresh subkey per draw",
+                    ))
+                else:
+                    drawn[key_name] = node
+        return out
+
+    @staticmethod
+    def _targets(node) -> list[str]:
+        tgts = []
+        raw = (
+            node.targets if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        for t in raw:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    tgts.append(n.id)
+        return tgts

@@ -1,0 +1,88 @@
+"""SARIF 2.1.0 output (``--format sarif``).
+
+The minimal profile GitHub code scanning ingests: one run, one tool
+driver carrying the rule index, one result per (new) finding with a
+physical location.  Dependency-free by design, like the rest of the
+analyzer.
+"""
+
+from __future__ import annotations
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(findings, rules: dict[str, str]) -> dict:
+    """``findings`` are the post-baseline (new) findings; ``rules``
+    maps every registered rule code to its one-line description (the
+    driver advertises the full rule set, not just the codes that
+    fired, so code-scanning UIs can render suppress/track state)."""
+    rule_ids = sorted(rules)
+    index = {code: i for i, code in enumerate(rule_ids)}
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpudes-analysis",
+                        "informationUri":
+                            "https://example.invalid/tpudes#static-analysis",
+                        "rules": [
+                            {
+                                "id": code,
+                                "shortDescription": {"text": rules[code]},
+                            }
+                            for code in rule_ids
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.code,
+                        "ruleIndex": index.get(f.code, -1),
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {
+                                        "uri": f.path,
+                                        "uriBaseId": "SRCROOT",
+                                    },
+                                    "region": {
+                                        "startLine": max(1, int(f.line)),
+                                        "startColumn": max(
+                                            1, int(f.col) + 1
+                                        ),
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
+
+
+def all_rule_descriptions(jaxpr: bool = False) -> dict[str, str]:
+    """Every registered rule code → description (optionally including
+    the jaxpr family)."""
+    from tpudes.analysis.engine import ALL_PASSES, _ensure_builtins
+
+    _ensure_builtins()
+    passes = list(ALL_PASSES)
+    if jaxpr:
+        from tpudes.analysis.jaxpr import JAXPR_PASSES
+
+        passes.extend(cls() for cls in JAXPR_PASSES)
+    out: dict[str, str] = {}
+    for p in passes:
+        out.update(p.codes)
+    return out

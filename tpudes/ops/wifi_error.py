@@ -80,7 +80,12 @@ def _qam_ber(snr: jax.Array, m: jax.Array) -> jax.Array:
     2(1-1/√M)/log2(M) · erfc(√(snr/div(M))) with upstream's per-M
     divisors (QAM_DIVISORS) — no extra ½ factor."""
     log2m = jnp.log2(m)
-    d16, d64, d256, d1024 = (QAM_DIVISORS[k] for k in (16.0, 64.0, 256.0, 1024.0))
+    # divisors pinned to the snr dtype: a where() over bare python
+    # floats would select at f64 under ambient x64 (JXL002)
+    d16, d64, d256, d1024 = (
+        jnp.asarray(QAM_DIVISORS[k], snr.dtype)
+        for k in (16.0, 64.0, 256.0, 1024.0)
+    )
     div = jnp.where(
         m <= 16.0, d16, jnp.where(m <= 64.0, d64, jnp.where(m <= 256.0, d256, d1024))
     )
@@ -108,9 +113,12 @@ def coded_pe(ber: jax.Array, rate_class: jax.Array) -> jax.Array:
     D = √(4p(1-p)), pe = factor(b) · Σ a_k D^e_k, clamped to [0, 1]."""
     p = jnp.clip(ber, 0.0, 0.5)
     d = jnp.sqrt(4.0 * p * (1.0 - p))
-    coeffs = jnp.asarray(_PE_COEFFS)[rate_class]           # (..., 10)
-    exps = jnp.asarray(_PE_EXPONENTS)[rate_class]          # (..., 10)
-    factor = jnp.asarray(_B_FACTOR)[rate_class]
+    # dtypes pinned f32: the host tables are f64 numpy, and an
+    # unpinned asarray would ride f64 through the whole PSR chain
+    # under ambient x64 (analysis rule JXL002)
+    coeffs = jnp.asarray(_PE_COEFFS, jnp.float32)[rate_class]  # (..., 10)
+    exps = jnp.asarray(_PE_EXPONENTS, jnp.float32)[rate_class]  # (..., 10)
+    factor = jnp.asarray(_B_FACTOR, jnp.float32)[rate_class]
     # stable evaluation: a_k D^e_k = exp(log a_k + e_k log D); D=0 → 0
     log_d = jnp.log(jnp.maximum(d, 1e-35))
     terms = jnp.where(

@@ -280,6 +280,20 @@ def _as_unpack(host: dict, replicas: int) -> dict:
     }
 
 
+def as_prog_key(prog: AsFlowsProgram) -> tuple:
+    """Hashable identity of the AsFlowsProgram fields that shape the
+    compiled relaxation (shared by the runner cache key and the serving
+    coalesce key so the two can never drift).  ``prog.sim_s`` is
+    deliberately ABSENT: the fluid fixed point has no time horizon (its
+    cost does not scale with simulated seconds)."""
+    return (
+        prog.edges.tobytes(), prog.delay_s.tobytes(),
+        prog.rate_bps.tobytes(), prog.src.tobytes(), prog.dst.tobytes(),
+        prog.flow_bps.tobytes(), prog.pkt_bytes, prog.max_hops,
+        prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
+    )
+
+
 def as_study(prog: AsFlowsProgram, key, replicas, mesh=None,
              rate_scale: float = 1.0):
     """Serving-layer study descriptor (see :mod:`tpudes.serving`): the
@@ -291,11 +305,7 @@ def as_study(prog: AsFlowsProgram, key, replicas, mesh=None,
     the unswept run at scale 1."""
     from tpudes.serving.descriptor import StudyDescriptor, mesh_fingerprint
 
-    ck = (
-        prog.edges.tobytes(), prog.delay_s.tobytes(),
-        prog.rate_bps.tobytes(), prog.src.tobytes(), prog.dst.tobytes(),
-        prog.flow_bps.tobytes(), prog.pkt_bytes, prog.max_hops,
-        prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
+    ck = as_prog_key(prog) + (
         np.asarray(key).tobytes(), int(replicas), mesh_fingerprint(mesh),
     )
 
@@ -320,6 +330,138 @@ def as_study(prog: AsFlowsProgram, key, replicas, mesh=None,
     return StudyDescriptor(
         "as_flows", ck, float(rate_scale), launch, warm, spec=spec
     )
+
+
+def build_as_run(prog: AsFlowsProgram, r_pad: int, n_cfg: int | None = None,
+                 obs: bool = False, mesh=None):
+    """The UNJITTED runner function ``run(carry, z, scale, rounds_end)``
+    exactly as :func:`run_as_flows` jits it — factored out so the trace
+    manifest (:func:`trace_manifest`) abstractly traces the same
+    program the runner cache compiles."""
+    E = prog.edges.shape[0]
+    E2 = 2 * E
+    cap = jnp.concatenate(
+        [jnp.asarray(prog.rate_bps), jnp.asarray(prog.rate_bps)]
+    ).astype(jnp.float32)
+    dly = jnp.concatenate(
+        [jnp.asarray(prog.delay_s), jnp.asarray(prog.delay_s)]
+    ).astype(jnp.float32)
+    fbps = jnp.asarray(prog.flow_bps, jnp.float32)
+    R, F, H = r_pad, len(prog.src), prog.max_hops
+    pad = lambda x: jnp.concatenate(  # noqa: E731
+        [x, jnp.zeros((R, 1), x.dtype)], axis=1
+    )
+    hs = jnp.arange(H, dtype=jnp.int32)
+
+    def topo():
+        ddst, dist, nh_edge, nh_node = device_spf(prog, mesh)
+        path, hops, arrived = _walk_paths(prog, ddst, nh_edge, nh_node)
+        reached = (
+            dist[ddst, jnp.asarray(prog.src)] < INF
+        ) & arrived
+        return path, hops, reached
+
+    def relax(carry, z, scale, rounds_end, path, reached):
+        # per-replica offered rates: lognormal jitter around the
+        # scale-multiplied nominal (z enters sharded over the
+        # mesh's replica axis — every (R, ...) array downstream
+        # inherits that sharding)
+        rate = fbps[None, :] * scale * jnp.exp(
+            prog.rate_jitter * z - 0.5 * prog.rate_jitter**2
+        )
+        rate = jnp.where(reached[None, :], rate, 0.0)
+
+        # fluid fixed point: a link's load is the SURVIVING rate of
+        # each transiting flow at that hop (loss upstream attenuates
+        # load downstream)
+        def one_round(lfrac_link):
+            # walk: per-flow surviving rate entering each hop, and
+            # accumulate this round's per-link loads
+            def walk(c, h):
+                lg, load = c
+                e_h = path[:, h]                       # (F,)
+                load = load.at[:, e_h].add(rate * jnp.exp(lg))
+                lg = lg + lfrac_link[:, e_h]
+                return (lg, load), None
+
+            (lg, load), _ = jax.lax.scan(
+                walk,
+                (jnp.zeros((R, F), jnp.float32),
+                 jnp.zeros((R, E2 + 1), jnp.float32)),
+                hs,
+            )
+            util = load[:, :E2] / cap[None, :]
+            new_lfrac = pad(
+                jnp.log(jnp.minimum(1.0, 1.0 / jnp.maximum(util, 1e-9)))
+            )
+            return new_lfrac, lg, util
+
+        def body(c):
+            i, lf, _, _ = c
+            lf2, lg2, util2 = one_round(lf)
+            return i + 1, lf2, lg2, util2
+
+        i, lfrac, lg, util = jax.lax.while_loop(
+            lambda c: c[0] < rounds_end, body, carry
+        )
+
+        # M/M/1 queue delay along each path from the settled utils
+        rho = jnp.minimum(util, 0.99)
+        q_delay = (
+            rho / (1.0 - rho) * (8.0 * prog.pkt_bytes / cap)[None, :]
+        )
+        serial = (8.0 * prog.pkt_bytes / cap)[None, :]
+        ldel = pad(q_delay + serial + dly[None, :])
+
+        def acc_hop(dl, h):
+            return dl + ldel[:, path[:, h]], None
+
+        dl, _ = jax.lax.scan(
+            acc_hop, jnp.zeros((R, F), jnp.float32), hs
+        )
+        frac = jnp.where(reached[None, :], jnp.exp(lg), 0.0)
+        outputs = dict(
+            goodput_bps=rate * frac,
+            delay_s=jnp.where(reached[None, :], dl, jnp.inf),
+            delivered_frac=frac,
+            max_util=util.max(axis=1),
+        )
+        # chunk summary only under TpudesObs (obs is in the cache
+        # key): a disabled run compiles the pre-obs program
+        metrics = dict(max_util=jnp.max(util)) if obs else {}
+        return (i, lfrac, lg, util), outputs, metrics
+
+    def run(carry, z, scale, rounds_end):
+        path, hops, reached = topo()
+        if n_cfg is None:
+            carry, outputs, metrics = relax(
+                carry, z, scale, rounds_end, path, reached
+            )
+        else:
+            # SPF + path walk are config-independent: computed once,
+            # closed over by the vmapped fixed point
+            carry, outputs, metrics = jax.vmap(
+                lambda c, s: relax(c, z, s, rounds_end, path, reached)
+            )(carry, scale)
+        outputs["hops"] = hops
+        outputs["unreachable"] = ~reached
+        return carry, outputs, metrics
+
+    return run
+
+
+def _as_replica_draws(prog: AsFlowsProgram, key, r_pad: int):
+    """(R, F) per-replica rate-jitter z-draws keyed by
+    ``fold_in(key, r)``: replica r's row is independent of the padded
+    axis size, so bucketing is exact.  dtype pinned f32 — the draw must
+    not widen under ambient x64 (analysis rule JXL002)."""
+    from tpudes.parallel.runtime import replica_keys
+
+    return jax.vmap(
+        lambda kk: jax.random.normal(
+            kk, (len(prog.src),), jnp.float32
+        )
+    )(replica_keys(key, r_pad))
 
 
 def run_as_flows(
@@ -363,7 +505,6 @@ def run_as_flows(
         donate_argnums,
         drive_chunks,
         finalize_with_flush,
-        replica_keys,
         shard_replica_axis,
         stack_axis,
         unstack_points,
@@ -372,135 +513,24 @@ def run_as_flows(
     r_pad = bucket_replicas(replicas, mesh)
     n_cfg = None if rate_scale is None else len(rate_scale)
     obs = device_metrics_enabled()
-    # prog.sim_s is deliberately ABSENT: the fluid fixed point has no
-    # time horizon (its cost does not scale with simulated seconds).
-    # mesh IS present: device_spf shards its tables via the mesh
-    # closure, unlike the engines whose sharding flows from inputs
-    ck = (
-        prog.edges.tobytes(), prog.delay_s.tobytes(),
-        prog.rate_bps.tobytes(), prog.src.tobytes(), prog.dst.tobytes(),
-        prog.flow_bps.tobytes(), prog.pkt_bytes,
-        prog.max_hops, prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
-        r_pad, mesh, n_cfg, obs,
-    )
+    # prog.sim_s is deliberately ABSENT (see as_prog_key).  mesh IS
+    # present: device_spf shards its tables via the mesh closure,
+    # unlike the engines whose sharding flows from inputs
+    ck = as_prog_key(prog) + (r_pad, mesh, n_cfg, obs)
 
     def build():
-        E = prog.edges.shape[0]
-        E2 = 2 * E
-        cap = jnp.concatenate(
-            [jnp.asarray(prog.rate_bps), jnp.asarray(prog.rate_bps)]
-        ).astype(jnp.float32)
-        dly = jnp.concatenate(
-            [jnp.asarray(prog.delay_s), jnp.asarray(prog.delay_s)]
-        ).astype(jnp.float32)
-        fbps = jnp.asarray(prog.flow_bps, jnp.float32)
-        R, F, H = r_pad, len(prog.src), prog.max_hops
-        pad = lambda x: jnp.concatenate(  # noqa: E731
-            [x, jnp.zeros((R, 1), x.dtype)], axis=1
+        return jax.jit(
+            build_as_run(prog, r_pad, n_cfg=n_cfg, obs=obs, mesh=mesh),
+            donate_argnums=donate_argnums(0),
         )
-        hs = jnp.arange(H, dtype=jnp.int32)
-
-        def topo():
-            ddst, dist, nh_edge, nh_node = device_spf(prog, mesh)
-            path, hops, arrived = _walk_paths(prog, ddst, nh_edge, nh_node)
-            reached = (
-                dist[ddst, jnp.asarray(prog.src)] < INF
-            ) & arrived
-            return path, hops, reached
-
-        def relax(carry, z, scale, rounds_end, path, reached):
-            # per-replica offered rates: lognormal jitter around the
-            # scale-multiplied nominal (z enters sharded over the
-            # mesh's replica axis — every (R, ...) array downstream
-            # inherits that sharding)
-            rate = fbps[None, :] * scale * jnp.exp(
-                prog.rate_jitter * z - 0.5 * prog.rate_jitter**2
-            )
-            rate = jnp.where(reached[None, :], rate, 0.0)
-
-            # fluid fixed point: a link's load is the SURVIVING rate of
-            # each transiting flow at that hop (loss upstream attenuates
-            # load downstream)
-            def one_round(lfrac_link):
-                # walk: per-flow surviving rate entering each hop, and
-                # accumulate this round's per-link loads
-                def walk(c, h):
-                    lg, load = c
-                    e_h = path[:, h]                       # (F,)
-                    load = load.at[:, e_h].add(rate * jnp.exp(lg))
-                    lg = lg + lfrac_link[:, e_h]
-                    return (lg, load), None
-
-                (lg, load), _ = jax.lax.scan(
-                    walk,
-                    (jnp.zeros((R, F)), jnp.zeros((R, E2 + 1), jnp.float32)),
-                    hs,
-                )
-                util = load[:, :E2] / cap[None, :]
-                new_lfrac = pad(
-                    jnp.log(jnp.minimum(1.0, 1.0 / jnp.maximum(util, 1e-9)))
-                )
-                return new_lfrac, lg, util
-
-            def body(c):
-                i, lf, _, _ = c
-                lf2, lg2, util2 = one_round(lf)
-                return i + 1, lf2, lg2, util2
-
-            i, lfrac, lg, util = jax.lax.while_loop(
-                lambda c: c[0] < rounds_end, body, carry
-            )
-
-            # M/M/1 queue delay along each path from the settled utils
-            rho = jnp.minimum(util, 0.99)
-            q_delay = (
-                rho / (1.0 - rho) * (8.0 * prog.pkt_bytes / cap)[None, :]
-            )
-            serial = (8.0 * prog.pkt_bytes / cap)[None, :]
-            ldel = pad(q_delay + serial + dly[None, :])
-
-            def acc_hop(dl, h):
-                return dl + ldel[:, path[:, h]], None
-
-            dl, _ = jax.lax.scan(acc_hop, jnp.zeros((R, F)), hs)
-            frac = jnp.where(reached[None, :], jnp.exp(lg), 0.0)
-            outputs = dict(
-                goodput_bps=rate * frac,
-                delay_s=jnp.where(reached[None, :], dl, jnp.inf),
-                delivered_frac=frac,
-                max_util=util.max(axis=1),
-            )
-            # chunk summary only under TpudesObs (obs is in the cache
-            # key): a disabled run compiles the pre-obs program
-            metrics = dict(max_util=jnp.max(util)) if obs else {}
-            return (i, lfrac, lg, util), outputs, metrics
-
-        def run(carry, z, scale, rounds_end):
-            path, hops, reached = topo()
-            if n_cfg is None:
-                carry, outputs, metrics = relax(
-                    carry, z, scale, rounds_end, path, reached
-                )
-            else:
-                # SPF + path walk are config-independent: computed once,
-                # closed over by the vmapped fixed point
-                carry, outputs, metrics = jax.vmap(
-                    lambda c, s: relax(c, z, s, rounds_end, path, reached)
-                )(carry, scale)
-            outputs["hops"] = hops
-            outputs["unreachable"] = ~reached
-            return carry, outputs, metrics
-
-        return jax.jit(run, donate_argnums=donate_argnums(0))
 
     run, compiling = RUNTIME.runner("as_flows", ck, build)
 
     # per-replica jitter draws keyed by fold_in(key, r): replica r's
     # z-row is independent of the padded axis size, so bucketing is exact
-    z = jax.vmap(
-        lambda kk: jax.random.normal(kk, (len(prog.src),))
-    )(replica_keys(key, r_pad))
-    z = shard_replica_axis(z, mesh, r_pad, 0)
+    z = shard_replica_axis(
+        _as_replica_draws(prog, key, r_pad), mesh, r_pad, 0
+    )
     scale = (
         jnp.float32(1.0) if n_cfg is None
         else jnp.asarray([float(v) for v in rate_scale], jnp.float32)
@@ -544,3 +574,94 @@ def run_as_flows(
         ),
     )
     return fut.result() if block else fut
+
+
+# --- trace manifest (tpudes.analysis.jaxpr) --------------------------------
+
+#: canonical tiny replica count for the abstract traces
+_TRACE_R = 2
+
+
+def _trace_prog(**over):
+    """Canonical tiny-shape program: 12-node BA graph, 2 CBR flows."""
+    import dataclasses
+
+    from tpudes.parallel.programs import toy_as_program
+
+    prog = toy_as_program(n_nodes=12, n_flows=2, spf_rounds=6)
+    return dataclasses.replace(prog, **over) if over else prog
+
+
+def _trace_entries(prog: AsFlowsProgram, obs: bool = False):
+    """The cached runner exactly as ``run_as_flows`` jits it, with
+    concrete tiny operands (same construction as the entry point)."""
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+
+    run = build_as_run(prog, _TRACE_R, obs=obs)
+    key = jax.random.PRNGKey(0)
+    z = _as_replica_draws(prog, key, _TRACE_R)
+    E2 = 2 * prog.edges.shape[0]
+    F = len(prog.src)
+    carry = (
+        jnp.int32(0),
+        jnp.zeros((_TRACE_R, E2 + 1), jnp.float32),
+        jnp.zeros((_TRACE_R, F), jnp.float32),
+        jnp.zeros((_TRACE_R, E2), jnp.float32),
+    )
+    return [
+        TraceEntry(
+            "run",
+            run,
+            (carry, z, jnp.float32(1.0), jnp.int32(FP_ROUNDS)),
+            donate=(0,),
+            carry=(0,),
+            traced={"scale": 2, "rounds_end": 3},
+        ),
+    ]
+
+
+def _trace_flips():
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import FlipSpec
+
+    base = _trace_prog()
+
+    def flip(**over):
+        prog = dataclasses.replace(base, **over)
+        return FlipSpec(
+            build=lambda p=prog: _trace_entries(p),
+            key_differs=as_prog_key(prog) != as_prog_key(base),
+        )
+
+    return {
+        # live components: each must change some traced program
+        "spf_metric": flip(spf_metric="delay"),
+        "rate_jitter": flip(rate_jitter=0.55),
+        "pkt_bytes": flip(pkt_bytes=256),
+        # obs is a cache-key component by construction (the metrics
+        # tree compiles differently)
+        "obs": FlipSpec(
+            build=lambda: _trace_entries(base, obs=True),
+            key_differs=True,
+        ),
+        # sim_s is excluded by design: the fluid fixed point has no
+        # time horizon, so flipping it must leave the trace identical
+        "sim_s": flip(sim_s=9.0),
+    }
+
+
+def trace_manifest():
+    """Per-engine trace manifest (see :mod:`tpudes.analysis.jaxpr`)."""
+    from tpudes.analysis.jaxpr.spec import TraceManifest, TraceVariant
+
+    return TraceManifest(
+        engine="as_flows",
+        path="tpudes/parallel/as_flows.py",
+        variants=lambda: [
+            TraceVariant(
+                "base", lambda: _trace_entries(_trace_prog())
+            )
+        ],
+        flips=_trace_flips,
+    )

@@ -57,6 +57,7 @@ from tpudes.parallel.wired import (
     packet_table,
     partition_flows,
     partition_lookahead,
+    wired_cache_key,
     _wired_unpack,
 )
 
@@ -64,6 +65,7 @@ __all__ = [
     "HybridRank",
     "SpaceLanesHybrid",
     "run_hybrid",
+    "trace_manifest",
 ]
 
 
@@ -204,11 +206,13 @@ class HybridRank:
             self._g2l = None
         self.n_total_pkts = n_total
 
-        ck = tuple(
-            v.tobytes() if isinstance(v, np.ndarray) else v
-            for k, v in sub.__dict__.items()
-            if k != "n_slots"
-        ) + (self.r_pad, self.owned.tobytes(), self.flow_ids.tobytes())
+        # wired_cache_key drops n_slots/slot_s/link_owner (the latter
+        # two were JXL004-found dead components — this rank's served
+        # set is keyed by the explicit owned mask below, not by the
+        # global ownership metadata)
+        ck = wired_cache_key(sub) + (
+            self.r_pad, self.owned.tobytes(), self.flow_ids.tobytes(),
+        )
 
         def build():
             init_state, advance = build_wired_advance(
@@ -332,11 +336,11 @@ class SpaceLanesHybrid:
         self.t_now = 0
         self.windows = 0
 
-        ck = tuple(
-            v.tobytes() if isinstance(v, np.ndarray) else v
-            for k, v in prog.__dict__.items()
-            if k != "n_slots"
-        ) + (self.r_pad, "space")
+        # keep_owner=True: unlike the per-rank engines, the space
+        # kernel derives its whole lane structure from the ownership
+        # map (n_slots/slot_s still excluded — traced bound /
+        # reporting-only scale)
+        ck = wired_cache_key(prog, keep_owner=True) + (self.r_pad, "space")
         r_pad, size = self.r_pad, self.size
 
         def build():
@@ -814,3 +818,102 @@ def run_hybrid(
     if "loop_wall_s" in rank_outs[0]:
         result["loop_wall_s"] = max(o["loop_wall_s"] for o in rank_outs)
     return result
+
+
+# --- trace manifest (tpudes.analysis.jaxpr) --------------------------------
+
+#: canonical tiny replica count for the abstract traces
+_TRACE_R = 2
+
+
+def _trace_prog(**over):
+    """Canonical tiny 2-rank chain — uniform partitions so the
+    space-lanes kernel lifts it."""
+    import dataclasses
+
+    from tpudes.parallel.wired import wired_weak_chain
+
+    prog = wired_weak_chain(
+        2, links_per_rank=2, flows_per_rank=1, n_slots=60,
+        boundary_delay=8,
+    )
+    return dataclasses.replace(prog, **over) if over else prog
+
+
+def _trace_entries(prog):
+    """The space-lanes window kernel exactly as :class:`SpaceLanesHybrid`
+    jits it, with concrete tiny operands."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+    from tpudes.parallel.wired import build_wired_space_advance
+
+    init_state, advance, parts = build_wired_space_advance(
+        prog, _TRACE_R
+    )
+    key = jax.random.PRNGKey(0)
+    carry = init_state(key)
+    K, R, P = carry["hop"].shape
+    no_ing = jnp.full((K, R, P), -1, jnp.int32)  # tpudes: ignore[SHP001]
+    return [
+        TraceEntry("init", init_state, (key,), kernel=False),
+        TraceEntry(
+            "advance",
+            advance,
+            (carry, no_ing, no_ing, jnp.int32(8)),
+            donate=(0,),
+            carry=(0,),
+            traced={"ing_hop": 1, "ing_ready": 2, "t_grant": 3},
+        ),
+    ]
+
+
+def _trace_flips():
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import FlipSpec
+
+    base = _trace_prog()
+
+    def flip(**over):
+        prog = dataclasses.replace(base, **over)
+        return FlipSpec(
+            build=lambda p=prog: _trace_entries(p),
+            key_differs=(
+                wired_cache_key(prog, keep_owner=True)
+                != wired_cache_key(base, keep_owner=True)
+            ),
+        )
+
+    L = int(base.n_links)
+    return {
+        # link_owner is LIVE here (it defines the lane structure) —
+        # flip to one rank owning everything; key and trace must both
+        # change
+        "link_owner": flip(
+            link_owner=np.zeros(L, np.int32)
+        ),
+        # excluded-by-design fields must leave every trace identical
+        "slot_s": flip(slot_s=0.5),
+        "n_slots": flip(n_slots=120),
+    }
+
+
+def trace_manifest():
+    """Per-engine trace manifest for the hybrid space-lanes window
+    kernel (see :mod:`tpudes.analysis.jaxpr`); the wired no-gather
+    contract applies to the lane step body too."""
+    from tpudes.analysis.jaxpr.spec import TraceManifest, TraceVariant
+
+    return TraceManifest(
+        engine="wired_space",
+        path="tpudes/parallel/hybrid.py",
+        no_gather=True,
+        variants=lambda: [
+            TraceVariant(
+                "base", lambda: _trace_entries(_trace_prog())
+            )
+        ],
+        flips=_trace_flips,
+    )

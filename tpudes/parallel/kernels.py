@@ -123,15 +123,20 @@ def multi_window_scan(positions, tx_prob, mode_idx, frame_bytes, key, n_windows:
     frame counts.  This is the shape of the bench inner loop: zero host
     round-trips inside the scan (SURVEY.md §7 hard part 3)."""
 
-    def step(carry, k):
+    def step(carry, i):
         delivered = carry
-        k_tx, k_phy = jax.random.split(k)
+        # window i's key is fold_in(key, i): pure in (key, i), so the
+        # streams are independent of n_windows (a split(key, n_windows)
+        # keys array reshuffled every window whenever the count changed
+        # — the KEY001 fold_in discipline)
+        k_tx, k_phy = jax.random.split(jax.random.fold_in(key, i))
         tx = jax.random.uniform(k_tx, (positions.shape[0],)) < tx_prob
         ok, _, _ = wifi_phy_window(positions, tx, mode_idx, frame_bytes, k_phy)
         return delivered + jnp.sum(ok, dtype=jnp.int32), None
 
-    keys = jax.random.split(key, n_windows)
-    total, _ = jax.lax.scan(step, jnp.int32(0), keys)
+    total, _ = jax.lax.scan(
+        step, jnp.int32(0), jnp.arange(n_windows, dtype=jnp.int32)
+    )
     return total
 
 

@@ -387,7 +387,9 @@ def build_sm_step(prog: LteSmProgram, use_pallas: bool | None = None):
 
     def step_fn(s, xs, sid):
         t, key = xs
-        coin = jax.random.uniform(key, (U,))[None, :]
+        # coin dtype pinned f32: ambient x64 must not widen the HARQ
+        # stream (JXL002)
+        coin = jax.random.uniform(key, (U,), jnp.float32)[None, :]
         return fused(s, coin, t, sid)
 
     consts = dict(
@@ -592,6 +594,121 @@ def lte_sm_study(prog: LteSmProgram, key, replicas=None, mesh=None):
     )
 
 
+def build_sm_advance(prog: LteSmProgram, r_pad: int | None = None,
+                     n_cfg: int | None = None, obs: bool = False,
+                     use_pallas: bool = False):
+    """``(consts, init_state, fn)`` with ``fn(carry, k, sid, t_end)``
+    the UNJITTED (but replica/config-vmapped) advance exactly as
+    :func:`run_lte_sm` jits it — factored out so the trace manifest
+    (:func:`trace_manifest`) abstractly traces the same program the
+    runner cache compiles."""
+    consts, init_state, step_fn = build_sm_step(prog, use_pallas)
+
+    def advance(carry, k, sid, t_end):
+        # per-TTI key = fold_in(k, t): a pure function of (k, t),
+        # so the traced horizon needs no key-array shape at all —
+        # one executable serves every n_ttis (split(k, n_ttis)
+        # would bake the horizon into the program), and a chunked
+        # run re-entering at t>0 draws the same per-TTI streams
+        def body(c):
+            t, s = c
+            kt = jax.random.fold_in(k, t)
+            return t + 1, step_fn(s, (t, kt), sid)
+
+        t, s = jax.lax.while_loop(
+            lambda c: c[0] < t_end, body, carry
+        )
+        # small per-chunk summaries (fresh buffers, NOT aliased to
+        # the carry — the next chunk donates the carry away); only
+        # under TpudesObs, so a disabled run compiles the exact
+        # pre-obs program
+        metrics = (
+            dict(
+                ok=jnp.sum(s["ok_cnt"]), drops=jnp.sum(s["drops"]),
+                retx=jnp.sum(s["retx"]),
+            )
+            if obs
+            else {}
+        )
+        return (t, s), metrics
+
+    fn = advance
+    if r_pad is not None:
+        fn = jax.vmap(fn, in_axes=(0, 0, None, None))
+    if n_cfg is not None:
+        fn = jax.vmap(fn, in_axes=(0, None, 0, None))
+    return consts, init_state, fn
+
+
+def build_sm_mobile_advance(prog: LteSmProgram, r_pad: int | None = None,
+                            n_cfg: int | None = None, obs: bool = False,
+                            use_pallas: bool = False):
+    """``(init_carry, fn)`` with
+    ``fn(carry, keys, sid, t_end, mob_ops, stride_, pos_table)`` the
+    UNJITTED mobile-geometry advance exactly as
+    :func:`_run_lte_sm_mobile` jits it (see that docstring for the
+    unbatched-loop / scalar-geometry-predicate structure)."""
+    consts_np = build_sm_consts(prog)
+    fused = build_sm_step_fn(
+        consts_np, use_pallas, dynamic=SM_DYNAMIC_ROWS
+    )
+    pos_at, rows_from_pos, init_rows = _build_geom_fn(prog, consts_np)
+    E, U = prog.n_enb, prog.n_ue
+
+    def advance(carry, keys, sid, t_end, mob_ops, stride_, pos_table):
+        def body(c):
+            t, g, s = c
+
+            def refresh(_):
+                pos = (
+                    pos_at(mob_ops, t) if pos_table is None
+                    else pos_table[t // stride_]
+                )
+                return dict(
+                    rows_from_pos(pos),
+                    refreshes=g["refreshes"] + 1,
+                )
+
+            g2 = jax.lax.cond(
+                t % stride_ == 0, refresh, lambda _: g, None
+            )
+            dyn = {k: g2[k] for k in SM_DYNAMIC_ROWS}
+
+            def one(s_r, k_r, sid_s):
+                coin = jax.random.uniform(
+                    jax.random.fold_in(k_r, t), (U,), jnp.float32
+                )[None, :]
+                return fused(s_r, coin, t, sid_s, dyn)
+
+            if r_pad is None:
+                step = one
+            else:
+                step = jax.vmap(one, in_axes=(0, 0, None))
+            if n_cfg is None:
+                s2 = step(s, keys, sid)
+            else:
+                s2 = jax.vmap(step, in_axes=(0, None, 0))(s, keys, sid)
+            return t + 1, g2, s2
+
+        t, g, s = jax.lax.while_loop(
+            lambda c: c[0] < t_end, body, carry
+        )
+        metrics = (
+            dict(
+                ok=jnp.sum(s["ok_cnt"]), drops=jnp.sum(s["drops"]),
+                retx=jnp.sum(s["retx"]),
+            )
+            if obs
+            else {}
+        )
+        return (t, g, s), metrics
+
+    def init_carry():
+        return (jnp.int32(0), init_rows(), sm_init_state(E, U))
+
+    return init_carry, advance
+
+
 def _run_lte_sm_mobile(
     prog: LteSmProgram,
     key,
@@ -652,67 +769,11 @@ def _run_lte_sm_mobile(
     k_ref = None if dg_on else -(-prog.n_ttis // stride)
 
     def build():
-        consts_np = build_sm_consts(prog)
-        fused = build_sm_step_fn(
-            consts_np, use_pallas, dynamic=SM_DYNAMIC_ROWS
+        init_carry, fn = build_sm_mobile_advance(
+            prog, r_pad=r_pad, n_cfg=n_cfg, obs=obs,
+            use_pallas=use_pallas,
         )
-        pos_at, rows_from_pos, init_rows = _build_geom_fn(prog, consts_np)
-        E, U = prog.n_enb, prog.n_ue
-
-        def advance(carry, keys, sid, t_end, mob_ops, stride_, pos_table):
-            def body(c):
-                t, g, s = c
-
-                def refresh(_):
-                    pos = (
-                        pos_at(mob_ops, t) if pos_table is None
-                        else pos_table[t // stride_]
-                    )
-                    return dict(
-                        rows_from_pos(pos),
-                        refreshes=g["refreshes"] + 1,
-                    )
-
-                g2 = jax.lax.cond(
-                    t % stride_ == 0, refresh, lambda _: g, None
-                )
-                dyn = {k: g2[k] for k in SM_DYNAMIC_ROWS}
-
-                def one(s_r, k_r, sid_s):
-                    coin = jax.random.uniform(
-                        jax.random.fold_in(k_r, t), (U,)
-                    )[None, :]
-                    return fused(s_r, coin, t, sid_s, dyn)
-
-                if r_pad is None:
-                    step = one
-                else:
-                    step = jax.vmap(one, in_axes=(0, 0, None))
-                if n_cfg is None:
-                    s2 = step(s, keys, sid)
-                else:
-                    s2 = jax.vmap(step, in_axes=(0, None, 0))(s, keys, sid)
-                return t + 1, g2, s2
-
-            t, g, s = jax.lax.while_loop(
-                lambda c: c[0] < t_end, body, carry
-            )
-            metrics = (
-                dict(
-                    ok=jnp.sum(s["ok_cnt"]), drops=jnp.sum(s["drops"]),
-                    retx=jnp.sum(s["retx"]),
-                )
-                if obs
-                else {}
-            )
-            return (t, g, s), metrics
-
-        fn = jax.jit(advance, donate_argnums=donate_argnums(0))
-
-        def init_carry():
-            return (jnp.int32(0), init_rows(), sm_init_state(E, U))
-
-        return init_carry, fn
+        return init_carry, jax.jit(fn, donate_argnums=donate_argnums(0))
 
     (init_carry, fn), compiling = RUNTIME.runner(
         "lte_sm",
@@ -881,43 +942,13 @@ def run_lte_sm(
     )
 
     def build():
-        consts, init_state, step_fn = build_sm_step(prog, use_pallas)
-
-        def advance(carry, k, sid, t_end):
-            # per-TTI key = fold_in(k, t): a pure function of (k, t),
-            # so the traced horizon needs no key-array shape at all —
-            # one executable serves every n_ttis (split(k, n_ttis)
-            # would bake the horizon into the program), and a chunked
-            # run re-entering at t>0 draws the same per-TTI streams
-            def body(c):
-                t, s = c
-                kt = jax.random.fold_in(k, t)
-                return t + 1, step_fn(s, (t, kt), sid)
-
-            t, s = jax.lax.while_loop(
-                lambda c: c[0] < t_end, body, carry
-            )
-            # small per-chunk summaries (fresh buffers, NOT aliased to
-            # the carry — the next chunk donates the carry away); only
-            # under TpudesObs, so a disabled run compiles the exact
-            # pre-obs program
-            metrics = (
-                dict(
-                    ok=jnp.sum(s["ok_cnt"]), drops=jnp.sum(s["drops"]),
-                    retx=jnp.sum(s["retx"]),
-                )
-                if obs
-                else {}
-            )
-            return (t, s), metrics
-
-        fn = advance
-        if r_pad is not None:
-            fn = jax.vmap(fn, in_axes=(0, 0, None, None))
-        if n_cfg is not None:
-            fn = jax.vmap(fn, in_axes=(0, None, 0, None))
-        fn = jax.jit(fn, donate_argnums=donate_argnums(0))
-        return consts, init_state, fn
+        consts, init_state, fn = build_sm_advance(
+            prog, r_pad=r_pad, n_cfg=n_cfg, obs=obs,
+            use_pallas=use_pallas,
+        )
+        return consts, init_state, jax.jit(
+            fn, donate_argnums=donate_argnums(0)
+        )
 
     (consts, init_state, fn), compiling = RUNTIME.runner(
         "lte_sm", _sm_cache_key(prog, r_pad, n_cfg, obs, use_pallas), build
@@ -971,3 +1002,111 @@ def run_lte_sm(
         ),
     )
     return fut.result() if block else fut
+
+
+# --- trace manifest (tpudes.analysis.jaxpr) --------------------------------
+
+#: canonical tiny replica count for the abstract traces
+_TRACE_R = 2
+
+
+def _trace_prog(**over):
+    """Canonical tiny-shape program: 2 cells, 3 UEs, PF scheduler."""
+    import dataclasses
+
+    from tpudes.parallel.programs import toy_lte_program
+
+    prog = toy_lte_program(n_enb=2, n_ue=3, n_ttis=40)
+    return dataclasses.replace(prog, **over) if over else prog
+
+
+def _trace_entries(prog: LteSmProgram, obs: bool = False):
+    """The cached-runner functions exactly as ``run_lte_sm`` jits them
+    (plain-XLA lowering), with concrete tiny operands."""
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+    from tpudes.parallel.runtime import replica_keys, stack_axis
+
+    consts, init_state, fn = build_sm_advance(
+        prog, r_pad=_TRACE_R, obs=obs, use_pallas=False
+    )
+    keys = replica_keys(jax.random.PRNGKey(0), _TRACE_R)
+    carry = stack_axis((jnp.int32(0), init_state()), _TRACE_R)
+    return [
+        TraceEntry("init", init_state, (), kernel=False),
+        TraceEntry(
+            "advance",
+            fn,
+            (carry, keys, jnp.int32(SM_SCHED_IDS[prog.scheduler]),
+             jnp.int32(8)),
+            donate=(0,),
+            carry=(0,),
+            traced={"sid": 2, "t_end": 3},
+        ),
+    ]
+
+
+def _trace_flips():
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import FlipSpec
+
+    base = _trace_prog()
+
+    def key_of(p):
+        return _sm_cache_key(p, _TRACE_R, None, False, False)
+
+    def flip(**over):
+        prog = dataclasses.replace(base, **over)
+        return FlipSpec(
+            build=lambda p=prog: _trace_entries(p),
+            key_differs=key_of(prog) != key_of(base),
+        )
+
+    return {
+        # live components: each must change some traced program
+        "n_rb": flip(n_rb=50),
+        "pf_alpha": flip(pf_alpha=0.25),
+        # the flip value must leave the degenerate regime: at the toy
+        # program's 30 dB dominance a thermal-scale noise change
+        # vanishes into the saturated MCS rows, so flip to an
+        # interference-scale value that moves the baked CQI/MI tables
+        "noise_psd": flip(noise_psd=1e-13),
+        "obs": FlipSpec(
+            build=lambda: _trace_entries(base, obs=True),
+            key_differs=True,
+        ),
+        # excluded-by-design fields must leave every trace identical:
+        # the scheduler id and the TTI horizon are traced operands
+        # (one executable serves all nine schedulers at every horizon)
+        "scheduler": flip(scheduler="rr"),
+        "n_ttis": flip(n_ttis=80),
+        "geom_stride": flip(geom_stride=8),
+    }
+
+
+def trace_manifest():
+    """Per-engine trace manifest (see :mod:`tpudes.analysis.jaxpr`).
+    The ``bf16`` variant arms the JXL002 accumulator check: every
+    reduction in the mixed-precision program must accumulate in f32
+    (the PR 6 precision policy)."""
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import TraceManifest, TraceVariant
+
+    return TraceManifest(
+        engine="lte_sm",
+        path="tpudes/parallel/lte_sm.py",
+        variants=lambda: [
+            TraceVariant(
+                "base", lambda: _trace_entries(_trace_prog())
+            ),
+            TraceVariant(
+                "bf16",
+                lambda: _trace_entries(
+                    dataclasses.replace(_trace_prog(), precision="bf16")
+                ),
+                bf16=True,
+            ),
+        ],
+        flips=_trace_flips,
+    )

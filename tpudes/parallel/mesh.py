@@ -118,7 +118,12 @@ def make_replica_batch(n_replicas: int, n_nodes: int, seed: int = 0, spread: flo
         k_pos, (n_nodes, 3), minval=0.0, maxval=spread
     ).at[:, 2].set(0.0)
     positions = jnp.broadcast_to(positions, (n_replicas, n_nodes, 3))
-    keys = jax.random.split(k_keys, n_replicas)
+    # fold_in-derived rows (runtime.replica_keys): replica r's key is
+    # independent of n_replicas, so growing the batch never reshuffles
+    # existing replicas' draws (KEY001; split(k, n) rows depend on n)
+    from tpudes.parallel.runtime import replica_keys
+
+    keys = replica_keys(k_keys, n_replicas)
     tx_active = jnp.zeros((n_replicas, n_nodes), dtype=bool).at[:, 0].set(True)
     mode_idx = jnp.zeros((n_replicas, n_nodes), dtype=jnp.int32)
     frame_bytes = jnp.full((n_replicas, n_nodes), 1000.0, dtype=jnp.float32)

@@ -654,7 +654,8 @@ def build_bss_step(
     def has_frame(s):
         sta_frame = (s["queue"] > 0) & ~is_ap[None, :]
         ap_frame = is_ap[None, :] & (
-            (s["bcn_pend"] > 0) | (jnp.sum(s["ap_pend"], axis=1) > 0)
+            (s["bcn_pend"] > 0)
+            | (jnp.sum(s["ap_pend"], axis=1, dtype=jnp.int32) > 0)
         )[:, None]
         return sta_frame | ap_frame
 
@@ -679,20 +680,25 @@ def build_bss_step(
         if AGG:
 
             def draw(kk):
+                # fixed-arity split of a fold_in-derived key: pure in
+                # (key, r, t), so bucketing/chunking stay bit-exact;
+                # draw dtypes pinned f32 (ambient x64 must not widen
+                # the streams — JXL002)
                 k_back, k_mpdu = jax.random.split(kk)
                 return (
-                    jax.random.uniform(k_back, (n,)),
-                    jax.random.uniform(k_mpdu, (n, K)),
+                    jax.random.uniform(k_back, (n,), jnp.float32),
+                    jax.random.uniform(k_mpdu, (n, K), jnp.float32),
                 )
 
             u_back, u_mpdu = jax.vmap(draw)(rkeys)
         else:
 
             def draw(kk):
+                # see above: fixed-arity split, f32-pinned draws
                 k_back, k_coin = jax.random.split(kk)
                 return (
-                    jax.random.uniform(k_back, (n,)),
-                    jax.random.uniform(k_coin, (n,)),
+                    jax.random.uniform(k_back, (n,), jnp.float32),
+                    jax.random.uniform(k_coin, (n,), jnp.float32),
                 )
 
             u_back, u_coin = jax.vmap(draw)(rkeys)
@@ -710,8 +716,12 @@ def build_bss_step(
         # ---------- arrival processing ----------
         is_arr = arrived[:, None] & (s["next_arr"] == next_t[:, None])
         new_queue = s["queue"] + jnp.where(is_arr & ~is_ap[None, :], 1, 0)
+        # int reductions pin dtype=jnp.int32: jnp.sum's numpy-style
+        # accumulator promotion would widen the carry to i64 under
+        # ambient x64 (JXL002); bit-exact no-op under the default config
         new_bcn = s["bcn_pend"] + jnp.sum(
-            jnp.where(is_arr & is_ap[None, :], 1, 0), axis=1
+            jnp.where(is_arr & is_ap[None, :], 1, 0), axis=1,
+            dtype=jnp.int32,
         )
         adv = jnp.where(
             s["next_arr"] >= INF, INF, s["next_arr"] + interval[None, :]
@@ -723,7 +733,10 @@ def build_bss_step(
         frame_after = jnp.where(is_arr & ~is_ap[None, :], new_queue > 0, frame)
         frame_after = jnp.where(
             is_arr & is_ap[None, :],
-            ((new_bcn > 0) | (jnp.sum(s["ap_pend"], 1) > 0))[:, None],
+            (
+                (new_bcn > 0)
+                | (jnp.sum(s["ap_pend"], 1, dtype=jnp.int32) > 0)
+            )[:, None],
             frame_after,
         )
         became_hol = is_arr & ~frame & frame_after
@@ -827,7 +840,11 @@ def build_bss_step(
             # (phy.mpdu_success_probs — equal shares → psr^(1/k))
             k_sta = jnp.minimum(s["queue"], K)
             k_ap = jnp.minimum(
-                jnp.sum(jnp.where(ed_1h, s["ap_pend"], 0), axis=1), K
+                jnp.sum(
+                    jnp.where(ed_1h, s["ap_pend"], 0), axis=1,
+                    dtype=jnp.int32,
+                ),
+                K,
             )[:, None]
             k_agg = jnp.maximum(
                 jnp.where(is_ap[None, :], k_ap, k_sta), 1
@@ -862,8 +879,8 @@ def build_bss_step(
         # ---- outcome updates (counts generalize the single-MPDU 0/1)
         sta_ok = jnp.where(~is_ap[None, :], n_ok, 0)
         ap_ok = jnp.where(is_ap[None, :], n_ok, 0)
-        new_srv = s["srv_rx"] + jnp.sum(sta_ok, axis=1)
-        got_echo = jnp.sum(ap_ok, axis=1)
+        new_srv = s["srv_rx"] + jnp.sum(sta_ok, axis=1, dtype=jnp.int32)
+        got_echo = jnp.sum(ap_ok, axis=1, dtype=jnp.int32)
         ed_i = ed_1h.astype(jnp.int32)      # dense scatter-free updates
         new_cli = s["cli_rx"] + ed_i * got_echo[:, None]
         new_queue = new_queue - sta_ok
@@ -877,9 +894,13 @@ def build_bss_step(
         # slightly later here — documented deviation)
         retry_exceeded = fail & (s["retries"] + 1 > RETRY_LIMIT)
         drop_n = jnp.where(retry_exceeded, k_agg, 0)
-        new_drops = s["drops"] + jnp.sum(drop_n, axis=1)
+        new_drops = s["drops"] + jnp.sum(
+            drop_n, axis=1, dtype=jnp.int32
+        )
         new_queue = new_queue - jnp.where(~is_ap[None, :], drop_n, 0)
-        drop_echo = jnp.sum(jnp.where(is_ap[None, :], drop_n, 0), axis=1)
+        drop_echo = jnp.sum(
+            jnp.where(is_ap[None, :], drop_n, 0), axis=1, dtype=jnp.int32
+        )
         new_ap_pend = new_ap_pend - ed_i * drop_echo[:, None]
         new_retries = jnp.where(
             success | retry_exceeded | beacon_tx,
@@ -917,7 +938,7 @@ def build_bss_step(
         )
 
         extra = (
-            {"retx": s["retx"] + jnp.sum(fail, axis=1).astype(jnp.int32)}
+            {"retx": s["retx"] + jnp.sum(fail, axis=1, dtype=jnp.int32)}
             if obs
             else {}
         )
@@ -938,7 +959,8 @@ def build_bss_step(
             busy_until=new_busy,
             srv_rx=new_srv,
             cli_rx=new_cli,
-            tx_data=s["tx_data"] + jnp.sum(data_tx, axis=1),
+            tx_data=s["tx_data"]
+            + jnp.sum(data_tx, axis=1, dtype=jnp.int32),
             drops=new_drops,
             step=s["step"] + 1,
         )
@@ -971,6 +993,51 @@ def _prog_cache_key(prog: BssProgram) -> tuple:
     return tuple(out)
 
 
+def build_bss_advance(prog: "BssProgram", replicas: int, obs: bool = False,
+                      n_cfg: int | None = None, geom_per_step: bool = False):
+    """``(init_state, pending, fn)`` with
+    ``fn(s, k, max_steps, sim_end, geom)`` the UNJITTED (but
+    config-vmapped) advance exactly as :func:`_compiled_bss_runner`
+    jits it — factored out so the trace manifest
+    (:func:`trace_manifest`) abstractly traces the same program the
+    runner cache compiles."""
+    init_state, pending, step_fn = build_bss_step(
+        prog, replicas, obs=obs, geom_per_step=geom_per_step
+    )
+
+    def advance(s, k, max_steps, sim_end, geom=None):
+        def cond(s):
+            return jnp.logical_and(
+                s["step"] < max_steps, jnp.any(pending(s, sim_end))
+            )
+
+        out = jax.lax.while_loop(
+            cond, lambda st: step_fn(st, k, sim_end, geom), s
+        )
+        # per-replica completion flags computed on-device so the
+        # caller needs no second compiled program (each extra host
+        # round trip costs ~90 ms over a tunneled TPU); a vector so
+        # padded replicas can be sliced off before the any().
+        # chunk metrics only under TpudesObs (obs is in the runner
+        # key) and as FRESH reductions only (drive_chunks's
+        # invariant: a carry leaf here would be deleted when the
+        # next chunk donates the carry)
+        metrics = (
+            dict(
+                srv_rx=jnp.sum(out["srv_rx"], dtype=jnp.int32),
+                drops=jnp.sum(out["drops"], dtype=jnp.int32),
+            )
+            if obs
+            else {}
+        )
+        return out, pending(out, sim_end), metrics
+
+    fn = advance
+    if n_cfg is not None:
+        fn = jax.vmap(fn, in_axes=(0, None, None, 0, None))
+    return init_state, pending, fn
+
+
 def _compiled_bss_runner(
     prog_key, prog, replicas, mesh, obs=False, n_cfg=None,
     geom_per_step=False,
@@ -998,40 +1065,10 @@ def _compiled_bss_runner(
     mobile = prog.mobility is not None
 
     def build():
-        init_state, pending, step_fn = build_bss_step(
-            prog, replicas, obs=obs, geom_per_step=geom_per_step
+        init_state, pending, fn = build_bss_advance(
+            prog, replicas, obs=obs, n_cfg=n_cfg,
+            geom_per_step=geom_per_step,
         )
-
-        def advance(s, k, max_steps, sim_end, geom=None):
-            def cond(s):
-                return jnp.logical_and(
-                    s["step"] < max_steps, jnp.any(pending(s, sim_end))
-                )
-
-            out = jax.lax.while_loop(
-                cond, lambda st: step_fn(st, k, sim_end, geom), s
-            )
-            # per-replica completion flags computed on-device so the
-            # caller needs no second compiled program (each extra host
-            # round trip costs ~90 ms over a tunneled TPU); a vector so
-            # padded replicas can be sliced off before the any().
-            # chunk metrics only under TpudesObs (obs is in the runner
-            # key) and as FRESH reductions only (drive_chunks's
-            # invariant: a carry leaf here would be deleted when the
-            # next chunk donates the carry)
-            metrics = (
-                dict(
-                    srv_rx=jnp.sum(out["srv_rx"]),
-                    drops=jnp.sum(out["drops"]),
-                )
-                if obs
-                else {}
-            )
-            return out, pending(out, sim_end), metrics
-
-        fn = advance
-        if n_cfg is not None:
-            fn = jax.vmap(fn, in_axes=(0, None, None, 0, None))
         run = jax.jit(fn, donate_argnums=donate_argnums(0))
         return init_state, pending, run
 
@@ -1265,3 +1302,88 @@ def run_replicated_bss(
 
     fut = EngineFuture("bss", fetch, finalize_with_flush(flush, finalize))
     return fut.result() if block else fut
+
+
+# --- trace manifest (tpudes.analysis.jaxpr) --------------------------------
+
+#: canonical tiny replica count for the abstract traces
+_TRACE_R = 2
+
+
+def _trace_prog(**over):
+    """Canonical tiny-shape program: AP + 2 STAs on the sensing circle."""
+    import dataclasses
+
+    from tpudes.parallel.programs import toy_bss_program
+
+    prog = toy_bss_program(n_sta=2, sim_end_us=20_000)
+    return dataclasses.replace(prog, **over) if over else prog
+
+
+def _trace_entries(prog: "BssProgram", obs: bool = False):
+    """The cached-runner functions exactly as ``run_replicated_bss``
+    jits them, with concrete tiny operands."""
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+
+    init_state, pending, fn = build_bss_advance(
+        prog, _TRACE_R, obs=obs
+    )
+    key = jax.random.PRNGKey(0)
+    s0 = init_state()
+    return [
+        TraceEntry("init", init_state, (), kernel=False),
+        TraceEntry(
+            "advance",
+            fn,
+            (s0, key, jnp.int32(64), jnp.int32(prog.sim_end_us), None),
+            donate=(0,),
+            carry=(0,),
+            traced={"max_steps": 2, "sim_end": 3},
+        ),
+    ]
+
+
+def _trace_flips():
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import FlipSpec
+
+    base = _trace_prog()
+
+    def flip(**over):
+        prog = dataclasses.replace(base, **over)
+        return FlipSpec(
+            build=lambda p=prog: _trace_entries(p),
+            key_differs=_prog_cache_key(prog) != _prog_cache_key(base),
+        )
+
+    return {
+        # live components: each must change some traced program
+        "data_bytes": flip(data_bytes=600),
+        "beacon_bytes": flip(beacon_bytes=100),
+        "obs": FlipSpec(
+            build=lambda: _trace_entries(base, obs=True),
+            key_differs=True,
+        ),
+        # excluded-by-design fields must leave every trace identical:
+        # the horizon is a traced operand (one executable per program
+        # across every sim_end / step budget)
+        "sim_end_us": flip(sim_end_us=40_000),
+        "geom_stride": flip(geom_stride=4),
+    }
+
+
+def trace_manifest():
+    """Per-engine trace manifest (see :mod:`tpudes.analysis.jaxpr`)."""
+    from tpudes.analysis.jaxpr.spec import TraceManifest, TraceVariant
+
+    return TraceManifest(
+        engine="bss",
+        path="tpudes/parallel/replicated.py",
+        variants=lambda: [
+            TraceVariant(
+                "base", lambda: _trace_entries(_trace_prog())
+            )
+        ],
+        flips=_trace_flips,
+    )

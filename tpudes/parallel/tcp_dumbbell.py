@@ -574,14 +574,18 @@ def _cwnd_increase(var, cwnd, ssthresh, acked, t_s, rtt_s, st,
     inc = jnp.where((var == V_LP) & in_infer, 0.0, inc)
     # TCP-LP's inference collapse holds at ONE segment (host behavior);
     # every other variant keeps the usual 2-segment floor
-    floor = jnp.where((var == V_LP) & in_infer, 1.0, 2.0)
+    floor = jnp.where(
+        (var == V_LP) & in_infer, jnp.float32(1.0), jnp.float32(2.0)
+    )
     new_cwnd = jnp.maximum(cwnd + jnp.where(a > 0, inc, 0.0), floor)
 
     # BBR replaces loss-driven AIMD entirely: cwnd tracks gain × BDP
     gain = jnp.select(
         [state == BBR_STARTUP, state == BBR_DRAIN],
         [BBR_HIGH_GAIN, 1.0 / BBR_HIGH_GAIN],
-        jnp.asarray(BBR_CYCLE_GAINS)[bbr_cycle],
+        # dtype pinned: an unpinned float table would ride f64 through
+        # the whole BBR lane under ambient x64 (JXL002)
+        jnp.asarray(BBR_CYCLE_GAINS, jnp.float32)[bbr_cycle],
     )
     bdp = bbr_bw * min_rtt
     target = jnp.maximum(gain * bdp, 4.0)
@@ -730,9 +734,11 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
     start = jnp.asarray(prog.start_slot)
     stop = jnp.asarray(prog.stop_slot)
     max_pkts = jnp.asarray(prog.max_pkts)
-    slot_s = prog.slot_s
+    # a strong f32 scalar: `t * slot_s` must stay f32 under ambient
+    # x64 (an unpinned python float would promote the i32 clock to f64)
+    slot_s = jnp.float32(prog.slot_s)
     base_rtt = jnp.float32(prog.base_rtt_s)
-    rtt_slots = max(1, int(round(prog.base_rtt_s / slot_s)))
+    rtt_slots = max(1, int(round(prog.base_rtt_s / prog.slot_s)))
     Q = prog.queue_cap
     burst = prog.burst_cap
     RED = prog.qdisc == "red"
@@ -748,6 +754,8 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
             if obs
             else {}
         )
+        # every fill dtype pinned f32: an unpinned python-float fill
+        # would widen the whole carry under ambient x64 (JXL002)
         return dict(
             **extra,
             cwnd=jnp.full((R, F), INIT_CWND, jnp.float32),
@@ -767,22 +775,26 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
             dctcp_acked=z(R, F),
             dctcp_marked=z(R, F),
             side=dict(
-                w_max=z(R, F), epoch_t=jnp.full((R, F), -1.0), k=z(R, F),
+                w_max=z(R, F),
+                epoch_t=jnp.full((R, F), -1.0, jnp.float32),
+                k=z(R, F),
                 origin=z(R, F), w_est=z(R, F),
                 base_rtt=jnp.broadcast_to(base_rtt, (R, F)),
                 last_diff=z(R, F),
-                min_rtt=jnp.full((R, F), jnp.inf),
+                min_rtt=jnp.full((R, F), jnp.inf, jnp.float32),
                 ww_acc=z(R, F), bwe=z(R, F),
                 ill_max_rtt=z(R, F),
-                ill_alpha=jnp.full((R, F), ILL_ALPHA_MAX),
-                ill_beta=jnp.full((R, F), ILL_BETA_MIN),
+                ill_alpha=jnp.full((R, F), ILL_ALPHA_MAX, jnp.float32),
+                ill_beta=jnp.full((R, F), ILL_BETA_MIN, jnp.float32),
                 bbr_acc=z(R, F), bbr_bw=z(R, F), bbr_full_bw=z(R, F),
                 bbr_full_cnt=z(R, F),
                 bbr_state=z(R, F, dt=jnp.int32),
                 bbr_cycle=z(R, F, dt=jnp.int32),
                 cwnd_cnt=z(R, F),
-                dctcp_alpha=jnp.ones((R, F)),
-                htcp_beta=jnp.full((R, F), HTCP_DEFAULT_BACKOFF),
+                dctcp_alpha=jnp.ones((R, F), jnp.float32),
+                htcp_beta=jnp.full(
+                    (R, F), HTCP_DEFAULT_BACKOFF, jnp.float32
+                ),
                 htcp_last_cong=z(R, F),
                 lp_until=z(R, F),
             ),
@@ -800,16 +812,22 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
         if RED:
 
             def draw(kk):
+                # fixed-arity split of a fold_in-derived key: pure in
+                # (key, t, r), so bucketing/chunking stay bit-exact;
+                # draw dtypes pinned f32 (ambient x64 must not widen
+                # the streams — JXL002)
                 k_dep, k_red, k_mark = jax.random.split(kk, 3)
                 return (
-                    jax.random.uniform(k_dep, ()),
-                    jax.random.uniform(k_red, (F,)),
-                    jax.random.uniform(k_mark, ()),
+                    jax.random.uniform(k_dep, (), jnp.float32),
+                    jax.random.uniform(k_red, (F,), jnp.float32),
+                    jax.random.uniform(k_mark, (), jnp.float32),
                 )
 
             u_dep, u_red, u_mark = jax.vmap(draw)(rkeys)
         else:
-            u_dep = jax.vmap(lambda kk: jax.random.uniform(kk, ()))(rkeys)
+            u_dep = jax.vmap(
+                lambda kk: jax.random.uniform(kk, (), jnp.float32)
+            )(rkeys)
 
         # 1. consume this slot's ack / loss / ECN-echo arrivals
         acks = s["ack_buf"][:, idx, :]
@@ -861,9 +879,12 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
 
         # 3. departure: serve one packet, flow ∝ queue occupancy
         q = s["q"]
-        qtot = q.sum(axis=1)
+        # int reductions pin dtype=jnp.int32: an unpinned .sum()
+        # widens to i64 under ambient x64 (JXL002); bit-exact
+        # no-op under the default config
+        qtot = q.sum(axis=1, dtype=jnp.int32)
         backlogged = qtot > 0
-        cum = jnp.cumsum(q, axis=1)
+        cum = jnp.cumsum(q, axis=1, dtype=jnp.int32)
         thresh = (u_dep * qtot.astype(jnp.float32)).astype(jnp.int32)
         dep = jnp.argmax(cum > thresh[:, None], axis=1)  # (R,)
         dep_oh = jax.nn.one_hot(dep, F, dtype=jnp.int32) * backlogged[
@@ -907,11 +928,13 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
             # queue (per-arrival updates folded into one (1-qw)^n step;
             # idle-time decay not modeled — the bottleneck is backlogged
             # in every regime this engine targets)
-            qnow = q.sum(axis=1).astype(jnp.float32)
-            n_arr = want.sum(axis=1)
+            qnow = q.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
+            n_arr = want.sum(axis=1, dtype=jnp.int32)
             red_avg = jnp.where(
                 n_arr > 0,
-                qnow + (red_avg - qnow) * (1.0 - prog.red_qw) ** n_arr,
+                qnow
+                + (red_avg - qnow)
+                * jnp.float32(1.0 - prog.red_qw) ** n_arr,
                 red_avg,
             )
             p = jnp.where(
@@ -949,8 +972,8 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
             want_q = want - red_drops
         else:
             want_q = want
-        wtot = want_q.sum(axis=1)
-        free = jnp.maximum(Q - q.sum(axis=1), 0)
+        wtot = want_q.sum(axis=1, dtype=jnp.int32)
+        free = jnp.maximum(Q - q.sum(axis=1, dtype=jnp.int32), 0)
         # proportional admission with largest-remainder rounding
         scale = jnp.minimum(
             free.astype(jnp.float32) / jnp.maximum(wtot, 1).astype(jnp.float32),
@@ -959,7 +982,10 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
         exact = want_q.astype(jnp.float32) * scale[:, None]
         acc = jnp.floor(exact).astype(jnp.int32)
         rem = exact - acc
-        leftover = jnp.minimum(free - acc.sum(axis=1), wtot - acc.sum(axis=1))
+        leftover = jnp.minimum(
+            free - acc.sum(axis=1, dtype=jnp.int32),
+            wtot - acc.sum(axis=1, dtype=jnp.int32),
+        )
         order = jnp.argsort(-rem, axis=1)
         rank = jnp.argsort(order, axis=1)
         acc = acc + (
@@ -1002,6 +1028,76 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
         ), None
 
     return init_state, step_fn
+
+
+#: the RED/AQM knobs — cache-key components only when the qdisc is
+#: actually "red" (see dumbbell_prog_key)
+_RED_FIELDS = (
+    "red_min_th", "red_max_th", "red_max_p", "red_qw", "red_gentle",
+    "red_use_ecn", "red_use_hard_drop",
+)
+
+
+def dumbbell_prog_key(prog: DumbbellProgram) -> tuple:
+    """Hashable identity of the DumbbellProgram fields that shape the
+    compiled program.  ``n_slots``, ``variant_idx`` and ``ecn`` are
+    deliberately ABSENT: the horizon is a traced while_loop bound and
+    the variant/ECN assignment a traced operand, so one executable
+    serves every horizon AND every variant assignment.  In fifo mode
+    the ``red_*`` parameters are absent too — they never reach the
+    fifo program (keying on them was a dead cache-key component
+    causing spurious recompiles across RED-parameter sweeps of
+    non-RED studies; found by analysis rule JXL004)."""
+    skip = {"n_slots", "variant_idx", "ecn"}
+    if prog.qdisc != "red":
+        skip.update(_RED_FIELDS)
+    return tuple(
+        v.tobytes() if isinstance(v, np.ndarray) else v
+        for k, v in prog.__dict__.items()
+        if k not in skip
+    )
+
+
+def build_dumbbell_advance(prog: DumbbellProgram, r_pad: int,
+                           obs: bool = False, n_cfg: int | None = None):
+    """``(init_state, fn)`` with ``fn(carry, key, var, ecn, t_end)``
+    the UNJITTED advance exactly as :func:`run_tcp_dumbbell` jits it —
+    factored out so the trace manifest (:func:`trace_manifest`)
+    abstractly traces the same program the runner cache compiles."""
+    init_state, step_fn = build_dumbbell_step(prog, r_pad, obs=obs)
+
+    def advance(carry, key, var, ecn, t_end):
+        # per-slot key = fold_in(key, t): pure in (key, t), so the
+        # traced horizon needs no split-keys array shape and a
+        # chunked run re-enters at t>0 on the same slot streams
+        def body(c):
+            t, s = c
+            s, _ = step_fn(
+                s, (t, jax.random.fold_in(key, t)), var, ecn
+            )
+            return t + 1, s
+
+        t, s = jax.lax.while_loop(
+            lambda c: c[0] < t_end, body, carry
+        )
+        # chunk summaries only under TpudesObs (obs is in the
+        # cache key): a disabled run compiles the pre-obs program
+        metrics = (
+            dict(
+                delivered=jnp.sum(
+                    s["delivered"], axis=-1, dtype=jnp.int32
+                ),
+                drops=jnp.sum(s["drops"], axis=-1, dtype=jnp.int32),
+            )
+            if obs
+            else {}
+        )
+        return (t, s), metrics
+
+    fn = advance
+    if n_cfg is not None:
+        fn = jax.vmap(fn, in_axes=(0, None, 0, 0, None))
+    return init_state, fn
 
 
 def _variant_point(entry) -> np.ndarray:
@@ -1188,50 +1284,14 @@ def run_tcp_dumbbell(
     obs = device_metrics_enabled()
     r_pad = bucket_replicas(replicas, mesh)
     n_cfg = None if variants is None else len(variants)
-    # n_slots, variant_idx and ecn are deliberately ABSENT from the
-    # key: the horizon is a traced while_loop bound and the variant/ECN
-    # assignment a traced operand, so one executable serves every
-    # horizon AND every variant assignment
-    ck = tuple(
-        v.tobytes() if isinstance(v, np.ndarray) else v
-        for k, v in prog.__dict__.items()
-        if k not in ("n_slots", "variant_idx", "ecn")
-    ) + (r_pad, obs, n_cfg)
+    # see dumbbell_prog_key for what is (deliberately) absent
+    ck = dumbbell_prog_key(prog) + (r_pad, obs, n_cfg)
 
     def build():
-        init_state, step_fn = build_dumbbell_step(prog, r_pad, obs=obs)
-
-        def advance(carry, key, var, ecn, t_end):
-            # per-slot key = fold_in(key, t): pure in (key, t), so the
-            # traced horizon needs no split-keys array shape and a
-            # chunked run re-enters at t>0 on the same slot streams
-            def body(c):
-                t, s = c
-                s, _ = step_fn(
-                    s, (t, jax.random.fold_in(key, t)), var, ecn
-                )
-                return t + 1, s
-
-            t, s = jax.lax.while_loop(
-                lambda c: c[0] < t_end, body, carry
-            )
-            # chunk summaries only under TpudesObs (obs is in the
-            # cache key): a disabled run compiles the pre-obs program
-            metrics = (
-                dict(
-                    delivered=jnp.sum(s["delivered"], axis=-1),
-                    drops=jnp.sum(s["drops"], axis=-1),
-                )
-                if obs
-                else {}
-            )
-            return (t, s), metrics
-
-        fn = advance
-        if n_cfg is not None:
-            fn = jax.vmap(fn, in_axes=(0, None, 0, 0, None))
-        fn = jax.jit(fn, donate_argnums=donate_argnums(0))
-        return init_state, fn
+        init_state, fn = build_dumbbell_advance(
+            prog, r_pad, obs=obs, n_cfg=n_cfg
+        )
+        return init_state, jax.jit(fn, donate_argnums=donate_argnums(0))
 
     (init_state, fn), compiling = RUNTIME.runner("dumbbell", ck, build)
 
@@ -1286,3 +1346,93 @@ def run_tcp_dumbbell(
         finalize = _planted_divergence(finalize)
     fut = EngineFuture("dumbbell", fetch, finalize)
     return fut.result() if block else fut
+
+
+# --- trace manifest (tpudes.analysis.jaxpr) --------------------------------
+
+#: canonical tiny replica count for the abstract traces
+_TRACE_R = 2
+
+
+def _trace_prog(**over):
+    """Canonical tiny-shape program: 2 flows, short horizon."""
+    import dataclasses
+
+    from tpudes.parallel.programs import toy_dumbbell_program
+
+    prog = toy_dumbbell_program(n_flows=2, n_slots=30)
+    return dataclasses.replace(prog, **over) if over else prog
+
+
+def _trace_entries(prog: DumbbellProgram, obs: bool = False):
+    """The cached-runner functions exactly as ``run_tcp_dumbbell`` jits
+    them, with concrete tiny operands."""
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+
+    init_state, fn = build_dumbbell_advance(prog, _TRACE_R, obs=obs)
+    key = jax.random.PRNGKey(0)
+    var = jnp.asarray(prog.variant_idx, jnp.int32)
+    ecn = jnp.asarray(_variant_ecn(np.asarray(prog.variant_idx)))
+    carry = (jnp.int32(0), init_state())
+    return [
+        TraceEntry("init", init_state, (), kernel=False),
+        TraceEntry(
+            "advance",
+            fn,
+            (carry, key, var, ecn, jnp.int32(8)),
+            donate=(0,),
+            carry=(0,),
+            traced={"var": 2, "ecn": 3, "t_end": 4},
+        ),
+    ]
+
+
+def _trace_flips():
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import FlipSpec
+
+    base = _trace_prog()
+
+    def flip(**over):
+        prog = dataclasses.replace(base, **over)
+        return FlipSpec(
+            build=lambda p=prog: _trace_entries(p),
+            key_differs=dumbbell_prog_key(prog) != dumbbell_prog_key(base),
+        )
+
+    return {
+        # live components: each must change some traced program
+        "queue_cap": flip(queue_cap=13),
+        "ack_lag": flip(ack_lag=7),
+        "qdisc": flip(qdisc="red"),
+        "obs": FlipSpec(
+            build=lambda: _trace_entries(base, obs=True),
+            key_differs=True,
+        ),
+        # excluded-by-design fields must leave every trace identical:
+        # the horizon/variant assignment are traced operands, and in
+        # fifo mode the RED knobs never reach the program (the JXL004-
+        # found dead components)
+        "n_slots": flip(n_slots=60),
+        "variant_idx": flip(
+            variant_idx=np.asarray([3, 5], np.int32)
+        ),
+        "red_qw": flip(red_qw=0.5),
+    }
+
+
+def trace_manifest():
+    """Per-engine trace manifest (see :mod:`tpudes.analysis.jaxpr`)."""
+    from tpudes.analysis.jaxpr.spec import TraceManifest, TraceVariant
+
+    return TraceManifest(
+        engine="dumbbell",
+        path="tpudes/parallel/tcp_dumbbell.py",
+        variants=lambda: [
+            TraceVariant(
+                "base", lambda: _trace_entries(_trace_prog())
+            )
+        ],
+        flips=_trace_flips,
+    )

@@ -62,6 +62,8 @@ __all__ = [
     "partition_lookahead",
     "run_wired",
     "run_wired_host",
+    "trace_manifest",
+    "wired_cache_key",
     "wired_chain",
     "wired_weak_chain",
 ]
@@ -491,6 +493,10 @@ def _make_lane_step(P: int, Lo: int):
     pid = jnp.arange(P, dtype=jnp.int32)
     lid = jnp.arange(Lo, dtype=jnp.int32)
 
+    # every int reduction pins dtype=jnp.int32: jnp.sum follows numpy's
+    # sub-default-int accumulator promotion, so an unpinned .sum() would
+    # widen the whole carry to i64 under ambient x64 (JXL002) — under
+    # the default config the pin is a bit-exact no-op
     def locate(tbl, hop):
         """(oh, on_owned, lseg, lane_oh) of each packet's CURRENT hop:
         whether it sits at a link this lane serves, and the (Lo, P)
@@ -498,13 +504,14 @@ def _make_lane_step(P: int, Lo: int):
         (their hop value matches no ``colh`` column)."""
         oh = hop[:, None] == tbl["colh"][None, :]   # (P, Hl)
         on_owned = (tbl["powned"] & oh).any(1)
-        lseg = (tbl["plseg"] * oh).sum(1)         # junk 0 unless owned
+        # junk 0 unless owned
+        lseg = (tbl["plseg"] * oh).sum(1, dtype=jnp.int32)
         lane_oh = (lseg[None, :] == lid[:, None]) & on_owned[None, :]
         return oh, on_owned, lseg, lane_oh
 
     def _next_min(on_owned, lseg, ready, free):
         lane_oh = (lseg[None, :] == lid[:, None]) & on_owned[None, :]
-        free_p = (free[:, None] * lane_oh).sum(0)
+        free_p = (free[:, None] * lane_oh).sum(0, dtype=jnp.int32)
         return jnp.min(jnp.where(
             on_owned, jnp.maximum(ready, free_p), INF_SLOT
         ))
@@ -521,17 +528,17 @@ def _make_lane_step(P: int, Lo: int):
         # FIFO head per link: lexicographic (arrival slot, packet id)
         # via two masked mins — int32-safe (no ready*P key to overflow)
         m_ready = jnp.where(at_link, ready[None, :], INF_SLOT).min(axis=1)
-        m_ready_p = (m_ready[:, None] * lane_oh).sum(0)
+        m_ready_p = (m_ready[:, None] * lane_oh).sum(0, dtype=jnp.int32)
         cand = waiting & (ready == m_ready_p)
         m_pid = jnp.where(
             at_link & cand[None, :], pid[None, :], INF_SLOT
         ).min(axis=1)
-        m_pid_p = (m_pid[:, None] * lane_oh).sum(0)
+        m_pid_p = (m_pid[:, None] * lane_oh).sum(0, dtype=jnp.int32)
         link_can = (free <= t) & (m_ready < INF_SLOT)   # (Lo,)
         link_can_p = (link_can[:, None] & lane_oh).any(0)
         serve = cand & (pid == m_pid_p) & link_can_p
 
-        arr = t + (tbl["psvcdly"] * oh).sum(1)    # (P,) valid if served
+        arr = t + (tbl["psvcdly"] * oh).sum(1, dtype=jnp.int32)  # (P,)
         new_hop = hop + 1
         oh2 = new_hop[:, None] == tbl["colh"][None, :]
         has_next = new_hop < tbl["pkt_nhops"]
@@ -553,7 +560,9 @@ def _make_lane_step(P: int, Lo: int):
         # owned-ness/row were already computed above (``oh2``) — reuse
         # them instead of paying a second full locate()
         on_owned2 = jnp.where(serve, has_next & next_owned, on_owned)
-        lseg2 = jnp.where(serve, (tbl["plseg"] * oh2).sum(1), lseg)
+        lseg2 = jnp.where(
+            serve, (tbl["plseg"] * oh2).sum(1, dtype=jnp.int32), lseg
+        )
         nxt = _next_min(on_owned2, lseg2, ready, free)
         return (hop, ready, free, deliver, eg_hop, eg_ready, served), nxt
 
@@ -846,6 +855,31 @@ def build_wired_space_advance(prog: WiredProgram, replicas: int):
     return init_state, advance, parts
 
 
+def wired_cache_key(prog: WiredProgram, keep_owner: bool = False) -> tuple:
+    """Hashable identity of the WiredProgram fields that shape the
+    compiled kernel (and its cached ``init_state`` closure).
+
+    ``n_slots`` is absent — the grant is a traced while_loop bound, so
+    one executable serves every horizon and window schedule.
+    ``slot_s`` is absent — it is a reporting-only scale factor that
+    never reaches the device (keying on it was a dead cache-key
+    component causing spurious recompiles; found by analysis rule
+    JXL004).  ``link_owner`` is absent unless ``keep_owner``: it is
+    partition METADATA that plain ``run_wired`` and the per-rank
+    hybrid engines never read (their served-link set arrives as the
+    explicit ``owned`` mask, already keyed separately) — only the
+    space-lanes kernel, which derives its whole lane structure from
+    the ownership map, keys on it."""
+    skip = {"n_slots", "slot_s"}
+    if not keep_owner:
+        skip.add("link_owner")
+    return tuple(
+        v.tobytes() if isinstance(v, np.ndarray) else v
+        for k, v in prog.__dict__.items()
+        if k not in skip
+    )
+
+
 def _wired_unpack(host: dict, prog: WiredProgram, replicas: int) -> dict:
     """Host-side result assembly (slice padded replicas back)."""
     R = int(replicas)
@@ -905,14 +939,9 @@ def run_wired(
     )
 
     r_pad = bucket_replicas(replicas, mesh)
-    # n_slots is absent: the grant is a traced while_loop bound, so one
-    # executable serves every horizon and every window schedule;
+    # see wired_cache_key for what is (deliberately) absent;
     # replica_offset only shifts host-side init-state construction
-    ck = tuple(
-        v.tobytes() if isinstance(v, np.ndarray) else v
-        for k, v in prog.__dict__.items()
-        if k != "n_slots"
-    ) + (r_pad,)
+    ck = wired_cache_key(prog) + (r_pad,)
 
     def build():
         init_state, advance = build_wired_advance(prog, r_pad)
@@ -1017,3 +1046,105 @@ def run_wired_host(prog: WiredProgram, jitter: np.ndarray | None = None) -> dict
     impl.Stop(horizon + int(svc.max()) + int(dly.max()) + 2)
     impl.Run()
     return dict(deliver_slot=deliver, served=served)
+
+
+# --- trace manifest (tpudes.analysis.jaxpr) --------------------------------
+
+#: canonical tiny replica count for the abstract traces
+_TRACE_R = 2
+
+
+def _trace_prog(**over):
+    """Canonical tiny-shape program: 3-link chain, 2 flows, jittered so
+    the per-replica ``fold_in`` draw path is part of the traced
+    surface.  ``over`` applies single-field flips."""
+    import dataclasses
+
+    prog = wired_chain(
+        n_links=3, n_flows=2, n_slots=40, jitter_slots=2
+    )
+    return dataclasses.replace(prog, **over) if over else prog
+
+
+def _trace_entries(prog: WiredProgram):
+    """The two cached-runner functions exactly as ``run_wired`` jits
+    them, with concrete tiny operands."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+
+    init_state, advance = build_wired_advance(prog, _TRACE_R)
+    key = jax.random.PRNGKey(0)
+    carry = init_state(key)
+    P = int(carry["hop"].shape[1])
+    no_ing = jnp.full((_TRACE_R, P), -1, jnp.int32)
+    return [
+        TraceEntry(
+            "init", lambda k: init_state(k, 0), (key,), kernel=False
+        ),
+        TraceEntry(
+            "advance",
+            advance,
+            (carry, no_ing, no_ing, jnp.int32(8)),
+            donate=(0,),
+            carry=(0,),
+            traced={"ing_hop": 1, "ing_ready": 2, "t_grant": 3},
+        ),
+    ]
+
+
+def _trace_flips():
+    """Single-field program variations for the JXL004 cache-key-hygiene
+    check; ``key_differs`` comes from :func:`wired_cache_key` itself,
+    so the manifest cannot drift from the real runner key."""
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import FlipSpec
+
+    base = _trace_prog()
+
+    def flip(**over):
+        prog = dataclasses.replace(base, **over)
+        return FlipSpec(
+            build=lambda p=prog: _trace_entries(p),
+            key_differs=wired_cache_key(prog) != wired_cache_key(base),
+        )
+
+    return {
+        # live components: each must change some traced program
+        "jitter_slots": flip(jitter_slots=0),
+        "service_slots": flip(
+            service_slots=np.asarray([2, 2, 1], np.int32)
+        ),
+        "period_slots": flip(
+            period_slots=np.asarray([7, 9], np.int32)
+        ),
+        # excluded-by-design fields: each must leave every trace
+        # identical (slot_s/link_owner were the JXL004-found dead
+        # components; n_slots is the traced-horizon contract)
+        "slot_s": flip(slot_s=0.5),
+        "link_owner": flip(
+            link_owner=np.asarray([0, 1, 1], np.int32)
+        ),
+        "n_slots": flip(n_slots=80),
+    }
+
+
+def trace_manifest():
+    """Per-engine trace manifest (see :mod:`tpudes.analysis.jaxpr`):
+    the no-gather contract is armed — the step kernel must stay one-hot
+    masked-reduction forms only."""
+    from tpudes.analysis.jaxpr.spec import TraceManifest, TraceVariant
+
+    return TraceManifest(
+        engine="wired",
+        path="tpudes/parallel/wired.py",
+        no_gather=True,
+        variants=lambda: [
+            TraceVariant(
+                "base", lambda: _trace_entries(_trace_prog())
+            )
+        ],
+        flips=_trace_flips,
+    )
