@@ -708,12 +708,22 @@ def bench_serving_closed_loop(smoke: bool = False):
     The study shape is deliberately SMALL: this row measures the
     serving layer's per-launch amortization, not engine compute — on
     accelerators the fixed launch+transfer overhead it amortizes is
-    larger still."""
+    larger still.
+
+    ISSUE-13 columns: a THIRD phase re-runs the closed loop under a
+    seed-keyed chaos schedule (launch-shaped errors recovered by the
+    requeue/retry path) with clients split across SLO classes (gold /
+    standard).  ``degraded_speedup`` is that run against the same
+    serialized baseline — the acceptance target is >= 1.5x (the fleet
+    absorbs injected failures without falling back to serialized
+    throughput) with bounded gold p99; the failure counters and
+    per-class SLO attainment ride the row."""
     import dataclasses
     import threading
 
     import jax
 
+    import tpudes.chaos as chaos
     from tpudes.obs.serving import ServingTelemetry
     from tpudes.parallel.programs import toy_dumbbell_program
     from tpudes.parallel.runtime import RUNTIME
@@ -751,35 +761,59 @@ def bench_serving_closed_loop(smoke: bool = False):
         f.result()
     wall_serial = time.monotonic() - t0
 
+    def closed_loop(slo_of=None):
+        """One closed-loop pool run; returns (wall_s, metrics)."""
+        ServingTelemetry.reset()
+        server = StudyServer(
+            max_wait_s=SERVING_MAX_WAIT_S,
+            max_batch=SERVING_MAX_BATCH,
+            retry_backoff_s=0.002,
+            warm=[dict(engine="dumbbell", prog=stream[0], key=key,
+                       replicas=SERVING_REPLICAS)],
+        )
+
+        def client(c):
+            for j in range(per_client):
+                h = server.submit_study(
+                    "dumbbell", stream[c * per_client + j], key,
+                    SERVING_REPLICAS, tenant=f"tenant{c}",
+                    slo=slo_of(c) if slo_of else "standard",
+                )
+                h.result(timeout=300)
+
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(target=client, args=(c,))
+            for c in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        metrics = server.metrics()
+        server.close()
+        return wall, metrics
+
     # --- coalesced serving: closed-loop client pool ----------------------
-    ServingTelemetry.reset()
-    server = StudyServer(
-        max_wait_s=SERVING_MAX_WAIT_S,
-        max_batch=SERVING_MAX_BATCH,
-        warm=[dict(engine="dumbbell", prog=stream[0], key=key,
-                   replicas=SERVING_REPLICAS)],
-    )
+    wall_served, metrics = closed_loop()
 
-    def client(c):
-        for j in range(per_client):
-            h = server.submit_study(
-                "dumbbell", stream[c * per_client + j], key,
-                SERVING_REPLICAS, tenant=f"tenant{c}",
-            )
-            h.result(timeout=300)
-
-    t0 = time.monotonic()
-    threads = [
-        threading.Thread(target=client, args=(c,))
-        for c in range(n_clients)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall_served = time.monotonic() - t0
-    metrics = server.metrics()
-    server.close()
+    # --- degraded: same pool under injected failures + SLO classes -------
+    # (ISSUE-13) a seed-keyed schedule plants launch-shaped errors on
+    # early dispatches; every affected batch recovers via requeue/retry
+    chaos.arm(chaos.ChaosSchedule([
+        chaos.ChaosEvent("launch_error", "local_launch", nth=n)
+        for n in (2, 5, 9)
+    ]))
+    try:
+        wall_degraded, m_deg = closed_loop(
+            slo_of=lambda c: "gold" if c < max(1, n_clients // 4)
+            else "standard"
+        )
+    finally:
+        chaos.disarm()
+    fail = m_deg["failures"]
+    slo = m_deg["slo"]
 
     eng = metrics["engines"]["dumbbell"]
     return dict(
@@ -796,6 +830,19 @@ def bench_serving_closed_loop(smoke: bool = False):
         latency_p50_ms=round(eng["study_latency_s"]["p50"] * 1e3, 2),
         latency_p99_ms=round(eng["study_latency_s"]["p99"] * 1e3, 2),
         launch_p99_ms=round(eng["launch_wall_s"]["p99"] * 1e3, 2),
+        # --- ISSUE-13: failure-injection + SLO-attainment columns -------
+        injected_failures=fail["injected_failures"],
+        requeued_studies=fail["requeued_studies"],
+        retry_budget_exhausted=fail["retry_budget_exhausted"],
+        rps_degraded=round(total / wall_degraded, 1),
+        degraded_speedup=round(wall_serial / wall_degraded, 3),  # >= 1.5
+        slo_attainment={
+            name: s["attainment"] for name, s in slo.items()
+        },
+        gold_p99_ms=round(
+            slo.get("gold", {}).get("latency_s", {}).get("p99", 0.0)
+            * 1e3, 2,
+        ),
     )
 
 

@@ -948,3 +948,53 @@ def test_key_stdlib_random_is_not_a_key_draw():
     assert _codes(
         src, path="tpudes/parallel/fixture.py", select=["KEY"]
     ) == []
+
+
+# --- serving-liveness (SRV) ------------------------------------------------
+
+def test_srv_bare_blocking_waits_flagged_in_serving():
+    src = """
+    def demux(self, conn, q):
+        self._cond.wait()
+        item = q.get()
+        blob = conn.recv_bytes()
+        msg = conn.recv()
+        return item, blob, msg
+    """
+    assert _codes(
+        src, path="tpudes/serving/fixture.py", select=["SRV"]
+    ) == ["SRV001"] * 4
+
+
+def test_srv_bounded_and_disambiguated_calls_clean():
+    src = """
+    def demux(self, conn, q, timeout):
+        self._cond.wait(timeout=0.05)
+        self._ev.wait(timeout)
+        item = q.get(timeout=1.0)
+        default = self._map.get("key")
+        if conn.poll(0.5):
+            blob = conn.recv_bytes()  # tpudes: ignore[SRV001]
+        return item, default
+    """
+    assert _codes(
+        src, path="tpudes/serving/fixture.py", select=["SRV"]
+    ) == []
+
+
+def test_srv_scope_is_serving_and_procmesh_only():
+    src = """
+    def drain(self, conn):
+        return conn.recv_bytes()
+    """
+    # same shape outside the scoped paths: host DES code is not flagged
+    assert _codes(
+        src, path="tpudes/models/fixture.py", select=["SRV"]
+    ) == []
+    assert _codes(
+        src, path="tpudes/parallel/mpi.py", select=["SRV"]
+    ) == []
+    # but procmesh.py IS in scope
+    assert _codes(
+        src, path="tpudes/parallel/procmesh.py", select=["SRV"]
+    ) == ["SRV001"]

@@ -1,0 +1,185 @@
+"""ISSUE 13 gates: deterministic chaos injection + replay.
+
+- Schedules are pure functions of their seed (same seed → same
+  events), events fire at exact per-(site, member) ordinals, at most
+  once, and invalid site/kind combinations are refused at build.
+- Wire injection produces deterministic WireFormatError shapes (never
+  silent garbage reaching the unpickler).
+- The canonical replay drill recovers every study bit-equal and its
+  failure/recovery counters are identical across runs of one seed —
+  the ``python -m tpudes.chaos --replay`` contract.
+- (slow) A real SIGKILL of a routed member mid-coalesced-batch: the
+  fleet requeues onto survivors and every study completes bit-equal.
+"""
+
+import json
+
+import pytest
+
+import tpudes.chaos as chaos
+from tpudes.chaos import ChaosEvent, ChaosSchedule, canonical_schedule
+from tpudes.obs.serving import ServingTelemetry, validate_serving_metrics
+from tpudes.parallel.mpi import WireFormatError, pack_frame, unpack_frame
+from tpudes.parallel.runtime import RUNTIME
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    chaos.reset()
+    ServingTelemetry.reset()
+    yield
+    chaos.reset()
+    ServingTelemetry.reset()
+    RUNTIME.clear()
+
+
+# --- schedule semantics ----------------------------------------------------
+
+
+def test_from_seed_is_pure_in_the_seed():
+    a = ChaosSchedule.from_seed(42, members=2)
+    b = ChaosSchedule.from_seed(42, members=2)
+    assert a.events == b.events
+    assert ChaosSchedule.from_seed(43, members=2).events != a.events
+    c = canonical_schedule(7, members=2)
+    d = canonical_schedule(7, members=2)
+    assert c.events == d.events
+
+
+def test_event_fires_at_exact_ordinal_once():
+    s = ChaosSchedule([
+        ChaosEvent("launch_error", "local_launch", nth=3),
+    ])
+    assert s.fire("local_launch") is None
+    assert s.fire("local_launch") is None
+    ev = s.fire("local_launch")
+    assert ev is not None and ev.kind == "launch_error"
+    assert s.fire("local_launch") is None, "events are single-shot"
+    assert s.injected == {"launch_error": 1}
+    assert s.remaining() == 0
+
+
+def test_member_ordinals_are_per_member():
+    s = ChaosSchedule([
+        ChaosEvent("kill_member", "member_study", nth=2, member=2),
+    ])
+    # member 1's visits never advance member 2's ordinal
+    assert s.fire("member_study", member=1) is None
+    assert s.fire("member_study", member=1) is None
+    assert s.fire("member_study", member=2) is None
+    ev = s.fire("member_study", member=2)
+    assert ev is not None and ev.member == 2
+
+
+def test_checkpoint_kill_tag_counts_per_engine():
+    s = ChaosSchedule([
+        ChaosEvent("checkpoint_kill", "checkpoint_save", nth=1,
+                   param="lte_sm"),
+    ])
+    # another engine's saves never consume the lte ordinal
+    assert s.fire("checkpoint_save", tag="dumbbell") is None
+    ev = s.fire("checkpoint_save", tag="lte_sm")
+    assert ev is not None and ev.param == "lte_sm"
+
+
+def test_invalid_events_refused():
+    with pytest.raises(ValueError, match="site"):
+        ChaosEvent("launch_error", "nowhere", nth=1)
+    with pytest.raises(ValueError, match="cannot fire"):
+        ChaosEvent("kill_member", "local_launch", nth=1)
+    with pytest.raises(ValueError, match="nth"):
+        ChaosEvent("launch_error", "local_launch", nth=0)
+
+
+def test_env_arming_and_reset(monkeypatch):
+    monkeypatch.setenv("TPUDES_CHAOS", "9")
+    monkeypatch.setenv("TPUDES_CHAOS_MEMBERS", "2")
+    chaos.reset()
+    s = chaos.armed()
+    assert s is not None
+    assert s.events == canonical_schedule(9, 2).events
+    monkeypatch.delenv("TPUDES_CHAOS")
+    chaos.reset()
+    assert chaos.armed() is None
+
+
+# --- wire-layer injection --------------------------------------------------
+
+
+def test_filter_frame_truncation_raises_wire_error():
+    chaos.arm(ChaosSchedule([
+        ChaosEvent("wire_truncate", "router_recv", nth=1),
+    ]))
+    blob = chaos.filter_frame("router_recv", pack_frame(("result", [1])))
+    with pytest.raises(WireFormatError):
+        unpack_frame(blob)
+
+
+def test_filter_frame_corruption_raises_wire_error():
+    chaos.arm(ChaosSchedule([
+        ChaosEvent("wire_corrupt", "router_send", nth=1),
+    ]))
+    blob = chaos.filter_frame("router_send", pack_frame(("study", {})))
+    with pytest.raises(WireFormatError, match="version"):
+        unpack_frame(blob)
+
+
+def test_unarmed_filter_is_identity():
+    blob = pack_frame(("result", [1, 2]))
+    assert chaos.filter_frame("router_recv", blob) == blob
+    assert unpack_frame(blob) == ("result", [1, 2])
+
+
+# --- the canonical replay drill -------------------------------------------
+
+
+def test_local_drill_recovers_and_is_deterministic():
+    from tpudes.chaos.scenario import run_local_scenario
+
+    r1 = run_local_scenario(7, n_studies=4)
+    r2 = run_local_scenario(7, n_studies=4)
+    assert r1["completed"] == 4 and r1["equal"]
+    f1, f2 = r1["telemetry"]["failures"], r2["telemetry"]["failures"]
+    assert f1 == f2, "same seed must reproduce the same recovery counters"
+    assert f1["injected_failures"] >= 1
+    assert f1["requeued_studies"] >= 1
+    assert validate_serving_metrics(r1["telemetry"]) == []
+
+
+def test_chaos_cli_replay_and_determinism_check(tmp_path):
+    from tpudes.chaos.__main__ import main as chaos_main
+    from tpudes.obs.__main__ import main as obs_main
+
+    out = tmp_path / "chaos-telemetry.json"
+    rc = chaos_main([
+        "--replay", "3", "--procs", "1", "--studies", "4",
+        "--check", "--quiet", "--out", str(out),
+    ])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["failures"]["injected_failures"] >= 1
+    assert obs_main(["--serving", str(out)]) == 0
+
+
+# --- the real thing: SIGKILL a spawned member mid-coalesced-batch ---------
+
+
+@pytest.mark.slow
+def test_member_sigkill_mid_batch_recovers_bit_equal():
+    """ISSUE 13 acceptance: kill -9 of a ProcessRouter member while its
+    block of a coalesced batch is in flight — every affected study
+    completes via requeue, results BIT-equal to a failure-free run
+    (the drill compares each against a solo launch)."""
+    from tpudes.chaos.scenario import run_scenario
+
+    outs = run_scenario(7, procs=3)
+    r0 = outs[0]
+    assert r0["completed"] == 6
+    assert r0["equal"], "recovered results diverged from solo launches"
+    assert r0["members_lost"] >= 1
+    assert r0["requeued"] >= 1
+    assert r0["excluded"], "the killed member must be excluded"
+    # the survivor member (if not the victim) either served or exited
+    # cleanly; the killed member's slot is None
+    assert any(o is None for o in outs[1:]) or r0["members_lost"] >= 1
+    assert validate_serving_metrics(r0["telemetry"]) == []

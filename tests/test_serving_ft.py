@@ -1,0 +1,429 @@
+"""ISSUE 13 gates: serving-fleet fault tolerance + SLO classes.
+
+- **Scheduler hardening**: an exception escaping dispatch/demux fails
+  only that batch's handles — submits after a poisoned batch still
+  complete (the loop never silently dies).
+- **Typed member loss**: EOF / WireFormatError / timeout on a routed
+  member surface as MemberLostError with the member id, never a raw
+  pipe/pickle exception.
+- **Requeue-on-death**: a batch that loses its member is requeued onto
+  the survivors (or the local engine) with the member excluded, and
+  the recovered results are BIT-equal to failure-free runs.
+- **Retry budget + backoff**: transient faults retry boundedly; past
+  the budget the handle raises RetryBudgetError (cause chained).
+- **SLO classes**: gold preempts coalesce-pending standard work; the
+  per-class attainment telemetry rides the serving schema gate.
+"""
+
+import dataclasses
+import multiprocessing as mp
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import tpudes.chaos as chaos
+from tpudes.chaos import ChaosEvent, ChaosInjected, ChaosSchedule
+from tpudes.obs.device import ChunkStream, CompileTelemetry
+from tpudes.obs.serving import ServingTelemetry, validate_serving_metrics
+from tpudes.parallel.runtime import RUNTIME
+from tpudes.serving import (
+    MemberLostError,
+    ProcessRouter,
+    RetryBudgetError,
+    StudyServer,
+    serve_studies,
+)
+
+KEY = jax.random.PRNGKey(11)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_runtime():
+    RUNTIME.clear()
+    CompileTelemetry.reset()
+    ChunkStream.reset()
+    ServingTelemetry.reset()
+    chaos.reset()
+    yield
+    chaos.reset()
+    RUNTIME.clear()
+    ServingTelemetry.reset()
+
+
+def _bss_prog(sim_end_us=40_000):
+    from tpudes.parallel.programs import toy_bss_program
+
+    return toy_bss_program(n_sta=4, sim_end_us=sim_end_us)
+
+
+def _lte_prog(n_ttis=60):
+    from tpudes.parallel.programs import toy_lte_program
+
+    return toy_lte_program(n_enb=2, n_ue=4, n_ttis=n_ttis)
+
+
+def _assert_equal(a: dict, b: dict):
+    for k in b:
+        np.testing.assert_array_equal(
+            np.asarray(a[k]), np.asarray(b[k]), err_msg=f"field {k!r}"
+        )
+
+
+# --- scheduler hardening (satellite: a poisoned batch never kills the
+# --- loop) ----------------------------------------------------------------
+
+
+def test_submit_after_poisoned_demux_still_completes(monkeypatch):
+    """A raise escaping the demux bookkeeping (NOT the launch itself)
+    must fail only that batch; the scheduler thread keeps dispatching."""
+    from tpudes.parallel.lte_sm import run_lte_sm
+
+    prog = _lte_prog()
+    solo = run_lte_sm(prog, KEY, replicas=3)  # pre-compile
+    real = ServingTelemetry.record_launch_done.__func__
+    boom = {"armed": True}
+
+    def poisoned(cls, engine, wall_s):
+        if boom.pop("armed", None):
+            raise RuntimeError("telemetry bug (planted)")
+        return real(cls, engine, wall_s)
+
+    monkeypatch.setattr(
+        ServingTelemetry, "record_launch_done", classmethod(poisoned)
+    )
+    with StudyServer(max_wait_s=0.01) as server:
+        h1 = server.submit_study("lte_sm", prog, KEY, replicas=3)
+        with pytest.raises(RuntimeError, match="planted"):
+            h1.result(timeout=30)
+        # the loop survived: a fresh submit completes normally
+        h2 = server.submit_study("lte_sm", prog, KEY, replicas=3)
+        _assert_equal(h2.result(timeout=30), solo)
+
+
+def test_poisoned_dispatch_fails_batch_not_loop(monkeypatch):
+    """A raise escaping _dispatch itself (after the internal launch
+    try) is caught by the loop's per-batch hardening."""
+    from tpudes.parallel.lte_sm import run_lte_sm
+
+    prog = _lte_prog()
+    solo = run_lte_sm(prog, KEY, replicas=3)
+    real = ServingTelemetry.record_dispatch.__func__
+    boom = {"armed": True}
+
+    def poisoned(cls, *a, **kw):
+        if boom.pop("armed", None):
+            raise RuntimeError("dispatch bookkeeping bug (planted)")
+        return real(cls, *a, **kw)
+
+    monkeypatch.setattr(
+        ServingTelemetry, "record_dispatch", classmethod(poisoned)
+    )
+    with StudyServer(max_wait_s=0.01) as server:
+        h1 = server.submit_study("lte_sm", prog, KEY, replicas=3)
+        with pytest.raises(RuntimeError, match="planted"):
+            h1.result(timeout=30)
+        h2 = server.submit_study("lte_sm", prog, KEY, replicas=3)
+        _assert_equal(h2.result(timeout=30), solo)
+
+
+# --- typed member loss (satellite: MemberLostError, never raw pipe) -------
+
+
+def test_routed_future_translates_closed_conn():
+    from tpudes.serving.distributed import _RoutedFuture
+
+    a, b = mp.Pipe(duplex=True)
+    b.close()
+    fut = _RoutedFuture(None, 0, [(1, a, 2)], timeout_s=1.0)
+    with pytest.raises(MemberLostError) as ei:
+        fut.result()
+    assert ei.value.members == (1,)
+    assert "EOFError" in str(ei.value) or "OSError" in str(ei.value)
+    # memoized: the same typed error on re-read, not a fresh recv
+    with pytest.raises(MemberLostError):
+        fut.result()
+
+
+def test_routed_future_translates_wire_garbage():
+    from tpudes.serving.distributed import _RoutedFuture
+
+    a, b = mp.Pipe(duplex=True)
+    b.send_bytes(b"\xffgarbage-that-is-not-a-frame")
+    fut = _RoutedFuture(None, 0, [(2, a, 1)], timeout_s=1.0)
+    with pytest.raises(MemberLostError) as ei:
+        fut.result()
+    assert ei.value.members == (2,)
+    assert "WireFormatError" in str(ei.value)
+
+
+def test_routed_future_timeout_is_member_loss():
+    from tpudes.serving.distributed import _RoutedFuture
+
+    a, _b = mp.Pipe(duplex=True)  # peer never replies (hung member)
+    fut = _RoutedFuture(None, 0, [(3, a, 1)], timeout_s=0.0)
+    with pytest.raises(MemberLostError) as ei:
+        fut.result()
+    assert ei.value.members == (3,)
+    assert "TimeoutError" in str(ei.value)
+
+
+# --- requeue-on-death: recovered results bit-equal ------------------------
+
+
+def test_member_death_mid_batch_requeues_bit_equal():
+    """The member takes its routed frame and dies before replying; the
+    whole batch requeues (member excluded) and completes locally with
+    results bit-equal to failure-free solo launches."""
+    from tpudes.parallel.replicated import run_replicated_bss
+
+    a, b = mp.Pipe(duplex=True)
+
+    def member():
+        b.recv_bytes()  # accept the study frame...
+        b.close()       # ...and die mid-batch
+
+    t = threading.Thread(target=member)
+    t.start()
+    router = ProcessRouter({1: a}, member_timeout_s=5.0)
+    prog = _bss_prog()
+    ends = (40_000, 44_000)
+    with StudyServer(
+        start=False, router=router, retry_backoff_s=0.0
+    ) as server:
+        handles = [
+            server.submit_study(
+                "bss", dataclasses.replace(prog, sim_end_us=e), KEY, 2
+            )
+            for e in ends
+        ]
+        server.pump()
+        t.join()
+        for h, e in zip(handles, ends):
+            solo = run_replicated_bss(
+                dataclasses.replace(prog, sim_end_us=e), 2, KEY
+            )
+            res = h.result(timeout=5)
+            for k in solo:
+                np.testing.assert_array_equal(
+                    np.asarray(res[k]), np.asarray(solo[k]), err_msg=k
+                )
+        m = server.metrics()
+    assert m["failures"]["requeued_studies"] == 2
+    assert m["failures"]["members_lost"] == 1
+    assert router._dead == {1}, "lost member must be excluded"
+
+
+def test_wire_corruption_requeues_and_excludes():
+    """Chaos corrupts the member's reply frame at the router: the
+    stream is untrusted, the member excluded, the batch requeued —
+    results still bit-equal."""
+    from tpudes.parallel.replicated import run_replicated_bss
+
+    a, b = mp.Pipe(duplex=True)
+    stop = threading.Thread(
+        target=serve_studies, args=(b,), kwargs=dict(member_id=1)
+    )
+    stop.start()
+    chaos.arm(ChaosSchedule([
+        ChaosEvent("wire_corrupt", "router_recv", nth=1, member=1),
+    ]))
+    router = ProcessRouter({1: a}, member_timeout_s=10.0)
+    prog = _bss_prog()
+    ends = (40_000, 44_000)
+    with StudyServer(
+        start=False, router=router, retry_backoff_s=0.0
+    ) as server:
+        handles = [
+            server.submit_study(
+                "bss", dataclasses.replace(prog, sim_end_us=e), KEY, 2
+            )
+            for e in ends
+        ]
+        server.pump()
+        for h, e in zip(handles, ends):
+            solo = run_replicated_bss(
+                dataclasses.replace(prog, sim_end_us=e), 2, KEY
+            )
+            res = h.result(timeout=10)
+            for k in solo:
+                np.testing.assert_array_equal(
+                    np.asarray(res[k]), np.asarray(solo[k]), err_msg=k
+                )
+        m = server.metrics()
+    stop.join(timeout=10)
+    assert m["failures"]["members_lost"] == 1
+    assert m["failures"]["requeued_studies"] == 2
+    assert m["failures"]["injected_wire_corrupt"] == 1
+    assert router._dead == {1}
+
+
+# --- retry budget + backoff ------------------------------------------------
+
+
+def test_retry_budget_exhaustion_raises_typed_error():
+    chaos.arm(ChaosSchedule([
+        ChaosEvent("launch_error", "local_launch", nth=n)
+        for n in (1, 2, 3)
+    ]))
+    prog = _lte_prog()
+    with StudyServer(
+        start=False, retry_budget=2, retry_backoff_s=0.0
+    ) as server:
+        h = server.submit_study("lte_sm", prog, KEY, replicas=3)
+        server.pump()
+        with pytest.raises(RetryBudgetError) as ei:
+            h.result(timeout=5)
+        assert isinstance(ei.value.__cause__, ChaosInjected)
+        m = server.metrics()
+        assert m["failures"]["retry_budget_exhausted"] == 1
+        assert m["failures"]["injected_launch_error"] == 3
+        chaos.disarm()
+        # the server is fine afterwards: a fresh study completes
+        h2 = server.submit_study("lte_sm", prog, KEY, replicas=3)
+        server.pump()
+        assert h2.result(timeout=5)["rx_bits"].shape == (3, 4)
+
+
+def test_transient_fault_recovers_within_budget():
+    from tpudes.parallel.lte_sm import run_lte_sm
+
+    chaos.arm(ChaosSchedule([
+        ChaosEvent("launch_error", "local_launch", nth=1),
+    ]))
+    prog = _lte_prog()
+    solo = run_lte_sm(prog, KEY, replicas=3)
+    with StudyServer(
+        start=False, retry_budget=3, retry_backoff_s=0.0
+    ) as server:
+        h = server.submit_study("lte_sm", prog, KEY, replicas=3)
+        server.pump()
+        _assert_equal(h.result(timeout=5), solo)
+        m = server.metrics()
+    assert m["failures"]["requeued_studies"] == 1
+    assert m["failures"]["injected_failures"] == 1
+
+
+def test_backoff_delays_background_redispatch():
+    """The background scheduler honors the retry backoff: the retried
+    study completes, but not before the backoff elapsed."""
+    from tpudes.parallel.lte_sm import run_lte_sm
+
+    prog = _lte_prog()
+    run_lte_sm(prog, KEY, replicas=3)  # pre-compile
+    chaos.arm(ChaosSchedule([
+        ChaosEvent("launch_error", "local_launch", nth=1),
+    ]))
+    backoff = 0.25
+    with StudyServer(
+        max_wait_s=0.005, retry_budget=3, retry_backoff_s=backoff
+    ) as server:
+        t0 = time.monotonic()
+        h = server.submit_study("lte_sm", prog, KEY, replicas=3)
+        h.result(timeout=30)
+        waited = time.monotonic() - t0
+    assert waited >= backoff * 0.8, (
+        f"retried after {waited:.3f}s < backoff {backoff}s"
+    )
+
+
+# --- SLO classes -----------------------------------------------------------
+
+
+def test_unknown_slo_class_rejected():
+    with StudyServer(start=False) as server:
+        with pytest.raises(ValueError, match="SLO class"):
+            server.submit_study(
+                "lte_sm", _lte_prog(), KEY, 3, slo="platinum"
+            )
+
+
+def test_gold_preempts_coalesce_pending_standard():
+    """Two standard studies sit waiting out the batching deadline; a
+    gold arrival dispatches FIRST even though it arrived last."""
+    other_key = jax.random.PRNGKey(12)
+    prog = _lte_prog()
+    with StudyServer(start=False, max_wait_s=60.0) as server:
+        h_std = [
+            server.submit_study(
+                "lte_sm", dataclasses.replace(prog, scheduler=s), KEY, 3
+            )
+            for s in ("pf", "rr")
+        ]
+        h_gold = server.submit_study(
+            "lte_sm", prog, other_key, 3, slo="gold"
+        )
+        server.pump(force=False)  # only DUE work dispatches
+        assert h_gold.done(), "gold preempts the batching deadline"
+        assert not any(h.done() for h in h_std), (
+            "standard studies still wait for their deadline"
+        )
+        server.pump(force=True)
+        assert all(h.done() for h in h_std)
+        m = server.metrics()
+    assert m["slo"]["gold"]["studies"] == 1
+    assert m["slo"]["standard"]["studies"] == 2
+    assert 0.0 <= m["slo"]["gold"]["attainment"] <= 1.0
+
+
+def test_gold_head_rides_its_own_batch():
+    """Review fix: with more compatible requests than max_batch, the
+    arrival-order slice must not cut the gold head out of the batch
+    its preempt flag made due."""
+    prog = _lte_prog()
+    with StudyServer(start=False, max_wait_s=60.0, max_batch=4) as server:
+        for s in ("pf", "rr", "fdmt", "tdmt"):
+            server.submit_study(
+                "lte_sm", dataclasses.replace(prog, scheduler=s), KEY, 3
+            )
+        h_gold = server.submit_study(
+            "lte_sm", dataclasses.replace(prog, scheduler="tta"), KEY, 3,
+            slo="gold",
+        )
+        server.pump(force=False)  # only the gold-headed batch is due
+        assert h_gold.done(), (
+            "the gold study must ride the batch it preempted for"
+        )
+        assert h_gold.batch_size == 4
+        server.pump(force=True)
+
+
+def test_slo_fields_ride_the_schema_gate(tmp_path):
+    import json
+
+    from tpudes.obs.__main__ import main as obs_main
+
+    prog = _lte_prog()
+    with StudyServer(start=False) as server:
+        server.submit_study("lte_sm", prog, KEY, 3, slo="gold")
+        server.submit_study(
+            "lte_sm", dataclasses.replace(prog, scheduler="rr"), KEY, 3,
+            slo="batch",
+        )
+        server.pump()
+        m = server.metrics()
+    assert validate_serving_metrics(m) == []
+    assert set(m["slo"]) == {"batch", "gold"}
+    assert m["slo"]["gold"]["attained"] <= m["slo"]["gold"]["studies"]
+    for k in ("requeued_studies", "members_lost", "injected_failures",
+              "checkpoint_saves", "checkpoint_restores"):
+        assert m["failures"][k] == 0
+    path = tmp_path / "serving-ft.json"
+    path.write_text(json.dumps(m))
+    assert obs_main(["--serving", str(path)]) == 0
+
+
+def test_validator_rejects_missing_failure_and_slo_fields():
+    good = ServingTelemetry.snapshot()
+    for drop in ("failures", "slo"):
+        bad = {k: v for k, v in good.items() if k != drop}
+        assert validate_serving_metrics(bad) != [], f"missing {drop}"
+    bad = dict(good)
+    bad["slo"] = {"gold": {"studies": 1, "attained": 2,
+                           "attainment": 2.0,
+                           "latency_s": {"p50": 0, "p99": 0, "n": 0}}}
+    problems = validate_serving_metrics(bad)
+    assert any("attained > studies" in p for p in problems)
+    assert any("attainment" in p for p in problems)

@@ -7,6 +7,7 @@ from tpudes.analysis.passes.determinism import DeterminismPass
 from tpudes.analysis.passes.event_hygiene import EventHygienePass
 from tpudes.analysis.passes.jit_purity import JitPurityPass
 from tpudes.analysis.passes.key_discipline import KeyDisciplinePass
+from tpudes.analysis.passes.liveness import ServingLivenessPass
 from tpudes.analysis.passes.registry_parity import RegistryParityPass
 from tpudes.analysis.passes.rng_discipline import RngDisciplinePass
 from tpudes.analysis.passes.style import StylePass
@@ -24,4 +25,5 @@ BUILTIN_PASSES = [
     CrossReplicaShapePass,
     TimeUnitsPass,
     KeyDisciplinePass,
+    ServingLivenessPass,
 ]

@@ -1,0 +1,69 @@
+"""serving-liveness pass: cross-process/thread waits must be bounded.
+
+The serving fleet's fault model (ISSUE 13) says a dead or hung peer is
+a *recoverable* event — which is only true if no wait can block
+forever.  A bare ``Condition.wait()`` is the classic lost-wakeup hang
+(the notify raced the sleep and nobody ever wakes you), and a bare
+``Queue.get()`` / ``conn.recv()`` / ``conn.recv_bytes()`` on a pipe to
+a process that just got SIGKILLed parks the scheduler thread
+permanently — the exact operator-babysitting failure the
+fault-tolerance layer exists to remove.
+
+SRV001 fires in the serving layer (``tpudes/serving/``) and the
+process-mesh launcher (``tpudes/parallel/procmesh.py``) on calls of
+the blocking-wait shapes with NO arguments and NO ``timeout=`` (a
+zero-arg ``.get()`` cannot be ``dict.get`` — that needs a key — and a
+zero-arg ``.wait()``/``.recv()``/``.recv_bytes()`` is precisely the
+unbounded form).  Sites that are *intentionally* unbounded (a
+shutdown drain that must block) carry ``# tpudes: ignore[SRV001]``
+with a justification, or live behind
+:func:`tpudes.parallel.mpi.recv_frame`'s explicit ``timeout_s=None``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudes.analysis.base import Finding, Pass, SourceModule
+
+#: zero-arg attribute calls that block unboundedly
+_BLOCKING_ATTRS = {"wait", "get", "recv", "recv_bytes"}
+
+
+class ServingLivenessPass(Pass):
+    name = "serving-liveness"
+    codes = {
+        "SRV001": "unbounded blocking wait (no timeout) in the serving "
+                  "layer — a dead/hung peer or lost wakeup hangs the "
+                  "scheduler forever",
+    }
+
+    def applies(self, path: str) -> bool:
+        return (
+            "tpudes/serving/" in path
+            or path.endswith("tpudes/parallel/procmesh.py")
+        )
+
+    def check_module(self, mod: SourceModule) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr not in _BLOCKING_ATTRS:
+                continue
+            if node.args or node.keywords:
+                # any argument bounds it (wait(t), get(timeout=...),
+                # poll-guarded recv helpers take theirs explicitly) or
+                # disambiguates (dict.get(key))
+                continue
+            out.append(Finding(
+                mod.path, node.lineno, node.col_offset, "SRV001",
+                f"bare blocking '.{fn.attr}()' without a timeout: a "
+                "dead peer or lost wakeup hangs this thread forever — "
+                "pass a timeout (and loop) or route through "
+                "mpi.recv_frame",
+            ))
+        return out

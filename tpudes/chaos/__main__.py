@@ -1,0 +1,114 @@
+"""Chaos replay CLI (the fault-tolerance analog of ``tpudes.fuzz``).
+
+Usage::
+
+    python -m tpudes.chaos --replay SEED [--procs N] [--studies K]
+                           [--out METRICS.json] [--check] [--quiet]
+
+``--replay SEED`` re-runs the canonical serving drill under
+``canonical_schedule(SEED, members)``: with ``--procs 1`` (default) the
+in-process launch-error drill, with ``--procs N`` the spawned fleet
+where the schedule SIGKILLs a seed-chosen member mid-coalesced-batch.
+Exit 0 requires every study to complete AND recover bit-equal to solo
+launches.  ``--check`` runs the drill twice and additionally demands
+bit-identical failure/recovery counters — the determinism gate
+(same seed → same injected failures → same recovery telemetry).
+``--out`` writes rank-0's serving-telemetry snapshot (validated by
+``python -m tpudes.obs --serving``).
+
+Exit codes: 0 = recovered (and deterministic, under --check);
+1 = a study failed, diverged, or the counters drifted; 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _failure_counters(report: dict) -> dict:
+    """The determinism-gated subset: injected + recovery counters
+    (latency distributions legitimately vary run to run)."""
+    f = dict(report["telemetry"]["failures"])
+    f["completed"] = report["completed"]
+    return f
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpudes.chaos",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--replay", type=int, metavar="SEED", required=True,
+                    help="chaos schedule seed to replay")
+    ap.add_argument("--procs", type=int, default=1,
+                    help="1 = in-process drill; N>1 spawns N-1 routed "
+                         "members and SIGKILLs a seed-chosen one")
+    ap.add_argument("--studies", type=int, default=None,
+                    help="studies per drill (default 6)")
+    ap.add_argument("--out", default=None,
+                    help="write the serving-telemetry snapshot here")
+    ap.add_argument("--check", action="store_true",
+                    help="run twice; fail unless the failure/recovery "
+                         "counters are identical (determinism gate)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.procs < 1:
+        ap.print_usage(sys.stderr)
+        print("--procs must be >= 1", file=sys.stderr)
+        return 2
+    log = (lambda *a: None) if args.quiet else print
+
+    from tpudes.chaos.scenario import (
+        N_STUDIES,
+        run_local_scenario,
+        run_scenario,
+    )
+
+    n_studies = args.studies or N_STUDIES
+
+    def drill() -> dict:
+        if args.procs == 1:
+            return run_local_scenario(args.replay, n_studies)
+        return run_scenario(args.replay, args.procs, n_studies)[0]
+
+    report = drill()
+    ok = report["completed"] == n_studies and report["equal"]
+    f = report["telemetry"]["failures"]
+    log(
+        f"chaos replay seed={args.replay}: {report['completed']}/"
+        f"{n_studies} studies completed, bit-equal={report['equal']}, "
+        f"injected={f['injected_failures']}, "
+        f"requeued={f['requeued_studies']}, "
+        f"members_lost={f['members_lost']}"
+    )
+    if args.check:
+        second = drill()
+        if _failure_counters(report) != _failure_counters(second):
+            print(
+                "chaos replay NOT deterministic:\n"
+                f"  first:  {_failure_counters(report)}\n"
+                f"  second: {_failure_counters(second)}",
+                file=sys.stderr,
+            )
+            ok = False
+        else:
+            log("determinism check: identical failure/recovery counters")
+    if args.out:
+        with open(args.out, "w") as fp:
+            json.dump(report["telemetry"], fp, indent=1, sort_keys=True)
+    if not ok:
+        print(
+            f"chaos replay FAILED: completed={report['completed']}, "
+            f"equal={report['equal']}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -3,8 +3,11 @@
 :class:`ServingTelemetry` is the process-global registry
 :class:`tpudes.serving.StudyServer` records into — queue depth,
 coalesce rate, batch occupancy, per-engine launch latency and
-end-to-end study latency — and :func:`validate_serving_metrics` is the
-schema gate the CI serving smoke runs over a dumped snapshot
+end-to-end study latency, plus (ISSUE 13) the failure/recovery
+counters (requeues, members lost, retry-budget exhaustion, chaos
+injections per kind, checkpoint saves/restores) and per-SLO-class
+attainment — and :func:`validate_serving_metrics` is the schema gate
+the CI serving/chaos smokes run over dumped snapshots
 (``python -m tpudes.obs --serving metrics.json``).
 
 The registry follows the :class:`tpudes.obs.device.CompileTelemetry`
@@ -45,6 +48,11 @@ class ServingTelemetry:
     _queue_depth = 0
     _queue_depth_max = 0
     _engines: dict[str, dict] = {}
+    #: failure/recovery counters (ISSUE 13): requeues, member loss,
+    #: retry-budget exhaustion, chaos injections, checkpoint traffic
+    _failures: dict[str, int] = {}
+    #: SLO class -> {"studies", "attained", "latency_s" ring}
+    _slo: dict[str, dict] = {}
 
     # --- recording hooks (called by tpudes.serving) ----------------------
 
@@ -100,13 +108,64 @@ class ServingTelemetry:
         del ring[: max(0, len(ring) - cls.CAP)]
 
     @classmethod
-    def record_study_done(cls, engine: str, latency_s: float) -> None:
+    def record_study_done(cls, engine: str, latency_s: float,
+                          slo: str | None = None,
+                          attained: bool | None = None) -> None:
         e = cls._engine(engine)
         e["studies"] += 1
         cls._bump("completed")
         ring = e["study_latency_s"]
         ring.append(float(latency_s))
         del ring[: max(0, len(ring) - cls.CAP)]
+        if slo is not None:
+            s = cls._slo.setdefault(
+                slo, {"studies": 0, "attained": 0, "latency_s": []}
+            )
+            s["studies"] += 1
+            if attained:
+                s["attained"] += 1
+            s["latency_s"].append(float(latency_s))
+            del s["latency_s"][: max(0, len(s["latency_s"]) - cls.CAP)]
+
+    # --- failure/recovery hooks (ISSUE 13) --------------------------------
+
+    @classmethod
+    def _fail_bump(cls, name: str, n: int = 1) -> None:
+        cls._failures[name] = cls._failures.get(name, 0) + int(n)
+
+    @classmethod
+    def record_requeue(cls, engine: str, n_studies: int) -> None:
+        """A batch transiently failed and went back to the queue."""
+        del engine
+        cls._fail_bump("requeued_batches")
+        cls._fail_bump("requeued_studies", n_studies)
+
+    @classmethod
+    def record_member_lost(cls, n_members: int = 1) -> None:
+        cls._fail_bump("members_lost", n_members)
+
+    @classmethod
+    def record_retry_exhausted(cls, n: int = 1) -> None:
+        cls._fail_bump("retry_budget_exhausted", n)
+
+    @classmethod
+    def record_injected(cls, kind: str) -> None:
+        """A chaos schedule fired (kind-tagged, plus the total the
+        schema gates on)."""
+        cls._fail_bump("injected_failures")
+        cls._fail_bump(f"injected_{kind}")
+
+    @classmethod
+    def record_checkpoint(cls, event: str) -> None:
+        """``event`` is ``save`` or ``restore``."""
+        cls._fail_bump(f"checkpoint_{event}s")
+
+    @classmethod
+    def record_backstop(cls) -> None:
+        """The scheduler loop's belt-and-braces catch fired — a bug
+        the per-batch poisoning should have handled.  Counted (never
+        silently swallowed) so a hot backstop shows up on dashboards."""
+        cls._fail_bump("scheduler_backstop")
 
     @classmethod
     def record_queue_depth(cls, depth: int) -> None:
@@ -159,6 +218,30 @@ class ServingTelemetry:
                 "launch_wall_s": dist(e["launch_wall_s"]),
                 "study_latency_s": dist(e["study_latency_s"]),
             }
+        failures = {
+            k: cls._failures.get(k, 0)
+            for k in (
+                "requeued_batches", "requeued_studies", "members_lost",
+                "retry_budget_exhausted", "injected_failures",
+                "checkpoint_saves", "checkpoint_restores",
+                "scheduler_backstop",
+            )
+        }
+        # kind-tagged injection counters ride along verbatim
+        failures.update({
+            k: v for k, v in sorted(cls._failures.items())
+            if k.startswith("injected_")
+        })
+        slo = {}
+        for name, s in sorted(cls._slo.items()):
+            slo[name] = {
+                "studies": s["studies"],
+                "attained": s["attained"],
+                "attainment": round(
+                    s["attained"] / s["studies"], 4
+                ) if s["studies"] else 0.0,
+                "latency_s": dist(s["latency_s"]),
+            }
         return {
             "version": 1,
             "counters": counters,
@@ -170,6 +253,8 @@ class ServingTelemetry:
                 "depth": cls._queue_depth,
                 "depth_max": cls._queue_depth_max,
             },
+            "failures": failures,
+            "slo": slo,
             "engines": engines,
         }
 
@@ -180,6 +265,8 @@ class ServingTelemetry:
         cls._queue_depth = 0
         cls._queue_depth_max = 0
         cls._warm_wall = 0.0
+        cls._failures = {}
+        cls._slo = {}
 
 
 def validate_serving_metrics(doc) -> list[str]:
@@ -209,6 +296,37 @@ def validate_serving_metrics(doc) -> list[str]:
     if queue is not None:
         need(queue, "depth", int, "queue")
         need(queue, "depth_max", int, "queue")
+    failures = need(doc, "failures", dict, "top level")
+    if failures is not None:
+        for k in (
+            "requeued_batches", "requeued_studies", "members_lost",
+            "retry_budget_exhausted", "injected_failures",
+            "checkpoint_saves", "checkpoint_restores",
+        ):
+            v = need(failures, k, int, "failures")
+            if isinstance(v, int) and v < 0:
+                problems.append(f"failures.{k}: negative")
+    slo = need(doc, "slo", dict, "top level")
+    if slo is not None:
+        for name, s in slo.items():
+            where = f"slo.{name}"
+            if not isinstance(s, dict):
+                problems.append(f"{where}: not an object")
+                continue
+            n = need(s, "studies", int, where)
+            att = need(s, "attained", int, where)
+            rate = need(s, "attainment", (int, float), where)
+            if rate is not None and not (0.0 <= rate <= 1.0):
+                problems.append(f"{where}.attainment: not in [0, 1]")
+            if (
+                isinstance(n, int) and isinstance(att, int) and att > n
+            ):
+                problems.append(f"{where}: attained > studies")
+            d = need(s, "latency_s", dict, where)
+            if d is not None:
+                need(d, "p50", (int, float), f"{where}.latency_s")
+                need(d, "p99", (int, float), f"{where}.latency_s")
+                need(d, "n", int, f"{where}.latency_s")
     engines = need(doc, "engines", dict, "top level")
     if engines is not None:
         for name, e in engines.items():

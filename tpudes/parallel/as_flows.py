@@ -472,6 +472,7 @@ def run_as_flows(
     *,
     rate_scale=None,
     chunk_rounds: int | None = None,
+    checkpoint=None,
     block: bool = True,
 ):
     """Execute R replicas; returns per-replica outcome arrays:
@@ -493,10 +494,14 @@ def run_as_flows(
     executable, so every segment re-runs the config-independent SPF +
     path walk and the output assembly — with :data:`FP_ROUNDS` = 4
     that is at most 4 repeats, but don't chunk a large-topology run
-    you aren't inspecting.  ``block=False`` returns an
+    you aren't inspecting.  ``checkpoint=`` (a path or
+    :class:`~tpudes.parallel.checkpoint.CarryCheckpoint`) persists the
+    relaxation carry after each segment and resumes a matching run,
+    bit-equal to uninterrupted.  ``block=False`` returns an
     :class:`~tpudes.parallel.runtime.EngineFuture`.
     """
     from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+    from tpudes.parallel.checkpoint import checkpoint_ctx
     from tpudes.parallel.runtime import (
         RUNTIME,
         EngineFuture,
@@ -551,12 +556,21 @@ def run_as_flows(
             carry, out, metrics = run(c[0], z, scale, jnp.int32(bound))
             return (carry, out), metrics
 
+        ckpt = checkpoint_ctx(
+            checkpoint, engine="as_flows", key=key, replicas=replicas,
+            r_pad=r_pad, n_cfg=n_cfg, obs=obs,
+            axis=0 if n_cfg is None else 1, mesh=mesh,
+            extra=as_prog_key(prog)
+            + (None if rate_scale is None
+               else tuple(float(v) for v in rate_scale),),
+        )
         (_, out), flush = drive_chunks(
             "as_flows",
             chunk_bounds(FP_ROUNDS, chunk_rounds or FP_ROUNDS),
             (carry, None),
             launch,
             obs,
+            checkpoint=ckpt,
         )
         if compiling:
             jax.block_until_ready(out)

@@ -1,0 +1,294 @@
+"""Checkpoint/resume for chunked-horizon engine runs.
+
+The PR-5 chunked-horizon restructuring made every engine's state an
+explicit ``advance(carry, …, t_end)`` carry — which means the carry
+*is* the complete simulation state, and persisting it after each
+completed chunk makes a long-horizon study resumable: a run killed at
+hour N restarts from its last completed chunk instead of from zero,
+and because every step's randomness is ``fold_in(key, t)`` (pure in t,
+indifferent to segment boundaries), the resumed run is **bit-equal**
+to an uninterrupted one.
+
+Usage (every device engine's ``run_*`` takes ``checkpoint=``, valid
+with its ``chunk_*`` argument)::
+
+    run_lte_sm(prog, key, replicas=64, chunk_ttis=1000,
+               checkpoint="study.ckpt")
+    # ... killed between chunks ...
+    run_lte_sm(prog, key, replicas=64, chunk_ttis=1000,
+               checkpoint="study.ckpt")   # resumes, finishes bit-equal
+
+Format: one pickle file (atomic tmp+rename) holding the host-fetched
+carry tree verbatim, a per-leaf *replica marker* tree (computed at
+save time: which leaves carry the padded replica axis at the engine's
+replica position), the save-time bucket size, and a fingerprint of
+everything the carry's meaning depends on — engine, key bytes, replica
+count, config axis, obs mode, and the engine's static program key.
+When the resume run's bucket matches the saved one (the common case,
+including every ``TPUDES_INFLIGHT`` flip) the carry is restored
+verbatim — no axis heuristics at all.  When the bucket CHANGED
+(a ``TPUDES_BUCKETING`` flip), only marker-true leaves are resized:
+real replica rows are kept and pad rows reconstructed by edge
+replication (any valid state row works: replicas are independent and
+pad-row results are sliced off at unpack).  The marker is a size match
+at the replica position recorded at save time, so a non-replica leaf
+whose axis length coincidentally equals the save-time bucket would be
+mis-resized on a cross-bucketing resume — the one residual heuristic,
+inherited from ``shard_replica_axis``'s identification rule and only
+reachable on a bucket change.
+The chunk *schedule* (the bounds list) must match between save and
+resume — a changed chunk size changes which carries exist, so it is
+refused loudly rather than resumed approximately.
+
+Chaos hook: after each save, the ``checkpoint_save`` injection site
+fires (tag = engine name), so a seed-keyed
+:class:`~tpudes.chaos.ChaosSchedule` can kill the run *between* chunks
+— exactly the crash the resume contract is pinned against.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from dataclasses import dataclass
+
+__all__ = ["CarryCheckpoint", "CheckpointError", "checkpoint_ctx"]
+
+_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file cannot serve this run: fingerprint mismatch
+    (different program/key/replicas/obs), a changed chunk schedule, or
+    a corrupt/foreign file.  Delete the file (or pass a fresh path) to
+    start over."""
+
+
+def _key_bytes(key) -> bytes:
+    import numpy as np
+
+    try:  # new-style typed PRNG keys
+        import jax
+
+        return np.asarray(jax.random.key_data(key)).tobytes()
+    except (TypeError, ValueError, AttributeError):
+        return np.asarray(key).tobytes()
+
+
+def _tree_map_np(fn, tree):
+    """Map ``fn`` over array leaves of a (tuple/list/dict/None) tree —
+    structure-preserving, no jax import needed at restore time."""
+    if tree is None:
+        return None
+    if isinstance(tree, dict):
+        return {k: _tree_map_np(fn, v) for k, v in tree.items()}
+    if isinstance(tree, tuple):
+        return tuple(_tree_map_np(fn, v) for v in tree)
+    if isinstance(tree, list):
+        return [_tree_map_np(fn, v) for v in tree]
+    return fn(tree)
+
+
+def _tree_map2_np(fn, tree, other):
+    """Two-tree variant: ``fn(leaf, other_leaf)`` over matching
+    positions (structures are identical by construction — the marker
+    tree is derived from the carry tree)."""
+    if tree is None:
+        return None
+    if isinstance(tree, dict):
+        return {k: _tree_map2_np(fn, v, other[k]) for k, v in tree.items()}
+    if isinstance(tree, tuple):
+        return tuple(
+            _tree_map2_np(fn, v, o) for v, o in zip(tree, other)
+        )
+    if isinstance(tree, list):
+        return [_tree_map2_np(fn, v, o) for v, o in zip(tree, other)]
+    return fn(tree, other)
+
+
+class CarryCheckpoint:
+    """One resumable run's persistent carry slot (one file)."""
+
+    def __init__(self, path):
+        self.path = str(path)
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def remove(self) -> None:
+        if self.exists():
+            os.remove(self.path)
+
+    # --- engine-facing protocol (driven by runtime.drive_chunks) ---------
+
+    def save(self, ctx: "_CkptCtx", bound: int, bounds, carry) -> None:
+        """Persist the carry after the chunk ending at ``bound``
+        (blocks on the device fetch; atomic on the filesystem).  The
+        chaos ``checkpoint_save`` site fires AFTER the file is durable,
+        so an injected kill always leaves a resumable state."""
+        import jax
+        import numpy as np
+
+        host = jax.device_get(carry)
+        markers = None
+        if ctx.r_pad is not None:
+            def is_replica_leaf(v):
+                a = np.asarray(v)
+                return bool(
+                    a.ndim > ctx.axis and a.shape[ctx.axis] == ctx.r_pad
+                )
+
+            markers = _tree_map_np(is_replica_leaf, host)
+        doc = {
+            "version": _VERSION,
+            "fingerprint": ctx.fingerprint,
+            "engine": ctx.engine,
+            "bound": int(bound),
+            "bounds": [int(b) for b in bounds],
+            "replicas": ctx.replicas,
+            "r_pad": ctx.r_pad,
+            "replica_leaf": markers,
+            "carry": host,
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(doc, f)
+        os.replace(tmp, self.path)
+        from tpudes.obs.serving import ServingTelemetry
+
+        ServingTelemetry.record_checkpoint("save")
+        from tpudes.chaos import maybe_fail
+
+        maybe_fail("checkpoint_save", what="checkpoint",
+                   tag=ctx.engine)
+
+    def restore(self, ctx: "_CkptCtx", bounds):
+        """Load the saved carry for this run, re-padded to the current
+        replica bucket; returns ``(done_bound, carry)`` or None when no
+        checkpoint exists.  Refuses (CheckpointError) a file whose
+        fingerprint or chunk schedule disagrees with this run."""
+        if not self.exists():
+            return None
+        try:
+            with open(self.path, "rb") as f:
+                doc = pickle.load(f)
+        except Exception as e:  # noqa: BLE001 - corrupt file: loud stop
+            raise CheckpointError(
+                f"{self.path}: unreadable checkpoint ({e})"
+            ) from e
+        if doc.get("version") != _VERSION:
+            raise CheckpointError(
+                f"{self.path}: checkpoint version {doc.get('version')} "
+                f"!= {_VERSION}"
+            )
+        if doc.get("fingerprint") != ctx.fingerprint:
+            raise CheckpointError(
+                f"{self.path}: fingerprint mismatch — this checkpoint "
+                "belongs to a different study (program, key, replicas, "
+                "sweep points, or obs mode changed)"
+            )
+        if doc.get("bounds") != [int(b) for b in bounds]:
+            raise CheckpointError(
+                f"{self.path}: chunk schedule changed "
+                f"({doc.get('bounds')} != {[int(b) for b in bounds]}); "
+                "resume with the same chunk size or start fresh"
+            )
+        carry = self._rebucket(doc, ctx)
+        if ctx.mesh is not None:
+            from tpudes.parallel.runtime import shard_replica_axis
+
+            carry = shard_replica_axis(
+                carry, ctx.mesh, ctx.r_pad, ctx.axis
+            )
+        from tpudes.obs.serving import ServingTelemetry
+
+        ServingTelemetry.record_checkpoint("restore")
+        return int(doc["bound"]), carry
+
+    # --- replica-axis normalization --------------------------------------
+
+    def _rebucket(self, doc: dict, ctx: "_CkptCtx"):
+        """The saved carry, resized to the CURRENT replica bucket.
+        Same bucket (every resume that didn't flip TPUDES_BUCKETING):
+        verbatim, zero heuristics.  Changed bucket: only the leaves the
+        save-time marker identified as replica-bearing are resized —
+        real rows kept, pad rows rebuilt by edge replication (pad rows
+        are independent replicas whose results are sliced off at
+        unpack, and their PRNG streams are re-derived per-index, so
+        any valid state row serves)."""
+        import numpy as np
+
+        host = doc["carry"]
+        saved_r_pad = doc.get("r_pad")
+        if ctx.r_pad == saved_r_pad:
+            return host
+        if ctx.r_pad is None or saved_r_pad is None:
+            raise CheckpointError(
+                f"{self.path}: replica-axis presence changed between "
+                "save and resume"
+            )
+        # indices into the saved axis: the real rows, edge-replicated
+        # out to the new bucket
+        idx = np.minimum(np.arange(ctx.r_pad), ctx.replicas - 1)
+
+        def resize(v, is_replica):
+            if not is_replica:
+                return v
+            return np.take(np.asarray(v), idx, axis=ctx.axis)
+
+        return _tree_map2_np(resize, host, doc["replica_leaf"])
+
+
+@dataclass
+class _CkptCtx:
+    """Everything drive_chunks needs to save/restore one run."""
+
+    ckpt: CarryCheckpoint
+    engine: str
+    fingerprint: str
+    replicas: int | None
+    r_pad: int | None
+    axis: int
+    mesh: object = None
+
+
+def checkpoint_ctx(
+    checkpoint,
+    *,
+    engine: str,
+    key,
+    replicas: int | None,
+    r_pad: int | None,
+    n_cfg: int | None,
+    obs: bool,
+    axis: int,
+    mesh=None,
+    extra: tuple = (),
+) -> _CkptCtx | None:
+    """Build the drive_chunks checkpoint context (None passes through).
+    ``extra`` is the engine's static identity (its program cache key +
+    sweep points): anything that, if changed, would make the saved
+    carry mean a different study."""
+    if checkpoint is None:
+        return None
+    ckpt = (
+        checkpoint
+        if isinstance(checkpoint, CarryCheckpoint)
+        else CarryCheckpoint(checkpoint)
+    )
+    ident = repr((
+        engine,
+        _key_bytes(key).hex(),
+        None if replicas is None else int(replicas),
+        None if n_cfg is None else int(n_cfg),
+        bool(obs),
+        extra,
+    ))
+    fp = hashlib.sha256(ident.encode()).hexdigest()
+    return _CkptCtx(
+        ckpt, engine, fp,
+        None if replicas is None else int(replicas),
+        None if r_pad is None else int(r_pad),
+        int(axis), mesh,
+    )

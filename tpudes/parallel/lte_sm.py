@@ -717,6 +717,7 @@ def _run_lte_sm_mobile(
     *,
     schedulers=None,
     chunk_ttis: int | None = None,
+    checkpoint=None,
     block: bool = True,
 ):
     """The mobile-geometry form of :func:`run_lte_sm` (same contract,
@@ -811,6 +812,15 @@ def _run_lte_sm_mobile(
     s0 = shard_replica_axis(s0, mesh, r_pad, 0 if n_cfg is None else 1)
     carry = (t0, g0, s0)
 
+    from tpudes.parallel.checkpoint import checkpoint_ctx
+
+    ckpt = checkpoint_ctx(
+        checkpoint, engine="lte_sm", key=key, replicas=replicas,
+        r_pad=r_pad, n_cfg=n_cfg, obs=obs,
+        axis=0 if n_cfg is None else 1, mesh=mesh,
+        extra=_sm_cache_key(prog, None, n_cfg, obs, False)
+        + ("mobile", dg_on, k_ref, stride, tuple(sids)),
+    )
     with CompileTelemetry.timed("lte_sm", compiling):
         carry, flush = drive_chunks(
             "lte_sm",
@@ -821,6 +831,7 @@ def _run_lte_sm_mobile(
                 jnp.int32(stride), pos_table,
             ),
             obs,
+            checkpoint=ckpt,
         )
         if compiling:
             jax.block_until_ready(carry)
@@ -873,6 +884,7 @@ def run_lte_sm(
     *,
     schedulers=None,
     chunk_ttis: int | None = None,
+    checkpoint=None,
     block: bool = True,
 ):
     """Run the full-buffer downlink simulation on-device.
@@ -910,7 +922,8 @@ def run_lte_sm(
     if prog.mobility is not None:
         return _run_lte_sm_mobile(
             prog, key, replicas=replicas, mesh=mesh,
-            schedulers=schedulers, chunk_ttis=chunk_ttis, block=block,
+            schedulers=schedulers, chunk_ttis=chunk_ttis,
+            checkpoint=checkpoint, block=block,
         )
     from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
     from tpudes.parallel.runtime import (
@@ -971,6 +984,15 @@ def run_lte_sm(
         carry, mesh, r_pad, 0 if n_cfg is None else 1
     )
 
+    from tpudes.parallel.checkpoint import checkpoint_ctx
+
+    ckpt = checkpoint_ctx(
+        checkpoint, engine="lte_sm", key=key, replicas=replicas,
+        r_pad=r_pad, n_cfg=n_cfg, obs=obs,
+        axis=0 if n_cfg is None else 1, mesh=mesh,
+        extra=_sm_cache_key(prog, None, n_cfg, obs, False)
+        + (tuple(sids),),
+    )
     # scheduler id and horizon are traced, so a 9-scheduler sweep must
     # keep the recorded compile count at ONE — bench reports the metric
     with CompileTelemetry.timed("lte_sm", compiling):
@@ -980,6 +1002,7 @@ def run_lte_sm(
             carry,
             lambda c, t_end: fn(c, keys, sid, jnp.int32(t_end)),
             obs,
+            checkpoint=ckpt,
         )
         if compiling:
             jax.block_until_ready(carry)

@@ -78,6 +78,39 @@ def unpack_frame(buf: bytes):
     return pickle.loads(buf[_HEADER_LEN:])
 
 
+def send_frame(conn, obj, *, chaos_site: str | None = None,
+               member: int | None = None) -> None:
+    """Frame + send one object.  ``chaos_site`` passes the outgoing
+    blob through :func:`tpudes.chaos.filter_frame` so a deterministic
+    chaos schedule can truncate/corrupt it at the frame layer — the
+    production path (site None) never imports chaos."""
+    blob = pack_frame(obj)
+    if chaos_site is not None:
+        from tpudes.chaos import filter_frame
+
+        blob = filter_frame(chaos_site, blob, member=member)
+    conn.send_bytes(blob)
+
+
+def recv_frame(conn, timeout_s: float | None = None, *,
+               chaos_site: str | None = None, member: int | None = None):
+    """Receive + validate one frame, waiting at most ``timeout_s``
+    (None blocks — only the shutdown-drain paths may do that; see
+    analysis rule SRV001).  Raises ``TimeoutError`` when nothing
+    arrives in time, ``EOFError``/``OSError`` when the peer is gone,
+    :class:`WireFormatError` on a bad frame."""
+    if timeout_s is not None and not conn.poll(timeout_s):
+        raise TimeoutError(
+            f"no frame within {timeout_s:.1f}s (dead or hung peer?)"
+        )
+    blob = conn.recv_bytes()
+    if chaos_site is not None:
+        from tpudes.chaos import filter_frame
+
+        blob = filter_frame(chaos_site, blob, member=member)
+    return unpack_frame(blob)
+
+
 class MpiInterface:
     """Process-global rank state + transport (mpi-interface.h API)."""
 
@@ -306,16 +339,27 @@ class MpiInterface:
         return grant
 
 
-def LaunchDistributed(target, size: int, args: tuple = (), timeout_s: float = 120.0):
+def LaunchDistributed(target, size: int, args: tuple = (),
+                      timeout_s: float = 120.0,
+                      optional_ranks: frozenset | set | tuple = ()):
     """Run ``target(rank, size, *args) -> result`` in ``size`` local
     processes wired all-to-all; returns [result_0, ..., result_{size-1}].
 
     The spawn start method keeps children free of the parent's JAX/TPU
     state (a forked XLA client is not fork-safe).
+
+    ``optional_ranks`` names ranks whose *death without a result* is
+    tolerated (their slot returns None) — the chaos harness SIGKILLs
+    member ranks mid-run and the survivors' results must still gather.
+    A required rank dying (or reporting failure) still raises.
     """
     import multiprocessing as mp
+    import queue as _queue
+
+    from tpudes.obs.distributed import wall_now
 
     ctx = mp.get_context("spawn")
+    optional = set(optional_ranks)
     # duplex pipe per unordered pair
     pipes = {}
     for i in range(size):
@@ -336,18 +380,64 @@ def LaunchDistributed(target, size: int, args: tuple = (), timeout_s: float = 12
     for p in procs:
         p.start()
     results: dict[int, object] = {}
+    needed = set(range(size))
+    deadline = wall_now() + timeout_s
     try:
-        for _ in range(size):
-            rank, ok, payload = result_q.get(timeout=timeout_s)
+        while needed:
+            try:
+                # bounded poll (not one big blocking get): a SIGKILLed
+                # optional rank never posts, so we must interleave
+                # queue reads with liveness sweeps
+                rank, ok, payload = result_q.get(
+                    timeout=min(0.5, max(0.01, deadline - wall_now()))
+                )
+            except _queue.Empty:
+                # drain anything already posted BEFORE the liveness
+                # sweep: a rank that posted its result and then died
+                # (e.g. chaos-killed right after) must not have that
+                # result discarded as if it never reported
+                while True:
+                    try:
+                        rank, ok, payload = result_q.get_nowait()
+                    except _queue.Empty:
+                        break
+                    if not ok:
+                        raise RuntimeError(f"rank {rank} failed:\n{payload}")
+                    results[rank] = payload
+                    needed.discard(rank)
+                for r in list(needed):
+                    if r not in optional or procs[r].is_alive():
+                        continue
+                    results[r] = None  # died without a result: tolerated
+                    needed.discard(r)
+                dead_required = [
+                    r for r in needed
+                    if r not in optional and not procs[r].is_alive()
+                ]
+                if dead_required:
+                    # fail fast: a required rank hard-crashed (SIGKILL/
+                    # OOM) without posting — waiting out the full
+                    # timeout would just delay the same error
+                    raise RuntimeError(
+                        f"required rank(s) {sorted(dead_required)} died "
+                        "without posting a result"
+                    )
+                if needed and wall_now() > deadline:
+                    raise RuntimeError(
+                        f"ranks {sorted(needed)} produced no result within "
+                        f"{timeout_s}s"
+                    )
+                continue
             if not ok:
                 raise RuntimeError(f"rank {rank} failed:\n{payload}")
             results[rank] = payload
+            needed.discard(rank)
     finally:
         for p in procs:
             p.join(timeout=10)
             if p.is_alive():
                 p.terminate()
-    return [results[r] for r in range(size)]
+    return [results.get(r) for r in range(size)]
 
 
 def _rank_main(target, rank, size, conns, args, result_q):

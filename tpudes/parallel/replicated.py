@@ -1166,6 +1166,7 @@ def run_replicated_bss(
     *,
     sim_end_us=None,
     chunk_steps: int | None = None,
+    checkpoint=None,
     block: bool = True,
     geom_per_step: bool = False,
 ):
@@ -1196,12 +1197,16 @@ def run_replicated_bss(
 
     ``chunk_steps=N`` splits the event loop into N-iteration segments
     with a donated carry handoff (bit-identical: the loop condition
-    depends only on the carry).  ``block=False`` returns an
-    :class:`~tpudes.parallel.runtime.EngineFuture`.
+    depends only on the carry).  ``checkpoint=`` (a path or
+    :class:`~tpudes.parallel.checkpoint.CarryCheckpoint`) persists the
+    carry after each segment and resumes a matching run from its last
+    completed segment, bit-equal to uninterrupted.  ``block=False``
+    returns an :class:`~tpudes.parallel.runtime.EngineFuture`.
     """
     import dataclasses
 
     from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+    from tpudes.parallel.checkpoint import checkpoint_ctx
     from tpudes.parallel.runtime import (
         EngineFuture,
         bucket_replicas,
@@ -1262,12 +1267,19 @@ def run_replicated_bss(
             )
             return (state, still_pending), metrics
 
+        ckpt = checkpoint_ctx(
+            checkpoint, engine="bss", key=key, replicas=replicas,
+            r_pad=r_pad, n_cfg=n_cfg, obs=obs,
+            axis=0 if n_cfg is None else 1, mesh=mesh,
+            extra=_prog_cache_key(prog) + (tuple(ends), geom_per_step),
+        )
         (out, still_pending), flush = drive_chunks(
             "bss",
             chunk_bounds(max_steps, chunk_steps or max_steps),
             (s0, None),
             launch,
             obs,
+            checkpoint=ckpt,
         )
         # one batched device→host transfer for every result (steps/
         # all_done ride along instead of costing their own round trips)

@@ -163,7 +163,8 @@ def chunk_bounds(total: int, chunk: int) -> list[int]:
     return list(range(chunk, total, chunk)) + [total]
 
 
-def drive_chunks(engine: str, bounds, carry, launch, obs: bool):
+def drive_chunks(engine: str, bounds, carry, launch, obs: bool,
+                 checkpoint=None):
     """The one chunk-dispatch protocol every engine runs: one device
     launch per bound; when observability is up AND the run is actually
     chunked (>1 bound — a single-shot run has no chunk stream), chunk
@@ -179,16 +180,33 @@ def drive_chunks(engine: str, bounds, carry, launch, obs: bool):
     other computed output), never a leaf of the returned carry: the
     next launch donates the carry on accelerators, and a metrics tree
     aliasing it would be deleted before the deferred fetch reads it.
+
+    ``checkpoint`` (a :func:`tpudes.parallel.checkpoint.checkpoint_ctx`
+    result) persists the carry after every completed chunk and, when a
+    matching checkpoint already exists, SKIPS the completed chunks and
+    resumes from the restored carry — bit-equal to an uninterrupted
+    run, since per-step randomness is ``fold_in``-keyed and segment-
+    boundary-indifferent.  Checkpointing trades the chunk-pipelining
+    overlap for durability: each save blocks on that chunk's D2H.
     """
     import jax
 
     from tpudes.obs.device import ChunkStream
 
+    bounds = list(bounds)
+    start = 0
+    if checkpoint is not None:
+        restored = checkpoint.ckpt.restore(checkpoint, bounds)
+        if restored is not None:
+            done_bound, carry = restored
+            start = bounds.index(done_bound) + 1
     stream = obs and len(bounds) > 1
     prev = None
-    for bound in bounds:
+    for bound in bounds[start:]:
         carry, metrics = launch(carry, bound)
         RUNTIME.record_launch(engine)
+        if checkpoint is not None:
+            checkpoint.ckpt.save(checkpoint, bound, bounds, carry)
         if stream:
             if prev is not None:
                 ChunkStream.record(engine, prev[0], jax.device_get(prev[1]))

@@ -1245,6 +1245,7 @@ def run_tcp_dumbbell(
     *,
     variants=None,
     chunk_slots: int | None = None,
+    checkpoint=None,
     block: bool = True,
 ):
     """Execute R replicas of the dumbbell program; returns per-replica
@@ -1264,10 +1265,14 @@ def run_tcp_dumbbell(
 
     ``chunk_slots=N`` splits the horizon into N-slot segments with a
     donated carry handoff (bit-identical to single-shot; per-chunk
-    metrics stream to ``tpudes.obs``).  ``block=False`` returns an
-    :class:`~tpudes.parallel.runtime.EngineFuture`.
+    metrics stream to ``tpudes.obs``).  ``checkpoint=`` (a path or
+    :class:`~tpudes.parallel.checkpoint.CarryCheckpoint`) persists the
+    carry after each chunk and resumes a matching run from its last
+    completed chunk, bit-equal to uninterrupted.  ``block=False``
+    returns an :class:`~tpudes.parallel.runtime.EngineFuture`.
     """
     from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+    from tpudes.parallel.checkpoint import checkpoint_ctx
     from tpudes.parallel.runtime import (
         RUNTIME,
         EngineFuture,
@@ -1320,6 +1325,13 @@ def run_tcp_dumbbell(
         carry, mesh, r_pad, 0 if n_cfg is None else 1
     )
 
+    ckpt = checkpoint_ctx(
+        checkpoint, engine="dumbbell", key=key, replicas=replicas,
+        r_pad=r_pad, n_cfg=n_cfg, obs=obs,
+        axis=0 if n_cfg is None else 1, mesh=mesh,
+        extra=dumbbell_prog_key(prog)
+        + (tuple(tuple(int(i) for i in p) for p in points),),
+    )
     with CompileTelemetry.timed("dumbbell", compiling):
         carry, flush = drive_chunks(
             "dumbbell",
@@ -1327,6 +1339,7 @@ def run_tcp_dumbbell(
             carry,
             lambda c, t_end: fn(c, key, var, ecn, jnp.int32(t_end)),
             obs,
+            checkpoint=ckpt,
         )
         if compiling:
             jax.block_until_ready(carry)

@@ -24,13 +24,25 @@ Quick start::
     server.close()
 """
 
+from tpudes.parallel.checkpoint import CarryCheckpoint, CheckpointError
 from tpudes.serving.descriptor import StudyDescriptor, mesh_fingerprint
 from tpudes.serving.distributed import ProcessRouter, serve_studies
-from tpudes.serving.server import AdmissionError, StudyHandle, StudyServer
+from tpudes.serving.errors import MemberLostError, RetryBudgetError
+from tpudes.serving.server import (
+    SLO_CLASSES,
+    AdmissionError,
+    StudyHandle,
+    StudyServer,
+)
 
 __all__ = [
+    "SLO_CLASSES",
     "AdmissionError",
+    "CarryCheckpoint",
+    "CheckpointError",
+    "MemberLostError",
     "ProcessRouter",
+    "RetryBudgetError",
     "StudyDescriptor",
     "StudyHandle",
     "StudyServer",

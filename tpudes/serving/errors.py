@@ -1,0 +1,44 @@
+"""Typed serving-layer failures (the requeue path's vocabulary).
+
+The fault-tolerance contract (ISSUE 13) needs the scheduler to tell
+*recoverable* transport faults apart from *deterministic* study
+failures: a member process dying mid-batch is recoverable (requeue the
+batch onto survivors or the local engine — results are bit-equal by
+the coalesce/demux contract), while a study whose program is genuinely
+broken must not burn the retry budget pretending otherwise.
+"""
+
+from __future__ import annotations
+
+__all__ = ["MemberLostError", "RetryBudgetError"]
+
+
+class MemberLostError(RuntimeError):
+    """A routed member process is gone or its frame stream is no longer
+    trustworthy: EOF/closed pipe (the process died), a
+    :class:`~tpudes.parallel.mpi.WireFormatError` (truncated/corrupted/
+    mixed-version frame — the stream cannot be resynchronized), or a
+    reply timeout (a hung member is indistinguishable from a dead one
+    and its late reply would desync the next batch).  Carries the
+    member ids so the router can exclude them from future launches."""
+
+    def __init__(self, members, detail: str = ""):
+        self.members = tuple(members)
+        msg = f"routed member(s) {list(self.members)} lost"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class RetryBudgetError(RuntimeError):
+    """A study was requeued past its retry budget; ``__cause__`` chains
+    the last transient failure.  Raised through the study's handle —
+    the caller decides whether to resubmit."""
+
+    def __init__(self, retries: int, last: BaseException):
+        super().__init__(
+            f"study failed after {retries} retries "
+            f"(last: {type(last).__name__}: {last})"
+        )
+        self.retries = retries
+        self.__cause__ = last

@@ -1,15 +1,15 @@
 """StudyServer: continuous batching of independently arriving studies.
 
 The long-lived serving layer on top of :data:`tpudes.parallel.runtime.RUNTIME`
-(ROADMAP item 1): clients call :meth:`StudyServer.submit_study` and get a
-:class:`StudyHandle` back immediately; a coalescing scheduler drains the
-request queue and merges **compatible** studies — same engine, same
-static cache key; differences only in traced operands (scheduler id,
-TCP variant assignment, BSS horizon, AS load scale) — into ONE
-megabatched config-axis device launch, demultiplexing per-study results
-back through each handle.  This is the simulator analog of continuous
-batching in LLM serving: the hardware sees dense (C, R, …) launches
-even when every study arrives alone.
+(ROADMAP items 1 and 6): clients call :meth:`StudyServer.submit_study`
+and get a :class:`StudyHandle` back immediately; a coalescing scheduler
+drains the request queue and merges **compatible** studies — same
+engine, same static cache key; differences only in traced operands
+(scheduler id, TCP variant assignment, BSS horizon, AS load scale) —
+into ONE megabatched config-axis device launch, demultiplexing
+per-study results back through each handle.  This is the simulator
+analog of continuous batching in LLM serving: the hardware sees dense
+(C, R, …) launches even when every study arrives alone.
 
 Correctness is inherited, not approximated: the PR-5 sweep arguments
 are pinned bit-equal to per-point solo launches (tests/test_sweep.py),
@@ -23,10 +23,29 @@ Operating behavior:
 - **Batching deadline** (``max_wait_s``): the head-of-queue study waits
   at most this long for batchmates; a lone study is dispatched alone at
   the deadline, never starved.
+- **SLO classes** (``slo=`` on submit; :data:`SLO_CLASSES`): the
+  scheduler picks the due head by (priority, arrival) instead of pure
+  FIFO, and ``gold`` studies *preempt* coalesce-pending work — a gold
+  head dispatches immediately with whatever batchmates are already
+  queued instead of waiting out the batching deadline behind
+  lower-priority batch formation.  Per-class latency targets
+  (``slo_targets``) feed the SLO-attainment telemetry.
 - **Admission control**: per-tenant cap on queued+in-flight studies
   (:class:`AdmissionError` on overflow) in front of the device-side
   bounded in-flight window (``TPUDES_INFLIGHT``) that
   :meth:`EngineRuntime.submit` enforces at dispatch.
+- **Fault tolerance** (ISSUE 13): a batch that loses a routed member
+  (:class:`~tpudes.serving.errors.MemberLostError` — death, wire
+  corruption, or timeout) or hits a transient launch fault
+  (:class:`~tpudes.chaos.ChaosInjected` — the chaos harness's
+  compile/OOM shape) is **requeued**, with the lost member excluded
+  from future routing, under a bounded per-study ``retry_budget`` with
+  exponential ``retry_backoff_s`` between attempts; past the budget the
+  handle raises :class:`~tpudes.serving.errors.RetryBudgetError`.
+  Requeued studies re-coalesce and relaunch through the same
+  descriptors, so recovered results are bit-equal to a failure-free
+  run.  An exception escaping dispatch/demux poisons only that batch's
+  handles — the scheduler loop itself never dies.
 - **pow2 batch buckets**: a coalesced batch pads its config axis to the
   next power of two by duplicating the tail point (results discarded),
   so the server compiles one executable per bucket, not per batch size;
@@ -37,9 +56,9 @@ Operating behavior:
   persistent-cache disk hits instead of fresh XLA compiles.
 - **Metrics**: every decision is recorded in
   :class:`tpudes.obs.serving.ServingTelemetry` (queue depth, coalesce
-  rate, batch occupancy, launch latency p50/p99); :meth:`metrics`
-  snapshots it and ``python -m tpudes.obs --serving dump.json``
-  validates a dump.
+  rate, batch occupancy, launch latency p50/p99, failure/recovery
+  counters, per-class SLO attainment); :meth:`metrics` snapshots it and
+  ``python -m tpudes.obs --serving dump.json`` validates a dump.
 
 Threading model: ALL device work (launch, D2H, unpack) happens on the
 single scheduler thread (or the caller's thread via :meth:`pump` when
@@ -50,20 +69,43 @@ Client threads only build descriptors, enqueue, and wait on events.
 from __future__ import annotations
 
 import importlib
+import itertools
 import threading
 import time
+from dataclasses import dataclass, field
 from collections import deque
-from dataclasses import dataclass
 
 from tpudes.obs.serving import ServingTelemetry
 from tpudes.serving.descriptor import StudyDescriptor
+from tpudes.serving.errors import MemberLostError, RetryBudgetError
 
-__all__ = ["AdmissionError", "StudyHandle", "StudyServer"]
+__all__ = [
+    "SLO_CLASSES",
+    "AdmissionError",
+    "StudyHandle",
+    "StudyServer",
+]
 
 
 class AdmissionError(RuntimeError):
     """The tenant's queued+in-flight study cap is exhausted; retry
     after some of its studies complete."""
+
+
+#: SLO class -> scheduling priority (lower dispatches first).  ``gold``
+#: additionally preempts coalesce-pending work (see module docstring).
+SLO_CLASSES = {"gold": 0, "standard": 1, "batch": 2}
+
+#: classes whose head never waits out the batching deadline
+_PREEMPT = frozenset({"gold"})
+
+#: default per-class latency targets (seconds) for SLO attainment —
+#: deliberately loose; operators pass ``slo_targets=`` for real fleets
+DEFAULT_SLO_TARGETS = {
+    "gold": 2.0, "standard": 30.0, "batch": float("inf"),
+}
+
+_INF = float("inf")
 
 
 #: engine name -> (module, study-descriptor extraction function); the
@@ -80,9 +122,10 @@ _ENGINE_STUDY = {
 class StudyHandle:
     """Client-side future for one submitted study."""
 
-    def __init__(self, engine: str, tenant: str):
+    def __init__(self, engine: str, tenant: str, slo: str = "standard"):
         self.engine = engine
         self.tenant = tenant
+        self.slo = slo
         #: how many real studies shared this study's launch (set at
         #: completion; 1 means it was dispatched alone)
         self.batch_size: int | None = None
@@ -118,6 +161,13 @@ class _Request:
     tenant: str
     handle: StudyHandle
     t_submit: float
+    slo: str = "standard"
+    priority: int = 1
+    preempt: bool = False
+    seq: int = 0
+    #: requeue state (ISSUE 13): attempts so far + earliest redispatch
+    retries: int = 0
+    t_ready: float = field(default=0.0)
 
 
 def _pow2(n: int) -> int:
@@ -137,12 +187,24 @@ class StudyServer:
         warm: list | None = None,
         start: bool = True,
         router=None,
+        retry_budget: int = 3,
+        retry_backoff_s: float = 0.05,
+        slo_targets: dict | None = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.max_wait_s = float(max_wait_s)
         self.max_batch = int(max_batch)
         self.tenant_cap = int(tenant_cap)
+        #: bounded retries per study for transient faults (member loss,
+        #: chaos-injected launch errors); exceeded -> RetryBudgetError
+        self.retry_budget = int(retry_budget)
+        #: base backoff before a requeued batch redispatches (doubles
+        #: per retry); force-pump/close ignore it so drains terminate
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.slo_targets = dict(DEFAULT_SLO_TARGETS)
+        if slo_targets:
+            self.slo_targets.update(slo_targets)
         #: optional cross-process dispatcher
         #: (:class:`tpudes.serving.distributed.ProcessRouter`): coalesced
         #: batches whose studies carry a picklable spec split across the
@@ -153,6 +215,7 @@ class StudyServer:
         #: dispatched launches not yet demuxed: (future, batch, t0)
         self._pending: deque[tuple] = deque()
         self._tenant_load: dict[str, int] = {}
+        self._seq = itertools.count()
         self._running = False
         self._closed = False
         self._thread: threading.Thread | None = None
@@ -172,6 +235,7 @@ class StudyServer:
         *,
         mesh=None,
         tenant: str = "default",
+        slo: str = "standard",
         **engine_kwargs,
     ) -> StudyHandle:
         """Queue one study; returns immediately with its handle.
@@ -179,19 +243,24 @@ class StudyServer:
         ``engine`` is one of ``bss`` / ``lte_sm`` / ``dumbbell`` /
         ``as_flows``; ``prog`` the engine's lowered Program dataclass;
         ``key``/``replicas``/``mesh`` exactly what the engine's
-        ``run_*`` entry takes.  Extra ``engine_kwargs`` flow to the
+        ``run_*`` entry takes.  ``slo`` picks the scheduling class
+        (:data:`SLO_CLASSES`).  Extra ``engine_kwargs`` flow to the
         engine's study extractor (e.g. ``rate_scale=`` for the AS
         engine).  Raises :class:`AdmissionError` when ``tenant``
         already has ``tenant_cap`` studies queued or in flight."""
         mod_name, fn_name = _ENGINE_STUDY[engine]
         extract = getattr(importlib.import_module(mod_name), fn_name)
         desc = extract(prog, key, replicas, mesh=mesh, **engine_kwargs)
-        return self.submit(desc, tenant=tenant)
+        return self.submit(desc, tenant=tenant, slo=slo)
 
-    def submit(self, desc: StudyDescriptor, tenant: str = "default"
-               ) -> StudyHandle:
+    def submit(self, desc: StudyDescriptor, tenant: str = "default",
+               slo: str = "standard") -> StudyHandle:
         """Queue a pre-extracted :class:`StudyDescriptor`."""
-        handle = StudyHandle(desc.engine, tenant)
+        if slo not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown SLO class {slo!r} (have {sorted(SLO_CLASSES)})"
+            )
+        handle = StudyHandle(desc.engine, tenant, slo)
         with self._cond:
             if self._closed:
                 # a closed server never strands a handle — including
@@ -205,9 +274,11 @@ class StudyServer:
                     "queued/in flight (tenant_cap)"
                 )
             self._tenant_load[tenant] = self._tenant_load.get(tenant, 0) + 1
-            self._queue.append(
-                _Request(desc, tenant, handle, time.monotonic())
-            )
+            self._queue.append(_Request(
+                desc, tenant, handle, time.monotonic(), slo=slo,
+                priority=SLO_CLASSES[slo], preempt=slo in _PREEMPT,
+                seq=next(self._seq),
+            ))
             ServingTelemetry.record_submit(desc.engine, len(self._queue))
             self._cond.notify_all()
         return handle
@@ -278,7 +349,8 @@ class StudyServer:
     def close(self) -> None:
         """Stop the scheduler, force-dispatching and completing every
         queued/in-flight study first (a closed server never strands a
-        handle)."""
+        handle — a study mid-retry either completes or surfaces its
+        RetryBudgetError)."""
         thread = self._thread
         with self._cond:
             self._running = False
@@ -294,19 +366,28 @@ class StudyServer:
 
     def pump(self, force: bool = True) -> int:
         """Synchronously dispatch what is due (everything queued when
-        ``force``) and demux every completed launch — the deterministic
-        single-thread mode (``start=False``); returns the number of
-        studies completed.  Must not be called while the background
-        thread runs."""
+        ``force`` — including batches still backing off) and demux
+        every completed launch, following requeues until the queue
+        drains — the deterministic single-thread mode (``start=False``);
+        returns the number of studies completed.  Must not be called
+        while the background thread runs."""
         done = 0
         while True:
             with self._cond:
                 batch = self._take_batch(force=force)
-            if batch is None:
-                break
-            self._dispatch(batch)
-        while self._pending:
-            done += self._demux_oldest()
+            if batch is not None:
+                self._dispatch(batch)
+                continue
+            if self._pending:
+                done += self._demux_oldest()
+                continue
+            with self._cond:
+                if not (force and self._queue):
+                    break
+            # a racing client submit landed between the lock drops
+            # (force mode always takes a batch from a settled queue) —
+            # yield briefly and re-take
+            time.sleep(0.001)
         return done
 
     def _loop(self) -> None:
@@ -323,52 +404,95 @@ class StudyServer:
                     return
                 batch = self._take_batch(force=not self._running)
                 if batch is None and self._queue and self._running:
-                    # head not due: sleep until its deadline or a new
-                    # arrival, whichever first
-                    head_age = time.monotonic() - self._queue[0].t_submit
-                    self._cond.wait(
-                        timeout=max(0.001, self.max_wait_s - head_age)
-                    )
+                    # head not due: sleep until its deadline, a retry
+                    # backoff expiring, or a new arrival — bounded so
+                    # the loop keeps sweeping pending work
+                    self._cond.wait(timeout=self._nap_s())
                     batch = self._take_batch(force=not self._running)
                 elif batch is None and not self._pending and self._running:
                     self._cond.wait(timeout=0.05)
             if batch is not None:
-                self._dispatch(batch)
-                RUNTIME.poll()  # sweep the window, never blocks
-            # demux finished launches; a blocking result() would pin
-            # the scheduler to one launch wall while a fresh arrival
-            # could be dispatching into the window, so while live we
-            # only nap (woken early by any submit) and retire done work
-            while self._pending and self._pending[0][0].done():
-                self._demux_oldest()
+                try:
+                    self._dispatch(batch)
+                except Exception as e:  # noqa: BLE001 - hardening: an
+                    # escaped dispatch error fails THIS batch's handles,
+                    # never the scheduler thread (ISSUE 13 satellite)
+                    self._finish_batch(batch, error=e, n_real=len(batch))
+                try:
+                    RUNTIME.poll()  # sweep the window, never blocks
+                except Exception:  # noqa: BLE001 - a poisoned window
+                    # future resurfaces via its own demux
+                    ServingTelemetry.record_backstop()
+            # demux finished launches (and force-demux any whose member
+            # deadline passed — a hung member must not pin its batch);
+            # a blocking result() on live work would serialize the
+            # scheduler, so while running we only retire what is ready
+            try:
+                while self._pending and (
+                    self._pending[0][0].done()
+                    or getattr(self._pending[0][0], "deadline", _INF)
+                    <= time.monotonic()
+                ):
+                    self._demux_oldest()
+            except Exception:  # noqa: BLE001 - _demux_oldest poisons
+                # per-batch; this is the loop's counted backstop
+                ServingTelemetry.record_backstop()
             if batch is None and self._pending and not self._queue:
                 if self._running:
                     with self._cond:
                         if self._running and not self._queue:
                             self._cond.wait(timeout=0.002)
                 else:
-                    self._demux_oldest()  # shutdown drain: block
+                    try:
+                        self._demux_oldest()  # shutdown drain: block
+                    except Exception:  # noqa: BLE001 - see above
+                        ServingTelemetry.record_backstop()
+
+    def _nap_s(self) -> float:
+        """Scheduler nap (caller holds the lock): until the oldest
+        head's batching deadline, capped so retry backoffs and pending
+        sweeps stay responsive."""
+        now = time.monotonic()
+        ages = [now - r.t_submit for r in self._queue]
+        rem = self.max_wait_s - (max(ages) if ages else 0.0)
+        return min(0.05, max(0.001, rem))
 
     def _take_batch(self, force: bool) -> list | None:
-        """Pop the head study's batch when it is due (caller holds the
-        lock): due = solo study, batch full, deadline reached, or
-        ``force``.  Batchmates are every queued request sharing the
-        head's coalesce key, in arrival order, up to ``max_batch``."""
+        """Pop the due batch (caller holds the lock).  The head is the
+        highest-priority (then oldest) request whose retry backoff has
+        expired; due = solo study, batch full, deadline reached,
+        preempting SLO class, or ``force`` (which also overrides
+        backoff so drains terminate).  Batchmates are every eligible
+        queued request sharing the head's coalesce key, in arrival
+        order, up to ``max_batch``."""
         if not self._queue:
             return None
-        head = self._queue[0]
+        now = time.monotonic()
+        ready = (
+            list(self._queue) if force
+            else [r for r in self._queue if r.t_ready <= now]
+        )
+        if not ready:
+            return None
+        head = min(ready, key=lambda r: (r.priority, r.seq))
         if head.desc.solo:
             mates = [head]
         else:
-            mates = [
-                r for r in self._queue
-                if r.desc.compatible(head.desc)
-            ][: self.max_batch]
+            # the head rides FIRST: with more compatible requests than
+            # max_batch queued, a plain arrival-order slice could cut
+            # the priority-selected head out of the very batch its
+            # preempt flag made due (gold would force-dispatch other
+            # tenants' work while itself staying queued)
+            mates = [head] + [
+                r for r in ready
+                if r is not head and r.desc.compatible(head.desc)
+            ][: self.max_batch - 1]
         due = (
             force
             or head.desc.solo
+            or head.preempt
             or len(mates) >= self.max_batch
-            or (time.monotonic() - head.t_submit) >= self.max_wait_s
+            or (now - head.t_submit) >= self.max_wait_s
         )
         if not due:
             return None
@@ -379,8 +503,11 @@ class StudyServer:
 
     def _dispatch(self, batch: list) -> None:
         """Launch one (possibly coalesced) batch through the runtime's
-        bounded in-flight window.  Never raises: a failed launch
-        poisons the batch's handles instead of killing the scheduler."""
+        bounded in-flight window.  Never raises: a transient fault
+        (chaos-injected launch error, member loss at send) requeues the
+        batch under its retry budget; anything else poisons the batch's
+        handles instead of killing the scheduler."""
+        from tpudes.chaos import ChaosInjected, maybe_fail
         from tpudes.parallel.runtime import RUNTIME
 
         points = [r.desc.sweep_point for r in batch]
@@ -391,6 +518,9 @@ class StudyServer:
             points = points + [points[-1]] * (_pow2(n_real) - n_real)
         t0 = time.monotonic()
         try:
+            maybe_fail(
+                "local_launch", what=f"{batch[0].desc.engine} launch"
+            )
             fut = None
             if self.router is not None:
                 # routed dispatch: the batch's point blocks fan out to
@@ -398,6 +528,13 @@ class StudyServer:
                 fut = self.router.launch(batch, points)
             if fut is None:
                 fut = RUNTIME.submit(batch[0].desc.launch, points)
+        except (ChaosInjected, MemberLostError) as e:
+            if isinstance(e, MemberLostError) and self.router is not None:
+                for m in e.members:
+                    self.router.exclude(m)
+                ServingTelemetry.record_member_lost(len(e.members))
+            self._requeue(batch, e)
+            return
         except Exception as e:  # noqa: BLE001 - poison, don't crash
             self._finish_batch(batch, error=e, n_real=n_real)
             return
@@ -409,24 +546,86 @@ class StudyServer:
         self._pending.append((fut, batch, t0))
 
     def _demux_oldest(self) -> int:
-        """Retire the oldest pending launch and complete its handles."""
+        """Retire the oldest pending launch and complete its handles;
+        a recoverable failure requeues the batch instead.  Returns the
+        number of handles COMPLETED (0 on requeue)."""
+        from tpudes.chaos import ChaosInjected
+
         fut, batch, t0 = self._pending.popleft()
         engine = batch[0].desc.engine
         try:
             res = fut.result()
+        except MemberLostError as e:
+            # the member is gone (or its stream is): exclude it so the
+            # requeued batch lands on survivors or the local engine
+            if self.router is not None:
+                for m in e.members:
+                    self.router.exclude(m)
+            ServingTelemetry.record_member_lost(len(e.members))
+            self._requeue(batch, e)
+            return 0
+        except ChaosInjected as e:
+            self._requeue(batch, e)
+            return 0
         except Exception as e:  # noqa: BLE001 - poison, don't crash
             self._finish_batch(batch, error=e, n_real=len(batch))
             return len(batch)
-        ServingTelemetry.record_launch_done(
-            engine, time.monotonic() - t0
-        )
-        results = res if isinstance(res, list) else [res]
+        try:
+            ServingTelemetry.record_launch_done(
+                engine, time.monotonic() - t0
+            )
+            results = res if isinstance(res, list) else [res]
+            now = time.monotonic()
+            for r, out in zip(batch, results):  # pad tail dropped by zip
+                latency = now - r.t_submit
+                r.handle._complete(result=out, batch_size=len(batch))
+                target = self.slo_targets.get(r.slo)
+                ServingTelemetry.record_study_done(
+                    engine, latency, slo=r.slo,
+                    attained=target is None or latency <= target,
+                )
+                self._release(r.tenant)
+            return len(batch)
+        except Exception as e:  # noqa: BLE001 - hardening: anything
+            # after a successful launch (telemetry, demux bookkeeping)
+            # fails only THIS batch's still-open handles
+            for r in batch:
+                if not r.handle.done():
+                    r.handle._complete(error=e, batch_size=len(batch))
+                    self._release(r.tenant)
+            return len(batch)
+
+    def _requeue(self, batch: list, err: BaseException) -> None:
+        """Put a transiently failed batch back at the queue head with
+        exponential backoff; studies past their retry budget surface
+        :class:`RetryBudgetError` through their handles instead."""
         now = time.monotonic()
-        for r, out in zip(batch, results):  # pad tail dropped by zip
-            r.handle._complete(result=out, batch_size=len(batch))
-            ServingTelemetry.record_study_done(engine, now - r.t_submit)
+        kept: list[_Request] = []
+        dead: list[_Request] = []
+        for r in batch:
+            r.retries += 1
+            if r.retries > self.retry_budget:
+                dead.append(r)
+            else:
+                r.t_ready = now + self.retry_backoff_s * (
+                    2 ** (r.retries - 1)
+                )
+                kept.append(r)
+        with self._cond:
+            for r in reversed(kept):
+                self._queue.appendleft(r)
+            self._cond.notify_all()
+        if kept:
+            ServingTelemetry.record_requeue(
+                batch[0].desc.engine, len(kept)
+            )
+        for r in dead:
+            ServingTelemetry.record_retry_exhausted()
+            r.handle._complete(
+                error=RetryBudgetError(r.retries - 1, err),
+                batch_size=len(batch),
+            )
             self._release(r.tenant)
-        return len(batch)
 
     def _finish_batch(self, batch, error, n_real) -> None:
         del n_real
