@@ -24,6 +24,17 @@ Moving stations lift too (the ISSUE-10 device geometry pipeline):
         --mobility=const_velocity --speed=1.0 --JaxGeomStride=8 \
         --SimulatorImplementationType=tpudes::JaxSimulatorImpl \
         --JaxReplicas=64
+
+And so do realistic workloads (the ISSUE-14 device traffic stage):
+``--JaxTrafficModel=onoff`` (or mmpp / trace / cbr) swaps the STA
+arrivals onto the traffic subsystem at the echo apps' mean rate —
+bursts, modulated rates, or exact trace replay, one executable for
+the whole model family:
+
+    python examples/wifi-bss.py --nStas=8 --simTime=2 \
+        --JaxTrafficModel=onoff --JaxTrafficSeed=7 \
+        --SimulatorImplementationType=tpudes::JaxSimulatorImpl \
+        --JaxReplicas=64
 """
 
 import os

@@ -148,9 +148,10 @@ def test_corpus_has_three_seeds_per_engine():
         assert doc["kind"] == "tpudes-fuzz-corpus", path
         by_engine[doc["engine"]] = by_engine.get(doc["engine"], 0) + 1
     # ISSUE-10 added 2 mobile stride-boundary seeds each for the two
-    # radio engines (mobility + geom_stride draws)
+    # radio engines (mobility + geom_stride draws); ISSUE-14 added 3
+    # burst-boundary seeds (bss/lte_sm/dumbbell traffic draws)
     assert by_engine == {
-        "bss": 5, "lte_sm": 5, "dumbbell": 3, "as_flows": 3, "wired": 3,
+        "bss": 6, "lte_sm": 6, "dumbbell": 4, "as_flows": 3, "wired": 3,
     }
 
 
@@ -186,7 +187,7 @@ def test_planted_bug_detected_shrunk_and_replayed(monkeypatch, tmp_path):
         n_flows=2, variant="TcpNewReno", variant_mix="homogeneous",
         bottleneck_mbps=10, bottleneck_delay_ms=5, queue_pkts=25,
         seg_bytes=1000, sim_ms=900, replicas=3, chunk_divisor=2,
-        key_seed=7,
+        key_seed=7, traffic="off", tr_burst=0.1, tr_phase=0.0,
     )
     assert fz.envelope.contains(cfg) == []
     divs = run_scenario(fz, cfg, pairs=["chunked_vs_single"], record=False)

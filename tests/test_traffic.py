@@ -1,0 +1,326 @@
+"""tpudes.traffic unit surface: TrafficProgram, the closed-form device
+kernels vs their numpy host mirrors, key/shape contracts, the
+workload-telemetry schema gate, and the ISSUE-14 static-analysis
+extensions (KEY001 scope + manifest registration, planted fixtures in
+both directions)."""
+
+import dataclasses
+import json
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudes.traffic import (
+    TRAFFIC_MODEL_IDS,
+    TrafficProgram,
+    bounded_pareto_icdf,
+    bounded_pareto_mean,
+    traffic_tables,
+    unify_shapes,
+)
+from tpudes.traffic.device import (
+    avg_mult,
+    build_bits_fn,
+    build_cum_fn,
+    build_gap_fn,
+    stack_traffic_operands,
+)
+from tpudes.traffic.host import arrival_times, offered_packets
+
+
+def _progs(horizon=800_000, n=3):
+    start = np.array([1000] * n, np.int32)
+    return {
+        "cbr": TrafficProgram.cbr(start, 20_000),
+        "mmpp": TrafficProgram.mmpp(
+            n, 80.0, horizon_us=horizon, epoch_s=0.05, start_us=start,
+            tr_seed=3,
+        ),
+        "onoff": TrafficProgram.onoff(
+            n, 200.0, horizon_us=horizon, on=(1.5, 0.05, 0.4),
+            off_mean_s=0.15, start_us=start, tr_seed=5,
+        ),
+        "trace": TrafficProgram.trace_replay(
+            np.sort(
+                1000
+                + (np.arange(n * 12).reshape(n, 12) * 7919) % horizon,
+                axis=1,
+            ),
+            200 + np.arange(n * 12).reshape(n, 12) % 900,
+        ),
+    }
+
+
+class TestProgram:
+    def test_model_ids_and_per_entity_mix(self):
+        p = _progs()["mmpp"].with_cbr_rows(
+            np.array([True, False, False]), 102_400, 0
+        )
+        ids = p.model_ids()
+        assert ids[0] == TRAFFIC_MODEL_IDS["cbr"]
+        assert (ids[1:] == TRAFFIC_MODEL_IDS["mmpp"]).all()
+        assert int(p.interval_us[0]) == 102_400
+        # param key sees the mix; shape key does not
+        base = _progs()["mmpp"]
+        assert p.param_key() != base.param_key()
+        assert p.shape_key() == base.shape_key()
+
+    def test_shape_key_excludes_params_param_key_sees_them(self):
+        a = _progs()["onoff"]
+        b = dataclasses.replace(a, tr_seed=99)
+        assert a.shape_key() == b.shape_key()
+        assert a.param_key() != b.param_key()
+
+    def test_tables_are_pure_in_seed(self):
+        a = _progs()["onoff"]
+        b = TrafficProgram.onoff(
+            3, 200.0, horizon_us=800_000, on=(1.5, 0.05, 0.4),
+            off_mean_s=0.15, start_us=np.array([1000] * 3, np.int32),
+            tr_seed=5,
+        )
+        ta, tb = traffic_tables(a), traffic_tables(b)
+        for k in ta:
+            np.testing.assert_array_equal(ta[k], tb[k])
+        c = dataclasses.replace(a, tr_seed=6)
+        assert not np.array_equal(
+            traffic_tables(c)["on_len"], ta["on_len"]
+        )
+
+    def test_capacity_padding_preserves_realization_prefix(self):
+        # unify_shapes grows table capacities; the per-index fold_in
+        # streams must keep the existing prefix bit-identical (the
+        # workload-sweep demux contract depends on it)
+        a = _progs()["onoff"]
+        bigger = dataclasses.replace(a, n_cycle=a.n_cycle + 7)
+        ta, tb = traffic_tables(a), traffic_tables(bigger)
+        c = int(a.n_cycle)
+        np.testing.assert_array_equal(
+            ta["on_len"], tb["on_len"][:, :c]
+        )
+        np.testing.assert_array_equal(
+            ta["on_start"], tb["on_start"][:, :c]
+        )
+
+    def test_unify_shapes_and_stack(self):
+        pts = unify_shapes(list(_progs().values()))
+        assert len({p.shape_key() for p in pts}) == 1
+        ops = stack_traffic_operands(pts)
+        assert ops["tr_id"].shape[0] == len(pts)
+        with pytest.raises(ValueError):
+            stack_traffic_operands(
+                [pts[0], dataclasses.replace(pts[1], n_cycle=1)]
+            )
+
+    def test_trace_replay_validation(self):
+        with pytest.raises(ValueError):
+            TrafficProgram.trace_replay(
+                np.array([[500, 100, 900]], np.int64)
+            )
+        with pytest.raises(ValueError):
+            TrafficProgram.mmpp(
+                2, 10.0, horizon_us=1000, envelope=(1.5, 1.0, 0.0)
+            )
+
+    def test_bounded_pareto_mean_matches_icdf_average(self):
+        u = (np.arange(20_000) + 0.5) / 20_000
+        emp = bounded_pareto_icdf(u, 1.4, 400.0, 12_000.0).mean()
+        assert abs(emp - bounded_pareto_mean(1.4, 400.0, 12_000.0)) < 20.0
+        # degenerate branch: constant
+        assert bounded_pareto_mean(0.0, 512.0, 99.0) == 512.0
+
+    def test_pickling_drops_device_caches(self):
+        import pickle
+
+        p = _progs()["mmpp"]
+        p.operands()
+        q = pickle.loads(pickle.dumps(p))
+        assert q.param_key() == p.param_key()
+        assert "_operands_cache" not in q.__dict__
+
+
+class TestDeviceVsHost:
+    @pytest.mark.parametrize("model", ["cbr", "mmpp", "onoff", "trace"])
+    def test_cum_matches_numpy_mirror(self, model):
+        p = _progs()[model]
+        cum = build_cum_fn(p)
+        ops = p.operands()
+        for t in (0, 1000, 137_911, 500_000, 799_999):
+            dev = np.asarray(cum(ops, jnp.int32(t)))
+            host = offered_packets(p, t)
+            np.testing.assert_allclose(dev, host, rtol=2e-5, atol=1e-3)
+
+    @pytest.mark.parametrize("model", ["cbr", "onoff", "trace"])
+    def test_gap_walk_reproduces_host_arrivals(self, model):
+        # the deterministic models: walking gap_fn from the first
+        # arrival must reproduce the host mirror's arrival list
+        # EXACTLY (the trace-replay parity contract, and the
+        # closed-form onoff/cbr one)
+        p = _progs()[model]
+        gap = build_gap_fn(p)
+        ops = p.operands()
+        key = jax.random.PRNGKey(0)
+        e = 1
+        horizon = 400_000
+        want = arrival_times(p, e, horizon)
+        t = int(p.start_us[e])
+        got = []
+        while t < horizon:
+            got.append(t)
+            g = int(np.asarray(gap(ops, key, jnp.full(
+                (p.n,), t, jnp.int32)))[e])
+            if g >= 2**29:
+                break
+            t += g
+        assert got == want
+
+    def test_mmpp_gaps_are_keyed_and_rate_scaled(self):
+        p = _progs()["mmpp"]
+        gap = build_gap_fn(p)
+        ops = p.operands()
+        t = jnp.full((p.n,), 50_000, jnp.int32)
+        g1 = np.asarray(gap(ops, jax.random.PRNGKey(0), t))
+        g2 = np.asarray(gap(ops, jax.random.PRNGKey(0), t))
+        g3 = np.asarray(gap(ops, jax.random.PRNGKey(1), t))
+        np.testing.assert_array_equal(g1, g2)  # pure in (key, e, t)
+        assert not np.array_equal(g1, g3)
+
+    def test_bits_fn_trace_is_exact_bytes(self):
+        p = _progs()["trace"]
+        bits = build_bits_fn(p)
+        ops = p.operands()
+        dev = np.asarray(
+            bits(ops, jax.random.PRNGKey(0), jnp.int32(0),
+                 jnp.int32(300_000))
+        )
+        live = p.arr_t < 2**30
+        want = (
+            (p.arr_b * (live & (p.arr_t < 300_000))).sum(axis=1) * 8.0
+        )
+        np.testing.assert_array_equal(dev, want.astype(np.float32))
+
+    def test_avg_mult_cbr_is_exactly_one(self):
+        p = _progs()["cbr"]
+        m = np.asarray(
+            avg_mult(p)(p.operands(), jnp.int32(800_000))
+        )
+        assert (m == 1.0).all()
+
+    def test_envelope_modulates_epoch_tables(self):
+        flat = TrafficProgram.mmpp(
+            2, 50.0, horizon_us=400_000, epoch_s=0.05, tr_seed=1
+        )
+        env = TrafficProgram.mmpp(
+            2, 50.0, horizon_us=400_000, epoch_s=0.05, tr_seed=1,
+            envelope=(0.5, 0.4, 0.25),
+        )
+        tf, te = traffic_tables(flat), traffic_tables(env)
+        assert not np.array_equal(tf["epoch_rate"], te["epoch_rate"])
+        # same chain realization (envelope scales, never reshuffles)
+        assert flat.shape_key() == env.shape_key()
+
+
+class TestTelemetrySchema:
+    def test_snapshot_validates_and_gate_cli(self, tmp_path, capsys):
+        from tpudes.obs.traffic import (
+            TrafficTelemetry,
+            validate_traffic_metrics,
+        )
+
+        TrafficTelemetry.reset()
+        try:
+            TrafficTelemetry.record(
+                "bss", "onoff", offered=100.0, delivered=90.0,
+                unit="packets", duty=0.4,
+            )
+            snap = TrafficTelemetry.snapshot()
+            assert validate_traffic_metrics(snap) == []
+            bad = json.loads(json.dumps(snap))
+            bad["engines"]["bss"]["delivered_frac"] = 1.5
+            bad["engines"]["bss"]["models"] = {"onoff": 2}
+            problems = validate_traffic_metrics(bad)
+            assert any("delivered_frac" in p for p in problems)
+            assert any("model counts" in p for p in problems)
+
+            from tpudes.obs.__main__ import main
+
+            good = tmp_path / "traffic.json"
+            good.write_text(json.dumps(snap))
+            assert main(["--traffic", str(good)]) == 0
+            badp = tmp_path / "bad.json"
+            badp.write_text(json.dumps(bad))
+            assert main(["--traffic", str(badp)]) == 1
+            capsys.readouterr()
+        finally:
+            TrafficTelemetry.reset()
+
+
+# --- static analysis: KEY001 scope + manifest registration ---------------
+
+
+def _codes(src, path, select=None):
+    from tpudes.analysis import analyze_source
+
+    findings = analyze_source(
+        textwrap.dedent(src), path=path, select=select
+    )
+    return [f.code for f in findings]
+
+
+def test_key001_covers_traffic_package_planted_defect():
+    # planted defect (shape-derived split) in traffic code must flag —
+    # the subsystem's draws ride the same bucketing contract
+    src = """
+    import jax
+
+    def gap_keys(key, n_entities):
+        return jax.random.split(key, n_entities)
+    """
+    assert _codes(
+        src, path="tpudes/traffic/fixture.py", select=["KEY"]
+    ) == ["KEY001"]
+    # raw-key reuse flags too
+    reuse = """
+    import jax
+
+    def correlated(key, n):
+        u = jax.random.uniform(key, (n,))
+        return u + jax.random.exponential(key, (n,))
+    """
+    assert _codes(
+        reuse, path="tpudes/traffic/fixture.py", select=["KEY"]
+    ) == ["KEY001"]
+
+
+def test_key001_clean_traffic_fixture_stays_clean():
+    # the discipline-following shape (per-index fold_in) must NOT flag
+    src = """
+    import jax
+
+    def gap_draws(key, t_arr, n):
+        def one(e, t):
+            k = jax.random.fold_in(jax.random.fold_in(key, e), t)
+            return jax.random.uniform(k, ())
+        return jax.vmap(one)(jax.numpy.arange(n), t_arr)
+    """
+    assert _codes(
+        src, path="tpudes/traffic/fixture.py", select=["KEY"]
+    ) == []
+
+
+def test_traffic_manifest_registered_with_jxl_registry():
+    from tpudes.analysis.jaxpr.manifest import ENGINE_MANIFESTS
+
+    assert ("tpudes.traffic.device", "trace_manifest") in ENGINE_MANIFESTS
+    from tpudes.traffic.device import trace_manifest
+
+    man = trace_manifest()
+    assert man.engine == "traffic"
+    flips = man.flips()
+    # both directions represented: shape components key-differ,
+    # model/param flips must not
+    assert flips["n_epoch"].key_differs
+    assert not flips["model"].key_differs
+    assert not flips["tr_seed"].key_differs

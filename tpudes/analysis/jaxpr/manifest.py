@@ -12,10 +12,11 @@ from __future__ import annotations
 import importlib
 
 #: (module, attribute) of every engine manifest the ``--jaxpr`` pass
-#: family lints — the five device engines plus the hybrid
-#: space-lanes window kernel.  A new engine front-end joins the gate by
-#: exporting ``trace_manifest()`` and adding one row here (see README
-#: "Static analysis" for the howto).
+#: family lints — the five device engines, the hybrid space-lanes
+#: window kernel, and the shared traffic stage (ISSUE-14).  A new
+#: engine front-end joins the gate by exporting ``trace_manifest()``
+#: and adding one row here (see README "Static analysis" for the
+#: howto).
 ENGINE_MANIFESTS = (
     ("tpudes.parallel.replicated", "trace_manifest"),
     ("tpudes.parallel.lte_sm", "trace_manifest"),
@@ -23,6 +24,7 @@ ENGINE_MANIFESTS = (
     ("tpudes.parallel.as_flows", "trace_manifest"),
     ("tpudes.parallel.wired", "trace_manifest"),
     ("tpudes.parallel.hybrid", "trace_manifest"),
+    ("tpudes.traffic.device", "trace_manifest"),
 )
 
 

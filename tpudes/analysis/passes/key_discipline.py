@@ -14,9 +14,10 @@ function of stable indices — ``fold_in(key, replica)``,
   intervening rebinding — both draws see identical bits, so "independent"
   coins are correlated 1.0.
 
-Scope: ``tpudes/parallel/`` and ``tpudes/ops/`` (the device-engine
-surface); host-side model code draws from the seeded MRG32k3a stream
-API instead.
+Scope: ``tpudes/parallel/``, ``tpudes/ops/`` and ``tpudes/traffic/``
+(the device-engine surface — the traffic subsystem's eager table
+draws and per-arrival gap streams ride the same contract); host-side
+model code draws from the seeded MRG32k3a stream API instead.
 """
 
 from __future__ import annotations
@@ -75,6 +76,7 @@ class KeyDisciplinePass(Pass):
         if not (
             mod.in_package("tpudes", "parallel")
             or mod.in_package("tpudes", "ops")
+            or mod.in_package("tpudes", "traffic")
         ):
             return []
         out: list[Finding] = []

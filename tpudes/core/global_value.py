@@ -137,6 +137,20 @@ JaxGeomStride = GlobalValue(
     "bit-identical to per-step recompute)",
     1,
 )
+JaxTrafficModel = GlobalValue(
+    "JaxTrafficModel",
+    "workload model of the lifted BSS path (tpudes/traffic): off = "
+    "the scenario's own CBR apps (bit-identical legacy compile), or "
+    "cbr | mmpp | onoff | trace — STA arrivals ride the device "
+    "traffic stage at the apps' mean rate (beacons stay cbr)",
+    "off",
+)
+JaxTrafficSeed = GlobalValue(
+    "JaxTrafficSeed",
+    "workload realization seed of the lifted traffic stage (the "
+    "fold_in table stream; model/param flips never recompile)",
+    0,
+)
 
 # Observability knobs (tpudes/obs).  Registered here, like the engine
 # knobs, so CommandLine / NS_GLOBAL_VALUE can bind them before any

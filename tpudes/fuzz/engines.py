@@ -203,6 +203,57 @@ def _shrink_choice(cfg, name, simplest):
     return out
 
 
+def _fuzz_traffic(cfg, n, horizon_us, rate_pps, start_us=0):
+    """The drawn workload program (ISSUE-14 axes) for ``n`` entities,
+    or None for the "off" draw.  ``tr_burst`` is the burstiness knob
+    (ON-OFF off-time mean / mmpp state spread), ``tr_phase`` the
+    diurnal-envelope phase (amp fixed at 0.35, one period per
+    horizon); the realization seed is the scenario's ``key_seed`` so
+    the workload is part of the one-integer reproduction story."""
+    from tpudes.traffic import TrafficProgram
+
+    model = cfg.get("traffic", "off")
+    if model == "off":
+        return None
+    burst = float(cfg.get("tr_burst", 0.3))
+    env = (0.35, horizon_us / 1e6, float(cfg.get("tr_phase", 0.0)))
+    seed = int(cfg.get("key_seed", 0))
+    if model == "cbr":
+        iv = max(1, int(round(1e6 / max(rate_pps, 1e-6))))
+        return TrafficProgram.cbr(
+            np.broadcast_to(
+                np.asarray(start_us, np.int32), (n,)
+            ).copy(),
+            iv,
+        )
+    if model == "mmpp":
+        return TrafficProgram.mmpp(
+            n, rate_pps, horizon_us=horizon_us, epoch_s=0.05,
+            mult=(1.0 - burst, 1.0 + 2.0 * burst),
+            switch_p=(0.4, 0.4), start_us=start_us, envelope=env,
+            tr_seed=seed,
+        )
+    if model == "onoff":
+        duty = 1.0 / (1.0 + burst / 0.2)  # on-mean 0.2 s vs off-mean
+        return TrafficProgram.onoff(
+            n, rate_pps / max(duty, 0.05), horizon_us=horizon_us,
+            on=(1.5, 0.05, 1.0), off_mean_s=burst, start_us=start_us,
+            envelope=env, tr_seed=seed,
+        )
+    # trace: a deterministic synthetic "empirical" table derived from
+    # the scenario draws (no host RNG — the seed IS the trace)
+    k = max(4, min(64, int(rate_pps * horizon_us / 1e6)))
+    phase = float(cfg.get("tr_phase", 0.0))
+    grid = (
+        np.linspace(0.05 + 0.4 * phase / max(k, 1), 0.95, k)[None, :]
+        * (horizon_us - int(np.max(start_us)))
+        + np.asarray(start_us).reshape(-1, 1)
+        + np.arange(n)[:, None] * 997
+    ).astype(np.int64)
+    sizes = (256 + 61 * ((seed + np.arange(n * k)) % 23)).reshape(n, k)
+    return TrafficProgram.trace_replay(np.sort(grid, axis=1), sizes)
+
+
 class EngineFuzzer:
     """Template for one engine's fuzz surface; subclasses fill in the
     build/run/host hooks.  ``outcome_fields`` is the sweep/serving
@@ -254,9 +305,43 @@ class EngineFuzzer:
 
     # --- engine-specific exact pairs -------------------------------------
 
+    #: fields the ``traffic_off`` pair compares (None = key union) —
+    #: engines whose traffic runs add result fields (LTE backlog/
+    #: goodput) restrict to the common outcome set
+    traffic_off_fields: tuple | None = None
+
+    def neutral_traffic(self, prog):
+        """A workload program pinned BIT-EQUAL to ``traffic=None`` on
+        this engine (the cbr branch / a saturating fill), or None when
+        the engine has no traffic seam.  Powers the ``traffic_off``
+        exact oracle pair."""
+        return None
+
+    def _traffic_off_pair(self, prog, cfg, canonical):
+        """ISSUE-14 exactness anchor: the engine with its traffic
+        stage COMPILED IN but fed the neutral workload must match the
+        legacy (traffic=None) path bit for bit — generalized over the
+        whole envelope, whatever workload the scenario drew."""
+        import dataclasses
+
+        del canonical  # both sides are fresh runs
+        neutral = self.neutral_traffic(prog)
+        if neutral is None:
+            return None
+        off = self.run_scalar(
+            dataclasses.replace(prog, traffic=None), cfg
+        )
+        neu = self.run_scalar(
+            dataclasses.replace(prog, traffic=neutral), cfg
+        )
+        return first_diff(off, neu, fields=self.traffic_off_fields)
+
     def extra_pairs(self):
-        """[(pair_name, fn(prog, cfg, canonical) -> diff|None), ...]"""
-        return []
+        """[(pair_name, fn(prog, cfg, canonical) -> diff|None), ...]
+        Every engine carries the ``traffic_off`` pair; one without a
+        traffic seam (``neutral_traffic`` → None) passes it
+        trivially."""
+        return [("traffic_off", self._traffic_off_pair)]
 
     # --- shrinking --------------------------------------------------------
 
@@ -274,6 +359,12 @@ class EngineFuzzer:
             c = _shrink_int(cfg, "sim_ms", floors.get("sim_ms", 8))
             if c:
                 out.append(("halve sim_ms", c))
+        if "traffic" in cfg:
+            # dropping the workload model is the single biggest
+            # simplification a traffic-era divergence can take
+            c = _shrink_choice(cfg, "traffic", "off")
+            if c:
+                out.append(("traffic -> off", c))
         return out
 
 
@@ -308,17 +399,34 @@ class BssFuzzer(EngineFuzzer):
         )
 
     def build(self, cfg):
+        import dataclasses
+
         from tpudes.parallel.replicated import lower_bss
 
         _reset_world()
         try:
             stas, ap, clients, _ = self._graph(cfg)
             with _quiet_lowering():
-                return lower_bss(
+                prog = lower_bss(
                     [stas.Get(i) for i in range(int(cfg["n_stas"]))],
                     ap, clients, cfg["sim_ms"] / 1e3,
                     geom_stride=int(cfg.get("geom_stride", 1)),
                 )
+            # ISSUE-14: STA arrivals ride the drawn workload (the AP
+            # row stays cbr at the beacon period); mean rate pinned to
+            # the envelope's CBR load so offered stays in-region
+            tp = _fuzz_traffic(
+                cfg, prog.n, prog.sim_end_us,
+                rate_pps=1000.0 / float(cfg["interval_ms"]),
+                start_us=prog.start_us,
+            )
+            if tp is not None:
+                tp = tp.with_cbr_rows(
+                    np.arange(prog.n) == 0, prog.interval_us[0],
+                    prog.start_us[0],
+                )
+                prog = dataclasses.replace(prog, traffic=tp)
+            return prog
         finally:
             _reset_world()
 
@@ -375,7 +483,19 @@ class BssFuzzer(EngineFuzzer):
         finally:
             _reset_world()
 
+    def neutral_traffic(self, prog):
+        from tpudes.traffic import TrafficProgram
+
+        return TrafficProgram.cbr(prog.start_us, prog.interval_us)
+
     def host_compare(self, host, dev, cfg):
+        # the host graph runs CBR echo apps: with a generative device
+        # workload the two sides simulate DIFFERENT arrival processes
+        # — host parity for those lives in the dedicated host-mirror
+        # parity tests (and the traffic_off exact pair covers the
+        # seam); the band below is the cbr-workload contract
+        if cfg.get("traffic", "off") not in ("off", "cbr"):
+            return None
         # one host RngRun draw against the device replica spread: the
         # fuzz band is the replica min/max widened by a timing-model +
         # Monte-Carlo slack proportional to the offered load (BSS host
@@ -447,16 +567,34 @@ class LteSmFuzzer(EngineFuzzer):
         )
 
     def build(self, cfg):
+        import dataclasses
+
         from tpudes.parallel.lte_sm import lower_lte_sm
 
         _reset_world()
         try:
             lte, _ = self._graph(cfg)
             with _quiet_lowering():
-                return lower_lte_sm(
+                prog = lower_lte_sm(
                     lte, cfg["sim_ms"] / 1e3,
                     geom_stride=int(cfg.get("geom_stride", 1)),
                 )
+            # ISSUE-14: finite per-UE backlogs from the drawn workload
+            # — only on STATIC drops (the engine rejects traffic +
+            # mobility on one program; a mobile draw keeps full buffer)
+            if prog.mobility is None:
+                tp = _fuzz_traffic(
+                    cfg, prog.n_ue, prog.n_ttis * 1000, rate_pps=120.0
+                )
+                if tp is not None:
+                    tp = dataclasses.replace(
+                        tp,
+                        size_pareto=np.asarray(
+                            [1.4, 800.0, 12000.0], np.float32
+                        ),
+                    )
+                    prog = dataclasses.replace(prog, traffic=tp)
+            return prog
         finally:
             _reset_world()
 
@@ -494,8 +632,38 @@ class LteSmFuzzer(EngineFuzzer):
             (dataclasses.replace(prog, scheduler=other), {}),
         ]
 
+    #: the common outcome set: a traffic run legitimately ADDS
+    #: backlog_bits/goodput_bits/offered_bits, which the traffic=None
+    #: side does not have
+    traffic_off_fields = (
+        "rx_bits", "new_tbs", "retx", "drops", "ok", "cqi", "mcs",
+        "sinr",
+    )
+
+    def neutral_traffic(self, prog):
+        """A saturating cbr fill (1 packet/µs at jumbo sizes): every
+        backlog is non-empty from TTI 0, so the dynamic-eligible
+        kernel must reproduce the full-buffer program bit for bit.
+        None on mobile draws — the engine rejects traffic + mobility
+        on one program, so there is no seam to pin there."""
+        import dataclasses
+
+        from tpudes.traffic import TrafficProgram
+
+        if prog.mobility is not None:
+            return None
+
+        tp = TrafficProgram.cbr(
+            np.zeros(prog.n_ue, np.int32),
+            np.full(prog.n_ue, 1, np.int64),
+        )
+        return dataclasses.replace(
+            tp,
+            size_pareto=np.asarray([0.0, 20000.0, 20000.0], np.float32),
+        )
+
     def extra_pairs(self):
-        return [
+        return super().extra_pairs() + [
             ("pallas_vs_xla", self._pallas_pair),
             ("bf16_budget", self._bf16_pair),
             ("device_geom_off", self._device_geom_pair),
@@ -570,6 +738,13 @@ class LteSmFuzzer(EngineFuzzer):
             _reset_world()
 
     def host_compare(self, host, dev, cfg):
+        # the host controller runs RLC-SM full buffer: any finite-
+        # backlog device workload simulates a different offered load —
+        # the traffic_off exact pair covers the seam instead
+        if cfg.get("traffic", "off") != "off" and cfg.get(
+            "mob_model", "static"
+        ) == "static":
+            return None
         h = float(host["rx_bits"])
         d = float(np.asarray(dev["rx_bits"]).sum(axis=-1).mean())
         # pinned parity is rel 0.15 at the hand-tuned geometry; random
@@ -633,13 +808,30 @@ class DumbbellFuzzer(EngineFuzzer):
         )
 
     def build(self, cfg):
+        import dataclasses
+
         from tpudes.parallel.tcp_dumbbell import lower_dumbbell
 
         _reset_world()
         try:
             self._graph(cfg)
             with _quiet_lowering():
-                return lower_dumbbell(cfg["sim_ms"] / 1e3)
+                prog = lower_dumbbell(cfg["sim_ms"] / 1e3)
+            # ISSUE-14: app-limited flows — mean offered ~70% of the
+            # bottleneck's fair share, so the workload (not just the
+            # window) shapes the dynamics without starving the queue
+            fair_pps = (
+                float(cfg["bottleneck_mbps"]) * 1e6
+                / (8.0 * float(cfg["seg_bytes"]))
+                / max(int(cfg["n_flows"]), 1)
+            )
+            tp = _fuzz_traffic(
+                cfg, prog.n_flows, int(cfg["sim_ms"]) * 1000,
+                rate_pps=0.7 * fair_pps,
+            )
+            if tp is not None:
+                prog = dataclasses.replace(prog, traffic=tp)
+            return prog
         finally:
             _reset_world()
 
@@ -704,7 +896,23 @@ class DumbbellFuzzer(EngineFuzzer):
         finally:
             _reset_world()
 
+    def neutral_traffic(self, prog):
+        from tpudes.traffic import TrafficProgram
+
+        # 1 segment/µs offered: the app never limits the window, so
+        # the app-limit gate must reproduce the bulk program bit for
+        # bit
+        return TrafficProgram.cbr(
+            np.zeros(prog.n_flows, np.int32),
+            np.full(prog.n_flows, 1, np.int64),
+        )
+
     def host_compare(self, host, dev, cfg):
+        # the host graph runs bulk senders: an app-limited device
+        # workload is a different offered load — the traffic_off exact
+        # pair covers the seam instead
+        if cfg.get("traffic", "off") != "off":
+            return None
         h = float(host["goodput_mbps"])
         d = float(np.asarray(dev["goodput_mbps"]).sum(axis=-1).mean())
         cap = float(cfg["bottleneck_mbps"])
@@ -771,13 +979,25 @@ class AsFlowsFuzzer(EngineFuzzer):
         )
 
     def build(self, cfg):
+        import dataclasses
+
         from tpudes.parallel.as_flows import lower_as_flows
 
         _reset_world()
         try:
             self._graph(cfg)
             with _quiet_lowering():
-                return lower_as_flows(cfg["sim_ms"] / 1e3)
+                prog = lower_as_flows(cfg["sim_ms"] / 1e3)
+            # ISSUE-14: the fluid engine consumes the workload's
+            # realized/nominal rate multiplier per flow
+            tp = _fuzz_traffic(
+                cfg, len(prog.src), int(cfg["sim_ms"]) * 1000,
+                rate_pps=float(cfg["flow_kbps"]) * 1e3
+                / (8.0 * float(cfg["pkt_bytes"])),
+            )
+            if tp is not None:
+                prog = dataclasses.replace(prog, traffic=tp)
+            return prog
         finally:
             _reset_world()
 
@@ -827,7 +1047,23 @@ class AsFlowsFuzzer(EngineFuzzer):
         finally:
             _reset_world()
 
+    def neutral_traffic(self, prog):
+        from tpudes.traffic import TrafficProgram
+
+        # any cbr program: the fluid multiplier is exactly 1.0 for the
+        # cbr branch by construction
+        return TrafficProgram.cbr(
+            np.zeros(len(prog.src), np.int32),
+            np.full(len(prog.src), 1000, np.int64),
+        )
+
     def host_compare(self, host, dev, cfg):
+        # the host graph runs constant-rate UdpClients: a generative
+        # device workload offers a different load — the traffic_off
+        # exact pair covers the seam (cbr's multiplier is exactly 1,
+        # so the cbr draw keeps the band meaningful)
+        if cfg.get("traffic", "off") not in ("off", "cbr"):
+            return None
         sim_s = cfg["sim_ms"] / 1e3
         interval_s = int(cfg["pkt_bytes"]) * 8.0 / (cfg["flow_kbps"] * 1e3)
         expected = (sim_s - 0.05) / interval_s  # clients start at 0.05 s
@@ -965,7 +1201,12 @@ class WiredFuzzer(EngineFuzzer):
                 {k: np.asarray(hybrid[k]) for k in ("deliver_slot", "served")},
             )
 
-        return [("hybrid_vs_host", hybrid_vs_host)]
+        # super() keeps the base traffic_off pair on the roster (it
+        # passes trivially until the wired engine grows a traffic
+        # seam, at which point the oracle arms itself)
+        return super().extra_pairs() + [
+            ("hybrid_vs_host", hybrid_vs_host)
+        ]
 
     def shrink_moves(self, cfg):
         out = super().shrink_moves(cfg)

@@ -352,6 +352,139 @@ class OnOffApplication(Application):
         self._next_event = Simulator.Schedule(interval, self._send)
 
 
+class PPBPApplication(Application):
+    """Poisson-Pareto Burst Process source (the PPBP-Application
+    model of the upstream traffic-generator surface): bursts ARRIVE as
+    a Poisson process (exponential inter-burst gaps), each burst lasts
+    a Pareto-distributed duration and sends CBR at ``BurstRate`` while
+    active; overlapping bursts SUM (unlike OnOffApplication's strict
+    alternation), which is what produces self-similar aggregate
+    traffic.  The host mirror the device ``onoff``/``mmpp`` traffic
+    models are parity-tested against at distribution band
+    (tests/test_traffic_host_parity.py)."""
+
+    tid = (
+        TypeId("tpudes::PPBPApplication")
+        .SetParent(Application.tid)
+        .AddConstructor(lambda **kw: PPBPApplication(**kw))
+        .AddAttribute("BurstRate", "rate of ONE active burst",
+                      "500kbps", checker=DataRate)
+        .AddAttribute("PacketSize", "payload bytes", 512)
+        .AddAttribute("Remote", "destination (InetSocketAddress)", None)
+        .AddAttribute("MeanBurstArrivals",
+                      "Poisson burst arrival rate (bursts/s)", 1.0)
+        .AddAttribute("BurstLength", "burst-duration RNG (Pareto)", None)
+        .AddAttribute("Protocol", "socket factory type",
+                      "tpudes::UdpSocketFactory")
+        .AddTraceSource("Tx", "a packet is sent")
+    )
+
+    def __init__(self, **attributes):
+        super().__init__(**attributes)
+        self._socket = None
+        self._running = False
+        self._active = 0          # currently-overlapping bursts
+        self._sent_pkts = 0
+        self._send_event = None
+        self._arrival_event = None
+        self._end_events: list = []
+        if self.burst_length is None:
+            from tpudes.core.rng import ParetoRandomVariable
+
+            self.burst_length = ParetoRandomVariable(
+                Scale=0.1, Shape=1.5, Bound=10.0
+            )
+        if self.mean_burst_arrivals <= 0.0:
+            raise ValueError("MeanBurstArrivals must be positive")
+        from tpudes.core.rng import ExponentialRandomVariable
+
+        self._gap = ExponentialRandomVariable(
+            Mean=1.0 / float(self.mean_burst_arrivals)
+        )
+
+    @property
+    def sent_packets(self) -> int:
+        return self._sent_pkts
+
+    def StartApplication(self):
+        self._running = True
+        if self._socket is None:
+            self._socket = SocketFactory.CreateSocket(
+                self._node, self.protocol
+            )
+            self._socket.Bind()
+            self._socket.Connect(self.remote)
+        self._schedule_arrival()
+
+    def StopApplication(self):
+        self._running = False
+        for ev in (
+            [self._send_event, self._arrival_event] + self._end_events
+        ):
+            if ev is not None:
+                ev.Cancel()
+        self._end_events = []
+        if self._socket is not None:
+            self._socket.Close()
+            self._socket = None
+
+    def _schedule_arrival(self):
+        if not self._running:
+            return
+        self._arrival_event = Simulator.Schedule(
+            Seconds(self._gap.GetValue()), self._burst_begins
+        )
+
+    def _burst_begins(self):
+        if not self._running:
+            return
+        self._active += 1
+        self._end_events.append(
+            Simulator.Schedule(
+                Seconds(self.burst_length.GetValue()), self._burst_ends
+            )
+        )
+        if self._active == 1:
+            # a send event left pending by the previous burst's tail
+            # must not survive into this one — two live chains would
+            # double the per-burst rate
+            if self._send_event is not None:
+                self._send_event.Cancel()
+                self._send_event = None
+            self._send()
+        self._schedule_arrival()
+
+    def _burst_ends(self):
+        self._active = max(0, self._active - 1)
+        if self._active == 0 and self._send_event is not None:
+            self._send_event.Cancel()
+            self._send_event = None
+        # prune expired end events (one per burst — a long horizon
+        # would otherwise accumulate them unboundedly)
+        self._end_events = [
+            e for e in self._end_events if not e.IsExpired()
+        ]
+
+    def _send(self):
+        if not self._running or self._active <= 0 or self._socket is None:
+            if self._send_event is not None:
+                self._send_event.Cancel()
+                self._send_event = None
+            return
+        packet = Packet(self.packet_size)
+        self.tx(packet)
+        self._socket.Send(packet)
+        self._sent_pkts += 1
+        # overlapping bursts sum: n active bursts send at n × BurstRate
+        interval = Seconds(
+            self.burst_rate.CalculateBytesTxTime(
+                self.packet_size
+            ).GetSeconds()
+            / max(self._active, 1)
+        )
+        self._send_event = Simulator.Schedule(interval, self._send)
+
+
 class BulkSendApplication(Application):
     """Send-as-fast-as-the-socket-allows source
     (src/applications/model/bulk-send-application.{h,cc}); primarily for

@@ -47,8 +47,11 @@ from tpudes.obs.profiler import (
     enabled,
 )
 from tpudes.obs.serving import ServingTelemetry, validate_serving_metrics
+from tpudes.obs.traffic import TrafficTelemetry, validate_traffic_metrics
 
 __all__ = [
+    "TrafficTelemetry",
+    "validate_traffic_metrics",
     "ChunkStream",
     "CompileTelemetry",
     "DistributedTelemetry",

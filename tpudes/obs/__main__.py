@@ -7,6 +7,7 @@ Usage::
     python -m tpudes.obs --fuzz <metrics.json> [more.json ...]
     python -m tpudes.obs --distributed <metrics.json> [more.json ...]
     python -m tpudes.obs --geometry <metrics.json> [more.json ...]
+    python -m tpudes.obs --traffic <metrics.json> [more.json ...]
 
 Default mode checks Chrome-trace exports against the Trace Event
 format; ``--serving`` checks :class:`tpudes.obs.serving.ServingTelemetry`
@@ -17,7 +18,10 @@ fuzz-metrics schema; ``--distributed`` checks
 against the hybrid-PDES window-protocol schema; ``--geometry`` checks
 :class:`tpudes.obs.geometry.GeomTelemetry` snapshot dumps against the
 geometry-refresh schema (device recomputes vs host refreshes, stride
-hit rate).  Exit 0 when every
+hit rate); ``--traffic`` checks
+:class:`tpudes.obs.traffic.TrafficTelemetry` snapshot dumps against
+the workload schema (offered vs delivered load, per-model launch
+counts, burst duty cycle).  Exit 0 when every
 file is valid, 1 on
 violations, 2 on usage / unreadable input.  These are the schema gates
 the CI smoke steps run over the artifacts an example (``TpudesObs=1``),
@@ -34,6 +38,7 @@ from tpudes.obs.export import validate_chrome_trace
 from tpudes.obs.fuzz import validate_fuzz_metrics
 from tpudes.obs.geometry import validate_geometry_metrics
 from tpudes.obs.serving import validate_serving_metrics
+from tpudes.obs.traffic import validate_traffic_metrics
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -42,13 +47,15 @@ def main(argv: list[str] | None = None) -> int:
     fuzz = "--fuzz" in argv
     distributed = "--distributed" in argv
     geometry = "--geometry" in argv
+    traffic = "--traffic" in argv
     argv = [
         a for a in argv
-        if a not in ("--serving", "--fuzz", "--distributed", "--geometry")
+        if a not in ("--serving", "--fuzz", "--distributed",
+                     "--geometry", "--traffic")
     ]
     if (
         not argv
-        or serving + fuzz + distributed + geometry > 1
+        or serving + fuzz + distributed + geometry + traffic > 1
         or any(a in ("-h", "--help") for a in argv)
     ):
         print(__doc__, file=sys.stderr)
@@ -61,6 +68,8 @@ def main(argv: list[str] | None = None) -> int:
         validate, kind = validate_distributed_metrics, "distributed metrics"
     elif geometry:
         validate, kind = validate_geometry_metrics, "geometry metrics"
+    elif traffic:
+        validate, kind = validate_traffic_metrics, "traffic metrics"
     else:
         validate, kind = validate_chrome_trace, "Chrome trace"
     rc = 0
@@ -83,7 +92,7 @@ def main(argv: list[str] | None = None) -> int:
                 n = doc["counters"]["scenarios"]
             elif distributed:
                 n = doc["counters"]["windows"]
-            elif geometry:
+            elif geometry or traffic:
                 n = len(doc["engines"])
             else:
                 n = len(doc["traceEvents"])

@@ -52,6 +52,12 @@ FUZZ_ENVELOPE = FuzzEnvelope(
         "replicas": ("int", 2, 9),
         "chunk_divisor": ("choice", (2,)),
         "key_seed": ("int", 0, 2**16),
+        # ISSUE-14 traffic draws (appended): per-flow offered rates
+        # scale by the drawn workload's fluid multiplier; "off" keeps
+        # the constant nominal rates
+        "traffic": ("choice", ("off", "cbr", "mmpp", "onoff", "trace")),
+        "tr_burst": ("float", 0.1, 0.6),
+        "tr_phase": ("float", 0.0, 1.0),
     },
     floors={"replicas": 1, "n_nodes": 8, "n_flows": 1},
     doc="BRITE BA AS topology, sparse CBR flows, fluid outcome model",
@@ -77,6 +83,16 @@ class AsFlowsProgram:
     #: "hops" matches the host Ipv4GlobalRouting (interface Metric = 1);
     #: "delay" routes on propagation delay instead
     spf_metric: str = "hops"
+    #: device-resident workload (tpudes.traffic.TrafficProgram over the
+    #: F flows): None = constant nominal rates (bit-identical compile).
+    #: The fluid engine consumes the workload's FLUID view — each
+    #: flow's offered rate scales by the model's realized/nominal
+    #: ratio over the horizon (exactly 1.0 for cbr, the traffic_off
+    #: anchor), computed ON DEVICE from the traced tables so model/
+    #: param flips never recompile.  Only ``traffic.shape_key()``
+    #: enters the runner cache key; the horizon rides as a traced
+    #: operand (``sim_s`` itself stays out of the key).
+    traffic: object = None
 
 
 class UnliftableAsError(ValueError):
@@ -291,6 +307,8 @@ def as_prog_key(prog: AsFlowsProgram) -> tuple:
         prog.rate_bps.tobytes(), prog.src.tobytes(), prog.dst.tobytes(),
         prog.flow_bps.tobytes(), prog.pkt_bytes, prog.max_hops,
         prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
+        # workload SHAPE only — the model id and params are traced
+        None if prog.traffic is None else prog.traffic.shape_key(),
     )
 
 
@@ -307,6 +325,11 @@ def as_study(prog: AsFlowsProgram, key, replicas, mesh=None,
 
     ck = as_prog_key(prog) + (
         np.asarray(key).tobytes(), int(replicas), mesh_fingerprint(mesh),
+        # workload identity by VALUE, and the horizon it averages over
+        # (with traffic the realized rates depend on sim_s even though
+        # the executable does not)
+        None if prog.traffic is None
+        else prog.traffic.param_key() + (float(prog.sim_s),),
     )
 
     def launch(points, block=False):
@@ -338,6 +361,11 @@ def build_as_run(prog: AsFlowsProgram, r_pad: int, n_cfg: int | None = None,
     exactly as :func:`run_as_flows` jits it — factored out so the trace
     manifest (:func:`trace_manifest`) abstractly traces the same
     program the runner cache compiles."""
+    TRAFFIC = prog.traffic is not None
+    if TRAFFIC:
+        from tpudes.traffic.device import avg_mult
+
+        mult_fn = avg_mult(prog.traffic)
     E = prog.edges.shape[0]
     E2 = 2 * E
     cap = jnp.concatenate(
@@ -361,12 +389,13 @@ def build_as_run(prog: AsFlowsProgram, r_pad: int, n_cfg: int | None = None,
         ) & arrived
         return path, hops, reached
 
-    def relax(carry, z, scale, rounds_end, path, reached):
+    def relax(carry, z, scale, rounds_end, path, reached, mult):
         # per-replica offered rates: lognormal jitter around the
         # scale-multiplied nominal (z enters sharded over the
         # mesh's replica axis — every (R, ...) array downstream
-        # inherits that sharding)
-        rate = fbps[None, :] * scale * jnp.exp(
+        # inherits that sharding); the workload's fluid multiplier
+        # rides per flow on top
+        rate = fbps[None, :] * mult[None, :] * scale * jnp.exp(
             prog.rate_jitter * z - 0.5 * prog.rate_jitter**2
         )
         rate = jnp.where(reached[None, :], rate, 0.0)
@@ -431,17 +460,26 @@ def build_as_run(prog: AsFlowsProgram, r_pad: int, n_cfg: int | None = None,
         metrics = dict(max_util=jnp.max(util)) if obs else {}
         return (i, lfrac, lg, util), outputs, metrics
 
-    def run(carry, z, scale, rounds_end):
+    def run(carry, z, scale, rounds_end, tr=None, horizon_us=None):
         path, hops, reached = topo()
+        # the workload's fluid multiplier: realized/nominal offered
+        # ratio over the traced horizon — config- and replica-
+        # independent, computed once like the SPF tables
+        mult = (
+            mult_fn(tr, horizon_us) if TRAFFIC
+            else jnp.ones((F,), jnp.float32)
+        )
         if n_cfg is None:
             carry, outputs, metrics = relax(
-                carry, z, scale, rounds_end, path, reached
+                carry, z, scale, rounds_end, path, reached, mult
             )
         else:
             # SPF + path walk are config-independent: computed once,
             # closed over by the vmapped fixed point
             carry, outputs, metrics = jax.vmap(
-                lambda c, s: relax(c, z, s, rounds_end, path, reached)
+                lambda c, s: relax(
+                    c, z, s, rounds_end, path, reached, mult
+                )
             )(carry, scale)
         outputs["hops"] = hops
         outputs["unreachable"] = ~reached
@@ -551,9 +589,20 @@ def run_as_flows(
     carry = stack_axis(carry, n_cfg)
     carry = shard_replica_axis(carry, mesh, r_pad, 0 if n_cfg is None else 1)
 
+    # workload operands (traced; None = the constant-rate path).  The
+    # horizon the fluid multiplier averages over is a traced operand
+    # too — sim_s stays out of the cache key even with traffic on
+    tr = None if prog.traffic is None else prog.traffic.operands()
+    horizon_us = (
+        None if prog.traffic is None
+        else jnp.int32(min(int(prog.sim_s * 1e6), 2**30 - 1))
+    )
+
     with CompileTelemetry.timed("as_flows", compiling):
         def launch(c, bound):
-            carry, out, metrics = run(c[0], z, scale, jnp.int32(bound))
+            carry, out, metrics = run(
+                c[0], z, scale, jnp.int32(bound), tr, horizon_us
+            )
             return (carry, out), metrics
 
         ckpt = checkpoint_ctx(
@@ -562,7 +611,9 @@ def run_as_flows(
             axis=0 if n_cfg is None else 1, mesh=mesh,
             extra=as_prog_key(prog)
             + (None if rate_scale is None
-               else tuple(float(v) for v in rate_scale),),
+               else tuple(float(v) for v in rate_scale),
+               None if prog.traffic is None
+               else prog.traffic.param_key() + (float(prog.sim_s),)),
         )
         (_, out), flush = drive_chunks(
             "as_flows",
@@ -622,16 +673,31 @@ def _trace_entries(prog: AsFlowsProgram, obs: bool = False):
         jnp.zeros((_TRACE_R, F), jnp.float32),
         jnp.zeros((_TRACE_R, E2), jnp.float32),
     )
+    tr = None if prog.traffic is None else prog.traffic.operands()
+    horizon = None if prog.traffic is None else jnp.int32(1_000_000)
+    traced = {"scale": 2, "rounds_end": 3}
+    if tr is not None:
+        # the horizon is traced precisely so sim_s can stay out of the
+        # runner cache key — the liveness check must guard it too
+        traced["tr"] = 4
+        traced["horizon_us"] = 5
     return [
         TraceEntry(
             "run",
             run,
-            (carry, z, jnp.float32(1.0), jnp.int32(FP_ROUNDS)),
+            (carry, z, jnp.float32(1.0), jnp.int32(FP_ROUNDS), tr,
+             horizon),
             donate=(0,),
             carry=(0,),
-            traced={"scale": 2, "rounds_end": 3},
+            traced=traced,
         ),
     ]
+
+
+def _flip_traffic():
+    from tpudes.traffic import TrafficProgram
+
+    return TrafficProgram.onoff(2, 300.0, horizon_us=1_000_000)
 
 
 def _trace_flips():
@@ -659,6 +725,9 @@ def _trace_flips():
             build=lambda: _trace_entries(base, obs=True),
             key_differs=True,
         ),
+        # a workload program joins the trace (the fluid multiplier) and
+        # its SHAPE key joins the cache key
+        "traffic": flip(traffic=_flip_traffic()),
         # sim_s is excluded by design: the fluid fixed point has no
         # time horizon, so flipping it must leave the trace identical
         "sim_s": flip(sim_s=9.0),

@@ -79,7 +79,71 @@ def _discover_bss(sim_end_s: float):
         stas, aps[0], clients, sim_end_s,
         geom_stride=int(GlobalValue.GetValue("JaxGeomStride")),
     )
+    prog = _attach_bss_traffic(prog)
     return "bss", prog, lambda: None
+
+
+def _attach_bss_traffic(prog):
+    """The ISSUE-14 one-flip seam: ``--JaxTrafficModel=<model>`` swaps
+    the lowered BSS program's STA arrivals onto the device traffic
+    stage at the echo apps' mean rate (the AP's beacon process stays
+    cbr); ``off`` returns the program untouched — the bit-identical
+    legacy compile."""
+    import dataclasses
+
+    import numpy as np
+
+    from tpudes.core.global_value import GlobalValue
+
+    model = str(GlobalValue.GetValue("JaxTrafficModel"))
+    if model == "off":
+        return prog
+    from tpudes.traffic import TrafficProgram
+
+    seed = int(GlobalValue.GetValue("JaxTrafficSeed"))
+    n, horizon = prog.n, prog.sim_end_us
+    sta_iv = prog.interval_us[1:].astype(np.int64)
+    rate = float(
+        np.mean(np.where(sta_iv >= 2**29, 0.0, 1e6 / np.maximum(sta_iv, 1)))
+    )
+    if model == "cbr":
+        tp = TrafficProgram.cbr(prog.start_us, prog.interval_us)
+    elif model == "mmpp":
+        tp = TrafficProgram.mmpp(
+            n, rate, horizon_us=horizon, epoch_s=0.05,
+            start_us=prog.start_us, tr_seed=seed,
+        )
+    elif model == "onoff":
+        tp = TrafficProgram.onoff(
+            n, rate / 0.4, horizon_us=horizon, on=(1.5, 0.05, 0.5),
+            off_mean_s=0.15, start_us=prog.start_us, tr_seed=seed,
+        )
+    elif model == "trace":
+        # a deterministic synthetic trace at the apps' mean rate (the
+        # stand-in until a pcap/CSV ingester lands — ROADMAP item 4
+        # remainder).  The span clamps at 0: an app starting past the
+        # horizon gets a constant (never-firing) row, not a descending
+        # one trace_replay would reject
+        k = max(4, int(rate * (horizon - int(prog.start_us[1:].min()))
+                       / 1e6))
+        span = np.maximum(
+            horizon - prog.start_us[:, None].astype(np.int64), 0
+        )
+        grid = np.sort(
+            (np.linspace(0.02, 0.98, k)[None, :] * span
+             + prog.start_us[:, None]).astype(np.int64),
+            axis=1,
+        )
+        tp = TrafficProgram.trace_replay(grid)
+    else:
+        raise ValueError(
+            f"JaxTrafficModel={model!r}: pick off|cbr|mmpp|onoff|trace"
+        )
+    tp = tp.with_cbr_rows(
+        np.arange(n) == 0, int(prog.interval_us[0]),
+        int(prog.start_us[0]),
+    )
+    return dataclasses.replace(prog, traffic=tp)
 
 
 def _discover_lte_sm(sim_end_s: float):

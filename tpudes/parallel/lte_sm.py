@@ -114,6 +114,13 @@ FUZZ_ENVELOPE = FuzzEnvelope(
                                  "random_walk")),
         "mob_speed": ("float", 1.0, 30.0),
         "geom_stride": ("choice", (1, 2, 8, 32)),
+        # ISSUE-14 traffic draws (appended): finite per-UE backlogs
+        # from the drawn workload model; "off" keeps RLC-SM full
+        # buffer.  Joint region note: a mobile draw forces "off" (the
+        # engine rejects traffic+mobility on one program).
+        "traffic": ("choice", ("off", "cbr", "mmpp", "onoff", "trace")),
+        "tr_burst": ("float", 0.1, 0.6),
+        "tr_phase": ("float", 0.0, 1.0),
     },
     floors={"replicas": 1, "n_enbs": 1, "ues_per_cell": 1, "sim_ms": 16},
     doc="lena macro grid, full-buffer RLC-SM downlink, all 9 schedulers",
@@ -158,6 +165,19 @@ class LteSmProgram:
     #: ("friis", frequency_hz, system_loss, min_loss_db) or
     #: ("log_distance", exponent, reference_distance, reference_loss_db)
     pathloss: tuple = None
+    #: device-resident workload (tpudes.traffic.TrafficProgram over the
+    #: U UEs): None = RLC-SM full buffer (bit-identical compile).  With
+    #: a program the engine runs FINITE per-UE backlogs: each TTI adds
+    #: the workload's offered bits (trace replay: exact bytes;
+    #: generative: arrivals × a bounded-Pareto size quantum, fold_in-
+    #: keyed and shared across replicas like the realization itself),
+    #: a UE is scheduling-eligible only while its backlog is non-empty
+    #: (the kernel's dynamic ``eligible`` row — the mobility seam), and
+    #: DELIVERED bits drain it.  Model id + params are traced operands;
+    #: only ``traffic.shape_key()`` enters the runner cache key.  A
+    #: saturating program (offered ≫ servable) is pinned bit-equal to
+    #: the full-buffer path (the ``traffic_off`` fuzz pair).
+    traffic: object = None
 
     @property
     def n_enb(self) -> int:
@@ -515,6 +535,8 @@ def _sm_cache_key(prog: LteSmProgram, replicas, n_cfg, obs, use_pallas) -> tuple
         None if prog.mobility is None else prog.mobility.shape_key(),
         None if prog.enb_pos is None else prog.enb_pos.tobytes(),
         prog.pathloss,
+        # workload SHAPE only — model id + params are traced operands
+        None if prog.traffic is None else prog.traffic.shape_key(),
     )
 
 
@@ -555,10 +577,11 @@ def lte_sm_study(prog: LteSmProgram, key, replicas=None, mesh=None):
         prog.tx_power_dbm.tobytes(), prog.noise_psd, prog.n_rb,
         prog.pf_alpha, prog.precision, prog.n_ttis,
         np.asarray(key).tobytes(), replicas, mesh_fingerprint(mesh),
-        # mobility params + stride are traced but must still separate
+        # mobility/traffic params are traced but must still separate
         # coalesce groups (only the scheduler id may differ per point)
         None if prog.mobility is None else prog.mobility.param_key(),
         int(prog.geom_stride),
+        None if prog.traffic is None else prog.traffic.param_key(),
     )
 
     def launch(points, block=False):
@@ -707,6 +730,285 @@ def build_sm_mobile_advance(prog: LteSmProgram, r_pad: int | None = None,
         return (jnp.int32(0), init_rows(), sm_init_state(E, U))
 
     return init_carry, advance
+
+
+def build_sm_traffic_advance(prog: LteSmProgram, r_pad: int | None = None,
+                             n_cfg: int | None = None, obs: bool = False,
+                             use_pallas: bool = False):
+    """``(init_carry, fn)`` with ``fn(carry, keys, sid, t_end, tr)``
+    the UNJITTED finite-backlog advance exactly as
+    :func:`_run_lte_sm_traffic` jits it.
+
+    Structure mirrors :func:`build_sm_mobile_advance`: the TTI
+    ``while_loop`` runs UNBATCHED and only the fused kernel is vmapped
+    over the replica/config axes — the workload realization (like the
+    mobility trajectory) is shared by every replica and config point,
+    so the per-TTI offered-bits fill is computed ONCE per TTI.  The
+    per-UE backlog rides the state dict (``_tr_backlog``, bits, f32)
+    inside the vmapped unit: it drains by each replica's own DELIVERED
+    bits (the rx counter delta — RLC-UM-style accounting: a TB leaves
+    the buffer when it decodes, and a TB grant larger than the backlog
+    still decodes whole, the documented TB-quantization deviation),
+    and gates the kernel's dynamic ``eligible`` row."""
+    import jax.numpy as jnp
+
+    from tpudes.traffic.device import build_bits_fn
+
+    consts_np = build_sm_consts(prog)
+    fused = build_sm_step_fn(consts_np, use_pallas, dynamic=("eligible",))
+    bits_fn = build_bits_fn(prog.traffic)
+    E, U = prog.n_enb, prog.n_ue
+    elig0 = jnp.asarray(consts_np["eligible"])            # (1, U) i32
+
+    def advance(carry, keys, sid, t_end, tr, tr_key):
+        def body(c):
+            t, s = c
+            # this TTI's offered bits — pure in (tr_key, entity, t),
+            # shared by every replica/config lane (ONE evaluation)
+            arr = jnp.reshape(
+                bits_fn(tr, tr_key, t * 1000, (t + 1) * 1000), (1, U)
+            )
+
+            def one(s_r, k_r, sid_s):
+                bl = jnp.minimum(
+                    s_r["_tr_backlog"] + arr, jnp.float32(2**30)
+                )
+                core = {
+                    k: v for k, v in s_r.items()
+                    if not k.startswith("_tr_")
+                }
+                dyn = {
+                    "eligible": elig0
+                    * (bl > 0.0).astype(elig0.dtype)
+                }
+                prev_lo, prev_hi = core["rx_lo"], core["rx_hi"]
+                coin = jax.random.uniform(
+                    jax.random.fold_in(k_r, t), (U,), jnp.float32
+                )[None, :]
+                s2 = fused(core, coin, t, sid_s, dyn)
+                served = (
+                    (s2["rx_hi"] - prev_hi).astype(jnp.float32)
+                    * jnp.float32(2**20)
+                    + (s2["rx_lo"] - prev_lo).astype(jnp.float32)
+                )
+                # a delivered TB larger than the backlog is padding
+                # (the TB-quantization deviation): only real SDU bits
+                # drain, and only they count as workload goodput.  The
+                # goodput counter uses the engine's rx_lo/rx_hi split
+                # (20-bit carry) so it stays EXACT past the ~2^24-bit
+                # f32 integer ceiling on long horizons.
+                drain = jnp.minimum(served, bl)
+                lo = s_r["_tr_drained_lo"] + jnp.round(drain).astype(
+                    jnp.int32
+                )
+                return dict(
+                    s2,
+                    _tr_backlog=bl - drain,
+                    _tr_drained_lo=lo % jnp.int32(2**20),
+                    _tr_drained_hi=s_r["_tr_drained_hi"]
+                    + lo // jnp.int32(2**20),
+                )
+
+            if r_pad is None:
+                step = one
+            else:
+                step = jax.vmap(one, in_axes=(0, 0, None))
+            if n_cfg is None:
+                s2 = step(s, keys, sid)
+            else:
+                s2 = jax.vmap(step, in_axes=(0, None, 0))(s, keys, sid)
+            return t + 1, s2
+
+        t, s = jax.lax.while_loop(
+            lambda c: c[0] < t_end, body, carry
+        )
+        metrics = (
+            dict(
+                ok=jnp.sum(s["ok_cnt"]), drops=jnp.sum(s["drops"]),
+                retx=jnp.sum(s["retx"]),
+            )
+            if obs
+            else {}
+        )
+        return (t, s), metrics
+
+    def init_carry():
+        s = sm_init_state(E, U)
+        s["_tr_backlog"] = jnp.zeros((1, U), jnp.float32)
+        s["_tr_drained_lo"] = jnp.zeros((1, U), jnp.int32)
+        s["_tr_drained_hi"] = jnp.zeros((1, U), jnp.int32)
+        return (jnp.int32(0), s)
+
+    return init_carry, advance
+
+
+def _run_lte_sm_traffic(
+    prog: LteSmProgram,
+    key,
+    replicas: int | None = None,
+    mesh=None,
+    *,
+    schedulers=None,
+    chunk_ttis: int | None = None,
+    checkpoint=None,
+    block: bool = True,
+):
+    """The finite-backlog form of :func:`run_lte_sm` (same contract,
+    same result fields + per-UE ``backlog_bits``/``offered_bits``).
+    One compiled executable serves the whole workload family AND all
+    nine schedulers at every horizon — model id, traffic params,
+    scheduler id and TTI bound are all traced operands."""
+    import jax.numpy as jnp
+
+    from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
+    from tpudes.obs.traffic import TrafficTelemetry
+    from tpudes.parallel.checkpoint import checkpoint_ctx
+    from tpudes.parallel.runtime import (
+        RUNTIME,
+        EngineFuture,
+        bucket_replicas,
+        chunk_bounds,
+        donate_argnums,
+        drive_chunks,
+        finalize_with_flush,
+        replica_keys,
+        shard_replica_axis,
+        stack_axis,
+        unstack_points,
+    )
+    from tpudes.traffic.device import TRAFFIC_KEY_TAG
+    from tpudes.traffic.host import offered_bits_mean
+
+    r_pad = bucket_replicas(replicas, mesh)
+    n_cfg = None if schedulers is None else len(schedulers)
+    obs = device_metrics_enabled()
+    use_pallas = pallas_enabled() and (
+        mesh is None or jax.default_backend() == "tpu"
+    )
+
+    def build():
+        init_carry, fn = build_sm_traffic_advance(
+            prog, r_pad=r_pad, n_cfg=n_cfg, obs=obs,
+            use_pallas=use_pallas,
+        )
+        return init_carry, jax.jit(fn, donate_argnums=donate_argnums(0))
+
+    (init_carry, fn), compiling = RUNTIME.runner(
+        "lte_sm",
+        _sm_cache_key(prog, r_pad, n_cfg, obs, use_pallas) + ("traffic",),
+        build,
+    )
+
+    sched_names = [prog.scheduler] if schedulers is None else list(schedulers)
+    sids = [SM_SCHED_IDS[s] for s in sched_names]
+    sid = (
+        jnp.int32(sids[0]) if n_cfg is None
+        else jnp.asarray(sids, jnp.int32)
+    )
+    keys = key if r_pad is None else shard_replica_axis(
+        replica_keys(key, r_pad), mesh, r_pad, 0
+    )
+    tr = prog.traffic.operands()
+    tr_key = jax.random.fold_in(key, TRAFFIC_KEY_TAG)
+
+    t0, s0 = init_carry()
+    s0 = stack_axis(stack_axis(s0, r_pad), n_cfg)
+    s0 = shard_replica_axis(s0, mesh, r_pad, 0 if n_cfg is None else 1)
+    carry = (t0, s0)
+
+    ckpt = checkpoint_ctx(
+        checkpoint, engine="lte_sm", key=key, replicas=replicas,
+        r_pad=r_pad, n_cfg=n_cfg, obs=obs,
+        axis=0 if n_cfg is None else 1, mesh=mesh,
+        extra=_sm_cache_key(prog, None, n_cfg, obs, False)
+        + ("traffic", prog.traffic.param_key(), tuple(sids)),
+    )
+    with CompileTelemetry.timed("lte_sm", compiling):
+        carry, flush = drive_chunks(
+            "lte_sm",
+            chunk_bounds(prog.n_ttis, chunk_ttis or prog.n_ttis),
+            carry,
+            lambda c, t_end: fn(
+                c, keys, sid, jnp.int32(t_end), tr, tr_key
+            ),
+            obs,
+            checkpoint=ckpt,
+        )
+        if compiling:
+            jax.block_until_ready(carry)
+
+    _, s_fin = carry
+    fetch = {k: s_fin[k] for k in _SM_FETCH}
+    fetch["_tr_backlog"] = s_fin["_tr_backlog"]
+    fetch["_tr_drained_lo"] = s_fin["_tr_drained_lo"]
+    fetch["_tr_drained_hi"] = s_fin["_tr_drained_hi"]
+    consts_np_h = build_sm_consts(prog)
+    consts_host = {
+        "cqi": np.asarray(consts_np_h["cqi"][0]),
+        "mcs": np.asarray(consts_np_h["mcs"][0]),
+        "sinr": np.asarray(consts_np_h["sinr"][0]),
+    }
+    want = replicas if r_pad is not None else None
+    # the workload's mean offered bits per UE over the horizon — the
+    # host mirror of the device fill (size quantization differs per
+    # TTI draw; this is its expectation), for telemetry + results
+    offered = offered_bits_mean(prog.traffic, prog.n_ttis * 1000)
+
+    def unpack_one(host):
+        host = dict(host)
+
+        def row(v):
+            a = np.asarray(v)
+            a = a.reshape(a.shape[:-2] + a.shape[-1:])
+            return a[:want] if want is not None and a.shape[0] != want \
+                else a
+
+        backlog = row(host.pop("_tr_backlog"))
+        drained = (
+            row(host.pop("_tr_drained_hi")).astype(np.int64) << 20
+        ) + row(host.pop("_tr_drained_lo")).astype(np.int64)
+        out = _sm_unpack(host, consts_host, want)
+        out["backlog_bits"] = backlog
+        out["goodput_bits"] = drained
+        out["offered_bits"] = offered
+        return out
+
+    unstack = unstack_points(n_cfg, unpack_one)
+
+    # burst duty (mean ON share) only means anything for onoff programs
+    duty = (
+        float(
+            np.clip(
+                prog.traffic.rate_pps.sum()
+                / max(float(prog.traffic.peak_pps.sum()), 1e-9),
+                0.0, 1.0,
+            )
+        )
+        if prog.traffic.model == "onoff"
+        else None
+    )
+
+    def finalize(host):
+        out = unstack(host)
+        pts = out if isinstance(out, list) else [out]
+        drained = float(
+            sum(
+                np.asarray(p["goodput_bits"], np.float64).sum()
+                for p in pts
+            )
+        )
+        lanes = len(pts) * (want or 1)
+        TrafficTelemetry.record(
+            "lte_sm", prog.traffic.model,
+            offered=float(offered.sum()) * lanes,
+            delivered=drained, duty=duty,
+        )
+        return out
+
+    fut = EngineFuture(
+        "lte_sm", fetch, finalize_with_flush(flush, finalize),
+    )
+    return fut.result() if block else fut
 
 
 def _run_lte_sm_mobile(
@@ -917,8 +1219,25 @@ def run_lte_sm(
 
     A program with ``prog.mobility`` routes to the mobile-geometry
     runner (same contract; results gain ``geom_refreshes``/
-    ``geom_stride``) — see :func:`_run_lte_sm_mobile`.
+    ``geom_stride``) — see :func:`_run_lte_sm_mobile`.  A program with
+    ``prog.traffic`` routes to the finite-backlog runner (results gain
+    ``backlog_bits``/``offered_bits``) — see
+    :func:`_run_lte_sm_traffic`; combining both axes on one LTE
+    program is rejected loudly (run one axis on device and the other
+    through the host controller) — the ROADMAP remainder.
     """
+    if prog.traffic is not None:
+        if prog.mobility is not None:
+            raise UnliftableLteScenarioError(
+                "traffic + mobility cannot yet ride one LTE program; "
+                "run one axis on device and the other on the host "
+                "controller"
+            )
+        return _run_lte_sm_traffic(
+            prog, key, replicas=replicas, mesh=mesh,
+            schedulers=schedulers, chunk_ttis=chunk_ttis,
+            checkpoint=checkpoint, block=block,
+        )
     if prog.mobility is not None:
         return _run_lte_sm_mobile(
             prog, key, replicas=replicas, mesh=mesh,
@@ -1068,6 +1387,51 @@ def _trace_entries(prog: LteSmProgram, obs: bool = False):
     ]
 
 
+def _trace_traffic_prog():
+    """Tiny finite-backlog program for the traffic TraceVariant."""
+    import dataclasses
+
+    from tpudes.traffic import TrafficProgram
+
+    base = _trace_prog()
+    return dataclasses.replace(
+        base,
+        traffic=TrafficProgram.onoff(
+            base.n_ue, 100.0, horizon_us=base.n_ttis * 1000,
+            on=(1.5, 0.01, 0.05), off_mean_s=0.02,
+        ),
+    )
+
+
+def _trace_entries_traffic(prog: LteSmProgram):
+    """The finite-backlog advance exactly as ``_run_lte_sm_traffic``
+    jits it (plain-XLA lowering), with concrete tiny operands — the
+    new jitted program joins the JXL lint surface like the base one."""
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+    from tpudes.parallel.runtime import replica_keys, stack_axis
+    from tpudes.traffic.device import TRAFFIC_KEY_TAG
+
+    init_carry, fn = build_sm_traffic_advance(
+        prog, r_pad=_TRACE_R, use_pallas=False
+    )
+    keys = replica_keys(jax.random.PRNGKey(0), _TRACE_R)
+    t0, s0 = init_carry()
+    carry = (t0, stack_axis(s0, _TRACE_R))
+    tr = prog.traffic.operands()
+    tr_key = jax.random.fold_in(jax.random.PRNGKey(0), TRAFFIC_KEY_TAG)
+    return [
+        TraceEntry(
+            "traffic_advance",
+            fn,
+            (carry, keys, jnp.int32(SM_SCHED_IDS[prog.scheduler]),
+             jnp.int32(8), tr, tr_key),
+            donate=(0,),
+            carry=(0,),
+            traced={"sid": 2, "t_end": 3, "tr": 4},
+        ),
+    ]
+
+
 def _trace_flips():
     import dataclasses
 
@@ -1129,6 +1493,12 @@ def trace_manifest():
                     dataclasses.replace(_trace_prog(), precision="bf16")
                 ),
                 bf16=True,
+            ),
+            # ISSUE-14: the finite-backlog traffic advance is its own
+            # jitted program — it must ride the lint surface too
+            TraceVariant(
+                "traffic",
+                lambda: _trace_entries_traffic(_trace_traffic_prog()),
             ),
         ],
         flips=_trace_flips,

@@ -89,6 +89,53 @@ def toy_dumbbell_program(n_flows: int = 3, n_slots: int = 250):
     )
 
 
+def toy_traffic_points(n: int, horizon_us: int, start_us=0,
+                       beacon=None) -> list:
+    """Eight mixed workload-sweep points (2 cbr rates, 3 mmpp seeds,
+    2 onoff seeds, 1 trace replay) over ``n`` entities, shape-unified
+    so they ride ONE engine executable — shared by the
+    ``traffic_burst`` bench row and the sweep-equality tests.
+    ``beacon=(interval_us, start_us)`` pins entity 0 to cbr (the BSS
+    AP's beacon process)."""
+    from tpudes.traffic import TrafficProgram, unify_shapes
+
+    start = np.broadcast_to(
+        np.asarray(start_us, np.int32), (n,)
+    ).copy()
+
+    def pin(tp):
+        if beacon is None:
+            return tp
+        return tp.with_cbr_rows(
+            np.arange(n) == 0, beacon[0], beacon[1]
+        )
+
+    pts = [
+        pin(TrafficProgram.cbr(start, 20_000)),
+        pin(TrafficProgram.cbr(start, 9_000)),
+    ]
+    for i in range(3):
+        pts.append(pin(TrafficProgram.mmpp(
+            n, 60.0 + 30.0 * i, horizon_us=horizon_us, epoch_s=0.05,
+            start_us=start, tr_seed=i,
+        )))
+    for i in range(2):
+        pts.append(pin(TrafficProgram.onoff(
+            n, 150.0, horizon_us=horizon_us, on=(1.5, 0.05, 0.3),
+            off_mean_s=0.1 + 0.1 * i, start_us=start, tr_seed=i,
+        )))
+    # deterministic synthetic "empirical" trace (no host RNG: the
+    # builders' pure-numpy rule) — staggered bursts per entity
+    k = 24
+    base = (
+        np.linspace(0.08, 0.92, k)[None, :] * horizon_us
+        + np.arange(n)[:, None] * 1771
+    ).astype(np.int64)
+    sizes = (200 + 37 * (np.arange(n * k) % 29)).reshape(n, k)
+    pts.append(pin(TrafficProgram.trace_replay(base, sizes)))
+    return unify_shapes(pts)
+
+
 def toy_as_program(
     n_nodes: int = 64, n_flows: int = 3, spf_rounds: int = 16, seed: int = 1
 ):

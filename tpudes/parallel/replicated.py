@@ -124,6 +124,12 @@ FUZZ_ENVELOPE = FuzzEnvelope(
                                  "random_walk")),
         "mob_speed": ("float", 0.3, 1.5),
         "geom_stride": ("choice", (1, 2, 4, 16)),
+        # ISSUE-14 traffic draws (appended — axis order is part of the
+        # seed→config contract): STA arrivals ride the drawn workload
+        # model (beacons stay cbr); "off" keeps the legacy CBR advance
+        "traffic": ("choice", ("off", "cbr", "mmpp", "onoff", "trace")),
+        "tr_burst": ("float", 0.1, 0.6),
+        "tr_phase": ("float", 0.0, 1.0),
     },
     floors={"replicas": 1, "n_stas": 1, "sim_ms": 1300},
     doc="AP + n STAs on one circle, UDP echo upstream, beacons on",
@@ -175,6 +181,18 @@ class BssProgram:
     #: samples the same motion, just less often (the stride contract,
     #: pinned like TPUDES_BUCKETING's).
     geom_stride: int = 1
+    #: device-resident workload (tpudes.traffic.TrafficProgram over the
+    #: N nodes; entity 0 is the AP's beacon process): None = the legacy
+    #: CBR advance (bit-identical compile).  The model id and every
+    #: traffic parameter are traced operands — only
+    #: ``traffic.shape_key()`` enters the runner cache key, so a sweep
+    #: across the whole workload family reuses one executable.  The
+    #: program's first arrivals still come from ``start_us``; the
+    #: traffic stage supplies every subsequent inter-arrival gap,
+    #: keyed ``fold_in(key, replica, entity, t)`` (bucketing/chunking/
+    #: checkpoint stay bit-exact).  A matching cbr program is pinned
+    #: bit-equal to ``traffic=None`` (the ``traffic_off`` fuzz pair).
+    traffic: object = None
 
     @property
     def n(self) -> int:
@@ -517,8 +535,22 @@ def _total_offered_arrivals(prog: BssProgram) -> int:
 
 def _estimate_max_steps(prog: BssProgram) -> int:
     # one arrival event + up to 1+RETRY_LIMIT tx events per frame, plus
-    # same-instant arrival/tx splits; generous slack
-    return int(_total_offered_arrivals(prog) * (3 + RETRY_LIMIT) * 1.5) + 64
+    # same-instant arrival/tx splits; generous slack.  A traffic
+    # program replaces the CBR count with the workload's own offered
+    # total (the host mirror of the device cum kernel — bursty models
+    # offer more than the nominal arrays say).
+    total = _total_offered_arrivals(prog)
+    if prog.traffic is not None:
+        from tpudes.traffic.host import offered_packets
+
+        horizon = np.minimum(
+            prog.stop_us.astype(np.int64), prog.sim_end_us
+        )
+        total = max(
+            total,
+            int(np.ceil(offered_packets(prog.traffic, horizon).sum())),
+        )
+    return int(total * (3 + RETRY_LIMIT) * 1.5) + 64
 
 
 def build_bss_step(
@@ -593,6 +625,13 @@ def build_bss_step(
     interval = jnp.asarray(prog.interval_us, dtype=jnp.int32)
     stop = jnp.asarray(prog.stop_us, dtype=jnp.int32)
     is_ap = jnp.arange(n) == 0
+
+    # --- device-resident workload (tpudes.traffic) ------------------------
+    TRAFFIC = prog.traffic is not None
+    if TRAFFIC:
+        from tpudes.traffic.device import TRAFFIC_KEY_TAG, build_gap_fn
+
+        gap_fn = build_gap_fn(prog.traffic)
 
     # --- device-resident geometry (tpudes.ops.mobility) -------------------
     MOBILE = prog.mobility is not None
@@ -669,7 +708,18 @@ def build_bss_step(
         tx = jnp.maximum(tx, s["t"][:, None])  # never in the past
         return jnp.where(frame, tx, INF)
 
-    def step_fn(s, key, sim_end, geom=None):
+    def traffic_keys(key):
+        """(R, 2) per-replica traffic key rows — pure in the RUN key
+        (not the step), so gap draws stay keyed (key, replica, entity,
+        arrival time).  Loop-invariant: computed ONCE per advance and
+        threaded into the while_loop body (recomputing R fold_ins per
+        step would ride the hot path for nothing)."""
+        tr_key = jax.random.fold_in(key, TRAFFIC_KEY_TAG)
+        return jax.vmap(
+            lambda i: jax.random.fold_in(tr_key, i)
+        )(jnp.arange(R))
+
+    def step_fn(s, key, sim_end, geom=None, tr=None, tr_keys=None):
         # per-replica keying: replica r's draws at step t are a pure
         # function of (key, t, r) — independent of R — so runtime
         # replica-bucketing (padding R to a power of two) leaves every
@@ -723,9 +773,26 @@ def build_bss_step(
             jnp.where(is_arr & is_ap[None, :], 1, 0), axis=1,
             dtype=jnp.int32,
         )
-        adv = jnp.where(
-            s["next_arr"] >= INF, INF, s["next_arr"] + interval[None, :]
-        )
+        if TRAFFIC:
+            # traffic stage: the next inter-arrival gap per (replica,
+            # node) comes from the traced workload program.  Gaps are
+            # pure in (key, replica, entity, arrival time) — the
+            # per-replica keys derive from the RUN key (not the
+            # step-folded k; see traffic_keys), so chunk boundaries
+            # and replica bucketing leave every stream bit-identical.
+            # The legacy cbr advance is the model's cbr branch, bit
+            # for bit.
+            tr_rkeys = traffic_keys(key) if tr_keys is None else tr_keys
+            gaps = jax.vmap(
+                lambda kr, ta: gap_fn(tr, kr, ta)
+            )(tr_rkeys, s["next_arr"])                   # (R, N) µs
+            adv = jnp.where(
+                s["next_arr"] >= INF, INF, s["next_arr"] + gaps
+            )
+        else:
+            adv = jnp.where(
+                s["next_arr"] >= INF, INF, s["next_arr"] + interval[None, :]
+            )
         adv = jnp.where(adv >= stop[None, :], INF, adv)
         new_next_arr = jnp.where(is_arr, adv, s["next_arr"])
 
@@ -970,6 +1037,9 @@ def build_bss_step(
         ta = jnp.min(s["next_arr"], axis=1)
         return (s["t"] < sim_end) & (jnp.minimum(ta, tx_t) < sim_end)
 
+    # loop-invariant key derivation, exposed so the advance builder
+    # hoists it outside the while_loop (None when no traffic stage)
+    step_fn.traffic_keys = traffic_keys if TRAFFIC else None
     return init_state, pending, step_fn
 
 
@@ -977,14 +1047,15 @@ def _prog_cache_key(prog: BssProgram) -> tuple:
     """Hashable identity of a BssProgram (ndarray fields → bytes).
     ``sim_end_us`` AND ``geom_stride`` are deliberately ABSENT (both
     are traced operands — one executable serves every horizon and
-    every stride), and ``mobility`` contributes only its SHAPE key:
-    the model id and every mobility parameter are traced too, so a
-    sweep across the whole model family reuses one executable."""
+    every stride), and ``mobility``/``traffic`` contribute only their
+    SHAPE keys: the model ids and every mobility/workload parameter
+    are traced too, so a sweep across either model family reuses one
+    executable."""
     out = []
     for k, v in prog.__dict__.items():
         if k in ("sim_end_us", "geom_stride"):
             continue
-        if k == "mobility":
+        if k in ("mobility", "traffic"):
             out.append(None if v is None else v.shape_key())
         elif isinstance(v, np.ndarray):
             out.append(v.tobytes())
@@ -994,25 +1065,37 @@ def _prog_cache_key(prog: BssProgram) -> tuple:
 
 
 def build_bss_advance(prog: "BssProgram", replicas: int, obs: bool = False,
-                      n_cfg: int | None = None, geom_per_step: bool = False):
+                      n_cfg: int | None = None, geom_per_step: bool = False,
+                      sweep: str = "horizon"):
     """``(init_state, pending, fn)`` with
-    ``fn(s, k, max_steps, sim_end, geom)`` the UNJITTED (but
+    ``fn(s, k, max_steps, sim_end, geom, tr)`` the UNJITTED (but
     config-vmapped) advance exactly as :func:`_compiled_bss_runner`
     jits it — factored out so the trace manifest
     (:func:`trace_manifest`) abstractly traces the same program the
-    runner cache compiles."""
+    runner cache compiles.  With ``n_cfg``, ``sweep`` picks the
+    config-axis operand: ``"horizon"`` vmaps (state, sim_end) — the
+    classic horizon sweep — while ``"traffic"`` vmaps (state, traffic
+    operands): an 8-point WORKLOAD sweep (mixed cbr/mmpp/onoff/trace
+    points sharing one traffic shape key) is one (C, R, …) launch."""
     init_state, pending, step_fn = build_bss_step(
         prog, replicas, obs=obs, geom_per_step=geom_per_step
     )
 
-    def advance(s, k, max_steps, sim_end, geom=None):
+    def advance(s, k, max_steps, sim_end, geom=None, tr=None):
+        tr_keys = (
+            step_fn.traffic_keys(k)
+            if step_fn.traffic_keys is not None else None
+        )
+
         def cond(s):
             return jnp.logical_and(
                 s["step"] < max_steps, jnp.any(pending(s, sim_end))
             )
 
         out = jax.lax.while_loop(
-            cond, lambda st: step_fn(st, k, sim_end, geom), s
+            cond,
+            lambda st: step_fn(st, k, sim_end, geom, tr, tr_keys),
+            s,
         )
         # per-replica completion flags computed on-device so the
         # caller needs no second compiled program (each extra host
@@ -1034,13 +1117,19 @@ def build_bss_advance(prog: "BssProgram", replicas: int, obs: bool = False,
 
     fn = advance
     if n_cfg is not None:
-        fn = jax.vmap(fn, in_axes=(0, None, None, 0, None))
+        fn = jax.vmap(
+            fn,
+            in_axes=(
+                (0, None, None, 0, None, None) if sweep == "horizon"
+                else (0, None, None, None, None, 0)
+            ),
+        )
     return init_state, pending, fn
 
 
 def _compiled_bss_runner(
     prog_key, prog, replicas, mesh, obs=False, n_cfg=None,
-    geom_per_step=False,
+    geom_per_step=False, sweep: str = "horizon",
 ):
     """Jitted runner via the shared :data:`~tpudes.parallel.runtime.RUNTIME`
     cache, keyed on (program, padded replicas) so a warm-up call
@@ -1067,13 +1156,15 @@ def _compiled_bss_runner(
     def build():
         init_state, pending, fn = build_bss_advance(
             prog, replicas, obs=obs, n_cfg=n_cfg,
-            geom_per_step=geom_per_step,
+            geom_per_step=geom_per_step, sweep=sweep,
         )
         run = jax.jit(fn, donate_argnums=donate_argnums(0))
         return init_state, pending, run
 
     (init_state, pending, run), compiled_new = RUNTIME.runner(
-        "bss", (prog_key, replicas, obs, n_cfg, mobile, geom_per_step),
+        "bss",
+        (prog_key, replicas, obs, n_cfg, mobile, geom_per_step,
+         sweep if n_cfg is not None else None),
         build,
     )
     return init_state, pending, run, compiled_new
@@ -1124,6 +1215,10 @@ def bss_study(prog: BssProgram, key, replicas, mesh=None):
         mesh_fingerprint(mesh),
         None if prog.mobility is None else prog.mobility.param_key(),
         int(prog.geom_stride),
+        # workload identity by VALUE: traffic params are traced (not in
+        # the runner cache key) but two studies with different
+        # workloads must not coalesce — the sweep operand is sim_end
+        None if prog.traffic is None else prog.traffic.param_key(),
     )
 
     def launch(points, block=False):
@@ -1165,6 +1260,7 @@ def run_replicated_bss(
     mesh=None,
     *,
     sim_end_us=None,
+    traffic_sweep=None,
     chunk_steps: int | None = None,
     checkpoint=None,
     block: bool = True,
@@ -1195,6 +1291,15 @@ def run_replicated_bss(
     finished replica is a fixed point of step_fn, so the extra
     iterations change nothing but the counter.)
 
+    ``traffic_sweep=[...]`` (TrafficPrograms sharing one
+    ``shape_key``, with ``prog.traffic`` naming the shape class) runs
+    a **config-axis workload sweep** instead: the traffic operand
+    tables gain the leading vmapped axis, so a C-point mixed
+    cbr/mmpp/onoff/trace workload study is ONE launch of a (C, R, …)
+    program — demuxed bit-equal to per-point launches with
+    ``dataclasses.replace(prog, traffic=tp)`` and the same key (the
+    sweep shares one step budget, exactly like the horizon sweep).
+
     ``chunk_steps=N`` splits the event loop into N-iteration segments
     with a donated carry handoff (bit-identical: the loop condition
     depends only on the carry).  ``checkpoint=`` (a path or
@@ -1218,15 +1323,31 @@ def run_replicated_bss(
         unstack_points,
     )
 
-    n_cfg = None if sim_end_us is None else len(sim_end_us)
+    if sim_end_us is not None and traffic_sweep is not None:
+        raise ValueError(
+            "one config axis per launch: sweep either the horizon "
+            "(sim_end_us=[...]) or the workload (traffic_sweep=[...])"
+        )
+    sweep = "traffic" if traffic_sweep is not None else "horizon"
+    n_cfg = (
+        len(sim_end_us) if sim_end_us is not None
+        else (len(traffic_sweep) if traffic_sweep is not None else None)
+    )
     ends = (
-        [prog.sim_end_us] if sim_end_us is None
-        else [int(v) for v in sim_end_us]
+        [int(v) for v in sim_end_us] if sim_end_us is not None
+        else [prog.sim_end_us]
+    )
+    sweep_progs = (
+        [prog] if traffic_sweep is None
+        else [
+            dataclasses.replace(prog, traffic=tp) for tp in traffic_sweep
+        ]
     )
     if max_steps is None:
         max_steps = max(
-            _estimate_max_steps(dataclasses.replace(prog, sim_end_us=v))
+            _estimate_max_steps(dataclasses.replace(p, sim_end_us=v))
             for v in ends
+            for p in sweep_progs
         )
     obs = device_metrics_enabled()
     # replica bucketing: pad R to the power-of-two bucket so a replica
@@ -1238,11 +1359,11 @@ def run_replicated_bss(
     r_pad = bucket_replicas(replicas, mesh)
     init_state, pending, run, compiling = _compiled_bss_runner(
         _prog_cache_key(prog), prog, r_pad, mesh, obs=obs, n_cfg=n_cfg,
-        geom_per_step=geom_per_step,
+        geom_per_step=geom_per_step, sweep=sweep,
     )
 
-    # mobility params + stride ride as TRACED operands (None for the
-    # static tables path); the cache key above carries only shapes
+    # mobility/traffic params ride as TRACED operands (None for the
+    # legacy paths); the cache key above carries only shapes
     geom = (
         None if prog.mobility is None
         else dict(
@@ -1250,8 +1371,23 @@ def run_replicated_bss(
             **prog.mobility.operands(),
         )
     )
+    if traffic_sweep is not None:
+        from tpudes.traffic.device import stack_traffic_operands
+
+        if prog.traffic is None or any(
+            tp.shape_key() != prog.traffic.shape_key()
+            for tp in traffic_sweep
+        ):
+            raise ValueError(
+                "a workload sweep needs prog.traffic set and every "
+                "point sharing its traffic shape key (one executable "
+                "serves the sweep; pad tables to a common capacity)"
+            )
+        tr = stack_traffic_operands(traffic_sweep)
+    else:
+        tr = None if prog.traffic is None else prog.traffic.operands()
     sim_end = (
-        jnp.int32(ends[0]) if n_cfg is None
+        jnp.int32(ends[0]) if n_cfg is None or sweep == "traffic"
         else jnp.asarray(ends, jnp.int32)
     )
     s0 = stack_axis(init_state(), n_cfg)
@@ -1263,7 +1399,7 @@ def run_replicated_bss(
             # the step bound; finished replicas are a fixed point of
             # step_fn, so later segments cost one cond evaluation
             state, still_pending, metrics = run(
-                carry[0], key, jnp.int32(bound), sim_end, geom
+                carry[0], key, jnp.int32(bound), sim_end, geom, tr
             )
             return (state, still_pending), metrics
 
@@ -1271,7 +1407,15 @@ def run_replicated_bss(
             checkpoint, engine="bss", key=key, replicas=replicas,
             r_pad=r_pad, n_cfg=n_cfg, obs=obs,
             axis=0 if n_cfg is None else 1, mesh=mesh,
-            extra=_prog_cache_key(prog) + (tuple(ends), geom_per_step),
+            extra=_prog_cache_key(prog) + (
+                tuple(ends), geom_per_step,
+                # traffic identity by VALUE (shape key alone would let
+                # a resumed run silently swap workloads mid-study)
+                None if prog.traffic is None
+                else prog.traffic.param_key(),
+                None if traffic_sweep is None
+                else tuple(tp.param_key() for tp in traffic_sweep),
+            ),
         )
         (out, still_pending), flush = drive_chunks(
             "bss",
@@ -1342,17 +1486,28 @@ def _trace_entries(prog: "BssProgram", obs: bool = False):
     )
     key = jax.random.PRNGKey(0)
     s0 = init_state()
+    tr = None if prog.traffic is None else prog.traffic.operands()
+    traced = {"max_steps": 2, "sim_end": 3}
+    if tr is not None:
+        traced["tr"] = 5
     return [
         TraceEntry("init", init_state, (), kernel=False),
         TraceEntry(
             "advance",
             fn,
-            (s0, key, jnp.int32(64), jnp.int32(prog.sim_end_us), None),
+            (s0, key, jnp.int32(64), jnp.int32(prog.sim_end_us), None,
+             tr),
             donate=(0,),
             carry=(0,),
-            traced={"max_steps": 2, "sim_end": 3},
+            traced=traced,
         ),
     ]
+
+
+def _flip_traffic():
+    from tpudes.traffic import TrafficProgram
+
+    return TrafficProgram.mmpp(3, 50.0, horizon_us=20_000)
 
 
 def _trace_flips():
@@ -1377,6 +1532,11 @@ def _trace_flips():
             build=lambda: _trace_entries(base, obs=True),
             key_differs=True,
         ),
+        # a workload program joins the trace (the traffic stage) and
+        # its SHAPE key joins the cache key — while the traffic
+        # manifest's own flips pin that model/param flips inside the
+        # family stay compile-free
+        "traffic": flip(traffic=_flip_traffic()),
         # excluded-by-design fields must leave every trace identical:
         # the horizon is a traced operand (one executable per program
         # across every sim_end / step budget)

@@ -103,6 +103,11 @@ FUZZ_ENVELOPE = FuzzEnvelope(
         "replicas": ("int", 2, 9),
         "chunk_divisor": ("choice", (2, 3)),
         "key_seed": ("int", 0, 2**16),
+        # ISSUE-14 traffic draws (appended): app-limited flows from
+        # the drawn workload model; "off" keeps the bulk source
+        "traffic": ("choice", ("off", "cbr", "mmpp", "onoff", "trace")),
+        "tr_burst": ("float", 0.1, 0.6),
+        "tr_phase": ("float", 0.0, 1.0),
     },
     # sim_ms floor 8: even at the fastest slot (500 B @ 10 Mbps,
     # 0.432 ms) the shrunk horizon lands under 32 slots
@@ -139,6 +144,15 @@ class DumbbellProgram:
     red_gentle: bool = True
     red_use_ecn: bool = False
     red_use_hard_drop: bool = True
+    #: device-resident workload (tpudes.traffic.TrafficProgram over the
+    #: F flows): None = the legacy bulk source (infinite application
+    #: backlog, bit-identical compile).  With a program, each flow is
+    #: APP-LIMITED: it may only keep ``delivered + inflight`` below the
+    #: workload's cumulative offered segments (closed-form on device),
+    #: so bursts and think-times shape the congestion dynamics.  Model
+    #: id + params are traced operands — only ``traffic.shape_key()``
+    #: enters the runner cache key.
+    traffic: object = None
 
     @property
     def buf_len(self) -> int:
@@ -742,6 +756,12 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
     Q = prog.queue_cap
     burst = prog.burst_cap
     RED = prog.qdisc == "red"
+    TRAFFIC = prog.traffic is not None
+    if TRAFFIC:
+        from tpudes.traffic.device import build_cum_fn
+
+        tr_cum = build_cum_fn(prog.traffic)
+        slot_us = max(1, int(round(prog.slot_s * 1e6)))
 
     def init_state():
         z = lambda *sh, dt=jnp.float32: jnp.zeros(sh, dt)  # noqa: E731
@@ -800,7 +820,7 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
             ),
         )
 
-    def step_fn(s, inp, var, ecn_cap):
+    def step_fn(s, inp, var, ecn_cap, tr=None):
         t, key = inp
         idx = t % L
 
@@ -920,6 +940,25 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
             delivered + inflight < max_pkts[None, :]
         )
         want = jnp.where(live, want, 0)
+        if TRAFFIC:
+            # app-limited sending: the workload's cumulative offered
+            # segments (closed-form, shared across replicas — the
+            # realization IS the workload, like the mobility
+            # trajectory) caps what may ever have left the
+            # application — an EXACT clip, not a gate, so the send
+            # burst cannot overshoot the offered count.  Arrivals
+            # inside a slot are sendable in that slot (the slot-end
+            # evaluation — sub-slot timing is below this model's
+            # resolution either way)
+            app_cum = jnp.floor(
+                tr_cum(tr, (t + 1) * jnp.int32(slot_us))
+            ).astype(jnp.int32)                          # (F,)
+            want = jnp.minimum(
+                want,
+                jnp.maximum(
+                    app_cum[None, :] - delivered - inflight, 0
+                ),
+            )
         red_avg = s["red_avg"]
         red_marks = jnp.zeros((R, F), jnp.float32)
         red_drops = jnp.zeros((R, F), jnp.int32)
@@ -1047,15 +1086,17 @@ def dumbbell_prog_key(prog: DumbbellProgram) -> tuple:
     the ``red_*`` parameters are absent too — they never reach the
     fifo program (keying on them was a dead cache-key component
     causing spurious recompiles across RED-parameter sweeps of
-    non-RED studies; found by analysis rule JXL004)."""
-    skip = {"n_slots", "variant_idx", "ecn"}
+    non-RED studies; found by analysis rule JXL004).  ``traffic``
+    contributes only its SHAPE key: the workload model id and every
+    parameter are traced operands."""
+    skip = {"n_slots", "variant_idx", "ecn", "traffic"}
     if prog.qdisc != "red":
         skip.update(_RED_FIELDS)
     return tuple(
         v.tobytes() if isinstance(v, np.ndarray) else v
         for k, v in prog.__dict__.items()
         if k not in skip
-    )
+    ) + (None if prog.traffic is None else prog.traffic.shape_key(),)
 
 
 def build_dumbbell_advance(prog: DumbbellProgram, r_pad: int,
@@ -1066,14 +1107,14 @@ def build_dumbbell_advance(prog: DumbbellProgram, r_pad: int,
     abstractly traces the same program the runner cache compiles."""
     init_state, step_fn = build_dumbbell_step(prog, r_pad, obs=obs)
 
-    def advance(carry, key, var, ecn, t_end):
+    def advance(carry, key, var, ecn, t_end, tr=None):
         # per-slot key = fold_in(key, t): pure in (key, t), so the
         # traced horizon needs no split-keys array shape and a
         # chunked run re-enters at t>0 on the same slot streams
         def body(c):
             t, s = c
             s, _ = step_fn(
-                s, (t, jax.random.fold_in(key, t)), var, ecn
+                s, (t, jax.random.fold_in(key, t)), var, ecn, tr
             )
             return t + 1, s
 
@@ -1096,7 +1137,7 @@ def build_dumbbell_advance(prog: DumbbellProgram, r_pad: int,
 
     fn = advance
     if n_cfg is not None:
-        fn = jax.vmap(fn, in_axes=(0, None, 0, 0, None))
+        fn = jax.vmap(fn, in_axes=(0, None, 0, 0, None, None))
     return init_state, fn
 
 
@@ -1194,7 +1235,11 @@ def tcp_study(prog: DumbbellProgram, key, replicas, mesh=None):
     statics = tuple(
         v.tobytes() if isinstance(v, np.ndarray) else v
         for k, v in prog.__dict__.items()
-        if k not in ("variant_idx", "ecn")
+        if k not in ("variant_idx", "ecn", "traffic")
+    ) + (
+        # workload identity by VALUE: params are traced, but studies
+        # with different workloads must not coalesce
+        None if prog.traffic is None else prog.traffic.param_key(),
     )  # n_slots stays IN: the batch shares one traced slot bound
     ck = (
         statics, np.asarray(key).tobytes(), int(replicas),
@@ -1325,19 +1370,23 @@ def run_tcp_dumbbell(
         carry, mesh, r_pad, 0 if n_cfg is None else 1
     )
 
+    # workload params ride as TRACED operands (None = the bulk path);
+    # the runner cache key above carries only the traffic shape key
+    tr = None if prog.traffic is None else prog.traffic.operands()
     ckpt = checkpoint_ctx(
         checkpoint, engine="dumbbell", key=key, replicas=replicas,
         r_pad=r_pad, n_cfg=n_cfg, obs=obs,
         axis=0 if n_cfg is None else 1, mesh=mesh,
         extra=dumbbell_prog_key(prog)
-        + (tuple(tuple(int(i) for i in p) for p in points),),
+        + (tuple(tuple(int(i) for i in p) for p in points),
+           None if prog.traffic is None else prog.traffic.param_key()),
     )
     with CompileTelemetry.timed("dumbbell", compiling):
         carry, flush = drive_chunks(
             "dumbbell",
             chunk_bounds(prog.n_slots, chunk_slots or prog.n_slots),
             carry,
-            lambda c, t_end: fn(c, key, var, ecn, jnp.int32(t_end)),
+            lambda c, t_end: fn(c, key, var, ecn, jnp.int32(t_end), tr),
             obs,
             checkpoint=ckpt,
         )
@@ -1387,15 +1436,19 @@ def _trace_entries(prog: DumbbellProgram, obs: bool = False):
     var = jnp.asarray(prog.variant_idx, jnp.int32)
     ecn = jnp.asarray(_variant_ecn(np.asarray(prog.variant_idx)))
     carry = (jnp.int32(0), init_state())
+    tr = None if prog.traffic is None else prog.traffic.operands()
+    traced = {"var": 2, "ecn": 3, "t_end": 4}
+    if tr is not None:
+        traced["tr"] = 5
     return [
         TraceEntry("init", init_state, (), kernel=False),
         TraceEntry(
             "advance",
             fn,
-            (carry, key, var, ecn, jnp.int32(8)),
+            (carry, key, var, ecn, jnp.int32(8), tr),
             donate=(0,),
             carry=(0,),
-            traced={"var": 2, "ecn": 3, "t_end": 4},
+            traced=traced,
         ),
     ]
 
@@ -1414,11 +1467,18 @@ def _trace_flips():
             key_differs=dumbbell_prog_key(prog) != dumbbell_prog_key(base),
         )
 
+    from tpudes.traffic import TrafficProgram
+
     return {
         # live components: each must change some traced program
         "queue_cap": flip(queue_cap=13),
         "ack_lag": flip(ack_lag=7),
         "qdisc": flip(qdisc="red"),
+        # a workload program joins the trace (the app-limit gate) and
+        # its SHAPE key joins the cache key
+        "traffic": flip(
+            traffic=TrafficProgram.onoff(2, 300.0, horizon_us=30_000)
+        ),
         "obs": FlipSpec(
             build=lambda: _trace_entries(base, obs=True),
             key_differs=True,

@@ -1,0 +1,33 @@
+"""Device-resident traffic subsystem (ISSUE-14, ROADMAP item 4).
+
+Workload models as traced operands, shared by every device engine:
+trace replay + generative models (MMPP, Poisson-Pareto ON-OFF bursts,
+diurnal envelopes, bounded-Pareto sizes) dispatched by a traced model
+id under the ``fold_in(key, replica, entity, t)`` keying discipline.
+
+- :mod:`tpudes.traffic.program` — :class:`TrafficProgram` + factories
+  and the eager ``fold_in``-keyed table realizations;
+- :mod:`tpudes.traffic.device` — the closed-form cum/gap/bits/avg-mult
+  kernels the engines trace (and their JXL trace manifest);
+- :mod:`tpudes.traffic.host` — numpy mirrors for parity tests and
+  telemetry (the upstream ``src/applications`` host apps live in
+  :mod:`tpudes.models.applications`).
+"""
+
+from tpudes.traffic.program import (
+    TRAFFIC_MODEL_IDS,
+    TrafficProgram,
+    bounded_pareto_icdf,
+    bounded_pareto_mean,
+    traffic_tables,
+    unify_shapes,
+)
+
+__all__ = [
+    "TRAFFIC_MODEL_IDS",
+    "TrafficProgram",
+    "bounded_pareto_icdf",
+    "bounded_pareto_mean",
+    "traffic_tables",
+    "unify_shapes",
+]

@@ -1,0 +1,421 @@
+"""Closed-form device kernels over a :class:`TrafficProgram`.
+
+Every query is a pure function of the traced operand dict
+(:meth:`TrafficProgram.operands`) and a traced time — the mobility
+``build_position_fn`` shape: all model branches are evaluated and the
+traced ``tr_id`` selects, which is what keeps the whole workload
+family on one executable.  Three query forms cover the four engines:
+
+- :func:`build_cum_fn` — cumulative offered packets ``A(ops, t_us) →
+  (N,) f32`` (slotted engines: the dumbbell's app-limit gate, the LTE
+  per-TTI arrival delta, the AS fluid average).  Closed form because
+  the stochastic realizations live in the eager operand tables.
+- :func:`build_gap_fn` — next inter-arrival gap after an arrival at
+  ``t`` (event-stepped engines: the BSS arrival advance).  Only the
+  mmpp branch draws (one exponential per arrival, keyed by the
+  established ``fold_in(key, replica, entity, t)`` discipline — pure
+  in those indices, so bucketing/chunking/checkpointing stay
+  bit-exact); cbr/onoff/trace gaps are deterministic table math.
+- :func:`build_bits_fn` — offered BITS in a window (the LTE backlog
+  fill): exact per-arrival bytes for trace replay, packet-count ×
+  bounded-Pareto size quantum for the generative models (one size
+  draw per (entity, window), keyed ``fold_in(key, entity, t)``).
+
+:func:`avg_mult` is the fluid view (AS flows): realized/nominal rate
+ratio over a traced horizon, exactly 1 for cbr by construction (the
+``traffic_off`` exact-pair anchor).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudes.traffic.program import (
+    GAP_INF,
+    TRAFFIC_MODEL_IDS,
+    TrafficProgram,
+)
+
+__all__ = [
+    "avg_mult",
+    "build_bits_fn",
+    "build_cum_fn",
+    "build_gap_fn",
+    "stack_traffic_operands",
+    "traffic_operands",
+]
+
+#: fold tag deriving the per-run traffic key from the engine key — a
+#: fixed integer, so the stream is pure in (run key, replica, entity, t)
+TRAFFIC_KEY_TAG = 0x7A
+
+
+def traffic_operands(prog: TrafficProgram | None) -> dict | None:
+    """None-safe operand extraction (the engines' ``geom`` shape)."""
+    return None if prog is None else prog.operands()
+
+
+def stack_traffic_operands(progs) -> dict:
+    """Stack the operand dicts of several SAME-SHAPE programs along a
+    leading config axis — the (C, …) operand of a workload sweep.  All
+    programs must share :meth:`TrafficProgram.shape_key` (a sweep
+    rides ONE executable; mismatched capacities are a caller error)."""
+    import jax.numpy as jnp
+
+    keys = {p.shape_key() for p in progs}
+    if len(keys) != 1:
+        raise ValueError(
+            f"workload sweep points must share one traffic shape key "
+            f"(got {sorted(keys)}); pad tables to a common capacity"
+        )
+    ops = [p.operands() for p in progs]
+    return {k: jnp.stack([o[k] for o in ops]) for k in ops[0]}
+
+
+def _cum_branches(prog: TrafficProgram):
+    """Shared branch math for cum/gap: returns a function computing all
+    four models' cumulative offered packets at ``t_us`` plus the
+    indices the gap kernel reuses."""
+    import jax.numpy as jnp
+
+    S = int(prog.n_epoch)
+    C = int(prog.n_cycle)
+    K = int(prog.arr_t.shape[1])
+    epoch_us = float(prog.epoch_us)
+
+    def parts(ops, t_us):
+        # normalize to a per-entity time vector (callers pass a traced
+        # scalar OR an (N,) per-entity arrival-time vector)
+        tv = jnp.broadcast_to(
+            jnp.asarray(t_us, jnp.int32), ops["tr_start"].shape
+        )
+        # workload clock: τ = t − start, clamped at 0 (nothing before
+        # the per-entity start); trace times are absolute
+        tau = jnp.maximum(tv - ops["tr_start"], 0)            # (N,)
+        tau_f = tau.astype(jnp.float32)
+
+        # cbr: arrivals at start + k·interval, k ≥ 0
+        started = tv >= ops["tr_start"]
+        a_cbr = jnp.where(
+            started & (ops["tr_interval"] < GAP_INF),
+            tau // jnp.maximum(ops["tr_interval"], 1) + 1,
+            0,
+        ).astype(jnp.float32)
+
+        # mmpp: rate_pps × closed-form cumulative intensity from the
+        # epoch prefix table
+        e = jnp.clip((tau // jnp.int32(epoch_us)), 0, S - 1)  # (N,)
+        lam = (
+            ops["tr_epoch_cum"][e]
+            + ops["tr_epoch_rate"][e]
+            * jnp.minimum(
+                tau_f - e.astype(jnp.float32) * jnp.float32(epoch_us),
+                jnp.float32(epoch_us),
+            )
+            * jnp.float32(1e-6)
+        )
+        a_mmpp = ops["tr_rate"] * lam * started
+
+        # onoff: per-cycle prefix packets + peak-rate fill of the
+        # current burst (the waypoint count-index trick)
+        c = jnp.clip(
+            jnp.sum(ops["tr_on_start"] <= tau[:, None], axis=1) - 1,
+            0, C - 1,
+        )                                                     # (N,)
+        on_s = jnp.take_along_axis(
+            ops["tr_on_start"], c[:, None], axis=1
+        )[:, 0]
+        on_l = jnp.take_along_axis(
+            ops["tr_on_len"], c[:, None], axis=1
+        )[:, 0]
+        pk = jnp.take_along_axis(ops["tr_peak"], c[:, None], axis=1)[:, 0]
+        cum0 = jnp.take_along_axis(
+            ops["tr_cum_pk"], c[:, None], axis=1
+        )[:, 0]
+        fill_s = jnp.clip(
+            tau_f - on_s.astype(jnp.float32), 0.0,
+            on_l.astype(jnp.float32),
+        ) * jnp.float32(1e-6)
+        a_onoff = (cum0 + pk * fill_s) * started
+
+        # trace: exact count of table entries at/before t (absolute
+        # clock, INF padding never counts)
+        live = ops["tr_arr_t"] < GAP_INF
+        hit = live & (ops["tr_arr_t"] <= tv[:, None])
+        a_trace = jnp.sum(hit, axis=1, dtype=jnp.int32).astype(
+            jnp.float32
+        )
+
+        return dict(
+            a_cbr=a_cbr, a_mmpp=a_mmpp, a_onoff=a_onoff,
+            a_trace=a_trace, tau=tau, e=e, c=c, on_s=on_s, on_l=on_l,
+            pk=pk, hit=hit, K=K,
+        )
+
+    return parts
+
+
+def _select(tr_id, cbr, mmpp, onoff, trace):
+    import jax.numpy as jnp
+
+    return jnp.where(
+        tr_id == TRAFFIC_MODEL_IDS["trace"], trace,
+        jnp.where(
+            tr_id == TRAFFIC_MODEL_IDS["onoff"], onoff,
+            jnp.where(tr_id == TRAFFIC_MODEL_IDS["mmpp"], mmpp, cbr),
+        ),
+    )
+
+
+def build_cum_fn(prog: TrafficProgram):
+    """``cum_fn(ops, t_us) -> (N,) f32`` cumulative offered packets in
+    ``[0, t_us]`` — monotone in t, closed form (no draws)."""
+    parts = _cum_branches(prog)
+
+    def cum_fn(ops, t_us):
+        p = parts(ops, t_us)
+        return _select(
+            ops["tr_id"], p["a_cbr"], p["a_mmpp"], p["a_onoff"],
+            p["a_trace"],
+        )
+
+    return cum_fn
+
+
+def build_gap_fn(prog: TrafficProgram):
+    """``gap_fn(ops, key_r, t_arr) -> (N,) i32`` µs from an arrival at
+    ``t_arr[e]`` (per entity) to that entity's NEXT arrival.
+
+    ``key_r`` is the caller's per-replica key; the one stochastic
+    branch (mmpp's exponential gap) folds in ``(entity, t_arr)`` on
+    top, so the draw is pure in ``(key, replica, entity, t)`` — the
+    bucketing/chunking bit-exactness discipline.  Entities past their
+    model's last table row return :data:`GAP_INF`-scale gaps (the
+    engines' stop logic masks them)."""
+    import jax
+    import jax.numpy as jnp
+
+    parts = _cum_branches(prog)
+    C = int(prog.n_cycle)
+    K = int(prog.arr_t.shape[1])
+
+    def gap_fn(ops, key_r, t_arr):
+        p = parts(ops, t_arr)
+        tau = p["tau"]
+
+        # cbr: the legacy advance, bit for bit
+        g_cbr = ops["tr_interval"]
+
+        # mmpp: exponential at the epoch's modulated rate (frozen-rate
+        # approximation), one fold_in-keyed uniform per (entity, t)
+        def draw(e_idx, t_e):
+            k = jax.random.fold_in(jax.random.fold_in(key_r, e_idx), t_e)
+            return jax.random.uniform(k, (), jnp.float32)
+
+        u = jax.vmap(draw)(jnp.arange(prog.n), t_arr)
+        rate = ops["tr_rate"] * ops["tr_epoch_rate"][p["e"]]
+        g_exp = -jnp.log1p(-jnp.minimum(u, 1.0 - 1e-7)) / jnp.maximum(
+            rate, 1e-9
+        )
+        g_mmpp = jnp.clip(
+            jnp.round(g_exp * 1e6), 1.0, float(GAP_INF)
+        ).astype(jnp.int32)
+        g_mmpp = jnp.where(rate > 1e-9, g_mmpp, GAP_INF)
+
+        # onoff: deterministic peak-rate spacing inside the burst; an
+        # arrival whose successor would cross the burst end jumps to
+        # the next burst's start
+        p_us = jnp.clip(
+            jnp.round(1e6 / jnp.maximum(p["pk"], 1e-9)), 1.0,
+            float(GAP_INF),
+        ).astype(jnp.int32)
+        in_on = (tau >= p["on_s"]) & (tau < p["on_s"] + p["on_l"])
+        cand = tau + p_us
+        next_c = jnp.clip(p["c"] + 1, 0, C - 1)
+        next_on = jnp.take_along_axis(
+            ops["tr_on_start"], next_c[:, None], axis=1
+        )[:, 0]
+        exhausted = next_c == p["c"]  # past the last table cycle
+        jump = jnp.where(
+            exhausted, GAP_INF, jnp.maximum(next_on - tau, 1)
+        )
+        stays = in_on & (cand < p["on_s"] + p["on_l"]) & (p["pk"] > 1e-9)
+        g_onoff = jnp.where(stays, p_us, jump)
+
+        # trace: exact next-entry lookup
+        idx = jnp.sum(p["hit"], axis=1, dtype=jnp.int32)      # (N,)
+        nxt = jnp.take_along_axis(
+            ops["tr_arr_t"], jnp.minimum(idx, K - 1)[:, None], axis=1
+        )[:, 0]
+        g_trace = jnp.where(
+            (idx < K) & (nxt < GAP_INF),
+            jnp.maximum(nxt - t_arr, 1),
+            GAP_INF,
+        )
+
+        return _select(ops["tr_id"], g_cbr, g_mmpp, g_onoff, g_trace)
+
+    return gap_fn
+
+
+def _traced_pareto_sizes(u, tr_size):
+    """Traced form of :func:`tpudes.traffic.program.bounded_pareto_icdf`
+    (whose eager twin branches on python floats): the size params ride
+    as the ``tr_size`` OPERAND, so a size flip is new operand values —
+    never a stale compiled kernel (the shapes-only cache-key
+    contract)."""
+    import jax.numpy as jnp
+
+    alpha, lo, hi = tr_size[0], tr_size[1], tr_size[2]
+    degen = (alpha <= 0.0) | (hi <= lo)
+    a = jnp.where(degen, 1.0, alpha)
+    h = jnp.maximum(hi, lo * (1.0 + 1e-6))
+    r = (lo / h) ** a
+    drawn = lo / (1.0 - u * (1.0 - r)) ** (1.0 / a)
+    return jnp.where(degen, lo, drawn)
+
+
+def build_bits_fn(prog: TrafficProgram):
+    """``bits_fn(ops, key, t0_us, t1_us) -> (N,) f32`` offered bits in
+    ``[t0, t1)`` — the LTE backlog fill.  Trace replay contributes the
+    EXACT per-arrival bytes; the generative models contribute packet
+    count × a bounded-Pareto size quantum (one draw per (entity,
+    window), ``fold_in(key, entity, t0)``-keyed — shared across
+    replicas like the workload realization itself).  The size params
+    are TRACED (``tr_size``), like every other workload parameter."""
+    import jax
+    import jax.numpy as jnp
+
+    cum_fn = build_cum_fn(prog)
+
+    def bits_fn(ops, key, t0_us, t1_us):
+        d_pkts = jnp.floor(cum_fn(ops, t1_us - 1)) - jnp.floor(
+            cum_fn(ops, t0_us - 1)
+        )
+        d_pkts = jnp.maximum(d_pkts, 0.0)
+
+        def draw(e_idx):
+            k = jax.random.fold_in(jax.random.fold_in(key, e_idx), t0_us)
+            return jax.random.uniform(k, (), jnp.float32)
+
+        u = jax.vmap(draw)(jnp.arange(prog.n))
+        size_b = _traced_pareto_sizes(u, ops["tr_size"])
+        gen_bits = d_pkts * size_b * 8.0
+
+        live = ops["tr_arr_t"] < GAP_INF
+        win = live & (ops["tr_arr_t"] >= t0_us) & (ops["tr_arr_t"] < t1_us)
+        tr_bits = (
+            jnp.sum(
+                jnp.where(win, ops["tr_arr_b"], 0), axis=1,
+                dtype=jnp.int32,
+            ).astype(jnp.float32)
+            * 8.0
+        )
+        return jnp.where(
+            ops["tr_id"] == TRAFFIC_MODEL_IDS["trace"], tr_bits, gen_bits
+        )
+
+    return bits_fn
+
+
+def avg_mult(prog: TrafficProgram):
+    """``mult_fn(ops, horizon_us) -> (N,) f32`` — the fluid view: the
+    workload's realized/nominal rate ratio over the horizon, i.e. how
+    an AS-flow engine scales each flow's nominal ``flow_bps``.  Exactly
+    1.0 for cbr (by construction, not by arithmetic — the
+    ``traffic_off`` exact-pair anchor)."""
+    import jax.numpy as jnp
+
+    cum_fn = build_cum_fn(prog)
+
+    def mult_fn(ops, horizon_us):
+        h_s = jnp.maximum(horizon_us.astype(jnp.float32), 1.0) * 1e-6
+        nominal = jnp.maximum(ops["tr_rate"] * h_s, 1e-9)
+        m = cum_fn(ops, horizon_us) / nominal
+        return jnp.where(
+            ops["tr_id"] == TRAFFIC_MODEL_IDS["cbr"],
+            jnp.float32(1.0), m,
+        )
+
+    return mult_fn
+
+
+# --- trace manifest (tpudes.analysis.jaxpr) --------------------------------
+
+
+def _trace_prog(**over) -> TrafficProgram:
+    """Canonical tiny-shape program for the abstract traces: a 3-entity
+    mmpp workload (the shape class every model shares)."""
+    import dataclasses
+
+    prog = TrafficProgram.mmpp(
+        3, 40.0, horizon_us=500_000, epoch_s=0.05, tr_seed=7
+    )
+    return dataclasses.replace(prog, **over) if over else prog
+
+
+def _trace_entries(prog: TrafficProgram):
+    import jax
+    import jax.numpy as jnp
+
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+
+    cum_fn = build_cum_fn(prog)
+    gap_fn = build_gap_fn(prog)
+    ops = prog.operands()
+    key = jax.random.PRNGKey(0)
+    t = jnp.full((prog.n,), 40_000, jnp.int32)
+    return [
+        TraceEntry(
+            "cum", cum_fn, (ops, jnp.int32(40_000)),
+            kernel=False, traced={"t_us": 1},
+        ),
+        TraceEntry(
+            "gap", gap_fn, (ops, key, t),
+            kernel=False, traced={"t_arr": 2},
+        ),
+    ]
+
+
+def _trace_flips():
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import FlipSpec
+
+    base = _trace_prog()
+
+    def flip(key_differs, **over):
+        prog = dataclasses.replace(base, **over)
+        return FlipSpec(
+            build=lambda p=prog: _trace_entries(p),
+            key_differs=key_differs,
+        )
+
+    return {
+        # live SHAPE components: each must change some traced program
+        "n_epoch": flip(True, n_epoch=64),
+        "epoch_us": flip(True, epoch_us=20_000),
+        # excluded-by-design: model id and every parameter are traced
+        # operands, so flipping them must leave the traces identical —
+        # a model/param sweep never recompiles (the tentpole contract)
+        "model": flip(False, model="onoff"),
+        "tr_seed": flip(False, tr_seed=99),
+        "rate_pps": flip(
+            False, rate_pps=np.full((3,), 80.0, np.float32)
+        ),
+    }
+
+
+def trace_manifest():
+    """Per-stage trace manifest (see :mod:`tpudes.analysis.jaxpr`) —
+    the traffic kernels join the JXL lint surface like any engine."""
+    from tpudes.analysis.jaxpr.spec import TraceManifest, TraceVariant
+
+    return TraceManifest(
+        engine="traffic",
+        path="tpudes/traffic/device.py",
+        variants=lambda: [
+            TraceVariant("base", lambda: _trace_entries(_trace_prog()))
+        ],
+        flips=_trace_flips,
+    )

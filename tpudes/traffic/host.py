@@ -1,0 +1,152 @@
+"""Host-side mirrors of the device traffic stage.
+
+Numpy re-implementations of the closed-form arrival math over the SAME
+eager tables (:func:`tpudes.traffic.program.traffic_tables`), so the
+parity tests compare two independent evaluations of one realization:
+
+- ``offered_packets`` — the numpy twin of ``build_cum_fn`` (exact for
+  every model: the stochastic content lives in the shared tables);
+- ``arrival_times`` — explicit per-entity arrival lists for the
+  DETERMINISTIC models (cbr / onoff / trace): the host DES
+  application layer can replay them event for event, which is what
+  makes trace-replay parity EXACT.  mmpp arrivals are device-drawn
+  (``fold_in``-keyed exponentials), so mmpp host parity is
+  distribution-band, like the PHY coin flips — the documented fuzz
+  band in tests/test_traffic_host_parity.py.
+
+The upstream ``src/applications`` mirrors themselves (OnOffApplication,
+PPBPApplication) live in :mod:`tpudes.models.applications`; this module
+is the bridge that turns a :class:`TrafficProgram` into something those
+host apps (and the parity tests) can consume.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpudes.traffic.program import (
+    GAP_INF,
+    TRAFFIC_MODEL_IDS,
+    TrafficProgram,
+    traffic_tables,
+)
+
+__all__ = ["arrival_times", "offered_packets", "offered_bits_mean"]
+
+
+def offered_packets(prog: TrafficProgram, t_us) -> np.ndarray:
+    """(N,) float cumulative offered packets in ``[0, t_us]`` — the
+    numpy twin of the device ``cum_fn`` (same tables, same closed
+    form, f64 host arithmetic, per-entity model-id select)."""
+    t = traffic_tables(prog)
+    tv = np.broadcast_to(np.asarray(t_us, np.int64), (prog.n,))
+    tau = np.maximum(tv - prog.start_us.astype(np.int64), 0)
+    started = tv >= prog.start_us
+    ids = prog.model_ids()
+
+    iv = prog.interval_us.astype(np.int64)
+    a_cbr = np.where(
+        started & (iv < GAP_INF), tau // np.maximum(iv, 1) + 1, 0
+    ).astype(np.float64)
+
+    S = int(prog.n_epoch)
+    e = np.clip(tau // int(prog.epoch_us), 0, S - 1).astype(int)
+    lam = t["epoch_cum"].astype(np.float64)[e] + t["epoch_rate"].astype(
+        np.float64
+    )[e] * np.minimum(
+        tau - e * int(prog.epoch_us), int(prog.epoch_us)
+    ) * 1e-6
+    a_mmpp = prog.rate_pps.astype(np.float64) * lam * started
+
+    C = int(prog.n_cycle)
+    c = np.clip(
+        (t["on_start"].astype(np.int64) <= tau[:, None]).sum(1) - 1,
+        0, C - 1,
+    )
+    rows = np.arange(prog.n)
+    on_s = t["on_start"][rows, c].astype(np.float64)
+    on_l = t["on_len"][rows, c].astype(np.float64)
+    pk = t["peak"][rows, c].astype(np.float64)
+    fill = np.clip(tau - on_s, 0.0, on_l) * 1e-6
+    a_onoff = (
+        t["cum_pk"][rows, c].astype(np.float64) + pk * fill
+    ) * started
+
+    live = prog.arr_t < GAP_INF
+    a_trace = (
+        (live & (prog.arr_t.astype(np.int64) <= tv[:, None]))
+        .sum(axis=1)
+        .astype(np.float64)
+    )
+
+    return np.select(
+        [
+            ids == TRAFFIC_MODEL_IDS["trace"],
+            ids == TRAFFIC_MODEL_IDS["onoff"],
+            ids == TRAFFIC_MODEL_IDS["mmpp"],
+        ],
+        [a_trace, a_onoff, a_mmpp],
+        default=a_cbr,
+    )
+
+
+def arrival_times(prog: TrafficProgram, entity: int, horizon_us: int):
+    """Sorted arrival times (µs, ints) of one entity over
+    ``[0, horizon_us)`` for the DETERMINISTIC models; raises for mmpp
+    (whose arrivals are device-drawn — compare distributions, not
+    events)."""
+    mid = int(prog.model_ids()[entity])
+    if mid == TRAFFIC_MODEL_IDS["mmpp"]:
+        raise ValueError(
+            "mmpp arrivals are fold_in-drawn on device; host parity "
+            "for mmpp is distribution-band (use offered_packets)"
+        )
+    out: list[int] = []
+    if mid == TRAFFIC_MODEL_IDS["trace"]:
+        row = prog.arr_t[entity]
+        return [int(v) for v in row[(row < GAP_INF) & (row < horizon_us)]]
+    start = int(prog.start_us[entity])
+    if mid == TRAFFIC_MODEL_IDS["cbr"]:
+        iv = int(prog.interval_us[entity])
+        if iv >= int(GAP_INF):
+            return out
+        t = start
+        while t < horizon_us:
+            out.append(t)
+            t += iv
+        return out
+    # onoff: deterministic peak-rate spacing inside each table burst
+    t = traffic_tables(prog)
+    for c in range(int(prog.n_cycle)):
+        pk = float(t["peak"][entity, c])
+        if pk <= 1e-9:
+            continue
+        p_us = max(1, int(round(1e6 / pk)))
+        b0 = start + int(t["on_start"][entity, c])
+        b1 = b0 + int(t["on_len"][entity, c])
+        a = b0
+        while a < min(b1, horizon_us):
+            out.append(a)
+            a += p_us
+    return out
+
+
+def offered_bits_mean(prog: TrafficProgram, t_us) -> np.ndarray:
+    """(N,) float expected offered bits by ``t_us`` — packets × mean
+    bounded-Pareto size for the generative models, exact byte sums for
+    trace-replay entities.  The telemetry-side load estimate (the
+    device backlog fill quantizes sizes per window; this is its
+    mean)."""
+    from tpudes.traffic.program import bounded_pareto_mean
+
+    ids = prog.model_ids()
+    mean_b = bounded_pareto_mean(
+        float(prog.size_pareto[0]), float(prog.size_pareto[1]),
+        float(prog.size_pareto[2]),
+    )
+    gen = np.floor(offered_packets(prog, t_us)) * mean_b * 8.0
+    live = prog.arr_t < GAP_INF
+    tv = np.broadcast_to(np.asarray(t_us, np.int64), (prog.n,))
+    hit = live & (prog.arr_t.astype(np.int64) <= tv[:, None])
+    tr = (prog.arr_b * hit).sum(axis=1).astype(np.float64) * 8.0
+    return np.where(ids == TRAFFIC_MODEL_IDS["trace"], tr, gen)

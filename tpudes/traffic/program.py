@@ -1,0 +1,553 @@
+"""Device-resident traffic programs — workload models as traced operands.
+
+Every engine used to drive itself with a degenerate source: CBR echo
+arrivals (BSS), an always-full RLC-SM buffer (LTE), an infinite bulk
+backlog (dumbbell), a constant fluid rate (AS flows).  Nothing bursts,
+thinks, or arrives like a population of real users.  This module makes
+the workload itself a first-class device operand, exactly the way
+``tpudes.ops.mobility`` made motion one: a :class:`TrafficProgram`
+describes one entity batch's arrival process, every stochastic choice
+is materialized EAGERLY into ``fold_in``-keyed operand tables (the
+``walk_segment_velocities`` pattern), and the engines dispatch on a
+TRACED model id (:data:`TRAFFIC_MODEL_IDS`) — so the whole model
+family rides one compiled executable and a model/param flip is new
+operand values, never a recompile.
+
+Model family (the upstream ``src/applications`` generator surface):
+
+- ``cbr`` — deterministic inter-arrival ``interval_us`` (UdpClient /
+  UdpEchoClient semantics).  The neutral member: engines are pinned
+  bit-equal between ``traffic=None`` and the matching cbr program.
+- ``mmpp`` — Markov-modulated Poisson arrivals: a 2-state modulating
+  chain sampled on a fixed epoch grid (the chain realization is an
+  eager ``fold_in``-keyed table — pure in ``tr_seed``), per-state rate
+  multipliers, exponential gaps at the epoch's modulated rate (the
+  frozen-rate approximation: the rate is held over one gap draw).
+- ``onoff`` — Poisson-Pareto ON-OFF bursts (OnOffApplication / PPBP
+  shape): bounded-Pareto ON durations, exponential OFF durations,
+  deterministic peak-rate arrivals during ON.  The cycle realization
+  is an eager per-(entity, cycle) table, so the burst boundaries are
+  closed-form in time — chunking/striding cannot shift them.
+- ``trace`` — compressed empirical-trace replay: per-entity
+  ``(time, bytes)`` tables ride as runtime operands; replay is EXACT
+  (arrival times are table lookups, no draws).
+
+A **diurnal rate envelope** ``rate(t) *= 1 + amp·sin(2π(t/period −
+phase))`` applies to the generative models by being folded into the
+materialized epoch/cycle rate tables — envelope flips are operand
+flips, compile-free.  Heavy-tailed packet/flow sizes are bounded-
+Pareto draws (:func:`bounded_pareto_icdf`); trace replay carries exact
+per-arrival bytes.
+
+Only SHAPES and table capacities (:meth:`TrafficProgram.shape_key`)
+may enter an engine cache key; :meth:`TrafficProgram.param_key` is the
+full-value identity serving-layer coalesce keys use.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "TRAFFIC_MODEL_IDS",
+    "TrafficProgram",
+    "bounded_pareto_icdf",
+    "bounded_pareto_mean",
+    "traffic_tables",
+    "unify_shapes",
+]
+
+#: traffic model short name → traced dispatch id (the scheduler-id /
+#: mobility-id pattern: the id is a runtime operand selecting the
+#: arrival branch, so the whole family rides one compiled executable)
+TRAFFIC_MODEL_IDS = {
+    "cbr": 0,
+    "mmpp": 1,
+    "onoff": 2,
+    "trace": 3,
+}
+
+#: root key of every traffic table/draw stream (the _MOB_ROOT_SEED
+#: pattern): table draws are fold_in(fold_in(PRNGKey(root), tr_seed), …)
+_TRAFFIC_ROOT_SEED = 0x7AF1C0
+
+#: "no more arrivals" sentinel on the µs clock — comfortably past any
+#: representable horizon, comfortably below i32 overflow when an engine
+#: adds a gap to it
+GAP_INF = np.int32(2**30)
+
+
+def bounded_pareto_icdf(u, alpha: float, lo: float, hi: float):
+    """Inverse CDF of the bounded Pareto on ``[lo, hi]`` with shape
+    ``alpha`` — works on numpy or jax arrays (pure arithmetic).
+    ``alpha <= 0`` or ``hi <= lo`` degenerates to the constant ``lo``
+    (how fixed-size workloads ride the same branch)."""
+    if alpha <= 0.0 or hi <= lo:
+        return u * 0.0 + lo
+    r = (lo / hi) ** alpha
+    return lo / (1.0 - u * (1.0 - r)) ** (1.0 / alpha)
+
+
+def bounded_pareto_mean(alpha: float, lo: float, hi: float) -> float:
+    """Closed-form mean of the bounded Pareto (``alpha != 1``); the
+    degenerate cases mirror :func:`bounded_pareto_icdf`."""
+    if alpha <= 0.0 or hi <= lo:
+        return float(lo)
+    if abs(alpha - 1.0) < 1e-9:
+        return float(lo * hi / (hi - lo) * math.log(hi / lo))
+    r = (lo / hi) ** alpha
+    return float(
+        (alpha * lo / (alpha - 1.0))
+        * (1.0 - (lo / hi) ** (alpha - 1.0))
+        / (1.0 - r)
+    )
+
+
+@dataclass(frozen=True)
+class TrafficProgram:
+    """One entity batch's arrival workload, ready to ride any device
+    engine.  All array fields are RUNTIME operands of the compiled
+    program; :meth:`shape_key` is the only part that belongs in an
+    engine cache key.  Build via the factory classmethods."""
+
+    model: str                    # key of TRAFFIC_MODEL_IDS
+    start_us: np.ndarray          # (N,) i32 workload start per entity
+    interval_us: np.ndarray       # (N,) i32 cbr inter-arrival
+    rate_pps: np.ndarray          # (N,) f32 nominal mean arrival rate
+    mmpp_mult: np.ndarray         # (2,) f32 state rate multipliers
+    mmpp_p: np.ndarray            # (2,) f32 per-epoch switch probs
+    peak_pps: np.ndarray          # (N,) f32 ON-period arrival rate
+    on_pareto: np.ndarray         # (3,) f32 (alpha, on_min_s, on_max_s)
+    off_mean_s: float = 1.0       # exponential OFF mean (onoff)
+    arr_t: np.ndarray = None      # (N, K) i32 µs trace times, sorted
+    arr_b: np.ndarray = None      # (N, K) i32 trace bytes per arrival
+    size_pareto: np.ndarray = None  # (3,) f32 (alpha, min_B, max_B)
+    env: np.ndarray = None        # (3,) f32 (amp, period_s, phase)
+    epoch_us: int = 100_000       # mmpp epoch length (trace-time const)
+    n_epoch: int = 1              # mmpp epoch-grid length (SHAPE)
+    n_cycle: int = 1              # onoff cycle-table length (SHAPE)
+    tr_seed: int = 0              # table stream seed (runtime operand)
+    #: (N,) i32 per-entity model override (None = every entity runs
+    #: ``model``).  The dispatch select is elementwise, so MIXED
+    #: batches ride one executable — e.g. a BSS program keeps the AP's
+    #: beacon process cbr while the STAs burst (the mobility
+    #: zero-speed-band precedent).  A runtime operand like the id.
+    model_id: np.ndarray = None
+
+    @property
+    def n(self) -> int:
+        return int(self.start_us.shape[0])
+
+    def shape_key(self) -> tuple:
+        """The trace-time identity: everything that changes the
+        compiled program's shape.  Model id and every array are
+        deliberately ABSENT — they are traced operands, so a sweep
+        across the model family reuses one executable."""
+        return (
+            self.n, int(self.n_epoch), int(self.n_cycle),
+            int(self.arr_t.shape[1]), int(self.epoch_us),
+        )
+
+    def param_key(self) -> tuple:
+        """Hashable identity of the FULL parameter set (serving-layer
+        coalesce keys: studies with different workloads must not
+        coalesce even though the params are traced)."""
+        return (
+            self.model, self.start_us.tobytes(),
+            self.interval_us.tobytes(), self.rate_pps.tobytes(),
+            self.mmpp_mult.tobytes(), self.mmpp_p.tobytes(),
+            self.peak_pps.tobytes(), self.on_pareto.tobytes(),
+            float(self.off_mean_s), self.arr_t.tobytes(),
+            self.arr_b.tobytes(), self.size_pareto.tobytes(),
+            self.env.tobytes(), int(self.epoch_us), int(self.n_epoch),
+            int(self.n_cycle), int(self.tr_seed),
+            None if self.model_id is None else self.model_id.tobytes(),
+        )
+
+    def model_ids(self) -> np.ndarray:
+        """(N,) i32 effective per-entity model ids."""
+        if self.model_id is not None:
+            return np.asarray(self.model_id, np.int32)
+        return np.full(
+            (self.n,), TRAFFIC_MODEL_IDS[self.model], np.int32
+        )
+
+    def with_cbr_rows(self, mask, interval_us, start_us=None):
+        """A copy whose ``mask``-selected entities run deterministic
+        cbr at ``interval_us`` instead of ``model`` — how an engine
+        keeps one entity's control-plane cadence (the AP beacon) exact
+        while the rest of the batch bursts."""
+        import dataclasses
+
+        mask = np.asarray(mask, bool)
+        ids = self.model_ids().copy()
+        ids[mask] = TRAFFIC_MODEL_IDS["cbr"]
+        iv = self.interval_us.copy()
+        iv[mask] = np.minimum(
+            np.asarray(interval_us, np.int64), GAP_INF
+        ).astype(np.int32)
+        start = self.start_us.copy()
+        if start_us is not None:
+            start[mask] = np.asarray(start_us, np.int32)
+        return dataclasses.replace(
+            self, model_id=ids, interval_us=iv, start_us=start
+        )
+
+    def operands(self) -> dict:
+        """The traced-operand dict the device kernels consume — all the
+        stochastic table realizations materialized eagerly (jax PRNG
+        draws are spec'd identical eager vs traced), memoized on the
+        immutable program so repeat launches skip the re-materialize +
+        H2D; dropped on pickling (procmesh study specs cross process
+        boundaries)."""
+        import jax.numpy as jnp
+
+        cached = self.__dict__.get("_operands_cache")
+        if cached is None:
+            t = traffic_tables(self)
+            cached = dict(
+                tr_id=jnp.asarray(self.model_ids(), jnp.int32),
+                tr_start=jnp.asarray(self.start_us, jnp.int32),
+                tr_interval=jnp.asarray(self.interval_us, jnp.int32),
+                tr_rate=jnp.asarray(self.rate_pps, jnp.float32),
+                tr_epoch_rate=jnp.asarray(t["epoch_rate"], jnp.float32),
+                tr_epoch_cum=jnp.asarray(t["epoch_cum"], jnp.float32),
+                tr_on_start=jnp.asarray(t["on_start"], jnp.int32),
+                tr_on_len=jnp.asarray(t["on_len"], jnp.int32),
+                tr_cum_pk=jnp.asarray(t["cum_pk"], jnp.float32),
+                tr_peak=jnp.asarray(t["peak"], jnp.float32),
+                tr_arr_t=jnp.asarray(self.arr_t, jnp.int32),
+                tr_arr_b=jnp.asarray(self.arr_b, jnp.int32),
+                tr_size=jnp.asarray(self.size_pareto, jnp.float32),
+            )
+            object.__setattr__(self, "_operands_cache", cached)
+        return dict(cached)
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_operands_cache", None)  # device arrays stay local
+        state.pop("_tables_cache", None)
+        return state
+
+    # --- factories --------------------------------------------------------
+
+    @classmethod
+    def _fill(cls, model: str, n: int, **kw) -> "TrafficProgram":
+        defaults = dict(
+            start_us=np.zeros((n,), np.int32),
+            interval_us=np.full((n,), GAP_INF, np.int32),
+            rate_pps=np.zeros((n,), np.float32),
+            mmpp_mult=np.ones((2,), np.float32),
+            mmpp_p=np.zeros((2,), np.float32),
+            peak_pps=np.zeros((n,), np.float32),
+            on_pareto=np.asarray([0.0, 1.0, 1.0], np.float32),
+            arr_t=np.full((n, 2), GAP_INF, np.int32),
+            arr_b=np.zeros((n, 2), np.int32),
+            size_pareto=np.asarray([0.0, 512.0, 512.0], np.float32),
+            env=np.zeros((3,), np.float32),
+        )
+        defaults.update(kw)
+        return cls(model=model, **defaults)
+
+    @classmethod
+    def cbr(cls, start_us, interval_us) -> "TrafficProgram":
+        """Deterministic arrivals: entity e fires at ``start + k·interval``
+        — arithmetically identical to the engines' legacy CBR advance,
+        which is what pins the ``traffic_off`` exact oracle pair."""
+        start = np.asarray(start_us, np.int32)
+        iv = np.asarray(
+            np.broadcast_to(np.asarray(interval_us), start.shape), np.int64
+        )
+        rate = np.where(
+            iv >= GAP_INF, 0.0, 1e6 / np.maximum(iv, 1)
+        ).astype(np.float32)
+        return cls._fill(
+            "cbr", start.shape[0], start_us=start,
+            interval_us=np.minimum(iv, GAP_INF).astype(np.int32),
+            rate_pps=rate,
+        )
+
+    @classmethod
+    def mmpp(
+        cls, n: int, rate_pps, *, horizon_us: int,
+        mult=(0.25, 3.0), switch_p=(0.3, 0.3), epoch_s: float = 0.1,
+        start_us=0, envelope=None, tr_seed: int = 0,
+    ) -> "TrafficProgram":
+        """2-state Markov-modulated Poisson arrivals.  ``mult`` are the
+        per-state rate multipliers, ``switch_p`` the per-epoch switch
+        probabilities (the discrete sampling of the modulating CTMC on
+        the ``epoch_s`` grid); ``horizon_us`` sizes the epoch grid.
+        The multipliers are normalized by the chain's STATIONARY mean,
+        so ``rate_pps`` is the long-run mean arrival rate (what the
+        fluid view and the fuzz load budgets reason about) and
+        ``mult`` only shapes the burstiness ratio."""
+        epoch_us = max(1, int(round(epoch_s * 1e6)))
+        n_epoch = int(horizon_us) // epoch_us + 1
+        mult = np.asarray(mult, np.float64).reshape(2)
+        p01, p10 = (float(v) for v in np.reshape(switch_p, 2))
+        tot = max(p01 + p10, 1e-9)
+        stationary_mean = (p10 * mult[0] + p01 * mult[1]) / tot
+        mult = mult / max(stationary_mean, 1e-9)
+        return cls._fill(
+            "mmpp", n,
+            start_us=np.broadcast_to(
+                np.asarray(start_us, np.int32), (n,)
+            ).copy(),
+            rate_pps=np.broadcast_to(
+                np.asarray(rate_pps, np.float32), (n,)
+            ).copy(),
+            mmpp_mult=mult.astype(np.float32),
+            mmpp_p=np.asarray(switch_p, np.float32).reshape(2),
+            env=_env_params(envelope),
+            epoch_us=epoch_us, n_epoch=n_epoch, tr_seed=int(tr_seed),
+        )
+
+    @classmethod
+    def onoff(
+        cls, n: int, peak_pps, *, horizon_us: int,
+        on=(1.5, 0.2, 5.0), off_mean_s: float = 0.5,
+        start_us=0, envelope=None, tr_seed: int = 0,
+    ) -> "TrafficProgram":
+        """Poisson-Pareto ON-OFF bursts: ON durations bounded-Pareto
+        ``on=(alpha, min_s, max_s)``, OFF durations exponential with
+        mean ``off_mean_s``, deterministic ``peak_pps`` arrivals while
+        ON.  The cycle realization is one eager table per entity, so
+        ``horizon_us`` sizes the cycle capacity from the MINIMUM mean
+        cycle length (never run out of bursts before the horizon)."""
+        on = np.asarray(on, np.float32).reshape(3)
+        mean_cycle = bounded_pareto_mean(
+            float(on[0]), float(on[1]), float(on[2])
+        ) + float(off_mean_s)
+        n_cycle = max(2, int(2.0 * horizon_us / 1e6 / max(mean_cycle, 1e-6)) + 4)
+        peak = np.broadcast_to(np.asarray(peak_pps, np.float32), (n,))
+        duty = bounded_pareto_mean(
+            float(on[0]), float(on[1]), float(on[2])
+        ) / max(mean_cycle, 1e-9)
+        return cls._fill(
+            "onoff", n,
+            start_us=np.broadcast_to(
+                np.asarray(start_us, np.int32), (n,)
+            ).copy(),
+            rate_pps=(peak * np.float32(duty)).copy(),
+            peak_pps=peak.copy(),
+            on_pareto=on,
+            off_mean_s=float(off_mean_s),
+            env=_env_params(envelope),
+            n_cycle=n_cycle, tr_seed=int(tr_seed),
+        )
+
+    @classmethod
+    def trace_replay(cls, arr_t, arr_b=None) -> "TrafficProgram":
+        """Empirical-trace replay: ``arr_t`` (N, K) µs arrival times
+        ascending per row (pad unused tail with any value ≥
+        :data:`GAP_INF`), ``arr_b`` (N, K) per-arrival bytes (defaults
+        512).  Replay is EXACT — the parity contract of the host
+        mirror tests."""
+        arr_t = np.asarray(arr_t, np.int64)
+        if arr_t.ndim != 2:
+            raise ValueError("arr_t must be (N, K)")
+        if arr_t.shape[1] < 2:
+            arr_t = np.concatenate(
+                [arr_t, np.full_like(arr_t, GAP_INF)], axis=1
+            )
+        live = arr_t < GAP_INF
+        srt = np.where(live, arr_t, GAP_INF)
+        if (np.diff(srt, axis=1) < 0).any():
+            raise ValueError("trace arrival times must ascend per row")
+        arr_t = np.minimum(arr_t, GAP_INF).astype(np.int32)
+        n, k = arr_t.shape
+        if arr_b is None:
+            arr_b = np.full((n, k), 512, np.int32)
+        else:
+            arr_b = np.asarray(arr_b, np.int32)
+            if arr_b.shape[1] < k:  # re-pad alongside arr_t
+                arr_b = np.concatenate(
+                    [arr_b, np.zeros((n, k - arr_b.shape[1]), np.int32)],
+                    axis=1,
+                )
+        dur_s = max(float(srt[live].max(initial=0)) * 1e-6, 1e-6)
+        rate = (live.sum(axis=1) / dur_s).astype(np.float32)
+        return cls._fill(
+            "trace", n,
+            start_us=np.where(
+                live.any(axis=1), srt.min(axis=1), GAP_INF
+            ).astype(np.int32),
+            rate_pps=rate, arr_t=arr_t, arr_b=arr_b,
+        )
+
+
+def unify_shapes(progs) -> list:
+    """Pad table CAPACITIES (epoch grid, cycle table, trace width) to
+    a common :meth:`TrafficProgram.shape_key` so mixed
+    cbr/mmpp/onoff/trace points ride ONE workload sweep.  Padding is
+    realization-preserving: the epoch chain and cycle draws are
+    per-index ``fold_in`` streams (prefix-stable under capacity
+    growth) and trace tables pad with the never-arriving sentinel.
+    Entity counts and ``epoch_us`` must already agree (they are
+    semantic, not capacity)."""
+    import dataclasses
+
+    progs = list(progs)
+    if len({p.n for p in progs}) != 1:
+        raise ValueError("workload sweep points must share the entity count")
+    # epoch_us only means anything to points that USE the epoch grid
+    # (mmpp, or any point with a real grid); those must agree — the
+    # rest are aligned to it (their mmpp branch is never selected)
+    used = {int(p.epoch_us) for p in progs if int(p.n_epoch) > 1}
+    if len(used) > 1:
+        raise ValueError(
+            "workload sweep points must share epoch_us (a trace-time "
+            "constant); build the mmpp points with one epoch_s"
+        )
+    epoch_us = used.pop() if used else int(progs[0].epoch_us)
+    progs = [
+        p if int(p.epoch_us) == epoch_us
+        else dataclasses.replace(p, epoch_us=epoch_us)
+        for p in progs
+    ]
+    S = max(int(p.n_epoch) for p in progs)
+    C = max(int(p.n_cycle) for p in progs)
+    K = max(int(p.arr_t.shape[1]) for p in progs)
+    out = []
+    for p in progs:
+        arr_t, arr_b = p.arr_t, p.arr_b
+        k0 = arr_t.shape[1]
+        if k0 < K:
+            n = arr_t.shape[0]
+            arr_t = np.concatenate(
+                [arr_t, np.full((n, K - k0), GAP_INF, np.int32)], axis=1
+            )
+            arr_b = np.concatenate(
+                [arr_b, np.zeros((n, K - k0), np.int32)], axis=1
+            )
+        out.append(
+            dataclasses.replace(
+                p, n_epoch=S, n_cycle=C, arr_t=arr_t, arr_b=arr_b
+            )
+        )
+    return out
+
+
+def _env_params(envelope) -> np.ndarray:
+    """(amp, period_s, phase) — None means flat (amp 0)."""
+    if envelope is None:
+        return np.zeros((3,), np.float32)
+    amp, period_s, phase = envelope
+    if not (0.0 <= float(amp) < 1.0):
+        raise ValueError("envelope amplitude must be in [0, 1)")
+    if float(period_s) <= 0.0:
+        raise ValueError("envelope period must be positive")
+    return np.asarray(
+        [float(amp), float(period_s), float(phase)], np.float32
+    )
+
+
+def _env_at(env: np.ndarray, t_s: np.ndarray) -> np.ndarray:
+    """Diurnal multiplier at time ``t_s`` (numpy, eager-table side)."""
+    amp, period, phase = (float(v) for v in env)
+    if amp == 0.0:
+        return np.ones_like(np.asarray(t_s, np.float64))
+    return np.maximum(
+        1.0 + amp * np.sin(2.0 * math.pi * (t_s / period - phase)), 0.0
+    )
+
+
+def traffic_tables(prog: TrafficProgram) -> dict:
+    """The eager stochastic-table realizations (numpy) — the single
+    source of truth shared by :meth:`TrafficProgram.operands` (device)
+    and :mod:`tpudes.traffic.host` (the parity mirrors), so the two
+    sides cannot drift.  Pure in ``(tr_seed, shapes, params)`` via the
+    ``fold_in`` discipline; memoized on the immutable program.
+
+    - ``epoch_rate`` (S,) f32 — mmpp per-epoch rate MULTIPLIER (state
+      multiplier × envelope at the epoch midpoint);
+    - ``epoch_cum`` (S+1,) f32 — prefix integral of ``epoch_rate`` in
+      multiplier-seconds (the closed-form cumulative intensity);
+    - ``on_start``/``on_len`` (N, C) i32 µs — ON-burst boundaries;
+    - ``peak`` (N, C) f32 — per-cycle ON rate (envelope folded in);
+    - ``cum_pk`` (N, C) f32 — offered packets before cycle c starts.
+    """
+    cached = prog.__dict__.get("_tables_cache")
+    if cached is not None:
+        return cached
+    import jax
+
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(_TRAFFIC_ROOT_SEED), int(prog.tr_seed)
+    )
+    S, C, N = int(prog.n_epoch), int(prog.n_cycle), prog.n
+    out: dict = {}
+
+    # --- mmpp: modulating-chain realization on the epoch grid ------------
+    k_chain = jax.random.fold_in(key, 0)
+    u = np.asarray(
+        jax.vmap(
+            lambda s: jax.random.uniform(jax.random.fold_in(k_chain, s))
+        )(np.arange(S))
+    )
+    p01, p10 = float(prog.mmpp_p[0]), float(prog.mmpp_p[1])
+    states = np.zeros(S, np.int32)
+    s = 0
+    for e in range(S):  # sequential chain — eager, tiny, pure in tr_seed
+        states[e] = s
+        s = (1 - s) if u[e] < (p01 if s == 0 else p10) else s
+    mids = (np.arange(S) + 0.5) * (prog.epoch_us * 1e-6)
+    epoch_rate = (
+        np.asarray(prog.mmpp_mult, np.float64)[states]
+        * _env_at(prog.env, mids)
+    ).astype(np.float32)
+    epoch_cum = np.zeros(S + 1, np.float32)
+    epoch_cum[1:] = np.cumsum(
+        epoch_rate.astype(np.float64) * (prog.epoch_us * 1e-6)
+    ).astype(np.float32)
+    out["epoch_rate"] = epoch_rate
+    out["epoch_cum"] = epoch_cum
+
+    # --- onoff: per-(entity, cycle) burst realization.  One fold_in
+    # per (entity, cycle) — NOT a (C, 2)-shaped draw — so growing the
+    # cycle capacity (unify_shapes padding for a mixed workload sweep)
+    # preserves the realization prefix, the same capacity-stability
+    # the engines' replica bucketing relies on.
+    k_cyc = jax.random.fold_in(key, 1)
+    uc = np.asarray(
+        jax.vmap(
+            lambda e: jax.vmap(
+                lambda c: jax.random.uniform(
+                    jax.random.fold_in(
+                        jax.random.fold_in(k_cyc, e), c
+                    ),
+                    (2,),
+                )
+            )(np.arange(C))
+        )(np.arange(N))
+    )                                                   # (N, C, 2)
+    alpha, on_lo, on_hi = (float(v) for v in prog.on_pareto)
+    on_s = bounded_pareto_icdf(uc[..., 0], alpha, on_lo, on_hi)
+    off_s = -float(prog.off_mean_s) * np.log1p(
+        -np.minimum(uc[..., 1], 1.0 - 1e-7)
+    )
+    on_us = np.maximum(np.round(on_s * 1e6), 1.0)
+    off_us = np.maximum(np.round(off_s * 1e6), 1.0)
+    starts = np.zeros((N, C), np.float64)
+    starts[:, 1:] = np.cumsum(on_us + off_us, axis=1)[:, :-1]
+    on_start = np.minimum(starts, float(GAP_INF)).astype(np.int32)
+    on_len = np.minimum(on_us, float(GAP_INF)).astype(np.int32)
+    cycle_t = starts * 1e-6  # cycle start on the workload clock, s
+    peak = (
+        prog.peak_pps.astype(np.float64)[:, None]
+        * _env_at(prog.env, cycle_t)
+    ).astype(np.float32)
+    cum_pk = np.zeros((N, C), np.float32)
+    cum_pk[:, 1:] = np.cumsum(
+        peak[:, :-1].astype(np.float64) * on_len[:, :-1] * 1e-6, axis=1
+    ).astype(np.float32)
+    out["on_start"] = on_start
+    out["on_len"] = on_len
+    out["peak"] = peak
+    out["cum_pk"] = cum_pk
+
+    object.__setattr__(prog, "_tables_cache", out)
+    return out
