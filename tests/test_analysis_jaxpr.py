@@ -486,3 +486,124 @@ def test_dumbbell_red_knobs_out_of_fifo_key():
     out = run_tcp_dumbbell(twin, key, replicas=2)
     assert RUNTIME.misses == misses
     np.testing.assert_array_equal(out["delivered"], base["delivered"])
+
+
+# --- JXL006 grad hygiene (ISSUE-15) ----------------------------------------
+
+
+def _surrogate_manifest(entries_fn):
+    return TraceManifest(
+        engine="synth",
+        path=SYNTH,
+        variants=lambda: [
+            TraceVariant("base", entries_fn, surrogate=True)
+        ],
+    )
+
+
+def test_jxl006_severed_gradient_fires_and_ste_is_clean():
+    """A round() in the only path to the output kills the gradient —
+    JXL006 fires; the straight-through annotation (tpudes.diff.ste)
+    restores a soft path and is clean."""
+    from tpudes.diff.surrogate import ste
+
+    x = jnp.ones((3,), jnp.float32)
+
+    def severed(x):
+        return jnp.sum(jnp.round(x) * 2.0)
+
+    def annotated(x):
+        return jnp.sum(ste(jnp.round(x), x) * 2.0)
+
+    found = lint_manifest(
+        _surrogate_manifest(
+            lambda: [TraceEntry("loss", severed, (x,), kernel=False,
+                               grad_wrt=(0,))]
+        )
+    )
+    assert "JXL006" in _codes(found)
+    assert "straight-through" in found[0].message
+    assert "JXL006" not in _codes(
+        lint_manifest(
+            _surrogate_manifest(
+                lambda: [TraceEntry("loss", annotated, (x,),
+                                    kernel=False, grad_wrt=(0,))]
+            )
+        )
+    )
+
+
+def test_jxl006_integer_cast_and_stop_gradient_sever():
+    x = jnp.ones((2,), jnp.float32)
+
+    def int_cast(x):
+        return jnp.sum(x.astype(jnp.int32).astype(jnp.float32))
+
+    def stopped(x):
+        return jnp.sum(jax.lax.stop_gradient(x) * 3.0)
+
+    for fn in (int_cast, stopped):
+        found = lint_manifest(
+            _surrogate_manifest(
+                lambda fn=fn: [TraceEntry("loss", fn, (x,),
+                                          kernel=False, grad_wrt=(0,))]
+            )
+        )
+        assert "JXL006" in _codes(found), fn.__name__
+
+
+def test_jxl006_scan_carry_feedback_path_is_live():
+    """Regression for the fixed-point liveness: an operand whose only
+    gradient route enters through a scan CARRY on iteration k>0 (the
+    fluid cap→util→lfrac→lg chain) must count as live."""
+    x = jnp.ones((3,), jnp.float32)
+
+    def through_carry(x):
+        def body(c, _):
+            lf, acc = c
+            # acc only sees x via the PREVIOUS iteration's lf
+            return (lf + x, acc + jnp.sum(lf)), None
+
+        (lf, acc), _ = jax.lax.scan(
+            body, (jnp.zeros((3,), jnp.float32), jnp.float32(0.0)),
+            None, length=3,
+        )
+        return acc
+
+    assert "JXL006" not in _codes(
+        lint_manifest(
+            _surrogate_manifest(
+                lambda: [TraceEntry("loss", through_carry, (x,),
+                                    kernel=False, grad_wrt=(0,))]
+            )
+        )
+    )
+
+
+def test_jxl006_only_audits_surrogate_variants():
+    """The same severed trace on a plain (non-surrogate) variant is
+    out of scope — legacy engines quantize by design."""
+    x = jnp.ones((3,), jnp.float32)
+
+    def severed(x):
+        return jnp.sum(jnp.round(x))
+
+    assert "JXL006" not in _codes(
+        lint_manifest(
+            _manifest(
+                lambda: [TraceEntry("loss", severed, (x,),
+                                    kernel=False, grad_wrt=(0,))]
+            )
+        )
+    )
+
+
+def test_diff_manifest_is_clean_and_its_flips_hold():
+    """The real diff-subsystem manifest: every exposed operand keeps a
+    live gradient path (JXL006), the surrogate/loss flips are honest
+    cache-key components (JXL004), and the traces carry no stray f64
+    (JXL002) — the ratchet stays ZERO."""
+    from tpudes.diff import as_grad
+
+    found = lint_manifest(as_grad.trace_manifest())
+    assert found == [], [f.message for f in found]

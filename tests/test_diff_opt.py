@@ -1,0 +1,377 @@
+"""Optimization on top of the diff engines (ISSUE-15): calibration
+recovers planted parameters, the descent loop is one compile / one
+launch, the ES fallback optimizes a BSS design objective in one
+megabatched launch per generation, and GradTelemetry passes its
+schema gate."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpudes.diff import (  # noqa: E402
+    Surrogacy,
+    calibrate_as_flows,
+    calibrate_lte,
+    descend,
+    es_search,
+    fd_gradient,
+)
+from tpudes.parallel.lte_sm import LteSmProgram  # noqa: E402
+from tpudes.parallel.programs import (  # noqa: E402
+    toy_as_program,
+    toy_bss_program,
+)
+
+KEY = jax.random.PRNGKey(17)
+
+
+@pytest.fixture(autouse=True)
+def _reset_grad_telemetry():
+    from tpudes.obs.grad import GradTelemetry
+
+    yield
+    GradTelemetry.reset()
+
+
+def _lte_scene(n_ue=6, pos_seed=0):
+    E = 2
+    serving = (np.arange(n_ue) % E).astype(np.int32)
+    rng = np.random.default_rng(pos_seed)
+    enb_pos = np.array([[0.0, 0.0, 30.0], [600.0, 0.0, 30.0]], np.float32)
+    ue_pos = (
+        enb_pos[serving]
+        + np.c_[rng.uniform(-200, 200, n_ue),
+                rng.uniform(-200, 200, n_ue),
+                np.full(n_ue, -28.5)]
+    ).astype(np.float32)
+    prog = LteSmProgram(
+        gain=np.full((E, n_ue), 1e-12),
+        serving=serving,
+        tx_power_dbm=np.full((E,), 43.0),
+        noise_psd=10.0**0.9 * 1.380649e-23 * 290.0,
+        n_rb=25,
+        n_ttis=400,
+        scheduler="pf",
+        enb_pos=enb_pos,
+        pathloss=("log_distance", 3.0, 1.0, 46.67),
+    )
+    return prog, ue_pos
+
+
+class TestCalibration:
+    def test_as_recovers_planted_flow_rates(self):
+        """Plant per-flow rates, synthesize observed goodput KPIs from
+        the diff runner, descend from the program's nominal rates —
+        the fitted rates land within 10 % of the plant (stochastic
+        replica minibatches; the loss must also collapse)."""
+        from tpudes.parallel.as_flows import (
+            _as_replica_draws,
+            build_as_diff,
+        )
+        from tpudes.parallel.runtime import bucket_replicas
+
+        # modest jitter: the recovery precision floor is the replica
+        # minibatches' sample-mean noise on E[exp(jitter·z)], not the
+        # optimizer — keep the noise floor under the asserted 10 %
+        prog = dataclasses.replace(
+            toy_as_program(n_nodes=24, n_flows=3),
+            surrogate=Surrogacy(ste=False),
+            rate_jitter=0.1,
+        )
+        planted = np.array([2.2e5, 0.9e5, 1.5e5], np.float32)
+        r_pad = bucket_replicas(8, None)
+        diff_run = jax.jit(build_as_diff(prog, r_pad))
+        # observed KPI: replica-mean per-flow goodput at the plant,
+        # averaged over several minibatch draws (what a measurement
+        # campaign would see)
+        gp = np.mean(
+            [
+                np.asarray(
+                    diff_run(
+                        _as_replica_draws(
+                            prog, jax.random.fold_in(KEY, i), r_pad
+                        ),
+                        jnp.float32(1.0),
+                        jnp.asarray(planted),
+                        jnp.asarray(prog.rate_bps, jnp.float32),
+                    )["goodput_bps"]
+                ).mean(axis=0)
+                for i in range(6)
+            ],
+            axis=0,
+        )
+        res = calibrate_as_flows(
+            prog, KEY, gp, wrt=("flow_bps",), steps=220, lr=0.06,
+            replicas=8,
+        )
+        rel = np.abs(res.params["flow_bps"] - planted) / planted
+        assert (rel < 0.10).all(), (res.params["flow_bps"], planted)
+        assert res.loss[-1] < res.loss[0] / 20
+        assert res.loss.shape == (220,)
+        assert np.isfinite(res.grad_norm).all()
+
+    def test_lte_recovers_planted_exponent_adam_and_lbfgs(self):
+        """Plant a propagation exponent, observe per-UE CQIs, recover
+        by descent — Adam within 2 %, L-BFGS-lite essentially exact on
+        the deterministic objective."""
+        from tpudes.diff.lte_grad import build_lte_diff, lte_default_params
+
+        prog, ue_pos = _lte_scene()
+        kpi = jax.jit(build_lte_diff(prog, Surrogacy()))
+        p = lte_default_params(prog, {"ue_pos": ue_pos})
+        p["ploss"] = jnp.asarray([3.45, 1.0, 46.67], jnp.float32)
+        observed = np.asarray(kpi(p)["cqi"])
+        adam = calibrate_lte(
+            prog, KEY, observed, wrt=("ploss",), at={"ue_pos": ue_pos},
+            steps=250, lr=0.02, loss="cqi_mse", opt="adam",
+        )
+        assert abs(adam.params["ploss"][0] - 3.45) < 0.07
+        lbfgs = calibrate_lte(
+            prog, KEY, observed, wrt=("ploss",), at={"ue_pos": ue_pos},
+            steps=80, lr=0.5, loss="cqi_mse", opt="lbfgs",
+        )
+        assert abs(lbfgs.params["ploss"][0] - 3.45) < 1e-3
+        assert lbfgs.loss[-1] < 1e-8
+
+    def test_descent_loop_is_one_launch_one_compile(self):
+        """The whole descent is ONE compiled scan: one device launch,
+        and a repeat calibration of the same study family re-uses the
+        cached program (0 fresh compiles)."""
+        from tpudes.diff.lte_grad import build_lte_diff, lte_default_params
+        from tpudes.obs.device import CompileTelemetry
+        from tpudes.parallel.runtime import RUNTIME
+
+        prog, ue_pos = _lte_scene()
+        kpi = jax.jit(build_lte_diff(prog, Surrogacy()))
+        p = lte_default_params(prog, {"ue_pos": ue_pos})
+        observed = np.asarray(kpi(p)["cqi"])
+        calibrate_lte(
+            prog, KEY, observed, wrt=("ploss",), at={"ue_pos": ue_pos},
+            steps=40, loss="cqi_mse",
+        )  # warm
+        l0 = RUNTIME.launches("diff_lte")
+        c0 = CompileTelemetry.compiles("diff_lte")
+        calibrate_lte(
+            prog, KEY, observed, wrt=("ploss",), at={"ue_pos": ue_pos},
+            steps=40, loss="cqi_mse",
+        )
+        assert RUNTIME.launches("diff_lte") - l0 == 1
+        assert CompileTelemetry.compiles("diff_lte") - c0 == 0
+
+    def test_descend_optimizers_on_a_quadratic(self):
+        """Both optimizers minimize a plain quadratic (the sanity
+        anchor independent of any engine)."""
+        target = jnp.asarray([1.5, -2.0, 0.25], jnp.float32)
+
+        def vg(params, kt, ops):
+            del kt, ops
+
+            def f(params):
+                d = params["x"] - target
+                return jnp.sum(d * d)
+
+            return jax.value_and_grad(f)(params)
+
+        for opt, steps, lr in (("adam", 300, 0.05), ("lbfgs", 30, 1.0)):
+            res = descend(
+                vg, {"x": jnp.zeros(3)}, steps=steps, lr=lr, key=KEY,
+                opt=opt,
+            )
+            np.testing.assert_allclose(
+                res.params["x"], np.asarray(target), atol=5e-2,
+            )
+            assert res.loss[-1] < 1e-3, opt
+
+    def test_unknown_optimizer_rejected(self):
+        with pytest.raises(ValueError, match="adam"):
+            descend(
+                lambda p, k, o: (0.0, p), {"x": jnp.zeros(2)},
+                steps=1, lr=0.1, key=KEY, opt="sgd",
+            )
+
+    def test_multi_start_recovers_a_wide_exponent_gap(self):
+        """Verify-drill regression: a 0.6-exponent gap lands in a
+        local minimum of the quantized-CQI landscape from a single
+        far-off start, but multi-start over ``init=`` (same cached
+        descent program — one compile, K launches) recovers the plant
+        exactly, and no start ever produces a non-finite iterate (the
+        domain clamps + step cap)."""
+        from tpudes.diff.lte_grad import build_lte_diff, lte_default_params
+        from tpudes.obs.device import CompileTelemetry
+
+        # the multi-modality (and which basin each start falls into)
+        # depends on the UE geometry; this draw is the verified one —
+        # the invariants under test are finiteness, program reuse, and
+        # best-of-starts recovery, not any single start's basin
+        prog, ue_pos = _lte_scene(pos_seed=4)
+        kpi = jax.jit(build_lte_diff(prog, Surrogacy()))
+        p = lte_default_params(prog, {"ue_pos": ue_pos})
+        p["ploss"] = jnp.asarray([3.6, 1.0, 46.67], jnp.float32)
+        observed = np.asarray(kpi(p)["cqi"])
+        best = None
+        starts = (2.5, 3.0, 3.5, 4.0)
+        first = None
+        for e0 in starts:
+            res = calibrate_lte(
+                prog, KEY, observed, wrt=("ploss",),
+                at={"ue_pos": ue_pos},
+                init={"ploss": np.array([e0, 1.0, 46.67])},
+                steps=120, lr=0.5, loss="cqi_mse", opt="lbfgs",
+            )
+            assert np.isfinite(res.loss).all(), e0
+            if first is None:
+                first = CompileTelemetry.compiles("diff_lte")
+            if best is None or res.final_loss < best.final_loss:
+                best = res
+        # starts 2..K reuse the first start's compiled descent program
+        assert CompileTelemetry.compiles("diff_lte") == first
+        assert abs(best.params["ploss"][0] - 3.6) < 1e-3
+        assert best.final_loss < 1e-8
+
+    def test_cached_descent_refits_new_observations(self):
+        """Regression (review): the cached descent program must fit
+        THIS call's observations — targets and non-optimized operands
+        ride traced, so a second calibration of the same study family
+        with different observed KPIs lands on a different fit."""
+        from tpudes.diff.lte_grad import build_lte_diff, lte_default_params
+
+        prog, ue_pos = _lte_scene()
+        kpi = jax.jit(build_lte_diff(prog, Surrogacy()))
+
+        def observe(exponent):
+            p = lte_default_params(prog, {"ue_pos": ue_pos})
+            p["ploss"] = jnp.asarray(
+                [exponent, 1.0, 46.67], jnp.float32
+            )
+            return np.asarray(kpi(p)["cqi"])
+
+        fit = {}
+        for exp in (3.45, 2.75):
+            fit[exp] = calibrate_lte(
+                prog, KEY, observe(exp), wrt=("ploss",),
+                at={"ue_pos": ue_pos}, steps=80, lr=0.5,
+                loss="cqi_mse", opt="lbfgs",
+            ).params["ploss"][0]
+        assert abs(fit[3.45] - 3.45) < 1e-3
+        assert abs(fit[2.75] - 2.75) < 1e-3
+
+
+class TestDesignSearch:
+    def test_es_improves_bss_objective_one_launch_per_generation(self):
+        """The ES fallback: each generation's antithetic population
+        rides ONE traffic_sweep launch; the decoded-echo objective
+        improves over generations (the ISSUE acceptance row)."""
+        from tpudes.diff import bss_interval_design
+        from tpudes.parallel.runtime import RUNTIME
+        from tpudes.traffic import TrafficProgram
+
+        prog = toy_bss_program(n_sta=3, sim_end_us=40_000)
+        tp = TrafficProgram.cbr(
+            np.asarray(prog.start_us), np.asarray(prog.interval_us)
+        )
+        prog = dataclasses.replace(prog, traffic=tp)
+        l0 = RUNTIME.launches("bss")
+        res = bss_interval_design(
+            prog, KEY, replicas=2, generations=3, pop=2
+        )
+        assert RUNTIME.launches("bss") - l0 == res.launches == 3
+        assert res.mean_fitness[-1] > res.mean_fitness[0]
+        assert res.theta.shape == (3,)
+
+    def test_es_and_fd_on_an_analytic_bowl(self):
+        """es_search climbs and fd_gradient matches the analytic
+        gradient of a concave bowl — the megabatch contract without
+        any engine in the loop."""
+        opt = np.array([0.7, -0.3])
+
+        def evaluate(thetas):
+            d = thetas - opt[None, :]
+            return -np.sum(d * d, axis=1)
+
+        res = es_search(
+            evaluate, np.zeros(2), key=KEY, generations=40, pop=8,
+            sigma=0.1, lr=0.5,
+        )
+        assert np.abs(res.theta - opt).max() < 0.15
+        g = fd_gradient(evaluate, np.zeros(2), eps=1e-4)
+        np.testing.assert_allclose(g, 2 * opt, rtol=1e-3, atol=1e-4)
+
+    def test_bss_design_requires_traffic_shape_class(self):
+        from tpudes.diff import bss_interval_design
+
+        prog = toy_bss_program(n_sta=2)
+        with pytest.raises(ValueError, match="traffic"):
+            bss_interval_design(prog, KEY, replicas=1)
+
+
+class TestGradTelemetry:
+    def test_records_and_schema_gate(self, tmp_path):
+        from tpudes.diff import grad_as_flows
+        from tpudes.obs.grad import GradTelemetry, validate_grad_metrics
+
+        GradTelemetry.reset()
+        prog = dataclasses.replace(
+            toy_as_program(n_nodes=16, n_flows=2),
+            surrogate=Surrogacy(),
+        )
+        grad_as_flows(prog, KEY, 2, loss="neg_goodput")
+        grad_as_flows(
+            prog, KEY, 2, loss="neg_goodput", rate_scale=[0.5, 1.0]
+        )
+        snap = GradTelemetry.snapshot()
+        assert validate_grad_metrics(snap) == []
+        e = snap["engines"]["as_flows"]
+        assert e["launches"] == 2
+        assert e["batched_points"] == 3
+        assert len(e["loss_ring"]) == 2
+        assert e["nonfinite"] == 0
+        # the CLI gate accepts the dump (the CI artifact path)
+        path = tmp_path / "grad.json"
+        path.write_text(json.dumps(snap))
+        from tpudes.obs.__main__ import main
+
+        assert main(["--grad", str(path)]) == 0
+
+    def test_descent_history_joins_the_rings(self):
+        from tpudes.obs.grad import GradTelemetry
+
+        GradTelemetry.reset()
+        GradTelemetry.record_descent(
+            "diff_lte", [1.0, 0.5, 0.25], [3.0, 2.0, 1.0]
+        )
+        e = GradTelemetry.engine("diff_lte")
+        assert e["steps"] == 3 and e["launches"] == 1
+        assert e["loss_ring"] == [1.0, 0.5, 0.25]
+
+    def test_schema_rejects_malformed(self):
+        from tpudes.obs.grad import validate_grad_metrics
+
+        assert validate_grad_metrics([]) != []
+        assert validate_grad_metrics({"version": 1}) != []
+        bad = {
+            "version": 1,
+            "engines": {
+                "x": {
+                    "launches": -1, "steps": 0, "batched_points": 0,
+                    "nonfinite": 0, "last_loss": None,
+                    "loss_ring": [], "grad_norm_ring": ["a"],
+                }
+            },
+        }
+        problems = validate_grad_metrics(bad)
+        assert any("negative" in p for p in problems)
+        assert any("non-number" in p for p in problems)
+
+    def test_nonfinite_canary(self):
+        from tpudes.obs.grad import GradTelemetry
+
+        GradTelemetry.reset()
+        GradTelemetry.record(
+            "diff_as", loss=float("nan"), grad_norm=1.0
+        )
+        assert GradTelemetry.engine("diff_as")["nonfinite"] == 1

@@ -305,6 +305,59 @@ class TestDumbbell:
         assert _eq(pt, sw[0], TCP_FIELDS)
 
 
+class TestDumbbellTrafficSweep:
+    """ISSUE-15: the dumbbell engine gains the BSS-style config-axis
+    workload sweep (``traffic_sweep=``)."""
+
+    def test_mixed_workload_sweep_one_launch_demux_bit_equal(self):
+        from tpudes.obs.device import CompileTelemetry
+        from tpudes.parallel.runtime import RUNTIME
+        from tpudes.parallel.tcp_dumbbell import run_tcp_dumbbell
+
+        prog = toy_dumbbell_program(n_flows=3, n_slots=120)
+        pts = toy_traffic_points(3, 120_000)
+        assert len(pts) == 8
+        per = [
+            run_tcp_dumbbell(
+                dataclasses.replace(prog, traffic=tp), KEY, replicas=3
+            )
+            for tp in pts
+        ]
+        base = dataclasses.replace(prog, traffic=pts[0])
+        run_tcp_dumbbell(base, KEY, replicas=3, traffic_sweep=pts)  # warm
+        l0 = RUNTIME.launches("dumbbell")
+        c0 = CompileTelemetry.compiles("dumbbell")
+        swept = run_tcp_dumbbell(
+            base, KEY, replicas=3, traffic_sweep=pts
+        )
+        assert RUNTIME.launches("dumbbell") - l0 == 1
+        assert CompileTelemetry.compiles("dumbbell") - c0 == 0
+        for a, b in zip(per, swept):
+            assert _eq(a, b, TCP_FIELDS)
+
+    def test_sweep_rejects_mismatched_shapes_and_double_axis(self):
+        from tpudes.parallel.tcp_dumbbell import run_tcp_dumbbell
+
+        prog = toy_dumbbell_program(n_flows=2, n_slots=60)
+        pts = toy_traffic_points(2, 60_000)
+        base = dataclasses.replace(prog, traffic=pts[0])
+        bad = dataclasses.replace(pts[1], n_cycle=1)
+        with pytest.raises(ValueError, match="shape key"):
+            run_tcp_dumbbell(
+                base, KEY, replicas=2, traffic_sweep=[pts[0], bad]
+            )
+        with pytest.raises(ValueError, match="one config axis"):
+            run_tcp_dumbbell(
+                base, KEY, replicas=2, traffic_sweep=pts,
+                variants=[[0, 1]] * 8,
+            )
+        # prog.traffic unset: the sweep has no shape class to compile
+        with pytest.raises(ValueError, match="prog.traffic"):
+            run_tcp_dumbbell(
+                prog, KEY, replicas=2, traffic_sweep=pts
+            )
+
+
 class TestAsFlows:
     def test_cbr_multiplier_is_exact_identity(self):
         from tpudes.parallel.as_flows import run_as_flows

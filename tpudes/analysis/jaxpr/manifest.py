@@ -25,6 +25,7 @@ ENGINE_MANIFESTS = (
     ("tpudes.parallel.wired", "trace_manifest"),
     ("tpudes.parallel.hybrid", "trace_manifest"),
     ("tpudes.traffic.device", "trace_manifest"),
+    ("tpudes.diff.as_grad", "trace_manifest"),
 )
 
 

@@ -56,6 +56,10 @@ class JaxprContractPass(Pass):
                   "tracing as a constant",
         "JXL005": "donation audit: donated carry leaf unused or "
                   "unaliasable, or a donatable carry never donated",
+        "JXL006": "grad-hygiene: a declared-differentiable operand of "
+                  "a surrogate-flagged trace has a structurally-zero "
+                  "gradient (round/argmax/int-cast/stop_gradient "
+                  "severs every path — annotate straight-through)",
     }
 
     def __init__(self, manifests=None):
@@ -175,6 +179,26 @@ def lint_manifest(man, line: int = 1) -> list:
                         "accelerators (wrap the jit in "
                         "donate_argnums)",
                     )
+
+            # JXL006 — grad hygiene on surrogate-flagged variants:
+            # every declared-differentiable operand leaf must keep a
+            # gradient path to the outputs; a round/argmax/integer
+            # cast/stop_gradient severing every path makes jax.grad
+            # return structural zeros — the silent way a calibration
+            # "converges" by never moving
+            if variant.surrogate:
+                for argnum in entry.grad_wrt:
+                    for path in T.grad_severed_leaves(entry, cj, argnum):
+                        emit(
+                            "JXL006",
+                            f"{tag}: differentiable operand leaf "
+                            f"'{path}' has no gradient path to the "
+                            "outputs — a hard op (round/argmax/int "
+                            "cast/stop_gradient) severs every route; "
+                            "wrap it straight-through "
+                            "(tpudes.diff.ste) or soften it behind "
+                            "the Surrogacy flag",
+                        )
 
         # JXL002 — f64 under ambient x64 (rebuild inside the context so
         # build-time asarray boundaries are exercised too).  A trace

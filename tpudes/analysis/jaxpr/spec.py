@@ -56,6 +56,12 @@ class TraceEntry:
     carry: tuple = ()
     traced: dict = field(default_factory=dict)
     kernel: bool = True
+    #: argnums documented as DIFFERENTIABLE runtime operands: on a
+    #: surrogate-flagged variant JXL006 checks each keeps a gradient
+    #: path to the outputs (a round/argmax/int-cast/stop_gradient
+    #: severing every path = structurally-zero gradient — the hard op
+    #: needs a straight-through annotation, ``tpudes.diff.ste``)
+    grad_wrt: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -71,6 +77,9 @@ class TraceVariant:
     name: str
     build: object
     bf16: bool = False
+    #: marks a surrogate-flagged (differentiable) build: JXL006 audits
+    #: the gradient hygiene of its entries' ``grad_wrt`` operands
+    surrogate: bool = False
 
 
 @dataclass(frozen=True)

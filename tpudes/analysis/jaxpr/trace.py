@@ -201,6 +201,125 @@ def unaliasable_donated_leaves(entry, closed_jaxpr, argnum: int):
     return missing
 
 
+#: primitives with FLOAT outputs whose gradient is zero (or undefined)
+#: almost everywhere — a gradient path running only through these is
+#: structurally dead.  Comparison/argmax/int-cast severing needs no
+#: listing: their outputs are not floating, so liveness never crosses
+#: them (see _diff_walk).
+NONDIFF_PRIMS = frozenset(
+    {"stop_gradient", "round", "floor", "ceil", "sign",
+     "round_nearest_even"}
+)
+
+
+def _is_float_var(v) -> bool:
+    import numpy as np
+
+    dtype = getattr(getattr(v, "aval", None), "dtype", None)
+    if dtype is None:
+        return False
+    try:
+        return np.issubdtype(dtype, np.inexact)
+    except TypeError:  # ml_dtypes (bfloat16) — inexact by definition
+        return "float" in str(dtype)
+
+
+def _diff_walk(jaxpr, live: set) -> None:
+    """Propagate differentiable liveness (by var id, in ``live``)
+    through one jaxpr's eqns in order.  Liveness crosses an eqn when a
+    live FLOAT invar feeds it and the primitive carries gradients:
+    non-float outputs (comparisons, argmax, float→int casts) and
+    :data:`NONDIFF_PRIMS` sever the path.  Call-like eqns whose single
+    sub-jaxpr aligns 1:1 with the invars (pjit/closed_call/remat)
+    recurse precisely; other sub-jaxpr carriers (scan/while/cond) are
+    treated as differentiable pass-through — conservative: a hard op
+    hidden inside a loop body is missed, one outside is not."""
+    from jax import core
+
+    for eqn in jaxpr.eqns:
+        in_live = any(
+            not isinstance(v, core.Literal)
+            and id(v) in live
+            and _is_float_var(v)
+            for v in eqn.invars
+        )
+        if not in_live:
+            continue
+        if eqn.primitive.name in NONDIFF_PRIMS:
+            continue
+        subs = []
+        for p in eqn.params.values():
+            subs.extend(_sub_jaxprs(p))
+        if len(subs) == 1 and len(subs[0].invars) == len(eqn.invars):
+            sub = subs[0]
+            sub_live = set(live)
+            for ev, sv in zip(eqn.invars, sub.invars):
+                if (
+                    not isinstance(ev, core.Literal)
+                    and id(ev) in live
+                    and _is_float_var(ev)
+                ):
+                    sub_live.add(id(sv))
+            # scan feeds its carry outputs back into its carry inputs:
+            # iterate the body walk to a FIXED POINT, or liveness that
+            # only enters the carry on iteration k>0 (the fluid
+            # fixed-point relaxation's cap→util→lfrac→lg chain) is
+            # missed
+            if eqn.primitive.name == "scan":
+                nc = int(eqn.params.get("num_consts", 0))
+                ncar = int(eqn.params.get("num_carry", 0))
+                while True:
+                    before = len(sub_live)
+                    _diff_walk(sub, sub_live)
+                    for ov, iv in zip(
+                        sub.outvars[:ncar], sub.invars[nc:nc + ncar]
+                    ):
+                        if (
+                            not isinstance(ov, core.Literal)
+                            and id(ov) in sub_live
+                        ):
+                            sub_live.add(id(iv))
+                    if len(sub_live) == before:
+                        break
+            else:
+                _diff_walk(sub, sub_live)
+            for ov, sv in zip(eqn.outvars, sub.outvars):
+                if (
+                    not isinstance(sv, core.Literal)
+                    and id(sv) in sub_live
+                    and _is_float_var(ov)
+                ):
+                    live.add(id(ov))
+            continue
+        for v in eqn.outvars:
+            if _is_float_var(v):
+                live.add(id(v))
+
+
+def grad_severed_leaves(entry, closed_jaxpr, argnum: int):
+    """Keypaths of ``entry.args[argnum]``'s FLOAT leaves with no
+    differentiable path to any float output of the trace — their
+    ``jax.grad`` is structurally zero (a hard op severs every path),
+    the JXL006 finding."""
+    jaxpr = closed_jaxpr.jaxpr
+    slices = arg_leaf_slices(entry.args)
+    start, stop = slices[argnum]
+    invars = jaxpr.invars
+    paths = arg_leaf_paths(entry.args[argnum])
+    out = []
+    for i in range(start, stop):
+        root = invars[i]
+        if not _is_float_var(root):
+            continue  # integer operands carry no gradient by design
+        live = {id(root)}
+        _diff_walk(jaxpr, live)
+        if not any(
+            id(v) in live and _is_float_var(v) for v in jaxpr.outvars
+        ):
+            out.append(paths[i - start])
+    return out
+
+
 def fingerprint(closed_jaxpr) -> str:
     """Canonical identity of a traced program: the pretty-printed jaxpr
     (var names are assigned deterministically in traversal order, so

@@ -1,0 +1,60 @@
+"""tpudes.diff — differentiable simulation on the device engines.
+
+The repro sits on JAX but the engines only ever ran FORWARD; this
+package turns simulation-as-a-service into
+optimization-as-a-service (ROADMAP item 5):
+
+- :class:`Surrogacy` — temperature-controlled smooth surrogates for
+  the engines' hard points (CQI staircase, decode thresholds, the AS
+  delivery min-gate), straight-through where forward exactness
+  matters; ``surrogate=None`` compiles the identical legacy program.
+- :func:`grad_as_flows` / :func:`grad_lte_sm` — ``jax.value_and_grad``
+  of scalar KPI losses w.r.t. runtime operands (propagation
+  exponents, tx powers, eNB/UE positions, traffic rates, scheduler
+  weights), riding ``RUNTIME`` with vmap-of-grad design batching.
+- :func:`calibrate_as_flows` / :func:`calibrate_lte` /
+  :func:`descend` — Adam / L-BFGS-lite descent as ONE compiled scan
+  (one launch, one compile per study family), ``fold_in``-keyed
+  minibatch replicas.
+- :func:`es_search` / :func:`fd_gradient` /
+  :func:`bss_interval_design` — the megabatched-sweep fallback for
+  the non-differentiable engines (one launch per ES generation).
+
+See README "Differentiable simulation" for the workflow.
+"""
+
+from tpudes.diff.as_grad import AS_LOSSES, grad_as_flows
+from tpudes.diff.calibrate import (
+    CalibResult,
+    calibrate_as_flows,
+    calibrate_lte,
+    descend,
+)
+from tpudes.diff.lte_grad import LTE_LOSSES, grad_lte_sm
+from tpudes.diff.search import (
+    ESResult,
+    bss_interval_design,
+    descend_design,
+    es_search,
+    fd_gradient,
+)
+from tpudes.diff.surrogate import Surrogacy, soft_staircase, ste
+
+__all__ = [
+    "AS_LOSSES",
+    "CalibResult",
+    "ESResult",
+    "LTE_LOSSES",
+    "Surrogacy",
+    "bss_interval_design",
+    "calibrate_as_flows",
+    "calibrate_lte",
+    "descend",
+    "descend_design",
+    "es_search",
+    "fd_gradient",
+    "grad_as_flows",
+    "grad_lte_sm",
+    "soft_staircase",
+    "ste",
+]

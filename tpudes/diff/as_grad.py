@@ -1,0 +1,380 @@
+"""``grad_as_flows`` — KPI gradients through the fluid AS engine.
+
+The differentiable runner (:func:`tpudes.parallel.as_flows.build_as_diff`)
+shares the fluid round/delay cores with the production engine and lifts
+the per-flow nominal rates and per-edge link capacities to traced
+operands; this module wraps it in ``jax.value_and_grad`` of scalar KPI
+losses, rides :data:`~tpudes.parallel.runtime.RUNTIME` (one cached
+executable per (program, loss, mode) — value flips never recompile,
+because EVERY operand is traced), and batches candidate designs with
+``vmap``-of-grad so a C-point design study is ONE device launch.
+
+Differentiable operands (all members of ``params``, all traced):
+
+- ``flow_bps``   (F,) — per-flow nominal offered rates (the traffic
+  rates; with ``prog.traffic`` the workload multiplier rides on top);
+- ``cap_bps``    (E,) — per-edge link capacities (design search:
+  where to add bandwidth);
+- ``rate_scale`` ()   — the global offered-load multiplier (the PR-5
+  sweep operand; a (C,) array under ``rate_scale=[...]`` sweeps).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["AS_LOSSES", "build_as_loss_fn", "grad_as_flows"]
+
+#: loss registry: name -> fn(outputs, target) -> scalar.  Losses are
+#: deliberately scale-normalized so Adam steps are comparable across
+#: operand magnitudes (bps vs unitless).
+AS_LOSSES = ("kpi_mse", "neg_goodput", "delay")
+
+
+def _as_scalar_loss(loss: str, out: dict, target):
+    import jax.numpy as jnp
+
+    gp = jnp.mean(out["goodput_bps"], axis=0)        # (F,) replica mean
+    if loss == "kpi_mse":
+        # relative MSE against observed per-flow goodput KPIs (the
+        # calibration objective): scale-free so mixed-rate flow sets
+        # condition well
+        return jnp.mean(
+            ((gp - target) / jnp.maximum(jnp.abs(target), 1.0)) ** 2
+        )
+    if loss == "neg_goodput":
+        return -jnp.sum(gp) * jnp.float32(1e-6)      # -Mbps (descent ↑)
+    if loss == "delay":
+        # reached-weighted mean end-to-end delay (unreachable flows
+        # report delay 0 in the diff runner; the mask weights them out
+        # instead of poisoning the gradient with an inf)
+        r = out["reached"]
+        dl = jnp.mean(out["delay_s"], axis=0)
+        return jnp.sum(dl * r) / jnp.maximum(jnp.sum(r), 1.0)
+    raise ValueError(f"unknown AS loss {loss!r}; one of {AS_LOSSES}")
+
+
+def build_as_loss_fn(prog, r_pad: int, loss: str, n_real: int | None = None):
+    """``loss_fn(params, z, tr, horizon_us, target) -> scalar`` — the
+    UNJITTED scalar-KPI objective exactly as :func:`grad_as_flows`
+    jits it (and as the calibration scan re-traces it), with every
+    runtime operand traced.  ``params`` carries flow_bps / cap_bps /
+    rate_scale; ``z`` the ``fold_in``-keyed replica jitter draws (the
+    minibatch axis of stochastic calibration).  ``n_real`` slices the
+    pow2-bucketed replica padding off before the loss reduction, so
+    the objective averages exactly the replicas the caller asked for —
+    the same KPIs ``run_as_flows`` reports (padding rows are real
+    independent replicas, but including them would make the loss a
+    function of the bucket size instead of the request)."""
+    from tpudes.parallel.as_flows import build_as_diff
+
+    diff_run = build_as_diff(prog, r_pad)
+
+    def loss_fn(params, z, tr, horizon_us, target):
+        out = diff_run(
+            z, params["rate_scale"], params["flow_bps"],
+            params["cap_bps"], tr, horizon_us,
+        )
+        if n_real is not None and n_real != r_pad:
+            out = {
+                k: (v[:n_real] if k not in ("reached",) else v)
+                for k, v in out.items()
+            }
+        return _as_scalar_loss(loss, out, target)
+
+    return loss_fn
+
+
+def as_default_params(prog) -> dict:
+    """The linearization point: the program's own nominal operands."""
+    import jax.numpy as jnp
+
+    return {
+        "flow_bps": jnp.asarray(prog.flow_bps, jnp.float32),
+        "cap_bps": jnp.asarray(prog.rate_bps, jnp.float32),
+        "rate_scale": jnp.float32(1.0),
+    }
+
+
+def _traffic_operands(prog):
+    import jax.numpy as jnp
+
+    if prog.traffic is None:
+        return None, None
+    tr = prog.traffic.operands()
+    horizon_us = jnp.int32(min(int(prog.sim_s * 1e6), 2**30 - 1))
+    return tr, horizon_us
+
+
+def _as_grad_key(prog_key, r_shape, loss, n_cfg, axes) -> tuple:
+    """Runner-cache identity of one grad program — shared by the entry
+    point and the trace manifest's flip specs (the JXL004 no-drift
+    rule).  ``prog_key`` (= ``as_prog_key``) carries the surrogate
+    config; ``r_shape`` = (r_pad, requested replicas) — the padded
+    axis AND the real-row slice both shape the trace; loss/batching
+    shape it too."""
+    return ("diff", "as_grad", prog_key, r_shape, loss, n_cfg,
+            None if axes is None else tuple(sorted(axes.items())))
+
+
+def grad_as_flows(
+    prog,
+    key,
+    replicas: int,
+    *,
+    loss: str = "neg_goodput",
+    target=None,
+    at: dict | None = None,
+    batch: dict | None = None,
+    rate_scale=None,
+    wrt=None,
+):
+    """``value_and_grad`` of a scalar KPI loss of the fluid AS engine
+    w.r.t. its runtime operands.
+
+    Returns ``{"loss": float, "grads": {name: np.ndarray}}``.  ``at``
+    overrides the linearization point (finite-difference probes pay no
+    recompile: every operand is traced).  ``batch={name: (C, ...)}``
+    evaluates C candidate designs with **vmap-of-grad in ONE device
+    launch** (per-point losses/grads gain a leading C axis);
+    ``rate_scale=[...]`` is the special case batching the PR-5 sweep
+    operand.  ``wrt`` optionally restricts the reported gradient dict
+    (everything is differentiated either way — the executable is
+    shared across ``wrt`` choices).
+
+    The surrogate config rides ``prog.surrogate``
+    (:class:`tpudes.diff.Surrogacy`): ``None`` differentiates the
+    exact program (the fluid math is piecewise-smooth — subgradients
+    at the min-gate kinks), a config smooths the delivery gate
+    (straight-through under ``ste`` keeps the forward bit-equal to the
+    legacy engine).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpudes.obs.device import CompileTelemetry
+    from tpudes.obs.grad import GradTelemetry
+    from tpudes.parallel.as_flows import _as_replica_draws, as_prog_key
+    from tpudes.parallel.runtime import RUNTIME, bucket_replicas
+
+    if batch is not None and rate_scale is not None:
+        raise ValueError(
+            "one batch axis per launch: candidate designs (batch=) or "
+            "the offered-load sweep (rate_scale=[...])"
+        )
+    r_pad = bucket_replicas(replicas, None)
+    n_cfg = None
+    axes = None
+    if rate_scale is not None:
+        n_cfg = len(rate_scale)
+        axes = {"flow_bps": None, "cap_bps": None, "rate_scale": 0}
+    elif batch is not None:
+        sizes = {int(np.shape(v)[0]) for v in batch.values()}
+        if len(sizes) != 1:
+            raise ValueError("batch= arrays need one shared leading axis")
+        n_cfg = sizes.pop()
+        axes = {
+            k: (0 if k in batch else None)
+            for k in ("flow_bps", "cap_bps", "rate_scale")
+        }
+    ck = _as_grad_key(
+        as_prog_key(prog), (r_pad, int(replicas)), loss, n_cfg, axes
+    )
+
+    def build():
+        loss_fn = build_as_loss_fn(prog, r_pad, loss, n_real=replicas)
+        vg = jax.value_and_grad(loss_fn)
+        if axes is not None:
+            vg = jax.vmap(vg, in_axes=(axes, None, None, None, None))
+        return jax.jit(vg)
+
+    vg, compiling = RUNTIME.runner("diff_as", ck, build)
+
+    params = as_default_params(prog)
+    for k, v in (at or {}).items():
+        params[k] = jnp.asarray(v, jnp.float32)
+    if rate_scale is not None:
+        params["rate_scale"] = jnp.asarray(
+            [float(v) for v in rate_scale], jnp.float32
+        )
+    for k, v in (batch or {}).items():
+        params[k] = jnp.asarray(v, jnp.float32)
+    F = len(prog.src)
+    tgt = (
+        jnp.zeros((F,), jnp.float32) if target is None
+        else jnp.asarray(target, jnp.float32)
+    )
+    z = _as_replica_draws(prog, key, r_pad)
+    tr, horizon_us = _traffic_operands(prog)
+
+    with CompileTelemetry.timed("diff_as", compiling):
+        val, grads = vg(params, z, tr, horizon_us, tgt)
+        RUNTIME.record_launch("diff_as")
+        if compiling:
+            jax.block_until_ready(val)
+
+    val = np.asarray(jax.device_get(val))
+    grads = {k: np.asarray(v) for k, v in jax.device_get(grads).items()}
+    if wrt is not None:
+        grads = {k: grads[k] for k in wrt}
+    gnorm = float(
+        np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
+                    for g in grads.values()))
+    )
+    GradTelemetry.record(
+        "as_flows", loss=float(val.mean()), grad_norm=gnorm,
+        batched=n_cfg,
+    )
+    return {
+        "loss": float(val) if val.ndim == 0 else val,
+        "grads": grads,
+    }
+
+
+# --- trace manifest (tpudes.analysis.jaxpr) --------------------------------
+
+#: canonical tiny replica count for the abstract traces
+_TRACE_R = 2
+
+
+def _trace_as_entries(surrogate, loss: str = "kpi_mse"):
+    """The AS grad objective exactly as ``grad_as_flows`` jits it
+    (before value_and_grad — JXL006 audits the FORWARD trace's
+    gradient paths), with concrete tiny operands."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+    from tpudes.parallel.as_flows import _as_replica_draws
+    from tpudes.parallel.programs import toy_as_program
+
+    prog = dataclasses.replace(
+        toy_as_program(n_nodes=12, n_flows=2, spf_rounds=6),
+        surrogate=surrogate,
+    )
+    loss_fn = build_as_loss_fn(prog, _TRACE_R, loss)
+    params = as_default_params(prog)
+    z = _as_replica_draws(prog, jax.random.PRNGKey(0), _TRACE_R)
+    target = jnp.zeros((len(prog.src),), jnp.float32)
+    return [
+        TraceEntry(
+            "as_loss",
+            loss_fn,
+            (params, z, None, None, target),
+            kernel=False,
+            traced={"params": 0, "z": 1, "target": 4},
+            grad_wrt=(0,),
+        ),
+    ]
+
+
+def _trace_lte_entries():
+    """The LTE expected-KPI objective exactly as ``grad_lte_sm`` jits
+    it, on a tiny positional (pathloss-bearing) program — every
+    exposed operand (powers, positions, propagation params, scheduler
+    weights) must keep a live gradient path (JXL006)."""
+    import jax.numpy as jnp
+
+    from tpudes.analysis.jaxpr.spec import TraceEntry
+    from tpudes.diff.lte_grad import build_lte_loss_fn, lte_default_params
+    from tpudes.diff.surrogate import Surrogacy
+    from tpudes.parallel.lte_sm import LteSmProgram
+
+    E, U = 2, 3
+    serving = np.array([0, 1, 0], np.int32)
+    prog = LteSmProgram(
+        gain=np.full((E, U), 1e-12),
+        serving=serving,
+        tx_power_dbm=np.full((E,), 43.0),
+        noise_psd=10.0**0.9 * 1.380649e-23 * 290.0,
+        n_rb=25,
+        n_ttis=40,
+        scheduler="pf",
+        enb_pos=np.array([[0.0, 0.0, 30.0], [400.0, 0.0, 30.0]],
+                         np.float32),
+        pathloss=("log_distance", 3.0, 1.0, 46.67),
+    )
+    ue_pos = np.array(
+        [[120.0, 40.0, 1.5], [300.0, -60.0, 1.5], [50.0, -90.0, 1.5]],
+        np.float32,
+    )
+    loss_fn = build_lte_loss_fn(prog, Surrogacy(), "kpi_mse")
+    params = lte_default_params(prog, {"ue_pos": ue_pos})
+    target = jnp.zeros((U,), jnp.float32)
+    return [
+        TraceEntry(
+            "lte_loss",
+            loss_fn,
+            (params, target),
+            kernel=False,
+            traced={"params": 0, "target": 1},
+            grad_wrt=(0,),
+        ),
+    ]
+
+
+def _trace_flips():
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import FlipSpec
+    from tpudes.diff.surrogate import Surrogacy
+    from tpudes.parallel.as_flows import as_prog_key
+    from tpudes.parallel.programs import toy_as_program
+
+    base_prog = dataclasses.replace(
+        toy_as_program(n_nodes=12, n_flows=2, spf_rounds=6),
+        surrogate=Surrogacy(),
+    )
+
+    def key_of(prog, loss):
+        return _as_grad_key(
+            as_prog_key(prog), (_TRACE_R, _TRACE_R), loss, None, None
+        )
+
+    base_key = key_of(base_prog, "kpi_mse")
+
+    def flip(surrogate=None, loss="kpi_mse"):
+        prog = (
+            base_prog if surrogate is None
+            else dataclasses.replace(base_prog, surrogate=surrogate)
+        )
+        return FlipSpec(
+            build=lambda: _trace_as_entries(prog.surrogate, loss),
+            key_differs=key_of(prog, loss) != base_key,
+        )
+
+    return {
+        # the surrogate config is a cache-key component: temperature
+        # and ste flips select different arithmetic (JXL004 both ways)
+        "gate_temp": flip(surrogate=Surrogacy(gate_temp=0.6)),
+        "ste": flip(surrogate=Surrogacy(ste=True)),
+        # the loss is baked into the objective — a loss flip must be
+        # key-separated
+        "loss": flip(loss="delay"),
+    }
+
+
+def trace_manifest():
+    """Diff-subsystem trace manifest (see :mod:`tpudes.analysis.jaxpr`):
+    both grad objectives join the JXL lint surface, surrogate-flagged
+    so JXL006 audits every exposed operand's gradient path."""
+    from tpudes.analysis.jaxpr.spec import TraceManifest, TraceVariant
+    from tpudes.diff.surrogate import Surrogacy
+
+    return TraceManifest(
+        engine="diff",
+        path="tpudes/diff/as_grad.py",
+        variants=lambda: [
+            TraceVariant(
+                "as_loss",
+                lambda: _trace_as_entries(Surrogacy()),
+                surrogate=True,
+            ),
+            TraceVariant(
+                "lte_loss", _trace_lte_entries, surrogate=True
+            ),
+        ],
+        flips=_trace_flips,
+    )

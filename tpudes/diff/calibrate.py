@@ -1,0 +1,405 @@
+"""Gradient-based calibration: fit runtime operands to observed KPIs.
+
+The whole descent loop is ONE compiled program: a ``lax.scan`` over
+iterations whose body evaluates ``value_and_grad`` of the engine's
+scalar KPI loss and applies the optimizer update — so a 200-step
+calibration is one launch and **one fresh compile** (pinned by the
+``grad_calibration`` bench row and CompileTelemetry tests), and the
+loss/grad-norm histories stream back as the scan's stacked outputs.
+Stochastic minibatching rides the established key discipline: step
+``t`` draws its replica minibatch from ``fold_in(key, t)``, pure in
+``t``, so the sample stream is independent of how many steps run.
+
+Optimizers (pure jnp — no external deps):
+
+- ``adam``  — the standard bias-corrected Adam update;
+- ``lbfgs`` — L-BFGS-lite: the two-loop recursion over an M=5 ring of
+  (s, y) pairs with a trust-region-style step cap in place of a line
+  search (each move is bounded to a fraction of the iterate's scale —
+  the "lite"), on the raveled parameter vector.  Good for the
+  deterministic LTE objectives; use adam when the loss is a minibatch
+  estimate.
+
+Quantized observables (CQI indices) make the calibration landscape
+multi-modal once the initial guess is far off — some UEs' observed
+CQIs saturate and their basins flatten.  The remedy is multi-start:
+descend from a few ``init=`` points and keep the best ``final_loss``
+(each start reuses the SAME cached descent program — ``init`` rides
+the traced ``params0``, so K starts cost K launches and one compile;
+tests pin a 0.6-exponent gap recovering exactly this way).
+
+:func:`calibrate_as_flows` / :func:`calibrate_lte` wrap the two diff
+engines: plant parameters, synthesize observed KPIs, descend, recover
+— the end-to-end demo tests/test_diff_opt.py pins.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "CalibResult",
+    "calibrate_as_flows",
+    "calibrate_lte",
+    "descend",
+]
+
+#: L-BFGS-lite history depth
+_LBFGS_M = 5
+
+
+@dataclass
+class CalibResult:
+    """One calibration run: the fitted operands plus the per-iteration
+    loss / gradient-norm rings (the GradTelemetry payload)."""
+
+    params: dict
+    loss: np.ndarray        # (steps,)
+    grad_norm: np.ndarray   # (steps,)
+    steps: int
+    opt: str
+
+    @property
+    def final_loss(self) -> float:
+        return float(self.loss[-1])
+
+
+def _adam_scan(vg, params0, steps: int, lr: float, key):
+    import jax
+    import jax.numpy as jnp
+
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params0)
+
+    def body(operands, carry, t):
+        params, m, v = carry
+        kt = jax.random.fold_in(key, t)
+        loss, g = vg(params, kt, operands)
+        m = jax.tree_util.tree_map(
+            lambda a, b: b1 * a + (1 - b1) * b, m, g
+        )
+        v = jax.tree_util.tree_map(
+            lambda a, b: b2 * a + (1 - b2) * b * b, v, g
+        )
+        tf = t.astype(jnp.float32) + 1.0
+        c1 = 1.0 - jnp.power(jnp.float32(b1), tf)
+        c2 = 1.0 - jnp.power(jnp.float32(b2), tf)
+        params = jax.tree_util.tree_map(
+            lambda p, mm, vv: p
+            - lr * (mm / c1) / (jnp.sqrt(vv / c2) + eps),
+            params, m, v,
+        )
+        gnorm = jnp.sqrt(
+            sum(
+                jnp.sum(leaf.astype(jnp.float32) ** 2)
+                for leaf in jax.tree_util.tree_leaves(g)
+            )
+        )
+        return (params, m, v), (loss, gnorm)
+
+    def run(params0, operands):
+        (params, _, _), (losses, gnorms) = jax.lax.scan(
+            lambda c, t: body(operands, c, t), (params0, zeros, zeros),
+            jnp.arange(steps, dtype=jnp.int32),
+        )
+        return params, losses, gnorms
+
+    return run
+
+
+def _lbfgs_scan(vg, params0, steps: int, lr: float, key):
+    """L-BFGS-lite on the raveled vector: M-deep (s, y) ring + the
+    two-loop recursion, fixed step size.  The ring slots start masked
+    (rho = 0 ⇒ the slot is skipped by construction in both loops)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    x0, unravel = ravel_pytree(params0)
+    P = x0.shape[0]
+    M = _LBFGS_M
+
+    def vg_flat(x, kt, operands):
+        loss, g = vg(unravel(x), kt, operands)
+        gf, _ = ravel_pytree(g)
+        return loss, gf
+
+    def direction(g, S, Y, rho):
+        # two-loop recursion, oldest→newest is ring order ptr..ptr+M
+        q = g
+        alphas = jnp.zeros((M,), jnp.float32)
+
+        def bwd(i, c):
+            q, alphas = c
+            j = M - 1 - i                    # newest first
+            a = rho[j] * jnp.dot(S[j], q)
+            q = q - a * Y[j]
+            return q, alphas.at[j].set(a)
+
+        q, alphas = jax.lax.fori_loop(0, M, bwd, (q, alphas))
+        # initial Hessian scale from the newest live pair
+        sy = jnp.dot(S[M - 1], Y[M - 1])
+        yy = jnp.dot(Y[M - 1], Y[M - 1])
+        gamma = jnp.where(yy > 1e-12, sy / jnp.maximum(yy, 1e-12), 1.0)
+        r = gamma * q
+
+        def fwd(j, r):
+            b = rho[j] * jnp.dot(Y[j], r)
+            return r + (alphas[j] - b) * S[j]
+
+        r = jax.lax.fori_loop(0, M, fwd, r)
+        return r
+
+    def body(operands, carry, t):
+        x, g_prev, x_prev, S, Y, rho, started = carry
+        kt = jax.random.fold_in(key, t)
+        loss, g = vg_flat(x, kt, operands)
+        # push (s, y) from the completed step (skip the very first)
+        s = x - x_prev
+        y = g - g_prev
+        sy = jnp.dot(s, y)
+        ok = started & (sy > 1e-12)
+        S = jnp.where(ok, jnp.roll(S, -1, axis=0).at[M - 1].set(s), S)
+        Y = jnp.where(ok, jnp.roll(Y, -1, axis=0).at[M - 1].set(y), Y)
+        rho = jnp.where(
+            ok,
+            jnp.roll(rho, -1).at[M - 1].set(1.0 / jnp.maximum(sy, 1e-12)),
+            rho,
+        )
+        d = direction(g, S, Y, rho)
+        # trust-region-style cap in place of a line search (the
+        # "lite"): a degenerate history can make H⁻¹g enormous, and a
+        # fixed-step quasi-Newton then leaves the basin entirely —
+        # bound each move to a fraction of the iterate's own scale
+        step = lr * d
+        cap = 0.25 * (1.0 + jnp.sqrt(jnp.sum(x**2)))
+        snorm = jnp.sqrt(jnp.sum(step**2))
+        step = step * jnp.minimum(1.0, cap / jnp.maximum(snorm, 1e-12))
+        x_new = x - step
+        gnorm = jnp.sqrt(jnp.sum(g**2))
+        return (
+            (x_new, g, x, S, Y, rho, jnp.bool_(True)),
+            (loss, gnorm),
+        )
+
+    def run(params0, operands):
+        x0_, _ = ravel_pytree(params0)
+        carry0 = (
+            x0_, jnp.zeros((P,), jnp.float32), x0_,
+            jnp.zeros((M, P), jnp.float32), jnp.zeros((M, P), jnp.float32),
+            jnp.zeros((M,), jnp.float32), jnp.bool_(False),
+        )
+        (x, *_), (losses, gnorms) = jax.lax.scan(
+            lambda c, t: body(operands, c, t), carry0,
+            jnp.arange(steps, dtype=jnp.int32),
+        )
+        return unravel(x), losses, gnorms
+
+    return run
+
+
+def descend(
+    loss_and_grad,
+    params0: dict,
+    *,
+    steps: int,
+    lr: float,
+    key,
+    opt: str = "adam",
+    operands=None,
+    runtime_key: tuple | None = None,
+    engine: str = "diff",
+) -> CalibResult:
+    """Run ``steps`` optimizer iterations of
+    ``loss_and_grad(params, key_t, operands) -> (loss, grads)`` as ONE
+    compiled ``lax.scan`` launch.
+
+    ``operands`` is the traced side-input pytree (observed KPI
+    targets, non-optimized linearization values, workload tables):
+    EVERYTHING value-like the objective reads must ride here, never a
+    closure — the descent program is cached in :data:`RUNTIME` under
+    ``runtime_key``, and a baked closure value would make a later
+    calibration of the same study family silently fit the FIRST
+    call's observations (regression-pinned in tests/test_diff_opt.py).
+    ``runtime_key`` is the hashable program identity (shapes + loss +
+    wrt — not operand values); without it the program is jitted ad
+    hoc (still one compile per call)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudes.obs.device import CompileTelemetry
+    from tpudes.obs.grad import GradTelemetry
+    from tpudes.parallel.runtime import RUNTIME
+
+    if opt == "adam":
+        maker = _adam_scan
+    elif opt == "lbfgs":
+        maker = _lbfgs_scan
+    else:
+        raise ValueError(f"opt must be 'adam' or 'lbfgs', not {opt!r}")
+
+    params0 = {
+        k: jnp.asarray(v, jnp.float32) for k, v in params0.items()
+    }
+    operands = {} if operands is None else operands
+
+    def build():
+        return jax.jit(
+            maker(loss_and_grad, params0, int(steps), float(lr), key)
+        )
+
+    if runtime_key is not None:
+        run, compiling = RUNTIME.runner(
+            engine,
+            ("descent", opt, int(steps), float(lr),
+             np.asarray(key).tobytes()) + runtime_key,
+            build,
+        )
+    else:
+        run, compiling = build(), True
+
+    with CompileTelemetry.timed(engine, compiling):
+        params, losses, gnorms = run(params0, operands)
+        RUNTIME.record_launch(engine)
+        if compiling:
+            jax.block_until_ready(losses)
+
+    losses = np.asarray(jax.device_get(losses))
+    gnorms = np.asarray(jax.device_get(gnorms))
+    result = CalibResult(
+        params={k: np.asarray(v) for k, v in
+                jax.device_get(params).items()},
+        loss=losses, grad_norm=gnorms, steps=int(steps), opt=opt,
+    )
+    GradTelemetry.record_descent(engine, losses, gnorms)
+    return result
+
+
+def calibrate_as_flows(
+    prog,
+    key,
+    observed,
+    *,
+    wrt=("flow_bps",),
+    init: dict | None = None,
+    steps: int = 80,
+    lr: float = 0.08,
+    replicas: int = 8,
+    loss: str = "kpi_mse",
+    opt: str = "adam",
+) -> CalibResult:
+    """Recover AS operands (flow rates / link capacities) from observed
+    per-flow goodput KPIs by descent.  Parameters are optimized in LOG
+    space (rates are positive and span decades), each step's replica
+    minibatch keyed ``fold_in(key, step)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudes.diff.as_grad import (
+        _traffic_operands,
+        as_default_params,
+        build_as_loss_fn,
+    )
+    from tpudes.parallel.as_flows import _as_replica_draws, as_prog_key
+    from tpudes.parallel.runtime import bucket_replicas
+
+    r_pad = bucket_replicas(replicas, None)
+    loss_fn = build_as_loss_fn(prog, r_pad, loss, n_real=replicas)
+    defaults = as_default_params(prog)
+    tr, horizon_us = _traffic_operands(prog)
+    start = dict(defaults)
+    for k, v in (init or {}).items():
+        start[k] = jnp.asarray(v, jnp.float32)
+    params0 = {
+        k: jnp.log(jnp.maximum(start[k], 1e-6)) for k in wrt
+    }
+    # everything the objective reads besides the optimized params is a
+    # TRACED operand of the descent program (see descend): target KPIs,
+    # the non-optimized linearization values, the workload tables
+    operands = {
+        "target": jnp.asarray(observed, jnp.float32),
+        "rest": {k: v for k, v in defaults.items() if k not in wrt},
+        "tr": tr,
+        "horizon_us": horizon_us,
+    }
+
+    def vg_step(log_params, kt, ops):
+        def scalar(log_params):
+            p = dict(ops["rest"])
+            for k in wrt:
+                p[k] = jnp.exp(log_params[k])
+            z = _as_replica_draws(prog, kt, r_pad)
+            return loss_fn(p, z, ops["tr"], ops["horizon_us"],
+                           ops["target"])
+
+        return jax.value_and_grad(scalar)(log_params)
+
+    res = descend(
+        vg_step, params0, steps=steps, lr=lr, key=key, opt=opt,
+        operands=operands,
+        runtime_key=(as_prog_key(prog), r_pad, int(replicas), loss,
+                     tuple(wrt)),
+        engine="diff_as",
+    )
+    res.params = {k: np.exp(v) for k, v in res.params.items()}
+    return res
+
+
+def calibrate_lte(
+    prog,
+    key,
+    observed,
+    *,
+    wrt=("ploss",),
+    init: dict | None = None,
+    at: dict | None = None,
+    steps: int = 120,
+    lr: float = 0.05,
+    loss: str = "cqi_mse",
+    opt: str = "adam",
+    surrogate=None,
+) -> CalibResult:
+    """Recover LTE propagation/power operands from observed KPIs
+    (per-UE CQI or throughput) by descent over the expected-KPI
+    chain."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudes.diff.lte_grad import (
+        _lte_diff_key,
+        build_lte_loss_fn,
+        lte_default_params,
+    )
+    from tpudes.diff.surrogate import Surrogacy
+
+    if surrogate is None:
+        surrogate = Surrogacy()
+    loss_fn = build_lte_loss_fn(prog, surrogate, loss)
+    defaults = lte_default_params(prog, at)
+    start = dict(defaults)
+    for k, v in (init or {}).items():
+        start[k] = jnp.asarray(v, jnp.float32)
+    params0 = {k: start[k] for k in wrt}
+    # target + non-optimized operands ride TRACED (see descend) — a
+    # cached descent program must never bake one call's observations
+    operands = {
+        "target": jnp.asarray(observed, jnp.float32),
+        "rest": {k: v for k, v in defaults.items() if k not in wrt},
+    }
+
+    def vg_step(params, kt, ops):
+        del kt  # the expected-KPI chain is deterministic
+
+        def scalar(params):
+            return loss_fn({**ops["rest"], **params}, ops["target"])
+
+        return jax.value_and_grad(scalar)(params)
+
+    return descend(
+        vg_step, params0, steps=steps, lr=lr, key=key, opt=opt,
+        operands=operands,
+        runtime_key=(_lte_diff_key(prog, surrogate), loss, tuple(wrt)),
+        engine="diff_lte",
+    )

@@ -1,0 +1,217 @@
+"""Design search: descent where gradients exist, megabatched ES where
+they don't.
+
+Two regimes, one contract (maximize a scalar design objective over a
+parameter vector):
+
+- **Descent** (:func:`descend_design`) — for the differentiable
+  engines (AS flows, the LTE expected-KPI chain): gradient ascent on
+  the negated KPI loss via the calibration scan, one compile for the
+  whole loop, ``vmap``-of-grad for multi-start.
+
+- **Antithetic ES** (:func:`es_search`) — the fallback optimizer for
+  the engines whose programs stay integer/event-stepped
+  (BSS/dumbbell/wired): each generation draws P Gaussian
+  perturbations, evaluates the 2P antithetic candidates θ ± σε as
+  **ONE megabatched device launch** through the PR-5 config-axis
+  sweep machinery (the caller's ``evaluate`` hook), and steps along
+  the fitness-weighted perturbation mean.  :func:`fd_gradient` is the
+  same machinery as a central-finite-difference gradient probe.
+
+:func:`bss_interval_design` is the worked example the bench/tests pin:
+optimize the per-STA offered interval of a BSS cell for decoded echo
+throughput, one ``traffic_sweep`` launch per generation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "ESResult",
+    "bss_interval_design",
+    "descend_design",
+    "es_search",
+    "fd_gradient",
+]
+
+
+@dataclass
+class ESResult:
+    """One evolution-strategies run."""
+
+    theta: np.ndarray          # (D,) final parameters
+    fitness: np.ndarray        # (generations,) best candidate per gen
+    mean_fitness: np.ndarray   # (generations,) population mean
+    launches: int              # device launches consumed (== generations)
+
+
+def _gen_eps(key, gen: int, shape):
+    """Deterministic per-generation perturbations, ``fold_in``-keyed
+    (the repo's key discipline: pure in (key, generation))."""
+    import jax
+
+    return np.asarray(
+        jax.random.normal(jax.random.fold_in(key, gen), shape),
+        np.float64,
+    )
+
+
+def es_search(
+    evaluate,
+    theta0,
+    *,
+    key,
+    generations: int = 10,
+    pop: int = 8,
+    sigma: float = 0.15,
+    lr: float = 0.25,
+    maximize: bool = True,
+    clip=None,
+) -> ESResult:
+    """Antithetic evolution strategies over
+    ``evaluate(thetas (2P, D)) -> (2P,) fitness`` — the caller runs all
+    2P candidates as ONE megabatched launch (a config-axis sweep), so
+    a run consumes exactly ``generations`` device launches.  ``clip``
+    is an optional ``(lo, hi)`` box the iterates project into."""
+    theta = np.asarray(theta0, np.float64).copy()
+    best_hist, mean_hist = [], []
+    launches = 0
+    for g in range(int(generations)):
+        eps = _gen_eps(key, g, (int(pop), theta.shape[0]))
+        cand = np.concatenate(
+            [theta[None, :] + sigma * eps, theta[None, :] - sigma * eps]
+        )
+        if clip is not None:
+            cand = np.clip(cand, clip[0], clip[1])
+        f = np.asarray(evaluate(cand), np.float64)
+        launches += 1
+        if f.shape != (2 * pop,):
+            raise ValueError(
+                f"evaluate returned shape {f.shape}, wanted {(2 * pop,)}"
+            )
+        adv = f[:pop] - f[pop:]
+        step = (adv[:, None] * eps).sum(axis=0) * (
+            lr / (2.0 * pop * sigma)
+        )
+        theta = theta + (step if maximize else -step)
+        if clip is not None:
+            theta = np.clip(theta, clip[0], clip[1])
+        best_hist.append(float(f.max() if maximize else f.min()))
+        mean_hist.append(float(f.mean()))
+    return ESResult(
+        theta=theta,
+        fitness=np.asarray(best_hist),
+        mean_fitness=np.asarray(mean_hist),
+        launches=launches,
+    )
+
+
+def fd_gradient(evaluate, theta, *, eps: float = 1e-3):
+    """Central finite differences over ONE batched evaluate call: 2D
+    probe points, ``(f(θ+εe_i) − f(θ−εe_i)) / 2ε`` — the
+    non-differentiable engines' gradient estimate, same megabatch
+    contract as :func:`es_search`."""
+    theta = np.asarray(theta, np.float64)
+    D = theta.shape[0]
+    probes = np.concatenate(
+        [theta[None, :] + eps * np.eye(D),
+         theta[None, :] - eps * np.eye(D)]
+    )
+    f = np.asarray(evaluate(probes), np.float64)
+    return (f[:D] - f[D:]) / (2.0 * eps)
+
+
+def descend_design(
+    grad_step,
+    theta0: dict,
+    *,
+    key,
+    steps: int = 60,
+    lr: float = 0.05,
+    opt: str = "adam",
+    runtime_key: tuple | None = None,
+    engine: str = "diff",
+):
+    """Gradient DESCENT on a design objective — a thin alias of the
+    calibration loop (:func:`tpudes.diff.calibrate.descend`) with the
+    convention that ``grad_step`` already negates a to-be-maximized
+    KPI.  Returns the :class:`~tpudes.diff.calibrate.CalibResult`."""
+    from tpudes.diff.calibrate import descend
+
+    return descend(
+        grad_step, theta0, steps=steps, lr=lr, key=key, opt=opt,
+        runtime_key=runtime_key, engine=engine,
+    )
+
+
+def bss_interval_design(
+    prog,
+    key,
+    replicas: int,
+    *,
+    generations: int = 6,
+    pop: int = 4,
+    sigma: float = 0.25,
+    lr: float = 0.4,
+    log_interval_bounds=(np.log(2_000.0), np.log(60_000.0)),
+    es_key=None,
+) -> ESResult:
+    """Optimize the per-STA offered CBR interval of a BSS cell for
+    decoded echo throughput — the ES-fallback worked example: θ is the
+    per-entity LOG interval (µs), each generation's 2P candidates ride
+    ONE ``traffic_sweep`` launch (cbr programs share a traffic shape
+    key, so the whole generation is a (C, R, …) program), fitness is
+    the replica-mean decoded echo count.  Entity 0 (the AP beacon)
+    keeps the program's own cadence.
+
+    ``prog`` must carry a cbr ``traffic`` program (the shape class the
+    sweep compiles); θ starts from its intervals.
+    """
+    import dataclasses
+
+    import jax
+
+    from tpudes.parallel.replicated import run_replicated_bss
+    from tpudes.traffic import TrafficProgram
+
+    if prog.traffic is None:
+        raise ValueError(
+            "bss_interval_design needs prog.traffic set (a cbr "
+            "TrafficProgram — the sweep's shape class)"
+        )
+    base = prog.traffic
+    theta0 = np.log(
+        np.maximum(np.asarray(base.interval_us, np.float64), 1.0)
+    )[1:]  # STAs only; entity 0 is the AP beacon
+
+    def evaluate(thetas):
+        points = []
+        for row in thetas:
+            # entity 0 (the AP beacon) keeps base.interval_us[0]; only
+            # the STA rows carry the candidate design
+            iv = np.asarray(base.interval_us, np.int64).copy()
+            iv[1:] = np.clip(
+                np.exp(row), 1.0, 2.0**30
+            ).astype(np.int64)
+            points.append(TrafficProgram.cbr(base.start_us, iv))
+        out = run_replicated_bss(
+            prog, replicas, key, traffic_sweep=points
+        )
+        return np.asarray(
+            [float(np.mean(p["srv_rx"])) for p in out], np.float64
+        )
+
+    return es_search(
+        evaluate,
+        theta0,
+        key=jax.random.fold_in(key, 0x5EA) if es_key is None else es_key,
+        generations=generations,
+        pop=pop,
+        sigma=sigma,
+        lr=lr,
+        maximize=True,
+        clip=log_interval_bounds,
+    )

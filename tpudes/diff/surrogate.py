@@ -1,0 +1,123 @@
+"""Smooth surrogates for the engines' hard decision points.
+
+The device engines are full of *quantizers*: the CQI ladder in
+``tpudes/ops/lte.py`` is a 16-step staircase over spectral efficiency,
+TB decoding thresholds a uniform coin against the BLER, the AS fluid
+engine clips per-link delivery at ``min(1, capacity/load)``.  Each one
+is exactly right for simulation and exactly wrong for ``jax.grad``:
+the derivative is zero (or undefined) almost everywhere, so a KPI loss
+sees a flat landscape.
+
+:class:`Surrogacy` is the one knob that swaps those hard points for
+temperature-controlled soft versions.  It is a **cache-key component,
+never a traced operand**: flipping the temperature (or turning the
+surrogate off) compiles a *different executable*, exactly like the
+``precision``/``pallas`` flags — the legacy program with
+``surrogate=None`` is bit-for-bit the pre-diff trace (pinned by
+tests/test_diff.py and the ``surrogate_off`` fuzz pair).
+
+Two blending modes:
+
+- ``ste=False`` — the forward value IS the soft version (sigmoid
+  staircases, softplus-smoothed min gates).  Finite-difference checks
+  of the gradients are exact against this forward, which is how the
+  FD test matrix pins every exposed operand.
+- ``ste=True`` — straight-through: the forward value is the HARD
+  legacy expression, bit-equal to ``surrogate=None`` (the
+  :func:`ste` identity ``hard + (soft - stop_gradient(soft))`` adds
+  an exact float zero), while the backward pass differentiates the
+  soft version.  Use it where forward exactness matters — calibrating
+  against KPIs the exact engine produced, or fuzz-pairing against the
+  legacy program.
+
+The helpers take the surrogate object duck-typed (``ops/`` must not
+import ``diff/``): any object with ``temp``/``gate_temp``/``ste``
+attributes and a ``blend`` method works.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = [
+    "Surrogacy",
+    "soft_sigmoid",
+    "soft_staircase",
+    "ste",
+]
+
+
+def ste(hard, soft):
+    """Straight-through blend: forward ``hard`` (bit-exact — the
+    correction term ``soft - stop_gradient(soft)`` is an exact float
+    zero), backward d(soft).  The hard path's own cotangent still
+    flows, which is correct for the engines' hard points: they are
+    piecewise-constant (staircases, threshold indicators), so their
+    a.e.-derivative is zero and the soft path is the only signal."""
+    import jax
+
+    return hard + (soft - jax.lax.stop_gradient(soft))
+
+
+def soft_sigmoid(x, temp: float):
+    """σ(x / temp) pinned f32 — the smooth step at temperature
+    ``temp`` (the JXL002 dtype discipline: no f64 under ambient x64)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.nn.sigmoid(jnp.asarray(x) / jnp.float32(temp))
+
+
+def soft_staircase(x, edges, heights, temp: float):
+    """Σ_k heights[k] · σ((x − edges[k]) / temp) — the smooth version
+    of the quantizer Σ_k heights[k] · 1[x ≥ edges[k]] (the CQI ladder,
+    the modulation-order ladder).  ``edges``/``heights`` are 1-D and
+    broadcast against ``x[..., None]``."""
+    import jax.numpy as jnp
+
+    e = jnp.asarray(edges, jnp.float32)
+    h = jnp.asarray(heights, jnp.float32)
+    return jnp.sum(
+        h * soft_sigmoid(x[..., None] - e, temp), axis=-1
+    )
+
+
+@dataclass(frozen=True)
+class Surrogacy:
+    """Temperature config for the soft surrogates — hashable, a cache-
+    key component of every program that honors it (never traced: a
+    temperature flip is a new executable, like a precision flip).
+
+    ``temp``       — staircase temperature in spectral-efficiency /
+                     CQI units (the LTE quantizer softness);
+    ``gate_temp``  — gate temperature in log-utilization units (the AS
+                     delivery min-gate and eligibility thresholds);
+    ``ste``        — straight-through: hard (bit-exact legacy) forward,
+                     soft backward.
+    """
+
+    temp: float = 0.08
+    gate_temp: float = 0.25
+    ste: bool = False
+
+    def key(self) -> tuple:
+        """The cache-key component (the ``shape_key`` analog)."""
+        return (
+            "surrogacy", float(self.temp), float(self.gate_temp),
+            bool(self.ste),
+        )
+
+    def blend(self, hard, soft):
+        """Combine the exact legacy expression with its soft twin per
+        the configured mode (see module docstring)."""
+        return ste(hard, soft) if self.ste else soft
+
+    def step(self, x, threshold=0.0):
+        """Soft indicator 1[x ≥ threshold] at ``gate_temp`` blended
+        with the hard comparison (the eligibility/reachability-mask
+        surrogate)."""
+        import jax.numpy as jnp
+
+        hard = (jnp.asarray(x) >= threshold).astype(jnp.float32)
+        soft = soft_sigmoid(jnp.asarray(x) - threshold, self.gate_temp)
+        return self.blend(hard, soft)

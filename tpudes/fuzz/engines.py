@@ -997,6 +997,15 @@ class AsFlowsFuzzer(EngineFuzzer):
             )
             if tp is not None:
                 prog = dataclasses.replace(prog, traffic=tp)
+            # ISSUE-15: "ste" compiles the straight-through surrogate
+            # program — forward pinned bit-equal to the legacy engine
+            # (pre-ISSUE-15 corpus configs lack the axis: off)
+            if cfg.get("surrogate", "off") == "ste":
+                from tpudes.diff.surrogate import Surrogacy
+
+                prog = dataclasses.replace(
+                    prog, surrogate=Surrogacy(ste=True)
+                )
             return prog
         finally:
             _reset_world()
@@ -1082,6 +1091,31 @@ class AsFlowsFuzzer(EngineFuzzer):
                         "lhs": host_frac, "rhs": float(frac[f])}
         return None
 
+    def _surrogate_off_pair(self, prog, cfg, canonical):
+        """ISSUE-15 exactness anchor: the straight-through surrogate
+        program (hard forward, soft backward) must match the legacy
+        (surrogate=None) engine bit for bit — generalized over the
+        whole envelope, whatever surrogate the config drew.  The
+        surrogate=None side IS the canonical run when the scenario
+        drew 'off' (reused, not recomputed)."""
+        import dataclasses
+
+        from tpudes.diff.surrogate import Surrogacy
+
+        off = canonical if prog.surrogate is None else self.run_scalar(
+            dataclasses.replace(prog, surrogate=None), cfg
+        )
+        ste = self.run_scalar(
+            dataclasses.replace(prog, surrogate=Surrogacy(ste=True)),
+            cfg,
+        )
+        return first_diff(off, ste)
+
+    def extra_pairs(self):
+        return super().extra_pairs() + [
+            ("surrogate_off", self._surrogate_off_pair)
+        ]
+
     def shrink_moves(self, cfg):
         out = super().shrink_moves(cfg)
         floors = self.envelope.floors
@@ -1089,6 +1123,10 @@ class AsFlowsFuzzer(EngineFuzzer):
             c = _shrink_int(cfg, name, floors.get(name, 1))
             if c:
                 out.append((f"halve {name}", c))
+        if cfg.get("surrogate", "off") != "off":
+            c = _shrink_choice(cfg, "surrogate", "off")
+            if c:
+                out.append(("surrogate -> off", c))
         return out
 
 

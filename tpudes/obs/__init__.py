@@ -40,6 +40,7 @@ from tpudes.obs.export import (
 )
 from tpudes.obs.flight_recorder import FlightRecorder
 from tpudes.obs.fuzz import FuzzTelemetry, validate_fuzz_metrics
+from tpudes.obs.grad import GradTelemetry, validate_grad_metrics
 from tpudes.obs.profiler import (
     HostProfiler,
     InstrumentedScheduler,
@@ -57,6 +58,8 @@ __all__ = [
     "DistributedTelemetry",
     "FlightRecorder",
     "FuzzTelemetry",
+    "GradTelemetry",
+    "validate_grad_metrics",
     "HostProfiler",
     "InstrumentedScheduler",
     "RunStats",

@@ -8,6 +8,7 @@ Usage::
     python -m tpudes.obs --distributed <metrics.json> [more.json ...]
     python -m tpudes.obs --geometry <metrics.json> [more.json ...]
     python -m tpudes.obs --traffic <metrics.json> [more.json ...]
+    python -m tpudes.obs --grad <metrics.json> [more.json ...]
 
 Default mode checks Chrome-trace exports against the Trace Event
 format; ``--serving`` checks :class:`tpudes.obs.serving.ServingTelemetry`
@@ -21,7 +22,10 @@ geometry-refresh schema (device recomputes vs host refreshes, stride
 hit rate); ``--traffic`` checks
 :class:`tpudes.obs.traffic.TrafficTelemetry` snapshot dumps against
 the workload schema (offered vs delivered load, per-model launch
-counts, burst duty cycle).  Exit 0 when every
+counts, burst duty cycle); ``--grad`` checks
+:class:`tpudes.obs.grad.GradTelemetry` snapshot dumps against the
+gradient schema (grad-norm/loss rings, descent step counters,
+non-finite canaries).  Exit 0 when every
 file is valid, 1 on
 violations, 2 on usage / unreadable input.  These are the schema gates
 the CI smoke steps run over the artifacts an example (``TpudesObs=1``),
@@ -37,6 +41,7 @@ from tpudes.obs.distributed import validate_distributed_metrics
 from tpudes.obs.export import validate_chrome_trace
 from tpudes.obs.fuzz import validate_fuzz_metrics
 from tpudes.obs.geometry import validate_geometry_metrics
+from tpudes.obs.grad import validate_grad_metrics
 from tpudes.obs.serving import validate_serving_metrics
 from tpudes.obs.traffic import validate_traffic_metrics
 
@@ -48,14 +53,15 @@ def main(argv: list[str] | None = None) -> int:
     distributed = "--distributed" in argv
     geometry = "--geometry" in argv
     traffic = "--traffic" in argv
+    grad = "--grad" in argv
     argv = [
         a for a in argv
         if a not in ("--serving", "--fuzz", "--distributed",
-                     "--geometry", "--traffic")
+                     "--geometry", "--traffic", "--grad")
     ]
     if (
         not argv
-        or serving + fuzz + distributed + geometry + traffic > 1
+        or serving + fuzz + distributed + geometry + traffic + grad > 1
         or any(a in ("-h", "--help") for a in argv)
     ):
         print(__doc__, file=sys.stderr)
@@ -70,6 +76,8 @@ def main(argv: list[str] | None = None) -> int:
         validate, kind = validate_geometry_metrics, "geometry metrics"
     elif traffic:
         validate, kind = validate_traffic_metrics, "traffic metrics"
+    elif grad:
+        validate, kind = validate_grad_metrics, "gradient metrics"
     else:
         validate, kind = validate_chrome_trace, "Chrome trace"
     rc = 0
@@ -92,7 +100,7 @@ def main(argv: list[str] | None = None) -> int:
                 n = doc["counters"]["scenarios"]
             elif distributed:
                 n = doc["counters"]["windows"]
-            elif geometry or traffic:
+            elif geometry or traffic or grad:
                 n = len(doc["engines"])
             else:
                 n = len(doc["traceEvents"])

@@ -145,7 +145,7 @@ def tti_sinr_py(tx_psd_w, gain, serving, noise_psd):
 # --- CQI -------------------------------------------------------------------
 
 
-def cqi_from_sinr(sinr: jax.Array, dtype=None) -> jax.Array:
+def cqi_from_sinr(sinr: jax.Array, dtype=None, surrogate=None) -> jax.Array:
     """Wideband CQI from mean per-RB SINR: spectral efficiency
     log2(1 + SINR/Γ) mapped to the highest CQI the efficiency supports
     (lte-amc CreateCqiFeedbacks, PiroEW2010 mapping).
@@ -155,12 +155,96 @@ def cqi_from_sinr(sinr: jax.Array, dtype=None) -> jax.Array:
     transcendental and the table comparison stay f32 — the engine's
     compute-in-low/accumulate-in-f32 policy.  The CQI error budget this
     buys is at most ±1 index at efficiency-boundary SINRs
-    (tests/test_ops_lte_kernels.py pins it)."""
+    (tests/test_ops_lte_kernels.py pins it).
+
+    ``surrogate`` (a :class:`tpudes.diff.Surrogacy`, duck-typed — ops
+    never imports diff) replaces the 16-level comparison staircase
+    with a temperature-controlled sigmoid sum so ``jax.grad`` sees a
+    smooth CQI; the return becomes FLOAT (soft index, or the hard
+    index straight-through when ``surrogate.ste``).  ``surrogate=None``
+    is the identical legacy integer program."""
     x = sinr if dtype is None else sinr.astype(dtype)
     se = jnp.log2((1.0 + x / SNR_GAP).astype(jnp.float32))
     # highest cqi with efficiency <= se
     eff = jnp.asarray(_CQI_EFF)                            # (16,)
-    return jnp.sum((eff[None, :] <= se[..., None]) & (eff[None, :] > 0.0), axis=-1)
+    if surrogate is None:
+        return jnp.sum(
+            (eff[None, :] <= se[..., None]) & (eff[None, :] > 0.0), axis=-1
+        )
+    hard = jnp.sum(
+        ((eff[None, :] <= se[..., None]) & (eff[None, :] > 0.0)).astype(
+            jnp.float32
+        ),
+        axis=-1,
+    )
+    from tpudes.diff.surrogate import soft_staircase  # lazy: diff is optional
+
+    soft = soft_staircase(
+        se, _CQI_EFF[1:], _np.ones(15, _np.float32), surrogate.temp
+    )
+    return surrogate.blend(hard, soft)
+
+
+def eff_from_sinr(sinr: jax.Array, surrogate=None) -> jax.Array:
+    """Quantized spectral efficiency (bits/RE) the CQI ladder grants at
+    this SINR: ``CQI_EFFICIENCY[cqi_from_sinr(sinr)]`` written as a
+    staircase so a surrogate can smooth it — the hard point of the
+    SINR→CQI→MCS→rate chain the diff engines differentiate through.
+    ``surrogate=None`` keeps the exact staircase (zero gradient a.e.)."""
+    se = jnp.log2(1.0 + sinr / SNR_GAP)
+    steps = _CQI_EFF[1:] - _CQI_EFF[:-1]                   # (15,)
+    hard = jnp.sum(
+        steps * (se[..., None] >= _CQI_EFF[1:]).astype(jnp.float32),
+        axis=-1,
+    )
+    if surrogate is None:
+        return hard
+    from tpudes.diff.surrogate import soft_staircase
+
+    soft = soft_staircase(se, _CQI_EFF[1:], steps, surrogate.temp)
+    return surrogate.blend(hard, soft)
+
+
+#: modulation-order ladder anchors: the granted efficiency at which Qm
+#: steps 2→4 (first 16-QAM MCS) and 4→6 (first 64-QAM MCS)
+_QM_EDGES = _np.array(
+    [MCS_EFFICIENCY[10], MCS_EFFICIENCY[17]], dtype=_np.float32
+)
+
+
+def qm_from_eff(eff: jax.Array, surrogate=None) -> jax.Array:
+    """Modulation order from granted spectral efficiency: the 2/4/6
+    staircase at the 16-QAM/64-QAM boundary efficiencies (the
+    ``MCS_QM`` ladder as a function of efficiency instead of an
+    integer MCS gather, so the diff chain can smooth it)."""
+    steps = _np.array([2.0, 2.0], _np.float32)
+    hard = 2.0 + jnp.sum(
+        steps * (eff[..., None] >= _QM_EDGES).astype(jnp.float32), axis=-1
+    )
+    if surrogate is None:
+        return hard
+    from tpudes.diff.surrogate import soft_staircase
+
+    soft = 2.0 + soft_staircase(eff, _QM_EDGES, steps, surrogate.temp)
+    return surrogate.blend(hard, soft)
+
+
+def decode_ok(coin: jax.Array, bler: jax.Array, surrogate=None) -> jax.Array:
+    """TB decode indicator: the hard threshold ``coin >= bler`` (what
+    :func:`tti_phy_step` wires in — bit-identical legacy trace at
+    ``surrogate=None``), or its temperature-smoothed sigmoid so a
+    SAMPLED-decode diff program keeps gradients flowing through the
+    BLER waterfall instead of dying at the comparison.  (The
+    expected-KPI chain in :mod:`tpudes.diff.lte_grad` needs no coin at
+    all — its decode expectation is ``1 − BLER``.)  Returns bool when
+    ``surrogate=None``, f32 in [0, 1] otherwise."""
+    if surrogate is None:
+        return coin >= bler
+    hard = (coin >= bler).astype(jnp.float32)
+    from tpudes.diff.surrogate import soft_sigmoid
+
+    soft = soft_sigmoid(coin - bler, surrogate.gate_temp)
+    return surrogate.blend(hard, soft)
 
 
 def cqi_from_sinr_py(sinr: float) -> int:
@@ -290,7 +374,7 @@ def tti_phy_step(
     bler = tb_bler(mi_new, mcs, tb_bits_)
     coin = jax.random.uniform(key, bler.shape)
     has_tb = tb_bits_ > 0.0
-    ok = has_tb & (coin >= bler)
+    ok = has_tb & decode_ok(coin, bler)
     ref_sinr = tti_sinr(
         ref_psd_w, gain if ref_gain is None else ref_gain, serving, noise_psd
     )

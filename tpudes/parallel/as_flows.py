@@ -58,6 +58,10 @@ FUZZ_ENVELOPE = FuzzEnvelope(
         "traffic": ("choice", ("off", "cbr", "mmpp", "onoff", "trace")),
         "tr_burst": ("float", 0.1, 0.6),
         "tr_phase": ("float", 0.0, 1.0),
+        # ISSUE-15 surrogate draws (appended): "ste" compiles the
+        # straight-through surrogate program, whose FORWARD is pinned
+        # bit-equal to the legacy engine (the surrogate_off pair)
+        "surrogate": ("choice", ("off", "ste")),
     },
     floors={"replicas": 1, "n_nodes": 8, "n_flows": 1},
     doc="BRITE BA AS topology, sparse CBR flows, fluid outcome model",
@@ -93,6 +97,15 @@ class AsFlowsProgram:
     #: enters the runner cache key; the horizon rides as a traced
     #: operand (``sim_s`` itself stays out of the key).
     traffic: object = None
+    #: smooth-surrogate config (:class:`tpudes.diff.Surrogacy`): None =
+    #: the identical legacy program (bit-equal trace, same runner —
+    #: the ``surrogate_off`` contract).  With a config, the fluid
+    #: delivery min-gate is temperature-smoothed (straight-through
+    #: when ``ste``: hard bit-exact forward, soft backward) so
+    #: ``jax.grad`` flows through the fixed point.  A CACHE-KEY
+    #: component, never a traced operand — a temperature flip compiles
+    #: a distinct executable, like a precision flip.
+    surrogate: object = None
 
 
 class UnliftableAsError(ValueError):
@@ -284,6 +297,85 @@ def _walk_paths(prog: AsFlowsProgram, ddst, nh_edge, nh_node):
 #: ≤k-th-hop links exactly in round k)
 FP_ROUNDS = 4
 
+
+def _fluid_pad(x):
+    """Append the sentinel column hop-index E2 writes into (the
+    done-hop landfill)."""
+    return jnp.concatenate(
+        [x, jnp.zeros((x.shape[0], 1), x.dtype)], axis=1
+    )
+
+
+def _fluid_round(prog: AsFlowsProgram, path, hs, rate, cap2, lfrac_link):
+    """ONE fluid fixed-point round — the walk/load/delivery core shared
+    by the while-loop runner (:func:`build_as_run`) and the
+    differentiable scan runner (:func:`build_as_diff`), so the two can
+    never drift.  A link's load is the SURVIVING rate of each
+    transiting flow at that hop (loss upstream attenuates load
+    downstream).  ``prog.surrogate`` (None = the exact legacy
+    min-gate, bit-identical trace) smooths the per-link delivery clip
+    ``min(1, cap/load)`` into a softplus gate in the log domain —
+    straight-through (hard bit-exact forward) when ``surrogate.ste``.
+    """
+    R, F = rate.shape
+    E2 = cap2.shape[0]
+
+    def walk(c, h):
+        lg, load = c
+        e_h = path[:, h]                       # (F,)
+        load = load.at[:, e_h].add(rate * jnp.exp(lg))
+        lg = lg + lfrac_link[:, e_h]
+        return (lg, load), None
+
+    (lg, load), _ = jax.lax.scan(
+        walk,
+        (jnp.zeros((R, F), jnp.float32),
+         jnp.zeros((R, E2 + 1), jnp.float32)),
+        hs,
+    )
+    util = load[:, :E2] / cap2[None, :]
+    hard = _fluid_pad(
+        jnp.log(jnp.minimum(1.0, 1.0 / jnp.maximum(util, 1e-9)))
+    )
+    sur = prog.surrogate
+    if sur is None:
+        new_lfrac = hard
+    else:
+        # log-domain delivery: hard is -relu(log util); the soft gate
+        # is the softplus smoothing at gate_temp (dtypes pinned f32 —
+        # JXL002)
+        t = jnp.float32(sur.gate_temp)
+        soft = _fluid_pad(
+            -jax.nn.softplus(
+                jnp.log(jnp.maximum(util, jnp.float32(1e-9))) / t
+            )
+            * t
+        )
+        new_lfrac = sur.blend(hard, soft)
+    return new_lfrac, lg, util
+
+
+def _fluid_delay(prog: AsFlowsProgram, path, hs, util, cap2, dly2):
+    """M/M/1 queue + serialization + propagation delay accumulated
+    along each flow's path from the settled utilizations (shared by
+    both runners, like :func:`_fluid_round`)."""
+    R = util.shape[0]
+    F = path.shape[0]
+    rho = jnp.minimum(util, 0.99)
+    q_delay = (
+        rho / (1.0 - rho) * (8.0 * prog.pkt_bytes / cap2)[None, :]
+    )
+    serial = (8.0 * prog.pkt_bytes / cap2)[None, :]
+    ldel = _fluid_pad(q_delay + serial + dly2[None, :])
+
+    def acc_hop(dl, h):
+        return dl + ldel[:, path[:, h]], None
+
+    dl, _ = jax.lax.scan(
+        acc_hop, jnp.zeros((R, F), jnp.float32), hs
+    )
+    return dl
+
 #: result keys carrying a leading replica axis (sliced back after
 #: bucket padding); hops/unreachable are per-flow statics
 _AS_R_LEAD = ("goodput_bps", "delay_s", "delivered_frac", "max_util")
@@ -309,6 +401,10 @@ def as_prog_key(prog: AsFlowsProgram) -> tuple:
         prog.spf_rounds, prog.rate_jitter, prog.spf_metric,
         # workload SHAPE only — the model id and params are traced
         None if prog.traffic is None else prog.traffic.shape_key(),
+        # the surrogate config is a cache-key component, never traced:
+        # a temperature/ste flip selects different arithmetic, i.e. a
+        # different executable (the precision-flag pattern)
+        None if prog.surrogate is None else prog.surrogate.key(),
     )
 
 
@@ -376,9 +472,6 @@ def build_as_run(prog: AsFlowsProgram, r_pad: int, n_cfg: int | None = None,
     ).astype(jnp.float32)
     fbps = jnp.asarray(prog.flow_bps, jnp.float32)
     R, F, H = r_pad, len(prog.src), prog.max_hops
-    pad = lambda x: jnp.concatenate(  # noqa: E731
-        [x, jnp.zeros((R, 1), x.dtype)], axis=1
-    )
     hs = jnp.arange(H, dtype=jnp.int32)
 
     def topo():
@@ -400,54 +493,18 @@ def build_as_run(prog: AsFlowsProgram, r_pad: int, n_cfg: int | None = None,
         )
         rate = jnp.where(reached[None, :], rate, 0.0)
 
-        # fluid fixed point: a link's load is the SURVIVING rate of
-        # each transiting flow at that hop (loss upstream attenuates
-        # load downstream)
-        def one_round(lfrac_link):
-            # walk: per-flow surviving rate entering each hop, and
-            # accumulate this round's per-link loads
-            def walk(c, h):
-                lg, load = c
-                e_h = path[:, h]                       # (F,)
-                load = load.at[:, e_h].add(rate * jnp.exp(lg))
-                lg = lg + lfrac_link[:, e_h]
-                return (lg, load), None
-
-            (lg, load), _ = jax.lax.scan(
-                walk,
-                (jnp.zeros((R, F), jnp.float32),
-                 jnp.zeros((R, E2 + 1), jnp.float32)),
-                hs,
-            )
-            util = load[:, :E2] / cap[None, :]
-            new_lfrac = pad(
-                jnp.log(jnp.minimum(1.0, 1.0 / jnp.maximum(util, 1e-9)))
-            )
-            return new_lfrac, lg, util
-
+        # fluid fixed point: the round/delay cores are module-level
+        # (shared with the differentiable runner, see _fluid_round)
         def body(c):
             i, lf, _, _ = c
-            lf2, lg2, util2 = one_round(lf)
+            lf2, lg2, util2 = _fluid_round(prog, path, hs, rate, cap, lf)
             return i + 1, lf2, lg2, util2
 
         i, lfrac, lg, util = jax.lax.while_loop(
             lambda c: c[0] < rounds_end, body, carry
         )
 
-        # M/M/1 queue delay along each path from the settled utils
-        rho = jnp.minimum(util, 0.99)
-        q_delay = (
-            rho / (1.0 - rho) * (8.0 * prog.pkt_bytes / cap)[None, :]
-        )
-        serial = (8.0 * prog.pkt_bytes / cap)[None, :]
-        ldel = pad(q_delay + serial + dly[None, :])
-
-        def acc_hop(dl, h):
-            return dl + ldel[:, path[:, h]], None
-
-        dl, _ = jax.lax.scan(
-            acc_hop, jnp.zeros((R, F), jnp.float32), hs
-        )
+        dl = _fluid_delay(prog, path, hs, util, cap, dly)
         frac = jnp.where(reached[None, :], jnp.exp(lg), 0.0)
         outputs = dict(
             goodput_bps=rate * frac,
@@ -486,6 +543,91 @@ def build_as_run(prog: AsFlowsProgram, r_pad: int, n_cfg: int | None = None,
         return carry, outputs, metrics
 
     return run
+
+
+def build_as_diff(prog: AsFlowsProgram, r_pad: int):
+    """The DIFFERENTIABLE AS runner (``tpudes.diff.grad_as_flows``):
+    the same fluid round/delay cores as :func:`build_as_run`
+    (:func:`_fluid_round` / :func:`_fluid_delay`), restructured for
+    ``jax.grad``:
+
+    - the fixed-point ``while_loop`` becomes a fixed-length
+      ``lax.scan`` over :data:`FP_ROUNDS` (reverse-mode autodiff
+      cannot differentiate a ``while_loop``; the legacy runner runs
+      exactly FP_ROUNDS rounds, so the forward values are BIT-EQUAL —
+      pinned in tests/test_diff.py);
+    - per-flow nominal rates (``fbps``) and per-edge link capacities
+      (``cap_bps``) are lifted from build-time closures to TRACED
+      OPERANDS, the runtime operands KPI losses differentiate w.r.t.;
+    - unreachable flows report ``delay_s`` 0 instead of inf (an inf
+      would poison every gradient through the loss), with the
+      ``reached`` mask returned so losses can weight it back in.
+
+    Forward-equality contract (tests/test_diff.py):
+    goodput/delivered_frac are BIT-equal to :func:`run_as_flows`;
+    utilization/delay agree to ≤1 ULP — lifting the capacities from a
+    baked constant to a traced operand changes how XLA
+    strength-reduces the per-link division (constant divisors compile
+    to reciprocal multiplies).
+
+    ``diff_run(z, scale, fbps, cap_bps, tr, horizon_us) -> outputs``.
+    """
+    TRAFFIC = prog.traffic is not None
+    if TRAFFIC:
+        from tpudes.traffic.device import avg_mult
+
+        mult_fn = avg_mult(prog.traffic)
+    F = len(prog.src)
+    hs = jnp.arange(prog.max_hops, dtype=jnp.int32)
+    dly = jnp.concatenate(
+        [jnp.asarray(prog.delay_s), jnp.asarray(prog.delay_s)]
+    ).astype(jnp.float32)
+
+    def diff_run(z, scale, fbps, cap_bps, tr=None, horizon_us=None):
+        ddst, dist, nh_edge, nh_node = device_spf(prog)
+        path, hops, arrived = _walk_paths(prog, ddst, nh_edge, nh_node)
+        reached = (
+            dist[ddst, jnp.asarray(prog.src)] < INF
+        ) & arrived
+        mult = (
+            mult_fn(tr, horizon_us) if TRAFFIC
+            else jnp.ones((F,), jnp.float32)
+        )
+        cap2 = jnp.concatenate([cap_bps, cap_bps]).astype(jnp.float32)
+        rate = fbps[None, :] * mult[None, :] * scale * jnp.exp(
+            prog.rate_jitter * z - 0.5 * prog.rate_jitter**2
+        )
+        rate = jnp.where(reached[None, :], rate, 0.0)
+        E2 = cap2.shape[0]
+        F_ = rate.shape[1]
+        carry0 = (
+            jnp.zeros((r_pad, E2 + 1), jnp.float32),
+            jnp.zeros((r_pad, F_), jnp.float32),
+            jnp.zeros((r_pad, E2), jnp.float32),
+        )
+
+        # carry (lfrac, lg, util) exactly like the while-loop runner's
+        # carry tail, so the final values are the same buffers (a
+        # stacked-ys slice would cost a ULP on the max reduction)
+        def body(c, _):
+            lf, _, _ = c
+            lf2, lg2, util2 = _fluid_round(prog, path, hs, rate, cap2, lf)
+            return (lf2, lg2, util2), None
+
+        (_, lg, util), _ = jax.lax.scan(
+            body, carry0, None, length=FP_ROUNDS
+        )
+        dl = _fluid_delay(prog, path, hs, util, cap2, dly)
+        frac = jnp.where(reached[None, :], jnp.exp(lg), 0.0)
+        return dict(
+            goodput_bps=rate * frac,
+            delay_s=jnp.where(reached[None, :], dl, 0.0),
+            delivered_frac=frac,
+            max_util=util.max(axis=1),
+            reached=reached.astype(jnp.float32),
+        )
+
+    return diff_run
 
 
 def _as_replica_draws(prog: AsFlowsProgram, key, r_pad: int):
@@ -700,6 +842,12 @@ def _flip_traffic():
     return TrafficProgram.onoff(2, 300.0, horizon_us=1_000_000)
 
 
+def _flip_surrogacy():
+    from tpudes.diff.surrogate import Surrogacy
+
+    return Surrogacy(ste=False)
+
+
 def _trace_flips():
     import dataclasses
 
@@ -728,6 +876,12 @@ def _trace_flips():
         # a workload program joins the trace (the fluid multiplier) and
         # its SHAPE key joins the cache key
         "traffic": flip(traffic=_flip_traffic()),
+        # ISSUE-15: the surrogate config swaps the delivery min-gate
+        # for the soft version — different arithmetic, different
+        # executable, so it must be a cache-key component (and None
+        # must compile the identical legacy trace, which JXL004 checks
+        # by this flip being key_differs AND trace-differs)
+        "surrogate": flip(surrogate=_flip_surrogacy()),
         # sim_s is excluded by design: the fluid fixed point has no
         # time horizon, so flipping it must leave the trace identical
         "sim_s": flip(sim_s=9.0),

@@ -179,6 +179,17 @@ class LteSmProgram:
     #: the full-buffer path (the ``traffic_off`` fuzz pair).
     traffic: object = None
 
+    # ISSUE-15 note — the DIFFERENTIABLE seam of this engine lives in
+    # :mod:`tpudes.diff.lte_grad`: ``grad_lte_sm(prog, ...)`` consumes
+    # the same program fields (gain/serving/powers, and for positional
+    # gradients ``enb_pos``/``pathloss`` + the PR-10 mobility
+    # operands) through the closed-form per-TTI expectation built from
+    # the identical ``tpudes.ops.lte`` kernels, with a
+    # :class:`tpudes.diff.Surrogacy` smoothing the staircase points.
+    # The run path here stays integer-exact by construction — it IS
+    # the straight-through forward — so no surrogate flag rides this
+    # dataclass (nothing in the compiled program would change).
+
     @property
     def n_enb(self) -> int:
         return int(self.gain.shape[0])

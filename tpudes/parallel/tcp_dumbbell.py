@@ -1100,11 +1100,19 @@ def dumbbell_prog_key(prog: DumbbellProgram) -> tuple:
 
 
 def build_dumbbell_advance(prog: DumbbellProgram, r_pad: int,
-                           obs: bool = False, n_cfg: int | None = None):
+                           obs: bool = False, n_cfg: int | None = None,
+                           sweep: str = "variant"):
     """``(init_state, fn)`` with ``fn(carry, key, var, ecn, t_end)``
     the UNJITTED advance exactly as :func:`run_tcp_dumbbell` jits it —
     factored out so the trace manifest (:func:`trace_manifest`)
-    abstractly traces the same program the runner cache compiles."""
+    abstractly traces the same program the runner cache compiles.
+
+    With a config axis (``n_cfg``), ``sweep`` picks which operand
+    carries it: ``"variant"`` vmaps the per-flow variant/ECN
+    assignment (the PR-5 sweep), ``"traffic"`` vmaps the workload
+    operand tables instead (ISSUE-15: the BSS ``traffic_sweep`` seam
+    mirrored — var/ecn are shared across points, the (C, …) traffic
+    tables fan out)."""
     init_state, step_fn = build_dumbbell_step(prog, r_pad, obs=obs)
 
     def advance(carry, key, var, ecn, t_end, tr=None):
@@ -1137,7 +1145,10 @@ def build_dumbbell_advance(prog: DumbbellProgram, r_pad: int,
 
     fn = advance
     if n_cfg is not None:
-        fn = jax.vmap(fn, in_axes=(0, None, 0, 0, None, None))
+        if sweep == "traffic":
+            fn = jax.vmap(fn, in_axes=(0, None, None, None, None, 0))
+        else:
+            fn = jax.vmap(fn, in_axes=(0, None, 0, 0, None, None))
     return init_state, fn
 
 
@@ -1289,6 +1300,7 @@ def run_tcp_dumbbell(
     mesh=None,
     *,
     variants=None,
+    traffic_sweep=None,
     chunk_slots: int | None = None,
     checkpoint=None,
     block: bool = True,
@@ -1307,6 +1319,15 @@ def run_tcp_dumbbell(
     (C, R, F) program, returning a list of per-point result dicts equal
     to what ``dataclasses.replace(prog, variant_idx=point,
     ecn=REQUIRES_ECN(point))`` per-point launches (same key) produce.
+
+    ``traffic_sweep=[...]`` (TrafficPrograms sharing one
+    ``shape_key``, with ``prog.traffic`` naming the shape class) runs
+    a **config-axis workload sweep** instead (ISSUE-15, mirroring the
+    BSS seam): the traffic operand tables gain the leading vmapped
+    axis while the variant/ECN assignment is shared, so a C-point
+    mixed cbr/mmpp/onoff/trace workload study is ONE launch of a
+    (C, R, F) program — demuxed bit-equal to per-point launches with
+    ``dataclasses.replace(prog, traffic=tp)`` and the same key.
 
     ``chunk_slots=N`` splits the horizon into N-slot segments with a
     donated carry handoff (bit-identical to single-shot; per-chunk
@@ -1331,15 +1352,27 @@ def run_tcp_dumbbell(
         unstack_points,
     )
 
+    if variants is not None and traffic_sweep is not None:
+        raise ValueError(
+            "one config axis per launch: sweep either the variant "
+            "assignment (variants=[...]) or the workload "
+            "(traffic_sweep=[...])"
+        )
     obs = device_metrics_enabled()
     r_pad = bucket_replicas(replicas, mesh)
-    n_cfg = None if variants is None else len(variants)
-    # see dumbbell_prog_key for what is (deliberately) absent
-    ck = dumbbell_prog_key(prog) + (r_pad, obs, n_cfg)
+    sweep = "traffic" if traffic_sweep is not None else "variant"
+    n_cfg = (
+        len(variants) if variants is not None
+        else (len(traffic_sweep) if traffic_sweep is not None else None)
+    )
+    # see dumbbell_prog_key for what is (deliberately) absent; the
+    # sweep KIND is a cache-key component (the two sweeps vmap
+    # different operands — different executables)
+    ck = dumbbell_prog_key(prog) + (r_pad, obs, n_cfg, sweep)
 
     def build():
         init_state, fn = build_dumbbell_advance(
-            prog, r_pad, obs=obs, n_cfg=n_cfg
+            prog, r_pad, obs=obs, n_cfg=n_cfg, sweep=sweep
         )
         return init_state, jax.jit(fn, donate_argnums=donate_argnums(0))
 
@@ -1361,8 +1394,14 @@ def run_tcp_dumbbell(
                     f"each sweep point assigns all {prog.n_flows} flows "
                     f"(got shape {p.shape})"
                 )
-    var = jnp.asarray(points[0] if n_cfg is None else np.stack(points))
-    ecn = jnp.asarray(ecns[0] if n_cfg is None else np.stack(ecns))
+    var = jnp.asarray(
+        points[0] if n_cfg is None or sweep == "traffic"
+        else np.stack(points)
+    )
+    ecn = jnp.asarray(
+        ecns[0] if n_cfg is None or sweep == "traffic"
+        else np.stack(ecns)
+    )
 
     carry = (jnp.int32(0), init_state())
     carry = stack_axis(carry, n_cfg)
@@ -1372,14 +1411,30 @@ def run_tcp_dumbbell(
 
     # workload params ride as TRACED operands (None = the bulk path);
     # the runner cache key above carries only the traffic shape key
-    tr = None if prog.traffic is None else prog.traffic.operands()
+    if traffic_sweep is not None:
+        from tpudes.traffic.device import stack_traffic_operands
+
+        if prog.traffic is None or any(
+            tp.shape_key() != prog.traffic.shape_key()
+            for tp in traffic_sweep
+        ):
+            raise ValueError(
+                "a workload sweep needs prog.traffic set and every "
+                "point sharing its traffic shape key (one executable "
+                "serves the sweep; pad tables to a common capacity)"
+            )
+        tr = stack_traffic_operands(traffic_sweep)
+    else:
+        tr = None if prog.traffic is None else prog.traffic.operands()
     ckpt = checkpoint_ctx(
         checkpoint, engine="dumbbell", key=key, replicas=replicas,
         r_pad=r_pad, n_cfg=n_cfg, obs=obs,
         axis=0 if n_cfg is None else 1, mesh=mesh,
         extra=dumbbell_prog_key(prog)
         + (tuple(tuple(int(i) for i in p) for p in points),
-           None if prog.traffic is None else prog.traffic.param_key()),
+           None if prog.traffic is None else prog.traffic.param_key(),
+           None if traffic_sweep is None
+           else tuple(tp.param_key() for tp in traffic_sweep)),
     )
     with CompileTelemetry.timed("dumbbell", compiling):
         carry, flush = drive_chunks(

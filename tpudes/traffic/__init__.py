@@ -11,9 +11,17 @@ id under the ``fold_in(key, replica, entity, t)`` keying discipline.
   kernels the engines trace (and their JXL trace manifest);
 - :mod:`tpudes.traffic.host` — numpy mirrors for parity tests and
   telemetry (the upstream ``src/applications`` host apps live in
-  :mod:`tpudes.models.applications`).
+  :mod:`tpudes.models.applications`);
+- :mod:`tpudes.traffic.ingest` — measured-trace ingestion (pcap/CSV →
+  compressed exact-replay tables, ISSUE-15).
 """
 
+from tpudes.traffic.ingest import (
+    TraceIngestError,
+    ingest_traces,
+    read_csv_trace,
+    read_pcap,
+)
 from tpudes.traffic.program import (
     TRAFFIC_MODEL_IDS,
     TrafficProgram,
@@ -25,7 +33,11 @@ from tpudes.traffic.program import (
 
 __all__ = [
     "TRAFFIC_MODEL_IDS",
+    "TraceIngestError",
     "TrafficProgram",
+    "ingest_traces",
+    "read_csv_trace",
+    "read_pcap",
     "bounded_pareto_icdf",
     "bounded_pareto_mean",
     "traffic_tables",
