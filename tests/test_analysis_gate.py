@@ -11,6 +11,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -62,7 +64,7 @@ def test_list_rules_covers_every_pass():
     assert proc.returncode == 0
     for code in ("JP001", "RNG001", "DET001", "EVT001", "REG001", "LNT001",
                  "TRC001", "KEY001", "JXL001", "JXL002", "JXL003", "JXL004",
-                 "JXL005"):
+                 "JXL005", "JXL006", "JXL007", "JXL008"):
         assert code in proc.stdout
 
 
@@ -216,6 +218,166 @@ def test_ast_cache_invalidates_on_content_change(tmp_path):
     payload = json.loads(second.stdout)
     assert second.returncode == 1
     assert any(f["code"] == "LNT005" for f in payload["findings"])
+
+
+def _tiny_proj(tmp_path):
+    proj = tmp_path / "proj"
+    (proj / "tpudes").mkdir(parents=True)
+    (proj / "tpudes" / "mod.py").write_text("x = 1\n")
+    (proj / "tests").mkdir()
+    (proj / "tests" / "t.py").write_text("y = 2\n")
+    return proj
+
+
+def _collect(proj):
+    from tpudes.analysis.engine import collect_modules
+
+    return collect_modules([proj / "tpudes", proj / "tests"], proj)
+
+
+def test_jaxpr_cache_key_tracks_modules_rules_and_tracer(
+    tmp_path, monkeypatch
+):
+    """ISSUE-16 satellite: the jaxpr section's key must move when a
+    traced tpudes/ module, the JXL pass family, or the jax install
+    changes — and must NOT move on test-file edits (retracing every
+    manifest because a test changed would make the cache useless)."""
+    from tpudes.analysis import cache as C
+
+    proj = _tiny_proj(tmp_path)
+    sha0 = C.AnalysisCache.jaxpr_sha(_collect(proj))
+
+    (proj / "tests" / "t.py").write_text("y = 3\n")
+    assert C.AnalysisCache.jaxpr_sha(_collect(proj)) == sha0
+
+    (proj / "tpudes" / "mod.py").write_text("x = 2\n")
+    sha1 = C.AnalysisCache.jaxpr_sha(_collect(proj))
+    assert sha1 != sha0
+
+    monkeypatch.setattr(C, "_jaxpr_rules_fp", "0" * 64)
+    assert C.AnalysisCache.jaxpr_sha(_collect(proj)) != sha1
+    monkeypatch.undo()
+
+    monkeypatch.setattr(C, "_jax_version", lambda: "999.0")
+    assert C.AnalysisCache.jaxpr_sha(_collect(proj)) != sha1
+
+
+def test_jaxpr_cache_section_roundtrips_and_resets_with_store(tmp_path):
+    from tpudes.analysis.base import Finding
+    from tpudes.analysis.cache import CACHE_VERSION, AnalysisCache
+
+    path = tmp_path / "cache.json"
+    cache = AnalysisCache(path)
+    f = Finding("tpudes/parallel/wired.py", 9, 1, "JXL007", "quadratic")
+    cache.put_jaxpr("abc", [f])
+    cache.save()
+
+    again = AnalysisCache(path)
+    served = again.get_jaxpr("abc")
+    assert served is not None and served[0].to_json() == f.to_json()
+    assert again.get_jaxpr("other-key") is None
+
+    # a rules-fingerprint mismatch drops the jaxpr section with the
+    # rest of the store
+    data = json.loads(path.read_text())
+    data["rules"] = "stale"
+    assert data["version"] == CACHE_VERSION
+    path.write_text(json.dumps(data))
+    assert AnalysisCache(path).get_jaxpr("abc") is None
+
+
+def test_engine_serves_and_invalidates_cached_jaxpr_findings(
+    tmp_path, monkeypatch
+):
+    """Cold run executes the JXL family and stores the findings; warm
+    run serves them without re-running; a tpudes/ edit re-runs; a
+    narrowed (--select) cold run never writes."""
+    import tpudes.analysis.jaxpr as jx
+    from tpudes.analysis import engine
+    from tpudes.analysis.base import Finding
+    from tpudes.analysis.cache import AnalysisCache
+
+    calls = []
+
+    class StubJaxprPass:
+        name = "stub-jaxpr"
+        codes = {"JXL999": "stub rule"}
+        project_wide = True
+
+        def check_project(self, mods):
+            calls.append(1)
+            return [Finding("tpudes/mod.py", 1, 1, "JXL999", "stub")]
+
+    monkeypatch.setattr(jx, "JAXPR_PASSES", (StubJaxprPass,))
+    proj = _tiny_proj(tmp_path)
+
+    def run(cache, **kw):
+        out = engine.run_passes(_collect(proj), jaxpr=True, cache=cache,
+                                **kw)
+        return [f for f in out if f.code == "JXL999"]
+
+    cache = AnalysisCache(tmp_path / "cache.json")
+    assert len(run(cache)) == 1 and len(calls) == 1
+    cache.save()
+
+    warm = AnalysisCache(tmp_path / "cache.json")
+    assert len(run(warm)) == 1
+    assert len(calls) == 1, "warm run must serve, not re-trace"
+
+    # selection narrows the output but still reads the cached set
+    assert run(warm, select=["LNT"]) == []
+    assert len(run(warm, select=["JXL"])) == 1
+    assert len(calls) == 1
+
+    (proj / "tpudes" / "mod.py").write_text("x = 2\n")
+    assert len(run(warm)) == 1
+    assert len(calls) == 2, "a tpudes/ edit must invalidate"
+
+    # a narrowed COLD run re-traces but must not poison the store
+    cold = AnalysisCache(tmp_path / "cache2.json")
+    assert len(run(cold, select=["JXL"])) == 1
+    assert len(calls) == 3
+    cold.save()
+    assert not (tmp_path / "cache2.json").exists()
+
+
+def test_jaxpr_warm_cache_analysis_is_subsecond():
+    """ISSUE-16 satellite: CI reruns the --jaxpr gate between rounds;
+    with the default cache warm it must answer in under a second (no
+    jax import, no manifest tracing).  The first run warms the cache
+    when a fresh checkout arrives cold."""
+    _run("--jaxpr")
+    warm = _run("--jaxpr", "--json")
+    assert warm.returncode == 0, warm.stdout + warm.stderr
+    payload = json.loads(warm.stdout)
+    assert payload["elapsed_s"] < 1.0, payload["elapsed_s"]
+
+
+def test_cost_requires_jaxpr():
+    proc = _run("--cost")
+    assert proc.returncode == 2
+    assert "--jaxpr" in proc.stderr
+
+
+@pytest.mark.slow
+def test_cost_report_cli_end_to_end(tmp_path):
+    """``--jaxpr --cost``: full-repo scale report with the wired
+    worklist, plus the JSON artifact CI uploads."""
+    out = tmp_path / "cost.json"
+    proc = _run("--jaxpr", "--cost", "--cost-out", str(out))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OVER BUDGET" in proc.stdout
+    assert "ROADMAP item 2" in proc.stdout
+    report = json.loads(out.read_text())
+    assert report["projection_nodes"] == [100000, 1000000]
+    assert "wired/advance:n_nodes" in report["worklist"]
+    assert "wired_space/advance:n_nodes" in report["worklist"]
+    by_axis = {
+        (r["engine"], r["axis"]): r for r in report["entries"]
+    }
+    wired_row = by_axis[("wired", "n_nodes")]
+    assert wired_row["mem_exponent"] >= 1.99
+    assert wired_row["projected"]["1e6_nodes"]["bytes"] > 0
 
 
 def test_write_baseline_without_jaxpr_refuses_to_drop_jxl_entries():

@@ -18,6 +18,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from tpudes.analysis.jaxpr import (  # noqa: E402
     FlipSpec,
+    ScaleAxis,
     TraceEntry,
     TraceManifest,
     TraceVariant,
@@ -416,10 +417,12 @@ def test_jxl005_proper_donated_carry_is_clean():
 # --- real-surface checks -----------------------------------------------------
 
 
-#: the four baselined-by-design findings (egress buffers are protocol-
-#: overwritten at every window start; dropping them from the input
-#: carry would break the carry-in == carry-out chunk-handoff shape)
-_EXPECTED_REAL = {"JXL005"}
+#: the baselined-by-design findings: four JXL005 egress buffers
+#: (protocol-overwritten at every window start; dropping them from the
+#: input carry would break the carry-in == carry-out chunk-handoff
+#: shape) plus the two JXL007 superlinear wired step kernels (the
+#: dense one-hot tables ROADMAP item 2 exists to replace)
+_EXPECTED_REAL = {"JXL005", "JXL007"}
 
 
 @pytest.mark.parametrize(
@@ -435,7 +438,19 @@ def test_real_manifest_lints_clean_modulo_baseline(module):
     unexpected = [f for f in found if f.code not in _EXPECTED_REAL]
     assert unexpected == [], unexpected
     for f in found:
-        assert "eg_" in f.message, f  # only the known egress entries
+        if f.code == "JXL005":
+            assert "eg_" in f.message, f  # only the known egress entries
+        else:
+            # only the wired engines carry the known quadratic axis
+            assert module in ("wired", "hybrid"), f
+            assert "scale axis 'n_nodes'" in f.message, f
+    if module in ("wired", "hybrid"):
+        # ISSUE acceptance: the dense one-hot step kernel must fire
+        # JXL007 out of the box, pointing at the --cost report
+        jxl7 = [f for f in found if f.code == "JXL007"]
+        assert len(jxl7) == 1, found
+        assert "exceeds budget" in jxl7[0].message
+        assert "--jaxpr --cost" in jxl7[0].message
 
 
 def test_wired_dead_key_fix_shares_one_runner():
@@ -601,9 +616,309 @@ def test_jxl006_only_audits_surrogate_variants():
 def test_diff_manifest_is_clean_and_its_flips_hold():
     """The real diff-subsystem manifest: every exposed operand keeps a
     live gradient path (JXL006), the surrogate/loss flips are honest
-    cache-key components (JXL004), and the traces carry no stray f64
-    (JXL002) — the ratchet stays ZERO."""
+    cache-key components (JXL004), the traces carry no stray f64
+    (JXL002), its sparse sites are all audited (JXL008) and its scale
+    axis stays linear (JXL007) — the ratchet stays ZERO."""
     from tpudes.diff import as_grad
 
     found = lint_manifest(as_grad.trace_manifest())
     assert found == [], [f.message for f in found]
+
+
+# --- JXL007 scale growth (ISSUE-16 tentpole) --------------------------------
+
+
+def _axis_manifest(build, **axkw):
+    """A one-entry manifest whose entry declares one scale axis over
+    ``build`` (value -> TraceEntry)."""
+
+    def entries():
+        return [
+            dataclasses.replace(
+                build(4), scale_axes=(ScaleAxis("n", build, **axkw),)
+            )
+        ]
+
+    return _manifest(entries)
+
+
+def _quad_entry(v):
+    # the planted defect: an outer product materializes an O(n^2)
+    # buffer while in/out stay O(n)
+    return TraceEntry(
+        "step", lambda x: jnp.outer(x, x).sum(),
+        (jnp.ones(int(v), jnp.float32),),
+    )
+
+
+def _lin_entry(v):
+    return TraceEntry(
+        "step", lambda x: (x * 2.0).sum(),
+        (jnp.ones(int(v), jnp.float32),),
+    )
+
+
+def test_jxl007_quadratic_axis_fires_and_linear_is_clean():
+    found = lint_manifest(_axis_manifest(_quad_entry, points=(2, 8)))
+    hits = [f for f in found if f.code == "JXL007"]
+    assert len(hits) == 1, found
+    assert "exceeds budget" in hits[0].message
+    assert "widest buffer 2.00" in hits[0].message
+    assert "JXL007" not in _codes(
+        lint_manifest(_axis_manifest(_lin_entry, points=(2, 8)))
+    )
+
+
+def test_jxl007_declared_budget_silences_known_superlinear():
+    # the bss n_sta pattern: O(n^2) pairwise geometry is the model's
+    # contract — declaring mem_budget=2.0 makes the fit an assertion,
+    # not a finding
+    assert "JXL007" not in _codes(
+        lint_manifest(
+            _axis_manifest(_quad_entry, points=(2, 8), mem_budget=2.0)
+        )
+    )
+
+
+def test_jxl007_dead_axis_declaration_fires():
+    def dead(v):  # ignores v: the manifest claims a scaling it lacks
+        return _lin_entry(4)
+
+    found = lint_manifest(_axis_manifest(dead, points=(2, 8)))
+    assert any(
+        f.code == "JXL007" and "dead axis" in f.message for f in found
+    ), found
+
+
+def test_jxl007_single_point_axis_cannot_fit():
+    found = lint_manifest(_axis_manifest(_lin_entry, points=(4,)))
+    assert any(
+        f.code == "JXL007" and "fewer than 2 points" in f.message
+        for f in found
+    ), found
+
+
+# --- JXL008 sparse-site audit (ISSUE-16 tentpole) ---------------------------
+
+
+def _take_entries():
+    x = jnp.arange(8, dtype=jnp.float32)
+    idx = jnp.asarray([3, 1], jnp.int32)
+    return [TraceEntry("step", lambda v, i: v[i], (x, idx))]
+
+
+def _synth_site(**over):
+    from tpudes.analysis.jaxpr.sparse_registry import SparseSite
+
+    kw = dict(
+        site="synth.window", engine="synth", entry="*/step",
+        primitive="gather", mode="promise_in_bounds",
+        provenance=("operand",),
+    )
+    kw.update(over)
+    return SparseSite(**kw)
+
+
+def test_jxl008_unregistered_gather_fires():
+    found = lint_manifest(_manifest(_take_entries))
+    hits = [f for f in found if f.code == "JXL008"]
+    assert len(hits) == 1, found
+    assert "unaudited sparse site" in hits[0].message
+    assert "sparse_registry" in hits[0].message
+
+
+def test_jxl008_registered_contract_passes(monkeypatch):
+    from tpudes.analysis.jaxpr import sparse_registry as SR
+
+    monkeypatch.setattr(
+        SR, "SPARSE_SITES", SR.SPARSE_SITES + (_synth_site(),)
+    )
+    assert "JXL008" not in _codes(lint_manifest(_manifest(_take_entries)))
+
+
+@pytest.mark.parametrize(
+    "over, fragment",
+    [
+        ({"mode": "clip"}, "mode"),
+        ({"provenance": ("iota",)}, "provenance"),
+    ],
+)
+def test_jxl008_contradicted_contract_fires(monkeypatch, over, fragment):
+    """A registered site whose declared mode/provenance the jaxpr does
+    not uphold is a finding, not a free pass — the contract is
+    machine-checked, never trusted."""
+    from tpudes.analysis.jaxpr import sparse_registry as SR
+
+    monkeypatch.setattr(
+        SR, "SPARSE_SITES", SR.SPARSE_SITES + (_synth_site(**over),)
+    )
+    found = lint_manifest(_manifest(_take_entries))
+    hits = [f for f in found if f.code == "JXL008"]
+    assert len(hits) == 1, found
+    assert "contract contradicted" in hits[0].message
+    assert fragment in hits[0].message
+
+
+def test_jxl001_gather_ban_relaxed_by_verified_contract(monkeypatch):
+    """The ISSUE-16 relaxation: under no_gather, a gather with a
+    VERIFIED sparse_registry contract passes JXL001 (the audit
+    replaces the blanket ban); an unregistered one still fires both."""
+    from tpudes.analysis.jaxpr import sparse_registry as SR
+
+    found = lint_manifest(_manifest(_take_entries, no_gather=True))
+    assert "JXL001" in _codes(found) and "JXL008" in _codes(found)
+
+    monkeypatch.setattr(
+        SR, "SPARSE_SITES", SR.SPARSE_SITES + (_synth_site(),)
+    )
+    clean = lint_manifest(_manifest(_take_entries, no_gather=True))
+    assert "JXL001" not in _codes(clean), clean
+    assert "JXL008" not in _codes(clean), clean
+
+
+def test_lte_serving_term_gather_is_audited():
+    """ISSUE acceptance: the LTE serving-term gather is a REGISTERED
+    allowlist entry whose contract (fill_or_drop mode, operand-rooted
+    indices) the traced jaxpr upholds."""
+    from tpudes.analysis.jaxpr import sparse_registry as SR
+    from tpudes.analysis.jaxpr.trace import trace_entry
+    from tpudes.parallel import lte_sm
+
+    man = lte_sm.trace_manifest()
+    variant = next(v for v in man.variants() if v.name == "traffic")
+    entry = next(
+        e for e in variant.build() if e.name == "traffic_advance"
+    )
+    records = SR.audit_entry(
+        man.engine, f"{variant.name}/{entry.name}", trace_entry(entry)
+    )
+    assert records, "the serving-term gathers must be visible"
+    assert all(r["ok"] for r in records), records
+    sites = {r["site"] for r in records}
+    assert "lte_sm.serving_term" in sites
+    serving = [r for r in records if r["site"] == "lte_sm.serving_term"]
+    assert all(r["mode"] == "fill_or_drop" for r in serving)
+    assert all(r["kinds"] == ["operand"] for r in serving)
+
+
+# --- cost model: peak-live / widest-buffer / FLOP accounting ----------------
+
+
+def _cost():
+    from tpudes.analysis.jaxpr import cost
+
+    return cost
+
+
+def test_buffer_accounting_pinned_on_tiny_jaxprs():
+    cost = _cost()
+    x = jnp.ones(4, jnp.float32)
+
+    cj = jax.make_jaxpr(lambda v: (v * 2.0).sum())(x)
+    assert cost.total_buffer_bytes(cj) == 36  # in 16 + mul 16 + sum 4
+    assert cost.peak_live_bytes(cj) == 36  # nothing dies before the sum
+    assert cost._jaxpr_flops(cj.jaxpr) == 8.0  # 4 mul + 4-element sum
+
+    def chain(v):
+        a = v * 2.0
+        b = a + 1.0
+        return b * 3.0
+
+    cj = jax.make_jaxpr(chain)(x)
+    assert cost.total_buffer_bytes(cj) == 64
+    # liveness: `a` dies when `b` is born, so at most two 16 B
+    # intermediates coexist on top of the held input
+    assert cost.peak_live_bytes(cj) == 48
+
+
+def test_widest_buffer_sees_the_quadratic_intermediate():
+    cost = _cost()
+    cj = jax.make_jaxpr(lambda v: jnp.outer(v, v))(
+        jnp.ones(4, jnp.float32)
+    )
+    assert cost.widest_buffer_bytes(cj) == 64  # the 4x4 f32 table
+    for n, widest in ((2, 16), (8, 256)):
+        cj = jax.make_jaxpr(lambda v: jnp.outer(v, v).sum())(
+            jnp.ones(n, jnp.float32)
+        )
+        assert cost.widest_buffer_bytes(cj) == widest  # exact n^2 * 4
+
+
+def test_scan_body_costs_scale_with_length():
+    cost = _cost()
+
+    def fn(v):
+        def body(c, _):
+            return c * 2.0, c.sum()
+
+        _, ys = jax.lax.scan(body, v, None, length=8)
+        return ys
+
+    cj = jax.make_jaxpr(fn)(jnp.ones(4, jnp.float32))
+    assert cost.total_buffer_bytes(cj) == 84
+    assert cost.peak_live_bytes(cj) == 84
+    assert cost._jaxpr_flops(cj.jaxpr) == 64.0  # (4 mul + 4 sum) x 8
+
+
+def test_fit_and_projection_are_exact_on_power_laws():
+    cost = _cost()
+    assert cost.fit_exponent([2, 4, 8], [4, 16, 64]) == pytest.approx(2.0)
+    assert cost.fit_exponent([2, 8], [6, 24]) == pytest.approx(1.0)
+    # projection anchors at the largest measured point
+    assert cost.project_bytes([2, 4], [8, 32], 2.0, 8) == pytest.approx(128.0)
+
+
+def test_peak_live_upper_bounds_xla_temp_allocation():
+    """Cross-check against the HLO machinery the LTE kernel tests use:
+    the abstract liveness walk assumes zero fusion, so it must never
+    report LESS than what XLA actually allocates for temps."""
+    cost = _cost()
+
+    def fn(x):
+        a = jnp.sin(x)
+        b = a * x
+        return b.sum()
+
+    x = jnp.ones((256,), jnp.float32)
+    compiled = jax.jit(fn).lower(x).compile()
+    analysis = compiled.memory_analysis()
+    if analysis is None:  # pragma: no cover - backend-dependent
+        return
+    cj = jax.make_jaxpr(fn)(x)
+    assert cost.peak_live_bytes(cj) >= analysis.temp_size_in_bytes
+
+
+def test_wired_scale_report_projects_the_csr_worklist():
+    """ISSUE acceptance: the --cost report fits the wired dense
+    one-hot step kernel at >= 2.0 in the joint (links, packets) axis
+    and projects its bytes at 1e5/1e6 nodes — the ROADMAP item-2
+    worklist."""
+    from tpudes.analysis.jaxpr.cost import scale_report
+    from tpudes.parallel import wired
+
+    # restrict the manifest to the joint axis: the n_links/n_flows
+    # marginals are already fitted (and held linear) by the lint in
+    # test_real_manifest_lints_clean_modulo_baseline[wired]
+    man = wired.trace_manifest()
+    base = man.variants()[0]
+    entries = [
+        dataclasses.replace(
+            e,
+            scale_axes=tuple(
+                a for a in e.scale_axes if a.name == "n_nodes"
+            ),
+        )
+        for e in base.build()
+    ]
+    slim = dataclasses.replace(
+        man, variants=lambda: [TraceVariant("base", lambda: entries)]
+    )
+    rep = scale_report(manifests=[(slim, 0)])
+    assert rep["worklist"] == ["wired/advance:n_nodes"]
+    (quad,) = [r for r in rep["entries"] if r["axis"] == "n_nodes"]
+    assert quad["mem_exponent"] >= 1.99
+    assert quad["over_budget"] and not quad["dead"]
+    proj = quad["projected"]
+    assert set(proj) == {"1e5_nodes", "1e6_nodes"}
+    assert proj["1e6_nodes"]["bytes"] > proj["1e5_nodes"]["bytes"] > 0
+    assert proj["1e6_nodes"]["human"].endswith("iB")

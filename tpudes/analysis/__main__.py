@@ -7,9 +7,12 @@ explicit paths the same rules run over just those files/dirs.
 ``--jaxpr`` additionally traces every registered device-engine
 manifest and runs the JXL contract passes over the jaxprs (CPU-safe —
 ``jax.make_jaxpr`` only, no compile; run it under
-``JAX_PLATFORMS=cpu`` in CI).  ``--format sarif`` emits SARIF 2.1.0
+``JAX_PLATFORMS=cpu`` in CI).  ``--jaxpr --cost`` swaps lint findings
+for the scale-complexity report: per-axis growth exponents and
+1e5/1e6-node byte projections.  ``--format sarif`` emits SARIF 2.1.0
 for GitHub code scanning.  AST findings are cached per file content
-hash (``tools/.analysis_cache.json``); ``--no-cache`` disables.
+hash, jaxpr findings per pass-family version + tpudes module set
+(``tools/.analysis_cache.json``); ``--no-cache`` disables.
 """
 
 from __future__ import annotations
@@ -37,6 +40,56 @@ def _csv(value: str) -> list[str]:
     return [v.strip() for v in value.split(",") if v.strip()]
 
 
+def _cost_report(args) -> int:
+    """``--jaxpr --cost``: the scale-complexity report.
+
+    Always exits 0 — the report informs; the ratchet on over-budget
+    growth is the JXL007 finding plus the baseline, not this mode.
+    """
+    from tpudes.analysis.jaxpr.cost import format_bytes, scale_report
+
+    t0 = time.perf_counter()
+    report = scale_report()
+    report["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    if args.cost_out:
+        out = Path(args.cost_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=1))
+    if args.fmt != "text":
+        print(json.dumps(report, indent=1))
+        return 0
+    for r in report["entries"]:
+        flag = ""
+        if r["dead"]:
+            flag = "  [DEAD AXIS]"
+        elif r["over_budget"]:
+            flag = "  [OVER BUDGET]"
+        print(
+            f"{r['engine']}/{r['entry']}  axis={r['axis']}  "
+            f"mem_exp={r['mem_exponent']:.2f} (budget "
+            f"{r['mem_budget']:g})  peak={r['peak_exponent']:.2f}  "
+            f"widest={r['widest_exponent']:.2f}  "
+            f"flops={r['flop_exponent']:.2f}{flag}"
+        )
+        proj = r.get("projected")
+        if proj:
+            parts = ", ".join(
+                f"{k.replace('_nodes', ' nodes')}: {v['human']}"
+                for k, v in sorted(proj.items())
+            )
+            print(f"    projected peak-live bytes  {parts}")
+    if report["worklist"]:
+        print(
+            "cost: over-budget worklist (ROADMAP item 2 — sparse/CSR "
+            "rewrite candidates): " + ", ".join(report["worklist"])
+        )
+    else:
+        print("cost: no axis exceeds its declared memory budget")
+    print(f"cost: {len(report['entries'])} axis fit(s) in "
+          f"{report['elapsed_s']:.1f}s")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpudes.analysis",
@@ -50,7 +103,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="drop rules with these code prefixes")
     ap.add_argument("--jaxpr", action="store_true",
                     help="also trace every registered engine manifest and "
-                         "run the JXL001-JXL005 jaxpr contract passes")
+                         "run the JXL001-JXL008 jaxpr contract passes")
+    ap.add_argument("--cost", action="store_true",
+                    help="emit the scale-complexity cost report instead of "
+                         "lint findings: per-axis growth exponents and "
+                         "1e5/1e6-node byte projections (requires --jaxpr)")
+    ap.add_argument("--cost-out", default=None, metavar="PATH",
+                    help="with --cost, also write the JSON report to PATH "
+                         "(for CI artifact upload)")
     ap.add_argument("--format", dest="fmt", default="text",
                     choices=("text", "json", "sarif"),
                     help="output format (sarif = GitHub code scanning)")
@@ -89,6 +149,14 @@ def main(argv: list[str] | None = None) -> int:
             for code in sorted(p.codes):
                 print(f"{code}  [{p.name}]  {p.codes[code]}")
         return 0
+
+    if args.cost or args.cost_out:
+        if not args.jaxpr:
+            print("analysis: --cost requires --jaxpr (the report is "
+                  "built by re-tracing the engine manifests)",
+                  file=sys.stderr)
+            return 2
+        return _cost_report(args)
 
     root = Path.cwd()
     explicit = bool(args.paths)

@@ -15,8 +15,16 @@ are covered by (a).  Findings are stored UNFILTERED by
 ``--select/--ignore`` (selection applies at read time); runs narrowed
 by selection therefore read the cache but never write it.
 
-The jaxpr pass family is never cached: its findings depend on the
-engine modules' runtime behavior, not just their bytes here.
+The jaxpr pass family gets its own section with a STRICTER key: its
+findings depend on the engine modules' runtime tracing, so the jaxpr
+sha folds together (a) the jaxpr pass-family sources
+(:func:`jaxpr_rules_fingerprint` — an edited JXL rule must never
+serve a stale warm result), (b) the content hash of every scanned
+``tpudes/`` module (the manifests and the kernels they trace live
+there), and (c) the installed jax version (the tracer itself).  A
+warm ``--jaxpr`` run with no edits serves findings without importing
+jax at all — that is what keeps the gate under a second between test
+rounds.
 """
 
 from __future__ import annotations
@@ -30,6 +38,7 @@ from tpudes.analysis.base import Finding
 CACHE_VERSION = 1
 
 _rules_fp: str | None = None
+_jaxpr_rules_fp: str | None = None
 
 
 def rules_fingerprint() -> str:
@@ -43,6 +52,37 @@ def rules_fingerprint() -> str:
             h.update(f.read_bytes())
         _rules_fp = h.hexdigest()
     return _rules_fp
+
+
+def jaxpr_rules_fingerprint() -> str:
+    """Digest of the jaxpr pass family specifically (memoized).
+
+    ``rules_fingerprint()`` already covers these files as part of the
+    whole-store key; this narrower digest is folded into the jaxpr
+    section's OWN key so the pass-family version is pinned in the cache
+    entry itself, not just in the store header — a defense in depth the
+    invalidation regression test exercises directly.
+    """
+    global _jaxpr_rules_fp
+    if _jaxpr_rules_fp is None:
+        root = Path(__file__).resolve().parent / "jaxpr"
+        h = hashlib.sha256()
+        for f in sorted(root.rglob("*.py")):
+            h.update(f.relative_to(root).as_posix().encode())
+            h.update(f.read_bytes())
+        _jaxpr_rules_fp = h.hexdigest()
+    return _jaxpr_rules_fp
+
+
+def _jax_version() -> str:
+    # importlib.metadata, not ``import jax``: reading the version must
+    # stay cheap on warm runs where jax is otherwise never loaded.
+    try:
+        from importlib.metadata import version
+
+        return version("jax")
+    except Exception:
+        return "unknown"
 
 
 def _to_dicts(findings: list[Finding]) -> list[dict]:
@@ -77,6 +117,7 @@ class AnalysisCache:
             data = {}
         self._files: dict = data.get("files", {})
         self._project: dict = data.get("project", {})
+        self._jaxpr: dict = data.get("jaxpr", {})
 
     # --- per-file module-pass findings ---------------------------------
 
@@ -111,6 +152,36 @@ class AnalysisCache:
         self._project = {"sha": sha, "findings": _to_dicts(findings)}
         self._dirty = True
 
+    # --- whole-set jaxpr-pass findings ----------------------------------
+
+    @staticmethod
+    def jaxpr_sha(mods) -> str:
+        """Key for the jaxpr findings section.
+
+        Folds the jaxpr pass-family version, the content hash of every
+        scanned ``tpudes/`` module (manifest entries trace kernels that
+        live anywhere under the package), and the jax version.  Tests,
+        examples and tools cannot change what tracing produces, so they
+        are excluded — editing a test must not cost a 30 s retrace.
+        """
+        h = hashlib.sha256()
+        h.update(jaxpr_rules_fingerprint().encode())
+        h.update(_jax_version().encode())
+        for m in sorted(mods, key=lambda m: m.path):
+            if m.path.startswith("tpudes/"):
+                h.update(m.path.encode())
+                h.update(m.sha.encode())
+        return h.hexdigest()
+
+    def get_jaxpr(self, sha: str) -> list[Finding] | None:
+        if self._jaxpr.get("sha") == sha:
+            return _from_dicts(self._jaxpr["findings"])
+        return None
+
+    def put_jaxpr(self, sha: str, findings: list[Finding]):
+        self._jaxpr = {"sha": sha, "findings": _to_dicts(findings)}
+        self._dirty = True
+
     def prune(self, keep_paths) -> None:
         """Drop per-file entries for paths no longer in the scanned
         set (renames/deletes) so the store cannot grow monotonically."""
@@ -131,6 +202,7 @@ class AnalysisCache:
             "rules": rules_fingerprint(),
             "files": self._files,
             "project": self._project,
+            "jaxpr": self._jaxpr,
         }
         try:
             self.path.parent.mkdir(parents=True, exist_ok=True)

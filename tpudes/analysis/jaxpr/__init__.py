@@ -16,8 +16,15 @@ the jaxprs for the structural contracts the paper's thesis rests on:
           traced operands burned to constants)
 - JXL005  donation audit (dead donated carry leaves, unaliasable
           donations, undonated carries)
+- JXL006  grad-hygiene on surrogate-flagged variants (no structurally
+          zero gradients)
+- JXL007  scale-growth (per-axis peak-live/widest-buffer growth
+          exponents fitted against declared budgets; dead axes)
+- JXL008  sparse-site audit (gather/scatter/dynamic-slice only at
+          registered, machine-checked SparseSite contracts)
 
-Enable with ``python -m tpudes.analysis --jaxpr``.
+Enable with ``python -m tpudes.analysis --jaxpr``; add ``--cost`` for
+the scale-complexity report with 1e5/1e6-node byte projections.
 """
 
 from tpudes.analysis.jaxpr.passes import (
@@ -27,6 +34,7 @@ from tpudes.analysis.jaxpr.passes import (
 )
 from tpudes.analysis.jaxpr.spec import (
     FlipSpec,
+    ScaleAxis,
     TraceEntry,
     TraceManifest,
     TraceVariant,
@@ -36,6 +44,7 @@ __all__ = [
     "JAXPR_PASSES",
     "JaxprContractPass",
     "FlipSpec",
+    "ScaleAxis",
     "TraceEntry",
     "TraceManifest",
     "TraceVariant",

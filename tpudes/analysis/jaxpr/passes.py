@@ -1,4 +1,4 @@
-"""JXL001–JXL005: trace-aware contract passes over the device-engine
+"""JXL001–JXL008: trace-aware contract passes over the device-engine
 surface.
 
 The AST passes see Python syntax; these see the *programs the engines
@@ -17,6 +17,8 @@ part of the default AST-only run — tracing costs a jax import).
 from __future__ import annotations
 
 from tpudes.analysis.base import Finding, Pass
+from tpudes.analysis.jaxpr import cost as C
+from tpudes.analysis.jaxpr import sparse_registry as SR
 from tpudes.analysis.jaxpr import trace as T
 
 #: primitives that have no business in ANY device-engine program:
@@ -60,6 +62,15 @@ class JaxprContractPass(Pass):
                   "a surrogate-flagged trace has a structurally-zero "
                   "gradient (round/argmax/int-cast/stop_gradient "
                   "severs every path — annotate straight-through)",
+        "JXL007": "scale-growth: an entry's fitted memory growth "
+                  "exponent exceeds its declared per-axis budget "
+                  "(superlinear device bytes before HBM finds out), "
+                  "or a declared scale axis never changes the traced "
+                  "shapes (dead axis)",
+        "JXL008": "sparse-site audit: a gather/scatter/dynamic-slice "
+                  "has no registered SparseSite contract, or the "
+                  "jaxpr contradicts the registered contract (mode, "
+                  "index provenance, scatter uniqueness)",
     }
 
     def __init__(self, manifests=None):
@@ -102,19 +113,100 @@ def lint_manifest(man, line: int = 1) -> list:
             tag = f"{man.engine}/{variant.name}/{entry.name}"
             prims = T.primitive_names(cj)
 
+            # JXL008 — sparse-site audit: every gather/scatter/
+            # dynamic-slice must match a registered SparseSite whose
+            # contract (mode, index provenance, scatter uniqueness)
+            # the jaxpr upholds
+            records = SR.audit_entry(
+                man.engine, f"{variant.name}/{entry.name}", cj
+            )
+            seen_msgs = set()
+            for rec in records:
+                if rec["ok"]:
+                    continue
+                if rec["site"] is None:
+                    msg = (
+                        f"{tag}: unaudited sparse site — '{rec['prim']}' "
+                        f"(mode {rec['mode']}, index roots "
+                        f"{rec['kinds']}) has no registered SparseSite; "
+                        "add a machine-checked contract in "
+                        "analysis/jaxpr/sparse_registry.py"
+                    )
+                else:
+                    msg = (
+                        f"{tag}: sparse-site contract contradicted — "
+                        f"'{rec['prim']}' vs '{rec['site']}': "
+                        + "; ".join(rec["problems"])
+                    )
+                if msg not in seen_msgs:
+                    seen_msgs.add(msg)
+                    emit("JXL008", msg)
+
             # JXL001 — forbidden primitives
             for p in sorted(prims & FORBIDDEN_EVERYWHERE):
                 emit("JXL001", f"{tag}: host primitive '{p}' inside "
                                "the device program")
             if man.no_gather and entry.kernel:
-                for p in sorted(p for p in prims if _is_gatherish(p)):
+                # the blanket ban relaxed into the audit: a gatherish
+                # eqn that passes a registered SparseSite contract is
+                # allowed even in a no-gather kernel (the path the
+                # CSR wired rewrite lands through); everything else
+                # still fires
+                bad = sorted(
+                    {
+                        r["prim"]
+                        for r in records
+                        if not r["ok"] and _is_gatherish(r["prim"])
+                    }
+                )
+                for p in bad:
                     emit(
                         "JXL001",
                         f"{tag}: '{p}' in a no-gather step kernel — "
                         "the wired contract is one-hot/masked-"
                         "reduction forms only (XLA:CPU serializes "
-                        "gathers; Mosaic tiling forbids them)",
+                        "gathers; Mosaic tiling forbids them), "
+                        "unless the site carries a verified "
+                        "sparse_registry contract",
                     )
+
+            # JXL007 — scale growth: re-trace the entry along each
+            # declared axis and fit the peak-live/widest-buffer
+            # growth exponents against the declared budget.  Base
+            # variant only: axes describe the program, not the
+            # variant, and tracing is the expensive part.
+            if vi == 0:
+                for ax in entry.scale_axes:
+                    if len(ax.points) < 2:
+                        emit(
+                            "JXL007",
+                            f"{tag}: scale axis '{ax.name}' declares "
+                            "fewer than 2 points — growth cannot be "
+                            "fitted",
+                        )
+                        continue
+                    m = C.axis_metrics(ax)
+                    if m["dead"]:
+                        emit(
+                            "JXL007",
+                            f"{tag}: scale axis '{ax.name}' never "
+                            "changes the traced shapes across points "
+                            f"{m['points']} — dead axis declaration "
+                            "(the manifest claims a scaling the "
+                            "program does not have)",
+                        )
+                    elif m["over_budget"]:
+                        emit(
+                            "JXL007",
+                            f"{tag}: scale axis '{ax.name}' fitted "
+                            f"memory exponent "
+                            f"{m['mem_exponent']:.2f} exceeds budget "
+                            f"{ax.mem_budget:g} (peak-live "
+                            f"{m['peak_exponent']:.2f}, widest "
+                            f"buffer {m['widest_exponent']:.2f}) — "
+                            "superlinear device bytes; run --jaxpr "
+                            "--cost for the 1e5/1e6-node projections",
+                        )
 
             # JXL002 — bf16 accumulator policy
             if variant.bf16:

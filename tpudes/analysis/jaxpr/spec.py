@@ -31,6 +31,38 @@ from dataclasses import dataclass, field
 
 
 @dataclass(frozen=True)
+class ScaleAxis:
+    """One scale dimension of a trace entry, for the JXL007
+    scale-growth pass and the ``--cost`` report.
+
+    ``build`` is a one-arg callable mapping an axis value to a fresh
+    :class:`TraceEntry` of the SAME program shape-scaled along this
+    axis only (tiny values — everything is ``jax.make_jaxpr`` traced,
+    never compiled).  ``points`` are the axis values to trace (>= 2,
+    strictly increasing; spread them wide — the growth-exponent fit is
+    a log-log slope and close points amplify the constant-term bias).
+    ``mem_budget`` is the maximum allowed fitted peak-live-bytes growth
+    exponent: 1.0 declares "device memory linear in this axis", 2.0
+    admits a dense quadratic table (e.g. the BSS pairwise-detect
+    geometry, which is O(n_sta^2) by physical contract).  An entry
+    whose fitted exponent exceeds the budget (plus the fit tolerance)
+    is a JXL007 finding; an axis whose traces do not change shape at
+    all across ``points`` is a dead-axis JXL007 finding (the manifest
+    claims a scaling the program does not have — the same both-ways
+    hygiene as the JXL004 flips).  ``nodes_per_unit`` calibrates the
+    10^5/10^6-node projections in the cost report: how many topology
+    NODES one unit of this axis represents (0 disables projection for
+    axes that are not node-like, e.g. replicas)."""
+
+    name: str
+    build: object
+    points: tuple = (2, 8)
+    mem_budget: float = 1.0
+    nodes_per_unit: float = 0.0
+    note: str = ""
+
+
+@dataclass(frozen=True)
 class TraceEntry:
     """One traceable function of a cached runner value.
 
@@ -62,6 +94,12 @@ class TraceEntry:
     #: severing every path = structurally-zero gradient — the hard op
     #: needs a straight-through annotation, ``tpudes.diff.ste``)
     grad_wrt: tuple = ()
+    #: declared :class:`ScaleAxis` list: how this entry's buffers are
+    #: expected to grow with problem size.  JXL007 re-traces the entry
+    #: at each axis's points, fits the peak-live-bytes growth exponent,
+    #: and flags any axis over its ``mem_budget`` — the dense-table
+    #: early-warning for ROADMAP item 2
+    scale_axes: tuple = ()
 
 
 @dataclass(frozen=True)

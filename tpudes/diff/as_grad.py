@@ -237,10 +237,15 @@ def grad_as_flows(
 _TRACE_R = 2
 
 
-def _trace_as_entries(surrogate, loss: str = "kpi_mse"):
+def _trace_as_entries(
+    surrogate, loss: str = "kpi_mse", n_nodes: int = 12,
+    scale: bool = True,
+):
     """The AS grad objective exactly as ``grad_as_flows`` jits it
     (before value_and_grad — JXL006 audits the FORWARD trace's
-    gradient paths), with concrete tiny operands."""
+    gradient paths), with concrete tiny operands.  ``n_nodes``
+    parameterizes the topology for the JXL007 axis; ``scale=False``
+    skips the axis declarations (the axis builder re-enters here)."""
     import dataclasses
 
     import jax
@@ -251,7 +256,7 @@ def _trace_as_entries(surrogate, loss: str = "kpi_mse"):
     from tpudes.parallel.programs import toy_as_program
 
     prog = dataclasses.replace(
-        toy_as_program(n_nodes=12, n_flows=2, spf_rounds=6),
+        toy_as_program(n_nodes=int(n_nodes), n_flows=2, spf_rounds=6),
         surrogate=surrogate,
     )
     loss_fn = build_as_loss_fn(prog, _TRACE_R, loss)
@@ -266,8 +271,31 @@ def _trace_as_entries(surrogate, loss: str = "kpi_mse"):
             kernel=False,
             traced={"params": 0, "z": 1, "target": 4},
             grad_wrt=(0,),
+            scale_axes=(
+                _scale_axes(surrogate, loss) if scale else ()
+            ),
         ),
     ]
+
+
+def _scale_axes(surrogate, loss: str):
+    """JXL007 scale axis for the differentiable AS loss: the forward
+    trace carries the same (R, 2E) edge tables as the as_flows
+    runner, linear in the topology — budget 1.0 (a dense adjoint
+    blow-up would fire it)."""
+    from tpudes.analysis.jaxpr.spec import ScaleAxis
+
+    return (
+        ScaleAxis(
+            "n_nodes",
+            lambda v: _trace_as_entries(
+                surrogate, loss, n_nodes=int(v), scale=False
+            )[0],
+            points=(8, 32),
+            mem_budget=1.0,
+            nodes_per_unit=1.0,
+        ),
+    )
 
 
 def _trace_lte_entries():

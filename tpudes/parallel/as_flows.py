@@ -799,9 +799,13 @@ def _trace_prog(**over):
     return dataclasses.replace(prog, **over) if over else prog
 
 
-def _trace_entries(prog: AsFlowsProgram, obs: bool = False):
+def _trace_entries(
+    prog: AsFlowsProgram, obs: bool = False, scale: bool = True
+):
     """The cached runner exactly as ``run_as_flows`` jits it, with
-    concrete tiny operands (same construction as the entry point)."""
+    concrete tiny operands (same construction as the entry point).
+    ``scale=False`` skips the JXL007 axis declarations (the axis
+    builders re-enter here)."""
     from tpudes.analysis.jaxpr.spec import TraceEntry
 
     run = build_as_run(prog, _TRACE_R, obs=obs)
@@ -832,8 +836,42 @@ def _trace_entries(prog: AsFlowsProgram, obs: bool = False):
             donate=(0,),
             carry=(0,),
             traced=traced,
+            scale_axes=_scale_axes() if scale else (),
         ),
     ]
+
+
+def _scale_axes():
+    """JXL007 scale axes for the SPF fixed-point runner: edge tables
+    are (R, 2E) with E linear in the node count of the BA topology,
+    and flow-path tables are (F, 2E).  Both axes budget 1.0 — this is
+    the linear-in-topology counterpoint to the wired engine's dense
+    quadratic tables in the --cost report."""
+    from tpudes.analysis.jaxpr.spec import ScaleAxis
+
+    from tpudes.parallel.programs import toy_as_program
+
+    def at(n_nodes, n_flows):
+        prog = toy_as_program(
+            n_nodes=int(n_nodes), n_flows=int(n_flows), spf_rounds=6
+        )
+        return _trace_entries(prog, scale=False)[0]
+
+    return (
+        ScaleAxis(
+            "n_nodes",
+            lambda v: at(v, 2),
+            points=(8, 32),
+            mem_budget=1.0,
+            nodes_per_unit=1.0,
+        ),
+        ScaleAxis(
+            "n_flows",
+            lambda v: at(12, v),
+            points=(2, 8),
+            mem_budget=1.0,
+        ),
+    )
 
 
 def _flip_traffic():

@@ -865,8 +865,44 @@ def _trace_entries(prog):
             donate=(0,),
             carry=(0,),
             traced={"ing_hop": 1, "ing_ready": 2, "t_grant": 3},
+            scale_axes=_scale_axes(),
         ),
     ]
+
+
+def _scale_axes():
+    """JXL007 scale axes: the lane step body shares the wired engine's
+    dense one-hot tables, so the joint per-rank topology axis is
+    quadratic and declared at budget 1.0 — it FIRES by design, the
+    baselined hybrid half of the ROADMAP item-2 worklist."""
+    from tpudes.analysis.jaxpr.spec import ScaleAxis
+    from tpudes.parallel.wired import wired_weak_chain
+
+    def at(v):
+        prog = wired_weak_chain(
+            2, links_per_rank=int(v), flows_per_rank=int(v),
+            n_slots=60, boundary_delay=8,
+        )
+        entries = _trace_entries(prog)
+        entry = entries[1]
+        # strip the nested axis declarations the re-entrant build
+        # added — axis traces must not recurse
+        import dataclasses
+
+        return dataclasses.replace(entry, scale_axes=())
+
+    return (
+        ScaleAxis(
+            "n_nodes",
+            at,
+            points=(2, 4, 8),
+            mem_budget=1.0,
+            nodes_per_unit=2.0,  # two ranks: 2v links per axis unit
+            note="joint links+flows per-rank axis: lane tables are "
+                 "O(L*P) like the wired engine — fires until the CSR "
+                 "rewrite (ROADMAP item 2) lands",
+        ),
+    )
 
 
 def _trace_flips():

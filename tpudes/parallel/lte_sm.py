@@ -1373,9 +1373,13 @@ def _trace_prog(**over):
     return dataclasses.replace(prog, **over) if over else prog
 
 
-def _trace_entries(prog: LteSmProgram, obs: bool = False):
+def _trace_entries(
+    prog: LteSmProgram, obs: bool = False, scale: bool = True
+):
     """The cached-runner functions exactly as ``run_lte_sm`` jits them
-    (plain-XLA lowering), with concrete tiny operands."""
+    (plain-XLA lowering), with concrete tiny operands.  ``scale=False``
+    skips the JXL007 axis declarations (the axis builders re-enter
+    here)."""
     from tpudes.analysis.jaxpr.spec import TraceEntry
     from tpudes.parallel.runtime import replica_keys, stack_axis
 
@@ -1394,8 +1398,39 @@ def _trace_entries(prog: LteSmProgram, obs: bool = False):
             donate=(0,),
             carry=(0,),
             traced={"sid": 2, "t_end": 3},
+            scale_axes=_scale_axes() if scale else (),
         ),
     ]
+
+
+def _scale_axes():
+    """JXL007 scale axes for the SINR/scheduler advance kernel: the
+    gain/SINR tables are (U, E) — linear in the UE count at fixed
+    cells and linear in the cell count at fixed UEs.  Both axes budget
+    1.0; a dense (U, U) interference rewrite would fire them."""
+    from tpudes.analysis.jaxpr.spec import ScaleAxis
+    from tpudes.parallel.programs import toy_lte_program
+
+    def at(n_enb, n_ue):
+        prog = toy_lte_program(
+            n_enb=int(n_enb), n_ue=int(n_ue), n_ttis=40
+        )
+        return _trace_entries(prog, scale=False)[1]
+
+    return (
+        ScaleAxis(
+            "n_ue",
+            lambda v: at(2, v),
+            points=(3, 12),
+            mem_budget=1.0,
+        ),
+        ScaleAxis(
+            "n_enb",
+            lambda v: at(v, 3),
+            points=(2, 8),
+            mem_budget=1.0,
+        ),
+    )
 
 
 def _trace_traffic_prog():

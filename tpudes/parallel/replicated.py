@@ -1476,14 +1476,17 @@ def _trace_prog(**over):
     return dataclasses.replace(prog, **over) if over else prog
 
 
-def _trace_entries(prog: "BssProgram", obs: bool = False):
+def _trace_entries(
+    prog: "BssProgram", obs: bool = False, r: int = _TRACE_R,
+    scale: bool = True,
+):
     """The cached-runner functions exactly as ``run_replicated_bss``
-    jits them, with concrete tiny operands."""
+    jits them, with concrete tiny operands.  ``r`` parameterizes the
+    replica count for the JXL007 replicas axis; ``scale=False`` skips
+    the axis declarations (the axis builders re-enter here)."""
     from tpudes.analysis.jaxpr.spec import TraceEntry
 
-    init_state, pending, fn = build_bss_advance(
-        prog, _TRACE_R, obs=obs
-    )
+    init_state, pending, fn = build_bss_advance(prog, r, obs=obs)
     key = jax.random.PRNGKey(0)
     s0 = init_state()
     tr = None if prog.traffic is None else prog.traffic.operands()
@@ -1500,8 +1503,47 @@ def _trace_entries(prog: "BssProgram", obs: bool = False):
             donate=(0,),
             carry=(0,),
             traced=traced,
+            scale_axes=_scale_axes() if scale else (),
         ),
     ]
+
+
+def _scale_axes():
+    """JXL007 scale axes for the BSS advance kernel: state and step
+    tables are linear in the replica count, and the pairwise
+    detectability geometry is O(n_sta^2) by physical contract — the
+    station axis is declared at budget 2.0 (a dense pairwise table is
+    the model, not an accident)."""
+    from tpudes.analysis.jaxpr.spec import ScaleAxis
+
+    def at(n_sta=None, r=_TRACE_R):
+        if n_sta is None:
+            prog = _trace_prog()
+        else:
+            from tpudes.parallel.programs import toy_bss_program
+
+            prog = toy_bss_program(
+                n_sta=int(n_sta), sim_end_us=20_000
+            )
+        return _trace_entries(prog, r=int(r), scale=False)[1]
+
+    return (
+        ScaleAxis(
+            "replicas",
+            lambda v: at(r=int(v)),
+            points=(2, 8),
+            mem_budget=1.0,
+        ),
+        ScaleAxis(
+            "n_sta",
+            lambda v: at(n_sta=int(v)),
+            points=(2, 8),
+            mem_budget=2.0,
+            note="pairwise detect/interference geometry is O(n_sta^2) "
+                 "by the channel model — budget 2.0 is the contract, "
+                 "not a concession",
+        ),
+    )
 
 
 def _flip_traffic():

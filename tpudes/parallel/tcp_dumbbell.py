@@ -1481,9 +1481,12 @@ def _trace_prog(**over):
     return dataclasses.replace(prog, **over) if over else prog
 
 
-def _trace_entries(prog: DumbbellProgram, obs: bool = False):
+def _trace_entries(
+    prog: DumbbellProgram, obs: bool = False, scale: bool = True
+):
     """The cached-runner functions exactly as ``run_tcp_dumbbell`` jits
-    them, with concrete tiny operands."""
+    them, with concrete tiny operands.  ``scale=False`` skips the
+    JXL007 axis declarations (the axis builders re-enter here)."""
     from tpudes.analysis.jaxpr.spec import TraceEntry
 
     init_state, fn = build_dumbbell_advance(prog, _TRACE_R, obs=obs)
@@ -1504,8 +1507,28 @@ def _trace_entries(prog: DumbbellProgram, obs: bool = False):
             donate=(0,),
             carry=(0,),
             traced=traced,
+            scale_axes=_scale_axes() if scale else (),
         ),
     ]
+
+
+def _scale_axes():
+    """JXL007 scale axis for the dumbbell advance kernel: per-flow
+    cwnd/ring state is (R, F) — linear in the flow count, budget
+    1.0 (an all-pairs fairness table would fire it)."""
+    from tpudes.analysis.jaxpr.spec import ScaleAxis
+
+    from tpudes.parallel.programs import toy_dumbbell_program
+
+    def at(v):
+        prog = toy_dumbbell_program(n_flows=int(v), n_slots=30)
+        return _trace_entries(prog, scale=False)[1]
+
+    return (
+        ScaleAxis(
+            "n_flows", at, points=(2, 8), mem_budget=1.0
+        ),
+    )
 
 
 def _trace_flips():

@@ -1066,9 +1066,11 @@ def _trace_prog(**over):
     return dataclasses.replace(prog, **over) if over else prog
 
 
-def _trace_entries(prog: WiredProgram):
+def _trace_entries(prog: WiredProgram, scale: bool = True):
     """The two cached-runner functions exactly as ``run_wired`` jits
-    them, with concrete tiny operands."""
+    them, with concrete tiny operands.  ``scale=False`` skips the
+    JXL007 axis declarations (the axis builders re-enter here for
+    their shape-scaled programs)."""
     import jax
     import jax.numpy as jnp
 
@@ -1090,8 +1092,53 @@ def _trace_entries(prog: WiredProgram):
             donate=(0,),
             carry=(0,),
             traced={"ing_hop": 1, "ing_ready": 2, "t_grant": 3},
+            scale_axes=_scale_axes() if scale else (),
         ),
     ]
+
+
+def _scale_axes():
+    """JXL007 scale axes for the advance kernel.  The dense
+    per-(packet,hop) one-hot tables are O(links × packets): each axis
+    alone is linear, but the joint ``n_nodes`` axis (links AND flows
+    both grow with topology size in a chain) is quadratic and is
+    declared at budget 1.0 so it FIRES by design — the documented,
+    baselined ROADMAP item-2 worklist the sparse CSR rewrite must
+    clear.  Axis builds pin ``n_pkts=4`` so the packet count scales
+    exactly with the flow count (horizon-filled budgets would vary
+    per-flow period and blur the fit)."""
+    from tpudes.analysis.jaxpr.spec import ScaleAxis
+
+    def at(**over):
+        prog = wired_chain(
+            n_slots=40, jitter_slots=2, n_pkts=4, **over
+        )
+        return _trace_entries(prog, scale=False)[1]
+
+    return (
+        ScaleAxis(
+            "n_links",
+            lambda v: at(n_links=int(v), n_flows=2),
+            points=(3, 12),
+            mem_budget=1.0,
+        ),
+        ScaleAxis(
+            "n_flows",
+            lambda v: at(n_links=3, n_flows=int(v)),
+            points=(2, 8),
+            mem_budget=1.0,
+        ),
+        ScaleAxis(
+            "n_nodes",
+            lambda v: at(n_links=int(v), n_flows=int(v)),
+            points=(3, 6, 12),
+            mem_budget=1.0,
+            nodes_per_unit=1.0,
+            note="joint links+flows axis: the dense one-hot step "
+                 "tables are O(L*P) — fires until the CSR rewrite "
+                 "(ROADMAP item 2) lands",
+        ),
+    )
 
 
 def _trace_flips():

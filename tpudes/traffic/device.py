@@ -354,7 +354,7 @@ def _trace_prog(**over) -> TrafficProgram:
     return dataclasses.replace(prog, **over) if over else prog
 
 
-def _trace_entries(prog: TrafficProgram):
+def _trace_entries(prog: TrafficProgram, scale: bool = True):
     import jax
     import jax.numpy as jnp
 
@@ -369,12 +369,42 @@ def _trace_entries(prog: TrafficProgram):
         TraceEntry(
             "cum", cum_fn, (ops, jnp.int32(40_000)),
             kernel=False, traced={"t_us": 1},
+            scale_axes=_scale_axes() if scale else (),
         ),
         TraceEntry(
             "gap", gap_fn, (ops, key, t),
             kernel=False, traced={"t_arr": 2},
         ),
     ]
+
+
+def _scale_axes():
+    """JXL007 scale axes for the workload kernels: the operand tables
+    are (n, n_epoch) — linear in the entity count and in the epoch
+    count, budget 1.0 each (a cross-entity correlation table would
+    fire them)."""
+    import dataclasses
+
+    from tpudes.analysis.jaxpr.spec import ScaleAxis
+
+    def at_n(v):
+        prog = TrafficProgram.mmpp(
+            int(v), 40.0, horizon_us=500_000, epoch_s=0.05, tr_seed=7
+        )
+        return _trace_entries(prog, scale=False)[0]
+
+    def at_epochs(v):
+        prog = dataclasses.replace(_trace_prog(), n_epoch=int(v))
+        return _trace_entries(prog, scale=False)[0]
+
+    return (
+        ScaleAxis(
+            "n", at_n, points=(3, 12), mem_budget=1.0
+        ),
+        ScaleAxis(
+            "n_epoch", at_epochs, points=(16, 64), mem_budget=1.0
+        ),
+    )
 
 
 def _trace_flips():
