@@ -1,0 +1,602 @@
+"""Device-resident FlowMonitor (tpudes.obs.flowmon): accumulator
+parity with the host monitor, per-engine oracle validation, packet-ring
+decode, the ONE shared XML serializer, pcap round-trip back into a
+trace-replay TrafficProgram, and the ``--flowmon`` / ``--pcap`` CLI
+validator modes.
+"""
+
+import struct
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from tpudes.core.global_value import GlobalValue
+from tpudes.core.world import reset_world
+from tpudes.models.flow_monitor import FiveTuple, FlowMonitor, FlowStats
+from tpudes.obs.flowmon import (
+    FLOW_RING_CAP,
+    VERDICT_RX,
+    VERDICT_TX,
+    DeviceFlowMonitor,
+    PacketEvent,
+    decode_packet_rings,
+    flow_accumulate,
+    flow_carry,
+    flow_ring_write,
+    host_reference_stats,
+    reduce_flow_stats,
+    serialize_flow_stats_xml,
+    validate_flowmon_xml,
+    validate_pcap,
+    write_events_pcap,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+KEY = jax.random.PRNGKey(0)
+
+
+# --- the shared one-packet-per-step sequence -------------------------------
+#
+# Two flows, at most one packet per (step, flow): the regime where the
+# device's one-observation-per-step jitter coarsening coincides exactly
+# with the host monitor's per-packet RFC-3550 chain.
+#
+# Each entry: (flow index, size, delay_s or None for a drop)
+
+_SEQ = [
+    {0: (100, 0.0030)},
+    {0: (100, 0.0042), 1: (300, 0.0020)},
+    {0: (100, 0.0034)},
+    {0: (100, 0.0046), 1: (300, 0.0030)},
+    {0: (100, 0.0038)},
+    {1: (300, None)},  # flow 1's packet is dropped this step
+    {0: (100, 0.0042)},
+    {0: (100, 0.0034), 1: (300, 0.0020)},
+    {1: (300, 0.0030)},
+]
+_STEP_S = 0.01
+
+
+class _StubPacket:
+    _next_uid = 0
+
+    def __init__(self, size):
+        _StubPacket._next_uid += 1
+        self._uid, self._size = _StubPacket._next_uid, size
+
+    def GetUid(self):
+        return self._uid
+
+    def GetSize(self):
+        return self._size
+
+    def PeekHeader(self):
+        return None
+
+
+class _StubHeader:
+    def __init__(self, flow):
+        self.source = "10.0.0.1"
+        self.destination = f"10.0.1.{flow + 1}"
+        self.protocol = 17
+
+
+def _live_monitor_stats():
+    """Replay _SEQ through a LIVE host FlowMonitor's probe callbacks
+    (time injected, periodic sweep off — pure accumulator arithmetic)."""
+    mon = FlowMonitor()
+    mon._stopped = True  # no Simulator needed for the expiry sweep
+    now = [0.0]
+    mon._now_s = lambda: now[0]
+    # fix flow-id assignment to the flow index order
+    for f in range(2):
+        mon.classifier.Classify(_StubHeader(f), _StubPacket(0))
+    for k, step in enumerate(_SEQ):
+        t = k * _STEP_S
+        for f, (size, delay) in step.items():
+            pkt = _StubPacket(size)
+            now[0] = t
+            mon._on_send(_StubHeader(f), pkt, 0)
+            if delay is None:
+                now[0] = t + 0.001
+                mon._on_drop(_StubHeader(f), pkt, "queue")
+            else:
+                now[0] = t + delay
+                mon._on_deliver(_StubHeader(f), pkt, 0)
+    return mon
+
+
+def _step_arrays():
+    """_SEQ as flow_accumulate operand dicts (the +20 matches the host
+    probe's GetSize()+20 IP-header accounting)."""
+    steps = []
+    for k, step in enumerate(_SEQ):
+        tx = np.zeros(2, np.int32)
+        txb = np.zeros(2, np.int32)
+        rx = np.zeros(2, np.int32)
+        rxb = np.zeros(2, np.int32)
+        lost = np.zeros(2, np.int32)
+        delay = np.zeros(2, np.float32)
+        for f, (size, d) in step.items():
+            tx[f], txb[f] = 1, size + 20
+            if d is None:
+                lost[f] = 1
+            else:
+                rx[f], rxb[f], delay[f] = 1, size + 20, d
+        steps.append(
+            dict(t_s=k * _STEP_S, tx=tx, tx_bytes=txb, rx=rx,
+                 rx_bytes=rxb, delay_s=delay, lost=lost)
+        )
+    return steps
+
+
+def _device_columns():
+    import jax.numpy as jnp
+
+    fm = flow_carry(2)
+    for ev in _step_arrays():
+        fm = flow_accumulate(
+            {k: v for k, v in fm.items() if k != "fm_ring"},
+            t_s=ev["t_s"],
+            tx=jnp.asarray(ev["tx"]),
+            tx_bytes=jnp.asarray(ev["tx_bytes"]),
+            rx=jnp.asarray(ev["rx"]),
+            rx_bytes=jnp.asarray(ev["rx_bytes"]),
+            delay_s=jnp.asarray(ev["delay_s"]),
+            lost=jnp.asarray(ev["lost"]),
+            bin_width_s=0.001,
+        )
+    return fm
+
+
+def test_flow_accumulate_matches_live_flow_monitor():
+    """The acceptance gate at the accumulator level: on a shared
+    one-packet-per-step sequence the device columns reproduce a live
+    host FlowMonitor — counts and bytes exact, delay/jitter sums and
+    last-delay within f32 tolerance."""
+    host = _live_monitor_stats().GetFlowStats()
+    dev = reduce_flow_stats(_device_columns())
+    assert set(dev) == set(host) == {1, 2}
+    for fid in host:
+        h, d = host[fid], dev[fid]
+        assert (d.tx_packets, d.tx_bytes) == (h.tx_packets, h.tx_bytes)
+        assert (d.rx_packets, d.rx_bytes) == (h.rx_packets, h.rx_bytes)
+        assert d.lost_packets == h.lost_packets
+        assert d.delay_sum_s == pytest.approx(h.delay_sum_s, rel=1e-5)
+        assert d.jitter_sum_s == pytest.approx(h.jitter_sum_s, rel=1e-5)
+        assert d.last_delay_s == pytest.approx(h.last_delay_s, rel=1e-5)
+        # device stamps flow times at the step clock; the live monitor
+        # stamps delivery at send-time + delay — sub-step skew only
+        assert d.time_first_tx_s == pytest.approx(h.time_first_tx_s)
+        assert abs(d.time_last_rx_s - h.time_last_rx_s) < 0.005
+    assert _live_monitor_stats().stats[2].lost_packets == 1
+
+
+def test_host_reference_stats_is_the_same_oracle():
+    """host_reference_stats (the NumPy oracle the engine tests use)
+    agrees with the live monitor on every field it defines with step-
+    clock semantics."""
+    host = _live_monitor_stats().GetFlowStats()
+    ref = host_reference_stats(_step_arrays())
+    for fid in host:
+        h, r = host[fid], ref[fid]
+        assert (r.tx_packets, r.tx_bytes, r.rx_packets, r.rx_bytes,
+                r.lost_packets) == (
+            h.tx_packets, h.tx_bytes, h.rx_packets, h.rx_bytes,
+            h.lost_packets,
+        )
+        assert r.delay_sum_s == pytest.approx(h.delay_sum_s)
+        assert r.jitter_sum_s == pytest.approx(h.jitter_sum_s)
+    # and it is exactly what the device columns integrate to
+    dev = reduce_flow_stats(_device_columns())
+    for fid, r in ref.items():
+        assert dev[fid].delay_sum_s == pytest.approx(r.delay_sum_s, rel=1e-5)
+        assert dev[fid].time_first_tx_s == pytest.approx(r.time_first_tx_s)
+        assert dev[fid].time_last_rx_s == pytest.approx(r.time_last_rx_s)
+
+
+# --- packet-event ring ------------------------------------------------------
+
+
+def test_ring_write_wraps_and_decode_dedups():
+    import jax.numpy as jnp
+
+    cap = 8
+    ring = jnp.full((cap, 5), -1, jnp.int32)
+    snaps = []
+    for step in range(20):
+        row = jnp.asarray(
+            [step, step * 1000, step % 3, 100 + step, VERDICT_RX],
+            jnp.int32,
+        )
+        ring = flow_ring_write(ring, jnp.int32(step), row)
+        if step == 7:
+            snaps.append(np.asarray(ring))
+    # final snapshot arrives flipped, as the engines emit it (lax.rev
+    # freshness) — decode's step-keyed ordering must not care
+    snaps.append(np.asarray(ring)[::-1])
+    events = decode_packet_rings(snaps)
+    # steps 0..7 from the boundary snapshot, 12..19 from the final one;
+    # 8..11 were recycled between snapshots (the bounded-ring contract)
+    assert [e.step for e in events] == list(range(8)) + list(range(12, 20))
+    assert all(e.t_us == e.step * 1000 for e in events)
+    assert len({e.step for e in events}) == len(events)
+
+
+def test_decode_rejects_batched_snapshot():
+    with pytest.raises(ValueError, match="slice the replica lane"):
+        decode_packet_rings([np.zeros((2, FLOW_RING_CAP, 5), np.int32)])
+
+
+def test_reduce_skips_inactive_flows_and_maps_sentinels():
+    fm = {k: np.asarray(v) for k, v in flow_carry(3).items()}
+    fm["fm_tx"] = np.asarray([2, 0, 0], np.int32)
+    fm["fm_txb"] = np.asarray([200, 0, 0], np.int32)
+    stats = reduce_flow_stats({k: v for k, v in fm.items() if k != "fm_ring"})
+    assert set(stats) == {1}  # flows 2 and 3 never materialise
+    st = stats[1]
+    assert st.tx_packets == 2 and st.rx_packets == 0
+    assert st.last_delay_s is None
+    assert st.time_first_tx_s is None and st.time_last_rx_s is None
+
+
+# --- per-engine device-vs-oracle validation --------------------------------
+
+
+def test_wired_flowstats_match_host_des_oracle_exactly():
+    """Wired acceptance: per-flow tx/rx counts AND the delay sums from
+    the device columns equal the host DES oracle's per-packet deliver
+    slots — exact, not approximate (integer slot arithmetic on both
+    sides; slot→seconds scaling happens in the host finalize)."""
+    from tpudes.obs.device import ChunkStream
+    from tpudes.parallel.wired import (
+        WIRED_PKT_BYTES,
+        packet_table,
+        run_wired,
+        run_wired_host,
+        wired_chain,
+    )
+
+    prog = wired_chain(n_links=3, n_flows=2, n_slots=60, jitter_slots=0)
+    GlobalValue.Bind("TpudesObs", 1)
+    ChunkStream.reset()
+    res = run_wired(prog, KEY, replicas=1, window_slots=16)
+    flow = res["flow"]
+
+    host = run_wired_host(prog)
+    pkt_flow, pkt_birth, _ = packet_table(prog)
+    F = prog.n_flows
+    rx_host = np.zeros(F, np.int64)
+    dsum_host = np.zeros(F, np.float64)
+    dl = host["deliver_slot"]
+    for p in range(len(pkt_flow)):
+        if dl[p] >= 0:
+            rx_host[pkt_flow[p]] += 1
+            dsum_host[pkt_flow[p]] += (dl[p] - pkt_birth[p]) * prog.slot_s
+    tx_host = np.bincount(pkt_flow[pkt_birth < prog.n_slots], minlength=F)
+
+    np.testing.assert_array_equal(flow["fm_rx"][0], rx_host)
+    np.testing.assert_array_equal(flow["fm_tx"][0], tx_host)
+    np.testing.assert_allclose(flow["fm_dsum"][0], dsum_host, rtol=1e-6)
+    np.testing.assert_array_equal(
+        flow["fm_rxb"][0], rx_host * WIRED_PKT_BYTES
+    )
+    assert (flow["fm_hist"][0].sum(-1) == flow["fm_rx"][0]).all()
+    # ring: chunk-boundary snapshots + the final ring decode into one
+    # deduped event log with both verdicts present
+    snaps = [
+        np.asarray(e["metrics"]["fm_ring"])[0]
+        for e in ChunkStream.entries("wired")
+    ]
+    events = decode_packet_rings(snaps + [flow["fm_ring"][0]])
+    assert events and {e.verdict for e in events} <= {VERDICT_TX, VERDICT_RX}
+    assert sum(e.verdict == VERDICT_RX for e in events) > 0
+
+
+def test_lte_flowstats_match_delivered_tb_counters():
+    """LTE acceptance: fm_rx equals the engine's per-UE delivered-TB
+    counter exactly; each delivery contributes one 1-TTI delay."""
+    from tpudes.parallel.lte_sm import run_lte_sm
+    from tpudes.parallel.programs import toy_lte_program
+
+    prog = toy_lte_program(n_enb=2, n_ue=3, n_ttis=50)
+    GlobalValue.Bind("TpudesObs", 1)
+    res = run_lte_sm(prog, KEY, chunk_ttis=16)
+    flow = res["flow"]
+    np.testing.assert_array_equal(flow["fm_rx"], res["ok"])
+    np.testing.assert_allclose(
+        flow["fm_dsum"], res["ok"] * 1e-3, rtol=1e-6
+    )
+    assert (flow["fm_hist"].sum(-1) == flow["fm_rx"]).all()
+    assert (flow["fm_rxb"] == flow["fm_txb"]).all()  # acked bytes both ways
+    stats = reduce_flow_stats(
+        {k: v for k, v in flow.items() if k != "fm_ring"}
+    )
+    assert sum(st.rx_packets for st in stats.values()) == int(
+        np.sum(res["ok"])
+    )
+
+
+def test_bss_flowstats_match_served_counters():
+    """BSS acceptance: per-replica MPDU totals from the flow columns
+    equal the engine's served/tx/drop counters exactly (flow = node)."""
+    sys.path.insert(0, str(REPO / "tests"))
+    from test_replicated import _lowered_program
+
+    from tpudes.parallel.replicated import run_replicated_bss
+
+    prog = _lowered_program()
+    GlobalValue.Bind("TpudesObs", 1)
+    out = run_replicated_bss(prog, 4, jax.random.PRNGKey(3))
+    flow = out["flow"]
+    np.testing.assert_array_equal(
+        flow["fm_rx"].sum(-1), out["srv_rx"] + out["cli_rx"].sum(-1)
+    )
+    np.testing.assert_array_equal(flow["fm_lost"].sum(-1), out["drops"])
+    np.testing.assert_array_equal(flow["fm_tx"].sum(-1), out["tx_data"])
+    assert (flow["fm_rxb"] == flow["fm_rx"] * prog.data_bytes).all()
+    assert (flow["fm_hist"].sum(-1) == flow["fm_rx"]).all()
+
+
+def test_dumbbell_flowstats_match_host_flow_monitor():
+    """Dumbbell acceptance: a live FlowMonitor on the host engine vs the
+    device columns, same deterministic scenario.  Goodput bytes and loss
+    are exact; the host's two extra control packets (SYN/FIN) are the
+    only count difference; jitter sums agree to float tolerance."""
+    from tpudes.core import Seconds, Simulator
+    from tpudes.core.config import Config
+    from tpudes.models.applications import BulkSendApplication
+    from tpudes.models.flow_monitor import FlowMonitorHelper
+    from tpudes.network.node import NodeList
+    from tpudes.parallel.tcp_dumbbell import lower_dumbbell, run_tcp_dumbbell
+    from tpudes.scenarios import build_dumbbell
+
+    sim_s, budget = 2.0, 20_000
+    # host MSS = device segment size, so both segment the budget alike
+    Config.SetDefault("tpudes::TcpSocketBase::SegmentSize", 1000)
+    build_dumbbell(2, sim_s, variant="TcpNewReno", queue="200p",
+                   seg_bytes=1000)
+    for i in range(NodeList.GetNNodes()):
+        node = NodeList.GetNode(i)
+        for a in range(node.GetNApplications()):
+            app = node.GetApplication(a)
+            if isinstance(app, BulkSendApplication):
+                app.SetAttribute("MaxBytes", budget)
+    prog = lower_dumbbell(sim_s)
+    mon = FlowMonitorHelper().InstallAll()
+    Simulator.Stop(Seconds(sim_s))
+    Simulator.Run()
+    mon.CheckForLostPackets()
+    host = {
+        fid: st
+        for fid, st in mon.GetFlowStats().items()
+        # data direction only: the device models the forward path; acks
+        # are implicit in its ack_lag pipeline
+        if 5000 <= mon.classifier.FindFlow(fid).destination_port < 5100
+    }
+    reset_world()
+
+    GlobalValue.Bind("TpudesObs", 1)
+    out = run_tcp_dumbbell(prog, KEY, replicas=2)
+    flow = out["flow"]
+    assert len(host) == 2
+    for j, fid in enumerate(sorted(host)):
+        h = host[fid]
+        # goodput (IP+TCP headers stripped: 40 B/packet) is exact
+        assert h.rx_bytes - h.rx_packets * 40 == budget
+        assert int(flow["fm_rxb"][0][j] - flow["fm_rx"][0][j] * 40) == budget
+        # SYN and FIN are the only packets the device does not model
+        assert h.rx_packets - 2 == int(flow["fm_rx"][0][j])
+        assert h.lost_packets == int(flow["fm_lost"][0][j]) == 0
+        assert float(flow["fm_jsum"][0][j]) == pytest.approx(
+            h.jitter_sum_s, abs=5e-3
+        )
+        # both see the same per-packet mean queueing delay regime
+        assert float(flow["fm_dsum"][0][j]) / int(
+            flow["fm_rx"][0][j]
+        ) == pytest.approx(h.delay_sum_s / h.rx_packets, rel=0.15)
+
+
+# --- the ONE shared XML serializer + validators ----------------------------
+
+
+def _toy_stats():
+    return {
+        1: FlowStats(tx_packets=10, tx_bytes=10400, rx_packets=9,
+                     rx_bytes=9360, lost_packets=1, delay_sum_s=0.05,
+                     jitter_sum_s=0.002, last_delay_s=0.004,
+                     time_first_tx_s=0.0, time_last_rx_s=0.9),
+        2: FlowStats(tx_packets=3, tx_bytes=1200, rx_packets=3,
+                     rx_bytes=1200, lost_packets=0, delay_sum_s=0.01,
+                     jitter_sum_s=0.0, last_delay_s=0.003,
+                     time_first_tx_s=0.1, time_last_rx_s=0.5),
+    }
+
+
+def test_xml_serializer_is_shared_byte_for_byte(tmp_path):
+    """FlowMonitor.SerializeToXmlFile and the device exporter emit THE
+    SAME bytes for the same stats — one serializer, two producers."""
+    stats = _toy_stats()
+    flows = {
+        FiveTuple("10.0.0.1", "10.0.1.1", 17, 49152, 9): 1,
+        FiveTuple("10.0.0.1", "10.0.1.2", 17, 49152, 9): 2,
+    }
+    mon = FlowMonitor()
+    mon.stats = stats
+    mon.classifier._flows = flows
+    a, b = tmp_path / "host.xml", tmp_path / "dev.xml"
+    mon.SerializeToXmlFile(str(a))
+    serialize_flow_stats_xml(stats, flows, str(b))
+    assert a.read_bytes() == b.read_bytes()
+    problems, n = validate_flowmon_xml(a.read_text())
+    assert problems == [] and n == 2
+
+
+def test_flowmon_xml_validator_names_the_defect():
+    bad = (
+        '<?xml version="1.0" ?>\n<FlowMonitor>\n  <FlowStats>\n'
+        '    <Flow flowId="1" txPackets="x" txBytes="10" rxPackets="9"'
+        ' rxBytes="9" lostPackets="0" delaySum="0.05s"'
+        ' jitterSum="+2000ns" />\n'
+        '    <Flow flowId="1" txPackets="1" txBytes="1" rxPackets="1"'
+        ' rxBytes="1" lostPackets="0" delaySum="+1ns"'
+        ' jitterSum="+0ns" />\n'
+        "  </FlowStats>\n</FlowMonitor>\n"
+    )
+    problems, n = validate_flowmon_xml(bad)
+    assert n == 2
+    assert any("txPackets='x' is not an integer" in p for p in problems)
+    assert any("delaySum='0.05s'" in p and "+<nanoseconds>ns" in p
+               for p in problems)
+    assert any("duplicate flowId 1" in p for p in problems)
+    assert validate_flowmon_xml("<wrong/>")[0][0].startswith(
+        "root element is <wrong>"
+    )
+    assert "not well-formed XML" in validate_flowmon_xml("{json?}")[0][0]
+
+
+def _toy_events(n=8):
+    return [
+        PacketEvent(step=i, t_us=i * 100, flow=1 + i % 2, size=1000,
+                    verdict=VERDICT_RX if i % 2 else VERDICT_TX)
+        for i in range(n)
+    ]
+
+
+def test_pcap_validator_both_endiannesses_and_ns_magic(tmp_path):
+    p = tmp_path / "cap.pcap"
+    n = write_events_pcap(_toy_events(), str(p),
+                          verdicts=(VERDICT_TX, VERDICT_RX))
+    assert n == 8
+    data = p.read_bytes()
+    assert validate_pcap(data) == ([], 8)
+    # big-endian + nanosecond magic, zero-payload records
+    hdr = struct.pack(">IHHiIII", 0xA1B23C4D, 2, 4, 0, 0, 65535, 101)
+    recs = b"".join(
+        struct.pack(">IIII", 0, t * 1000, 16, 16) + bytes(16)
+        for t in range(5)
+    )
+    assert validate_pcap(hdr + recs) == ([], 5)
+    # pcapng is rejected with conversion advice, not a parse crash
+    problems, _ = validate_pcap(struct.pack("<I", 0x0A0D0D0A) + data[4:])
+    assert "pcapng container" in problems[0] and "tcpdump" in problems[0]
+    # truncation names the exact record and byte offset
+    problems, n = validate_pcap(data[:-3])
+    assert n == 7 and "truncated" in problems[0]
+
+
+def test_obs_cli_flowmon_and_pcap_modes(tmp_path):
+    """Satellite: ``python -m tpudes.obs --flowmon/--pcap`` — the CI
+    gate over the artifacts a TpudesObs=1 run writes."""
+    xml = tmp_path / "flowmon.xml"
+    serialize_flow_stats_xml(_toy_stats(), {}, str(xml))
+    cap = tmp_path / "run.pcap"
+    write_events_pcap(_toy_events(), str(cap), verdicts=(VERDICT_RX,))
+    bad = tmp_path / "bad.pcap"
+    bad.write_bytes(cap.read_bytes()[:-2])
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "tpudes.obs", *args],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+
+    ok = cli("--flowmon", str(xml))
+    assert ok.returncode == 0 and "valid FlowMonitor XML (2 records)" in ok.stdout
+    ok = cli("--pcap", str(cap))
+    assert ok.returncode == 0 and "valid pcap capture (4 records)" in ok.stdout
+    fail = cli("--pcap", str(bad))
+    assert fail.returncode == 1 and "truncated" in fail.stdout
+    missing = cli("--flowmon", str(tmp_path / "nope.xml"))
+    assert missing.returncode == 2
+    both = cli("--flowmon", "--pcap", str(xml))
+    assert both.returncode == 2  # mutually exclusive modes
+
+
+# --- pcap round trip: device run -> EnablePcap format -> TrafficProgram ----
+
+
+def test_device_pcap_roundtrips_into_trace_replay_program(tmp_path):
+    """Satellite acceptance: a device run's delivered packets, written
+    through the trace_helper pcap frame format, read back by
+    traffic/ingest.read_pcap, reproduce the offered load as an exact
+    trace-replay TrafficProgram."""
+    from tpudes.obs.device import ChunkStream
+    from tpudes.parallel.wired import run_wired, wired_chain
+    from tpudes.traffic.ingest import ingest_traces, read_pcap
+
+    prog = wired_chain(n_links=3, n_flows=2, n_slots=60, jitter_slots=0)
+    GlobalValue.Bind("TpudesObs", 1)
+    ChunkStream.reset()
+    res = run_wired(prog, KEY, replicas=1, window_slots=16)
+    snaps = [
+        np.asarray(e["metrics"]["fm_ring"])[0]
+        for e in ChunkStream.entries("wired")
+    ]
+    mon = DeviceFlowMonitor(
+        {k: v[0] for k, v in res["flow"].items() if k != "fm_ring"},
+        rings=snaps + [res["flow"]["fm_ring"][0]],
+    )
+    rx = [e for e in mon.events if e.verdict == VERDICT_RX]
+    assert rx, "the run must deliver packets"
+
+    cap = tmp_path / "device.pcap"
+    n = mon.WritePcap(str(cap))
+    assert n == len(rx)
+    times_us, bytes_ = read_pcap(str(cap))
+    np.testing.assert_array_equal(times_us, [e.t_us for e in rx])
+    np.testing.assert_array_equal(bytes_, [e.size for e in rx])
+
+    # the same offered load whether built from the pcap file (one merged
+    # lane — frames carry no flow attribution) or straight from the ring
+    # (one lane per flow id)
+    via_pcap = ingest_traces([(times_us, bytes_)], t0_us=0)
+    via_ring = mon.ToTrafficProgram()
+    assert via_ring.arr_t.shape[0] == len({e.flow for e in rx})
+
+    def _pairs(p):
+        t, b = np.asarray(p.arr_t), np.asarray(p.arr_b)
+        m = b > 0
+        return sorted(zip(t[m].tolist(), b[m].tolist()))
+
+    want = sorted((e.t_us, e.size) for e in rx)
+    assert _pairs(via_pcap) == _pairs(via_ring) == want
+    # offered load reproduced: every delivered wire byte is in the table
+    assert int(via_pcap.arr_b[via_pcap.arr_b > 0].sum()) == sum(
+        e.size for e in rx
+    )
+
+
+# --- DeviceFlowMonitor reporting surface + Chrome-trace flow spans ---------
+
+
+def test_device_flow_monitor_exports_and_flow_spans(tmp_path):
+    from tpudes.obs.export import flow_trace_events, validate_chrome_trace
+
+    fm = {k: np.asarray(v) for k, v in flow_carry(2).items()
+          if k != "fm_ring"}
+    fm["fm_tx"] = np.asarray([5, 3], np.int32)
+    fm["fm_txb"] = np.asarray([5200, 3120], np.int32)
+    fm["fm_rx"] = np.asarray([5, 0], np.int32)
+    fm["fm_rxb"] = np.asarray([5200, 0], np.int32)
+    fm["fm_dsum"] = np.asarray([0.02, 0.0], np.float32)
+    fm["fm_t0"] = np.asarray([0.1, 0.2], np.float32)
+    fm["fm_t1"] = np.asarray([0.8, -1.0], np.float32)
+    mon = DeviceFlowMonitor(fm)
+    xml = tmp_path / "flowmon.xml"
+    mon.SerializeToXmlFile(str(xml))
+    problems, n = validate_flowmon_xml(xml.read_text())
+    assert problems == [] and n == 2
+
+    spans = flow_trace_events(mon.GetFlowStats())
+    doc = {"traceEvents": spans, "displayTimeUnit": "ms"}
+    assert validate_chrome_trace(doc) == []
+    xs = [e for e in spans if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["flow 1", "flow 2"]
+    assert xs[0]["ts"] == pytest.approx(0.1e6) and xs[0]["dur"] == pytest.approx(0.7e6)
+    assert xs[1]["dur"] == 0.0  # never-delivered flow: zero-length span
+    assert xs[0]["args"]["rxPackets"] == 5

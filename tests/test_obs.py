@@ -435,6 +435,91 @@ def test_obs_disabled_overhead_within_3_percent():
     )
 
 
+def _flowmon_runner(engine):
+    """A small deterministic run thunk per device engine (the program is
+    built once so both knob settings exercise the identical key)."""
+    key = jax.random.PRNGKey(0)
+    if engine == "dumbbell":
+        from tpudes.parallel.tcp_dumbbell import lower_dumbbell, run_tcp_dumbbell
+        from tpudes.scenarios import build_dumbbell
+
+        build_dumbbell(2, 1.0, variant="TcpNewReno")
+        prog = lower_dumbbell(1.0)
+        reset_world()
+        return lambda: run_tcp_dumbbell(prog, key, replicas=2)
+    if engine == "bss":
+        sys.path.insert(0, str(REPO / "tests"))
+        from test_replicated import _lowered_program
+
+        from tpudes.parallel.replicated import run_replicated_bss
+
+        prog = _lowered_program()
+        return lambda: run_replicated_bss(prog, 4, key)
+    if engine == "lte_sm":
+        from tpudes.parallel.lte_sm import run_lte_sm
+        from tpudes.parallel.programs import toy_lte_program
+
+        prog = toy_lte_program(n_enb=2, n_ue=3, n_ttis=40)
+        return lambda: run_lte_sm(prog, key, replicas=2)
+    from tpudes.parallel.wired import run_wired, wired_chain
+
+    prog = wired_chain(n_links=3, n_flows=2, n_slots=60)
+    return lambda: run_wired(prog, key, replicas=2)
+
+
+@pytest.mark.parametrize("engine", ["dumbbell", "bss", "lte_sm", "wired"])
+def test_flowmon_off_reuses_the_pre_obs_executable(engine):
+    """TpudesObs=0 compiles the exact pre-obs program on every engine:
+    binding the knob to 0 after an unset-knob run is a runner-cache HIT
+    (unchanged cache key) and records no new compile — the FlowMonitor
+    columns are structurally absent, not merely unused."""
+    from tpudes.parallel.runtime import RUNTIME
+
+    run = _flowmon_runner(engine)
+    RUNTIME.clear(engine)
+    CompileTelemetry.reset()
+    out_unset = run()
+    keys0 = {k for k in RUNTIME._runners if k[0] == engine}
+    compiles0 = CompileTelemetry.snapshot()[engine]["compiles"]
+    assert compiles0 >= 1
+    GlobalValue.Bind("TpudesObs", 0)
+    out_zero = run()
+    assert {k for k in RUNTIME._runners if k[0] == engine} == keys0
+    assert CompileTelemetry.snapshot()[engine]["compiles"] == compiles0
+    assert "flow" not in out_unset and "flow" not in out_zero
+
+
+@pytest.mark.parametrize(
+    "mod, site",
+    [
+        ("tpudes.parallel.tcp_dumbbell", "dumbbell.flow_ring"),
+        ("tpudes.parallel.replicated", "bss.flow_ring"),
+        ("tpudes.parallel.lte_sm", "lte_sm.flow_ring"),
+        ("tpudes.parallel.wired", "wired.flow_ring"),
+    ],
+)
+def test_flowmon_ring_sparse_site_is_audited(mod, site):
+    """TpudesObs=1 adds exactly one class of sparse op per engine — the
+    packet ring's mod-bounded slot write — and it is a REGISTERED
+    SparseSite whose contract the traced obs jaxpr upholds (JXL008):
+    zero new unaudited findings beyond the registry rows."""
+    import importlib
+
+    from tpudes.analysis.jaxpr import sparse_registry as SR
+    from tpudes.analysis.jaxpr.trace import trace_entry
+
+    man = importlib.import_module(mod).trace_manifest()
+    variant = next(v for v in man.variants() if v.name == "obs")
+    seen_sites = set()
+    for entry in variant.build():
+        records = SR.audit_entry(
+            man.engine, f"{variant.name}/{entry.name}", trace_entry(entry)
+        )
+        assert all(r["ok"] for r in records), (entry.name, records)
+        seen_sites |= {r["site"] for r in records}
+    assert site in seen_sites, seen_sites
+
+
 def test_queue_depth_resyncs_after_cancellations():
     """Cancelled events are purged inside the wrapped scheduler without
     a visible pop; the profiler's periodic resync must snap the depth

@@ -390,6 +390,50 @@ SPARSE_SITES: tuple = (
         unique_indices=False,
         note="as_flows.relax_scatter through the loss wrapper",
     ),
+    # -- device FlowMonitor packet rings (tpudes/obs/flowmon.py) ------
+    # One site per engine: flow_ring_write's dynamic_update_slice at
+    # ring slot ``step % FLOW_RING_CAP`` — the start index is the
+    # engine's monotonic step counter reduced by lax.rem, so every
+    # write is in-bounds by the modulus; XLA clamps DUS starts anyway
+    # (mode clip).  LTE is the exception that proves the vmap hazard:
+    # its advance is replica-vmapped with a batched carry, so the DUS
+    # batching rule lowers the ring write to a scatter (still mod-
+    # rooted, still clip-moded).
+    SparseSite(
+        site="dumbbell.flow_ring",
+        engine="dumbbell", entry="obs/advance",
+        primitive="dynamic_update_slice", mode="clip",
+        provenance=("operand", "mod"),
+        note="FlowMonitor ring write at slot t % FLOW_RING_CAP "
+             "(tpudes/obs/flowmon.py flow_ring_write)",
+    ),
+    SparseSite(
+        site="bss.flow_ring",
+        engine="bss", entry="obs/advance",
+        primitive="dynamic_update_slice", mode="clip",
+        provenance=("operand", "mod"),
+        note="FlowMonitor ring write at slot step % FLOW_RING_CAP "
+             "(tpudes/obs/flowmon.py flow_ring_write)",
+    ),
+    SparseSite(
+        site="lte_sm.flow_ring",
+        engine="lte_sm", entry="obs/advance",
+        primitive="scatter", mode="clip",
+        provenance=("operand", "mod"),
+        note="FlowMonitor ring write at slot t % FLOW_RING_CAP; the "
+             "replica vmap batches the DUS start index, so the "
+             "batching rule lowers it to scatter — indices stay "
+             "mod-bounded",
+    ),
+    SparseSite(
+        site="wired.flow_ring",
+        engine="wired", entry="obs/advance",
+        primitive="dynamic_update_slice", mode="clip",
+        provenance=("operand", "mod"),
+        note="FlowMonitor ring write at slot t % FLOW_RING_CAP; rides "
+             "the no-gather kernel through the JXL001 contract "
+             "relaxation (verified registered sites only)",
+    ),
     # -- wired / hybrid: one-time init packet-table expansion ---------
     SparseSite(
         site="wired.init_paths",

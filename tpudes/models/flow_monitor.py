@@ -205,27 +205,14 @@ class FlowMonitor:
 
     def SerializeToXmlFile(self, filename: str, *_args) -> None:
         """flow-monitor.cc SerializeToXmlFile: the standard FlowMonitor
-        XML shape (attribute names match upstream's parser ecosystem)."""
-        with open(filename, "w") as f:
-            f.write("<?xml version=\"1.0\" ?>\n<FlowMonitor>\n  <FlowStats>\n")
-            for fid, st in sorted(self.stats.items()):
-                f.write(
-                    f'    <Flow flowId="{fid}" '
-                    f'txPackets="{st.tx_packets}" txBytes="{st.tx_bytes}" '
-                    f'rxPackets="{st.rx_packets}" rxBytes="{st.rx_bytes}" '
-                    f'lostPackets="{st.lost_packets}" '
-                    f'delaySum="+{st.delay_sum_s * 1e9:.0f}ns" '
-                    f'jitterSum="+{st.jitter_sum_s * 1e9:.0f}ns" />\n'
-                )
-            f.write("  </FlowStats>\n  <Ipv4FlowClassifier>\n")
-            for t, fid in self.classifier._flows.items():
-                f.write(
-                    f'    <Flow flowId="{fid}" sourceAddress="{t.source}" '
-                    f'destinationAddress="{t.destination}" '
-                    f'protocol="{t.protocol}" sourcePort="{t.source_port}" '
-                    f'destinationPort="{t.destination_port}" />\n'
-                )
-            f.write("  </Ipv4FlowClassifier>\n</FlowMonitor>\n")
+        XML shape (attribute names match upstream's parser ecosystem).
+        The actual serializer lives in :mod:`tpudes.obs.flowmon` and is
+        shared with the device-side monitor — one format, two
+        producers.  Imported lazily: flowmon imports FlowStats from
+        this module at top level."""
+        from tpudes.obs.flowmon import serialize_flow_stats_xml
+
+        serialize_flow_stats_xml(self.stats, self.classifier._flows, filename)
 
 
 class FlowMonitorHelper:

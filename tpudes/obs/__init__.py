@@ -16,7 +16,15 @@ One GlobalValue knob, ``TpudesObs`` (bound like every engine knob:
 - the **Chrome-trace export** (:mod:`tpudes.obs.export`): set
   ``TpudesObsTrace=/path/trace.json`` and ``Simulator.Destroy`` writes
   a chrome://tracing / Perfetto loadable timeline.  Validate with
-  ``python -m tpudes.obs trace.json``.
+  ``python -m tpudes.obs trace.json``;
+- the **device FlowMonitor** (:mod:`tpudes.obs.flowmon`): per-flow
+  FlowStats columns and a packet-event ring riding each compiled
+  engine's scan carry, reduced on the host into the same ``FlowStats``
+  objects the host monitor produces.  Export through the shared
+  ns-3-parity XML serializer, write delivered packets as pcap, merge
+  flow spans into the Chrome trace, or round-trip a device run back
+  into a trace-replay ``TrafficProgram``.  Validate the artifacts with
+  ``python -m tpudes.obs --flowmon flowmon.xml`` / ``--pcap out.pcap``.
 
 With the knob at 0 the engines run their pre-obs code paths unchanged
 (pinned by the overhead test in tests/test_obs.py).
@@ -39,6 +47,16 @@ from tpudes.obs.export import (
     validate_chrome_trace,
 )
 from tpudes.obs.flight_recorder import FlightRecorder
+from tpudes.obs.flowmon import (
+    DeviceFlowMonitor,
+    decode_packet_rings,
+    host_reference_stats,
+    reduce_flow_stats,
+    serialize_flow_stats_xml,
+    validate_flowmon_xml,
+    validate_pcap,
+    write_events_pcap,
+)
 from tpudes.obs.fuzz import FuzzTelemetry, validate_fuzz_metrics
 from tpudes.obs.grad import GradTelemetry, validate_grad_metrics
 from tpudes.obs.profiler import (
@@ -55,6 +73,7 @@ __all__ = [
     "validate_traffic_metrics",
     "ChunkStream",
     "CompileTelemetry",
+    "DeviceFlowMonitor",
     "DistributedTelemetry",
     "FlightRecorder",
     "FuzzTelemetry",
@@ -66,12 +85,19 @@ __all__ = [
     "ServingTelemetry",
     "assert_valid_chrome_trace",
     "chrome_trace",
+    "decode_packet_rings",
     "device_metrics_enabled",
     "enabled",
     "export_chrome_trace",
     "export_on_destroy",
+    "host_reference_stats",
+    "reduce_flow_stats",
+    "serialize_flow_stats_xml",
     "validate_chrome_trace",
     "validate_distributed_metrics",
+    "validate_flowmon_xml",
     "validate_fuzz_metrics",
+    "validate_pcap",
     "validate_serving_metrics",
+    "write_events_pcap",
 ]

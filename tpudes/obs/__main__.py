@@ -9,6 +9,8 @@ Usage::
     python -m tpudes.obs --geometry <metrics.json> [more.json ...]
     python -m tpudes.obs --traffic <metrics.json> [more.json ...]
     python -m tpudes.obs --grad <metrics.json> [more.json ...]
+    python -m tpudes.obs --flowmon <flowmon.xml> [more.xml ...]
+    python -m tpudes.obs --pcap <capture.pcap> [more.pcap ...]
 
 Default mode checks Chrome-trace exports against the Trace Event
 format; ``--serving`` checks :class:`tpudes.obs.serving.ServingTelemetry`
@@ -25,7 +27,11 @@ the workload schema (offered vs delivered load, per-model launch
 counts, burst duty cycle); ``--grad`` checks
 :class:`tpudes.obs.grad.GradTelemetry` snapshot dumps against the
 gradient schema (grad-norm/loss rings, descent step counters,
-non-finite canaries).  Exit 0 when every
+non-finite canaries); ``--flowmon`` checks FlowMonitor XML exports
+(ours or upstream ns-3's ``SerializeToXmlFile``) for the standard
+FlowStats attribute set; ``--pcap`` structurally validates classic
+libpcap captures (both byte orders, µs and ns magic) record by record
+— these two read XML / raw bytes, not JSON.  Exit 0 when every
 file is valid, 1 on
 violations, 2 on usage / unreadable input.  These are the schema gates
 the CI smoke steps run over the artifacts an example (``TpudesObs=1``),
@@ -54,18 +60,46 @@ def main(argv: list[str] | None = None) -> int:
     geometry = "--geometry" in argv
     traffic = "--traffic" in argv
     grad = "--grad" in argv
+    flowmon = "--flowmon" in argv
+    pcap = "--pcap" in argv
     argv = [
         a for a in argv
         if a not in ("--serving", "--fuzz", "--distributed",
-                     "--geometry", "--traffic", "--grad")
+                     "--geometry", "--traffic", "--grad",
+                     "--flowmon", "--pcap")
     ]
     if (
         not argv
-        or serving + fuzz + distributed + geometry + traffic + grad > 1
+        or serving + fuzz + distributed + geometry + traffic + grad
+        + flowmon + pcap > 1
         or any(a in ("-h", "--help") for a in argv)
     ):
         print(__doc__, file=sys.stderr)
         return 2
+    if flowmon or pcap:
+        # non-JSON modes: FlowMonitor XML / raw libpcap bytes
+        from tpudes.obs.flowmon import validate_flowmon_xml, validate_pcap
+
+        rc = 0
+        for path in argv:
+            try:
+                if pcap:
+                    with open(path, "rb") as f:
+                        problems, n = validate_pcap(f.read())
+                else:
+                    with open(path, encoding="utf-8") as f:
+                        problems, n = validate_flowmon_xml(f.read())
+            except OSError as e:
+                print(f"{path}: unreadable ({e})", file=sys.stderr)
+                return 2
+            if problems:
+                rc = 1
+                for p in problems:
+                    print(f"{path}: {p}")
+            else:
+                kind = "pcap capture" if pcap else "FlowMonitor XML"
+                print(f"{path}: valid {kind} ({n} records)")
+        return rc
     if serving:
         validate, kind = validate_serving_metrics, "serving metrics"
     elif fuzz:

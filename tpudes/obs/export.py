@@ -8,7 +8,10 @@ is "where did the *wall time* go"; each span carries the simulated
 timestamp and context in ``args`` so the two clocks can be correlated.
 
 Layout: tid 0 carries host event spans, tid 1 the engine windows, plus
-a queue-depth counter track and one metadata record per track.  The
+a queue-depth counter track and one metadata record per track.  When a
+FlowMonitor (host or device) is passed along, tid 2 carries one span
+per flow — those run on the *simulated* clock (first tx → last rx, µs),
+which the track name flags so the two time bases aren't conflated.  The
 validator is dependency-free (no jsonschema in the image) and is what
 the CI smoke step runs over a real exported trace.
 """
@@ -23,10 +26,43 @@ _KNOWN_PHASES = set("BEXiICnbesftTPNODMVvRcG()")
 _PID = 1
 _TID_EVENTS = 0
 _TID_WINDOWS = 1
+_TID_FLOWS = 2
 
 
-def chrome_trace(profiler) -> dict:
-    """Build the trace document from a ``HostProfiler``."""
+def flow_trace_events(stats) -> list[dict]:
+    """Per-flow "X" spans for the flow track (tid 2) from a
+    ``{flow_id: FlowStats}`` map — the shape both
+    ``FlowMonitor.GetFlowStats`` and
+    ``DeviceFlowMonitor.GetFlowStats`` return.  Unlike the wall-clock
+    tracks, these run on the simulated clock (first tx → last rx)."""
+    events: list[dict] = [
+        {"ph": "M", "pid": _PID, "tid": _TID_FLOWS, "name": "thread_name",
+         "args": {"name": "flows (sim time)"}},
+    ]
+    for fid, st in sorted(stats.items()):
+        t0 = st.time_first_tx_s
+        if t0 is None or t0 < 0:
+            continue
+        t1 = st.time_last_rx_s
+        end = t1 if t1 is not None and t1 >= t0 else t0
+        events.append({
+            "ph": "X", "pid": _PID, "tid": _TID_FLOWS,
+            "name": f"flow {fid}", "cat": "flow",
+            "ts": round(t0 * 1e6, 3), "dur": round((end - t0) * 1e6, 3),
+            "args": {
+                "txPackets": st.tx_packets, "txBytes": st.tx_bytes,
+                "rxPackets": st.rx_packets, "rxBytes": st.rx_bytes,
+                "lostPackets": st.lost_packets,
+                "delaySumNs": round(st.delay_sum_s * 1e9),
+                "jitterSumNs": round(st.jitter_sum_s * 1e9),
+            },
+        })
+    return events
+
+
+def chrome_trace(profiler, flow_stats=None) -> dict:
+    """Build the trace document from a ``HostProfiler``; pass a
+    ``{flow_id: FlowStats}`` map to merge per-flow spans as tid 2."""
     events: list[dict] = [
         {"ph": "M", "pid": _PID, "tid": _TID_EVENTS, "name": "process_name",
          "args": {"name": "tpudes"}},
@@ -62,6 +98,8 @@ def chrome_trace(profiler) -> dict:
         "args": {"depth_max": profiler.queue_depth_max,
                  "depth_final": profiler.resync_depth()},
     })
+    if flow_stats:
+        events.extend(flow_trace_events(flow_stats))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -69,8 +107,8 @@ def chrome_trace(profiler) -> dict:
     }
 
 
-def export_chrome_trace(profiler, path: str) -> dict:
-    doc = chrome_trace(profiler)
+def export_chrome_trace(profiler, path: str, flow_stats=None) -> dict:
+    doc = chrome_trace(profiler, flow_stats)
     with open(path, "w") as f:
         json.dump(doc, f)
     return doc

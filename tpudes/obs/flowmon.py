@@ -1,0 +1,608 @@
+"""Device-resident FlowMonitor: per-flow KPI columns + packet rings.
+
+The host engines measure flows through
+:class:`tpudes.models.flow_monitor.FlowMonitor` riding the Ipv4 trace
+sources; the device engines cannot fire per-packet callbacks — their
+whole point is that the hot loop never leaves the accelerator.  This
+module is the device-side equivalent, split in two:
+
+- **In-kernel accumulators** (:func:`flow_carry`,
+  :func:`flow_accumulate`, :func:`flow_ring_write`): per-flow FlowStats
+  columns that ride the scan carry — tx/rx packets+bytes, RFC-3550
+  delay/jitter sums, loss, a fixed-bin delay histogram — plus a bounded
+  packet-event ring ``(step, t_us, flow, size, verdict)`` recycled
+  modularly by the engine's step counter.  All updates are DENSE
+  (one-hot / where forms); the single sparse op is the ring's
+  ``dynamic_update_slice``, registered as a machine-checked
+  ``SparseSite`` contract per engine (JXL008) rather than a gate
+  exemption.  The columns only exist when ``TpudesObs=1`` — a disabled
+  run compiles the exact pre-obs program (pinned in tests/test_obs.py).
+- **Host-side reduction** (:func:`decode_packet_rings`,
+  :func:`reduce_flow_stats`, :class:`DeviceFlowMonitor`): turn the
+  fetched columns/ring snapshots into the same
+  :class:`~tpudes.models.flow_monitor.FlowStats` objects the host
+  monitor produces, export them through the ONE shared XML serializer
+  (:func:`serialize_flow_stats_xml` — ``FlowMonitor.SerializeToXmlFile``
+  calls it too), and emit the ring's delivered packets as a classic
+  libpcap file in the ``network/trace_helper`` frame format, so
+  ``traffic/ingest.read_pcap`` round-trips a device run straight back
+  into a trace-replay :class:`~tpudes.traffic.TrafficProgram`.
+
+Accumulation semantics match the host monitor's callbacks
+(``_on_send`` / ``_on_deliver``) with one documented coarsening: the
+engines are step-synchronous, so when a flow delivers more than one
+packet in a single step the step contributes ONE delay observation
+(the per-step mean) to the jitter chain instead of one per packet.
+The pure-NumPy :func:`host_reference_stats` oracle applies the
+identical rule, and tests/test_flowmon.py additionally pins
+:func:`flow_accumulate` bit-for-bit against a live
+:class:`~tpudes.models.flow_monitor.FlowMonitor` on a shared
+one-packet-per-step event sequence, where the rules coincide exactly.
+
+Counters are ``int32`` (JXL002 dtype discipline): byte sums overflow
+past ~2.1 GB per flow — far beyond the chunked horizons the engines
+run, and the reducer checks for saturation loudly.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import NamedTuple
+
+import numpy as np
+
+from tpudes.models.flow_monitor import FiveTuple, FlowStats
+
+__all__ = [
+    "FLOW_DELAY_BINS",
+    "FLOW_RING_CAP",
+    "FM_KEYS",
+    "RING_COLS",
+    "VERDICT_TX",
+    "VERDICT_RX",
+    "VERDICT_DROP",
+    "DeviceFlowMonitor",
+    "PacketEvent",
+    "decode_packet_rings",
+    "flow_accumulate",
+    "flow_carry",
+    "flow_ring_write",
+    "host_reference_stats",
+    "reduce_flow_stats",
+    "serialize_flow_stats_xml",
+    "validate_flowmon_xml",
+    "validate_pcap",
+    "write_events_pcap",
+]
+
+#: fixed-bin delay histogram width (per-flow column ``fm_hist``)
+FLOW_DELAY_BINS = 16
+#: packet-event ring capacity — one slot per engine step, recycled
+#: modularly; a chunk no longer than this fetches a COMPLETE event log
+#: at every chunk boundary (the ChunkStream overlap path)
+FLOW_RING_CAP = 512
+#: ring row layout: (step, t_us, flow, size, verdict)
+RING_COLS = 5
+
+VERDICT_TX = 0
+VERDICT_RX = 1
+VERDICT_DROP = 2
+
+#: the carry/fetch keys :func:`flow_carry` creates — the engines fetch
+#: exactly this set (order is the stable fetch order)
+FM_KEYS = (
+    "fm_tx", "fm_txb", "fm_rx", "fm_rxb", "fm_lost",
+    "fm_dsum", "fm_jsum", "fm_dlast", "fm_t0", "fm_t1",
+    "fm_hist", "fm_ring",
+)
+
+
+class PacketEvent(NamedTuple):
+    """One decoded ring row (µs timestamp, ns-3 trace verdict)."""
+
+    step: int
+    t_us: int
+    flow: int
+    size: int
+    verdict: int
+
+
+# --- in-kernel accumulators (jax.numpy; imported lazily so the host
+# ---  layers can use the reducer without jax present) ----------------
+
+
+def flow_carry(n_flows: int, lead: tuple = (), ring_cap: int = FLOW_RING_CAP):
+    """The obs-only carry extension: per-flow FlowStats columns plus
+    the packet-event ring, all zero/sentinel-initialised.
+
+    ``lead`` prefixes every column with batch axes (the engine's
+    replica layout, e.g. ``(R,)`` or LTE's ``(1,)`` row convention).
+    Sentinels: ``fm_dlast``/``fm_t0``/``fm_t1`` start at ``-1.0`` (no
+    observation yet — the host monitor's ``None``), ring rows start at
+    step ``-1`` (never written)."""
+    import jax.numpy as jnp
+
+    F = int(n_flows)
+    z = lambda *s: jnp.zeros(lead + s, jnp.int32)  # noqa: E731
+    zf = lambda *s: jnp.zeros(lead + s, jnp.float32)  # noqa: E731
+    return dict(
+        fm_tx=z(F),
+        fm_txb=z(F),
+        fm_rx=z(F),
+        fm_rxb=z(F),
+        fm_lost=z(F),
+        fm_dsum=zf(F),
+        fm_jsum=zf(F),
+        fm_dlast=zf(F) - 1.0,
+        fm_t0=zf(F) - 1.0,
+        fm_t1=zf(F) - 1.0,
+        fm_hist=z(F, FLOW_DELAY_BINS),
+        fm_ring=jnp.full(
+            lead + (int(FLOW_RING_CAP), RING_COLS), -1, jnp.int32
+        ),
+    )
+
+
+def flow_accumulate(
+    fm: dict,
+    *,
+    t_s,
+    tx,
+    tx_bytes,
+    rx,
+    rx_bytes,
+    delay_s,
+    lost,
+    bin_width_s: float,
+):
+    """One step of FlowStats accumulation over the ``fm_*`` columns
+    (dense — no gather/scatter; the ring write is separate).
+
+    All operands broadcast against the ``(..., F)`` columns: ``tx``/
+    ``rx``/``lost`` are this step's per-flow packet counts, ``*_bytes``
+    the matching byte counts, ``delay_s`` the per-flow delay of this
+    step's deliveries (ignored where ``rx == 0``), ``t_s`` the current
+    sim time in seconds.  Jitter is the RFC-3550 accumulation the host
+    monitor runs (|delay - last_delay|), with one observation per
+    (step, flow) — see the module docstring for the multi-packet
+    coarsening rule."""
+    import jax.numpy as jnp
+
+    got = rx > 0
+    seen = fm["fm_dlast"] >= 0.0
+    delay_s = jnp.asarray(delay_s, jnp.float32)
+    t_s = jnp.asarray(t_s, jnp.float32)
+    bins = jnp.clip(
+        (delay_s / jnp.float32(bin_width_s)).astype(jnp.int32),
+        0,
+        FLOW_DELAY_BINS - 1,
+    )
+    one_hot = (
+        bins[..., None]
+        == jnp.arange(FLOW_DELAY_BINS, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    out = dict(fm)
+    out["fm_tx"] = fm["fm_tx"] + tx.astype(jnp.int32)
+    out["fm_txb"] = fm["fm_txb"] + tx_bytes.astype(jnp.int32)
+    out["fm_rx"] = fm["fm_rx"] + rx.astype(jnp.int32)
+    out["fm_rxb"] = fm["fm_rxb"] + rx_bytes.astype(jnp.int32)
+    out["fm_lost"] = fm["fm_lost"] + lost.astype(jnp.int32)
+    out["fm_dsum"] = fm["fm_dsum"] + delay_s * rx.astype(jnp.float32)
+    out["fm_jsum"] = fm["fm_jsum"] + jnp.where(
+        got & seen, jnp.abs(delay_s - fm["fm_dlast"]), 0.0
+    )
+    out["fm_dlast"] = jnp.where(got, delay_s, fm["fm_dlast"])
+    out["fm_t0"] = jnp.where(
+        (tx > 0) & (fm["fm_t0"] < 0.0), t_s, fm["fm_t0"]
+    )
+    out["fm_t1"] = jnp.where(got, t_s, fm["fm_t1"])
+    out["fm_hist"] = fm["fm_hist"] + one_hot * rx.astype(jnp.int32)[..., None]
+    return out
+
+
+def flow_ring_write(ring, counter, row):
+    """Write this step's event ``row`` at ring slot ``counter % CAP``
+    (modular recycling).  ``ring`` is ``(..., CAP, COLS)``, ``row`` the
+    matching ``(..., COLS)`` int32 vector (step ``-1`` = no event this
+    step — the slot is still overwritten, so a slot always describes
+    the LAST step that owned it).
+
+    This is the subsystem's one sparse op: a
+    ``jax.lax.dynamic_update_slice`` whose start index is the modular
+    step counter — registered per engine as a ``SparseSite`` contract
+    (mode ``clip``, provenance operand+mod) in
+    ``analysis/jaxpr/sparse_registry.py``.  ``.at[].set`` is avoided
+    deliberately: it may lower to scatter, which the no-gather engines
+    ban outright."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(counter, jnp.int32) % jnp.int32(ring.shape[-2])
+    starts = tuple(jnp.int32(0) for _ in range(ring.ndim - 2)) + (
+        idx,
+        jnp.int32(0),
+    )
+    return jax.lax.dynamic_update_slice(
+        ring, row.astype(jnp.int32)[..., None, :], starts
+    )
+
+
+# --- host-side reduction ---------------------------------------------
+
+
+def decode_packet_rings(rings) -> list[PacketEvent]:
+    """Merge ring snapshots (one per chunk boundary) into one event
+    list, sorted by step and deduped on the step column (unique per
+    event — every engine stamps rows with its monotonic step counter,
+    so the same event fetched at two chunk boundaries collapses).
+
+    Each snapshot is a ``(CAP, COLS)`` array slice (pick the replica /
+    config lane before calling); rows with step ``< 0`` are empty
+    slots.  Snapshots may arrive flipped or rotated — order inside a
+    ring is irrelevant, the step column is the total order."""
+    by_step: dict[int, PacketEvent] = {}
+    for ring in rings:
+        arr = np.asarray(ring)
+        if arr.ndim != 2 or arr.shape[-1] != RING_COLS:
+            raise ValueError(
+                f"ring snapshot must be (cap, {RING_COLS}), got "
+                f"{arr.shape} — slice the replica lane first"
+            )
+        for r in arr[arr[:, 0] >= 0]:
+            by_step[int(r[0])] = PacketEvent(*(int(v) for v in r))
+    return [by_step[s] for s in sorted(by_step)]
+
+
+def reduce_flow_stats(fm: dict) -> dict[int, FlowStats]:
+    """Fetched ``fm_*`` columns (leaves sliced to ``(F,)`` /
+    ``(F, BINS)``) → host :class:`FlowStats`, flow ids 1-based as
+    upstream's classifier assigns them.  Flows with no activity are
+    omitted (the host monitor only materialises a flow on its first
+    packet)."""
+    tx = np.asarray(fm["fm_tx"]).reshape(-1)
+    if (tx == np.iinfo(np.int32).max).any():
+        raise ValueError(
+            "fm_tx saturated int32 — shorten the horizon or shard flows"
+        )
+    F = tx.shape[0]
+    get = lambda k: np.asarray(fm[k]).reshape(F, -1).squeeze(-1)  # noqa: E731
+    rx = get("fm_rx")
+    lost = get("fm_lost")
+    dlast = np.asarray(fm["fm_dlast"], np.float64).reshape(-1)
+    t0 = np.asarray(fm["fm_t0"], np.float64).reshape(-1)
+    t1 = np.asarray(fm["fm_t1"], np.float64).reshape(-1)
+    out: dict[int, FlowStats] = {}
+    for i in range(F):
+        if tx[i] == 0 and rx[i] == 0 and lost[i] == 0:
+            continue
+        out[i + 1] = FlowStats(
+            tx_packets=int(tx[i]),
+            tx_bytes=int(get("fm_txb")[i]),
+            rx_packets=int(rx[i]),
+            rx_bytes=int(get("fm_rxb")[i]),
+            lost_packets=int(lost[i]),
+            delay_sum_s=float(np.asarray(fm["fm_dsum"])[i]),
+            jitter_sum_s=float(np.asarray(fm["fm_jsum"])[i]),
+            last_delay_s=float(dlast[i]) if dlast[i] >= 0 else None,
+            time_first_tx_s=float(t0[i]) if t0[i] >= 0 else None,
+            time_last_rx_s=float(t1[i]) if t1[i] >= 0 else None,
+        )
+    return out
+
+
+def host_reference_stats(
+    steps, n_flows: int | None = None
+) -> dict[int, FlowStats]:
+    """Pure-NumPy reference accumulator: the host monitor's
+    ``_on_send`` / ``_on_deliver`` / ``_on_drop`` arithmetic applied to
+    a per-step event stream, under the same one-observation-per-
+    (step, flow) jitter rule the device columns use.  ``steps`` is an
+    iterable of dicts with keys ``t_s`` and per-flow arrays ``tx``,
+    ``tx_bytes``, ``rx``, ``rx_bytes``, ``delay_s``, ``lost`` (exactly
+    :func:`flow_accumulate`'s operands) — the oracle the device columns
+    are validated against per engine."""
+    stats: dict[int, FlowStats] = {}
+    last: dict[int, float] = {}
+    for ev in steps:
+        t_s = float(ev["t_s"])
+        F = len(np.atleast_1d(ev["tx"])) if n_flows is None else n_flows
+        for i in range(F):
+            tx = int(np.atleast_1d(ev["tx"])[i])
+            rx = int(np.atleast_1d(ev["rx"])[i])
+            lost = int(np.atleast_1d(ev.get("lost", np.zeros(F)))[i])
+            if tx == 0 and rx == 0 and lost == 0:
+                continue
+            st = stats.setdefault(i + 1, FlowStats())
+            st.tx_packets += tx
+            st.tx_bytes += int(np.atleast_1d(ev["tx_bytes"])[i])
+            st.lost_packets += lost
+            if tx and st.time_first_tx_s is None:
+                st.time_first_tx_s = t_s
+            if rx:
+                delay = float(np.atleast_1d(ev["delay_s"])[i])
+                st.rx_packets += rx
+                st.rx_bytes += int(np.atleast_1d(ev["rx_bytes"])[i])
+                st.delay_sum_s += delay * rx
+                if i + 1 in last:
+                    st.jitter_sum_s += abs(delay - last[i + 1])
+                last[i + 1] = delay
+                st.last_delay_s = delay
+                st.time_last_rx_s = t_s
+    return stats
+
+
+# --- export: the ONE XML serializer + pcap emission ------------------
+
+
+def serialize_flow_stats_xml(
+    stats: dict[int, FlowStats],
+    flows: dict[FiveTuple, int],
+    filename: str,
+) -> None:
+    """flow-monitor.cc ``SerializeToXmlFile``: the standard FlowMonitor
+    XML shape (attribute names match upstream's parser ecosystem).
+    Shared by the host monitor and :class:`DeviceFlowMonitor` — one
+    serializer, two producers (REG001 trace-name parity)."""
+    with open(filename, "w") as f:
+        f.write("<?xml version=\"1.0\" ?>\n<FlowMonitor>\n  <FlowStats>\n")
+        for fid, st in sorted(stats.items()):
+            f.write(
+                f'    <Flow flowId="{fid}" '
+                f'txPackets="{st.tx_packets}" txBytes="{st.tx_bytes}" '
+                f'rxPackets="{st.rx_packets}" rxBytes="{st.rx_bytes}" '
+                f'lostPackets="{st.lost_packets}" '
+                f'delaySum="+{st.delay_sum_s * 1e9:.0f}ns" '
+                f'jitterSum="+{st.jitter_sum_s * 1e9:.0f}ns" />\n'
+            )
+        f.write("  </FlowStats>\n  <Ipv4FlowClassifier>\n")
+        for t, fid in (flows or {}).items():
+            f.write(
+                f'    <Flow flowId="{fid}" sourceAddress="{t.source}" '
+                f'destinationAddress="{t.destination}" '
+                f'protocol="{t.protocol}" sourcePort="{t.source_port}" '
+                f'destinationPort="{t.destination_port}" />\n'
+            )
+        f.write("  </Ipv4FlowClassifier>\n</FlowMonitor>\n")
+
+
+def write_events_pcap(
+    events,
+    filename: str,
+    *,
+    verdicts=(VERDICT_RX,),
+    data_link_type: int | None = None,
+    snap_len: int = 65535,
+) -> int:
+    """Emit decoded ring events as a classic libpcap file in the
+    ``network/trace_helper`` frame format (same magic/version/record
+    layout as :class:`~tpudes.network.trace_helper.PcapFileWrapper`),
+    so the device run opens in tcpdump/wireshark and — the round trip
+    this repo cares about — ``traffic/ingest.read_pcap`` reads it back
+    into a trace-replay table.
+
+    The device rings carry sizes, not payload bytes, so frames are
+    zero-filled and capped at ``snap_len`` while the record header
+    keeps the ORIGINAL length — exactly what ``read_pcap`` returns, so
+    the round trip is lossless on (µs time, wire bytes).  Returns the
+    record count."""
+    from tpudes.network.trace_helper import (
+        DLT_RAW,
+        PCAP_MAGIC,
+        PCAP_VERSION,
+    )
+
+    dlt = DLT_RAW if data_link_type is None else int(data_link_type)
+    n = 0
+    with open(filename, "wb") as f:
+        f.write(
+            struct.pack(
+                "<IHHiIII",
+                PCAP_MAGIC, PCAP_VERSION[0], PCAP_VERSION[1],
+                0, 0, snap_len, dlt,
+            )
+        )
+        for ev in events:
+            if ev.verdict not in verdicts:
+                continue
+            sec, usec = divmod(int(ev.t_us), 1_000_000)
+            cap = min(int(ev.size), snap_len)
+            f.write(
+                struct.pack("<IIII", sec, usec, cap, int(ev.size))
+                + b"\x00" * cap
+            )
+            n += 1
+    return n
+
+
+def validate_flowmon_xml(text: str) -> tuple[list, int]:
+    """Schema-check a FlowMonitor XML document (the shared serializer's
+    output, or upstream ns-3's — same attribute ecosystem).  Returns
+    ``(problems, n_flows)``; empty problems = valid.  Messages are
+    actionable: they name the element, the attribute and what to fix."""
+    import xml.etree.ElementTree as ET
+
+    problems: list[str] = []
+    try:
+        root = ET.fromstring(text)
+    except ET.ParseError as e:
+        return [f"not well-formed XML ({e}) — is this a FlowMonitor "
+                "SerializeToXmlFile output?"], 0
+    if root.tag != "FlowMonitor":
+        return [f"root element is <{root.tag}>, expected <FlowMonitor> "
+                "(SerializeToXmlFile writes <FlowMonitor> at top level)"], 0
+    stats = root.find("FlowStats")
+    if stats is None:
+        return ["missing <FlowStats> section under <FlowMonitor>"], 0
+    int_attrs = ("txPackets", "txBytes", "rxPackets", "rxBytes",
+                 "lostPackets")
+    ns_attrs = ("delaySum", "jitterSum")
+    seen_ids: set = set()
+    n = 0
+    for i, flow in enumerate(stats.findall("Flow")):
+        n += 1
+        where = f"FlowStats/Flow[{i}]"
+        fid = flow.get("flowId")
+        if fid is None:
+            problems.append(f"{where}: missing flowId attribute")
+        elif fid in seen_ids:
+            problems.append(f"{where}: duplicate flowId {fid}")
+        else:
+            seen_ids.add(fid)
+        for a in int_attrs:
+            v = flow.get(a)
+            if v is None:
+                problems.append(f"{where}: missing {a} attribute")
+            elif not v.lstrip("-").isdigit():
+                problems.append(
+                    f"{where}: {a}={v!r} is not an integer"
+                )
+            elif int(v) < 0:
+                problems.append(f"{where}: {a}={v} is negative")
+        for a in ns_attrs:
+            v = flow.get(a)
+            if v is None:
+                problems.append(f"{where}: missing {a} attribute")
+            elif not (v.startswith("+") and v.endswith("ns")):
+                problems.append(
+                    f"{where}: {a}={v!r} must be '+<nanoseconds>ns' "
+                    "(upstream ns-3 Time serialization)"
+                )
+    for i, flow in enumerate(
+        root.findall("Ipv4FlowClassifier/Flow")
+    ):
+        where = f"Ipv4FlowClassifier/Flow[{i}]"
+        for a in ("flowId", "sourceAddress", "destinationAddress"):
+            if flow.get(a) is None:
+                problems.append(f"{where}: missing {a} attribute")
+    return problems, n
+
+
+#: pcapng section-header magic — a different container format
+_PCAPNG_MAGIC = 0x0A0D0D0A
+#: classic-pcap magic accepted in either byte order, µs or ns ticks
+_PCAP_MAGICS = (0xA1B2C3D4, 0xA1B23C4D)
+
+
+def validate_pcap(data: bytes) -> tuple[list, int]:
+    """Structurally validate a classic libpcap capture: both byte
+    orders, both the microsecond and nanosecond magic.  Returns
+    ``(problems, n_records)``.  Walks every record header and checks it
+    against the remaining bytes, so a truncated or corrupt file names
+    the exact offset."""
+    if len(data) < 24:
+        return [f"file is {len(data)} bytes — a pcap global header is "
+                "24 bytes; not a capture file"], 0
+    (magic,) = struct.unpack("<I", data[:4])
+    if magic == _PCAPNG_MAGIC or struct.unpack(">I", data[:4])[0] == _PCAPNG_MAGIC:
+        return ["pcapng container, not classic pcap — convert with "
+                "`tcpdump -r in.pcapng -w out.pcap` or read with a "
+                "pcapng-aware tool"], 0
+    endian = None
+    for e in ("<", ">"):
+        (m,) = struct.unpack(e + "I", data[:4])
+        if m in _PCAP_MAGICS:
+            endian = e
+            magic = m
+            break
+    if endian is None:
+        return [f"unknown magic 0x{magic:08X} — expected classic pcap "
+                "0xA1B2C3D4 (µs) or 0xA1B23C4D (ns) in either byte "
+                "order"], 0
+    ver_major, ver_minor, _tz, _sig, snap_len, _dlt = struct.unpack(
+        endian + "HHiIII", data[4:24]
+    )
+    problems: list[str] = []
+    if ver_major != 2:
+        problems.append(
+            f"version {ver_major}.{ver_minor} — classic pcap is 2.x"
+        )
+    if snap_len == 0:
+        problems.append("snap_len is 0 — every record would be empty")
+    off = 24
+    n = 0
+    while off < len(data):
+        if off + 16 > len(data):
+            problems.append(
+                f"truncated record header at byte {off} "
+                f"({len(data) - off} bytes left, need 16)"
+            )
+            break
+        _sec, _sub, cap, orig = struct.unpack(
+            endian + "IIII", data[off:off + 16]
+        )
+        if cap > snap_len:
+            problems.append(
+                f"record {n} at byte {off}: incl_len {cap} exceeds "
+                f"snap_len {snap_len}"
+            )
+            break
+        if cap > orig:
+            problems.append(
+                f"record {n} at byte {off}: incl_len {cap} exceeds "
+                f"orig_len {orig}"
+            )
+        if off + 16 + cap > len(data):
+            problems.append(
+                f"record {n} at byte {off}: declares {cap} payload "
+                f"bytes but only {len(data) - off - 16} remain "
+                "(truncated capture)"
+            )
+            break
+        off += 16 + cap
+        n += 1
+    return problems, n
+
+
+class DeviceFlowMonitor:
+    """Host wrapper over one lane's reduced device telemetry: the same
+    reporting surface the host :class:`FlowMonitor` exposes
+    (``GetFlowStats`` / ``SerializeToXmlFile``) plus the device-only
+    exports (pcap, trace-replay round trip).
+
+    ``five_tuples`` optionally names each flow id's classifier tuple
+    for the XML's Ipv4FlowClassifier section; device engines have no
+    IP layer, so it defaults to empty (the section is emitted empty —
+    parsers that only read FlowStats are unaffected)."""
+
+    def __init__(
+        self,
+        fm: dict,
+        rings=(),
+        five_tuples: dict[int, FiveTuple] | None = None,
+    ):
+        self.stats = reduce_flow_stats(fm)
+        self.events = decode_packet_rings(rings) if len(rings) else []
+        self._flows = {
+            t: fid for fid, t in (five_tuples or {}).items()
+        }
+
+    def GetFlowStats(self) -> dict[int, FlowStats]:
+        return self.stats
+
+    def SerializeToXmlFile(self, filename: str, *_args) -> None:
+        serialize_flow_stats_xml(self.stats, self._flows, filename)
+
+    def WritePcap(self, filename: str, **kw) -> int:
+        return write_events_pcap(self.events, filename, **kw)
+
+    def ToTrafficProgram(self, n_entities: int | None = None, **kw):
+        """Delivered ring events → exact trace-replay
+        :class:`~tpudes.traffic.TrafficProgram` (one entity per flow id
+        seen, or ``n_entities`` fixed lanes), closing the ingest loop
+        against our own output without touching the filesystem."""
+        from tpudes.traffic.ingest import ingest_traces
+
+        rx = [e for e in self.events if e.verdict == VERDICT_RX]
+        flows = sorted({e.flow for e in rx})
+        if n_entities is not None:
+            flows = list(range(n_entities))
+        sources = []
+        for fl in flows:
+            mine = [e for e in rx if e.flow == fl]
+            sources.append(
+                (
+                    np.asarray([e.t_us for e in mine], np.int64),
+                    np.asarray([e.size for e in mine], np.int64),
+                )
+            )
+        return ingest_traces(sources, t0_us=0, **kw)

@@ -555,16 +555,31 @@ def _sm_cache_key(prog: LteSmProgram, replicas, n_cfg, obs, use_pallas) -> tuple
 _SM_FETCH = ("rx_lo", "rx_hi", "new_tbs", "retx", "drops", "ok_cnt")
 
 
+def _sm_fetch_obs() -> tuple:
+    from tpudes.obs.flowmon import FM_KEYS
+
+    return FM_KEYS
+
+
 def _sm_unpack(host: dict, consts_np: dict, replicas) -> dict:
     """Host-side result assembly for ONE config point (already
     device_get; drops the kernel's (1, U) row axis, slices the replica
-    padding, rebuilds the 52-bit rx counter)."""
-    result = {
-        k: np.asarray(v).reshape(np.shape(v)[:-2] + np.shape(v)[-1:])
-        for k, v in host.items()
-    }
+    padding, rebuilds the 52-bit rx counter).  FlowMonitor columns
+    (``fm_*``, present under TpudesObs) land in a ``flow`` sub-dict."""
+    result = {}
+    for k, v in host.items():
+        v = np.asarray(v)
+        if k in ("fm_hist", "fm_ring"):
+            # (…, 1, U, BINS) / (…, 1, CAP, 5): only the kernel row
+            # axis drops — the trailing two axes are payload
+            result[k] = np.squeeze(v, axis=-3)
+        else:
+            result[k] = v.reshape(v.shape[:-2] + v.shape[-1:])
     if replicas is not None and result["rx_lo"].shape[0] != replicas:
         result = {k: v[:replicas] for k, v in result.items()}
+    fm = {k: result.pop(k) for k in list(result) if k.startswith("fm_")}
+    if fm:
+        result["flow"] = fm
     result["rx_bits"] = (
         result.pop("rx_hi").astype(np.int64) << 20
     ) + result.pop("rx_lo").astype(np.int64)
@@ -637,6 +652,20 @@ def build_sm_advance(prog: LteSmProgram, r_pad: int | None = None,
     (:func:`trace_manifest`) abstractly traces the same program the
     runner cache compiles."""
     consts, init_state, step_fn = build_sm_step(prog, use_pallas)
+    if obs:
+        from tpudes.obs.flowmon import (
+            VERDICT_RX,
+            VERDICT_TX,
+            flow_accumulate,
+            flow_carry,
+            flow_ring_write,
+        )
+
+        U = prog.n_ue
+        base_init = init_state
+
+        def init_state():  # noqa: F811 — obs variant shadows on purpose
+            return dict(base_init(), **flow_carry(U, lead=(1,)))
 
     def advance(carry, k, sid, t_end):
         # per-TTI key = fold_in(k, t): a pure function of (k, t),
@@ -647,7 +676,67 @@ def build_sm_advance(prog: LteSmProgram, r_pad: int | None = None,
         def body(c):
             t, s = c
             kt = jax.random.fold_in(k, t)
-            return t + 1, step_fn(s, (t, kt), sid)
+            if not obs:
+                return t + 1, step_fn(s, (t, kt), sid)
+            # the fused TTI core builds exact-key state dicts, so the
+            # FlowMonitor columns ride AROUND it: split them off the
+            # carry, diff the cumulative counters across the TTI, and
+            # merge them back (flow = UE; one observation per TTI)
+            fm = {kk: v for kk, v in s.items() if kk.startswith("fm_")}
+            core = {kk: v for kk, v in s.items()
+                    if not kk.startswith("fm_")}
+            s2 = step_fn(core, (t, kt), sid)
+            d_ok = s2["ok_cnt"] - core["ok_cnt"]            # (1, U)
+            d_tx = (
+                (s2["new_tbs"] - core["new_tbs"])
+                + (s2["retx"] - core["retx"])
+            )
+            d_drop = s2["drops"] - core["drops"]
+            # acked bits this TTI, split-counter diff (bits far below
+            # 2^31 per TTI, so plain i32 arithmetic is exact)
+            d_bytes = (
+                ((s2["rx_hi"] - core["rx_hi"]) << jnp.int32(20))
+                + (s2["rx_lo"] - core["rx_lo"])
+            ) // jnp.int32(8)
+            tti_s = jnp.float32(1e-3)
+            fm = flow_accumulate(
+                fm,
+                t_s=t.astype(jnp.float32) * tti_s,
+                tx=d_tx,
+                # bytes are metered at ACK (the rx counters are the
+                # only byte stream the TTI core keeps) — documented
+                # coarsening: tx_bytes counts acknowledged bytes
+                tx_bytes=d_bytes,
+                rx=d_ok,
+                rx_bytes=d_bytes,
+                # MAC-to-ACK latency is one TTI by construction in the
+                # sub-band model — delay is exact, jitter is zero
+                delay_s=jnp.full((1, U), tti_s, jnp.float32),
+                lost=d_drop,
+                bin_width_s=1e-3,
+            )
+            got = jnp.sum(d_ok) > 0
+            sent = jnp.sum(d_tx) > 0
+            ev_flow = jnp.where(
+                got, jnp.argmax(d_ok[0]), jnp.argmax(d_tx[0])
+            ).astype(jnp.int32)
+            oh = (jnp.arange(U, dtype=jnp.int32) == ev_flow)
+            ev_bytes = jnp.sum(
+                d_bytes[0] * oh.astype(jnp.int32), dtype=jnp.int32
+            )
+            row = jnp.stack([
+                jnp.where(got | sent, t, jnp.int32(-1)),
+                t * jnp.int32(1000),
+                ev_flow,
+                ev_bytes,
+                jnp.where(
+                    got, jnp.int32(VERDICT_RX), jnp.int32(VERDICT_TX)
+                ),
+            ])
+            fm["fm_ring"] = flow_ring_write(
+                fm["fm_ring"], t, row[None, :]
+            )
+            return t + 1, dict(s2, **fm)
 
         t, s = jax.lax.while_loop(
             lambda c: c[0] < t_end, body, carry
@@ -660,6 +749,10 @@ def build_sm_advance(prog: LteSmProgram, r_pad: int | None = None,
             dict(
                 ok=jnp.sum(s["ok_cnt"]), drops=jnp.sum(s["drops"]),
                 retx=jnp.sum(s["retx"]),
+                # lax.rev is a real op XLA cannot fold into an alias of
+                # the donated carry; the decoder sorts by step, so the
+                # flipped order never needs undoing
+                fm_ring=jnp.flip(s["fm_ring"], axis=-2),
             )
             if obs
             else {}
@@ -1337,7 +1430,8 @@ def run_lte_sm(
         if compiling:
             jax.block_until_ready(carry)
 
-    fetch = {k: carry[1][k] for k in _SM_FETCH}
+    fetch_keys = _SM_FETCH + (_sm_fetch_obs() if obs else ())
+    fetch = {k: carry[1][k] for k in fetch_keys}
     consts_np = {
         "cqi": np.asarray(consts["cqi"]),
         "mcs": np.asarray(consts["mcs"]),
@@ -1545,6 +1639,13 @@ def trace_manifest():
             TraceVariant(
                 "traffic",
                 lambda: _trace_entries_traffic(_trace_traffic_prog()),
+            ),
+            # the TpudesObs program (FlowMonitor columns + packet ring)
+            # joins the lint surface: its ring write — a scatter here,
+            # the replica vmap batches the ring-slot start index — must
+            # pass the registered SparseSite contract (JXL008)
+            TraceVariant(
+                "obs", lambda: _trace_entries(_trace_prog(), obs=True)
             ),
         ],
         flips=_trace_flips,

@@ -590,6 +590,16 @@ def build_bss_step(
     R = replicas
     from tpudes.ops.wifi_error import ALL_MODES
 
+    if obs:
+        from tpudes.obs.flowmon import (
+            FLOW_DELAY_BINS,
+            VERDICT_RX,
+            VERDICT_TX,
+            flow_accumulate,
+            flow_carry,
+            flow_ring_write,
+        )
+
     data_mode = ALL_MODES[prog.data_mode_idx]
     ack_mode = ALL_MODES[prog.ack_mode_idx]
     AGG = prog.max_mpdus > 1
@@ -662,7 +672,13 @@ def build_bss_step(
             )
 
     def init_state():
-        extra = {"retx": jnp.zeros((R,), jnp.int32)} if obs else {}
+        extra = (
+            # flows = nodes (node 0 is the AP): per-flow FlowMonitor
+            # columns + the packet-event ring ride the carry
+            {"retx": jnp.zeros((R,), jnp.int32), **flow_carry(n, lead=(R,))}
+            if obs
+            else {}
+        )
         if MOBILE:
             # placeholders only: step 0 refreshes (0 % stride == 0), so
             # no outcome ever reads these zeros
@@ -1009,6 +1025,50 @@ def build_bss_step(
             if obs
             else {}
         )
+        if obs:
+            # FlowMonitor columns (flow = node): a data exchange sends
+            # k_agg MPDUs and delivers n_ok of them; delay = the MAC
+            # exchange airtime this PPDU occupied (dur_k µs); a failed
+            # exchange is a retransmission, not a loss — only retry-
+            # limit drops count as lost (the host monitor's Drop hook)
+            pkt_b = jnp.int32(
+                prog.subframe_bytes if AGG else prog.data_bytes
+            )
+            fm_tx = jnp.where(data_tx, k_agg, 0)
+            delay_us = dur_k.astype(jnp.float32)
+            fm = flow_accumulate(
+                {k: s[k] for k in s if k.startswith("fm_")},
+                t_s=next_t[:, None].astype(jnp.float32) * 1e-6,
+                tx=fm_tx,
+                tx_bytes=fm_tx * pkt_b,
+                rx=n_ok,
+                rx_bytes=n_ok * pkt_b,
+                delay_s=delay_us * 1e-6,
+                lost=drop_n,
+                bin_width_s=max(1, 2 * data_dur)
+                * 1e-6 / FLOW_DELAY_BINS,
+            )
+            # packet-event ring: one sampled event per (replica, step)
+            # — the node whose MPDUs were delivered, else the (failed)
+            # winner; idle steps stamp -1
+            has_rx = jnp.sum(n_ok, axis=1, dtype=jnp.int32) > 0
+            ev_flow = jnp.where(
+                has_rx, jnp.argmax(n_ok, axis=1),
+                jnp.argmax(winners.astype(jnp.int32), axis=1),
+            ).astype(jnp.int32)
+            ev_verdict = jnp.where(has_rx, VERDICT_RX, VERDICT_TX)
+            row = jnp.stack(
+                [
+                    jnp.where(any_win, s["step"], -1),
+                    next_t,
+                    ev_flow,
+                    jnp.broadcast_to(pkt_b, (R,)),
+                    ev_verdict,
+                ],
+                axis=-1,
+            )
+            fm["fm_ring"] = flow_ring_write(s["fm_ring"], s["step"], row)
+            extra.update(fm)
         if MOBILE:
             extra.update(geom_rx_w=rx_w_c, geom_det=det_c)
         return dict(
@@ -1109,6 +1169,10 @@ def build_bss_advance(prog: "BssProgram", replicas: int, obs: bool = False,
             dict(
                 srv_rx=jnp.sum(out["srv_rx"], dtype=jnp.int32),
                 drops=jnp.sum(out["drops"], dtype=jnp.int32),
+                # lax.rev keeps the ring snapshot FRESH (not an alias
+                # of the donated carry); the decoder orders rows by
+                # the step column, so the flip needs no undo
+                fm_ring=jnp.flip(out["fm_ring"], axis=-2),
             )
             if obs
             else {}
@@ -1182,7 +1246,14 @@ def _bss_unpack(host: dict, replicas: int, obs: bool, prog=None) -> dict:
         all_done=not bool(host["pending"][:R].any()),
     )
     if obs:
+        from tpudes.obs.flowmon import FM_KEYS
+
         result["retx"] = host["retx"][:R]
+        # per-flow FlowMonitor columns + the packet-event ring (flow =
+        # node), replica-sliced; reduce with tpudes.obs.flowmon
+        result["flow"] = {
+            k: np.asarray(host[k])[:R] for k in FM_KEYS
+        }
     if prog is not None and prog.mobility is not None:
         # geometry-refresh accounting: the cond fires on steps where
         # step % stride == 0, i.e. ceil(steps / stride) times.
@@ -1436,7 +1507,11 @@ def run_replicated_bss(
             pending=still_pending,
         )
         if obs:
+            from tpudes.obs.flowmon import FM_KEYS
+
             fetch["retx"] = out["retx"]
+            for k in FM_KEYS:
+                fetch[k] = out[k]
         if compiling:
             jax.block_until_ready(fetch)
 
@@ -1597,7 +1672,13 @@ def trace_manifest():
         variants=lambda: [
             TraceVariant(
                 "base", lambda: _trace_entries(_trace_prog())
-            )
+            ),
+            # the TpudesObs program (FlowMonitor columns + packet ring)
+            # joins the lint surface: its ring dynamic_update_slice
+            # must pass the registered SparseSite contract (JXL008)
+            TraceVariant(
+                "obs", lambda: _trace_entries(_trace_prog(), obs=True)
+            ),
         ],
         flips=_trace_flips,
     )

@@ -745,6 +745,16 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
     disabled run compiles the exact pre-obs program.
     """
     R, F, L = replicas, prog.n_flows, prog.buf_len
+    if obs:
+        from tpudes.obs.flowmon import (
+            FLOW_DELAY_BINS,
+            VERDICT_DROP,
+            VERDICT_RX,
+            VERDICT_TX,
+            flow_accumulate,
+            flow_carry,
+            flow_ring_write,
+        )
     start = jnp.asarray(prog.start_slot)
     stop = jnp.asarray(prog.stop_slot)
     max_pkts = jnp.asarray(prog.max_pkts)
@@ -770,6 +780,8 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
                 cwnd_cuts=z(R, F, dt=jnp.int32),
                 retx_cnt=z(R, F, dt=jnp.int32),
                 q_hist=z(R, OBS_QHIST_BINS, dt=jnp.int32),
+                # per-flow FlowMonitor columns + the packet-event ring
+                **flow_carry(F, lead=(R,)),
             )
             if obs
             else {}
@@ -1053,6 +1065,64 @@ def build_dumbbell_step(prog: DumbbellProgram, replicas: int, obs: bool = False)
                 q_hist=s["q_hist"]
                 + jax.nn.one_hot(bucket, OBS_QHIST_BINS, dtype=jnp.int32),
             )
+            # FlowMonitor columns: a packet is one segment + 40 header
+            # bytes (the host monitor counts GetSize()+20 on packets
+            # already carrying a 20-byte TCP header); one-way delay =
+            # half the base RTT plus the bottleneck residence this
+            # slot's departure saw — all dense adds, no sparse ops
+            pkt_b = jnp.int32(prog.seg_bytes + 40)
+            drop_f = rej + red_drops
+            delay = (
+                0.5 * base_rtt
+                + qtot.astype(jnp.float32)[:, None] * slot_s
+            )
+            fm = flow_accumulate(
+                {k: s[k] for k in s if k.startswith("fm_")},
+                t_s=t * slot_s,
+                tx=want,
+                tx_bytes=want * pkt_b,
+                rx=dep_oh,
+                rx_bytes=dep_oh * pkt_b,
+                delay_s=jnp.broadcast_to(delay, (R, F)),
+                lost=drop_f,
+                bin_width_s=(
+                    0.5 * prog.base_rtt_s + Q * prog.slot_s
+                ) / FLOW_DELAY_BINS,
+            )
+            # packet-event ring: ONE sampled event per (replica, slot)
+            # — the delivery if one happened (at most one per replica
+            # per slot: dep_oh is one-hot), else a drop, else a send;
+            # step column -1 marks an idle slot
+            has_drop = drop_f.sum(axis=1, dtype=jnp.int32) > 0
+            has_tx = want.sum(axis=1, dtype=jnp.int32) > 0
+            ev_flow = jnp.where(
+                backlogged,
+                dep,
+                jnp.where(
+                    has_drop,
+                    jnp.argmax(drop_f, axis=1),
+                    jnp.argmax(want, axis=1),
+                ),
+            ).astype(jnp.int32)
+            ev_verdict = jnp.where(
+                backlogged,
+                VERDICT_RX,
+                jnp.where(has_drop, VERDICT_DROP, VERDICT_TX),
+            )
+            any_ev = backlogged | has_drop | has_tx
+            slot_us_c = jnp.int32(max(1, round(prog.slot_s * 1e6)))
+            row = jnp.stack(
+                [
+                    jnp.where(any_ev, t, -1),
+                    jnp.broadcast_to(t * slot_us_c, (R,)),
+                    ev_flow,
+                    jnp.broadcast_to(pkt_b, (R,)),
+                    ev_verdict,
+                ],
+                axis=-1,
+            )
+            fm["fm_ring"] = flow_ring_write(s["fm_ring"], t, row)
+            extra.update(fm)
         return dict(
             **extra,
             cwnd=cwnd, ssthresh=ssthresh, inflight=inflight, q=q,
@@ -1137,6 +1207,13 @@ def build_dumbbell_advance(prog: DumbbellProgram, r_pad: int,
                     s["delivered"], axis=-1, dtype=jnp.int32
                 ),
                 drops=jnp.sum(s["drops"], axis=-1, dtype=jnp.int32),
+                # the per-chunk packet-ring snapshot must be a FRESH
+                # value (drive_chunks donates the carry before the
+                # deferred fetch reads the metrics): lax.rev is a real
+                # op XLA cannot fold back into an alias, and the
+                # decoder orders rows by the step column, so the flip
+                # needs no undo
+                fm_ring=jnp.flip(s["fm_ring"], axis=-2),
             )
             if obs
             else {}
@@ -1178,7 +1255,12 @@ def _variant_ecn(variant_idx: np.ndarray) -> np.ndarray:
 
 #: state keys fetched to the host at run end (plus the obs extras)
 _TCP_FETCH = ("delivered", "drops", "qsum", "cwnd")
-_TCP_FETCH_OBS = ("cwnd_cuts", "retx_cnt", "q_hist")
+
+
+def _tcp_fetch_obs():
+    from tpudes.obs.flowmon import FM_KEYS
+
+    return ("cwnd_cuts", "retx_cnt", "q_hist") + FM_KEYS
 
 
 def _planted_divergence(finalize):
@@ -1215,10 +1297,15 @@ def _tcp_unpack(host: dict, prog: DumbbellProgram, replicas: int,
         cwnd_final=np.asarray(host["cwnd"])[:R],
     )
     if obs:
+        from tpudes.obs.flowmon import FM_KEYS
+
         result.update(
             cwnd_cuts=np.asarray(host["cwnd_cuts"])[:R],
             retx=np.asarray(host["retx_cnt"])[:R],
             queue_hist=np.asarray(host["q_hist"])[:R],
+            # per-flow FlowMonitor columns + the packet-event ring,
+            # replica-sliced; reduce with tpudes.obs.flowmon
+            flow={k: np.asarray(host[k])[:R] for k in FM_KEYS},
         )
     return result
 
@@ -1448,7 +1535,7 @@ def run_tcp_dumbbell(
         if compiling:
             jax.block_until_ready(carry)
 
-    keys = _TCP_FETCH + (_TCP_FETCH_OBS if obs else ())
+    keys = _TCP_FETCH + (_tcp_fetch_obs() if obs else ())
     fetch = {k: carry[1][k] for k in keys}
     finalize = finalize_with_flush(
         flush,
@@ -1583,7 +1670,13 @@ def trace_manifest():
         variants=lambda: [
             TraceVariant(
                 "base", lambda: _trace_entries(_trace_prog())
-            )
+            ),
+            # the TpudesObs program (FlowMonitor columns + packet ring)
+            # joins the lint surface: its ring dynamic_update_slice
+            # must pass the registered SparseSite contract (JXL008)
+            TraceVariant(
+                "obs", lambda: _trace_entries(_trace_prog(), obs=True)
+            ),
         ],
         flips=_trace_flips,
     )

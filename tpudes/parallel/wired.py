@@ -72,6 +72,12 @@ __all__ = [
 #: ``INF_SLOT + service + delay`` never overflows int32
 INF_SLOT = 1 << 30
 
+#: nominal wire bytes per packet for the FlowMonitor byte counters —
+#: the slot model has no packet sizes (service cost lives in
+#: ``service_slots``), so the device FlowStats report this constant
+#: per packet, like a fixed-MTU trace
+WIRED_PKT_BYTES = 1000
+
 
 class UnliftableWiredError(ValueError):
     """The wired program is malformed for the slot model (bad path,
@@ -570,7 +576,7 @@ def _make_lane_step(P: int, Lo: int):
 
 
 def build_wired_advance(prog: WiredProgram, replicas: int, owned=None,
-                        flow_ids=None):
+                        flow_ids=None, obs: bool = False):
     """Return ``(init_state, advance)`` for the windowed wired kernel.
 
     ``owned`` is an (L,) bool mask of the links THIS engine instance
@@ -625,12 +631,47 @@ def build_wired_advance(prog: WiredProgram, replicas: int, owned=None,
     }
     step, next_of = _make_lane_step(P, Lo)
 
+    if obs:
+        from tpudes.obs.flowmon import (
+            FLOW_DELAY_BINS,
+            VERDICT_RX,
+            VERDICT_TX,
+            flow_accumulate,
+            flow_carry,
+            flow_ring_write,
+        )
+
+        F = int(prog.n_flows)
+        # (P, F) flow-membership CONSTANT: every per-flow reduction is
+        # a matmul against it (counts/slot sums far below 2^24, exact
+        # in f32) — the no-gather contract stays intact
+        flow_oh = jnp.asarray(
+            pkt_flow_np[:, None] == np.arange(F, dtype=pkt_flow_np.dtype),
+            jnp.float32,
+        )
+        valid_h = np.asarray(prog.paths) >= 0
+        safe_h = np.clip(np.asarray(prog.paths), 0, L - 1)
+        path_slots = np.where(
+            valid_h,
+            np.asarray(prog.service_slots)[safe_h]
+            + np.asarray(prog.delay_slots)[safe_h],
+            0,
+        ).sum(axis=1)
+        # histogram bin width in SLOT units: slot_s is a reporting-only
+        # scale that never reaches the compiled program (wired_cache_key
+        # excludes it) — run_wired's unpack scales the fetched float
+        # columns to seconds on the host
+        bin_slots = max(1.0, 2.0 * float(path_slots.max()) / FLOW_DELAY_BINS)
+
+        def per_flow(mask_f32):
+            return jnp.matmul(mask_f32, flow_oh)        # (R, F)
+
     def init_state(key, replica_offset: int = 0):
         jit_rf = _replica_jitter(
             prog, key, R, replica_offset, flow_ids
         )  # (R, F)
         birth = pkt_birth[None, :] + jit_rf[:, pkt_flow]  # (R, P)
-        return dict(
+        state = dict(
             t=jnp.int32(0),
             hop=jnp.zeros((R, P), jnp.int32),
             ready=birth.astype(jnp.int32),
@@ -640,6 +681,15 @@ def build_wired_advance(prog: WiredProgram, replicas: int, owned=None,
             eg_ready=jnp.full((R, P), -1, jnp.int32),
             served=jnp.zeros((R, Lo), jnp.int32),
         )
+        if obs:
+            # fm_birth: the jittered send slot of every packet (delay =
+            # deliver - birth, exact); fm_mark: the last slot whose
+            # births were folded into fm_tx (exactly-once accounting
+            # across event steps AND window boundaries)
+            state.update(flow_carry(F, lead=(R,)))
+            state["fm_birth"] = state["ready"]
+            state["fm_mark"] = jnp.int32(-1)
+        return state
 
     vstep = jax.vmap(
         lambda t, *s: step(tbl, t, *s),
@@ -667,19 +717,107 @@ def build_wired_advance(prog: WiredProgram, replicas: int, owned=None,
 
         def body(c):
             t, n_steps = c[0], c[1]
-            new, nxt = vstep(t, *c[2:-1])
+            if not obs:
+                new, nxt = vstep(t, *c[2:-1])
+                t_next = jnp.maximum(
+                    t + 1, jnp.minimum(jnp.min(nxt), t_grant)
+                )
+                return (t_next, n_steps + 1, *new, nxt)
+            # obs variant: the fm dict rides at the end of the loop
+            # carry; deliveries are the deliver-column edge this event
+            # step, sends the births that became visible since the
+            # last accounted slot (fm_mark) — exactly-once per packet
+            fm = c[-1]
+            new, nxt = vstep(t, *c[2:-2])
+            new_del = (new[3] >= 0) & (c[5] < 0)            # (R, P)
+            born = (
+                (fm["fm_birth"] > fm["fm_mark"])
+                & (fm["fm_birth"] <= t)
+            )
+            rx_f = per_flow(new_del.astype(jnp.float32)).astype(jnp.int32)
+            tx_f = per_flow(born.astype(jnp.float32)).astype(jnp.int32)
+            dsum_f = per_flow(
+                jnp.where(
+                    new_del,
+                    (new[3] - fm["fm_birth"]).astype(jnp.float32),
+                    0.0,
+                )
+            )
+            # per-(step, flow) delay observation = the step mean (the
+            # documented multi-packet coarsening); dsum accumulates
+            # mean*rx = the exact per-packet slot sum
+            mean_d = dsum_f / jnp.maximum(rx_f, 1).astype(jnp.float32)
+            fm2 = flow_accumulate(
+                fm,
+                t_s=t.astype(jnp.float32),                  # slot units
+                tx=tx_f,
+                tx_bytes=tx_f * jnp.int32(WIRED_PKT_BYTES),
+                rx=rx_f,
+                rx_bytes=rx_f * jnp.int32(WIRED_PKT_BYTES),
+                delay_s=mean_d,                             # slot units
+                lost=jnp.zeros_like(rx_f),
+                bin_width_s=bin_slots,
+            )
+            any_rx = new_del.any(axis=1)
+            any_tx = born.any(axis=1)
+            ev_flow = jnp.where(
+                any_rx,
+                jnp.argmax(rx_f, axis=1),
+                jnp.argmax(tx_f, axis=1),
+            ).astype(jnp.int32)
+            row = jnp.stack([
+                jnp.where(any_rx | any_tx, t, jnp.int32(-1)),
+                jnp.broadcast_to(t, (R,)),  # slot; host scales to µs
+                ev_flow,
+                jnp.full((R,), WIRED_PKT_BYTES, jnp.int32),
+                jnp.where(
+                    any_rx, jnp.int32(VERDICT_RX), jnp.int32(VERDICT_TX)
+                ),
+            ], axis=-1)
+            fm2["fm_ring"] = flow_ring_write(fm["fm_ring"], t, row)
+            fm2["fm_mark"] = t
             t_next = jnp.maximum(t + 1, jnp.minimum(jnp.min(nxt), t_grant))
-            return (t_next, n_steps + 1, *new, nxt)
+            return (t_next, n_steps + 1, *new, nxt, fm2)
 
         nxt0 = jnp.full((R,), INF_SLOT, jnp.int32)
+        loop0 = (state[0], jnp.int32(0), *state[1:], nxt0)
+        if obs:
+            loop0 = loop0 + (
+                {k: v for k, v in carry.items() if k.startswith("fm_")},
+            )
+        out = jax.lax.while_loop(cond, body, loop0)
         (t, n_steps, hop, ready, free, deliver, eg_hop, eg_ready,
-         served, nxt) = jax.lax.while_loop(
-            cond, body, (state[0], jnp.int32(0), *state[1:], nxt0)
-        )
+         served, nxt) = out[:10]
         carry = dict(
             t=t, hop=hop, ready=ready, free=free, deliver=deliver,
             eg_hop=eg_hop, eg_ready=eg_ready, served=served,
         )
+        if obs:
+            fm = out[10]
+            # window-edge flush: births the event loop never visited
+            # (their first service met a busy link past the grant) are
+            # still sends of THIS window — fold them in so fm_tx is
+            # exact at every boundary; the next window resumes at
+            # fm_mark = t_grant - 1
+            born = (
+                (fm["fm_birth"] > fm["fm_mark"])
+                & (fm["fm_birth"] < t_grant)
+            )
+            tx_f = per_flow(born.astype(jnp.float32)).astype(jnp.int32)
+            zf = jnp.zeros_like(tx_f)
+            fm = flow_accumulate(
+                fm,
+                t_s=(t_grant - 1).astype(jnp.float32),
+                tx=tx_f,
+                tx_bytes=tx_f * jnp.int32(WIRED_PKT_BYTES),
+                rx=zf,
+                rx_bytes=zf,
+                delay_s=jnp.zeros(tx_f.shape, jnp.float32),
+                lost=zf,
+                bin_width_s=bin_slots,
+            )
+            fm["fm_mark"] = t_grant - 1
+            carry.update(fm)
         # the loop's LAST step already reduced the final state's next
         # interesting slot — recompute the full locate chain only for
         # the rare zero-step window (priming / an empty grant), where
@@ -690,6 +828,11 @@ def build_wired_advance(prog: WiredProgram, replicas: int, owned=None,
             lambda: jnp.min(nxt),
         )
         metrics = dict(next_event=next_event, n_steps=n_steps)
+        if obs:
+            # lax.rev is a real op XLA cannot fold into an alias of the
+            # donated carry (drive_chunks freshness invariant); the
+            # decoder sorts by the step column, so order never matters
+            metrics["fm_ring"] = jnp.flip(carry["fm_ring"], axis=-2)
         return carry, metrics
 
     return init_state, advance
@@ -928,23 +1071,26 @@ def run_wired(
     import jax
     import jax.numpy as jnp
 
-    from tpudes.obs.device import CompileTelemetry
+    from tpudes.obs.device import CompileTelemetry, device_metrics_enabled
     from tpudes.parallel.runtime import (
         RUNTIME,
         EngineFuture,
         bucket_replicas,
         chunk_bounds,
         donate_argnums,
+        drive_chunks,
+        finalize_with_flush,
         shard_replica_axis,
     )
 
     r_pad = bucket_replicas(replicas, mesh)
+    obs = device_metrics_enabled()
     # see wired_cache_key for what is (deliberately) absent;
     # replica_offset only shifts host-side init-state construction
-    ck = wired_cache_key(prog) + (r_pad,)
+    ck = wired_cache_key(prog) + (r_pad, obs)
 
     def build():
-        init_state, advance = build_wired_advance(prog, r_pad)
+        init_state, advance = build_wired_advance(prog, r_pad, obs=obs)
         fn = jax.jit(advance, donate_argnums=donate_argnums(0))
         return init_state, fn
 
@@ -958,18 +1104,50 @@ def run_wired(
     )
     bounds = chunk_bounds(prog.n_slots, window_slots or prog.n_slots)
     with CompileTelemetry.timed("wired", compiling):
-        for bound in bounds:
-            carry, _ = fn(carry, *no_ingress, jnp.int32(bound))
-            RUNTIME.record_launch("wired")
+        carry, flush = drive_chunks(
+            "wired",
+            bounds,
+            carry,
+            lambda c, t_end: fn(c, *no_ingress, jnp.int32(t_end)),
+            obs,
+        )
         if compiling:
             jax.block_until_ready(carry)
 
     fetch = dict(deliver=carry["deliver"], served=carry["served"])
+    if obs:
+        from tpudes.obs.flowmon import FM_KEYS
+
+        for k in FM_KEYS:
+            fetch[k] = carry[k]
 
     def finalize(host):
-        return _wired_unpack(host, prog, replicas)
+        out = _wired_unpack(host, prog, replicas)
+        fm = {
+            k: np.asarray(v)[:replicas]
+            for k, v in host.items()
+            if k.startswith("fm_")
+        }
+        if fm:
+            # the device accumulates in SLOT units (slot_s is a
+            # reporting-only scale excluded from wired_cache_key, so it
+            # must never reach the compiled program) — scale the float
+            # columns to seconds and the ring timestamps to µs here;
+            # the -1.0 sentinels stay negative under the positive scale
+            slot_s = float(prog.slot_s)
+            for k in ("fm_dsum", "fm_jsum", "fm_dlast", "fm_t0", "fm_t1"):
+                fm[k] = np.asarray(fm[k], np.float64) * slot_s
+            ring = np.asarray(fm["fm_ring"], np.int64).copy()
+            ring[..., 1] = np.where(
+                ring[..., 0] >= 0,
+                np.round(ring[..., 1] * slot_s * 1e6).astype(np.int64),
+                ring[..., 1],
+            )
+            fm["fm_ring"] = ring
+            out["flow"] = fm
+        return out
 
-    fut = EngineFuture("wired", fetch, finalize)
+    fut = EngineFuture("wired", fetch, finalize_with_flush(flush, finalize))
     return fut.result() if block else fut
 
 
@@ -1066,7 +1244,8 @@ def _trace_prog(**over):
     return dataclasses.replace(prog, **over) if over else prog
 
 
-def _trace_entries(prog: WiredProgram, scale: bool = True):
+def _trace_entries(prog: WiredProgram, scale: bool = True,
+                   obs: bool = False):
     """The two cached-runner functions exactly as ``run_wired`` jits
     them, with concrete tiny operands.  ``scale=False`` skips the
     JXL007 axis declarations (the axis builders re-enter here for
@@ -1076,7 +1255,7 @@ def _trace_entries(prog: WiredProgram, scale: bool = True):
 
     from tpudes.analysis.jaxpr.spec import TraceEntry
 
-    init_state, advance = build_wired_advance(prog, _TRACE_R)
+    init_state, advance = build_wired_advance(prog, _TRACE_R, obs=obs)
     key = jax.random.PRNGKey(0)
     carry = init_state(key)
     P = int(carry["hop"].shape[1])
@@ -1161,6 +1340,12 @@ def _trace_flips():
     return {
         # live components: each must change some traced program
         "jitter_slots": flip(jitter_slots=0),
+        # TpudesObs: the FlowMonitor columns/ring join the carry — a
+        # different executable, keyed (run_wired appends obs to ck)
+        "obs": FlipSpec(
+            build=lambda: _trace_entries(base, obs=True),
+            key_differs=True,
+        ),
         "service_slots": flip(
             service_slots=np.asarray([2, 2, 1], np.int32)
         ),
@@ -1191,7 +1376,14 @@ def trace_manifest():
         variants=lambda: [
             TraceVariant(
                 "base", lambda: _trace_entries(_trace_prog())
-            )
+            ),
+            # the TpudesObs program (FlowMonitor columns + packet ring)
+            # joins the lint surface: its ring dynamic_update_slice
+            # must pass the registered SparseSite contract — the
+            # no-gather ban is relaxed ONLY for verified contracts
+            TraceVariant(
+                "obs", lambda: _trace_entries(_trace_prog(), obs=True)
+            ),
         ],
         flips=_trace_flips,
     )
